@@ -1,0 +1,32 @@
+"""h2o3_tpu — a TPU-native, in-memory, distributed machine-learning platform.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of H2O-3
+(reference: /root/reference, Java). The reference's four load-bearing ideas map to:
+
+  * Frame/Vec/Chunk (water/fvec/Frame.java)        -> sharded columnar arrays
+    (host-canonical numpy columns, device shards over a ``jax.sharding.Mesh``)
+  * MRTask map + tree-reduce (water/MRTask.java)   -> ``shard_map`` + ``psum``
+  * DKV distributed K/V store (water/DKV.java)     -> host-side keyed catalog
+    (JAX owns device placement; no coherence protocol needed)
+  * Rapids DSL + REST API (water/rapids/)          -> same logical op surface
+
+This is NOT a port: no Java cluster runtime, no custom UDP/TCP transport, no
+Paxos — XLA collectives over ICI/DCN and the JAX distributed runtime replace
+all of it (SURVEY.md §5 "Distributed communication backend").
+"""
+
+__version__ = "0.1.0"
+
+from h2o3_tpu.frame.frame import Frame, Column, ColType
+from h2o3_tpu.frame.parse import parse_csv, parse_setup
+from h2o3_tpu.keyed import KeyedStore, DKV
+
+__all__ = [
+    "Frame",
+    "Column",
+    "ColType",
+    "parse_csv",
+    "parse_setup",
+    "KeyedStore",
+    "DKV",
+]

@@ -1,0 +1,4 @@
+from h2o3_tpu.compute.mapreduce import FrameTable, map_reduce
+from h2o3_tpu.compute.quantile import quantiles
+
+__all__ = ["FrameTable", "map_reduce", "quantiles"]

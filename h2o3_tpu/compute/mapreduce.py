@@ -1,0 +1,137 @@
+"""The compute primitive: shard_map + psum ≡ MRTask map + tree-reduce.
+
+Reference: ``new MRTask(){ map(Chunk[]); reduce(T); }.doAll(frame)``
+(``water/MRTask.java:15-64,391``) — fan out over the node tree, map each home
+chunk, reduce partials pairwise back up the tree (``MRTask.java:96-127``).
+
+TPU-native: the node tree and hand-rolled reduction disappear. A user map
+function runs per device shard under ``shard_map`` and partials are combined
+with ``lax.psum`` — XLA emits the log-depth reduction over ICI natively.
+Everything above this layer (rollups, metrics, GLM Gram, tree histograms,
+KMeans assignments, …) is expressed in terms of these two calls, exactly the
+way everything in the reference sits on MRTask (SURVEY.md §1).
+
+Two entry points:
+  * ``map_reduce(fn, table)``   — fn: (cols, mask) -> pytree of partials; psum'd.
+  * ``map_batches(fn, table)``  — fn: (cols, mask) -> per-row outputs; stays sharded
+    (the analogue of an MRTask producing NewChunks / outputFrame).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # JAX >= 0.6 top-level API, older fallback
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+from h2o3_tpu.frame.frame import ColType, Frame
+from h2o3_tpu.parallel.mesh import DATA_AXIS, default_mesh, row_mask, shard_rows
+
+
+class FrameTable:
+    """Device-resident, row-sharded view of (a subset of) a Frame.
+
+    Columns are float32 by default (the TPU-native compute dtype; float64 on
+    request for e.g. exact Gram accumulation), padded to a multiple of the
+    mesh size, with a boolean validity ``mask`` for the pad rows.
+    """
+
+    def __init__(
+        self,
+        arrays: Dict[str, jax.Array],
+        mask: jax.Array,
+        n_valid: int,
+        mesh: Mesh,
+    ) -> None:
+        self.arrays = arrays
+        self.mask = mask
+        self.n_valid = n_valid
+        self.mesh = mesh
+
+    @staticmethod
+    def from_frame(
+        frame: Frame,
+        columns: Optional[Sequence[str]] = None,
+        mesh: Optional[Mesh] = None,
+        dtype=jnp.float32,
+    ) -> "FrameTable":
+        mesh = mesh or default_mesh()
+        names = list(columns) if columns is not None else [
+            c.name for c in frame.columns if c.type not in (ColType.STR, ColType.UUID)
+        ]
+        if not names:
+            raise ValueError("no device-shardable (numeric/categorical/time) columns")
+        arrays: Dict[str, jax.Array] = {}
+        n = frame.nrows
+        for name in names:
+            col = frame.col(name)
+            host = col.numeric_view().astype(np.dtype(dtype.dtype if hasattr(dtype, "dtype") else dtype))
+            arr, n = shard_rows(host, mesh, fill=np.nan)
+            arrays[name] = arr
+        some = next(iter(arrays.values()))
+        mask = row_mask(n, some.shape[0], mesh)
+        return FrameTable(arrays, mask, n, mesh)
+
+    @property
+    def n_padded(self) -> int:
+        return next(iter(self.arrays.values())).shape[0]
+
+    def matrix(self, columns: Optional[Sequence[str]] = None) -> jax.Array:
+        """[N_pad, F] feature matrix (column-stacked, row-sharded)."""
+        names = list(columns) if columns is not None else list(self.arrays)
+        return jnp.stack([self.arrays[n] for n in names], axis=1)
+
+
+def map_reduce(
+    fn: Callable,
+    table: FrameTable,
+    *extra_args,
+    reduce: str = "sum",
+):
+    """Run ``fn(cols_dict, mask, *extra)`` per shard; psum/pmax/pmin partials.
+
+    ``fn`` must be jax-traceable and return a pytree of arrays whose shapes do
+    not depend on the shard content (static shapes — the SPMD contract).
+    The returned pytree is fully reduced and replicated on every device.
+    """
+    red = {"sum": jax.lax.psum, "max": jax.lax.pmax, "min": jax.lax.pmin}[reduce]
+
+    def shard_fn(arrays, mask, *extras):
+        part = fn(arrays, mask, *extras)
+        return jax.tree.map(lambda x: red(x, DATA_AXIS), part)
+
+    mapped = _shard_map(
+        shard_fn,
+        mesh=table.mesh,
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS)) + tuple(P() for _ in extra_args),
+        out_specs=P(),
+    )
+    return jax.jit(mapped)(table.arrays, table.mask, *extra_args)
+
+
+def map_batches(fn: Callable, table: FrameTable, *extra_args):
+    """Run ``fn(cols_dict, mask, *extra)`` per shard, keep outputs row-sharded.
+
+    The analogue of an MRTask writing NewChunks into an output Frame
+    (``water/MRTask.java:558-559`` outputFrame)."""
+
+    mapped = _shard_map(
+        fn,
+        mesh=table.mesh,
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS)) + tuple(P() for _ in extra_args),
+        out_specs=P(DATA_AXIS),
+    )
+    return jax.jit(mapped)(table.arrays, table.mask, *extra_args)
+
+
+def gather_rows(x: jax.Array, n_valid: int) -> np.ndarray:
+    """Pull a row-sharded device result back to host, dropping pad rows."""
+    return np.asarray(jax.device_get(x))[:n_valid]

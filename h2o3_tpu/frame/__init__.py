@@ -1,0 +1,4 @@
+from h2o3_tpu.frame.frame import Column, ColType, Frame
+from h2o3_tpu.frame.parse import parse_csv, parse_setup
+
+__all__ = ["Column", "ColType", "Frame", "parse_csv", "parse_setup"]

@@ -1,0 +1,441 @@
+"""Columnar distributed Frame — the TPU-native Frame/Vec/Chunk.
+
+Reference design (water/fvec/): a ``Frame`` is a named list of ``Vec``s; each
+``Vec`` is one distributed column cut into ``Chunk``s by row ranges with ~23
+per-chunk compression codecs chosen at write time (``water/fvec/Chunk.java:35-43``),
+plus lazy cached ``RollupStats`` (``water/fvec/RollupStats.java``).
+
+TPU-native redesign:
+
+  * The host-canonical representation of a column is ONE dense numpy array
+    (float64 for NUM/TIME with NaN as the NA sentinel — same sentinel the
+    reference uses for numeric NAs — int32 codes with -1 for CAT, object array
+    for STR). Chunk codecs are pointless on TPU: XLA wants dense, statically
+    shaped, contiguous arrays, and HBM is fed by the host in bulk. The
+    "compression" that matters (uint8 bin codes for tree training, bfloat16
+    activations) happens at the *compute* layer instead.
+  * The device representation is produced on demand: columns are padded to a
+    multiple of the mesh's data-axis size and sharded row-wise with
+    ``NamedSharding(P("data"))`` — a shard is the moral equivalent of a home
+    node's chunks (compute moves to data: SURVEY.md §1 invariant).
+  * RollupStats stay: lazily computed min/max/mean/sigma/NA-count/isint plus a
+    fixed-width histogram, in one jitted reduction, cached per column and
+    invalidated on mutation (h2o3_tpu/frame/rollups.py).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+
+class ColType(enum.Enum):
+    """Column types — mirrors the reference's Vec type ids (water/fvec/Vec.java:207-212:
+    T_BAD, T_UUID, T_STR, T_NUM, T_CAT, T_TIME)."""
+
+    NUM = "numeric"
+    CAT = "categorical"
+    TIME = "time"
+    STR = "string"
+    UUID = "uuid"
+    BAD = "bad"  # all-NA column
+
+
+NA_CAT = np.int32(-1)  # categorical NA sentinel (codes); numeric NA is NaN
+
+
+class Column:
+    """One named, typed column. Host-canonical numpy storage.
+
+    ``data`` dtype by type:
+      NUM  -> float64 (NaN = NA)
+      CAT  -> int32 codes into ``domain`` (-1 = NA)
+      TIME -> float64 milliseconds since epoch (NaN = NA; reference stores int64
+              ms, water/fvec/Vec.java — float64 keeps exact ms until year ~287k)
+      STR  -> object ndarray of python str / None
+      UUID -> object ndarray of str / None
+      BAD  -> float64 all-NaN
+    """
+
+    __slots__ = ("name", "type", "data", "domain", "_rollups")
+
+    def __init__(
+        self,
+        name: str,
+        data: np.ndarray,
+        type: Optional[ColType] = None,
+        domain: Optional[List[str]] = None,
+    ) -> None:
+        if type is None:
+            type = _infer_type(data)
+        data = _canonicalize(data, type)
+        self.name = name
+        self.type = type
+        self.data = data
+        self.domain = list(domain) if domain is not None else None
+        self._rollups = None
+        if self.type is ColType.CAT and self.domain is None:
+            raise ValueError(f"CAT column {name!r} requires a domain")
+
+    # -- basic shape ---------------------------------------------------------
+    def __len__(self) -> int:
+        return int(self.data.shape[0])
+
+    @property
+    def nrows(self) -> int:
+        return len(self)
+
+    # -- type predicates (mirrors Vec.isNumeric/isCategorical/...) -----------
+    def is_numeric(self) -> bool:
+        return self.type in (ColType.NUM, ColType.TIME)
+
+    def is_categorical(self) -> bool:
+        return self.type is ColType.CAT
+
+    def is_string(self) -> bool:
+        return self.type is ColType.STR
+
+    def is_time(self) -> bool:
+        return self.type is ColType.TIME
+
+    def is_bad(self) -> bool:
+        return self.type is ColType.BAD
+
+    def cardinality(self) -> int:
+        """Domain size for CAT columns, -1 otherwise (Vec.cardinality())."""
+        return len(self.domain) if self.domain is not None else -1
+
+    # -- NA handling ---------------------------------------------------------
+    def isna(self) -> np.ndarray:
+        if self.type is ColType.CAT:
+            return self.data < 0
+        if self.type in (ColType.STR, ColType.UUID):
+            return np.array([v is None for v in self.data], dtype=bool)
+        return np.isnan(self.data)
+
+    def na_count(self) -> int:
+        return int(self.isna().sum())
+
+    # -- rollups (lazy cached stats; water/fvec/RollupStats.java) ------------
+    @property
+    def rollups(self):
+        if self._rollups is None:
+            from h2o3_tpu.frame.rollups import compute_rollups
+
+            self._rollups = compute_rollups(self)
+        return self._rollups
+
+    def invalidate_rollups(self) -> None:
+        self._rollups = None
+
+    def min(self) -> float:
+        return self.rollups.min
+
+    def max(self) -> float:
+        return self.rollups.max
+
+    def mean(self) -> float:
+        return self.rollups.mean
+
+    def sigma(self) -> float:
+        return self.rollups.sigma
+
+    def is_int(self) -> bool:
+        return self.rollups.is_int
+
+    # -- conversions ---------------------------------------------------------
+    def numeric_view(self) -> np.ndarray:
+        """float64 view used for device transfer: CAT codes as floats with NaN NAs."""
+        if self.type is ColType.CAT:
+            out = self.data.astype(np.float64)
+            out[self.data < 0] = np.nan
+            return out
+        if self.type in (ColType.STR, ColType.UUID):
+            raise TypeError(f"column {self.name!r} of type {self.type} has no numeric view")
+        return self.data
+
+    def as_factor(self) -> "Column":
+        """NUM/STR -> CAT conversion (rapids AstAsFactor)."""
+        if self.type is ColType.CAT:
+            return self
+        if self.type in (ColType.STR, ColType.UUID):
+            mask = np.array([v is not None for v in self.data], dtype=bool)
+            uniq = sorted({str(v) for v in self.data[mask]})
+            index = {lv: i for i, lv in enumerate(uniq)}
+            codes = np.full(len(self.data), NA_CAT, dtype=np.int32)
+            codes[mask] = [index[str(v)] for v in self.data[mask]]
+            return Column(self.name, codes, ColType.CAT, uniq)
+        vals = self.data
+        mask = ~np.isnan(vals)
+        uniq = np.unique(vals[mask])
+        domain = [_format_level(v) for v in uniq]
+        codes = np.full(len(vals), NA_CAT, dtype=np.int32)
+        codes[mask] = np.searchsorted(uniq, vals[mask]).astype(np.int32)
+        return Column(self.name, codes, ColType.CAT, domain)
+
+    def as_numeric(self) -> "Column":
+        """CAT -> NUM conversion (rapids AstAsNumeric): parse levels, else codes."""
+        if self.type is not ColType.CAT:
+            return Column(self.name, self.numeric_view(), ColType.NUM)
+        try:
+            lv = np.array([float(d) for d in self.domain], dtype=np.float64)
+            out = np.where(self.data >= 0, lv[np.clip(self.data, 0, None)], np.nan)
+        except ValueError:
+            out = np.where(self.data >= 0, self.data.astype(np.float64), np.nan)
+        return Column(self.name, out, ColType.NUM)
+
+    def copy(self) -> "Column":
+        return Column(self.name, self.data.copy(), self.type, self.domain)
+
+    def select(self, idx: np.ndarray) -> "Column":
+        return Column(self.name, self.data[idx], self.type, self.domain)
+
+    def __repr__(self) -> str:
+        dom = f", card={len(self.domain)}" if self.domain is not None else ""
+        return f"<Column {self.name!r} {self.type.value} n={len(self)}{dom}>"
+
+
+def _infer_type(data: np.ndarray) -> ColType:
+    data = np.asarray(data)
+    if data.dtype == object or data.dtype.kind in "US":
+        return ColType.STR
+    return ColType.NUM
+
+
+def _canonicalize(data: Any, type: ColType) -> np.ndarray:
+    data = np.asarray(data)
+    if type in (ColType.NUM, ColType.TIME, ColType.BAD):
+        return np.ascontiguousarray(data, dtype=np.float64)
+    if type is ColType.CAT:
+        return np.ascontiguousarray(data, dtype=np.int32)
+    if type in (ColType.STR, ColType.UUID):
+        if data.dtype != object:
+            data = data.astype(object)
+        return data
+    raise ValueError(f"unknown column type {type}")
+
+
+def _format_level(v: float) -> str:
+    return str(int(v)) if float(v).is_integer() else repr(float(v))
+
+
+class Frame:
+    """A named collection of equal-length Columns (water/fvec/Frame.java).
+
+    Supports the core munging surface the reference exposes through Rapids:
+    column/row slicing, boolean filtering, cbind/rbind, renaming, NA ops.
+    Heavier relational ops (group-by, merge, sort) live in h2o3_tpu/rapids/.
+    """
+
+    def __init__(self, columns: Sequence[Column], key: Optional[str] = None) -> None:
+        cols = list(columns)
+        if cols:
+            n = len(cols[0])
+            for c in cols:
+                if len(c) != n:
+                    raise ValueError(
+                        f"column {c.name!r} has {len(c)} rows, expected {n}"
+                    )
+        names = [c.name for c in cols]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate column names: {names}")
+        self._cols: List[Column] = cols
+        self.key = key
+
+    # -- constructors --------------------------------------------------------
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "Frame":
+        cols = []
+        for name, vals in d.items():
+            if isinstance(vals, Column):
+                c = vals.copy()
+                c.name = name
+                cols.append(c)
+            else:
+                arr = np.asarray(vals)
+                if arr.dtype == object or arr.dtype.kind in "US":
+                    from h2o3_tpu.frame.parse import column_from_strings
+
+                    cols.append(column_from_strings(name, [None if v is None else str(v) for v in arr]))
+                else:
+                    cols.append(Column(name, arr.astype(np.float64), ColType.NUM))
+        return Frame(cols)
+
+    @staticmethod
+    def from_pandas(df) -> "Frame":
+        return Frame.from_dict({str(c): df[c].to_numpy() for c in df.columns})
+
+    # -- shape ---------------------------------------------------------------
+    @property
+    def nrows(self) -> int:
+        return len(self._cols[0]) if self._cols else 0
+
+    @property
+    def ncols(self) -> int:
+        return len(self._cols)
+
+    @property
+    def names(self) -> List[str]:
+        return [c.name for c in self._cols]
+
+    @property
+    def types(self) -> Dict[str, ColType]:
+        return {c.name: c.type for c in self._cols}
+
+    @property
+    def columns(self) -> List[Column]:
+        return list(self._cols)
+
+    @property
+    def shape(self) -> Tuple[int, int]:
+        return (self.nrows, self.ncols)
+
+    def __len__(self) -> int:
+        return self.nrows
+
+    # -- selection -----------------------------------------------------------
+    def col(self, name_or_idx: Union[str, int]) -> Column:
+        if isinstance(name_or_idx, int):
+            return self._cols[name_or_idx]
+        for c in self._cols:
+            if c.name == name_or_idx:
+                return c
+        raise KeyError(f"no column {name_or_idx!r} in {self.names}")
+
+    def __getitem__(self, sel: Any) -> "Frame":
+        # fr[col] / fr[[cols]] / fr[bool-mask] / fr[row-slice] / fr[rows, cols]
+        if isinstance(sel, tuple) and len(sel) == 2:
+            return self.rows(sel[0]).cols(sel[1])
+        if isinstance(sel, str):
+            return Frame([self.col(sel)])
+        if isinstance(sel, (list,)) and sel and isinstance(sel[0], str):
+            return Frame([self.col(n) for n in sel])
+        if isinstance(sel, np.ndarray) and sel.dtype == bool:
+            return self.rows(sel)
+        if isinstance(sel, slice):
+            return self.rows(sel)
+        raise TypeError(f"unsupported selector {sel!r}")
+
+    def cols(self, sel: Any) -> "Frame":
+        if sel is None or (isinstance(sel, slice) and sel == slice(None)):
+            return self
+        if isinstance(sel, (str, int)):
+            sel = [sel]
+        return Frame([self.col(s) for s in sel])
+
+    def rows(self, sel: Any) -> "Frame":
+        if isinstance(sel, slice) or (
+            isinstance(sel, np.ndarray) and sel.dtype in (bool, np.bool_)
+        ):
+            idx = np.arange(self.nrows)[sel]
+        else:
+            idx = np.asarray(sel, dtype=np.int64)
+        return Frame([c.select(idx) for c in self._cols])
+
+    def drop(self, names: Union[str, Iterable[str]]) -> "Frame":
+        if isinstance(names, str):
+            names = [names]
+        names = set(names)
+        return Frame([c for c in self._cols if c.name not in names])
+
+    # -- mutation ------------------------------------------------------------
+    def add_column(self, col: Column) -> "Frame":
+        if col.name in self.names:
+            cols = [col if c.name == col.name else c for c in self._cols]
+        else:
+            cols = self._cols + [col]
+        return Frame(cols)
+
+    def rename(self, mapping: Dict[str, str]) -> "Frame":
+        cols = []
+        for c in self._cols:
+            c2 = c.copy()
+            c2.name = mapping.get(c.name, c.name)
+            cols.append(c2)
+        return Frame(cols)
+
+    def cbind(self, other: "Frame") -> "Frame":
+        cols = list(self._cols)
+        taken = set(self.names)
+        for c in other._cols:
+            name, i = c.name, 0
+            while name in taken:
+                name = f"{c.name}{i}"
+                i += 1
+            c2 = c.copy()
+            c2.name = name
+            taken.add(name)
+            cols.append(c2)
+        return Frame(cols)
+
+    def rbind(self, other: "Frame") -> "Frame":
+        if self.names != other.names:
+            raise ValueError("rbind requires identical column names")
+        out = []
+        for a, b in zip(self._cols, other._cols):
+            if a.type is ColType.CAT or b.type is ColType.CAT:
+                a, b = _unify_cat(a), _unify_cat(b)
+                domain, amap = _merge_domains(a.domain, b.domain)
+                ad = a.data.copy()
+                bd = np.where(b.data >= 0, amap[np.clip(b.data, 0, None)], NA_CAT)
+                out.append(
+                    Column(a.name, np.concatenate([ad, bd.astype(np.int32)]), ColType.CAT, domain)
+                )
+            elif a.type in (ColType.STR, ColType.UUID):
+                out.append(
+                    Column(a.name, np.concatenate([a.data, b.data]), a.type)
+                )
+            else:
+                out.append(
+                    Column(a.name, np.concatenate([a.data, b.data]), a.type)
+                )
+        return Frame(out)
+
+    def na_omit(self) -> "Frame":
+        mask = np.zeros(self.nrows, dtype=bool)
+        for c in self._cols:
+            mask |= c.isna()
+        return self.rows(~mask)
+
+    # -- numeric matrix for modeling ----------------------------------------
+    def to_numpy(self, columns: Optional[Sequence[str]] = None) -> np.ndarray:
+        names = list(columns) if columns is not None else self.names
+        return np.stack([self.col(n).numeric_view() for n in names], axis=1)
+
+    def to_pandas(self):
+        import pandas as pd
+
+        data = {}
+        for c in self._cols:
+            if c.type is ColType.CAT:
+                dom = np.asarray(c.domain + [None], dtype=object)
+                data[c.name] = dom[np.where(c.data >= 0, c.data, len(c.domain))]
+            else:
+                data[c.name] = c.data
+        return pd.DataFrame(data)
+
+    def head(self, n: int = 10) -> "Frame":
+        return self.rows(slice(0, n))
+
+    def __repr__(self) -> str:
+        return f"<Frame {self.nrows}x{self.ncols} {self.names[:8]}{'...' if self.ncols > 8 else ''}>"
+
+
+def _unify_cat(c: Column) -> Column:
+    return c if c.type is ColType.CAT else c.as_factor()
+
+
+def _merge_domains(a: List[str], b: List[str]) -> Tuple[List[str], np.ndarray]:
+    """Merge categorical domains; returns merged domain and b-code -> merged-code map
+    (reference: domain unification during parse, water/parser/Categorical.java)."""
+    index = {lv: i for i, lv in enumerate(a)}
+    merged = list(a)
+    bmap = np.empty(len(b), dtype=np.int32)
+    for j, lv in enumerate(b):
+        if lv not in index:
+            index[lv] = len(merged)
+            merged.append(lv)
+        bmap[j] = index[lv]
+    return merged, bmap

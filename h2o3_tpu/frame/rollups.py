@@ -1,0 +1,73 @@
+"""RollupStats — lazy cached per-column statistics.
+
+Reference: ``water/fvec/RollupStats.java`` computes min/max/mean/sigma/NA
+count/isInt plus a histogram in one MRTask on first use and caches the result
+under a rollup key; any mutation invalidates it.
+
+TPU-native note: columns are host-canonical float64 numpy, and rollups must be
+float64-exact (TIME columns hold epoch-milliseconds ~1.6e12 — float32 would be
+off by tens of seconds). JAX here runs with x64 disabled for TPU-native
+compute, so the rollup pass runs in numpy on the host where the canonical data
+already lives; it is a single streaming pass and is memory-bandwidth bound
+either way. Device-side (float32) reductions belong to the compute layer
+(h2o3_tpu/compute/mapreduce.py), which always carries explicit masks.
+Cached on the Column object, invalidated by ``Column.invalidate_rollups()``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from h2o3_tpu.frame.frame import ColType, Column
+
+
+@dataclass
+class RollupStats:
+    min: float
+    max: float
+    mean: float
+    sigma: float
+    na_count: int
+    zero_count: int
+    is_int: bool
+    histogram: Optional[np.ndarray] = None  # lazy, via histogram()
+    checksum: float = 0.0
+
+
+def compute_rollups(col: Column) -> RollupStats:
+    if col.type in (ColType.STR, ColType.UUID):
+        na = col.na_count()
+        return RollupStats(np.nan, np.nan, np.nan, np.nan, na, 0, False)
+    x = col.numeric_view()
+    if x.size == 0:
+        return RollupStats(np.nan, np.nan, np.nan, np.nan, 0, 0, True)
+    ok = ~np.isnan(x)
+    n = int(ok.sum())
+    if n == 0:
+        return RollupStats(np.nan, np.nan, np.nan, np.nan, x.size, 0, True)
+    v = x[ok]
+    return RollupStats(
+        float(v.min()),
+        float(v.max()),
+        float(v.mean()),
+        float(v.std(ddof=1)) if n > 1 else 0.0,
+        x.size - n,
+        int((v == 0).sum()),
+        bool(np.all(np.floor(v) == v)),
+        checksum=float(v.sum()),
+    )
+
+
+def histogram(col: Column, nbins: int = 64) -> np.ndarray:
+    """Fixed-width histogram over [min, max] (RollupStats lazy histogram)."""
+    r = col.rollups
+    x = col.numeric_view()
+    ok = ~np.isnan(x)
+    if not np.any(ok) or not np.isfinite(r.min):
+        return np.zeros(nbins, dtype=np.int64)
+    span = max(r.max - r.min, 1e-300)
+    idx = np.clip(((x[ok] - r.min) / span * nbins).astype(np.int64), 0, nbins - 1)
+    return np.bincount(idx, minlength=nbins)

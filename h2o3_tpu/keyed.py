@@ -1,0 +1,103 @@
+"""Keyed object catalog — the TPU-native replacement for H2O's DKV.
+
+The reference keeps every Frame/Vec/Chunk/Model under a ``water.Key`` in a
+distributed K/V store with home-node hashing, caching and invalidation
+(``water/DKV.java:3-62``, ``water/Key.java:196``). On TPU there is a single
+host control-plane per process (multi-host SPMD runs the same program
+everywhere), so the catalog is a plain in-process keyed store: device
+placement of the *data* is owned by JAX shardings, not by the store. What we
+keep from the reference is the *lifecycle* surface: put/get/remove, type-keyed
+lookups, and `Scope`-style temp tracking (``water/Scope.java``).
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Any, Dict, Iterator, List, Optional
+
+
+class KeyedStore:
+    """Process-local keyed object store with scoped temp-key tracking."""
+
+    def __init__(self) -> None:
+        self._store: Dict[str, Any] = {}
+        self._lock = threading.RLock()
+        self._scopes: List[List[str]] = []
+
+    # -- DKV.put/get/remove (water/DKV.java:30-62) ---------------------------
+    def put(self, key: str, value: Any) -> str:
+        with self._lock:
+            self._store[key] = value
+            if self._scopes:
+                self._scopes[-1].append(key)
+        return key
+
+    def get(self, key: str, default: Any = None) -> Any:
+        with self._lock:
+            return self._store.get(key, default)
+
+    def __contains__(self, key: str) -> bool:
+        with self._lock:
+            return key in self._store
+
+    def remove(self, key: str) -> None:
+        with self._lock:
+            self._store.pop(key, None)
+
+    def keys(self) -> List[str]:
+        with self._lock:
+            return list(self._store.keys())
+
+    def keys_of_type(self, cls: type) -> List[str]:
+        with self._lock:
+            return [k for k, v in self._store.items() if isinstance(v, cls)]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._store.clear()
+
+    @staticmethod
+    def make_key(prefix: str = "obj") -> str:
+        """Fresh unique key (reference: ``Key.make()``, water/Key.java:44)."""
+        return f"{prefix}_{uuid.uuid4().hex[:12]}"
+
+    # -- Scope.enter/exit (water/Scope.java) ---------------------------------
+    def scope_enter(self) -> None:
+        with self._lock:
+            self._scopes.append([])
+
+    def scope_exit(self, keep: Optional[List[str]] = None) -> None:
+        keep_set = set(keep or [])
+        with self._lock:
+            if not self._scopes:
+                return
+            for k in self._scopes.pop():
+                if k not in keep_set:
+                    self._store.pop(k, None)
+
+    def scope(self) -> "_ScopeCtx":
+        return _ScopeCtx(self)
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self.keys())
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._store)
+
+
+class _ScopeCtx:
+    def __init__(self, store: KeyedStore) -> None:
+        self._store = store
+
+    def __enter__(self) -> KeyedStore:
+        self._store.scope_enter()
+        return self._store
+
+    def __exit__(self, *exc: Any) -> None:
+        self._store.scope_exit()
+
+
+#: Global catalog — the analogue of the cluster-wide DKV singleton.
+DKV = KeyedStore()

@@ -1,0 +1,19 @@
+from h2o3_tpu.parallel.mesh import (
+    DATA_AXIS,
+    default_mesh,
+    device_count,
+    distributed_initialize,
+    pad_rows,
+    row_sharding,
+    shard_rows,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "default_mesh",
+    "device_count",
+    "distributed_initialize",
+    "pad_rows",
+    "row_sharding",
+    "shard_rows",
+]

@@ -1,0 +1,128 @@
+"""Device mesh + sharding utilities — the cluster layer, TPU-native.
+
+The reference builds a cluster out of UDP heartbeats + Paxos quorum
+(``water/Paxos.java:10-27``), a custom RPC (``water/RPC.java:101``) and a
+distributed K/V store with home-node key hashing (``water/Key.java:196``).
+None of that exists here by design: membership, rendezvous and collectives are
+XLA's job. ``jax.distributed.initialize`` is the Paxos/heartbeat replacement
+for multi-host pods (coordinator-based rendezvous over DCN), and a
+``jax.sharding.Mesh`` over all addressable devices is "the cloud".
+
+Row-sharded placement: a Frame column maps to a device array padded to a
+multiple of the mesh's data-axis size and sharded along axis 0 with
+``NamedSharding(P(DATA_AXIS))`` — one shard per device is the analogue of one
+node's home chunks, and XLA inserts the psum/all-gather that MRTask's node
+tree did by hand (``water/MRTask.java:96-127``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+#: Name of the batch/data axis — every algo shards rows over this axis (pure DP;
+#: the reference has no TP/PP/SP workloads, SURVEY.md §2.4: its models are
+#: trees/linear/small MLPs and the long axis is *rows*).
+DATA_AXIS = "data"
+
+_default_mesh: Optional[Mesh] = None
+
+
+def distributed_initialize(**kwargs) -> None:
+    """Multi-host bootstrap (replaces Paxos cloud formation; SURVEY.md §5).
+
+    On a multi-host pod, call once per host before any computation:
+    coordinator rendezvous + global device visibility via the JAX distributed
+    runtime. Single-host (and CI) setups skip this silently.
+    """
+    try:
+        jax.distributed.initialize(**kwargs)
+    except (RuntimeError, ValueError):
+        pass  # already initialized or single-process
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def default_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """The 1-D data mesh over all (or the first n) addressable devices."""
+    global _default_mesh
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+        return Mesh(np.array(devs), (DATA_AXIS,))
+    if _default_mesh is None or len(_default_mesh.devices.flat) != len(devs):
+        _default_mesh = Mesh(np.array(devs), (DATA_AXIS,))
+    return _default_mesh
+
+
+def row_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    """Shard axis 0 over DATA_AXIS, replicate the rest."""
+    return NamedSharding(mesh, P(DATA_AXIS, *([None] * (ndim - 1))))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def pad_rows(
+    x: np.ndarray, multiple: int, fill: Union[int, float] = 0
+) -> Tuple[np.ndarray, int]:
+    """Pad axis 0 up to a multiple; returns (padded, original_n)."""
+    n = x.shape[0]
+    rem = (-n) % multiple
+    if rem == 0:
+        return x, n
+    pad_widths = [(0, rem)] + [(0, 0)] * (x.ndim - 1)
+    return np.pad(x, pad_widths, constant_values=fill), n
+
+
+def shard_rows(
+    x: np.ndarray,
+    mesh: Optional[Mesh] = None,
+    fill: Union[int, float] = 0,
+) -> Tuple[jax.Array, int]:
+    """Place a host array on the mesh row-sharded; returns (array, valid_rows).
+
+    The pad rows are the price of SPMD static shapes; every consumer masks them
+    via the ``valid_rows`` count (compare: the reference's ESPC chunk layout
+    allows ragged chunks, ``water/fvec/Vec.java:264-280`` — ragged shards are
+    hostile to XLA, so we pad instead).
+    """
+    mesh = mesh or default_mesh()
+    nshards = mesh.devices.size
+    padded, n = pad_rows(np.asarray(x), nshards, fill)
+    arr = jax.device_put(padded, row_sharding(mesh, padded.ndim))
+    return arr, n
+
+
+def row_mask(n_valid: int, n_padded: int, mesh: Optional[Mesh] = None) -> jax.Array:
+    """Boolean validity mask for padded row-sharded arrays."""
+    mesh = mesh or default_mesh()
+    m = (np.arange(n_padded) < n_valid)
+    arr = jax.device_put(m, row_sharding(mesh, 1))
+    return arr
+
+
+def shard_table(
+    columns: Dict[str, np.ndarray],
+    mesh: Optional[Mesh] = None,
+) -> Tuple[Dict[str, jax.Array], jax.Array, int]:
+    """Shard a dict of equal-length host columns; returns (device cols, mask, n)."""
+    mesh = mesh or default_mesh()
+    out: Dict[str, jax.Array] = {}
+    n = None
+    for name, arr in columns.items():
+        sharded, n = shard_rows(arr, mesh)
+        out[name] = sharded
+    assert n is not None, "empty table"
+    some = next(iter(out.values()))
+    mask = row_mask(n, some.shape[0], mesh)
+    return out, mask, n

@@ -1,0 +1,39 @@
+"""Test harness: single-process multi-device CPU mesh.
+
+Reference analogue: tests run against an "N JVMs on localhost" cloud via
+``water.runner.H2ORunner`` + ``@CloudSize(n)`` (SURVEY.md §4). Here the cloud
+is 8 virtual XLA CPU devices in one process — the sharding/collective code
+paths are identical to a real TPU slice.
+"""
+
+import os
+
+# Force CPU before any backend initializes: the test tier always runs on the
+# virtual 8-device CPU mesh, even when a real TPU is attached. (The config
+# calls below are authoritative; the env vars cover subprocesses.)
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh():
+    from h2o3_tpu.parallel.mesh import default_mesh
+
+    m = default_mesh()
+    assert m.devices.size == 8, f"expected 8 virtual devices, got {m.devices.size}"
+    return m
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(42)

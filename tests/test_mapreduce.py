@@ -1,0 +1,84 @@
+"""map_reduce / FrameTable / quantile tests — the M1 compute primitive.
+
+Reference analogue: water/MRTaskTest.java, hex/quantile tests (SURVEY.md §4)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from h2o3_tpu import Frame
+from h2o3_tpu.compute import FrameTable, map_reduce, quantiles
+from h2o3_tpu.compute.mapreduce import gather_rows, map_batches
+
+
+@pytest.fixture()
+def table(mesh, rng):
+    n = 10_001  # deliberately not divisible by 8 → exercises pad masking
+    fr = Frame.from_dict({"x": rng.normal(size=n), "y": rng.normal(2.0, size=n)})
+    return FrameTable.from_frame(fr, mesh=mesh), fr
+
+
+def test_sum_and_count(table):
+    t, fr = table
+
+    def stats(cols, mask):
+        m = mask & ~jnp.isnan(cols["x"])
+        return {
+            "n": jnp.sum(m),
+            "sum": jnp.sum(jnp.where(m, cols["x"], 0.0)),
+            "sumsq": jnp.sum(jnp.where(m, cols["x"] ** 2, 0.0)),
+        }
+
+    out = map_reduce(stats, t)
+    x = fr.col("x").data
+    assert int(out["n"]) == len(x)
+    assert float(out["sum"]) == pytest.approx(x.sum(), rel=1e-4)
+    assert float(out["sumsq"]) == pytest.approx((x**2).sum(), rel=1e-4)
+
+
+def test_minmax_reduce(table):
+    t, fr = table
+
+    def lo(cols, mask):
+        return jnp.min(jnp.where(mask, cols["x"], jnp.inf))
+
+    def hi(cols, mask):
+        return jnp.max(jnp.where(mask, cols["x"], -jnp.inf))
+
+    assert float(map_reduce(lo, t, reduce="min")) == pytest.approx(fr.col("x").data.min(), rel=1e-5)
+    assert float(map_reduce(hi, t, reduce="max")) == pytest.approx(fr.col("x").data.max(), rel=1e-5)
+
+
+def test_map_batches_elementwise(table):
+    t, fr = table
+
+    def double_plus(cols, mask):
+        return cols["x"] * 2.0 + cols["y"]
+
+    out = map_batches(double_plus, t)
+    got = gather_rows(out, t.n_valid)
+    want = fr.col("x").data * 2 + fr.col("y").data
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_matrix_shape(table):
+    t, fr = table
+    m = t.matrix(["x", "y"])
+    assert m.shape == (t.n_padded, 2)
+    assert t.n_padded % 8 == 0 and t.n_valid == fr.nrows
+
+
+def test_quantiles_match_numpy(rng):
+    x = rng.normal(size=50_000).astype(np.float32)
+    probs = [0.01, 0.1, 0.25, 0.5, 0.75, 0.9, 0.99]
+    got = quantiles(x, probs)
+    want = np.quantile(x.astype(np.float64), probs)
+    np.testing.assert_allclose(got, want, atol=2e-3)
+
+
+def test_quantiles_with_nans(rng):
+    x = rng.normal(size=10_000).astype(np.float32)
+    x[::7] = np.nan
+    got = quantiles(x, [0.5])
+    want = np.nanquantile(x.astype(np.float64), 0.5)
+    assert got[0] == pytest.approx(want, abs=5e-3)
