@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 
 _NBINS = 1024
-_ITERS = 4  # 1024^4 = 2^40 distinct resolvable values — exact for f32 inputs
+_MAX_ITERS = 64  # safety bound; the loop exits on bin convergence first
 
 
 @jax.jit
@@ -33,17 +33,31 @@ def _count_valid(x, mask):
     return jnp.sum((mask & ~jnp.isnan(x)).astype(jnp.int32))
 
 
-@partial(jax.jit, static_argnames=("nbins", "iters"))
-def _order_stats_kernel(x, mask, ranks, nbins: int = _NBINS, iters: int = _ITERS):
-    """Exact order statistics at integer ``ranks`` (int32) via histogram zoom."""
+@partial(jax.jit, static_argnames=("nbins",))
+def _order_stats_kernel(x, mask, ranks, nbins: int = _NBINS):
+    """Exact order statistics at integer ``ranks`` (int32) via histogram zoom.
+
+    Zooms until the target bin narrows below the floating-point resolution of
+    its endpoints (all values inside are then one representable number), the
+    same run-to-exact contract as the reference's iterative refinement —
+    robust to outlier-dominated ranges where a fixed iteration count is not.
+    """
     ok = mask & ~jnp.isnan(x)
     gmin = jnp.min(jnp.where(ok, x, jnp.inf))
     gmax = jnp.max(jnp.where(ok, x, -jnp.inf))
+    eps = jnp.asarray(1e-7 if x.dtype == jnp.float32 else 1e-15, x.dtype)
 
     def locate(rank):
-        def body(_, carry):
-            lo, hi = carry
-            span = jnp.maximum(hi - lo, 1e-30)
+        def cond(carry):
+            lo, hi, cnt, it = carry
+            width_converged = (hi - lo) <= eps * jnp.maximum(
+                jnp.maximum(jnp.abs(lo), jnp.abs(hi)), jnp.asarray(1e-30, x.dtype)
+            )
+            return (cnt > 1) & ~width_converged & (it < _MAX_ITERS)
+
+        def body(carry):
+            lo, hi, _, it = carry
+            span = jnp.maximum(hi - lo, jnp.asarray(1e-30, x.dtype))
             in_range = ok & (x >= lo) & (x <= hi)
             idx = jnp.clip(((x - lo) / span * nbins).astype(jnp.int32), 0, nbins - 1)
             hist = jnp.zeros(nbins, jnp.int32).at[idx].add(in_range.astype(jnp.int32))
@@ -52,10 +66,12 @@ def _order_stats_kernel(x, mask, ranks, nbins: int = _NBINS, iters: int = _ITERS
             bin_i = jnp.clip(jnp.searchsorted(cum, rank, side="right") - 1, 0, nbins - 1)
             new_lo = lo + bin_i.astype(x.dtype) * span / nbins
             new_hi = lo + (bin_i + 1).astype(x.dtype) * span / nbins
-            return new_lo, new_hi
+            return new_lo, new_hi, hist[bin_i], it + 1
 
-        lo, hi = jax.lax.fori_loop(0, iters, body, (gmin, gmax))
-        # the exact order statistic inside the final sliver: min of values >= lo
+        lo, hi, _, _ = jax.lax.while_loop(
+            cond, body, (gmin, gmax, jnp.asarray(2, jnp.int32), jnp.asarray(0, jnp.int32))
+        )
+        # the exact order statistic inside the converged sliver
         return jnp.min(jnp.where(ok & (x >= lo), x, jnp.inf))
 
     return jax.vmap(locate)(ranks)

@@ -361,7 +361,14 @@ def _parse_times(tokens: List[str], na: frozenset) -> np.ndarray:
     import datetime as dt
 
     out = np.empty(len(tokens), dtype=np.float64)
-    fmts = ("%Y-%m-%d %H:%M:%S.%f", "%Y-%m-%d %H:%M:%S", "%Y-%m-%dT%H:%M:%S", "%Y-%m-%d", "%m/%d/%Y")
+    fmts = (
+        "%Y-%m-%d %H:%M:%S.%f",
+        "%Y-%m-%d %H:%M:%S",
+        "%Y-%m-%dT%H:%M:%S.%f",
+        "%Y-%m-%dT%H:%M:%S",
+        "%Y-%m-%d",
+        "%m/%d/%Y",
+    )
     epoch = dt.datetime(1970, 1, 1)
     for i, t in enumerate(tokens):
         if t in na:

@@ -1,0 +1,4 @@
+from h2o3_tpu.models.framework import Job, Model, ModelBuilder, ModelParameters
+from h2o3_tpu.models import metrics
+
+__all__ = ["Job", "Model", "ModelBuilder", "ModelParameters", "metrics"]

@@ -1,0 +1,306 @@
+"""Model framework: Parameters / Model / ModelBuilder / Job lifecycle.
+
+Reference: ``hex/Model.java`` (scoring + test-frame adaptation + metrics
+hookup, Model.java:1764 score, 2077 BigScore), ``hex/ModelBuilder.java``
+(lifecycle + validation + cross-validation, ModelBuilder.java:228,368-377,597),
+``water/Job.java`` (cancellable progress handle in the DKV).
+
+TPU-native: the lifecycle is the same shape — validate params, build, score,
+metrics — but scoring is a jitted batch computation over sharded arrays
+instead of a per-row MRTask, and CV fold models are independent jit programs
+(the reference's parallel fold building, hex/CVModelBuilder.java:10).
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from dataclasses import dataclass, field as dataclass_field
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from h2o3_tpu.frame.frame import ColType, Column, Frame
+from h2o3_tpu.keyed import DKV
+from h2o3_tpu.models import metrics as M
+from h2o3_tpu.models.data_info import DataInfo
+
+
+@dataclass
+class ModelParameters:
+    """Common hyperparameters (reference: hex/Model.Parameters)."""
+
+    response_column: Optional[str] = None
+    ignored_columns: List[str] = dataclass_field(default_factory=list)
+    weights_column: Optional[str] = None
+    offset_column: Optional[str] = None
+    fold_column: Optional[str] = None
+    nfolds: int = 0
+    fold_assignment: str = "auto"  # auto|random|modulo|stratified
+    keep_cross_validation_predictions: bool = False
+    seed: int = -1
+    max_runtime_secs: float = 0.0
+    stopping_rounds: int = 0
+    stopping_metric: str = "auto"
+    stopping_tolerance: float = 1e-3
+    categorical_encoding: str = "auto"
+
+    def actual_seed(self) -> int:
+        if self.seed is None or self.seed == -1:
+            return int(time.time_ns() % (2**31))
+        return int(self.seed)
+
+
+class Job:
+    """Cancellable, progress-reporting handle (water/Job.java)."""
+
+    def __init__(self, description: str = "") -> None:
+        self.key = DKV.make_key("job")
+        self.description = description
+        self.progress = 0.0
+        self.status = "CREATED"
+        self.start_time: Optional[float] = None
+        self.end_time: Optional[float] = None
+        self.exception: Optional[BaseException] = None
+        self._cancel_requested = False
+        DKV.put(self.key, self)
+
+    def start(self) -> "Job":
+        self.start_time = time.time()
+        self.status = "RUNNING"
+        return self
+
+    def update(self, progress: float) -> None:
+        self.progress = min(max(progress, 0.0), 1.0)
+
+    def cancel(self) -> None:
+        self._cancel_requested = True
+
+    @property
+    def stop_requested(self) -> bool:
+        return self._cancel_requested
+
+    def done(self) -> None:
+        self.end_time = time.time()
+        self.progress = 1.0
+        self.status = "DONE" if not self._cancel_requested else "CANCELLED"
+
+    def fail(self, e: BaseException) -> None:
+        self.end_time = time.time()
+        self.exception = e
+        self.status = "FAILED"
+
+    @property
+    def run_time(self) -> float:
+        end = self.end_time if self.end_time is not None else time.time()
+        return (end - self.start_time) if self.start_time else 0.0
+
+
+class Model:
+    """Trained model: predict + metrics (hex/Model.java).
+
+    Subclasses implement ``_predict_raw(frame) -> np.ndarray``:
+      regression      -> [N] predictions
+      binomial        -> [N, 2] class probabilities
+      multinomial     -> [N, K] class probabilities
+    """
+
+    algo_name: str = "model"
+
+    def __init__(self, params: ModelParameters, data_info: DataInfo) -> None:
+        self.key = DKV.make_key(self.algo_name)
+        self.params = params
+        self.data_info = data_info
+        self.training_metrics: Optional[Any] = None
+        self.validation_metrics: Optional[Any] = None
+        self.cross_validation_metrics: Optional[Any] = None
+        self.scoring_history: List[Dict[str, Any]] = []
+        self.run_time: float = 0.0
+        DKV.put(self.key, self)
+
+    # -- category of the learning problem -----------------------------------
+    @property
+    def nclasses(self) -> int:
+        dom = self.data_info.response_domain
+        return len(dom) if dom else 1
+
+    @property
+    def is_classifier(self) -> bool:
+        return self.nclasses > 1
+
+    # -- scoring (Model.score, Model.java:1764) ------------------------------
+    def _predict_raw(self, frame: Frame) -> np.ndarray:
+        raise NotImplementedError
+
+    def predict(self, frame: Frame) -> Frame:
+        """Predictions frame: 'predict' (+ per-class probability columns)."""
+        raw = self._predict_raw(frame)
+        if not self.is_classifier:
+            return Frame([Column("predict", raw.astype(np.float64), ColType.NUM)])
+        dom = self.data_info.response_domain
+        assert dom is not None
+        if self.nclasses == 2:
+            thr = getattr(self.training_metrics, "max_f1_threshold", 0.5) or 0.5
+            labels = (raw[:, 1] >= thr).astype(np.int32)
+        else:
+            labels = raw.argmax(axis=1).astype(np.int32)
+        cols = [Column("predict", labels, ColType.CAT, dom)]
+        for k, lv in enumerate(dom):
+            cols.append(Column(f"p{lv}", raw[:, k].astype(np.float64), ColType.NUM))
+        return Frame(cols)
+
+    def model_performance(self, frame: Frame) -> Any:
+        """Score a frame and build the right ModelMetrics (Model.score + MM builders)."""
+        from h2o3_tpu.models.data_info import response_vector
+
+        raw = self._predict_raw(frame)
+        y = response_vector(self.data_info, frame)
+        w = (
+            frame.col(self.params.weights_column).numeric_view()
+            if self.params.weights_column
+            else None
+        )
+        if not self.is_classifier:
+            return M.regression_metrics(y, raw, weights=w)
+        if self.nclasses == 2:
+            return M.binomial_metrics(y, raw[:, 1], weights=w)
+        return M.multinomial_metrics(
+            y.astype(np.int64), raw, self.data_info.response_domain, weights=w
+        )
+
+    def __repr__(self) -> str:
+        return f"<{type(self).__name__} {self.key} metrics={self.training_metrics!r}>"
+
+
+class ModelBuilder:
+    """Train lifecycle (hex/ModelBuilder.java:368-377 trainModel).
+
+    Subclasses set ``model_class`` and implement ``_fit(frame) -> Model``.
+    ``train`` adds: parameter validation, the Job, cross-validation
+    (ModelBuilder.java:597 computeCrossValidation), and main-model CV metrics
+    from the aggregated holdout predictions.
+    """
+
+    algo_name: str = "builder"
+
+    def __init__(self, params: ModelParameters) -> None:
+        self.params = params
+        self.job: Optional[Job] = None
+
+    # -- validation (ModelBuilder.init) --------------------------------------
+    def _validate(self, frame: Frame) -> None:
+        p = self.params
+        if p.response_column and p.response_column not in frame.names:
+            raise ValueError(f"response_column {p.response_column!r} not in frame")
+        if p.weights_column and p.weights_column not in frame.names:
+            raise ValueError(f"weights_column {p.weights_column!r} not in frame")
+        if p.nfolds == 1:
+            raise ValueError("nfolds must be 0 or >= 2")
+        if p.nfolds and p.fold_column:
+            raise ValueError("cannot use both nfolds and fold_column")
+
+    def _fit(self, frame: Frame, valid: Optional[Frame] = None) -> Model:
+        raise NotImplementedError
+
+    def train(self, frame: Frame, valid: Optional[Frame] = None) -> Model:
+        self._validate(frame)
+        self.job = Job(f"{self.algo_name} train").start()
+        t0 = time.time()
+        try:
+            model = self._fit(frame, valid)
+            if self.params.nfolds >= 2 or self.params.fold_column:
+                self._cross_validate(model, frame)
+            model.run_time = time.time() - t0
+            self.job.done()
+            return model
+        except BaseException as e:
+            self.job.fail(e)
+            raise
+
+    # -- cross-validation (ModelBuilder.computeCrossValidation) --------------
+    def _cross_validate(self, main_model: Model, frame: Frame) -> None:
+        from h2o3_tpu.models.data_info import response_vector
+
+        p = self.params
+        fold = fold_assignment(
+            n=frame.nrows,
+            nfolds=p.nfolds,
+            scheme=p.fold_assignment,
+            seed=p.actual_seed(),
+            y=response_vector(main_model.data_info, frame) if p.fold_assignment == "stratified" else None,
+            fold_column=frame.col(p.fold_column).numeric_view().astype(np.int64)
+            if p.fold_column
+            else None,
+        )
+        nfolds = int(fold.max()) + 1
+        nclasses = main_model.nclasses
+        holdout = (
+            np.full(frame.nrows, np.nan)
+            if nclasses == 1
+            else np.full((frame.nrows, nclasses), np.nan)
+        )
+        cv_models = []
+        for f in range(nfolds):
+            tr = frame.rows(fold != f)
+            te = frame.rows(fold == f)
+            sub = type(self)(_clone_params_no_cv(p))
+            m = sub._fit(tr)
+            cv_models.append(m)
+            holdout[fold == f] = m._predict_raw(te)
+            self.job.update(0.5 + 0.5 * (f + 1) / nfolds)
+        y = response_vector(main_model.data_info, frame)
+        w = (
+            frame.col(p.weights_column).numeric_view() if p.weights_column else None
+        )
+        if nclasses == 1:
+            main_model.cross_validation_metrics = M.regression_metrics(y, holdout, weights=w)
+        elif nclasses == 2:
+            main_model.cross_validation_metrics = M.binomial_metrics(y, holdout[:, 1], weights=w)
+        else:
+            main_model.cross_validation_metrics = M.multinomial_metrics(
+                y.astype(np.int64), holdout, main_model.data_info.response_domain, weights=w
+            )
+        main_model.cv_models = cv_models
+        if p.keep_cross_validation_predictions:
+            main_model.cv_holdout_predictions = holdout
+
+
+def _clone_params_no_cv(p: ModelParameters) -> ModelParameters:
+    import copy
+
+    q = copy.deepcopy(p)
+    q.nfolds = 0
+    q.fold_column = None
+    return q
+
+
+def fold_assignment(
+    n: int,
+    nfolds: int,
+    scheme: str = "auto",
+    seed: int = 42,
+    y: Optional[np.ndarray] = None,
+    fold_column: Optional[np.ndarray] = None,
+) -> np.ndarray:
+    """Row -> fold id (hex/FoldAssignment.java). auto==random; modulo is
+    deterministic row%nfolds; stratified balances class frequencies per fold."""
+    if fold_column is not None:
+        vals = fold_column
+        uniq = np.unique(vals)
+        remap = {v: i for i, v in enumerate(uniq)}
+        return np.array([remap[v] for v in vals], dtype=np.int64)
+    if scheme in ("auto", "random"):
+        rng = np.random.default_rng(seed)
+        return rng.integers(0, nfolds, size=n)
+    if scheme == "modulo":
+        return np.arange(n) % nfolds
+    if scheme == "stratified":
+        assert y is not None, "stratified fold assignment needs the response"
+        rng = np.random.default_rng(seed)
+        fold = np.zeros(n, dtype=np.int64)
+        for cls in np.unique(y[~np.isnan(y)]):
+            idx = np.nonzero(y == cls)[0]
+            perm = rng.permutation(len(idx))
+            fold[idx[perm]] = np.arange(len(idx)) % nfolds
+        return fold
+    raise ValueError(f"unknown fold_assignment {scheme!r}")

@@ -1,0 +1,437 @@
+"""GLM — generalized linear models via IRLSM with a device-side Gram.
+
+Reference: ``hex/glm/GLM.java`` (3.8k LoC; IRLSM driver GLM.java:1160,
+1184-1222), ``hex/glm/GLMTask.java:1502`` (GLMIterationTask: one MRTask pass
+computes the weighted Gram X'WX + X'Wz), ``hex/gram/Gram.java:452`` (Cholesky),
+``hex/optimization/ADMM.java`` (L1 via ADMM soft-thresholding),
+``hex/glm/GLMModel.java:268-334`` (families/links).
+
+TPU-native: the per-iteration distributed pass is ONE jitted matmul —
+``X.T @ (W[:,None] * X)`` on the row-sharded design matrix; XLA inserts the
+psum over the data axis (sharded-in, replicated-out), which IS the MRTask
+reduce. The tiny (P+1)^2 solve (Cholesky or ADMM inner loop) runs on the
+host in float64, mirroring the reference where the Gram solve happens on the
+driver node. The design matrix comes from DataInfo (one-hot cats,
+standardization) exactly as in the reference.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.data_info import (
+    DataInfo,
+    build_data_info,
+    destandardize_coefs,
+    expand_matrix,
+    response_vector,
+)
+from h2o3_tpu.models.framework import Model, ModelBuilder, ModelParameters
+from h2o3_tpu.parallel.mesh import default_mesh, row_sharding
+
+FAMILIES = ("gaussian", "binomial", "quasibinomial", "poisson", "gamma", "tweedie")
+
+_DEFAULT_LINK = {
+    "gaussian": "identity",
+    "binomial": "logit",
+    "quasibinomial": "logit",
+    "poisson": "log",
+    "gamma": "log",
+    "tweedie": "tweedie",
+}
+
+
+@dataclass
+class GLMParameters(ModelParameters):
+    family: str = "gaussian"
+    link: str = "family_default"
+    alpha: float = 0.5
+    lambda_: float = 0.0
+    lambda_search: bool = False
+    nlambdas: int = 30
+    standardize: bool = True
+    intercept: bool = True
+    max_iterations: int = 50
+    beta_epsilon: float = 1e-4
+    objective_epsilon: float = 1e-6
+    tweedie_variance_power: float = 1.5
+    tweedie_link_power: float = 0.0
+    compute_p_values: bool = False
+    missing_values_handling: str = "mean_imputation"
+    solver: str = "irlsm"
+
+    def actual_link(self) -> str:
+        return _DEFAULT_LINK[self.family] if self.link == "family_default" else self.link
+
+
+# ---------------------------------------------------------------------------
+# family math (hex/glm/GLMModel.GLMParameters link/variance/deviance defs)
+
+
+def _linkinv(link: str, eta: np.ndarray, p: GLMParameters) -> np.ndarray:
+    if link == "identity":
+        return eta
+    if link == "logit":
+        return 1.0 / (1.0 + np.exp(-eta))
+    if link == "log":
+        return np.exp(eta)
+    if link == "inverse":
+        return 1.0 / np.where(np.abs(eta) < 1e-10, np.sign(eta + 1e-30) * 1e-10, eta)
+    if link == "tweedie":
+        lp = p.tweedie_link_power
+        return np.exp(eta) if lp == 0 else np.power(np.maximum(eta, 1e-10), 1.0 / lp)
+    raise ValueError(f"unknown link {link}")
+
+
+def _link_deriv(link: str, mu: np.ndarray, p: GLMParameters) -> np.ndarray:
+    """d eta / d mu."""
+    if link == "identity":
+        return np.ones_like(mu)
+    if link == "logit":
+        return 1.0 / np.maximum(mu * (1 - mu), 1e-10)
+    if link == "log":
+        return 1.0 / np.maximum(mu, 1e-10)
+    if link == "inverse":
+        return -1.0 / np.maximum(mu**2, 1e-10)
+    if link == "tweedie":
+        lp = p.tweedie_link_power
+        if lp == 0:
+            return 1.0 / np.maximum(mu, 1e-10)
+        return lp * np.power(np.maximum(mu, 1e-10), lp - 1)
+    raise ValueError(f"unknown link {link}")
+
+
+def _variance(family: str, mu: np.ndarray, p: GLMParameters) -> np.ndarray:
+    if family == "gaussian":
+        return np.ones_like(mu)
+    if family in ("binomial", "quasibinomial"):
+        return np.maximum(mu * (1 - mu), 1e-10)
+    if family == "poisson":
+        return np.maximum(mu, 1e-10)
+    if family == "gamma":
+        return np.maximum(mu**2, 1e-10)
+    if family == "tweedie":
+        return np.power(np.maximum(mu, 1e-10), p.tweedie_variance_power)
+    raise ValueError(f"unknown family {family}")
+
+
+def deviance(family: str, y: np.ndarray, mu: np.ndarray, p: GLMParameters) -> np.ndarray:
+    """Per-row unit deviance (hex/Distribution.java / GLMModel deviance defs)."""
+    eps = 1e-10
+    if family == "gaussian":
+        return (y - mu) ** 2
+    if family in ("binomial", "quasibinomial"):
+        mu = np.clip(mu, eps, 1 - eps)
+        return -2 * (y * np.log(mu) + (1 - y) * np.log(1 - mu))
+    if family == "poisson":
+        mu = np.maximum(mu, eps)
+        t = np.where(y > 0, y * np.log(np.where(y > 0, y, 1.0) / mu), 0.0)
+        return 2 * (t - (y - mu))
+    if family == "gamma":
+        mu = np.maximum(mu, eps)
+        ys = np.maximum(y, eps)
+        return -2 * (np.log(ys / mu) - (ys - mu) / mu)
+    if family == "tweedie":
+        vp = p.tweedie_variance_power
+        mu = np.maximum(mu, eps)
+        ys = np.maximum(y, 0.0)
+        a = np.where(ys > 0, np.power(np.maximum(ys, eps), 2 - vp) / ((1 - vp) * (2 - vp)), 0.0)
+        b = ys * np.power(mu, 1 - vp) / (1 - vp)
+        c = np.power(mu, 2 - vp) / (2 - vp)
+        return 2 * (a - b + c)
+    raise ValueError(f"unknown family {family}")
+
+
+# ---------------------------------------------------------------------------
+# the distributed pass: weighted Gram via one sharded matmul
+
+
+@jax.jit
+def _gram_kernel(Xw, wz, w):
+    """X'WX and X'Wz in one pass. Xw:[N,P+1] (with intercept col), w:[N]."""
+    WX = Xw * w[:, None]
+    g = Xw.T @ WX  # psum over the sharded data axis is implicit
+    q = Xw.T @ (w * wz)
+    return g, q
+
+
+def _gram(Xd, wz, w):
+    g, q = _gram_kernel(Xd, jnp.asarray(wz, dtype=Xd.dtype), jnp.asarray(w, dtype=Xd.dtype))
+    return np.asarray(jax.device_get(g), dtype=np.float64), np.asarray(
+        jax.device_get(q), dtype=np.float64
+    )
+
+
+# ---------------------------------------------------------------------------
+# host-side solvers (the reference solves the Gram on the driver node too)
+
+
+def _solve_ridge(G: np.ndarray, q: np.ndarray, l2: float, free: int) -> np.ndarray:
+    """(G + l2*I) b = q, no penalty on the last ``free`` coefs (intercept)."""
+    A = G.copy()
+    n = A.shape[0]
+    pen = n - free
+    A[np.arange(pen), np.arange(pen)] += l2
+    A[np.arange(n), np.arange(n)] += 1e-10  # jitter for singular one-hot blocks
+    try:
+        from scipy.linalg import cho_factor, cho_solve
+
+        return cho_solve(cho_factor(A, lower=True), q)
+    except Exception:
+        return np.linalg.lstsq(A, q, rcond=None)[0]
+
+
+def _solve_admm(
+    G: np.ndarray, q: np.ndarray, l1: float, l2: float, free: int, iters: int = 500, tol: float = 1e-7
+) -> np.ndarray:
+    """Elastic-net quadratic subproblem via ADMM (hex/optimization/ADMM.java):
+    min 1/2 b'Gb - q'b + l1*|b|_1 + l2/2*|b|^2, intercept unpenalized."""
+    n = G.shape[0]
+    pen = n - free
+    rho = float(np.mean(np.diag(G))) + l2 + 1e-6
+    A = G.copy()
+    A[np.arange(pen), np.arange(pen)] += l2 + rho
+    A[np.arange(pen, n), np.arange(pen, n)] += rho
+    A[np.arange(n), np.arange(n)] += 1e-10
+    from scipy.linalg import cho_factor, cho_solve
+
+    cf = cho_factor(A, lower=True)
+    z = np.zeros(n)
+    u = np.zeros(n)
+    for _ in range(iters):
+        x = cho_solve(cf, q + rho * (z - u))
+        z_old = z
+        xu = x + u
+        z = np.concatenate(
+            [np.sign(xu[:pen]) * np.maximum(np.abs(xu[:pen]) - l1 / rho, 0.0), xu[pen:]]
+        )
+        u = xu - z
+        if np.max(np.abs(z - z_old)) < tol and np.max(np.abs(x - z)) < tol:
+            break
+    return z
+
+
+# ---------------------------------------------------------------------------
+# model
+
+
+class GLMModel(Model):
+    algo_name = "glm"
+
+    def __init__(self, params: GLMParameters, data_info: DataInfo) -> None:
+        super().__init__(params, data_info)
+        self.coefficients: Dict[str, float] = {}
+        self.coefficients_std: Dict[str, float] = {}
+        self.beta_std: Optional[np.ndarray] = None  # [P+1] incl intercept, std space
+        self.null_deviance: float = np.nan
+        self.residual_deviance: float = np.nan
+        self.aic: float = np.nan
+        self.dispersion: float = 1.0
+        self.std_errors: Optional[Dict[str, float]] = None
+        self.p_values: Optional[Dict[str, float]] = None
+        self.iterations: int = 0
+
+    def _eta(self, frame: Frame) -> np.ndarray:
+        X, _ = expand_matrix(self.data_info, frame, dtype=np.float64)
+        b = self.beta_std
+        eta = X @ b[:-1] + b[-1]
+        if self.params.offset_column:
+            eta = eta + frame.col(self.params.offset_column).numeric_view()
+        return eta
+
+    def _predict_raw(self, frame: Frame) -> np.ndarray:
+        p: GLMParameters = self.params
+        mu = _linkinv(p.actual_link(), self._eta(frame), p)
+        if p.family in ("binomial", "quasibinomial"):
+            return np.stack([1 - mu, mu], axis=1)
+        return mu
+
+
+class GLM(ModelBuilder):
+    """Builder (reference driver loop: hex/glm/GLM.java:1160 fitIRLSM)."""
+
+    algo_name = "glm"
+
+    def __init__(self, params: Optional[GLMParameters] = None, **kw) -> None:
+        super().__init__(params or GLMParameters(**kw))
+
+    def _validate(self, frame: Frame) -> None:
+        super()._validate(frame)
+        p: GLMParameters = self.params
+        if p.family not in FAMILIES:
+            raise ValueError(f"family must be one of {FAMILIES}, got {p.family!r}")
+        if not (0 <= p.alpha <= 1):
+            raise ValueError("alpha must be in [0, 1]")
+        if p.lambda_ < 0:
+            raise ValueError("lambda must be >= 0")
+        if p.compute_p_values and p.lambda_ > 0:
+            raise ValueError("p-values require lambda = 0 (no regularization)")
+
+    def _fit(self, frame: Frame, valid: Optional[Frame] = None) -> GLMModel:
+        p: GLMParameters = self.params
+        link = p.actual_link()
+        info = build_data_info(
+            frame,
+            y=p.response_column,
+            ignored=p.ignored_columns,
+            standardize=p.standardize,
+            missing_values_handling=p.missing_values_handling,
+        )
+        model = GLMModel(p, info)
+
+        X, skip = expand_matrix(info, frame, dtype=np.float32)
+        y = response_vector(info, frame)
+        obs_w = (
+            frame.col(p.weights_column).numeric_view().astype(np.float64)
+            if p.weights_column
+            else np.ones(frame.nrows)
+        )
+        offset = (
+            frame.col(p.offset_column).numeric_view().astype(np.float64)
+            if p.offset_column
+            else np.zeros(frame.nrows)
+        )
+        keep = ~(skip | np.isnan(y) | np.isnan(obs_w))
+        X, y, obs_w, offset = X[keep], y[keep], obs_w[keep], offset[keep]
+        n, pcols = X.shape
+        if n == 0:
+            raise ValueError("no rows left after NA handling")
+
+        # device placement: row-sharded [N, P+1] with intercept column
+        mesh = default_mesh()
+        nshards = mesh.devices.size
+        padn = (-n) % nshards
+        Xi = np.concatenate([X, np.ones((n, 1), dtype=np.float32)], axis=1)
+        if padn:
+            Xi = np.concatenate([Xi, np.zeros((padn, pcols + 1), dtype=np.float32)])
+        Xd = jax.device_put(Xi, row_sharding(mesh, 2))
+        pad = lambda a: np.concatenate([a, np.zeros(padn)]) if padn else a
+
+        X64 = X.astype(np.float64)  # host copy for eta/deviance (made once)
+        wsum = float(obs_w.sum())
+        ybar = float((obs_w * y).sum() / wsum)
+        beta = np.zeros(pcols + 1)
+        # intercept warm start at the link of the response mean (GLM.java init)
+        if p.intercept:
+            beta[-1] = _link_of_mean(link, ybar, p)
+        l1 = p.lambda_ * p.alpha * wsum
+        l2 = p.lambda_ * (1 - p.alpha) * wsum
+
+        prev_obj = np.inf
+        for it in range(p.max_iterations):
+            eta = X64 @ beta[:-1] + beta[-1] + offset
+            mu = _linkinv(link, eta, p)
+            d = _link_deriv(link, mu, p)
+            v = _variance(p.family, mu, p)
+            w = obs_w / np.maximum(v * d * d, 1e-12)
+            wz = (eta - offset) + (y - mu) * d
+
+            G, q = _gram(Xd, pad(wz), pad(w))
+            if l1 > 0:
+                beta_new = _solve_admm(G / wsum, q / wsum, l1 / wsum, l2 / wsum, free=1)
+            else:
+                beta_new = _solve_ridge(G / wsum, q / wsum, l2 / wsum, free=1)
+            if not p.intercept:
+                beta_new[-1] = 0.0
+
+            dev = float((obs_w * deviance(p.family, y, _linkinv(link, X64 @ beta_new[:-1] + beta_new[-1] + offset, p), p)).sum())
+            obj = dev / (2 * wsum) + p.lambda_ * (
+                p.alpha * np.abs(beta_new[:-1]).sum() + (1 - p.alpha) / 2 * (beta_new[:-1] ** 2).sum()
+            )
+            delta = np.max(np.abs(beta_new - beta))
+            beta = beta_new
+            model.iterations = it + 1
+            if delta < p.beta_epsilon or abs(prev_obj - obj) < p.objective_epsilon * max(abs(prev_obj), 1.0):
+                prev_obj = obj
+                break
+            prev_obj = obj
+
+        model.beta_std = beta
+        b_raw, icpt = destandardize_coefs(info, beta[:-1], beta[-1])
+        model.coefficients = dict(zip(info.coef_names, b_raw.tolist()))
+        model.coefficients["Intercept"] = icpt
+        model.coefficients_std = dict(zip(info.coef_names, beta[:-1].tolist()))
+        model.coefficients_std["Intercept"] = float(beta[-1])
+
+        # deviances + AIC (GLMModel.GLMOutput)
+        mu = _linkinv(link, X64 @ beta[:-1] + beta[-1] + offset, p)
+        model.residual_deviance = float((obs_w * deviance(p.family, y, mu, p)).sum())
+        mu0 = np.full_like(y, ybar)
+        model.null_deviance = float((obs_w * deviance(p.family, y, mu0, p)).sum())
+        rank = int(np.sum(np.abs(beta[:-1]) > 0)) + (1 if p.intercept else 0)
+        model.aic = _aic(p.family, y, mu, obs_w, model.residual_deviance, rank)
+
+        if p.compute_p_values and p.lambda_ == 0:
+            self._p_values(model, X, y, mu, obs_w, offset, link, p, info)
+
+        model.training_metrics = model.model_performance(frame)
+        if valid is not None:
+            model.validation_metrics = model.model_performance(valid)
+        return model
+
+    def _p_values(self, model, X, y, mu, obs_w, offset, link, p, info) -> None:
+        d = _link_deriv(link, mu, p)
+        v = _variance(p.family, mu, p)
+        w = obs_w / np.maximum(v * d * d, 1e-12)
+        Xi = np.concatenate([X.astype(np.float64), np.ones((len(y), 1))], axis=1)
+        G = Xi.T @ (w[:, None] * Xi)
+        cov = np.linalg.pinv(G)
+        if p.family in ("gaussian", "gamma", "tweedie", "quasibinomial"):
+            dof = max(len(y) - G.shape[0], 1)
+            disp = float((obs_w * (y - mu) ** 2 / _variance(p.family, mu, p)).sum() / dof)
+        else:
+            disp = 1.0
+        model.dispersion = disp
+        se = np.sqrt(np.maximum(np.diag(cov) * disp, 0))
+        zvals = model.beta_std / np.maximum(se, 1e-300)
+        from scipy import stats as sps
+
+        if p.family in ("gaussian",):
+            pv = 2 * sps.t.sf(np.abs(zvals), df=max(len(y) - G.shape[0], 1))
+        else:
+            pv = 2 * sps.norm.sf(np.abs(zvals))
+        names = info.coef_names + ["Intercept"]
+        model.std_errors = dict(zip(names, se.tolist()))
+        model.p_values = dict(zip(names, pv.tolist()))
+
+
+def _link_of_mean(link: str, ybar: float, p: GLMParameters) -> float:
+    eps = 1e-10
+    if link == "identity":
+        return ybar
+    if link == "logit":
+        yb = min(max(ybar, eps), 1 - eps)
+        return float(np.log(yb / (1 - yb)))
+    if link == "log":
+        return float(np.log(max(ybar, eps)))
+    if link == "inverse":
+        return 1.0 / max(abs(ybar), eps) * (1 if ybar >= 0 else -1)
+    if link == "tweedie":
+        lp = p.tweedie_link_power
+        return float(np.log(max(ybar, eps))) if lp == 0 else float(np.power(max(ybar, eps), lp))
+    raise ValueError(link)
+
+
+def _aic(family, y, mu, w, resid_dev, rank) -> float:
+    n = len(y)
+    eps = 1e-15
+    if family == "gaussian":
+        return float(n * np.log(2 * np.pi * resid_dev / n) + n + 2 * (rank + 1))
+    if family == "binomial":
+        mu = np.clip(mu, eps, 1 - eps)
+        ll = float((w * (y * np.log(mu) + (1 - y) * np.log(1 - mu))).sum())
+        return -2 * ll + 2 * rank
+    if family == "poisson":
+        from scipy.special import gammaln
+
+        ll = float((w * (y * np.log(np.maximum(mu, eps)) - mu - gammaln(y + 1))).sum())
+        return -2 * ll + 2 * rank
+    return float("nan")  # gamma/tweedie AIC needs dispersion MLE (as in reference: NaN unless computed)

@@ -43,8 +43,11 @@ def distributed_initialize(**kwargs) -> None:
     """
     try:
         jax.distributed.initialize(**kwargs)
-    except (RuntimeError, ValueError):
-        pass  # already initialized or single-process
+    except RuntimeError as e:
+        # only tolerate double-initialization; real bootstrap failures must
+        # surface, or a multi-host job would silently train on one host
+        if "already" not in str(e).lower():
+            raise
 
 
 def device_count() -> int:

@@ -1,0 +1,146 @@
+"""GLM correctness vs sklearn/scipy oracles — the M3 end-to-end slice.
+
+Reference analogue: hex/glm tests (GLMTest.java etc., SURVEY.md §4);
+reference solver: hex/glm/GLM.java:1160 IRLSM."""
+
+import numpy as np
+import pytest
+from sklearn.linear_model import LinearRegression, LogisticRegression, PoissonRegressor, Ridge
+
+from h2o3_tpu import Frame
+from h2o3_tpu.models.glm import GLM, GLMParameters
+
+
+@pytest.fixture()
+def lin_data(rng):
+    n, p = 2000, 5
+    X = rng.normal(size=(n, p))
+    beta = np.array([1.5, -2.0, 0.5, 0.0, 3.0])
+    y = X @ beta + 0.7 + rng.normal(0, 0.5, n)
+    fr = Frame.from_dict({f"x{i}": X[:, i] for i in range(p)} | {"y": y})
+    return fr, X, y
+
+
+def test_gaussian_matches_ols(mesh, lin_data):
+    fr, X, y = lin_data
+    m = GLM(family="gaussian", response_column="y", lambda_=0.0).train(fr)
+    sk = LinearRegression().fit(X, y)
+    got = np.array([m.coefficients[f"x{i}"] for i in range(5)])
+    np.testing.assert_allclose(got, sk.coef_, atol=2e-4)
+    assert m.coefficients["Intercept"] == pytest.approx(sk.intercept_, abs=2e-4)
+    assert m.training_metrics.r2 > 0.9
+
+
+def test_gaussian_ridge_matches_sklearn(mesh, lin_data):
+    fr, X, y = lin_data
+    lam = 0.1
+    m = GLM(family="gaussian", response_column="y", lambda_=lam, alpha=0.0, standardize=False).train(fr)
+    # sklearn Ridge penalizes sum b^2 * alpha; our objective: dev/(2N) + lam/2 |b|^2
+    sk = Ridge(alpha=lam * len(y), fit_intercept=True).fit(X, y)
+    got = np.array([m.coefficients[f"x{i}"] for i in range(5)])
+    np.testing.assert_allclose(got, sk.coef_, atol=1e-3)
+
+
+def test_binomial_matches_sklearn(mesh, rng):
+    n, p = 3000, 4
+    X = rng.normal(size=(n, p))
+    beta = np.array([1.0, -1.5, 0.7, 2.0])
+    logit = X @ beta - 0.3
+    y = (rng.random(n) < 1 / (1 + np.exp(-logit))).astype(np.float64)
+    fr = Frame.from_dict(
+        {f"x{i}": X[:, i] for i in range(p)} | {"y": np.where(y > 0, "yes", "no")}
+    )
+    m = GLM(family="binomial", response_column="y", lambda_=0.0).train(fr)
+    sk = LogisticRegression(penalty=None, max_iter=500, tol=1e-10).fit(X, y)
+    got = np.array([m.coefficients[f"x{i}"] for i in range(p)])
+    np.testing.assert_allclose(got, sk.coef_[0], atol=2e-3)
+    assert m.coefficients["Intercept"] == pytest.approx(sk.intercept_[0], abs=2e-3)
+    assert m.training_metrics.auc > 0.85
+    # prediction frame shape: predict + two probability columns
+    pred = m.predict(fr)
+    assert pred.names == ["predict", "pno", "pyes"]
+    p1 = pred.col("pyes").data
+    sk_p = sk.predict_proba(X)[:, 1]
+    np.testing.assert_allclose(p1, sk_p, atol=5e-3)
+
+
+def test_poisson_matches_sklearn(mesh, rng):
+    n, p = 2000, 3
+    X = rng.normal(size=(n, p)) * 0.5
+    mu = np.exp(X @ np.array([0.5, -0.3, 0.8]) + 1.0)
+    y = rng.poisson(mu).astype(np.float64)
+    fr = Frame.from_dict({f"x{i}": X[:, i] for i in range(p)} | {"y": y})
+    m = GLM(family="poisson", response_column="y", lambda_=0.0).train(fr)
+    sk = PoissonRegressor(alpha=0.0, max_iter=500, tol=1e-10).fit(X, y)
+    got = np.array([m.coefficients[f"x{i}"] for i in range(p)])
+    np.testing.assert_allclose(got, sk.coef_, atol=1e-3)
+
+
+def test_lasso_sparsifies(mesh, rng):
+    n = 1500
+    X = rng.normal(size=(n, 6))
+    y = X[:, 0] * 2.0 + X[:, 1] * -1.0 + rng.normal(0, 0.3, n)  # x2..x5 irrelevant
+    fr = Frame.from_dict({f"x{i}": X[:, i] for i in range(6)} | {"y": y})
+    m = GLM(family="gaussian", response_column="y", lambda_=0.1, alpha=1.0).train(fr)
+    coefs = np.array([m.coefficients_std[f"x{i}"] for i in range(6)])
+    assert np.sum(np.abs(coefs[2:]) < 1e-8) >= 3, f"L1 should zero noise coefs, got {coefs}"
+    assert abs(coefs[0]) > 0.5
+
+
+def test_categorical_predictors(mesh, rng):
+    n = 2000
+    g = rng.integers(0, 3, n)
+    x = rng.normal(size=n)
+    effect = np.array([0.0, 1.0, -2.0])
+    y = 2.0 * x + effect[g] + rng.normal(0, 0.3, n)
+    fr = Frame.from_dict({"x": x, "g": np.array(["a", "b", "c"])[g], "y": y})
+    m = GLM(family="gaussian", response_column="y", lambda_=0.0).train(fr)
+    # one-hot with first level dropped: coefs for g.b, g.c relative to a
+    assert m.coefficients["g.b"] == pytest.approx(1.0, abs=0.1)
+    assert m.coefficients["g.c"] == pytest.approx(-2.0, abs=0.1)
+    assert m.coefficients["x"] == pytest.approx(2.0, abs=0.05)
+
+
+def test_weights_and_offset(mesh, rng):
+    n = 1000
+    x = rng.normal(size=n)
+    y = 3.0 * x + 1.0 + rng.normal(0, 0.5, n)
+    w = rng.random(n) + 0.5
+    fr = Frame.from_dict({"x": x, "y": y, "w": w})
+    m = GLM(family="gaussian", response_column="y", weights_column="w", lambda_=0.0).train(fr)
+    sk = LinearRegression().fit(x[:, None], y, sample_weight=w)
+    assert m.coefficients["x"] == pytest.approx(sk.coef_[0], abs=1e-3)
+
+
+def test_p_values(mesh, rng):
+    n = 500
+    X = rng.normal(size=(n, 3))
+    y = X @ np.array([2.0, 0.0, 1.0]) + rng.normal(0, 1.0, n)
+    fr = Frame.from_dict({f"x{i}": X[:, i] for i in range(3)} | {"y": y})
+    m = GLM(
+        family="gaussian", response_column="y", lambda_=0.0, compute_p_values=True, standardize=False
+    ).train(fr)
+    assert m.p_values["x0"] < 1e-6  # strong effect
+    assert m.p_values["x1"] > 0.01  # null effect
+
+
+def test_cross_validation(mesh, rng):
+    n = 1200
+    X = rng.normal(size=(n, 3))
+    logit = X @ np.array([1.0, -1.0, 0.5])
+    y = (rng.random(n) < 1 / (1 + np.exp(-logit))).astype(np.float64)
+    fr = Frame.from_dict({f"x{i}": X[:, i] for i in range(3)} | {"y": np.where(y > 0, "p", "n")})
+    m = GLM(family="binomial", response_column="y", nfolds=3, seed=7).train(fr)
+    assert m.cross_validation_metrics is not None
+    assert m.cross_validation_metrics.auc > 0.7
+    assert len(m.cv_models) == 3
+
+
+def test_validation_errors(mesh):
+    fr = Frame.from_dict({"x": [1.0, 2.0], "y": [0.0, 1.0]})
+    with pytest.raises(ValueError, match="response_column"):
+        GLM(family="gaussian", response_column="nope").train(fr)
+    with pytest.raises(ValueError, match="family"):
+        GLM(family="bogus", response_column="y").train(fr)
+    with pytest.raises(ValueError, match="alpha"):
+        GLM(family="gaussian", response_column="y", alpha=2.0).train(fr)

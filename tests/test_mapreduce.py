@@ -82,3 +82,10 @@ def test_quantiles_with_nans(rng):
     got = quantiles(x, [0.5])
     want = np.nanquantile(x.astype(np.float64), 0.5)
     assert got[0] == pytest.approx(want, abs=5e-3)
+
+
+def test_quantiles_outlier_dominated_range(rng):
+    """Regression: zoom must converge past a 1e30 outlier (review finding)."""
+    x = np.concatenate([np.arange(1000, dtype=np.float32), [np.float32(1e30)]])
+    got = quantiles(x, [0.5])
+    assert got[0] == pytest.approx(500.0, abs=1e-3)

@@ -1,0 +1,100 @@
+"""Metric parity tests vs sklearn (the M2 model-framework tier).
+
+Reference analogue: hex/AUC2 tests, ModelMetrics tests (SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+from sklearn import metrics as skm
+
+from h2o3_tpu.models import metrics as M
+
+
+@pytest.fixture()
+def binom_data(rng):
+    n = 5000
+    y = (rng.random(n) < 0.35).astype(np.float64)
+    p = np.clip(0.35 + 0.4 * (y - 0.35) + rng.normal(0, 0.25, n), 1e-6, 1 - 1e-6)
+    return y, p
+
+
+def test_auc_exact_matches_sklearn(binom_data):
+    y, p = binom_data
+    m = M.binomial_metrics(y, p)
+    assert m.auc == pytest.approx(skm.roc_auc_score(y, p), abs=1e-10)
+    assert m.logloss == pytest.approx(skm.log_loss(y, p), abs=1e-10)
+    assert m.gini == pytest.approx(2 * m.auc - 1)
+
+
+def test_auc_400_bins_close_to_exact(binom_data):
+    """The reference's 400-bin approximation (AUC2.java:36) stays within ~1e-3."""
+    y, p = binom_data
+    exact = M.binomial_metrics(y, p, nbins=0).auc
+    approx = M.binomial_metrics(y, p, nbins=400).auc
+    assert approx == pytest.approx(exact, abs=2e-3)
+
+
+def test_max_f1_threshold_and_cm(binom_data):
+    y, p = binom_data
+    m = M.binomial_metrics(y, p)
+    # compare to brute-force F1 over all candidate thresholds
+    prec, rec, thr = skm.precision_recall_curve(y, p)
+    f1 = 2 * prec * rec / np.maximum(prec + rec, 1e-300)
+    best_f1 = f1.max()
+    assert m.cm.f1 == pytest.approx(best_f1, abs=1e-6)
+    cm = m.confusion_matrix(0.5)
+    sk_cm = skm.confusion_matrix(y, (p >= 0.5).astype(int))
+    np.testing.assert_allclose(cm.table, sk_cm)
+
+
+def test_pr_auc_close(binom_data):
+    y, p = binom_data
+    m = M.binomial_metrics(y, p)
+    assert m.pr_auc == pytest.approx(skm.average_precision_score(y, p), abs=5e-3)
+
+
+def test_regression_metrics(rng):
+    y = rng.normal(10, 2, 1000)
+    p = y + rng.normal(0, 1, 1000)
+    m = M.regression_metrics(y, p)
+    assert m.mse == pytest.approx(skm.mean_squared_error(y, p))
+    assert m.mae == pytest.approx(skm.mean_absolute_error(y, p))
+    assert m.r2 == pytest.approx(skm.r2_score(y, p))
+
+
+def test_regression_weights(rng):
+    y = rng.normal(size=500)
+    p = y + rng.normal(0, 1, 500)
+    w = rng.random(500) + 0.5
+    m = M.regression_metrics(y, p, weights=w)
+    assert m.mse == pytest.approx(skm.mean_squared_error(y, p, sample_weight=w))
+
+
+def test_multinomial_metrics(rng):
+    n, k = 3000, 4
+    y = rng.integers(0, k, n)
+    logits = rng.normal(0, 1, (n, k))
+    logits[np.arange(n), y] += 1.5
+    probs = np.exp(logits) / np.exp(logits).sum(1, keepdims=True)
+    m = M.multinomial_metrics(y, probs, domain=["a", "b", "c", "d"])
+    assert m.logloss == pytest.approx(skm.log_loss(y, probs), abs=1e-9)
+    acc = (probs.argmax(1) == y).mean()
+    assert m.hit_ratios[0] == pytest.approx(acc, abs=1e-9)
+    assert m.hit_ratios[-1] == pytest.approx(1.0)
+    assert m.confusion_matrix.sum() == n
+
+
+def test_stop_early_semantics():
+    # monotone improving: never stops
+    hist = list(np.linspace(1.0, 0.5, 20))
+    assert not M.stop_early(hist, stopping_rounds=3, more_is_better=False, stopping_tolerance=1e-3)
+    # plateaued: stops
+    hist = [1.0, 0.8, 0.6, 0.5] + [0.45] * 10
+    assert M.stop_early(hist, stopping_rounds=3, more_is_better=False, stopping_tolerance=1e-3)
+    # too-short history: no decision
+    assert not M.stop_early([1.0, 0.9], stopping_rounds=3, more_is_better=False, stopping_tolerance=1e-3)
+    # more-is-better plateau (e.g. AUC)
+    hist = [0.6, 0.7, 0.75] + [0.76] * 10
+    assert M.stop_early(hist, stopping_rounds=3, more_is_better=True, stopping_tolerance=1e-3)
+    # still improving AUC
+    hist = list(np.linspace(0.6, 0.9, 20))
+    assert not M.stop_early(hist, stopping_rounds=3, more_is_better=True, stopping_tolerance=1e-3)
