@@ -1,0 +1,82 @@
+"""Benchmark: tpu_hist booster training throughput on Higgs-like data.
+
+Measures the north-star config (BASELINE.json configs[2]): XGBoost-style
+``tree_method=tpu_hist`` training rows/sec/chip on a synthetic Higgs-shaped
+dataset (28 numeric features, binary response — the real Higgs-11M is not
+bundled in this zero-egress image, so shapes/statistics are simulated).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline is relative to the previous round's recorded value when a
+BENCH_r*.json exists, else 1.0 (the reference repo publishes no numbers —
+SURVEY.md §6).
+"""
+
+import glob
+import json
+import os
+import re
+import sys
+import time
+
+import numpy as np
+
+
+def synth_higgs(n_rows: int, n_feat: int = 28, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n_rows, n_feat)).astype(np.float32)
+    w = rng.normal(size=n_feat) / np.sqrt(n_feat)
+    logit = X @ w + 0.5 * X[:, 0] * X[:, 1]
+    y = (rng.random(n_rows) < 1.0 / (1.0 + np.exp(-logit))).astype(np.float64)
+    return X, y
+
+
+def main() -> None:
+    n_rows = int(os.environ.get("BENCH_ROWS", 2_000_000))
+    ntrees = int(os.environ.get("BENCH_TREES", 10))
+    max_depth = int(os.environ.get("BENCH_DEPTH", 6))
+
+    import jax
+
+    from h2o3_tpu.models.tree.booster import TreeParams, train_boosted
+    from h2o3_tpu.models.tree.common import grad_hess, init_margin
+
+    X, y = synth_higgs(n_rows)
+    params = TreeParams(
+        ntrees=ntrees, max_depth=max_depth, learn_rate=0.1, nbins=256,
+        min_rows=1.0, reg_lambda=1.0, seed=0,
+    )
+    gh = lambda m: grad_hess("bernoulli", y, m)
+    f0 = init_margin("bernoulli", y, 1)
+
+    # warmup: compile all level programs on a small slice
+    warm = TreeParams(ntrees=1, max_depth=max_depth, nbins=256, seed=0)
+    train_boosted(X[:65536], lambda m: grad_hess("bernoulli", y[:65536], m), 1,
+                  init_margin("bernoulli", y[:65536], 1), warm)
+
+    t0 = time.time()
+    booster = train_boosted(X, gh, 1, f0, params)
+    dt = time.time() - t0
+
+    rows_per_sec = n_rows * ntrees / dt  # row-scans per second per chip
+
+    vs = 1.0
+    prior = sorted(glob.glob("BENCH_r*.json"))
+    if prior:
+        try:
+            with open(prior[-1]) as f:
+                prev = json.load(f)
+            if prev.get("value"):
+                vs = rows_per_sec / float(prev["value"])
+        except Exception:
+            pass
+
+    print(json.dumps({
+        "metric": "tpu_hist_train_rows_per_sec_per_chip",
+        "value": round(rows_per_sec, 1),
+        "unit": "rows/sec (n_rows*ntrees/train_time, Higgs-shaped 28f)",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -96,10 +96,11 @@ def expand_matrix(
     """Frame -> dense [N, P] design matrix per the learned layout.
 
     Returns (X, skip_mask) where skip_mask marks rows dropped under
-    missing_values_handling="skip". Categoricals one-hot expand (unseen test
-    levels get all-zeros like the reference's adaptTestForTrain NA path);
-    numerics are NA-imputed with the training mean and standardized with the
-    training mean/sd.
+    missing_values_handling="skip". Unseen test-time categorical levels map to
+    NA (the reference's adaptTestForTrain) and then follow
+    missing_values_handling like any other NA: mode-imputed under
+    mean_imputation, row-dropped under skip. Numerics are NA-imputed with the
+    training mean and standardized with the training mean/sd.
     """
     n = frame.nrows
     blocks: List[np.ndarray] = []

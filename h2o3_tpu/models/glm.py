@@ -35,7 +35,7 @@ from h2o3_tpu.models.data_info import (
     response_vector,
 )
 from h2o3_tpu.models.framework import Model, ModelBuilder, ModelParameters
-from h2o3_tpu.parallel.mesh import default_mesh, row_sharding
+from h2o3_tpu.parallel.mesh import default_mesh, pad_rows, shard_rows
 
 FAMILIES = ("gaussian", "binomial", "quasibinomial", "poisson", "gamma", "tweedie")
 
@@ -278,6 +278,14 @@ class GLM(ModelBuilder):
     def _fit(self, frame: Frame, valid: Optional[Frame] = None) -> GLMModel:
         p: GLMParameters = self.params
         link = p.actual_link()
+        if p.family in ("binomial", "quasibinomial"):
+            # the reference requires a categorical response for binomial
+            # families; a numeric 0/1 column is auto-converted (as_factor)
+            ycol = frame.col(p.response_column)
+            if not ycol.is_categorical():
+                frame = frame.add_column(ycol.as_factor())
+                if valid is not None:
+                    valid = valid.add_column(valid.col(p.response_column).as_factor())
         info = build_data_info(
             frame,
             y=p.response_column,
@@ -305,15 +313,16 @@ class GLM(ModelBuilder):
         if n == 0:
             raise ValueError("no rows left after NA handling")
 
-        # device placement: row-sharded [N, P+1] with intercept column
+        # device placement: row-sharded [N, P(+1 intercept col when enabled)]
         mesh = default_mesh()
         nshards = mesh.devices.size
-        padn = (-n) % nshards
-        Xi = np.concatenate([X, np.ones((n, 1), dtype=np.float32)], axis=1)
-        if padn:
-            Xi = np.concatenate([Xi, np.zeros((padn, pcols + 1), dtype=np.float32)])
-        Xd = jax.device_put(Xi, row_sharding(mesh, 2))
-        pad = lambda a: np.concatenate([a, np.zeros(padn)]) if padn else a
+        Xi = (
+            np.concatenate([X, np.ones((n, 1), dtype=np.float32)], axis=1)
+            if p.intercept
+            else X
+        )
+        Xd, _ = shard_rows(Xi, mesh)
+        pad = lambda a: pad_rows(a, nshards)[0]
 
         X64 = X.astype(np.float64)  # host copy for eta/deviance (made once)
         wsum = float(obs_w.sum())
@@ -335,12 +344,14 @@ class GLM(ModelBuilder):
             wz = (eta - offset) + (y - mu) * d
 
             G, q = _gram(Xd, pad(wz), pad(w))
+            free = 1 if p.intercept else 0
             if l1 > 0:
-                beta_new = _solve_admm(G / wsum, q / wsum, l1 / wsum, l2 / wsum, free=1)
+                solved = _solve_admm(G / wsum, q / wsum, l1 / wsum, l2 / wsum, free=free)
             else:
-                beta_new = _solve_ridge(G / wsum, q / wsum, l2 / wsum, free=1)
-            if not p.intercept:
-                beta_new[-1] = 0.0
+                solved = _solve_ridge(G / wsum, q / wsum, l2 / wsum, free=free)
+            # without an intercept the ones column is excluded from the solve
+            # entirely (clamping after solving would converge to wrong coefs)
+            beta_new = solved if p.intercept else np.append(solved, 0.0)
 
             dev = float((obs_w * deviance(p.family, y, _linkinv(link, X64 @ beta_new[:-1] + beta_new[-1] + offset, p), p)).sum())
             obj = dev / (2 * wsum) + p.lambda_ * (

@@ -330,7 +330,7 @@ def multinomial_metrics(
     kk = min(max_hit_ratio_k, K)
     ranks = np.argsort(-P, axis=1)[:, :kk]
     hits = ranks == y[:, None]
-    hr = np.cumsum(hits.astype(np.float64) * w[:, None], axis=0)[-1] if len(y) else np.zeros(kk)
+    hr = (hits.astype(np.float64) * w[:, None]).sum(axis=0) if len(y) else np.zeros(kk)
     hit_ratios = np.cumsum(hr) / wsum
     return MultinomialMetrics(
         logloss=logloss,

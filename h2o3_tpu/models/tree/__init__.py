@@ -1,0 +1,19 @@
+from h2o3_tpu.models.tree.booster import BoostedTrees, TreeParams, train_boosted
+from h2o3_tpu.models.tree.gbm import GBM, GBMModel, GBMParameters
+from h2o3_tpu.models.tree.drf import DRF, DRFModel, DRFParameters
+from h2o3_tpu.models.tree.xgboost import XGBoost, XGBoostModel, XGBoostParameters
+
+__all__ = [
+    "BoostedTrees",
+    "TreeParams",
+    "train_boosted",
+    "GBM",
+    "GBMModel",
+    "GBMParameters",
+    "DRF",
+    "DRFModel",
+    "DRFParameters",
+    "XGBoost",
+    "XGBoostModel",
+    "XGBoostParameters",
+]

@@ -1,0 +1,395 @@
+"""The tpu_hist booster core — histogram GBDT shared by GBM/DRF/XGBoost.
+
+Reference architecture being re-designed (not translated):
+  * driver loop: ``hex/tree/SharedTree.java:208-210,440-469`` (iterate trees ×
+    scoreAndBuildTrees, k trees per class);
+  * per-level fused pass: ``hex/tree/ScoreBuildHistogram2.java`` (re-assign
+    rows to new leaves + accumulate histograms);
+  * split search over bins: ``hex/tree/DTree.java`` (UndecidedNode.bestCol);
+  * XGBoost-style second-order machinery: ``h2o-extensions/xgboost``'s native
+    ``grow_gpu_hist`` updater (``XGBoostModel.java:382-394``), Rabit allreduce
+    replaced by ``lax.psum`` (SURVEY.md §2.3).
+
+TPU-native design decisions:
+  * global quantile binning once per training run (static uint8-range codes)
+    — the reference's ``histogram_type=QuantilesGlobal`` made the default,
+    because per-leaf re-binning (UniformAdaptive) implies dynamic shapes;
+  * level-wise growth with a fixed node capacity of 2^depth per level: every
+    level is one jitted program of static shape, compiled once per depth and
+    reused across all trees and all boosting rounds;
+  * rows carry a level-local node id (-1 = out of tree); the histogram is a
+    shard-private scatter-add + psum (h2o3_tpu/ops/histogram.py);
+  * split search, leaf values, and node routing are replicated O(K·F·B) jnp
+    ops — tiny next to the histogram pass;
+  * NA routing learns a per-split default direction by evaluating the NA
+    bucket on both sides (DHistogram's trailing NA bin, XGBoost default-dir).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from h2o3_tpu.ops.histogram import apply_bins, build_histogram_sharded, make_bins
+from h2o3_tpu.parallel.mesh import default_mesh, row_sharding
+
+
+@dataclass
+class TreeParams:
+    ntrees: int = 50
+    max_depth: int = 6
+    learn_rate: float = 0.1
+    nbins: int = 256
+    min_rows: float = 1.0
+    min_split_improvement: float = 1e-5
+    reg_lambda: float = 1.0  # L2 on leaf values (xgboost lambda; GBM uses 0)
+    reg_alpha: float = 0.0  # L1 on leaf values
+    gamma: float = 0.0  # min loss reduction (xgboost gamma)
+    sample_rate: float = 1.0  # row subsample per tree
+    col_sample_rate_per_tree: float = 1.0
+    mtries: int = -1  # features per split; -1 = all (DRF uses sqrt/thirds)
+    seed: int = 42
+
+
+class Trees:
+    """Heap-layout tree arrays. Node i's children are 2i+1 / 2i+2.
+
+    Per tree: feat[M] int32, split_bin[M] int32, default_left[M] bool,
+    is_split[M] bool, leaf[M] f32 (learn-rate scaled), with
+    M = 2^(max_depth+1)-1. Stored stacked: [T, M] per field.
+    """
+
+    def __init__(self, max_depth: int, n_bins1: int, edges: np.ndarray):
+        self.max_depth = max_depth
+        self.n_bins1 = n_bins1
+        self.edges = edges  # [F, B-1] for re-binning at predict time
+        self.feat: List[np.ndarray] = []
+        self.split_bin: List[np.ndarray] = []
+        self.default_left: List[np.ndarray] = []
+        self.is_split: List[np.ndarray] = []
+        self.leaf: List[np.ndarray] = []
+
+    def append(self, feat, split_bin, default_left, is_split, leaf) -> None:
+        self.feat.append(np.asarray(feat))
+        self.split_bin.append(np.asarray(split_bin))
+        self.default_left.append(np.asarray(default_left))
+        self.is_split.append(np.asarray(is_split))
+        self.leaf.append(np.asarray(leaf))
+
+    @property
+    def ntrees(self) -> int:
+        return len(self.feat)
+
+    def stacked(self):
+        return (
+            jnp.asarray(np.stack(self.feat)),
+            jnp.asarray(np.stack(self.split_bin)),
+            jnp.asarray(np.stack(self.default_left)),
+            jnp.asarray(np.stack(self.is_split)),
+            jnp.asarray(np.stack(self.leaf)),
+        )
+
+
+# ---------------------------------------------------------------------------
+# jitted level-step pieces
+
+
+@partial(jax.jit, static_argnames=("n_bins1", "min_rows"))
+def _split_search(hist, lam, alpha, gamma, lr, feat_mask, min_rows: float, n_bins1: int):
+    """Per-node best split over (feature, bin, NA-direction).
+
+    hist: [K, F, B+1, 3] (Σg, Σh, count). Returns per-node arrays:
+    feat, bin, default_left, gain, leaf_value (lr-scaled), plus can_split.
+    """
+    B = n_bins1 - 1
+    total = hist.sum(axis=2)  # [K, F, 3] — identical across F
+    G = total[:, 0, 0]
+    H = total[:, 0, 1]
+    CNT = total[:, 0, 2]
+
+    real = hist[:, :, :B, :]
+    na = hist[:, :, B, :]  # [K, F, 3]
+    cum = jnp.cumsum(real, axis=2)  # bins <= b on the left
+
+    def side_score(g, h):
+        # optimal leaf objective with L1/L2: 0.5 * T(g)^2 / (h + lam)
+        t = jnp.sign(g) * jnp.maximum(jnp.abs(g) - alpha, 0.0)
+        return t * t / jnp.maximum(h + lam, 1e-12)
+
+    parent = side_score(G, H)  # [K]
+
+    def dir_gain(gl, hl, cl):
+        gr = G[:, None, None] - gl
+        hr = H[:, None, None] - hl
+        cr = CNT[:, None, None] - cl
+        gain = 0.5 * (side_score(gl, hl) + side_score(gr, hr) - parent[:, None, None]) - gamma
+        ok = (cl >= min_rows) & (cr >= min_rows)
+        return jnp.where(ok, gain, -jnp.inf)
+
+    # NA right (default_left=False): left stats = cum; NA left: left += NA bucket
+    gain_r = dir_gain(cum[..., 0], cum[..., 1], cum[..., 2])
+    gain_l = dir_gain(
+        cum[..., 0] + na[..., 0][:, :, None],
+        cum[..., 1] + na[..., 1][:, :, None],
+        cum[..., 2] + na[..., 2][:, :, None],
+    )
+
+    go_left_better = gain_l > gain_r
+    gain_fb = jnp.where(go_left_better, gain_l, gain_r)  # [K, F, B]
+    # feat_mask: [F] global or [K, F] per-node (DRF mtries per split)
+    fm = feat_mask[None, :, None] if feat_mask.ndim == 1 else feat_mask[:, :, None]
+    gain_fb = jnp.where(fm, gain_fb, -jnp.inf)
+
+    flat = gain_fb.reshape(gain_fb.shape[0], -1)
+    best = jnp.argmax(flat, axis=1)
+    best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+    best_f = (best // B).astype(jnp.int32)
+    best_b = (best % B).astype(jnp.int32)
+    dl = jnp.take_along_axis(
+        go_left_better.reshape(go_left_better.shape[0], -1), best[:, None], axis=1
+    )[:, 0]
+
+    # leaf value if this node terminates (Newton step, L1-thresholded, lr-scaled)
+    t = jnp.sign(G) * jnp.maximum(jnp.abs(G) - alpha, 0.0)
+    leaf = -lr * t / jnp.maximum(H + lam, 1e-12)
+    return best_f, best_b, dl, best_gain, leaf
+
+
+@jax.jit
+def _route_rows(bins, nodes, feat, split_bin, default_left, is_split, n_bins1_arr):
+    """Advance rows one level: node k -> 2k (left) / 2k+1 (right); rows whose
+    node became a leaf leave the tree (-1)."""
+    k = jnp.where(nodes >= 0, nodes, 0)
+    f = feat[k]
+    b = jnp.take_along_axis(bins, f[:, None], axis=1)[:, 0]
+    is_na = b >= n_bins1_arr - 1
+    go_left = jnp.where(is_na, default_left[k], b <= split_bin[k])
+    child = 2 * k + jnp.where(go_left, 0, 1)
+    new_nodes = jnp.where((nodes >= 0) & is_split[k], child, -1)
+    return new_nodes.astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("max_depth",))
+def _predict_stacked(bins, feat, split_bin, default_left, is_split, leaf, max_depth: int, n_bins1_arr):
+    """Sum of all trees' outputs for each row. Tree arrays: [T, M]."""
+
+    def one_tree(carry, tree):
+        tf, tb, tdl, tsp, tlf = tree
+        idx = jnp.zeros(bins.shape[0], dtype=jnp.int32)
+
+        def body(_, idx):
+            f = tf[idx]
+            b = jnp.take_along_axis(bins, f[:, None], axis=1)[:, 0]
+            is_na = b >= n_bins1_arr - 1
+            go_left = jnp.where(is_na, tdl[idx], b <= tb[idx])
+            nxt = 2 * idx + jnp.where(go_left, 1, 2)
+            return jnp.where(tsp[idx], nxt, idx)
+
+        idx = jax.lax.fori_loop(0, max_depth, body, idx)
+        return carry + tlf[idx], None
+
+    out, _ = jax.lax.scan(one_tree, jnp.zeros(bins.shape[0], jnp.float32), (feat, split_bin, default_left, is_split, leaf))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# training driver
+
+
+class BoostedTrees:
+    """Trained ensemble: per-class Trees + binning spec + init margin."""
+
+    def __init__(
+        self,
+        trees_per_class: List[Trees],
+        init_margin: np.ndarray,  # [C]
+        params: TreeParams,
+        average: bool = False,  # DRF averages instead of summing margins
+    ):
+        self.trees_per_class = trees_per_class
+        self.init_margin = init_margin
+        self.params = params
+        self.average = average
+
+    @property
+    def nclasses_trees(self) -> int:
+        return len(self.trees_per_class)
+
+    def predict_margin(self, X: np.ndarray) -> np.ndarray:
+        """Raw margins [N, C] from raw features (re-binned with stored edges)."""
+        t0 = self.trees_per_class[0]
+        bins = jnp.asarray(apply_bins(X, t0.edges))
+        cols = []
+        for c, trees in enumerate(self.trees_per_class):
+            if trees.ntrees == 0:
+                cols.append(np.full(X.shape[0], self.init_margin[c], dtype=np.float64))
+                continue
+            s = _predict_stacked(
+                bins, *trees.stacked(), max_depth=trees.max_depth,
+                n_bins1_arr=jnp.int32(trees.n_bins1),
+            )
+            s = np.asarray(jax.device_get(s), dtype=np.float64)
+            if self.average:
+                s = s / trees.ntrees
+            cols.append(self.init_margin[c] + s)
+        return np.stack(cols, axis=1)
+
+
+def train_boosted(
+    X: np.ndarray,
+    grad_hess_fn: Callable[[np.ndarray], Tuple[jnp.ndarray, jnp.ndarray]],
+    n_class_trees: int,
+    init_margin: np.ndarray,
+    params: TreeParams,
+    average: bool = False,
+    monitor: Optional[Callable[[int, np.ndarray], bool]] = None,
+    mesh=None,
+) -> BoostedTrees:
+    """Generic booster loop.
+
+    grad_hess_fn(margin[N, C]) -> (g[N, C], h[N, C]) on host or device.
+    monitor(tree_idx, margin) -> True to stop early (ScoreKeeper hook).
+    ``average=True`` gives DRF semantics (bagged trees, mean aggregation):
+    each tree then fits the raw targets (grad_hess_fn ignores the margin).
+    """
+    n, F = X.shape
+    p = params
+    if mesh is None:
+        mesh = default_mesh()
+    nshards = mesh.devices.size
+
+    edges = make_bins(X, p.nbins, seed=p.seed)
+    bins_host = apply_bins(X, edges)
+    n_bins1 = p.nbins + 1
+    padn = (-n) % nshards
+    if padn:
+        bins_host = np.concatenate(
+            [bins_host, np.zeros((padn, F), dtype=np.int32)], axis=0
+        )
+    bins_d = jax.device_put(bins_host, row_sharding(mesh, 2))
+    n_pad = bins_host.shape[0]
+    valid_row = np.arange(n_pad) < n
+
+    margin = np.tile(np.asarray(init_margin, dtype=np.float32), (n, 1))  # [N, C]
+    rng = np.random.default_rng(p.seed)
+    trees_per_class = [Trees(p.max_depth, n_bins1, edges) for _ in range(n_class_trees)]
+
+    key = jax.random.PRNGKey(p.seed)
+    for t in range(p.ntrees):
+        g_all, h_all = grad_hess_fn(margin)
+        g_all = np.asarray(g_all, dtype=np.float32)
+        h_all = np.asarray(h_all, dtype=np.float32)
+        # row subsample for this boosting round
+        if p.sample_rate < 1.0:
+            row_mask = rng.random(n) < p.sample_rate
+        else:
+            row_mask = np.ones(n, dtype=bool)
+        # per-tree column subsample
+        if p.col_sample_rate_per_tree < 1.0:
+            ncols = max(1, int(round(p.col_sample_rate_per_tree * F)))
+            chosen = rng.choice(F, ncols, replace=False)
+            feat_mask = np.zeros(F, dtype=bool)
+            feat_mask[chosen] = True
+        else:
+            feat_mask = np.ones(F, dtype=bool)
+        feat_mask_d = jnp.asarray(feat_mask)
+
+        for c in range(n_class_trees):
+            g = np.zeros(n_pad, dtype=np.float32)
+            h = np.zeros(n_pad, dtype=np.float32)
+            g[:n], h[:n] = g_all[:, c], h_all[:, c]
+            g_d = jax.device_put(g, row_sharding(mesh, 1))
+            h_d = jax.device_put(h, row_sharding(mesh, 1))
+            active = row_mask
+            if padn:
+                active = np.concatenate([row_mask, np.zeros(padn, dtype=bool)])
+            nodes0 = np.where(valid_row & active, 0, -1).astype(np.int32)
+            nodes = jax.device_put(nodes0, row_sharding(mesh, 1))
+
+            M = 2 ** (p.max_depth + 1) - 1
+            t_feat = np.zeros(M, np.int32)
+            t_bin = np.zeros(M, np.int32)
+            t_dl = np.zeros(M, bool)
+            t_sp = np.zeros(M, bool)
+            t_lf = np.zeros(M, np.float32)
+
+            for d in range(p.max_depth + 1):
+                K = 2**d
+                hist = build_histogram_sharded(
+                    bins_d, nodes, g_d, h_d, n_nodes=K, n_bins1=n_bins1, mesh=mesh
+                )
+                if p.mtries > 0:
+                    key, sub = jax.random.split(key)
+                    r = jax.random.uniform(sub, (K, F))
+                    thresh = jnp.sort(r, axis=1)[:, p.mtries - 1][:, None]
+                    node_feat_mask = (r <= thresh) & feat_mask_d[None, :]
+                else:
+                    node_feat_mask = None
+                bf, bb, dl, gain, leaf = _split_search(
+                    hist,
+                    jnp.float32(p.reg_lambda),
+                    jnp.float32(p.reg_alpha),
+                    jnp.float32(p.gamma),
+                    jnp.float32(p.learn_rate),
+                    feat_mask_d if node_feat_mask is None else node_feat_mask,
+                    min_rows=float(p.min_rows),
+                    n_bins1=n_bins1,
+                )
+                bf, bb, dl, gain, leaf = jax.device_get((bf, bb, dl, gain, leaf))
+                lo = 2**d - 1
+                can = (gain > max(p.min_split_improvement, 0.0)) & np.isfinite(gain) & (d < p.max_depth)
+                t_feat[lo : lo + K] = bf
+                t_bin[lo : lo + K] = bb
+                t_dl[lo : lo + K] = dl
+                t_sp[lo : lo + K] = can
+                t_lf[lo : lo + K] = leaf
+                if not can.any():
+                    break
+                nodes = _route_rows(
+                    bins_d,
+                    nodes,
+                    jnp.asarray(bf),
+                    jnp.asarray(bb),
+                    jnp.asarray(dl),
+                    jnp.asarray(can),
+                    jnp.int32(n_bins1),
+                )
+            trees_per_class[c].append(t_feat, t_bin, t_dl, t_sp, t_lf)
+
+            # margin update from this tree (full data, not just the sample)
+            pred = _tree_predict_single(
+                bins_d, jnp.asarray(t_feat), jnp.asarray(t_bin), jnp.asarray(t_dl),
+                jnp.asarray(t_sp), jnp.asarray(t_lf), p.max_depth, jnp.int32(n_bins1),
+            )
+            margin[:, c] += np.asarray(jax.device_get(pred))[:n]
+
+        if monitor is not None and monitor(t, margin):
+            break
+
+    if average:
+        # DRF: margins were accumulated as sums; convert to means lazily at
+        # predict; training margin conversion is the caller's concern.
+        pass
+    return BoostedTrees(trees_per_class, np.asarray(init_margin, np.float64), p, average=average)
+
+
+@partial(jax.jit, static_argnames=("max_depth",))
+def _tree_predict_single(bins, feat, split_bin, default_left, is_split, leaf, max_depth: int, n_bins1_arr):
+    idx = jnp.zeros(bins.shape[0], dtype=jnp.int32)
+
+    def body(_, idx):
+        f = feat[idx]
+        b = jnp.take_along_axis(bins, f[:, None], axis=1)[:, 0]
+        is_na = b >= n_bins1_arr - 1
+        go_left = jnp.where(is_na, default_left[idx], b <= split_bin[idx])
+        nxt = 2 * idx + jnp.where(go_left, 1, 2)
+        return jnp.where(is_split[idx], nxt, idx)
+
+    idx = jax.lax.fori_loop(0, max_depth, body, idx)
+    return leaf[idx]

@@ -1,0 +1,162 @@
+"""Shared plumbing for tree models: matrices, distributions, monitors.
+
+Reference: trees consume raw (non-standardized) predictors with categorical
+codes; ``hex/tree/SharedTree.java`` + ``hex/DataInfo`` handle the layout and
+``hex/Distribution.java`` the gradient families. Categorical handling note:
+the reference can split categorical sets directly; this build currently
+treats categorical codes as ordinal bins (equivalent to the reference's
+``categorical_encoding=label_encoder`` / sorted enum mode) — set-valued
+splits are a planned refinement.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.data_info import DataInfo, _align_codes, build_data_info
+from h2o3_tpu.models.framework import Model
+from h2o3_tpu.models import metrics as M
+
+
+def tree_data_info(frame: Frame, y: str, ignored=()) -> DataInfo:
+    """Layout for tree models: raw numerics, label-encoded categoricals."""
+    return build_data_info(
+        frame, y=y, ignored=ignored, standardize=False, use_all_factor_levels=True
+    )
+
+
+def tree_matrix(info: DataInfo, frame: Frame) -> np.ndarray:
+    """[N, F] float32 raw-feature matrix; cat codes as ordinals, NaN for NA."""
+    cols = []
+    for name in info.predictor_names:
+        col = frame.col(name)
+        if name in info.cat_domains:
+            codes = _align_codes(col, info.cat_domains[name])
+            cols.append(np.where(codes >= 0, codes.astype(np.float32), np.nan))
+        else:
+            cols.append(col.numeric_view().astype(np.float32))
+    return np.stack(cols, axis=1)
+
+
+# -- distributions (hex/Distribution.java gradient/hessian families) ---------
+
+
+def sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def softmax(m):
+    z = m - m.max(axis=1, keepdims=True)
+    e = np.exp(z)
+    return e / e.sum(axis=1, keepdims=True)
+
+
+def grad_hess(distribution: str, y: np.ndarray, margin: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-row (g, h) of the loss wrt the margin. y: [N] (codes for classif),
+    margin: [N, C]. Returns [N, C] arrays."""
+    if distribution == "gaussian":
+        g = margin[:, 0] - y
+        return g[:, None], np.ones_like(g)[:, None]
+    if distribution == "bernoulli":
+        p = sigmoid(margin[:, 0])
+        return (p - y)[:, None], np.maximum(p * (1 - p), 1e-16)[:, None]
+    if distribution == "multinomial":
+        p = softmax(margin)
+        onehot = np.zeros_like(p)
+        onehot[np.arange(len(y)), y.astype(np.int64)] = 1.0
+        return p - onehot, np.maximum(p * (1 - p), 1e-16)
+    if distribution == "poisson":
+        mu = np.exp(margin[:, 0])
+        return (mu - y)[:, None], np.maximum(mu, 1e-16)[:, None]
+    if distribution == "laplace":
+        g = np.sign(margin[:, 0] - y)
+        return g[:, None], np.ones_like(g)[:, None]
+    if distribution == "quantile_0.5":
+        g = np.where(margin[:, 0] > y, 0.5, -0.5)
+        return g[:, None], np.ones_like(g)[:, None]
+    raise ValueError(f"unknown distribution {distribution!r}")
+
+
+def init_margin(distribution: str, y: np.ndarray, nclasses: int) -> np.ndarray:
+    """Initial margin f0 (SharedTree init: response moments / priors)."""
+    if distribution == "gaussian":
+        return np.array([float(np.nanmean(y))])
+    if distribution == "bernoulli":
+        p = float(np.nanmean(y))
+        p = min(max(p, 1e-10), 1 - 1e-10)
+        return np.array([np.log(p / (1 - p))])
+    if distribution == "multinomial":
+        pri = np.bincount(y[~np.isnan(y)].astype(np.int64), minlength=nclasses).astype(np.float64)
+        pri = np.maximum(pri / pri.sum(), 1e-10)
+        return np.log(pri)
+    if distribution == "poisson":
+        return np.array([np.log(max(float(np.nanmean(y)), 1e-10))])
+    if distribution in ("laplace", "quantile_0.5"):
+        return np.array([float(np.nanmedian(y))])
+    raise ValueError(f"unknown distribution {distribution!r}")
+
+
+def margin_to_probs(distribution: str, margin: np.ndarray) -> np.ndarray:
+    if distribution == "bernoulli":
+        p = sigmoid(margin[:, 0])
+        return np.stack([1 - p, p], axis=1)
+    if distribution == "multinomial":
+        return softmax(margin)
+    return margin  # regression: identity
+
+
+def auto_distribution(nclasses: int) -> str:
+    if nclasses == 2:
+        return "bernoulli"
+    if nclasses > 2:
+        return "multinomial"
+    return "gaussian"
+
+
+def training_score(distribution: str, y: np.ndarray, margin: np.ndarray) -> float:
+    """Scalar stopping metric from the current margin (deviance-flavored)."""
+    if distribution == "bernoulli":
+        p = np.clip(sigmoid(margin[:, 0]), 1e-15, 1 - 1e-15)
+        return float(np.mean(-(y * np.log(p) + (1 - y) * np.log(1 - p))))
+    if distribution == "multinomial":
+        p = softmax(margin)
+        return float(np.mean(-np.log(np.clip(p[np.arange(len(y)), y.astype(np.int64)], 1e-15, 1))))
+    if distribution == "poisson":
+        mu = np.exp(margin[:, 0])
+        return float(np.mean(2 * (np.where(y > 0, y * np.log(np.where(y > 0, y, 1) / mu), 0) - (y - mu))))
+    return float(np.mean((margin[:, 0] - y) ** 2))
+
+
+class TreeModelBase(Model):
+    """Common prediction path for GBM/DRF/XGBoost models."""
+
+    def __init__(self, params, data_info, distribution: str):
+        super().__init__(params, data_info)
+        self.distribution = distribution
+        self.booster = None  # BoostedTrees
+        self.ntrees_built = 0
+
+    def _predict_raw(self, frame: Frame) -> np.ndarray:
+        X = tree_matrix(self.data_info, frame)
+        margin = self.booster.predict_margin(X)
+        return (
+            margin_to_probs(self.distribution, margin)
+            if self.is_classifier
+            else margin[:, 0]
+        )
+
+    def variable_importances(self) -> dict:
+        """Split-count/gain-weighted importances (SharedTree varimp analogue:
+        squared-error reduction summed per feature)."""
+        imp = np.zeros(len(self.data_info.predictor_names))
+        for trees in self.booster.trees_per_class:
+            for t in range(trees.ntrees):
+                sp = trees.is_split[t]
+                feats = trees.feat[t][sp]
+                np.add.at(imp, feats, 1.0)
+        total = imp.sum()
+        rel = imp / total if total > 0 else imp
+        return dict(zip(self.data_info.predictor_names, rel.tolist()))

@@ -1,0 +1,116 @@
+"""DRF — distributed random forest on the shared tree machinery.
+
+Reference: ``hex/tree/drf/DRF.java`` — same SharedTree driver as GBM, but
+bagged trees fit the raw response (no boosting), per-split feature sampling
+(mtries), sample_rate 0.632 default, and predictions aggregate by averaging.
+Classification leaves hold class frequencies; this build realizes that as a
+per-class indicator-regression tree (leaf = class fraction in the leaf),
+averaged over trees and normalized — same estimator, SPMD-friendly shapes.
+OOB scoring is a planned refinement (reference scores OOB by default).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.data_info import response_vector
+from h2o3_tpu.models.framework import ModelBuilder, ModelParameters
+from h2o3_tpu.models.tree.booster import TreeParams, train_boosted
+from h2o3_tpu.models.tree.common import TreeModelBase, tree_data_info, tree_matrix
+
+
+@dataclass
+class DRFParameters(ModelParameters):
+    ntrees: int = 50
+    max_depth: int = 12  # reference default 20; dense level-wise capacity caps this build
+    nbins: int = 20
+    min_rows: float = 1.0
+    min_split_improvement: float = 1e-5
+    sample_rate: float = 0.632  # reference DRF default (DRFParametersV3)
+    mtries: int = -1  # -1: sqrt(F) classif, F/3 regression (DRF.java)
+
+
+class DRFModel(TreeModelBase):
+    algo_name = "drf"
+
+    def _predict_raw(self, frame: Frame) -> np.ndarray:
+        X = tree_matrix(self.data_info, frame)
+        margin = self.booster.predict_margin(X)  # averaged leaf values per class
+        if not self.is_classifier:
+            return margin[:, 0]
+        p = np.clip(margin, 1e-9, None)
+        if p.shape[1] == 1:  # binomial: single tree-set predicts P(class 1)
+            p1 = np.clip(margin[:, 0], 0.0, 1.0)
+            return np.stack([1 - p1, p1], axis=1)
+        return p / p.sum(axis=1, keepdims=True)
+
+
+class DRF(ModelBuilder):
+    algo_name = "drf"
+
+    def __init__(self, params: Optional[DRFParameters] = None, **kw) -> None:
+        super().__init__(params or DRFParameters(**kw))
+
+    def _fit(self, frame: Frame, valid: Optional[Frame] = None) -> DRFModel:
+        p: DRFParameters = self.params
+        info = tree_data_info(frame, p.response_column, p.ignored_columns)
+        y = response_vector(info, frame)
+        nclasses = len(info.response_domain) if info.response_domain else 1
+        model = DRFModel(p, info, "gaussian")
+        X = tree_matrix(info, frame)
+        keep = ~np.isnan(y)
+        X, y = X[keep], y[keep]
+        F = X.shape[1]
+
+        mtries = p.mtries
+        if mtries <= 0:
+            mtries = max(1, int(np.sqrt(F)) if nclasses > 1 else max(1, F // 3))
+
+        # targets: raw y (regression) or per-class indicators (classification)
+        if nclasses > 1 and nclasses != 2:
+            targets = np.zeros((len(y), nclasses), dtype=np.float64)
+            targets[np.arange(len(y)), y.astype(np.int64)] = 1.0
+            n_class_trees = nclasses
+        elif nclasses == 2:
+            targets = y[:, None]
+            n_class_trees = 1
+        else:
+            targets = y[:, None]
+            n_class_trees = 1
+
+        tp = TreeParams(
+            ntrees=p.ntrees,
+            max_depth=p.max_depth,
+            learn_rate=1.0,  # no shrinkage: each tree predicts the target itself
+            nbins=p.nbins,
+            min_rows=p.min_rows,
+            min_split_improvement=p.min_split_improvement,
+            reg_lambda=0.0,
+            reg_alpha=0.0,
+            sample_rate=p.sample_rate,
+            mtries=mtries,
+            seed=p.actual_seed(),
+        )
+
+        # each tree independently fits the raw targets: g = -target, h = 1
+        # gives Newton leaf = mean(target in leaf)
+        def gh(_margin):
+            return -targets, np.ones_like(targets)
+
+        model.booster = train_boosted(
+            X,
+            grad_hess_fn=gh,
+            n_class_trees=n_class_trees,
+            init_margin=np.zeros(n_class_trees),
+            params=tp,
+            average=True,
+        )
+        model.ntrees_built = model.booster.trees_per_class[0].ntrees
+        model.training_metrics = model.model_performance(frame)
+        if valid is not None:
+            model.validation_metrics = model.model_performance(valid)
+        return model

@@ -1,0 +1,110 @@
+"""GBM — H2O-style gradient boosting on the tpu_hist booster core.
+
+Reference: ``hex/tree/gbm/GBM.java:452,493,571`` (buildNextKTrees / growTrees),
+distributions from ``hex/Distribution.java``, defaults from GBMParametersV3.
+One tree per class per iteration (SharedTree k-trees), Newton leaf values,
+row/column sampling, ScoreKeeper early stopping.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models import metrics as M
+from h2o3_tpu.models.data_info import response_vector
+from h2o3_tpu.models.framework import ModelBuilder, ModelParameters
+from h2o3_tpu.models.tree.booster import TreeParams, train_boosted
+from h2o3_tpu.models.tree.common import (
+    TreeModelBase,
+    auto_distribution,
+    grad_hess,
+    init_margin,
+    training_score,
+    tree_data_info,
+    tree_matrix,
+)
+
+
+@dataclass
+class GBMParameters(ModelParameters):
+    ntrees: int = 50
+    max_depth: int = 5
+    learn_rate: float = 0.1
+    nbins: int = 20  # reference GBM default nbins=20 (GBMParametersV3)
+    min_rows: float = 10.0
+    min_split_improvement: float = 1e-5
+    sample_rate: float = 1.0
+    col_sample_rate_per_tree: float = 1.0
+    distribution: str = "auto"
+    score_tree_interval: int = 1
+
+
+class GBMModel(TreeModelBase):
+    algo_name = "gbm"
+
+
+class GBM(ModelBuilder):
+    algo_name = "gbm"
+
+    def __init__(self, params: Optional[GBMParameters] = None, **kw) -> None:
+        super().__init__(params or GBMParameters(**kw))
+
+    def _fit(self, frame: Frame, valid: Optional[Frame] = None) -> GBMModel:
+        p: GBMParameters = self.params
+        info = tree_data_info(frame, p.response_column, p.ignored_columns)
+        y = response_vector(info, frame)
+        nclasses = len(info.response_domain) if info.response_domain else 1
+        dist = auto_distribution(nclasses) if p.distribution == "auto" else p.distribution
+
+        model = GBMModel(p, info, dist)
+        X = tree_matrix(info, frame)
+        keep = ~np.isnan(y)
+        X, y = X[keep], y[keep]
+
+        f0 = init_margin(dist, y, nclasses)
+        n_class_trees = nclasses if dist == "multinomial" else 1
+
+        tp = TreeParams(
+            ntrees=p.ntrees,
+            max_depth=p.max_depth,
+            learn_rate=p.learn_rate,
+            nbins=p.nbins,
+            min_rows=p.min_rows,
+            min_split_improvement=p.min_split_improvement,
+            reg_lambda=0.0,  # the reference GBM has no leaf L2
+            reg_alpha=0.0,
+            sample_rate=p.sample_rate,
+            col_sample_rate_per_tree=p.col_sample_rate_per_tree,
+            seed=p.actual_seed(),
+        )
+
+        history = []
+
+        def monitor(t: int, margin: np.ndarray) -> bool:
+            model.ntrees_built = t + 1
+            if p.stopping_rounds <= 0 or (t + 1) % p.score_tree_interval:
+                return False
+            history.append(training_score(dist, y, margin))
+            model.scoring_history.append({"tree": t + 1, "score": history[-1]})
+            return M.stop_early(
+                history, p.stopping_rounds, more_is_better=False,
+                stopping_tolerance=p.stopping_tolerance,
+            )
+
+        model.booster = train_boosted(
+            X,
+            grad_hess_fn=lambda m: grad_hess(dist, y, m),
+            n_class_trees=n_class_trees,
+            init_margin=f0,
+            params=tp,
+            monitor=monitor,
+        )
+        model.ntrees_built = model.booster.trees_per_class[0].ntrees
+        model.training_metrics = model.model_performance(frame)
+        if valid is not None:
+            model.validation_metrics = model.model_performance(valid)
+        return model

@@ -1,0 +1,122 @@
+"""XGBoost-style booster — tree_method="tpu_hist", the north-star config.
+
+Reference: ``h2o-extensions/xgboost`` — Java glue around native libxgboost
+(``XGBoostModel.java:240-292,382-394`` resolves backend/tree_method to
+``grow_gpu_hist``; ``task/XGBoostUpdater.java:124,155`` steps the native
+booster; Rabit allreduce merges histograms across nodes, SURVEY.md §2.3).
+
+TPU-native: no JNI, no Rabit, no DMatrix conversion — the booster IS the
+tpu_hist core (h2o3_tpu/models/tree/booster.py): quantized features, Pallas/
+XLA scatter-add histograms, psum merge over ICI, second-order split gains
+with lambda/alpha/gamma regularization exactly as libxgboost defines them.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models import metrics as M
+from h2o3_tpu.models.data_info import response_vector
+from h2o3_tpu.models.framework import ModelBuilder, ModelParameters
+from h2o3_tpu.models.tree.booster import TreeParams, train_boosted
+from h2o3_tpu.models.tree.common import (
+    TreeModelBase,
+    auto_distribution,
+    grad_hess,
+    init_margin,
+    training_score,
+    tree_data_info,
+    tree_matrix,
+)
+
+
+@dataclass
+class XGBoostParameters(ModelParameters):
+    ntrees: int = 50
+    max_depth: int = 6
+    learn_rate: float = 0.3  # eta
+    nbins: int = 256  # max_bins (hist/gpu_hist default)
+    min_rows: float = 1.0  # min_child_weight analogue on row counts
+    min_split_improvement: float = 0.0
+    reg_lambda: float = 1.0
+    reg_alpha: float = 0.0
+    gamma: float = 0.0
+    sample_rate: float = 1.0  # subsample
+    col_sample_rate_per_tree: float = 1.0  # colsample_bytree
+    tree_method: str = "tpu_hist"
+    distribution: str = "auto"
+    score_tree_interval: int = 1
+
+
+class XGBoostModel(TreeModelBase):
+    algo_name = "xgboost"
+
+
+class XGBoost(ModelBuilder):
+    algo_name = "xgboost"
+
+    def __init__(self, params: Optional[XGBoostParameters] = None, **kw) -> None:
+        super().__init__(params or XGBoostParameters(**kw))
+
+    def _fit(self, frame: Frame, valid: Optional[Frame] = None) -> XGBoostModel:
+        p: XGBoostParameters = self.params
+        info = tree_data_info(frame, p.response_column, p.ignored_columns)
+        y = response_vector(info, frame)
+        nclasses = len(info.response_domain) if info.response_domain else 1
+        dist = auto_distribution(nclasses) if p.distribution == "auto" else p.distribution
+
+        model = XGBoostModel(p, info, dist)
+        X = tree_matrix(info, frame)
+        keep = ~np.isnan(y)
+        X, y = X[keep], y[keep]
+
+        # libxgboost starts from base_score (0.5 prob -> 0 margin); we use the
+        # data-driven init like the reference's H2O-side initial prediction
+        f0 = init_margin(dist, y, nclasses)
+        n_class_trees = nclasses if dist == "multinomial" else 1
+
+        tp = TreeParams(
+            ntrees=p.ntrees,
+            max_depth=p.max_depth,
+            learn_rate=p.learn_rate,
+            nbins=p.nbins,
+            min_rows=p.min_rows,
+            min_split_improvement=p.min_split_improvement,
+            reg_lambda=p.reg_lambda,
+            reg_alpha=p.reg_alpha,
+            gamma=p.gamma,
+            sample_rate=p.sample_rate,
+            col_sample_rate_per_tree=p.col_sample_rate_per_tree,
+            seed=p.actual_seed(),
+        )
+
+        history = []
+
+        def monitor(t: int, margin: np.ndarray) -> bool:
+            model.ntrees_built = t + 1
+            if p.stopping_rounds <= 0 or (t + 1) % p.score_tree_interval:
+                return False
+            history.append(training_score(dist, y, margin))
+            model.scoring_history.append({"tree": t + 1, "score": history[-1]})
+            return M.stop_early(
+                history, p.stopping_rounds, more_is_better=False,
+                stopping_tolerance=p.stopping_tolerance,
+            )
+
+        model.booster = train_boosted(
+            X,
+            grad_hess_fn=lambda m: grad_hess(dist, y, m),
+            n_class_trees=n_class_trees,
+            init_margin=f0,
+            params=tp,
+            monitor=monitor,
+        )
+        model.ntrees_built = model.booster.trees_per_class[0].ntrees
+        model.training_metrics = model.model_performance(frame)
+        if valid is not None:
+            model.validation_metrics = model.model_performance(valid)
+        return model

@@ -1,0 +1,3 @@
+from h2o3_tpu.ops.histogram import build_histogram_sharded, make_bins, apply_bins
+
+__all__ = ["build_histogram_sharded", "make_bins", "apply_bins"]

@@ -1,0 +1,128 @@
+"""Gradient-histogram construction — the tree-training hot kernel.
+
+Reference: ``hex/tree/DHistogram.java:433`` (updateHisto: accumulate
+{Σw, Σwy, Σwy²} per (leaf, column, bin) in a flat double[]), built per node
+tree-level by ``ScoreBuildHistogram2`` (``tree/ScoreBuildHistogram2.java:
+273-280,385-396``) as a two-stage pass: per-thread private histograms, then a
+shared atomic merge, then a cross-node MRTask reduce. The XGBoost extension
+does the same thing on GPU inside ``grow_gpu_hist`` (native, §2.3 of
+SURVEY.md).
+
+TPU-native redesign (the "tpu_hist" kernel):
+  * features are pre-quantized to int bin codes (global quantile binning like
+    XGBoost hist / H2O ``histogram_type=QuantilesGlobal``) — static shapes,
+    uint8-sized codes, NA gets a dedicated trailing bin;
+  * per device shard, the (node, feature, bin) histogram of (grad, hess,
+    count) is ONE fused scatter-add into a zeros array — the shard-private
+    histogram, exactly ScoreBuildHistogram2's private stage;
+  * the cross-device merge is ``lax.psum`` over the data axis — the MRTask
+    reduce, emitted by XLA as a log-depth ICI collective.
+
+A Pallas VMEM-resident variant lives in h2o3_tpu/ops/pallas_histogram.py;
+this module is the portable XLA path and the correctness oracle.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+from h2o3_tpu.parallel.mesh import DATA_AXIS
+
+
+# ---------------------------------------------------------------------------
+# quantile binning (GlobalQuantilesCalc / XGBoost sketch analogue)
+
+
+def make_bins(
+    X: np.ndarray, nbins: int = 256, sample: int = 200_000, seed: int = 0
+) -> np.ndarray:
+    """Per-feature bin edges from (sampled) quantiles. Returns [F, nbins-1]
+    interior edges; value -> bin = searchsorted(edges, v, 'right')."""
+    n, F = X.shape
+    if n > sample:
+        idx = np.random.default_rng(seed).choice(n, sample, replace=False)
+        Xs = X[idx]
+    else:
+        Xs = X
+    qs = np.linspace(0, 1, nbins + 1)[1:-1]
+    edges = np.empty((F, nbins - 1), dtype=np.float64)
+    for f in range(F):
+        col = Xs[:, f]
+        col = col[~np.isnan(col)]
+        if col.size == 0:
+            edges[f] = np.arange(nbins - 1, dtype=np.float64)
+            continue
+        e = np.quantile(col, qs)
+        # de-duplicate while keeping monotonicity (constant-ish features)
+        e = np.maximum.accumulate(e)
+        edges[f] = e
+    return edges
+
+
+def apply_bins(X: np.ndarray, edges: np.ndarray) -> np.ndarray:
+    """Quantize raw features to bin codes [N, F] int8-range; NA -> nbins."""
+    n, F = X.shape
+    nbins = edges.shape[1] + 1
+    out = np.empty((n, F), dtype=np.int32)
+    for f in range(F):
+        out[:, f] = np.searchsorted(edges[f], X[:, f], side="right")
+        out[np.isnan(X[:, f]), f] = nbins  # NA bucket (DHistogram NA bin at end)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the scatter-add histogram
+
+
+def _shard_histogram(bins, nodes, g, h, n_nodes: int, n_bins1: int):
+    """Shard-private histogram: [K, F, B+1, 3] of (Σg, Σh, count)."""
+    n, F = bins.shape
+    valid = nodes >= 0
+    node = jnp.where(valid, nodes, 0)
+    flat = (node[:, None] * F + jnp.arange(F, dtype=jnp.int32)[None, :]) * n_bins1 + bins
+    w = valid.astype(g.dtype)
+    vals = jnp.stack(
+        [
+            jnp.broadcast_to((g * w)[:, None], (n, F)),
+            jnp.broadcast_to((h * w)[:, None], (n, F)),
+            jnp.broadcast_to(w[:, None], (n, F)),
+        ],
+        axis=-1,
+    )  # [n, F, 3]
+    hist = jnp.zeros((n_nodes * F * n_bins1, 3), g.dtype)
+    hist = hist.at[flat.reshape(-1)].add(vals.reshape(-1, 3))
+    return hist.reshape(n_nodes, F, n_bins1, 3)
+
+
+@partial(jax.jit, static_argnames=("n_nodes", "n_bins1", "mesh"))
+def build_histogram_sharded(bins, nodes, g, h, n_nodes: int, n_bins1: int, mesh=None):
+    """Full distributed histogram: private scatter-add per shard, psum merge.
+
+    bins:[N,F] int32 row-sharded; nodes:[N] int32 (-1 = inactive row);
+    g,h:[N] float32. Returns replicated [n_nodes, F, n_bins1, 3].
+    """
+    if mesh is None:
+        return _shard_histogram(bins, nodes, g, h, n_nodes, n_bins1)
+
+    def fn(b, nd, gg, hh):
+        part = _shard_histogram(b, nd, gg, hh, n_nodes, n_bins1)
+        return jax.lax.psum(part, DATA_AXIS)
+
+    return _shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=P(),
+    )(bins, nodes, g, h)

@@ -44,9 +44,14 @@ def distributed_initialize(**kwargs) -> None:
     try:
         jax.distributed.initialize(**kwargs)
     except RuntimeError as e:
-        # only tolerate double-initialization; real bootstrap failures must
+        # tolerate double-initialization; real bootstrap failures must
         # surface, or a multi-host job would silently train on one host
         if "already" not in str(e).lower():
+            raise
+    except ValueError as e:
+        # single-process runs (no coordinator configured) are a no-op;
+        # misconfigured multi-host args still raise
+        if "coordinator" not in str(e).lower():
             raise
 
 

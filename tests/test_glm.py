@@ -144,3 +144,25 @@ def test_validation_errors(mesh):
         GLM(family="bogus", response_column="y").train(fr)
     with pytest.raises(ValueError, match="alpha"):
         GLM(family="gaussian", response_column="y", alpha=2.0).train(fr)
+
+
+def test_binomial_numeric_response_autoconverts(mesh, rng):
+    """Regression: numeric 0/1 response + binomial family (review finding)."""
+    n = 800
+    x = rng.normal(size=n)
+    y = (rng.random(n) < 1 / (1 + np.exp(-2 * x))).astype(np.float64)
+    fr = Frame.from_dict({"x": x, "y": y})
+    m = GLM(family="binomial", response_column="y", lambda_=0.0).train(fr)
+    assert m.is_classifier and m.training_metrics.auc > 0.7
+
+
+def test_no_intercept_solution(mesh, rng):
+    """Regression: intercept=False must exclude the ones column (review finding)."""
+    n = 1000
+    x = rng.normal(size=n) + 1.0
+    y = x + 5.0 + rng.normal(0, 0.1, n)
+    fr = Frame.from_dict({"x": x, "y": y})
+    m = GLM(family="gaussian", response_column="y", intercept=False, standardize=False).train(fr)
+    want = float((x * y).sum() / (x * x).sum())  # closed-form no-intercept OLS
+    assert m.coefficients["x"] == pytest.approx(want, rel=1e-4)
+    assert m.coefficients["Intercept"] == 0.0

@@ -1,0 +1,167 @@
+"""Tree booster correctness — the M4 flagship kernel.
+
+Reference analogue: hex/tree/gbm/GBMTest.java, DRFTest (SURVEY.md §4).
+Oracles: sklearn GBM/HistGradientBoosting on identical data."""
+
+import numpy as np
+import pytest
+from sklearn.ensemble import HistGradientBoostingClassifier, HistGradientBoostingRegressor
+
+from h2o3_tpu import Frame
+from h2o3_tpu.models.tree import DRF, GBM, XGBoost
+from h2o3_tpu.ops.histogram import apply_bins, build_histogram_sharded, make_bins
+
+import jax.numpy as jnp
+
+
+def _classif_frame(rng, n=4000, informative=True):
+    X = rng.normal(size=(n, 6)).astype(np.float64)
+    logit = 1.5 * X[:, 0] - 2.0 * X[:, 1] + X[:, 2] * X[:, 3]
+    y = (rng.random(n) < 1 / (1 + np.exp(-logit))).astype(int)
+    d = {f"x{i}": X[:, i] for i in range(6)}
+    d["y"] = np.where(y > 0, "yes", "no")
+    return Frame.from_dict(d), X, y
+
+
+def test_histogram_matches_numpy(mesh, rng):
+    n, F, K, B = 1003, 4, 3, 8
+    bins = rng.integers(0, B + 1, size=(n, F)).astype(np.int32)
+    nodes = rng.integers(-1, K, size=n).astype(np.int32)
+    g = rng.normal(size=n).astype(np.float32)
+    h = rng.random(n).astype(np.float32)
+    pad = (-n) % 8
+    bp = np.pad(bins, ((0, pad), (0, 0)))
+    npad = np.pad(nodes, (0, pad), constant_values=-1)
+    gp, hp = np.pad(g, (0, pad)), np.pad(h, (0, pad))
+    hist = np.asarray(
+        build_histogram_sharded(
+            jnp.asarray(bp), jnp.asarray(npad), jnp.asarray(gp), jnp.asarray(hp),
+            n_nodes=K, n_bins1=B + 1, mesh=mesh,
+        )
+    )
+    want = np.zeros((K, F, B + 1, 3))
+    for i in range(n):
+        if nodes[i] < 0:
+            continue
+        for f in range(F):
+            want[nodes[i], f, bins[i, f], 0] += g[i]
+            want[nodes[i], f, bins[i, f], 1] += h[i]
+            want[nodes[i], f, bins[i, f], 2] += 1
+    np.testing.assert_allclose(hist, want, rtol=1e-4, atol=1e-4)
+
+
+def test_binning_roundtrip(rng):
+    X = rng.normal(size=(5000, 3))
+    X[::17, 1] = np.nan
+    edges = make_bins(X, nbins=32)
+    bins = apply_bins(X, edges)
+    assert bins.min() >= 0 and bins.max() <= 32
+    assert np.all(bins[::17, 1] == 32)  # NA bucket
+    # bins are monotone in the raw value
+    order = np.argsort(X[:, 0])
+    assert np.all(np.diff(bins[order, 0]) >= 0)
+
+
+def test_gbm_binomial_learns(mesh, rng):
+    fr, X, y = _classif_frame(rng)
+    m = GBM(response_column="y", ntrees=30, max_depth=4, seed=1).train(fr)
+    assert m.training_metrics.auc > 0.87, f"AUC {m.training_metrics.auc}"
+    sk = HistGradientBoostingClassifier(max_iter=30, max_depth=4, early_stopping=False).fit(X, y)
+    from sklearn.metrics import roc_auc_score
+
+    sk_auc = roc_auc_score(y, sk.predict_proba(X)[:, 1])
+    assert m.training_metrics.auc > sk_auc - 0.03, f"{m.training_metrics.auc} vs sklearn {sk_auc}"
+
+
+def test_gbm_regression_matches_sklearn_ballpark(mesh, rng):
+    n = 3000
+    X = rng.normal(size=(n, 5))
+    y = 3 * X[:, 0] + np.sin(3 * X[:, 1]) * 2 + X[:, 2] * X[:, 3] + 0.1 * rng.normal(size=n)
+    fr = Frame.from_dict({f"x{i}": X[:, i] for i in range(5)} | {"y": y})
+    m = GBM(response_column="y", ntrees=50, max_depth=4, seed=1).train(fr)
+    sk = HistGradientBoostingRegressor(max_iter=50, max_depth=4, early_stopping=False).fit(X, y)
+    from sklearn.metrics import mean_squared_error
+
+    sk_mse = mean_squared_error(y, sk.predict(X))
+    assert m.training_metrics.mse < max(2.5 * sk_mse, 0.15), (
+        f"{m.training_metrics.mse} vs sklearn {sk_mse}"
+    )
+
+
+def test_gbm_multinomial(mesh, rng):
+    n = 3000
+    X = rng.normal(size=(n, 4))
+    score = np.stack([X[:, 0], X[:, 1], -X[:, 0] - X[:, 1]], axis=1) + 0.3 * rng.normal(size=(n, 3))
+    y = score.argmax(axis=1)
+    fr = Frame.from_dict(
+        {f"x{i}": X[:, i] for i in range(4)} | {"y": np.array(["a", "b", "c"])[y]}
+    )
+    m = GBM(response_column="y", ntrees=20, max_depth=4, seed=1).train(fr)
+    assert m.training_metrics.hit_ratios[0] > 0.85
+    pred = m.predict(fr)
+    assert pred.names[0] == "predict"
+    assert set(pred.col("predict").domain) == {"a", "b", "c"}
+
+
+def test_gbm_handles_nas_and_categoricals(mesh, rng):
+    n = 2000
+    x = rng.normal(size=n)
+    x[::5] = np.nan
+    g = rng.integers(0, 3, n)
+    y = np.where(np.isnan(x), 2.0, x) + np.array([0.0, 2.0, -1.0])[g] + 0.1 * rng.normal(size=n)
+    fr = Frame.from_dict({"x": x, "g": np.array(["u", "v", "w"])[g], "y": y})
+    m = GBM(response_column="y", ntrees=30, max_depth=4, min_rows=5, seed=1).train(fr)
+    assert m.training_metrics.r2 > 0.8
+
+
+def test_gbm_early_stopping(mesh, rng):
+    fr, X, y = _classif_frame(rng, n=1500)
+    m = GBM(
+        response_column="y", ntrees=200, max_depth=3, stopping_rounds=3,
+        stopping_tolerance=0.01, seed=1,
+    ).train(fr)
+    assert m.ntrees_built < 200, "early stopping should have triggered"
+
+
+def test_drf_classification(mesh, rng):
+    fr, X, y = _classif_frame(rng)
+    m = DRF(response_column="y", ntrees=30, max_depth=8, seed=1).train(fr)
+    assert m.training_metrics.auc > 0.9
+    probs = m._predict_raw(fr)
+    assert probs.shape == (fr.nrows, 2)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-5)
+
+
+def test_drf_regression(mesh, rng):
+    n = 2000
+    X = rng.normal(size=(n, 5))
+    y = 2 * X[:, 0] - X[:, 1] + 0.2 * rng.normal(size=n)
+    fr = Frame.from_dict({f"x{i}": X[:, i] for i in range(5)} | {"y": y})
+    m = DRF(response_column="y", ntrees=40, max_depth=10, seed=1).train(fr)
+    assert m.training_metrics.r2 > 0.7
+
+
+def test_xgboost_binomial(mesh, rng):
+    fr, X, y = _classif_frame(rng)
+    m = XGBoost(response_column="y", ntrees=30, max_depth=5, learn_rate=0.3, seed=1).train(fr)
+    assert m.training_metrics.auc > 0.95
+    assert m.params.tree_method == "tpu_hist"
+
+
+def test_xgboost_regularization_shrinks_leaves(mesh, rng):
+    fr, X, y = _classif_frame(rng, n=1500)
+    m1 = XGBoost(response_column="y", ntrees=5, max_depth=4, reg_lambda=0.0, seed=1).train(fr)
+    m2 = XGBoost(response_column="y", ntrees=5, max_depth=4, reg_lambda=100.0, seed=1).train(fr)
+    l1 = np.abs(np.concatenate([t for t in m1.booster.trees_per_class[0].leaf])).max()
+    l2 = np.abs(np.concatenate([t for t in m2.booster.trees_per_class[0].leaf])).max()
+    assert l2 < l1
+
+
+def test_variable_importance(mesh, rng):
+    n = 2000
+    X = rng.normal(size=(n, 4))
+    y = 5 * X[:, 2] + 0.1 * rng.normal(size=n)  # only x2 matters
+    fr = Frame.from_dict({f"x{i}": X[:, i] for i in range(4)} | {"y": y})
+    m = GBM(response_column="y", ntrees=10, max_depth=3, seed=1).train(fr)
+    vi = m.variable_importances()
+    assert vi["x2"] == max(vi.values())
