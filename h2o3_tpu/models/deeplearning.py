@@ -1,0 +1,274 @@
+"""DeepLearning — multilayer perceptron, TPU-native.
+
+Reference: ``hex/deeplearning`` (5.7k LoC) — MLP with SGD+momentum or
+ADADELTA, dropout, L1/L2, autoencoder mode; per-layer fprop/bprop hand-coded
+(``Neurons.java:184-229``); parallelism is per-node Hogwild racy updates plus
+cross-node model averaging each iteration (``DeepLearningModelInfo.java:70``,
+``DeepLearningTask.java:50-62,125``).
+
+TPU-native redesign (SURVEY.md §2.4): Hogwild and model averaging are replaced
+by SYNCHRONOUS minibatch data-parallel SGD — the batch is row-sharded over the
+mesh, parameters are replicated, and XLA inserts the gradient all-reduce; this
+is both deterministic and faster on TPU (racy updates don't exist in SPMD).
+Forward/backward come from ``jax.grad`` instead of hand-coded bprop; the MXU
+sees one [B, in]x[in, out] matmul per layer. Optimizers via optax
+(ADADELTA to match the reference's adaptive_rate default, SGD+momentum with
+rate annealing otherwise). Dropout/L1/L2/autoencoder semantics preserved.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models import metrics as M
+from h2o3_tpu.models.data_info import build_data_info, expand_matrix, response_vector
+from h2o3_tpu.models.framework import Model, ModelBuilder, ModelParameters
+from h2o3_tpu.parallel.mesh import default_mesh, row_sharding
+
+
+@dataclass
+class DeepLearningParameters(ModelParameters):
+    hidden: List[int] = field(default_factory=lambda: [200, 200])
+    activation: str = "rectifier"  # rectifier|tanh|maxout(≈rectifier here)
+    epochs: float = 10.0
+    mini_batch_size: int = 256  # reference default is 1 (Hogwild); sync DP wants real batches
+    adaptive_rate: bool = True  # ADADELTA (rho/epsilon), as in the reference
+    rho: float = 0.99
+    epsilon: float = 1e-8
+    rate: float = 0.005
+    rate_annealing: float = 1e-6
+    momentum_start: float = 0.0
+    momentum_ramp: float = 1e6  # samples over which momentum ramps (reference default)
+    momentum_stable: float = 0.0
+    input_dropout_ratio: float = 0.0
+    hidden_dropout_ratios: Optional[List[float]] = None
+    l1: float = 0.0
+    l2: float = 0.0
+    loss: str = "auto"  # auto|cross_entropy|quadratic|absolute
+    distribution: str = "auto"
+    standardize: bool = True
+    autoencoder: bool = False
+    score_interval: int = 1  # epochs between scoring events
+
+
+def _activation(name: str):
+    return {
+        "rectifier": jax.nn.relu,
+        "relu": jax.nn.relu,
+        "tanh": jnp.tanh,
+        "maxout": jax.nn.relu,  # maxout pieces degrade to relu in this build
+    }[name]
+
+
+def _init_params(key, sizes: List[int]) -> List[Tuple[jnp.ndarray, jnp.ndarray]]:
+    """He-uniform init (reference: UniformAdaptive initial_weight_distribution)."""
+    params = []
+    for i in range(len(sizes) - 1):
+        key, sub = jax.random.split(key)
+        fan_in, fan_out = sizes[i], sizes[i + 1]
+        bound = jnp.sqrt(6.0 / (fan_in + fan_out))
+        W = jax.random.uniform(sub, (fan_in, fan_out), jnp.float32, -bound, bound)
+        params.append((W, jnp.zeros(fan_out, jnp.float32)))
+    return params
+
+
+def _forward(params, x, act, dropout_key=None, input_dropout=0.0, hidden_dropout=None):
+    h = x
+    if dropout_key is not None and input_dropout > 0:
+        dropout_key, sub = jax.random.split(dropout_key)
+        keep = jax.random.bernoulli(sub, 1 - input_dropout, h.shape)
+        h = jnp.where(keep, h / (1 - input_dropout), 0.0)
+    n_layers = len(params)
+    for i, (W, b) in enumerate(params):
+        h = h @ W + b
+        if i < n_layers - 1:
+            h = act(h)
+            if dropout_key is not None and hidden_dropout is not None and hidden_dropout[i] > 0:
+                dropout_key, sub = jax.random.split(dropout_key)
+                keep = jax.random.bernoulli(sub, 1 - hidden_dropout[i], h.shape)
+                h = jnp.where(keep, h / (1 - hidden_dropout[i]), 0.0)
+    return h
+
+
+class DeepLearningModel(Model):
+    algo_name = "deeplearning"
+
+    def __init__(self, params, data_info, loss_kind: str):
+        super().__init__(params, data_info)
+        self.net_params = None
+        self.loss_kind = loss_kind
+        self.epochs_trained = 0.0
+
+    def _forward_np(self, frame: Frame) -> np.ndarray:
+        X, _ = expand_matrix(self.data_info, frame, dtype=np.float32)
+        out = _forward(self.net_params, jnp.asarray(X), _activation(self.params.activation))
+        return np.asarray(jax.device_get(out))
+
+    def _predict_raw(self, frame: Frame) -> np.ndarray:
+        out = self._forward_np(frame)
+        if self.params.autoencoder:
+            return out
+        if self.is_classifier:
+            z = out - out.max(axis=1, keepdims=True)
+            e = np.exp(z)
+            return e / e.sum(axis=1, keepdims=True)
+        return out[:, 0]
+
+    def predict(self, frame: Frame) -> Frame:
+        if not self.params.autoencoder:
+            return super().predict(frame)
+        # reconstruction frame, one column per design-matrix coefficient
+        # (reference: DeepLearningModel scoreAutoEncoder reconstruction output)
+        from h2o3_tpu.frame.frame import ColType, Column
+
+        rec = self._forward_np(frame)
+        names = self.data_info.coef_names
+        return Frame(
+            [Column(f"reconstr_{names[i]}", rec[:, i].astype(np.float64), ColType.NUM)
+             for i in range(rec.shape[1])]
+        )
+
+    def anomaly(self, frame: Frame) -> np.ndarray:
+        """Autoencoder per-row reconstruction MSE (reference: DeepLearningModel
+        scoreAutoEncoder)."""
+        assert self.params.autoencoder, "anomaly() requires autoencoder=True"
+        X, _ = expand_matrix(self.data_info, frame, dtype=np.float32)
+        rec = np.asarray(jax.device_get(
+            _forward(self.net_params, jnp.asarray(X), _activation(self.params.activation))
+        ))
+        return ((rec - X) ** 2).mean(axis=1)
+
+
+class DeepLearning(ModelBuilder):
+    algo_name = "deeplearning"
+
+    def __init__(self, params: Optional[DeepLearningParameters] = None, **kw) -> None:
+        super().__init__(params or DeepLearningParameters(**kw))
+
+    def _fit(self, frame: Frame, valid: Optional[Frame] = None) -> DeepLearningModel:
+        p: DeepLearningParameters = self.params
+        info = build_data_info(
+            frame,
+            y=None if p.autoencoder else p.response_column,
+            ignored=p.ignored_columns,
+            standardize=p.standardize,
+            use_all_factor_levels=True,
+        )
+        X, _ = expand_matrix(info, frame, dtype=np.float32)
+        n, d_in = X.shape
+
+        if p.autoencoder:
+            nclasses, y_codes = 1, None
+            d_out, loss_kind = d_in, "quadratic"
+            Y = X
+        else:
+            y = response_vector(info, frame)
+            keep = ~np.isnan(y)
+            X, y = X[keep], y[keep]
+            n = len(y)
+            nclasses = len(info.response_domain) if info.response_domain else 1
+            if nclasses > 1:
+                d_out, loss_kind = nclasses, "cross_entropy"
+                Y = y.astype(np.int32)
+            else:
+                d_out, loss_kind = 1, "quadratic" if p.loss in ("auto", "quadratic") else p.loss
+                Y = y.astype(np.float32)
+
+        model = DeepLearningModel(p, info, loss_kind)
+        act = _activation(p.activation)
+        sizes = [d_in] + list(p.hidden) + [d_out]
+        key = jax.random.PRNGKey(p.actual_seed())
+        key, init_key = jax.random.split(key)
+        net = _init_params(init_key, sizes)
+
+        use_momentum = (p.momentum_start > 0) or (p.momentum_stable > 0)
+        if p.adaptive_rate:
+            opt = optax.adadelta(learning_rate=1.0, rho=p.rho, eps=p.epsilon)
+        else:
+            sched = (
+                optax.schedules.exponential_decay(p.rate, 1, 1.0 / (1.0 + p.rate_annealing))
+                if p.rate_annealing > 0
+                else p.rate
+            )
+            if use_momentum:
+                # momentum ramps linearly from start to stable over momentum_ramp
+                # samples (reference: Neurons momentum(), momentum_ramp param)
+                def mom_sched(step):
+                    samples = step * float(p.mini_batch_size)
+                    frac = jnp.clip(samples / max(p.momentum_ramp, 1.0), 0.0, 1.0)
+                    return p.momentum_start + (p.momentum_stable - p.momentum_start) * frac
+
+                opt = optax.inject_hyperparams(
+                    lambda momentum: optax.sgd(sched, momentum=momentum)
+                )(momentum=mom_sched)
+            else:
+                opt = optax.sgd(sched)
+        opt_state = opt.init(net)
+
+        hidden_do = tuple(p.hidden_dropout_ratios) if p.hidden_dropout_ratios else None
+
+        def loss_fn(net, xb, yb, dk):
+            out = _forward(net, xb, act, dk, p.input_dropout_ratio, hidden_do)
+            if loss_kind == "cross_entropy":
+                ll = optax.softmax_cross_entropy_with_integer_labels(out, yb)
+                data_loss = ll.mean()
+            elif loss_kind == "absolute":
+                data_loss = jnp.abs(out[:, 0] - yb).mean()
+            elif p.autoencoder:
+                data_loss = ((out - yb) ** 2).mean()
+            else:
+                data_loss = ((out[:, 0] - yb) ** 2).mean()
+            reg = sum(p.l1 * jnp.abs(W).sum() + p.l2 * (W**2).sum() for W, _ in net)
+            return data_loss + reg
+
+        @jax.jit
+        def train_step(net, opt_state, xb, yb, dk):
+            loss, grads = jax.value_and_grad(loss_fn)(net, xb, yb, dk)
+            updates, opt_state = opt.update(grads, opt_state, net)
+            net = optax.apply_updates(net, updates)
+            return net, opt_state, loss
+
+        mesh = default_mesh()
+        nshards = mesh.devices.size
+        bs = max(p.mini_batch_size, nshards)
+        bs -= bs % nshards  # static sharded batch shape
+        rng = np.random.default_rng(p.actual_seed())
+        steps_per_epoch = max(n // bs, 1)
+        total_epochs = int(np.ceil(p.epochs))
+        history: List[float] = []
+
+        for epoch in range(total_epochs):
+            perm = rng.permutation(n)
+            for s in range(steps_per_epoch):
+                idx = perm[s * bs : (s + 1) * bs]
+                if len(idx) < bs:  # static shapes: cycle the permutation
+                    idx = np.resize(perm, bs)
+                xb = jax.device_put(X[idx], row_sharding(mesh, 2))
+                yb = jax.device_put(Y[idx], row_sharding(mesh, Y.ndim))
+                key, dk = jax.random.split(key)
+                net, opt_state, loss = train_step(net, opt_state, xb, yb, dk)
+            model.epochs_trained = epoch + 1
+            if p.stopping_rounds > 0 and (epoch + 1) % p.score_interval == 0:
+                history.append(float(jax.device_get(loss)))
+                if M.stop_early(
+                    history, p.stopping_rounds, more_is_better=False,
+                    stopping_tolerance=p.stopping_tolerance,
+                ):
+                    break
+            if self.job is not None:
+                self.job.update((epoch + 1) / total_epochs)
+
+        model.net_params = jax.device_get(net)
+        if not p.autoencoder:
+            model.training_metrics = model.model_performance(frame)
+            if valid is not None:
+                model.validation_metrics = model.model_performance(valid)
+        return model

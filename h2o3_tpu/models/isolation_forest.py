@@ -1,0 +1,162 @@
+"""IsolationForest — anomaly detection by random isolation trees.
+
+Reference: ``hex/tree/isofor/IsolationForest.java`` — trees grown on small
+row samples with uniformly random (feature, threshold) splits; anomaly score
+normalizes the mean path length by c(sample_size)
+(score = 2^(-E[path]/c(n)), Liu et al.).
+
+TPU-native split of labor: tree BUILDING runs on the host — each tree sees
+only ``sample_size`` (default 256) rows, so building is microseconds and
+data-independent of N. SCORING is the N-scale work and runs as the same
+jitted heap-walk used by the boosting trees (leaf value = path length), over
+row-sharded data. This mirrors the reference's economics where build cost is
+bounded by the sample, not the frame.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from functools import partial
+
+from h2o3_tpu.frame.frame import ColType, Column, Frame
+from h2o3_tpu.models.framework import Model, ModelBuilder, ModelParameters
+from h2o3_tpu.models.tree.common import tree_data_info, tree_matrix
+
+
+@partial(jax.jit, static_argnames=("max_depth",))
+def _path_lengths(X, feat, thresh, is_split, path_len, max_depth: int):
+    """Mean isolation path length per row over all trees (scan over [T, M])."""
+
+    def one_tree(carry, tree):
+        tf, tt, tsp, tpl = tree
+        idx = jnp.zeros(X.shape[0], dtype=jnp.int32)
+
+        def body(_, idx):
+            f = tf[idx]
+            v = jnp.take_along_axis(X, f[:, None], axis=1)[:, 0]
+            go_left = ~(v > tt[idx])  # NaN compares False -> routes left
+            nxt = 2 * idx + jnp.where(go_left, 1, 2)
+            return jnp.where(tsp[idx], nxt, idx)
+
+        idx = jax.lax.fori_loop(0, max_depth, body, idx)
+        return carry + tpl[idx], None
+
+    total, _ = jax.lax.scan(
+        one_tree, jnp.zeros(X.shape[0], jnp.float32), (feat, thresh, is_split, path_len)
+    )
+    return total / feat.shape[0]
+
+
+@dataclass
+class IsolationForestParameters(ModelParameters):
+    ntrees: int = 50
+    sample_size: int = 256
+    max_depth: int = 8  # reference default: ceil(log2(sample_size))
+    mtries: int = -1
+
+
+def _c_factor(n: float) -> float:
+    """Average unsuccessful BST search length c(n) (Liu et al.; reference scoring)."""
+    if n <= 1:
+        return 0.0
+    return 2.0 * (np.log(n - 1.0) + 0.5772156649) - 2.0 * (n - 1.0) / n
+
+
+class IsolationForestModel(Model):
+    algo_name = "isolationforest"
+
+    def __init__(self, params, data_info):
+        super().__init__(params, data_info)
+        self.trees = None  # stacked arrays [T, M] like the booster
+        self.max_depth = params.max_depth
+        self._cn = 1.0
+
+    @property
+    def is_classifier(self) -> bool:
+        return False
+
+    def _predict_raw(self, frame: Frame) -> np.ndarray:
+        """Anomaly score in [0,1]; higher = more anomalous."""
+        X = tree_matrix(self.data_info, frame)
+        feat, thresh, is_split, path_len = self.trees
+        mean_path = np.asarray(jax.device_get(_path_lengths(
+            jnp.asarray(X), jnp.asarray(feat), jnp.asarray(thresh),
+            jnp.asarray(is_split), jnp.asarray(path_len), self.max_depth,
+        )), dtype=np.float64)
+        return np.power(2.0, -mean_path / max(self._cn, 1e-9))
+
+    def model_performance(self, frame: Frame):
+        s = self._predict_raw(frame)
+        return {"mean_score": float(s.mean()), "max_score": float(s.max())}
+
+    def predict(self, frame: Frame) -> Frame:
+        s = self._predict_raw(frame)
+        return Frame([Column("anomaly_score", s, ColType.NUM)])
+
+
+class IsolationForest(ModelBuilder):
+    algo_name = "isolationforest"
+
+    def __init__(self, params: Optional[IsolationForestParameters] = None, **kw) -> None:
+        super().__init__(params or IsolationForestParameters(**kw))
+
+    def _fit(self, frame: Frame, valid: Optional[Frame] = None) -> IsolationForestModel:
+        p: IsolationForestParameters = self.params
+        info = tree_data_info(frame, y=None, ignored=p.ignored_columns)
+        X = tree_matrix(info, frame)
+        n, F = X.shape
+        model = IsolationForestModel(p, info)
+        rng = np.random.default_rng(p.actual_seed())
+        sample = min(p.sample_size, n)
+        model._cn = _c_factor(sample)
+        M = 2 ** (p.max_depth + 1) - 1
+
+        feats = np.zeros((p.ntrees, M), np.int32)
+        threshs = np.zeros((p.ntrees, M), np.float32)
+        splits = np.zeros((p.ntrees, M), bool)
+        plens = np.zeros((p.ntrees, M), np.float32)
+
+        for t in range(p.ntrees):
+            rows = rng.choice(n, sample, replace=False)
+            self._grow(X[rows], 0, 0, rng, feats[t], threshs[t], splits[t], plens[t], p.max_depth)
+        model.trees = (feats, threshs, splits, plens)
+        model.training_metrics = model.model_performance(frame)
+        return model
+
+    def _grow(self, Xn, node, depth, rng, feat, thresh, is_split, path_len, max_depth) -> None:
+        m = len(Xn)
+        if depth >= max_depth or m <= 1:
+            path_len[node] = depth + _c_factor(m)
+            return
+        # random feature with spread (from an mtries subset when set),
+        # random threshold in (min, max)
+        F = Xn.shape[1]
+        mtries = self.params.mtries
+        cand = rng.choice(F, min(mtries, F), replace=False) if mtries > 0 else None
+        for _ in range(F):
+            f = rng.choice(cand) if cand is not None else rng.integers(F)
+            col = Xn[:, f]
+            ok = ~np.isnan(col)
+            if ok.any() and np.nanmin(col) < np.nanmax(col):
+                break
+        else:
+            path_len[node] = depth + _c_factor(m)
+            return
+        lo, hi = np.nanmin(col), np.nanmax(col)
+        if not (hi > lo):
+            path_len[node] = depth + _c_factor(m)
+            return
+        cut = rng.uniform(lo, hi)
+        go_left = ~(col > cut)  # NaN routes left
+        feat[node] = f
+        thresh[node] = cut
+        is_split[node] = True
+        self._grow(Xn[go_left], 2 * node + 1, depth + 1, rng, feat, thresh, is_split, path_len, max_depth)
+        self._grow(Xn[~go_left], 2 * node + 2, depth + 1, rng, feat, thresh, is_split, path_len, max_depth)

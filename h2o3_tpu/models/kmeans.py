@@ -1,0 +1,173 @@
+"""KMeans — Lloyd's iterations as sharded device passes.
+
+Reference: ``hex/kmeans/KMeans.java:688,725`` — kmeans++ ("PlusPlus") /
+Furthest / Random init, standardized features, Lloyd's assign+recompute as an
+MRTask per iteration, within-cluster SS metrics.
+
+TPU-native: one jitted iteration computes [N,k] distances via the
+|x|²-2x·C+|C|² matmul expansion (MXU), argmin assignment, and new centers via
+a one-hot-matmul segment-mean (``onehot(assign)ᵀ @ X``) — all on row-sharded
+arrays with implicit psum; no per-chunk loops.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.data_info import build_data_info, expand_matrix
+from h2o3_tpu.models.framework import Model, ModelBuilder, ModelParameters
+from h2o3_tpu.parallel.mesh import default_mesh, row_mask, shard_rows
+
+
+@dataclass
+class KMeansParameters(ModelParameters):
+    k: int = 3
+    max_iterations: int = 10
+    init: str = "plus_plus"  # plus_plus|random|furthest
+    standardize: bool = True
+    estimate_k: bool = False
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _lloyd_step(X, mask, C, k: int):
+    """One Lloyd iteration. X:[N,D] sharded, C:[k,D] replicated."""
+    d2 = (
+        jnp.sum(X * X, axis=1, keepdims=True)
+        - 2.0 * X @ C.T
+        + jnp.sum(C * C, axis=1)[None, :]
+    )  # [N, k]
+    assign = jnp.argmin(d2, axis=1)
+    # pad rows are zeroed (not inf-ed) everywhere they aggregate: 0*inf = NaN
+    d2z = jnp.where(mask[:, None], d2, 0.0)
+    onehot = jax.nn.one_hot(assign, k, dtype=X.dtype) * mask[:, None].astype(X.dtype)
+    sums = onehot.T @ X  # [k, D] — psum implicit over the sharded axis
+    counts = onehot.sum(axis=0)  # [k]
+    newC = jnp.where(counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1.0), C)
+    per_cluster_wss = (onehot * d2z).sum(axis=0)
+    wss = per_cluster_wss.sum()
+    return assign, newC, counts, wss, per_cluster_wss
+
+
+class KMeansModel(Model):
+    algo_name = "kmeans"
+
+    def __init__(self, params, data_info):
+        super().__init__(params, data_info)
+        self.centers_std: Optional[np.ndarray] = None  # standardized space
+        self.centers: Optional[np.ndarray] = None  # original space (numeric cols)
+        self.size: Optional[np.ndarray] = None
+        self.withinss: Optional[np.ndarray] = None
+        self.tot_withinss: float = np.nan
+        self.totss: float = np.nan
+        self.betweenss: float = np.nan
+        self.iterations: int = 0
+
+    @property
+    def is_classifier(self) -> bool:
+        return False
+
+    def _predict_raw(self, frame: Frame) -> np.ndarray:
+        X, _ = expand_matrix(self.data_info, frame, dtype=np.float32)
+        C = self.centers_std
+        d2 = (X * X).sum(1, keepdims=True) - 2 * X @ C.T + (C * C).sum(1)[None, :]
+        return d2.argmin(axis=1).astype(np.float64)
+
+    def model_performance(self, frame: Frame):
+        return {
+            "tot_withinss": self.tot_withinss,
+            "totss": self.totss,
+            "betweenss": self.betweenss,
+            "size": self.size,
+        }
+
+
+class KMeans(ModelBuilder):
+    algo_name = "kmeans"
+
+    def __init__(self, params: Optional[KMeansParameters] = None, **kw) -> None:
+        super().__init__(params or KMeansParameters(**kw))
+
+    def _validate(self, frame: Frame) -> None:
+        if self.params.k < 1:
+            raise ValueError("k must be >= 1")
+        if self.params.estimate_k:
+            raise NotImplementedError(
+                "estimate_k is not implemented yet; pass an explicit k"
+            )
+
+    def _fit(self, frame: Frame, valid: Optional[Frame] = None) -> KMeansModel:
+        p: KMeansParameters = self.params
+        info = build_data_info(
+            frame, y=None, ignored=p.ignored_columns,
+            standardize=p.standardize, use_all_factor_levels=True,
+        )
+        X, _ = expand_matrix(info, frame, dtype=np.float32)
+        n, D = X.shape
+        model = KMeansModel(p, info)
+        rng = np.random.default_rng(p.actual_seed())
+
+        C = _init_centers(X, p.k, p.init, rng)
+
+        mesh = default_mesh()
+        Xd, _ = shard_rows(X, mesh)
+        maskd = row_mask(n, Xd.shape[0], mesh)
+        Cd = jnp.asarray(C)
+
+        prev_wss = np.inf
+        assign = counts = wss_k = None
+        for it in range(p.max_iterations):
+            assign, Cd, counts, wss, wss_k = _lloyd_step(Xd, maskd, Cd, p.k)
+            model.iterations = it + 1
+            wss = float(jax.device_get(wss))
+            if abs(prev_wss - wss) < 1e-6 * max(abs(prev_wss), 1.0):
+                break
+            prev_wss = wss
+
+        model.centers_std = np.asarray(jax.device_get(Cd), dtype=np.float64)
+        model.size = np.asarray(jax.device_get(counts), dtype=np.int64)
+        model.withinss = np.asarray(jax.device_get(wss_k), dtype=np.float64)
+        model.tot_withinss = float(model.withinss.sum())
+        gmean = X.mean(axis=0)
+        model.totss = float(((X - gmean) ** 2).sum())
+        model.betweenss = model.totss - model.tot_withinss
+        model.centers = _destandardize_centers(info, model.centers_std)
+        model.training_metrics = model.model_performance(frame)
+        return model
+
+
+def _init_centers(X: np.ndarray, k: int, init: str, rng) -> np.ndarray:
+    n = len(X)
+    if init == "random":
+        return X[rng.choice(n, k, replace=False)].copy()
+    # kmeans++ / furthest share the distance-seeded loop (KMeans.java init)
+    centers = [X[rng.integers(n)]]
+    d2 = ((X - centers[0]) ** 2).sum(axis=1)
+    for _ in range(1, k):
+        if init == "furthest":
+            centers.append(X[int(d2.argmax())])
+        else:  # plus_plus: sample proportional to d²
+            probs = d2 / max(d2.sum(), 1e-30)
+            centers.append(X[rng.choice(n, p=probs)])
+        d2 = np.minimum(d2, ((X - centers[-1]) ** 2).sum(axis=1))
+    return np.stack(centers)
+
+
+def _destandardize_centers(info, C_std: np.ndarray) -> np.ndarray:
+    C = C_std.copy()
+    j = 0
+    for name in info.predictor_names:
+        if name in info.cat_domains:
+            j += len(info.cat_domains[name])
+        else:
+            if info.standardize:
+                C[:, j] = C_std[:, j] * info.num_sds[name] + info.num_means[name]
+            j += 1
+    return C

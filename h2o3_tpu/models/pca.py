@@ -1,0 +1,160 @@
+"""PCA / SVD — Gram-based decomposition with a sharded Gram pass.
+
+Reference: ``hex/pca/PCA.java`` (pca_method=GramSVD default: distributed Gram
+then local SVD) and ``hex/svd/SVD.java`` (distributed power iteration).
+
+TPU-native: the [D,D] Gram is one sharded ``XᵀX`` matmul (psum implicit);
+the small host-side eigendecomposition mirrors the reference's driver-side
+SVD of the Gram. Scores/u are one more sharded matmul. Power iteration is
+pointless below D≈10⁴, which covers the reference's use cases.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from h2o3_tpu.frame.frame import ColType, Column, Frame
+from h2o3_tpu.models.data_info import build_data_info, expand_matrix
+from h2o3_tpu.models.framework import Model, ModelBuilder, ModelParameters
+from h2o3_tpu.parallel.mesh import default_mesh, row_mask, shard_rows
+
+
+@dataclass
+class PCAParameters(ModelParameters):
+    k: int = 2
+    transform: str = "standardize"  # none|standardize|demean|descale
+    pca_method: str = "gram_svd"
+    use_all_factor_levels: bool = False
+
+
+@jax.jit
+def _gram_xx(X, mask):
+    Xm = X * mask[:, None]
+    return Xm.T @ Xm, jnp.sum(mask)
+
+
+class PCAModel(Model):
+    algo_name = "pca"
+
+    def __init__(self, params, data_info):
+        super().__init__(params, data_info)
+        self.eigenvectors: Optional[np.ndarray] = None  # [D, k]
+        self.std_deviation: Optional[np.ndarray] = None  # [k]
+        self.pve: Optional[np.ndarray] = None  # proportion of variance explained
+        self.cum_pve: Optional[np.ndarray] = None
+
+    @property
+    def is_classifier(self) -> bool:
+        return False
+
+    def _predict_raw(self, frame: Frame) -> np.ndarray:
+        X, _ = expand_matrix(self.data_info, frame, dtype=np.float32)
+        return X @ self.eigenvectors
+
+    def predict(self, frame: Frame) -> Frame:
+        scores = self._predict_raw(frame)
+        return Frame(
+            [Column(f"PC{i + 1}", scores[:, i].astype(np.float64), ColType.NUM)
+             for i in range(scores.shape[1])]
+        )
+
+    def model_performance(self, frame: Frame):
+        return {"std_deviation": self.std_deviation, "pve": self.pve, "cum_pve": self.cum_pve}
+
+
+class PCA(ModelBuilder):
+    algo_name = "pca"
+
+    def __init__(self, params: Optional[PCAParameters] = None, **kw) -> None:
+        super().__init__(params or PCAParameters(**kw))
+
+    def _fit(self, frame: Frame, valid: Optional[Frame] = None) -> PCAModel:
+        p: PCAParameters = self.params
+        standardize = p.transform == "standardize"
+        info = build_data_info(
+            frame, y=None, ignored=p.ignored_columns,
+            standardize=standardize, use_all_factor_levels=p.use_all_factor_levels,
+        )
+        X, _ = expand_matrix(info, frame, dtype=np.float32)
+        # transform semantics (hex/DataInfo TransformType): STANDARDIZE is done
+        # inside expand_matrix; DEMEAN centers only; DESCALE scales only
+        if p.transform == "demean":
+            X = X - X.mean(axis=0, keepdims=True)
+        elif p.transform == "descale":
+            sd = X.std(axis=0, ddof=1, keepdims=True)
+            X = X / np.where(sd > 0, sd, 1.0)
+        n, D = X.shape
+        k = min(p.k, D)
+        model = PCAModel(p, info)
+
+        mesh = default_mesh()
+        Xd, _ = shard_rows(X, mesh)
+        maskd = row_mask(n, Xd.shape[0], mesh).astype(jnp.float32)
+        G, cnt = jax.device_get(_gram_xx(Xd, maskd))
+        G = np.asarray(G, dtype=np.float64) / max(n - 1, 1)
+
+        evals, evecs = np.linalg.eigh(G)
+        order = np.argsort(evals)[::-1]
+        evals = np.maximum(evals[order][:k], 0.0)
+        evecs = evecs[:, order][:, :k]
+        # deterministic sign: largest-|loading| component positive
+        for i in range(k):
+            j = np.argmax(np.abs(evecs[:, i]))
+            if evecs[j, i] < 0:
+                evecs[:, i] = -evecs[:, i]
+        total_var = np.trace(G)
+        model.eigenvectors = evecs.astype(np.float32)
+        model.std_deviation = np.sqrt(evals)
+        model.pve = evals / max(total_var, 1e-300)
+        model.cum_pve = np.cumsum(model.pve)
+        model.training_metrics = model.model_performance(frame)
+        return model
+
+
+@dataclass
+class SVDParameters(PCAParameters):
+    nv: int = 2  # number of right singular vectors
+
+
+class SVDModel(PCAModel):
+    algo_name = "svd"
+
+    def __init__(self, params, data_info):
+        super().__init__(params, data_info)
+        self.d: Optional[np.ndarray] = None  # singular values
+        self.v: Optional[np.ndarray] = None  # [D, nv]
+
+
+class SVD(ModelBuilder):
+    """Distributed SVD via the Gram eigendecomposition (hex/svd/SVD.java)."""
+
+    algo_name = "svd"
+
+    def __init__(self, params: Optional[SVDParameters] = None, **kw) -> None:
+        super().__init__(params or SVDParameters(**kw))
+
+    def _fit(self, frame: Frame, valid: Optional[Frame] = None) -> SVDModel:
+        p: SVDParameters = self.params
+        inner = PCA(PCAParameters(
+            k=max(p.nv, p.k), transform=p.transform,
+            ignored_columns=p.ignored_columns,
+            use_all_factor_levels=p.use_all_factor_levels,
+        ))
+        pca_model = inner._fit(frame)
+        model = SVDModel(p, pca_model.data_info)
+        X, _ = expand_matrix(pca_model.data_info, frame, dtype=np.float32)
+        n = X.shape[0]
+        model.v = pca_model.eigenvectors
+        model.d = pca_model.std_deviation * np.sqrt(max(n - 1, 1))
+        model.eigenvectors = pca_model.eigenvectors
+        model.std_deviation = pca_model.std_deviation
+        model.pve = pca_model.pve
+        model.cum_pve = pca_model.cum_pve
+        model.training_metrics = {"d": model.d}
+        return model

@@ -1,0 +1,189 @@
+"""DeepLearning / KMeans / PCA / SVD / NaiveBayes / IsolationForest tests.
+
+Reference analogue: per-algo JUnit tests in h2o-algos (SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+from sklearn import datasets
+from sklearn.cluster import KMeans as SKKMeans
+from sklearn.decomposition import PCA as SKPCA
+from sklearn.naive_bayes import GaussianNB
+
+from h2o3_tpu import Frame
+from h2o3_tpu.models.deeplearning import DeepLearning
+from h2o3_tpu.models.isolation_forest import IsolationForest
+from h2o3_tpu.models.kmeans import KMeans
+from h2o3_tpu.models.naive_bayes import NaiveBayes
+from h2o3_tpu.models.pca import PCA, SVD
+
+
+@pytest.fixture()
+def blobs(rng):
+    X, y = datasets.make_blobs(
+        n_samples=1200, centers=3, n_features=4, random_state=7, cluster_std=1.2
+    )
+    return X, y
+
+
+def test_deeplearning_classification(mesh, rng):
+    n = 2000
+    X = rng.normal(size=(n, 5))
+    logit = 2 * X[:, 0] - X[:, 1] + X[:, 2] ** 2 - 1
+    y = (rng.random(n) < 1 / (1 + np.exp(-logit)))
+    fr = Frame.from_dict({f"x{i}": X[:, i] for i in range(5)} | {"y": np.where(y, "a", "b")})
+    m = DeepLearning(
+        response_column="y", hidden=[32, 32], epochs=20, mini_batch_size=128, seed=5
+    ).train(fr)
+    assert m.training_metrics.auc > 0.85, m.training_metrics
+    pred = m.predict(fr)
+    assert pred.names == ["predict", "pa", "pb"]
+
+
+def test_deeplearning_regression(mesh, rng):
+    n = 2000
+    X = rng.normal(size=(n, 4))
+    y = np.sin(X[:, 0]) * 2 + X[:, 1] + 0.1 * rng.normal(size=n)
+    fr = Frame.from_dict({f"x{i}": X[:, i] for i in range(4)} | {"y": y})
+    m = DeepLearning(
+        response_column="y", hidden=[64, 64], epochs=30, mini_batch_size=128, seed=5
+    ).train(fr)
+    assert m.training_metrics.r2 > 0.8, m.training_metrics
+
+
+def test_deeplearning_autoencoder(mesh, rng):
+    n = 1000
+    X = rng.normal(size=(n, 6))
+    X[::50] += 8.0  # planted anomalies
+    fr = Frame.from_dict({f"x{i}": X[:, i] for i in range(6)})
+    m = DeepLearning(autoencoder=True, hidden=[3], epochs=30, mini_batch_size=128, seed=5).train(fr)
+    scores = m.anomaly(fr)
+    planted = scores[::50].mean()
+    normal = np.delete(scores, np.arange(0, n, 50)).mean()
+    assert planted > normal * 2
+
+
+def test_kmeans_recovers_blobs(mesh, blobs):
+    X, y = blobs
+    fr = Frame.from_dict({f"x{i}": X[:, i] for i in range(4)})
+    m = KMeans(k=3, max_iterations=20, seed=3).train(fr)
+    assert m.iterations >= 1
+    assert m.tot_withinss > 0 and m.betweenss > 0
+    sk = SKKMeans(n_clusters=3, n_init=5, random_state=3).fit(
+        (X - X.mean(0)) / X.std(0, ddof=1)
+    )
+    assert m.tot_withinss == pytest.approx(sk.inertia_, rel=0.05)
+    assign = m._predict_raw(fr).astype(int)
+    # cluster agreement up to permutation: each true blob maps to one cluster
+    from scipy.stats import mode
+
+    agree = sum((assign[y == c] == mode(assign[y == c]).mode).mean() for c in range(3)) / 3
+    assert agree > 0.95
+
+
+def test_kmeans_predict_and_sizes(mesh, blobs):
+    X, _ = blobs
+    fr = Frame.from_dict({f"x{i}": X[:, i] for i in range(4)})
+    m = KMeans(k=3, seed=3).train(fr)
+    assert int(m.size.sum()) == fr.nrows
+    assert m.centers.shape == (3, 4)
+
+
+def test_pca_matches_sklearn(mesh, rng):
+    X = rng.normal(size=(500, 6)) @ rng.normal(size=(6, 6))
+    fr = Frame.from_dict({f"x{i}": X[:, i] for i in range(6)})
+    m = PCA(k=3, transform="demean").train(fr)
+    sk = SKPCA(n_components=3).fit(X)
+    np.testing.assert_allclose(m.std_deviation, np.sqrt(sk.explained_variance_), rtol=1e-3)
+    np.testing.assert_allclose(m.pve, sk.explained_variance_ratio_, rtol=1e-3)
+    # eigenvectors equal up to sign
+    for i in range(3):
+        dot = abs(float(np.dot(m.eigenvectors[:, i], sk.components_[i])))
+        assert dot == pytest.approx(1.0, abs=1e-3)
+
+
+def test_svd_singular_values(mesh, rng):
+    X = rng.normal(size=(400, 5))
+    fr = Frame.from_dict({f"x{i}": X[:, i] for i in range(5)})
+    m = SVD(nv=3, transform="demean").train(fr)
+    want = np.linalg.svd(X - X.mean(0), compute_uv=False)[:3]
+    np.testing.assert_allclose(m.d, want, rtol=1e-3)
+
+
+def test_naive_bayes_matches_sklearn_gaussian(mesh, rng):
+    n = 2000
+    X = rng.normal(size=(n, 3))
+    y = (X[:, 0] + X[:, 1] + rng.normal(0, 0.5, n) > 0).astype(int)
+    fr = Frame.from_dict(
+        {f"x{i}": X[:, i] for i in range(3)} | {"y": np.where(y > 0, "p", "n")}
+    )
+    m = NaiveBayes(response_column="y").train(fr)
+    sk = GaussianNB().fit(X, y)
+    ours = m._predict_raw(fr)[:, 1]
+    theirs = sk.predict_proba(X)[:, 1]
+    # same model family: probabilities should correlate near-perfectly
+    assert np.corrcoef(ours, theirs)[0, 1] > 0.999
+    assert m.training_metrics.auc > 0.9
+
+
+def test_naive_bayes_categorical_laplace(mesh, rng):
+    n = 1500
+    g = rng.integers(0, 4, n)
+    y = (rng.random(n) < np.array([0.1, 0.4, 0.6, 0.9])[g]).astype(int)
+    fr = Frame.from_dict(
+        {"g": np.array(["a", "b", "c", "d"])[g], "y": np.where(y > 0, "t", "f")}
+    )
+    m = NaiveBayes(response_column="y", laplace=1.0).train(fr)
+    assert m.training_metrics.auc > 0.7
+    tab = m.cat_probs["g"]
+    np.testing.assert_allclose(tab.sum(axis=1), 1.0, atol=1e-9)
+
+
+def test_isolation_forest_finds_outliers(mesh, rng):
+    n = 2000
+    X = rng.normal(size=(n, 4))
+    X[:20] = X[:20] * 6 + 10  # planted outliers
+    fr = Frame.from_dict({f"x{i}": X[:, i] for i in range(4)})
+    m = IsolationForest(ntrees=60, seed=11).train(fr)
+    s = m._predict_raw(fr)
+    assert s[:20].mean() > s[20:].mean() + 0.1
+    # top-30 by score should include most planted outliers
+    top = np.argsort(-s)[:30]
+    assert (top < 20).sum() >= 15
+
+
+def test_kmeans_nondivisible_rows_no_nan(mesh, rng):
+    """Regression: pad rows must not poison withinss with NaN (review finding)."""
+    X = rng.normal(size=(1201, 3))
+    fr = Frame.from_dict({f"x{i}": X[:, i] for i in range(3)})
+    m = KMeans(k=3, seed=1).train(fr)
+    assert np.isfinite(m.tot_withinss) and np.isfinite(m.withinss).all()
+    assert int(m.size.sum()) == 1201
+
+
+def test_deeplearning_tiny_frame_big_batch(mesh, rng):
+    """Regression: n < mini_batch_size must keep static batch shape (review finding)."""
+    X = rng.normal(size=(99, 3))
+    y = (X[:, 0] > 0)
+    fr = Frame.from_dict({f"x{i}": X[:, i] for i in range(3)} | {"y": np.where(y, "a", "b")})
+    m = DeepLearning(response_column="y", hidden=[8], epochs=2, seed=1).train(fr)
+    assert m.training_metrics is not None
+
+
+def test_deeplearning_momentum_ramp(mesh, rng):
+    X = rng.normal(size=(500, 3))
+    y = X[:, 0] * 2 + rng.normal(0, 0.1, 500)
+    fr = Frame.from_dict({f"x{i}": X[:, i] for i in range(3)} | {"y": y})
+    m = DeepLearning(
+        response_column="y", hidden=[16], epochs=15, adaptive_rate=False,
+        rate=0.01, momentum_start=0.5, momentum_stable=0.9, mini_batch_size=64, seed=1,
+    ).train(fr)
+    assert m.training_metrics.r2 > 0.5
+
+
+def test_autoencoder_predict_reconstruction_frame(mesh, rng):
+    X = rng.normal(size=(300, 4))
+    fr = Frame.from_dict({f"x{i}": X[:, i] for i in range(4)})
+    m = DeepLearning(autoencoder=True, hidden=[2], epochs=5, seed=1).train(fr)
+    rec = m.predict(fr)
+    assert rec.ncols == 4 and rec.nrows == 300
+    assert all(n.startswith("reconstr_") for n in rec.names)
