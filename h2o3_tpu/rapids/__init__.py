@@ -1,0 +1,19 @@
+"""Rapids — the dataframe munging DSL.
+
+Reference: ``water/rapids/`` — a Lisp-like AST language (``Rapids.java:19-51``)
+with ~200 primitives under ``rapids/ast/prims/{mungers,math,reducers,...}``,
+interpreted server-side against distributed Frames; Python/R clients compile
+dataframe expressions to these ASTs (``h2o-py/h2o/expr.py``).
+
+TPU-native redesign: same wire syntax and primitive inventory (SURVEY.md
+Appendix A), interpreted against the host-canonical columnar Frame.  Munging
+is host-side, memory-bound work over dense numpy columns (the reference's
+MRTask munging is likewise CPU work close to the data); the *device* path is
+reserved for the ML compute layer (h2o3_tpu/compute, h2o3_tpu/models) where
+the FLOPs are.  Big reducers transparently ride the shard_map/psum primitive
+when a mesh is active.
+"""
+
+from h2o3_tpu.rapids.runtime import Session, Val, exec_rapids, parse_rapids
+
+__all__ = ["Session", "Val", "exec_rapids", "parse_rapids"]

@@ -1,0 +1,155 @@
+"""Distributed sort and merge (join).
+
+Reference: ``water/rapids/RadixOrder.java`` + ``BinaryMerge.java`` +
+``Merge.java`` — MSB radix partition, per-MSB single-threaded order, batched
+binary merge of sorted key ranges; powers the ``sort`` and ``merge`` prims.
+
+TPU-native: the MSB-partition/merge machinery existed to move key ranges
+between JVMs; with host-canonical dense columns a single vectorized
+``np.lexsort`` (radix-family, stable) is the same algorithm without the
+shuffle.  Joins: factorize both sides' key tuples into one int64 code space,
+sort the right side once, then ``searchsorted`` + run-length expansion —
+a sort-merge join, exactly the reference's strategy.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from h2o3_tpu.frame.frame import Column, ColType, Frame, _merge_domains
+
+
+def sort_frame(fr: Frame, by: Sequence[int], ascending: Optional[Sequence[bool]] = None) -> Frame:
+    """(sort fr [cols] [asc]) — stable multi-key sort; NAs sort first
+    (reference Merge.sort: NA = -Inf in radix order)."""
+    if ascending is None:
+        ascending = [True] * len(by)
+    keys = []
+    for j, asc in zip(reversed(list(by)), reversed(list(ascending))):
+        c = fr.col(j)
+        if c.type in (ColType.STR, ColType.UUID):
+            svals = np.asarray([("" if v is None else str(v)) for v in c.data])
+            _, codes = np.unique(svals, return_inverse=True)
+            k = codes.astype(np.float64)
+        else:
+            k = c.numeric_view().copy()
+            k[np.isnan(k)] = -np.inf  # NAs first
+        keys.append(k if asc else -k)
+    order = np.lexsort(tuple(keys))
+    return fr.rows(order)
+
+
+def _encode_keys(
+    left: Frame, right: Frame, by_left: Sequence[int], by_right: Sequence[int]
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Factorize each key-column pair over the union of both sides, then mix
+    the per-column codes into one int64 key per row."""
+    lcodes, rcodes = np.zeros(left.nrows, dtype=np.int64), np.zeros(right.nrows, dtype=np.int64)
+    for jl, jr in zip(by_left, by_right):
+        cl, cr = left.col(jl), right.col(jr)
+        if cl.type is ColType.CAT and cr.type is ColType.CAT:
+            # align domains so equal levels get equal codes
+            dom, rmap = _merge_domains(cl.domain, cr.domain)
+            lv = cl.data.astype(np.int64)
+            rv = np.where(cr.data >= 0, rmap[np.clip(cr.data, 0, None)], -1).astype(np.int64)
+            card = len(dom) + 1
+        else:
+            lvals, rvals = cl.numeric_view(), cr.numeric_view()
+            both = np.concatenate([lvals, rvals])
+            finite = both[~np.isnan(both)]
+            uniq = np.unique(finite)
+            lv = np.where(np.isnan(lvals), -1, np.searchsorted(uniq, np.nan_to_num(lvals))).astype(np.int64)
+            rv = np.where(np.isnan(rvals), -1, np.searchsorted(uniq, np.nan_to_num(rvals))).astype(np.int64)
+            card = len(uniq) + 1
+        lcodes = lcodes * card + (lv + 1)
+        rcodes = rcodes * card + (rv + 1)
+    return lcodes, rcodes
+
+
+def merge_frames(
+    left: Frame,
+    right: Frame,
+    by_left: Sequence[int],
+    by_right: Sequence[int],
+    all_left: bool = False,
+    all_right: bool = False,
+) -> Frame:
+    """Sort-merge join (rapids ``merge``; Merge.java semantics):
+    inner by default; all_left/all_right add unmatched rows with NAs.
+    Output columns: join keys (left naming), then left non-key, right non-key."""
+    lk, rk = _encode_keys(left, right, by_left, by_right)
+    r_order = np.argsort(rk, kind="stable")
+    rk_sorted = rk[r_order]
+    lo = np.searchsorted(rk_sorted, lk, side="left")
+    hi = np.searchsorted(rk_sorted, lk, side="right")
+    counts = hi - lo
+    matched = counts > 0
+
+    # inner part: expand each left row by its match count
+    l_idx = np.repeat(np.arange(left.nrows), np.where(matched, counts, 0))
+    offs = np.concatenate([[0], np.cumsum(np.where(matched, counts, 0))])[:-1]
+    within = np.arange(len(l_idx)) - np.repeat(offs, np.where(matched, counts, 0))
+    r_idx = r_order[np.repeat(lo, np.where(matched, counts, 0)) + within]
+
+    if all_left:
+        un_l = np.nonzero(~matched)[0]
+        l_idx = np.concatenate([l_idx, un_l])
+        r_idx = np.concatenate([r_idx, np.full(len(un_l), -1, dtype=np.int64)])
+    if all_right:
+        r_matched = np.zeros(right.nrows, dtype=bool)
+        r_matched[np.unique(r_idx[r_idx >= 0])] = True
+        un_r = np.nonzero(~r_matched)[0]
+        l_idx = np.concatenate([l_idx, np.full(len(un_r), -1, dtype=np.int64)])
+        r_idx = np.concatenate([r_idx, un_r])
+
+    def take(col: Column, idx: np.ndarray) -> Column:
+        miss = idx < 0
+        safe = np.clip(idx, 0, None)
+        if col.type is ColType.CAT:
+            data = np.where(miss, -1, col.data[safe]).astype(np.int32)
+            return Column(col.name, data, ColType.CAT, col.domain)
+        if col.type in (ColType.STR, ColType.UUID):
+            data = col.data[safe].copy()
+            data[miss] = None
+            return Column(col.name, data, col.type)
+        data = np.where(miss, np.nan, col.data[safe])
+        return Column(col.name, data, col.type)
+
+    out_cols: List[Column] = []
+    taken = set()
+    for pos, (jl, jr) in enumerate(zip(by_left, by_right)):
+        # key column: prefer left values, fill from right for all_right rows
+        lc, rc = take(left.col(jl), l_idx), take(right.col(jr), r_idx)
+        if left.col(jl).type is ColType.CAT and right.col(jr).type is ColType.CAT:
+            dom, rmap = _merge_domains(left.col(jl).domain, right.col(jr).domain)
+            lcd = lc.data
+            rcd = np.where(rc.data >= 0, rmap[np.clip(rc.data, 0, None)], -1).astype(np.int32)
+            data = np.where(l_idx >= 0, lcd, rcd).astype(np.int32)
+            out_cols.append(Column(lc.name, data, ColType.CAT, dom))
+        elif lc.type in (ColType.STR, ColType.UUID):
+            data = np.where(l_idx >= 0, lc.data, rc.data)
+            out_cols.append(Column(lc.name, data.astype(object), lc.type))
+        else:
+            data = np.where(l_idx >= 0, lc.data, rc.data)
+            out_cols.append(Column(lc.name, data, lc.type))
+        taken.add(lc.name)
+    for j, c in enumerate(left.columns):
+        if j in list(by_left):
+            continue
+        cc = take(c, l_idx)
+        out_cols.append(cc)
+        taken.add(cc.name)
+    for j, c in enumerate(right.columns):
+        if j in list(by_right):
+            continue
+        cc = take(c, r_idx)
+        name, k = cc.name, 0
+        while name in taken:
+            name = f"{cc.name}_{k}"
+            k += 1
+        cc.name = name
+        taken.add(name)
+        out_cols.append(cc)
+    return Frame(out_cols)

@@ -1,0 +1,43 @@
+"""Rapids primitive registry.
+
+Reference: ``water/rapids/ast/prims/{mungers,math,reducers,operators,advmath,
+string,time,matrix,assign,search,...}`` — each ``Ast*`` class registers a
+name; clients emit exactly these ops (SURVEY.md Appendix A inventory).
+
+Here each primitive is a function ``prim(env, args: List[Val]) -> Val``
+registered under one or more rapids names.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+PRIMS: Dict[str, Callable] = {}
+
+
+def prim(*names: str):
+    """Register a primitive under the given rapids op names."""
+
+    def deco(fn):
+        for n in names:
+            if n in PRIMS:
+                raise RuntimeError(f"duplicate rapids prim {n!r}")
+            PRIMS[n] = fn
+        return fn
+
+    return deco
+
+
+# importing the groups populates PRIMS
+from h2o3_tpu.rapids.prims import (  # noqa: E402,F401
+    advmath,
+    assign,
+    mathops,
+    matrix,
+    mungers,
+    operators,
+    reducers,
+    search,
+    strings,
+    times,
+)

@@ -1,0 +1,96 @@
+"""Rapids math prims (36): elementwise transcendental/rounding functions.
+
+Reference: ``water/rapids/ast/prims/math/`` — Abs..Trunc (SURVEY.md App. A).
+All are columnwise NaN-propagating maps over numeric columns.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+from scipy import special as _sp_special  # scipy ships with jax stack
+
+from h2o3_tpu.rapids.prims import prim
+from h2o3_tpu.rapids.prims.util import map_columns
+from h2o3_tpu.rapids.runtime import RapidsError, Val
+
+
+def _uniop(name: str, fn):
+    @prim(name)
+    def op(env, args, fn=fn, name=name):
+        if len(args) != 1:
+            raise RapidsError(f"{name} expects 1 arg")
+        v = args[0]
+        if v.is_frame():
+            return Val.frame(map_columns(v.value, fn))
+        with np.errstate(all="ignore"):
+            return Val.num(float(fn(np.float64(v.as_num()))))
+
+    return op
+
+
+_uniop("abs", np.abs)
+_uniop("acos", np.arccos)
+_uniop("acosh", np.arccosh)
+_uniop("asin", np.arcsin)
+_uniop("asinh", np.arcsinh)
+_uniop("atan", np.arctan)
+_uniop("atanh", np.arctanh)
+_uniop("ceiling", np.ceil)
+_uniop("cos", np.cos)
+_uniop("cospi", lambda x: np.cos(np.pi * x))
+_uniop("cosh", np.cosh)
+_uniop("digamma", _sp_special.digamma)
+_uniop("exp", np.exp)
+_uniop("expm1", np.expm1)
+_uniop("floor", np.floor)
+_uniop("gamma", _sp_special.gamma)
+_uniop("lgamma", _sp_special.gammaln)
+_uniop("log", np.log)
+_uniop("log10", np.log10)
+_uniop("log1p", np.log1p)
+_uniop("log2", np.log2)
+_uniop("sgn", np.sign)
+_uniop("sign", np.sign)
+_uniop("sin", np.sin)
+_uniop("sinpi", lambda x: np.sin(np.pi * x))
+_uniop("sinh", np.sinh)
+_uniop("sqrt", np.sqrt)
+_uniop("tan", np.tan)
+_uniop("tanpi", lambda x: np.tan(np.pi * x))
+_uniop("tanh", np.tanh)
+_uniop("trigamma", lambda x: _sp_special.polygamma(1, x))
+_uniop("trunc", np.trunc)
+_uniop("none", lambda x: x)  # AstNoOp
+
+
+def _round_half_even(x, digits):
+    # R/H2O round: IEC 60559 round-half-to-even (AstRound)
+    return np.round(x, int(digits))
+
+
+@prim("round")
+def round_(env, args):
+    digits = args[1].as_num() if len(args) > 1 else 0
+    v = args[0]
+    if v.is_frame():
+        return Val.frame(map_columns(v.value, lambda a: _round_half_even(a, digits)))
+    return Val.num(float(_round_half_even(np.float64(v.as_num()), digits)))
+
+
+@prim("signif")
+def signif(env, args):
+    """(signif fr digits) — round to significant digits (AstSignif)."""
+    digits = int(args[1].as_num()) if len(args) > 1 else 6
+    digits = max(digits, 1)
+
+    def fn(a):
+        with np.errstate(all="ignore"):
+            mag = np.where(a == 0, 1.0, np.power(10.0, digits - 1 - np.floor(np.log10(np.abs(a)))))
+            return np.round(a * mag) / mag
+
+    v = args[0]
+    if v.is_frame():
+        return Val.frame(map_columns(v.value, fn))
+    return Val.num(float(fn(np.array([v.as_num()]))[0]))

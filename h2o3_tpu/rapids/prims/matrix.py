@@ -1,0 +1,52 @@
+"""Rapids matrix prims: distributed matmul + transpose.
+
+Reference: ``water/rapids/ast/prims/matrix/`` — AstMMult (chunk-blocked
+distributed matmul), AstTranspose.
+
+TPU-native: THIS op goes to the device — matmul is MXU work.  The left
+operand is row-sharded over the mesh; each shard computes its block-row of
+the product (no collective needed: the result keeps the row sharding).
+Small frames short-circuit to host numpy to skip transfer latency.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from h2o3_tpu.frame.frame import Column, ColType, Frame
+from h2o3_tpu.rapids.prims import prim
+from h2o3_tpu.rapids.runtime import RapidsError, Val
+
+_DEVICE_MIN_ELEMS = 1 << 20  # below this, host matmul wins on transfer cost
+
+
+@prim("x")
+def mmult(env, args):
+    """(x fr1 fr2) — matrix multiply (AstMMult)."""
+    a = args[0].as_frame().to_numpy()
+    b = args[1].as_frame().to_numpy()
+    if a.shape[1] != b.shape[0]:
+        raise RapidsError(f"x: shape mismatch {a.shape} @ {b.shape}")
+    if a.size + b.size >= _DEVICE_MIN_ELEMS:
+        import jax.numpy as jnp
+
+        from h2o3_tpu.parallel.mesh import default_mesh, shard_rows
+
+        mesh = default_mesh()
+        a_dev, n = shard_rows(a.astype(np.float32), mesh, fill=0.0)
+        out = np.asarray(jnp.matmul(a_dev, jnp.asarray(b.astype(np.float32))))[:n]
+        out = out.astype(np.float64)
+    else:
+        out = a @ b
+    return Val.frame(
+        Frame([Column(f"C{j+1}", out[:, j], ColType.NUM) for j in range(out.shape[1])])
+    )
+
+
+@prim("t")
+def transpose(env, args):
+    fr = args[0].as_frame()
+    m = fr.to_numpy().T
+    return Val.frame(
+        Frame([Column(f"C{j+1}", m[:, j], ColType.NUM) for j in range(m.shape[1])])
+    )

@@ -1,0 +1,156 @@
+"""Rapids operators (21): arithmetic, comparison, logical, ifelse.
+
+Reference: ``water/rapids/ast/prims/operators/`` — And BinOp Div Eq Ge Gt
+IfElse IntDiv IntDivR LAnd LOr Le Lt Mod ModR Mul Ne Or Plus Pow Sub.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from h2o3_tpu.frame.frame import Column, ColType, Frame
+from h2o3_tpu.rapids.prims import prim
+from h2o3_tpu.rapids.prims.util import binop_frame, numeric_data
+from h2o3_tpu.rapids.runtime import RapidsError, Val
+
+
+def _binop(name: str, fn):
+    @prim(name)
+    def op(env, args, fn=fn, name=name):
+        if len(args) != 2:
+            raise RapidsError(f"{name} expects 2 args")
+        return _maybe_string_eq(name, args) or binop_frame(args[0], args[1], fn, name)
+
+    return op
+
+
+def _maybe_string_eq(name, args):
+    """== / != against a string literal compares CAT levels / STR values
+    (reference AstEq handles categorical string comparison)."""
+    if name not in ("==", "!="):
+        return None
+    fr_v, s_v = None, None
+    if args[0].is_frame() and args[1].is_str():
+        fr_v, s_v = args[0], args[1]
+    elif args[1].is_frame() and args[0].is_str():
+        fr_v, s_v = args[1], args[0]
+    else:
+        return None
+    s = s_v.as_str()
+    cols = []
+    for c in fr_v.value.columns:
+        if c.type is ColType.CAT:
+            try:
+                code = c.domain.index(s)
+                eq = (c.data == code).astype(np.float64)
+            except ValueError:
+                eq = np.zeros(len(c), dtype=np.float64)
+        elif c.type in (ColType.STR, ColType.UUID):
+            eq = np.array([v == s for v in c.data], dtype=np.float64)
+        else:
+            eq = np.zeros(len(c), dtype=np.float64)
+        if name == "!=":
+            eq = 1.0 - eq
+        cols.append(Column(c.name, eq, ColType.NUM))
+    return Val.frame(Frame(cols))
+
+
+# NaN-propagating comparisons return NaN for NA inputs (reference cmp semantics)
+def _cmp(fn):
+    def g(a, b):
+        out = fn(a, b).astype(np.float64)
+        na = np.isnan(a) | np.isnan(b)
+        return np.where(na, np.nan, out) if np.ndim(out) else (np.nan if na else out)
+
+    return g
+
+
+_binop("+", lambda a, b: a + b)
+_binop("-", lambda a, b: a - b)
+_binop("*", lambda a, b: a * b)
+_binop("/", lambda a, b: a / b)
+_binop("^", lambda a, b: np.power(a, b))
+_binop("%", lambda a, b: np.mod(a, b))  # R-style modulo (AstMod)
+_binop("%%", lambda a, b: np.mod(a, b))
+_binop("intDiv", lambda a, b: np.floor_divide(a, b))
+_binop("%/%", lambda a, b: np.floor_divide(a, b))
+_binop("==", _cmp(lambda a, b: a == b))
+_binop("!=", _cmp(lambda a, b: a != b))
+_binop("<", _cmp(lambda a, b: a < b))
+_binop("<=", _cmp(lambda a, b: a <= b))
+_binop(">", _cmp(lambda a, b: a > b))
+_binop(">=", _cmp(lambda a, b: a >= b))
+# logical: NA-aware and/or (AstAnd/AstOr: 0 && NA == 0, 1 || NA == 1)
+
+
+def _and(a, b):
+    out = ((a != 0) & (b != 0)).astype(np.float64)
+    na = np.isnan(a) | np.isnan(b)
+    zero = (a == 0) | (b == 0)
+    return np.where(na & ~zero, np.nan, out)
+
+
+def _or(a, b):
+    out = ((a != 0) | (b != 0)).astype(np.float64)
+    na = np.isnan(a) | np.isnan(b)
+    one = (~np.isnan(a) & (a != 0)) | (~np.isnan(b) & (b != 0))
+    return np.where(na & ~one, np.nan, out)
+
+
+_binop("&", _and)
+_binop("&&", _and)
+_binop("|", _or)
+_binop("||", _or)
+
+
+@prim("ifelse")
+def ifelse(env, args):
+    """(ifelse test yes no) — vectorized conditional (AstIfElse)."""
+    if len(args) != 3:
+        raise RapidsError("ifelse expects 3 args")
+    test, yes, no = args
+    if not test.is_frame():
+        return yes if test.as_num() != 0 else no
+    tf = test.value
+    n = tf.nrows
+    cols = []
+    for tc in tf.columns:
+        t = numeric_data(tc)
+
+        def _branch(v):
+            if v.is_frame():
+                c = v.value.col(0)
+                d = numeric_data(c)
+                return (np.full(n, d[0]) if len(d) == 1 and n > 1 else d), c
+            return np.full(n, v.as_num()), None
+
+        yv, yc = _branch(yes)
+        nv, nc = _branch(no)
+        out = np.where(np.isnan(t), np.nan, np.where(t != 0, yv, nv))
+        # preserve a shared categorical domain when both branches agree
+        if (
+            yc is not None
+            and nc is not None
+            and yc.type is ColType.CAT
+            and nc.type is ColType.CAT
+            and yc.domain == nc.domain
+        ):
+            codes = np.where(np.isnan(out), -1, out).astype(np.int32)
+            cols.append(Column(tc.name, codes, ColType.CAT, yc.domain))
+        else:
+            cols.append(Column(tc.name, out, ColType.NUM))
+    return Val.frame(Frame(cols))
+
+
+@prim("not")
+def not_(env, args):
+    """(not fr) — logical negation, NA-propagating (math/AstNot)."""
+    from h2o3_tpu.rapids.prims.util import map_columns
+
+    v = args[0]
+    if not v.is_frame():
+        x = v.as_num()
+        return Val.num(float("nan") if np.isnan(x) else float(x == 0))
+    return Val.frame(
+        map_columns(v.value, lambda a: np.where(np.isnan(a), np.nan, (a == 0).astype(np.float64)))
+    )

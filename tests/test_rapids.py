@@ -1,0 +1,360 @@
+"""Rapids DSL tests — parser, operators, reducers, mungers, groupby, merge,
+strings, time, advmath.  Oracle: hand-computed numpy results (the reference's
+pyunit_munging tests are the model; SURVEY.md §4 tier 2)."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.frame.frame import Column, ColType, Frame
+from h2o3_tpu.rapids import Session, Val, exec_rapids
+from h2o3_tpu.rapids.parser import parse, AstExec, AstNum, AstNumList, AstStr, AstFun
+
+
+@pytest.fixture
+def sess():
+    s = Session()
+    fr = Frame.from_dict(
+        {
+            "a": [1.0, 2.0, 3.0, 4.0, np.nan],
+            "b": [10.0, 20.0, 30.0, 40.0, 50.0],
+            "g": ["x", "y", "x", "y", "x"],
+        }
+    )
+    s.assign("fr", fr)
+    return s
+
+
+def ex(s, expr):
+    return exec_rapids(expr, s)
+
+
+# -- parser ------------------------------------------------------------------
+def test_parse_basic():
+    ast = parse('(+ 1 2)')
+    assert isinstance(ast, AstExec) and len(ast.args) == 2
+
+def test_parse_numlist_ranges():
+    ast = parse("[0:3 10]")
+    assert isinstance(ast, AstNumList)
+    np.testing.assert_array_equal(ast.values, [0, 1, 2, 10])
+
+def test_parse_string_and_lambda():
+    ast = parse('{x . (+ x 1)}')
+    assert isinstance(ast, AstFun) and ast.params == ["x"]
+
+
+# -- operators ---------------------------------------------------------------
+def test_arith_frame_scalar(sess):
+    out = ex(sess, "(+ (cols fr [1]) 5)").as_frame()
+    np.testing.assert_allclose(out.col(0).data, [15, 25, 35, 45, 55])
+
+def test_arith_frame_frame(sess):
+    out = ex(sess, "(* (cols fr [0]) (cols fr [1]))").as_frame()
+    np.testing.assert_allclose(out.col(0).data[:4], [10, 40, 90, 160])
+    assert np.isnan(out.col(0).data[4])
+
+def test_cmp_string_eq(sess):
+    out = ex(sess, '(== (cols fr [2]) "x")').as_frame()
+    np.testing.assert_allclose(out.col(0).data, [1, 0, 1, 0, 1])
+
+def test_ifelse(sess):
+    out = ex(sess, "(ifelse (> (cols fr [1]) 25) 1 0)").as_frame()
+    np.testing.assert_allclose(out.col(0).data, [0, 0, 1, 1, 1])
+
+
+# -- reducers ----------------------------------------------------------------
+def test_mean_narm(sess):
+    assert ex(sess, "(mean (cols fr [0]) 1 0)").as_num() == pytest.approx(2.5)
+
+def test_max_poisoned_by_na(sess):
+    assert np.isnan(ex(sess, "(max (cols fr [0]))").as_num())
+    assert ex(sess, "(maxNA (cols fr [0]))").as_num() == 4.0
+
+def test_sum_sd(sess):
+    assert ex(sess, "(sum (cols fr [1]))").as_num() == 150.0
+    assert ex(sess, "(sd (cols fr [1]))").as_num() == pytest.approx(np.std([10, 20, 30, 40, 50], ddof=1))
+
+def test_cumsum(sess):
+    out = ex(sess, "(cumsum (cols fr [1]) 0)").as_frame()
+    np.testing.assert_allclose(out.col(0).data, [10, 30, 60, 100, 150])
+
+def test_nacnt(sess):
+    assert ex(sess, "(naCnt (cols fr [0]))").as_num() == 1.0
+
+
+# -- mungers -----------------------------------------------------------------
+def test_nrow_ncol_colnames(sess):
+    assert ex(sess, "(nrow fr)").as_num() == 5
+    assert ex(sess, "(ncol fr)").as_num() == 3
+    assert ex(sess, "(colnames fr)").as_strs() == ["a", "b", "g"]
+
+def test_rows_slice(sess):
+    out = ex(sess, "(rows fr [0 2])").as_frame()
+    np.testing.assert_allclose(out.col("a").data, [1, 3])
+
+def test_rows_bool_mask(sess):
+    out = ex(sess, "(rows fr (> (cols fr [1]) 25))").as_frame()
+    assert out.nrows == 3
+
+def test_cbind_rbind(sess):
+    out = ex(sess, "(cbind (cols fr [0]) (cols fr [1]))").as_frame()
+    assert out.ncols == 2
+    out2 = ex(sess, "(rbind (cols fr [1]) (cols fr [1]))").as_frame()
+    assert out2.nrows == 10
+
+def test_asfactor_levels(sess):
+    out = ex(sess, "(as.factor (cols fr [0]))").as_frame()
+    assert out.col(0).type is ColType.CAT
+    assert ex(sess, "(levels (as.factor (cols fr [0])))").as_strs() == ["1", "2", "3", "4"]
+
+def test_isna_naomit(sess):
+    out = ex(sess, "(is.na (cols fr [0]))").as_frame()
+    np.testing.assert_allclose(out.col(0).data, [0, 0, 0, 0, 1])
+    assert ex(sess, "(na.omit fr)").as_frame().nrows == 4
+
+def test_tmp_assign_and_session_end(sess):
+    ex(sess, "(tmp= t1 (+ (cols fr [1]) 1))")
+    assert sess.lookup("t1") is not None
+    sess.end()
+    assert sess.lookup("t1") is None
+
+def test_rectangle_assign(sess):
+    out = ex(sess, "(:= fr (cols fr [1]) [0] [0:5])").as_frame()
+    np.testing.assert_allclose(out.col("a").data, [10, 20, 30, 40, 50])
+
+def test_append(sess):
+    out = ex(sess, '(append fr (* (cols fr [1]) 2) "b2")').as_frame()
+    assert "b2" in out.names
+    np.testing.assert_allclose(out.col("b2").data, [20, 40, 60, 80, 100])
+
+def test_scale(sess):
+    out = ex(sess, "(scale (cols fr [1]) 1 1)").as_frame()
+    d = out.col(0).data
+    assert abs(np.nanmean(d)) < 1e-12 and np.nanstd(d, ddof=1) == pytest.approx(1.0)
+
+def test_cut(sess):
+    out = ex(sess, "(cut (cols fr [1]) [0 25 60] [] 0 1 3)").as_frame()
+    c = out.col(0)
+    assert c.type is ColType.CAT
+    np.testing.assert_array_equal(c.data, [0, 0, 1, 1, 1])
+
+def test_fillna(sess):
+    out = ex(sess, '(h2o.fillna (cols fr [0]) "forward" 0 2)').as_frame()
+    np.testing.assert_allclose(out.col(0).data, [1, 2, 3, 4, 4])
+
+
+# -- group-by / ddply --------------------------------------------------------
+def test_groupby(sess):
+    out = ex(sess, '(GB fr [2] "sum" 1 "all" "nrow" 1 "all")').as_frame()
+    assert out.nrows == 2
+    g = out.col("g")
+    sums = out.col("sum_b").data
+    counts = out.col("nrow").data
+    by_level = {g.domain[g.data[i]]: (sums[i], counts[i]) for i in range(2)}
+    assert by_level["x"] == (90.0, 3.0)
+    assert by_level["y"] == (60.0, 2.0)
+
+def test_groupby_mean_narm(sess):
+    out = ex(sess, '(GB fr [2] "mean" 0 "rm")').as_frame()
+    g = out.col("g")
+    means = {g.domain[g.data[i]]: out.col("mean_a").data[i] for i in range(2)}
+    assert means["x"] == pytest.approx(2.0)  # (1+3)/2, NA removed
+    assert means["y"] == pytest.approx(3.0)
+
+def test_ddply(sess):
+    out = ex(sess, "(ddply fr [2] {g . (sum (cols g [1]))})").as_frame()
+    assert out.nrows == 2
+    assert set(out.col(1).data) == {90.0, 60.0}
+
+
+# -- merge / sort ------------------------------------------------------------
+def test_sort(sess):
+    out = ex(sess, "(sort fr [1] [0])").as_frame()  # descending b
+    np.testing.assert_allclose(out.col("b").data, [50, 40, 30, 20, 10])
+
+def test_merge(sess):
+    right = Frame.from_dict({"g": ["x", "y", "z"], "v": [100.0, 200.0, 300.0]})
+    sess.assign("rt", right)
+    out = ex(sess, "(merge fr rt 0 0 [2] [0] 'auto')").as_frame()
+    assert out.nrows == 5
+    gi = out.col("g")
+    vals = out.col("v").data
+    for i in range(5):
+        lvl = gi.domain[gi.data[i]]
+        assert vals[i] == (100.0 if lvl == "x" else 200.0)
+
+def test_merge_all_left(sess):
+    right = Frame.from_dict({"g": ["x"], "v": [7.0]})
+    sess.assign("rt2", right)
+    out = ex(sess, "(merge fr rt2 1 0 [2] [0] 'auto')").as_frame()
+    assert out.nrows == 5
+    assert np.isnan(out.col("v").data).sum() == 2  # the two 'y' rows
+
+
+# -- strings -----------------------------------------------------------------
+def test_string_ops(sess):
+    s = Session()
+    fr = Frame.from_dict({"s": ["  Hello ", "World", None]})
+    # keep as STR: from_dict makes CAT via column_from_strings? ensure STR col
+    fr = Frame([Column("s", np.array(["  Hello ", "World", None], dtype=object), ColType.STR)])
+    s.assign("sf", fr)
+    out = ex(s, "(tolower (trim sf))").as_frame()
+    assert list(out.col(0).data) == ["hello", "world", None]
+    ln = ex(s, "(length (trim sf))").as_frame()
+    np.testing.assert_allclose(ln.col(0).data[:2], [5, 5])
+    assert np.isnan(ln.col(0).data[2])
+
+def test_strsplit_substring():
+    s = Session()
+    fr = Frame([Column("s", np.array(["a_b", "c_d_e"], dtype=object), ColType.STR)])
+    s.assign("sf", fr)
+    out = ex(s, '(strsplit sf "_")').as_frame()
+    assert out.ncols == 3
+    assert out.col(0).data[0] == "a" and out.col(2).data[1] == "e"
+
+def test_countmatches_grep():
+    s = Session()
+    fr = Frame([Column("s", np.array(["banana", "apple"], dtype=object), ColType.STR)])
+    s.assign("sf", fr)
+    out = ex(s, '(countmatches sf ["an"])').as_frame()
+    np.testing.assert_allclose(out.col(0).data, [2, 0])
+    g = ex(s, '(grep sf "app" 0 0 0)').as_frame()
+    np.testing.assert_allclose(g.col(0).data, [1])
+
+def test_str_distance():
+    s = Session()
+    f1 = Frame([Column("a", np.array(["kitten"], dtype=object), ColType.STR)])
+    f2 = Frame([Column("b", np.array(["sitting"], dtype=object), ColType.STR)])
+    s.assign("f1", f1)
+    s.assign("f2", f2)
+    out = ex(s, '(strDistance f1 f2 "lv" 1)').as_frame()
+    assert out.col(0).data[0] == 3.0
+
+
+# -- time --------------------------------------------------------------------
+def test_time_fields():
+    s = Session()
+    # 2020-06-15 12:34:56 UTC
+    ms = 1592224496000.0
+    fr = Frame([Column("t", np.array([ms]), ColType.TIME)])
+    s.assign("tf", fr)
+    assert ex(s, "(year tf)").as_frame().col(0).data[0] == 2020
+    assert ex(s, "(month tf)").as_frame().col(0).data[0] == 6
+    assert ex(s, "(day tf)").as_frame().col(0).data[0] == 15
+    assert ex(s, "(hour tf)").as_frame().col(0).data[0] == 12
+    assert ex(s, "(minute tf)").as_frame().col(0).data[0] == 34
+    assert ex(s, "(second tf)").as_frame().col(0).data[0] == 56
+    assert ex(s, "(dayOfWeek tf)").as_frame().col(0).data[0] == 0  # Monday
+
+def test_mktime_roundtrip():
+    s = Session()
+    v = exec_rapids("(mktime 2020 5 14 12 34 56 0)", s)  # month/day 0-based
+    assert v.as_num() == 1592224496000.0
+
+
+# -- advmath -----------------------------------------------------------------
+def test_cor(sess):
+    v = ex(sess, "(cor (cols fr [1]) (cols fr [1]) 'everything' 'Pearson')")
+    assert v.as_num() == pytest.approx(1.0)
+
+def test_hist(sess):
+    out = ex(sess, "(hist (cols fr [1]) 5)").as_frame()
+    assert "counts" in out.names
+    assert np.nansum(out.col("counts").data) == 5
+
+def test_table(sess):
+    out = ex(sess, "(table (cols fr [2]) 1)").as_frame()
+    cnt = {out.col(0).domain[out.col(0).data[i]]: out.col("Count").data[i] for i in range(out.nrows)}
+    assert cnt == {"x": 3.0, "y": 2.0}
+
+def test_unique(sess):
+    out = ex(sess, "(unique (cols fr [2]) 0)").as_frame()
+    assert out.nrows == 2
+
+def test_quantile(sess):
+    out = ex(sess, "(quantile (cols fr [1]) [0.5] 'interpolated' _)")
+    q = out.as_frame()
+    assert q.col(1).data[0] == pytest.approx(30.0)
+
+def test_impute(sess):
+    out = ex(sess, "(impute fr 0 'mean' 'interpolate' [] _ _)").as_frame()
+    assert out.col("a").data[4] == pytest.approx(2.5)
+
+def test_runif(sess):
+    out = ex(sess, "(h2o.runif fr 42)").as_frame()
+    assert out.nrows == 5
+    assert ((out.col(0).data >= 0) & (out.col(0).data < 1)).all()
+
+def test_kfold(sess):
+    out = ex(sess, "(kfold_column fr 2 7)").as_frame()
+    assert set(np.unique(out.col(0).data)) <= {0.0, 1.0}
+
+def test_match(sess):
+    out = ex(sess, '(match (cols fr [2]) ["y" "x"] nan 1)').as_frame()
+    np.testing.assert_allclose(out.col(0).data, [2, 1, 2, 1, 2])
+
+def test_which(sess):
+    out = ex(sess, "(which (> (cols fr [1]) 25))").as_frame()
+    np.testing.assert_allclose(out.col(0).data, [2, 3, 4])
+
+def test_mmult(sess):
+    s = Session()
+    a = Frame.from_dict({"x": [1.0, 2.0], "y": [3.0, 4.0]})
+    s.assign("A", a)
+    out = ex(s, "(x (t A) A)").as_frame()
+    m = out.to_numpy()
+    np.testing.assert_allclose(m, np.array([[1, 3], [2, 4]]) @ np.array([[1, 3], [2, 4]]).T @ np.eye(2) if False else np.array([[5, 11], [11, 25]]))
+
+def test_seq_replen():
+    s = Session()
+    out = exec_rapids("(seq 1 5 1)", s).as_frame()
+    np.testing.assert_allclose(out.col(0).data, [1, 2, 3, 4, 5])
+    out2 = exec_rapids("(rep_len 7 3)", s).as_frame()
+    np.testing.assert_allclose(out2.col(0).data, [7, 7, 7])
+
+def test_difflag1(sess):
+    out = ex(sess, "(difflag1 (cols fr [1]))").as_frame()
+    assert np.isnan(out.col(0).data[0])
+    np.testing.assert_allclose(out.col(0).data[1:], [10, 10, 10, 10])
+
+def test_melt(sess):
+    out = ex(sess, '(melt fr [2] [0 1] "variable" "value" 0)').as_frame()
+    assert out.nrows == 10
+    assert "variable" in out.names and "value" in out.names
+
+def test_pivot():
+    s = Session()
+    fr = Frame.from_dict(
+        {"i": [1.0, 1.0, 2.0, 2.0], "c": ["p", "q", "p", "q"], "v": [1.0, 2.0, 3.0, 4.0]}
+    )
+    s.assign("pf", fr)
+    out = ex(s, '(pivot pf "i" "c" "v")').as_frame()
+    assert out.nrows == 2 and out.ncols == 3
+    np.testing.assert_allclose(out.col("p").data, [1, 3])
+
+def test_topn(sess):
+    out = ex(sess, "(topn fr 1 40 1)").as_frame()
+    np.testing.assert_allclose(sorted(out.col(1).data, reverse=True), [50, 40])
+
+def test_rank_within_groupby(sess):
+    out = ex(sess, '(rankWithinGroupBy fr [2] [1] [1] "rank")').as_frame()
+    r = out.col("rank").data
+    g = sess.lookup("fr").col("g")
+    # within x group (rows 0,2,4 with b=10,30,50): ranks 1,2,3
+    assert r[0] == 1 and r[2] == 2 and r[4] == 3
+    assert r[1] == 1 and r[3] == 2
+
+def test_stratified_split():
+    s = Session()
+    y = Frame([Column("y", np.array([0, 0, 0, 0, 1, 1, 1, 1], dtype=np.int32), ColType.CAT, ["a", "b"])])
+    s.assign("yf", y)
+    out = ex(s, "(h2o.random_stratified_split yf 0.5 42)").as_frame()
+    d = out.col(0).data
+    assert d[:4].sum() == 2 and d[4:].sum() == 2
+
+def test_dropdup(sess):
+    s = Session()
+    fr = Frame.from_dict({"a": [1.0, 1.0, 2.0], "b": [5.0, 5.0, 6.0]})
+    s.assign("df", fr)
+    out = ex(s, "(dropdup df [0 1] 'first')").as_frame()
+    assert out.nrows == 2
