@@ -1,0 +1,161 @@
+"""Aggregator — exemplar-based data aggregation.
+
+Reference: ``hex/aggregator/Aggregator.java:16`` — single pass over rows:
+a row within ``radius`` of an existing exemplar is counted into it, otherwise
+it becomes a new exemplar; the radius is grown (and exemplars re-aggregated)
+whenever the exemplar count overshoots ``target_num_exemplars`` beyond
+``rel_tol_num_exemplars``.  Output is the exemplar frame + per-exemplar
+``counts`` column.
+
+TPU-native: the sequential scan becomes a *batched* scan — each batch computes
+its full [B, E] distance matrix to the current exemplars as one MXU matmul,
+absorbs covered rows with a segment-sum, and only the uncovered remainder is
+processed greedily (tiny).  Radius escalation re-aggregates exemplars against
+themselves with the same kernel.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from h2o3_tpu.frame.frame import ColType, Column, Frame
+from h2o3_tpu.models.data_info import build_data_info, expand_matrix
+from h2o3_tpu.models.framework import Model, ModelBuilder, ModelParameters
+
+
+@dataclass
+class AggregatorParameters(ModelParameters):
+    target_num_exemplars: int = 5000
+    rel_tol_num_exemplars: float = 0.5
+    transform: str = "normalize"  # none | standardize | normalize
+    batch_size: int = 65536
+
+
+@jax.jit
+def _dist2(B, E):
+    """Squared euclidean distances [nb, ne] via the matmul expansion."""
+    return (
+        jnp.sum(B * B, axis=1, keepdims=True)
+        - 2.0 * B @ E.T
+        + jnp.sum(E * E, axis=1)[None, :]
+    )
+
+
+class AggregatorModel(Model):
+    algo_name = "aggregator"
+
+    def __init__(self, params, data_info):
+        super().__init__(params, data_info)
+        self.exemplar_rows: Optional[np.ndarray] = None  # row indices into training frame
+        self.counts: Optional[np.ndarray] = None
+        self.output_frame: Optional[Frame] = None
+        self.radius: float = 0.0
+
+    @property
+    def is_classifier(self) -> bool:
+        return False
+
+    def _predict_raw(self, frame: Frame) -> np.ndarray:
+        raise NotImplementedError("Aggregator produces an output frame, not predictions")
+
+
+class Aggregator(ModelBuilder):
+    algo_name = "aggregator"
+
+    def __init__(self, params: Optional[AggregatorParameters] = None, **kw) -> None:
+        super().__init__(params or AggregatorParameters(**kw))
+
+    def _fit(self, frame: Frame, valid: Optional[Frame] = None) -> AggregatorModel:
+        p: AggregatorParameters = self.params
+        info = build_data_info(
+            frame, None, ignored=p.ignored_columns,
+            standardize=p.transform in ("standardize", "normalize"),
+        )
+        X, _ = expand_matrix(info, frame, dtype=np.float32)
+        n, d = X.shape
+        if p.transform == "normalize" and d:
+            # scale standardized features into [-.5,.5]-ish per-dim range
+            span = X.max(axis=0) - X.min(axis=0)
+            X = X / np.where(span > 0, span, 1.0)
+
+        target = min(p.target_num_exemplars, n)
+        hi_cap = target * (1.0 + p.rel_tol_num_exemplars)
+        radius2 = 0.0  # start exact: every distinct row is an exemplar until overshoot
+        ex_idx: List[int] = []
+        ex_pts: List[np.ndarray] = []
+        counts: List[float] = []
+
+        Emat = np.zeros((0, d), dtype=np.float32)
+        for start in range(0, n, p.batch_size):
+            B = X[start : start + p.batch_size]
+            covered = np.zeros(len(B), dtype=bool)
+            assign = np.zeros(len(B), dtype=np.int64)
+            if len(ex_pts):
+                d2 = np.asarray(_dist2(jnp.asarray(B), jnp.asarray(Emat)))
+                j = d2.argmin(axis=1)
+                m = d2[np.arange(len(B)), j] <= radius2
+                covered, assign = m, j
+            for k, c in zip(*np.unique(assign[covered], return_counts=True)):
+                counts[k] += float(c)
+            for bi in np.nonzero(~covered)[0]:
+                x = B[bi]
+                if ex_pts:
+                    d2x = ((Emat - x) ** 2).sum(axis=1)
+                    k = int(d2x.argmin())
+                    if d2x[k] <= radius2:
+                        counts[k] += 1.0
+                        continue
+                ex_idx.append(start + int(bi))
+                ex_pts.append(x)
+                counts.append(1.0)
+                Emat = np.vstack([Emat, x[None, :]])
+                if len(ex_pts) > hi_cap:
+                    radius2 = _grow_radius(radius2, X)
+                    ex_idx, ex_pts, counts, Emat = _reaggregate(
+                        ex_idx, Emat, counts, radius2
+                    )
+            if self.job:
+                self.job.update(min(1.0, (start + len(B)) / n))
+
+        model = AggregatorModel(p, info)
+        model.exemplar_rows = np.asarray(ex_idx, dtype=np.int64)
+        model.counts = np.asarray(counts)
+        model.radius = float(np.sqrt(radius2))
+        out = frame.rows(model.exemplar_rows)
+        model.output_frame = out.add_column(Column("counts", model.counts, ColType.NUM))
+        return model
+
+
+def _grow_radius(radius2: float, X: np.ndarray) -> float:
+    """Escalate the merge radius (Aggregator.java's iterative radius growth)."""
+    if radius2 <= 0.0:
+        d = X.shape[1]
+        return 1e-4 * max(d, 1)
+    return radius2 * 2.0
+
+
+def _reaggregate(ex_idx, Emat, counts, radius2):
+    """Merge exemplars that now fall within the grown radius of an earlier one."""
+    keep_idx: List[int] = []
+    keep_pts: List[np.ndarray] = []
+    keep_counts: List[float] = []
+    K = np.zeros((0, Emat.shape[1]), dtype=np.float32)
+    for i in range(len(ex_idx)):
+        x = Emat[i]
+        if len(keep_pts):
+            d2 = ((K - x) ** 2).sum(axis=1)
+            k = int(d2.argmin())
+            if d2[k] <= radius2:
+                keep_counts[k] += counts[i]
+                continue
+        keep_idx.append(ex_idx[i])
+        keep_pts.append(x)
+        keep_counts.append(counts[i])
+        K = np.vstack([K, x[None, :]])
+    return keep_idx, keep_pts, keep_counts, K

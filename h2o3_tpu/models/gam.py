@@ -1,0 +1,272 @@
+"""GAM — generalized additive models: spline basis expansion + GLM core.
+
+Reference: ``hex/gam/GAM.java:47`` — each ``gam_column`` is expanded into a
+cubic-regression-spline basis block ("gamified" columns, knots at quantiles,
+``hex/gam/GamSplines/``), the blocks are Z-transformed for identifiability
+(centered against the intercept), and the penalized IRLSM solves
+``(X'WX + Σ λⱼ Sⱼ) β = X'Wz`` with the smoothing penalty Sⱼ = DᵀB⁻¹D from the
+natural-cubic-spline second-derivative quadratic form.
+
+TPU-native: the basis expansion is a host-side construction (tiny, once); the
+per-iteration Gram X'WX remains the one sharded matmul from the GLM core
+(h2o3_tpu/models/glm.py), with the penalty added to the host-side solve.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.data_info import build_data_info, expand_matrix, response_vector
+from h2o3_tpu.models.framework import Model, ModelBuilder
+from h2o3_tpu.models.glm import (
+    GLMParameters,
+    _aic,
+    _gram,
+    _link_deriv,
+    _link_of_mean,
+    _linkinv,
+    _variance,
+    deviance,
+)
+from h2o3_tpu.parallel.mesh import default_mesh, pad_rows, shard_rows
+
+
+@dataclass
+class GAMParameters(GLMParameters):
+    gam_columns: List[str] = field(default_factory=list)
+    num_knots: int = 10
+    scale: float = 1.0  # smoothing λ (per gam column; reference: scale array)
+    bs: int = 0  # 0 = cubic regression spline (the reference default)
+
+
+# ---------------------------------------------------------------------------
+# cubic regression spline machinery (hex/gam/GamSplines/CubicRegressionSplines)
+
+
+def cr_matrices(knots: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Natural-cubic-spline D ((K-2)×K) and B ((K-2)×(K-2)) matrices.
+    γ = B⁻¹D β maps knot values to interior second derivatives; the curvature
+    penalty is S = DᵀB⁻¹D."""
+    h = np.diff(knots)
+    K = len(knots)
+    D = np.zeros((K - 2, K))
+    B = np.zeros((K - 2, K - 2))
+    for i in range(K - 2):
+        D[i, i] = 1.0 / h[i]
+        D[i, i + 1] = -1.0 / h[i] - 1.0 / h[i + 1]
+        D[i, i + 2] = 1.0 / h[i + 1]
+        B[i, i] = (h[i] + h[i + 1]) / 3.0
+        if i + 1 < K - 2:
+            B[i, i + 1] = B[i + 1, i] = h[i + 1] / 6.0
+    return D, B
+
+
+def cr_penalty(knots: np.ndarray) -> np.ndarray:
+    D, B = cr_matrices(knots)
+    return D.T @ np.linalg.solve(B, D)
+
+
+def cr_basis(x: np.ndarray, knots: np.ndarray) -> np.ndarray:
+    """[N, K] cardinal natural-cubic-spline basis: row · β evaluates the
+    spline with values β at the knots (linear extrapolation outside)."""
+    D, B = cr_matrices(knots)
+    F = np.vstack([np.zeros(len(knots)), np.linalg.solve(B, D), np.zeros(len(knots))])
+    h = np.diff(knots)
+    K = len(knots)
+    xc = np.clip(x, knots[0], knots[-1])
+    j = np.clip(np.searchsorted(knots, xc, side="right") - 1, 0, K - 2)
+    hj = h[j]
+    kl, kr = knots[j], knots[j + 1]
+    am = (kr - xc) / hj
+    ap = (xc - kl) / hj
+    cm = ((kr - xc) ** 3 / hj - hj * (kr - xc)) / 6.0
+    cp = ((xc - kl) ** 3 / hj - hj * (xc - kl)) / 6.0
+    n = len(x)
+    basis = np.zeros((n, K))
+    rows = np.arange(n)
+    basis[rows, j] += am
+    basis[rows, j + 1] += ap
+    basis += cm[:, None] * F[j] + cp[:, None] * F[j + 1]
+    # linear extrapolation beyond the boundary knots (natural spline slope)
+    lo, hi = x < knots[0], x > knots[-1]
+    if lo.any():
+        slope = (cr_basis(np.array([knots[0] + 1e-6]), knots) - cr_basis(np.array([knots[0]]), knots)) / 1e-6
+        basis[lo] = cr_basis(np.array([knots[0]]), knots) + (x[lo] - knots[0])[:, None] * slope
+    if hi.any():
+        slope = (cr_basis(np.array([knots[-1]]), knots) - cr_basis(np.array([knots[-1] - 1e-6]), knots)) / 1e-6
+        basis[hi] = cr_basis(np.array([knots[-1]]), knots) + (x[hi] - knots[-1])[:, None] * slope
+    return basis
+
+
+@dataclass
+class GamSpec:
+    column: str
+    knots: np.ndarray
+    Z: np.ndarray  # [K, K-1] identifiability transform (⊥ training column means)
+    penalty: np.ndarray  # [K-1, K-1] Zᵀ S Z
+    na_fill: float
+
+    def expand(self, x: np.ndarray) -> np.ndarray:
+        x = np.where(np.isnan(x), self.na_fill, x)
+        return cr_basis(x, self.knots) @ self.Z
+
+
+def _make_spec(name: str, x: np.ndarray, num_knots: int) -> GamSpec:
+    ok = ~np.isnan(x)
+    xs = x[ok]
+    qs = np.quantile(xs, np.linspace(0, 1, num_knots))
+    knots = np.unique(qs)
+    if len(knots) < 3:
+        raise ValueError(f"gam column {name!r} has too few distinct values for splines")
+    basis = cr_basis(xs, knots)
+    m = basis.mean(axis=0)
+    # Z: orthonormal basis of the null space of mᵀ (H2O's centering transform
+    # — gamified columns stay orthogonal to the intercept)
+    _, _, Vt = np.linalg.svd(m[None, :], full_matrices=True)
+    Z = Vt[1:].T  # [K, K-1]
+    S = cr_penalty(knots)
+    return GamSpec(name, knots, Z, Z.T @ S @ Z, float(np.median(xs)))
+
+
+class GAMModel(Model):
+    algo_name = "gam"
+
+    def __init__(self, params: GAMParameters, data_info) -> None:
+        super().__init__(params, data_info)
+        self.specs: List[GamSpec] = []
+        self.beta: Optional[np.ndarray] = None  # [P_lin + Σ(Kⱼ-1) + 1]
+        self.coefficients: Dict[str, float] = {}
+        self.null_deviance: float = np.nan
+        self.residual_deviance: float = np.nan
+        self.aic: float = np.nan
+        self.iterations: int = 0
+
+    def _design(self, frame: Frame) -> np.ndarray:
+        Xl, _ = expand_matrix(self.data_info, frame, dtype=np.float64)
+        blocks = [Xl]
+        for s in self.specs:
+            blocks.append(s.expand(frame.col(s.column).numeric_view().astype(np.float64)))
+        return np.concatenate(blocks, axis=1)
+
+    def _predict_raw(self, frame: Frame) -> np.ndarray:
+        p: GAMParameters = self.params
+        X = self._design(frame)
+        eta = X @ self.beta[:-1] + self.beta[-1]
+        mu = _linkinv(p.actual_link(), eta, p)
+        if p.family in ("binomial", "quasibinomial"):
+            return np.stack([1 - mu, mu], axis=1)
+        return mu
+
+
+class GAM(ModelBuilder):
+    algo_name = "gam"
+
+    def __init__(self, params: Optional[GAMParameters] = None, **kw) -> None:
+        super().__init__(params or GAMParameters(**kw))
+
+    def _validate(self, frame: Frame) -> None:
+        super()._validate(frame)
+        if not self.params.gam_columns:
+            raise ValueError("GAM requires gam_columns")
+
+    def _fit(self, frame: Frame, valid: Optional[Frame] = None) -> GAMModel:
+        p: GAMParameters = self.params
+        link = p.actual_link()
+        if p.family in ("binomial", "quasibinomial"):
+            ycol = frame.col(p.response_column)
+            if not ycol.is_categorical():
+                frame = frame.add_column(ycol.as_factor())
+        # gam columns are modeled through their basis only (GAM.java removes
+        # them from the linear predictors)
+        info = build_data_info(
+            frame,
+            y=p.response_column,
+            ignored=list(p.ignored_columns) + list(p.gam_columns),
+            standardize=p.standardize,
+            missing_values_handling=p.missing_values_handling,
+        )
+        model = GAMModel(p, info)
+        model.specs = [
+            _make_spec(c, frame.col(c).numeric_view().astype(np.float64), p.num_knots)
+            for c in p.gam_columns
+        ]
+
+        X = model._design(frame)
+        y = response_vector(info, frame)
+        obs_w = (
+            frame.col(p.weights_column).numeric_view().astype(np.float64)
+            if p.weights_column else np.ones(frame.nrows)
+        )
+        keep = ~(np.isnan(y) | np.isnan(X).any(axis=1))
+        X, y, obs_w = X[keep], y[keep], obs_w[keep]
+        n, pc = X.shape
+        n_lin = info.n_coefs
+
+        # block-diagonal smoothing penalty, zero on linear coefs + intercept
+        Lam = np.zeros((pc + 1, pc + 1))
+        off = n_lin
+        for s in model.specs:
+            kz = s.penalty.shape[0]
+            Lam[off : off + kz, off : off + kz] = p.scale * s.penalty
+            off += kz
+
+        mesh = default_mesh()
+        Xi = np.concatenate([X, np.ones((n, 1))], axis=1).astype(np.float32)
+        Xd, _ = shard_rows(Xi, mesh)
+        pad = lambda a: pad_rows(a, mesh.devices.size)[0]
+
+        wsum = float(obs_w.sum())
+        ybar = float((obs_w * y).sum() / wsum)
+        beta = np.zeros(pc + 1)
+        beta[-1] = _link_of_mean(link, ybar, p)
+        l2 = p.lambda_ * (1 - p.alpha) * wsum
+
+        prev_obj = np.inf
+        for it in range(p.max_iterations):
+            eta = X @ beta[:-1] + beta[-1]
+            mu = _linkinv(link, eta, p)
+            d = _link_deriv(link, mu, p)
+            v = _variance(p.family, mu, p)
+            w = obs_w / np.maximum(v * d * d, 1e-12)
+            wz = eta + (y - mu) * d
+
+            G, q = _gram(Xd, pad(wz), pad(w))
+            A = G / wsum + Lam / wsum + (l2 / wsum) * np.eye(pc + 1)
+            A[-1, -1] -= l2 / wsum  # intercept unpenalized
+            A[np.arange(pc + 1), np.arange(pc + 1)] += 1e-10
+            beta_new = np.linalg.solve(A, q / wsum)
+
+            mu_new = _linkinv(link, X @ beta_new[:-1] + beta_new[-1], p)
+            dev = float((obs_w * deviance(p.family, y, mu_new, p)).sum())
+            obj = dev / (2 * wsum) + float(beta_new @ Lam @ beta_new) / (2 * wsum)
+            delta = np.max(np.abs(beta_new - beta))
+            beta = beta_new
+            model.iterations = it + 1
+            if delta < p.beta_epsilon or abs(prev_obj - obj) < p.objective_epsilon * max(abs(prev_obj), 1.0):
+                break
+            prev_obj = obj
+
+        model.beta = beta
+        names = list(info.coef_names)
+        for s in model.specs:
+            names += [f"{s.column}_cr_{i}" for i in range(s.penalty.shape[0])]
+        model.coefficients = dict(zip(names, beta[:-1].tolist()))
+        model.coefficients["Intercept"] = float(beta[-1])
+
+        mu = _linkinv(link, X @ beta[:-1] + beta[-1], p)
+        model.residual_deviance = float((obs_w * deviance(p.family, y, mu, p)).sum())
+        model.null_deviance = float(
+            (obs_w * deviance(p.family, y, np.full_like(y, ybar), p)).sum()
+        )
+        rank = pc + 1
+        model.aic = _aic(p.family, y, mu, obs_w, model.residual_deviance, rank)
+        model.training_metrics = model.model_performance(frame)
+        if valid is not None:
+            model.validation_metrics = model.model_performance(valid)
+        return model

@@ -132,7 +132,7 @@ def group_by(
 
 def rank_within_group_by(
     fr: Frame, by: Sequence[int], sort_cols: Sequence[int], ascending: Sequence[bool],
-    new_col: str, sort_cols_by: Optional[Sequence[int]] = None,
+    new_col: str,
 ) -> Frame:
     """AstRankWithinGroupBy: dense rank of rows within each group under the
     given sort order; NAs get NaN rank."""

@@ -63,6 +63,14 @@ def _encode_keys(
             lv = np.where(np.isnan(lvals), -1, np.searchsorted(uniq, np.nan_to_num(lvals))).astype(np.int64)
             rv = np.where(np.isnan(rvals), -1, np.searchsorted(uniq, np.nan_to_num(rvals))).astype(np.int64)
             card = len(uniq) + 1
+        # overflow guard: the mixed-radix encoding must stay within int64 or
+        # unrelated key tuples would silently collide
+        max_prior = max(int(lcodes.max(initial=0)), int(rcodes.max(initial=0)))
+        if max_prior > (2**62) // card:
+            raise ValueError(
+                "merge: combined key cardinality exceeds int64 encoding range; "
+                "reduce the number/cardinality of join columns"
+            )
         lcodes = lcodes * card + (lv + 1)
         rcodes = rcodes * card + (rv + 1)
     return lcodes, rcodes

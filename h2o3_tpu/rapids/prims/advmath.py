@@ -275,7 +275,9 @@ def stratified_split(env, args):
     y = fr.col(0)
     codes = y.data if y.type is ColType.CAT else y.numeric_view()
     out = np.zeros(fr.nrows, dtype=np.float64)
-    vals = np.unique(codes[~np.isnan(np.asarray(codes, dtype=np.float64))])
+    cf = np.asarray(codes, dtype=np.float64)
+    # exclude NAs from stratification: NaN for numeric, code -1 for CAT
+    vals = np.unique(cf[~np.isnan(cf) & (cf >= 0 if y.type is ColType.CAT else True)])
     for v in vals:
         idx = np.nonzero(codes == v)[0]
         k = int(round(len(idx) * frac))
@@ -314,34 +316,34 @@ def table(env, args):
     c1 = f1.col(0)
 
     def codes_domain(c):
+        """-> (codes, labels, is_cat, raw_values) — raw numeric uniques kept
+        exact (a %g label round-trip would collapse values past 6 sig digits)."""
         if c.type is ColType.CAT:
-            return c.data.astype(np.int64), list(c.domain), True
+            return c.data.astype(np.int64), list(c.domain), True, None
         d = numeric_data(c)
         u = np.unique(d[~np.isnan(d)])
         codes = np.full(len(d), -1, dtype=np.int64)
         ok = ~np.isnan(d)
         codes[ok] = np.searchsorted(u, d[ok])
-        return codes, [f"{v:g}" for v in u], False
+        return codes, [repr(float(v)) for v in u], False, u
 
-    k1, dom1, cat1 = codes_domain(c1)
+    def key_column(c, dom, cat, raw):
+        if cat:
+            return Column(c.name, np.arange(len(dom), dtype=np.int32), ColType.CAT, dom)
+        return Column(c.name, raw.astype(np.float64), ColType.NUM)
+
+    k1, dom1, cat1, raw1 = codes_domain(c1)
     if f2 is None:
         counts = np.bincount(k1[k1 >= 0], minlength=len(dom1)).astype(np.float64)
-        c_out = (
-            Column(c1.name, np.arange(len(dom1), dtype=np.int32), ColType.CAT, dom1)
-            if cat1
-            else Column(c1.name, np.array([float(d) for d in dom1]), ColType.NUM)
+        return Val.frame(
+            Frame([key_column(c1, dom1, cat1, raw1), Column("Count", counts, ColType.NUM)])
         )
-        return Val.frame(Frame([c_out, Column("Count", counts, ColType.NUM)]))
     c2 = f2.col(0)
-    k2, dom2, cat2 = codes_domain(c2)
+    k2, dom2, cat2, raw2 = codes_domain(c2)
     ok = (k1 >= 0) & (k2 >= 0)
     flat = k1[ok] * len(dom2) + k2[ok]
     counts = np.bincount(flat, minlength=len(dom1) * len(dom2)).reshape(len(dom1), len(dom2))
-    cols = [
-        Column(c1.name, np.arange(len(dom1), dtype=np.int32), ColType.CAT, dom1)
-        if cat1
-        else Column(c1.name, np.array([float(d) for d in dom1]), ColType.NUM)
-    ]
+    cols = [key_column(c1, dom1, cat1, raw1)]
     for j, lv in enumerate(dom2):
         cols.append(Column(str(lv), counts[:, j].astype(np.float64), ColType.NUM))
     return Val.frame(Frame(cols))

@@ -272,8 +272,10 @@ def fillna(env, args):
     axis = int(args[2].as_num()) if len(args) > 2 else 0
     maxlen = int(args[3].as_num()) if len(args) > 3 else 1
     if axis != 0:
+        # axis=1 fills across columns within each row: mat is [N, C] and
+        # _fill_along fills along its second axis, so no transpose
         mat = np.stack([numeric_data(c) for c in fr.columns], axis=1)
-        filled = _fill_along(mat.T, method, maxlen).T
+        filled = _fill_along(mat, method, maxlen)
         return Val.frame(
             Frame([Column(c.name, filled[:, j], ColType.NUM) for j, c in enumerate(fr.columns)])
         )

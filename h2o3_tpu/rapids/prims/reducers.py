@@ -61,8 +61,10 @@ _reduce("mean", np.mean)
 _reduce("median", np.median)
 _reduce("sd", lambda d: np.std(d, ddof=1))
 _reduce("mad", lambda d: 1.4826 * np.median(np.abs(d - np.median(d))))
-_reduce("all", lambda d: float(np.all(d != 0)))
-_reduce("any", lambda d: float(np.any(d != 0)))
+# NaN != 0 is True in numpy, so all/any must NA-poison explicitly under
+# na_rm=0 (matching the Max/MaxNa NA-poisoning convention above)
+_reduce("all", lambda d: float("nan") if np.isnan(d).any() else float(np.all(d != 0)))
+_reduce("any", lambda d: float("nan") if np.isnan(d).any() else float(np.any(d != 0)))
 
 
 @prim("naCnt")

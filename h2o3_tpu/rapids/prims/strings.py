@@ -123,8 +123,12 @@ def strsplit(env, args):
 @prim("substring")
 def substring(env, args):
     fr = args[0].as_frame()
-    start = int(args[1].as_num())
+    # AstSubstring clamps indices into [0, len] — raw python slicing would
+    # give negative-start from-the-end semantics instead
+    start = max(int(args[1].as_num()), 0)
     end = int(args[2].as_num()) if len(args) > 2 and not math.isnan(args[2].as_num()) else None
+    if end is not None:
+        end = max(end, start)
     return Val.frame(_map_str(fr, lambda s: s[start:end]))
 
 

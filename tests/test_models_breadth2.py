@@ -1,0 +1,179 @@
+"""Breadth algorithms round 2: TargetEncoder, ExtendedIsolationForest,
+Aggregator, StackedEnsemble (reference parity per SURVEY.md §2.2/§2.7)."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu import Frame
+from h2o3_tpu.frame.frame import ColType, Column
+
+
+def _cat_frame(rng, n=600):
+    levels = np.array(["a", "b", "c"])
+    codes = rng.integers(0, 3, size=n)
+    base = np.array([0.2, 0.5, 0.8])[codes]
+    y = (rng.random(n) < base).astype(np.int64)
+    return Frame([
+        Column("cat", codes.astype(np.int32), ColType.CAT, list(levels)),
+        Column("num", rng.normal(size=n), ColType.NUM),
+        Column("y", y.astype(np.int32), ColType.CAT, ["no", "yes"]),
+    ]), codes, y
+
+
+class TestTargetEncoder:
+    def test_encodes_level_means(self, rng):
+        from h2o3_tpu.models.target_encoder import TargetEncoder
+
+        fr, codes, y = _cat_frame(rng)
+        te = TargetEncoder(response_column="y", columns_to_encode=["cat"], noise=0.0)
+        model = te.train(fr)
+        out = model.transform(fr)
+        assert "cat_te" in out.names
+        enc = out.col("cat_te").numeric_view()
+        for k in range(3):
+            expected = y[codes == k].mean()
+            assert np.allclose(enc[codes == k], expected, atol=1e-12)
+
+    def test_blending_shrinks_rare_levels(self, rng):
+        from h2o3_tpu.models.target_encoder import TargetEncoder
+
+        n = 500
+        codes = np.zeros(n, dtype=np.int32)
+        codes[:3] = 1  # rare level with extreme mean
+        y = np.zeros(n, dtype=np.int32)
+        y[:3] = 1
+        fr = Frame([
+            Column("cat", codes, ColType.CAT, ["common", "rare"]),
+            Column("y", y, ColType.CAT, ["no", "yes"]),
+        ])
+        blended = TargetEncoder(
+            response_column="y", columns_to_encode=["cat"], blending=True,
+            inflection_point=10, smoothing=20, noise=0.0,
+        ).train(fr)
+        raw = TargetEncoder(
+            response_column="y", columns_to_encode=["cat"], blending=False, noise=0.0
+        ).train(fr)
+        b = blended.transform(fr).col("cat_te").numeric_view()
+        r = raw.transform(fr).col("cat_te").numeric_view()
+        prior = y.mean()
+        # raw posterior for the rare level is 1.0; blending pulls it toward the prior
+        assert r[0] == pytest.approx(1.0)
+        assert prior < b[0] < 1.0
+        assert abs(b[0] - prior) < abs(r[0] - prior)
+
+    def test_loo_subtracts_own_row(self, rng):
+        from h2o3_tpu.models.target_encoder import TargetEncoder
+
+        fr, codes, y = _cat_frame(rng, n=100)
+        m = TargetEncoder(
+            response_column="y", columns_to_encode=["cat"],
+            data_leakage_handling="leave_one_out", noise=0.0,
+        ).train(fr)
+        enc = m.transform(fr, as_training=True).col("cat_te").numeric_view()
+        k, i = codes[0], 0
+        mask = codes == k
+        expected = (y[mask].sum() - y[i]) / (mask.sum() - 1)
+        assert enc[i] == pytest.approx(expected)
+
+    def test_unseen_level_gets_prior(self, rng):
+        from h2o3_tpu.models.target_encoder import TargetEncoder
+
+        fr, codes, y = _cat_frame(rng)
+        m = TargetEncoder(response_column="y", columns_to_encode=["cat"], noise=0.0).train(fr)
+        test = Frame([
+            Column("cat", np.zeros(4, np.int32), ColType.CAT, ["zz"]),
+            Column("num", np.zeros(4), ColType.NUM),
+        ])
+        enc = m.transform(test).col("cat_te").numeric_view()
+        assert np.allclose(enc, m.prior_mean)
+
+
+class TestExtendedIsolationForest:
+    def test_outliers_score_higher(self, rng):
+        from h2o3_tpu.models.ext_isolation_forest import ExtendedIsolationForest
+
+        inliers = rng.normal(size=(400, 4))
+        outliers = rng.normal(size=(8, 4)) * 0.2 + 9.0
+        X = np.vstack([inliers, outliers])
+        fr = Frame.from_dict({f"x{j}": X[:, j] for j in range(4)})
+        m = ExtendedIsolationForest(ntrees=60, sample_size=128, extension_level=3,
+                                    seed=7).train(fr)
+        pred = m.predict(fr)
+        assert pred.names == ["anomaly_score", "mean_length"]
+        s = pred.col("anomaly_score").numeric_view()
+        assert s.min() >= 0.0 and s.max() <= 1.0
+        assert s[-8:].mean() > s[:400].mean() + 0.1
+
+    def test_extension_level_validation(self, rng):
+        from h2o3_tpu.models.ext_isolation_forest import ExtendedIsolationForest
+
+        fr = Frame.from_dict({"a": rng.normal(size=50), "b": rng.normal(size=50)})
+        with pytest.raises(ValueError, match="extension_level"):
+            ExtendedIsolationForest(ntrees=2, extension_level=5, seed=1).train(fr)
+
+
+class TestAggregator:
+    def test_reduces_to_target_exemplars(self, rng):
+        from h2o3_tpu.models.aggregator import Aggregator
+
+        X = rng.normal(size=(3000, 3))
+        fr = Frame.from_dict({f"x{j}": X[:, j] for j in range(3)})
+        m = Aggregator(target_num_exemplars=100, rel_tol_num_exemplars=0.5,
+                       seed=1).train(fr)
+        out = m.output_frame
+        n_ex = out.nrows
+        assert n_ex <= 100 * 1.5 + 1
+        assert "counts" in out.names
+        # counts conserve rows
+        assert out.col("counts").numeric_view().sum() == pytest.approx(3000)
+
+    def test_small_data_all_exemplars(self, rng):
+        from h2o3_tpu.models.aggregator import Aggregator
+
+        X = rng.normal(size=(40, 2))
+        fr = Frame.from_dict({"a": X[:, 0], "b": X[:, 1]})
+        m = Aggregator(target_num_exemplars=5000, seed=1).train(fr)
+        assert m.output_frame.nrows == 40  # radius never grows
+
+
+class TestStackedEnsemble:
+    def test_beats_or_matches_base_models(self, rng):
+        from h2o3_tpu.models.glm import GLM
+        from h2o3_tpu.models.stacked_ensemble import StackedEnsemble
+        from h2o3_tpu.models.tree.gbm import GBM
+
+        n = 800
+        X = rng.normal(size=(n, 5))
+        logit = X[:, 0] - 0.8 * X[:, 1] + 0.5 * X[:, 2] * X[:, 3]
+        y = (rng.random(n) < 1 / (1 + np.exp(-logit))).astype(np.int32)
+        d = {f"x{j}": X[:, j] for j in range(5)}
+        d["y"] = y
+        fr = Frame.from_dict(d)
+        fr = Frame([*fr.drop("y").columns,
+                    Column("y", y, ColType.CAT, ["0", "1"])])
+
+        common = dict(response_column="y", nfolds=3,
+                      keep_cross_validation_predictions=True, seed=11)
+        glm = GLM(family="binomial", **common).train(fr)
+        gbm = GBM(ntrees=20, max_depth=3, **common).train(fr)
+
+        se = StackedEnsemble(base_models=[glm, gbm], response_column="y",
+                             seed=11).train(fr)
+        auc_se = se.training_metrics.auc
+        auc_base = max(glm.training_metrics.auc, gbm.training_metrics.auc)
+        assert auc_se > 0.5
+        assert auc_se >= auc_base - 0.05
+
+        preds = se.predict(fr)
+        assert preds.nrows == n
+        assert "predict" in preds.names
+
+    def test_requires_cv_predictions(self, rng):
+        from h2o3_tpu.models.glm import GLM
+        from h2o3_tpu.models.stacked_ensemble import StackedEnsemble
+
+        n = 100
+        fr = Frame.from_dict({"x": rng.normal(size=n), "y": rng.normal(size=n)})
+        glm = GLM(response_column="y", family="gaussian").train(fr)
+        with pytest.raises(ValueError, match="holdout"):
+            StackedEnsemble(base_models=[glm], response_column="y").train(fr)

@@ -1,0 +1,187 @@
+"""Breadth round 3: GAM, GLRM, CoxPH (SURVEY.md §2.2)."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu import Frame
+from h2o3_tpu.frame.frame import ColType, Column
+
+
+class TestGAM:
+    def test_recovers_nonlinear_effect(self, rng):
+        from h2o3_tpu.models.gam import GAM
+
+        n = 1200
+        x = rng.uniform(-3, 3, size=n)
+        z = rng.normal(size=n)
+        y = np.sin(x) + 0.5 * z + rng.normal(size=n) * 0.1
+        fr = Frame.from_dict({"x": x, "z": z, "y": y})
+        m = GAM(response_column="y", gam_columns=["x"], num_knots=10,
+                family="gaussian", scale=0.1, seed=1).train(fr)
+        pred = m.predict(fr).col("predict").numeric_view()
+        resid = y - pred
+        # a linear model can't do better than sd(sin residual) ~ .45; GAM should
+        assert resid.std() < 0.2
+        # r2 via metrics
+        assert m.training_metrics.r2 > 0.95
+
+    def test_gam_binomial(self, rng):
+        from h2o3_tpu.models.gam import GAM
+
+        n = 1500
+        x = rng.uniform(-3, 3, size=n)
+        p_true = 1 / (1 + np.exp(-2 * np.sin(x)))
+        y = (rng.random(n) < p_true).astype(np.int32)
+        fr = Frame([
+            Column("x", x, ColType.NUM),
+            Column("y", y, ColType.CAT, ["0", "1"]),
+        ])
+        m = GAM(response_column="y", gam_columns=["x"], num_knots=8,
+                family="binomial", scale=0.01, seed=1).train(fr)
+        assert m.training_metrics.auc > 0.75
+
+    def test_smoothing_scale_shrinks_wiggle(self, rng):
+        from h2o3_tpu.models.gam import GAM
+
+        n = 400
+        x = rng.uniform(-3, 3, size=n)
+        y = np.sin(3 * x) + rng.normal(size=n) * 0.3
+        fr = Frame.from_dict({"x": x, "y": y})
+        loose = GAM(response_column="y", gam_columns=["x"], num_knots=12,
+                    scale=1e-4, seed=1).train(fr)
+        stiff = GAM(response_column="y", gam_columns=["x"], num_knots=12,
+                    scale=1e4, seed=1).train(fr)
+        # heavy smoothing -> worse training fit (approaches a line)
+        assert stiff.training_metrics.mse > loose.training_metrics.mse
+
+    def test_requires_gam_columns(self, rng):
+        from h2o3_tpu.models.gam import GAM
+
+        fr = Frame.from_dict({"x": rng.normal(size=30), "y": rng.normal(size=30)})
+        with pytest.raises(ValueError, match="gam_columns"):
+            GAM(response_column="y").train(fr)
+
+
+class TestGLRM:
+    def test_low_rank_recovery(self, rng):
+        from h2o3_tpu.models.glrm import GLRM
+
+        n, p, k = 300, 10, 3
+        Xtrue = rng.normal(size=(n, k))
+        Ytrue = rng.normal(size=(k, p))
+        A = Xtrue @ Ytrue + rng.normal(size=(n, p)) * 0.01
+        fr = Frame.from_dict({f"c{j}": A[:, j] for j in range(p)})
+        m = GLRM(k=k, max_iterations=100, seed=1).train(fr)
+        R = m.x_factors @ m.archetypes
+        rel = np.linalg.norm(R - A) / np.linalg.norm(A)
+        assert rel < 0.05
+        assert m.archetypes.shape == (k, p)
+
+    def test_missing_value_imputation(self, rng):
+        from h2o3_tpu.models.glrm import GLRM
+
+        n, p, k = 200, 8, 2
+        A = rng.normal(size=(n, k)) @ rng.normal(size=(k, p))
+        Aobs = A.copy()
+        holes = rng.random(A.shape) < 0.15
+        Aobs[holes] = np.nan
+        fr = Frame.from_dict({f"c{j}": Aobs[:, j] for j in range(p)})
+        m = GLRM(k=k, max_iterations=150, seed=1).train(fr)
+        R = m.x_factors @ m.archetypes
+        # reconstruction should approximate the TRUE values in the holes
+        err = np.abs(R[holes] - A[holes]).mean()
+        scale = np.abs(A).mean()
+        assert err < 0.2 * scale
+
+    def test_nonneg_regularization(self, rng):
+        from h2o3_tpu.models.glrm import GLRM
+
+        W = np.abs(rng.normal(size=(100, 2)))
+        H = np.abs(rng.normal(size=(2, 6)))
+        A = W @ H
+        fr = Frame.from_dict({f"c{j}": A[:, j] for j in range(6)})
+        m = GLRM(k=2, regularization_x="non_negative", regularization_y="non_negative",
+                 init="random", max_iterations=200, seed=3).train(fr)
+        assert (m.x_factors >= 0).all()
+        assert (m.archetypes >= 0).all()
+
+    def test_transform_new_frame(self, rng):
+        from h2o3_tpu.models.glrm import GLRM
+
+        A = rng.normal(size=(120, 5))
+        fr = Frame.from_dict({f"c{j}": A[:, j] for j in range(5)})
+        m = GLRM(k=2, seed=1).train(fr)
+        xf = m.transform_frame(fr)
+        assert xf.shape == (120, 2)
+        assert xf.names == ["Arch1", "Arch2"]
+
+
+def _naive_cox_nll(beta, X, t, d, ties="breslow"):
+    """Independent O(n^2) negative partial log-likelihood oracle."""
+    eta = X @ beta
+    r = np.exp(eta)
+    ll = 0.0
+    for ti in np.unique(t[d > 0]):
+        ev = (t == ti) & (d > 0)
+        risk = t >= ti
+        ll += eta[ev].sum() - ev.sum() * np.log(r[risk].sum())
+    return -ll
+
+
+class TestCoxPH:
+    def _sim(self, rng, n=500, beta=(0.8, -0.5)):
+        X = rng.normal(size=(n, len(beta)))
+        lam = np.exp(X @ np.array(beta))
+        t_event = rng.exponential(1.0 / lam)
+        t_cens = rng.exponential(2.0, size=n)
+        t = np.minimum(t_event, t_cens)
+        d = (t_event <= t_cens).astype(np.float64)
+        return X, t, d
+
+    def test_matches_naive_breslow_oracle(self, rng):
+        from scipy.optimize import minimize
+
+        from h2o3_tpu.models.coxph import CoxPH
+
+        X, t, d = self._sim(rng, n=300)
+        fr = Frame.from_dict({"x0": X[:, 0], "x1": X[:, 1], "time": t, "event": d})
+        m = CoxPH(response_column="event", stop_column="time", ties="breslow").train(fr)
+
+        res = minimize(_naive_cox_nll, np.zeros(2), args=(X, t, d), method="BFGS")
+        ours = np.array([m.coefficients["x0"], m.coefficients["x1"]])
+        assert np.allclose(ours, res.x, atol=2e-3)
+
+    def test_recovers_hazard_ratio(self, rng):
+        from h2o3_tpu.models.coxph import CoxPH
+
+        X, t, d = self._sim(rng, n=2000, beta=(1.0, 0.0))
+        fr = Frame.from_dict({"x0": X[:, 0], "x1": X[:, 1], "time": t, "event": d})
+        m = CoxPH(response_column="event", stop_column="time").train(fr)
+        assert abs(m.coefficients["x0"] - 1.0) < 0.15
+        assert abs(m.coefficients["x1"]) < 0.15
+        assert m.concordance > 0.65
+        assert m.loglik > m.loglik_null
+
+    def test_efron_handles_ties(self, rng):
+        from h2o3_tpu.models.coxph import CoxPH
+
+        X, t, d = self._sim(rng, n=400)
+        t = np.round(t, 1)  # induce heavy ties
+        fr = Frame.from_dict({"x0": X[:, 0], "x1": X[:, 1], "time": t, "event": d})
+        me = CoxPH(response_column="event", stop_column="time", ties="efron").train(fr)
+        mb = CoxPH(response_column="event", stop_column="time", ties="breslow").train(fr)
+        # both sane, efron != breslow under ties but close
+        for m in (me, mb):
+            assert np.isfinite(list(m.coefficients.values())).all()
+        diff = abs(me.coefficients["x0"] - mb.coefficients["x0"])
+        assert 0 < diff < 0.2
+
+    def test_se_and_z(self, rng):
+        from h2o3_tpu.models.coxph import CoxPH
+
+        X, t, d = self._sim(rng, n=800, beta=(1.0, 0.0))
+        fr = Frame.from_dict({"x0": X[:, 0], "x1": X[:, 1], "time": t, "event": d})
+        m = CoxPH(response_column="event", stop_column="time").train(fr)
+        assert m.std_errors["x0"] > 0
+        assert abs(m.z_values["x0"]) > 2  # strong true effect
+        assert abs(m.z_values["x1"]) < 2  # null effect

@@ -1,0 +1,139 @@
+"""Breadth round 4: Word2Vec, RuleFit, PSVM (SURVEY.md §2.2)."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu import Frame
+from h2o3_tpu.frame.frame import ColType, Column
+
+
+def _word_corpus(rng, n_sent=300):
+    """Two topic clusters: fruit words co-occur; tool words co-occur."""
+    fruit = ["apple", "banana", "cherry", "grape", "melon"]
+    tools = ["hammer", "wrench", "drill", "saw", "pliers"]
+    words = []
+    for _ in range(n_sent):
+        topic = fruit if rng.random() < 0.5 else tools
+        for _ in range(rng.integers(4, 9)):
+            words.append(topic[rng.integers(0, len(topic))])
+        words.append(None)  # sentence separator
+    return Frame([Column("words", np.array(words, dtype=object), ColType.STR)])
+
+
+class TestWord2Vec:
+    def test_topic_words_cluster(self, rng):
+        from h2o3_tpu.models.word2vec import Word2Vec
+
+        fr = _word_corpus(rng)
+        m = Word2Vec(vec_size=16, window_size=3, epochs=20, min_word_freq=2,
+                     negative_samples=4, sent_sample_rate=0.0, batch_size=256,
+                     init_learning_rate=0.5, seed=7).train(fr)
+        assert m.vectors.shape[0] == 10
+        syn = m.find_synonyms("apple", count=4)
+        fruit = {"banana", "cherry", "grape", "melon"}
+        # at least 3 of the 4 nearest neighbours are fruit
+        assert len(fruit & set(syn)) >= 3
+
+    def test_transform_average(self, rng):
+        from h2o3_tpu.models.word2vec import Word2Vec
+
+        fr = _word_corpus(rng, n_sent=100)
+        m = Word2Vec(vec_size=8, epochs=3, min_word_freq=1, seed=1).train(fr)
+        out = m.transform(fr, aggregate_method="average")
+        assert out.ncols == 8
+        assert out.nrows == 100  # one vector per sentence
+        assert np.isfinite(out.to_numpy()).all()
+
+    def test_unknown_word(self, rng):
+        from h2o3_tpu.models.word2vec import Word2Vec
+
+        fr = _word_corpus(rng, n_sent=50)
+        m = Word2Vec(vec_size=8, epochs=2, min_word_freq=1, seed=1).train(fr)
+        assert m.word_vector("zebra") is None
+        assert m.find_synonyms("zebra") == {}
+
+
+class TestRuleFit:
+    def test_finds_threshold_rule(self, rng):
+        from h2o3_tpu.models.rulefit import RuleFit
+
+        n = 1000
+        x1 = rng.uniform(0, 10, n)
+        x2 = rng.normal(size=n)
+        y = ((x1 > 5) & (x2 > 0)).astype(np.int32)
+        # flip a little noise
+        flip = rng.random(n) < 0.02
+        y = np.where(flip, 1 - y, y)
+        fr = Frame([
+            Column("x1", x1, ColType.NUM),
+            Column("x2", x2, ColType.NUM),
+            Column("y", y, ColType.CAT, ["0", "1"]),
+        ])
+        m = RuleFit(response_column="y", min_rule_length=2, max_rule_length=3,
+                    rule_generation_ntrees=20, model_type="rules", seed=5).train(fr)
+        assert m.training_metrics.auc > 0.95
+        assert len(m.rule_importance) > 0
+        top = m.rule_importance[0]
+        assert "rule" in top and top["coefficient"] != 0.0
+
+    def test_linear_only(self, rng):
+        from h2o3_tpu.models.rulefit import RuleFit
+
+        n = 400
+        x = rng.normal(size=n)
+        y = 2.0 * x + rng.normal(size=n) * 0.1
+        fr = Frame.from_dict({"x": x, "y": y})
+        m = RuleFit(response_column="y", model_type="linear", seed=1).train(fr)
+        assert m.training_metrics.r2 > 0.95
+        assert all(v["variable"].startswith("linear_") for v in m.rule_importance)
+
+    def test_predict_shape(self, rng):
+        from h2o3_tpu.models.rulefit import RuleFit
+
+        n = 300
+        fr = Frame.from_dict({
+            "a": rng.normal(size=n), "b": rng.normal(size=n),
+            "y": rng.normal(size=n),
+        })
+        m = RuleFit(response_column="y", rule_generation_ntrees=10,
+                    min_rule_length=2, max_rule_length=2, seed=1).train(fr)
+        assert m.predict(fr).nrows == n
+
+
+class TestPSVM:
+    def test_matches_sklearn_svc_predictions(self, rng):
+        from sklearn.svm import SVC
+
+        from h2o3_tpu.models.psvm import PSVM
+
+        n = 400
+        X = rng.normal(size=(n, 2))
+        y = ((X[:, 0] ** 2 + X[:, 1] ** 2) > 1.2).astype(np.int32)  # ring: needs RBF
+        fr = Frame([
+            Column("x0", X[:, 0], ColType.NUM),
+            Column("x1", X[:, 1], ColType.NUM),
+            Column("y", y, ColType.CAT, ["0", "1"]),
+        ])
+        m = PSVM(response_column="y", hyper_param=1.0, gamma=0.5,
+                 rank_ratio=0.5, max_iterations=2000, seed=1).train(fr)
+
+        # sklearn oracle on the standardized features PSVM actually used
+        Xs = (X - X.mean(0)) / X.std(0, ddof=1)
+        skl = SVC(C=1.0, gamma=0.5).fit(Xs, y)
+        ours = (m.decision_function(fr) > 0).astype(np.int32)
+        agree = (ours == skl.predict(Xs)).mean()
+        assert agree > 0.95
+        assert m.svs_count > 0
+        assert m.training_metrics.auc > 0.95
+
+    def test_requires_binary(self, rng):
+        from h2o3_tpu.models.psvm import PSVM
+
+        n = 60
+        fr = Frame([
+            Column("x", rng.normal(size=n), ColType.NUM),
+            Column("y", rng.integers(0, 3, n).astype(np.int32), ColType.CAT,
+                   ["a", "b", "c"]),
+        ])
+        with pytest.raises(ValueError, match="binary"):
+            PSVM(response_column="y").train(fr)
