@@ -21,9 +21,6 @@ from typing import List, Optional
 
 import numpy as np
 
-import jax
-import jax.numpy as jnp
-
 from h2o3_tpu.frame.frame import ColType, Column, Frame
 from h2o3_tpu.models.data_info import build_data_info, expand_matrix
 from h2o3_tpu.models.framework import Model, ModelBuilder, ModelParameters
@@ -37,14 +34,35 @@ class AggregatorParameters(ModelParameters):
     batch_size: int = 65536
 
 
-@jax.jit
-def _dist2(B, E):
-    """Squared euclidean distances [nb, ne] via the matmul expansion."""
+def _dist2(B: np.ndarray, E: np.ndarray) -> np.ndarray:
+    """Squared euclidean distances [nb, ne] via the matmul expansion.
+
+    Plain numpy on purpose: the exemplar count changes every batch, so a
+    jitted version would recompile per batch and compile time would dominate.
+    """
     return (
-        jnp.sum(B * B, axis=1, keepdims=True)
+        (B * B).sum(axis=1, keepdims=True)
         - 2.0 * B @ E.T
-        + jnp.sum(E * E, axis=1)[None, :]
+        + (E * E).sum(axis=1)[None, :]
     )
+
+
+class _ExemplarBuffer:
+    """Capacity-doubling [cap, d] float32 buffer (amortized O(1) append)."""
+
+    def __init__(self, d: int, cap: int = 1024) -> None:
+        self._buf = np.zeros((cap, d), dtype=np.float32)
+        self.n = 0
+
+    def append(self, x: np.ndarray) -> None:
+        if self.n == len(self._buf):
+            self._buf = np.concatenate([self._buf, np.zeros_like(self._buf)])
+        self._buf[self.n] = x
+        self.n += 1
+
+    @property
+    def view(self) -> np.ndarray:
+        return self._buf[: self.n]
 
 
 class AggregatorModel(Model):
@@ -88,16 +106,15 @@ class Aggregator(ModelBuilder):
         hi_cap = target * (1.0 + p.rel_tol_num_exemplars)
         radius2 = 0.0  # start exact: every distinct row is an exemplar until overshoot
         ex_idx: List[int] = []
-        ex_pts: List[np.ndarray] = []
         counts: List[float] = []
 
-        Emat = np.zeros((0, d), dtype=np.float32)
+        buf = _ExemplarBuffer(d)
         for start in range(0, n, p.batch_size):
             B = X[start : start + p.batch_size]
             covered = np.zeros(len(B), dtype=bool)
             assign = np.zeros(len(B), dtype=np.int64)
-            if len(ex_pts):
-                d2 = np.asarray(_dist2(jnp.asarray(B), jnp.asarray(Emat)))
+            if buf.n:
+                d2 = _dist2(B, buf.view)
                 j = d2.argmin(axis=1)
                 m = d2[np.arange(len(B)), j] <= radius2
                 covered, assign = m, j
@@ -105,20 +122,19 @@ class Aggregator(ModelBuilder):
                 counts[k] += float(c)
             for bi in np.nonzero(~covered)[0]:
                 x = B[bi]
-                if ex_pts:
-                    d2x = ((Emat - x) ** 2).sum(axis=1)
+                if buf.n:
+                    d2x = ((buf.view - x) ** 2).sum(axis=1)
                     k = int(d2x.argmin())
                     if d2x[k] <= radius2:
                         counts[k] += 1.0
                         continue
                 ex_idx.append(start + int(bi))
-                ex_pts.append(x)
                 counts.append(1.0)
-                Emat = np.vstack([Emat, x[None, :]])
-                if len(ex_pts) > hi_cap:
+                buf.append(x)
+                if buf.n > hi_cap:
                     radius2 = _grow_radius(radius2, X)
-                    ex_idx, ex_pts, counts, Emat = _reaggregate(
-                        ex_idx, Emat, counts, radius2
+                    ex_idx, counts, buf = _reaggregate(
+                        ex_idx, buf, counts, radius2
                     )
             if self.job:
                 self.job.update(min(1.0, (start + len(B)) / n))
@@ -140,22 +156,20 @@ def _grow_radius(radius2: float, X: np.ndarray) -> float:
     return radius2 * 2.0
 
 
-def _reaggregate(ex_idx, Emat, counts, radius2):
+def _reaggregate(ex_idx, buf: "_ExemplarBuffer", counts, radius2):
     """Merge exemplars that now fall within the grown radius of an earlier one."""
     keep_idx: List[int] = []
-    keep_pts: List[np.ndarray] = []
     keep_counts: List[float] = []
-    K = np.zeros((0, Emat.shape[1]), dtype=np.float32)
+    kept = _ExemplarBuffer(buf.view.shape[1])
     for i in range(len(ex_idx)):
-        x = Emat[i]
-        if len(keep_pts):
-            d2 = ((K - x) ** 2).sum(axis=1)
+        x = buf.view[i]
+        if kept.n:
+            d2 = ((kept.view - x) ** 2).sum(axis=1)
             k = int(d2.argmin())
             if d2[k] <= radius2:
                 keep_counts[k] += counts[i]
                 continue
         keep_idx.append(ex_idx[i])
-        keep_pts.append(x)
         keep_counts.append(counts[i])
-        K = np.vstack([K, x[None, :]])
-    return keep_idx, keep_pts, keep_counts, K
+        kept.append(x)
+    return keep_idx, keep_counts, kept

@@ -39,14 +39,23 @@ class CoxPHParameters(ModelParameters):
     lre_min: float = 9.0  # log-relative-error convergence (reference default)
 
 
-@partial(jax.jit, static_argnames=("efron",))
-def _partial_stats(Xs, ws, ds, group_start, group_size, efron: bool, beta):
+@partial(jax.jit, static_argnames=("efron", "truncated"))
+def _partial_stats(
+    Xs, ws, ds, group_start, group_size, efron: bool, beta,
+    Xe=None, we=None, m=None, truncated: bool = False,
+):
     """Gradient / Hessian / loglik of the partial likelihood.
 
     Inputs are sorted by descending stop time so the risk set at event time t
     is a *prefix*; rows of one tied event time form a contiguous group.
     Xs [N,P], ws [N] weights, ds [N] event indicator, group_start/size [G]
     aligned to event-time groups (G = distinct event times with >=1 event).
+
+    Left truncation (counting-process (start, stop] data, reference
+    ``hex/coxph/CoxPH.java`` start_column): a row is at risk at event time t
+    iff start < t <= stop.  The prefix over descending-stop rows counts
+    {stop >= t}; ``Xe/we`` are the same rows sorted by DESCENDING start and
+    ``m[g]`` = #rows with start >= t_g, whose aggregates are subtracted.
     """
     eta = Xs @ beta
     r = ws * jnp.exp(eta)  # risk contributions
@@ -60,6 +69,18 @@ def _partial_stats(Xs, ws, ds, group_start, group_size, efron: bool, beta):
     S0 = c0[end]
     S1 = c1[end]
     S2 = cxx[end]
+
+    if truncated:
+        re = we * jnp.exp(Xe @ beta)
+        rex = re[:, None] * Xe
+        a0 = jnp.cumsum(re)
+        a1 = jnp.cumsum(rex, axis=0)
+        a2 = jnp.cumsum(rex[:, :, None] * Xe[:, None, :], axis=0)
+        has = m > 0
+        idx = jnp.maximum(m - 1, 0)
+        S0 = S0 - jnp.where(has, a0[idx], 0.0)
+        S1 = S1 - jnp.where(has[:, None], a1[idx], 0.0)
+        S2 = S2 - jnp.where(has[:, None, None], a2[idx], 0.0)
 
     # per-group sums over *events* (tied deaths) in the group
     ev_w = ws * ds
@@ -190,8 +211,16 @@ class CoxPH(ModelBuilder):
             frame.col(p.weights_column).numeric_view().astype(np.float64)
             if p.weights_column else np.ones(frame.nrows)
         )
+        s = (
+            frame.col(p.start_column).numeric_view().astype(np.float64)
+            if p.start_column else None
+        )
         keep = ~(skip | np.isnan(y) | np.isnan(t))
+        if s is not None:
+            keep &= ~np.isnan(s) & (s < t)  # (start, stop] intervals only
         X, y, t, w = X[keep], y[keep], t[keep], w[keep]
+        if s is not None:
+            s = s[keep]
         n, P = X.shape
         model.n_events = int((y > 0).sum())
 
@@ -222,11 +251,27 @@ class CoxPH(ModelBuilder):
         Xj, wj, dj = jnp.asarray(Xs), jnp.asarray(ws), jnp.asarray(ds)
         efron = p.ties == "efron"
 
+        # left truncation: rows sorted by descending start; m[g] = #rows whose
+        # start >= the group's event time (they have not yet entered the study)
+        trunc_kw = dict(truncated=False)
+        if s is not None:
+            e_order = np.argsort(-s, kind="stable")
+            s_desc = s[e_order]
+            group_times = ts[np.array(starts, dtype=np.int64)] if starts else np.array([])
+            # count of start >= t_g in the descending start array
+            m = np.searchsorted(-s_desc, -group_times, side="right").astype(np.int32)
+            trunc_kw = dict(
+                Xe=jnp.asarray(Xc[e_order]),
+                we=jnp.asarray(w[e_order]),
+                m=jnp.asarray(m),
+                truncated=True,
+            )
+
         beta = np.zeros(P)
         ll0 = None
         prev_ll = -np.inf
         for it in range(p.max_iterations):
-            ll, grad, hess = _partial_stats(Xj, wj, dj, gs, gz, efron, jnp.asarray(beta))
+            ll, grad, hess = _partial_stats(Xj, wj, dj, gs, gz, efron, jnp.asarray(beta), **trunc_kw)
             ll = float(ll)
             g = np.asarray(grad)
             H = np.asarray(hess)  # negative definite (d²ll/dβ²)
@@ -243,7 +288,7 @@ class CoxPH(ModelBuilder):
             if lre >= p.lre_min:
                 break
 
-        ll, grad, hess = _partial_stats(Xj, wj, dj, gs, gz, efron, jnp.asarray(beta))
+        ll, grad, hess = _partial_stats(Xj, wj, dj, gs, gz, efron, jnp.asarray(beta), **trunc_kw)
         model.loglik = float(ll)
         model.loglik_null = float(ll0) if ll0 is not None else np.nan
         H = np.asarray(hess)
@@ -258,23 +303,33 @@ class CoxPH(ModelBuilder):
             k: (model.coefficients[k] / s if s > 0 else np.nan)
             for k, s in zip(names, se.tolist())
         }
-        model.concordance = _concordance(t, y, Xc @ beta)
+        model.concordance = _concordance(t, y, Xc @ beta, start=s)
         return model
 
 
-def _concordance(t: np.ndarray, d: np.ndarray, risk: np.ndarray) -> float:
+def _concordance(
+    t: np.ndarray, d: np.ndarray, risk: np.ndarray,
+    start: Optional[np.ndarray] = None,
+) -> float:
     """Harrell's C: P(higher risk → earlier event) over comparable pairs
-    (subsampled for large n — metric only, not part of the fit)."""
+    (subsampled for large n — metric only, not part of the fit).
+
+    With left truncation, a pair (i event, j) is comparable only if j was
+    at risk at t_i, i.e. start_j < t_i."""
     n = len(t)
     if n > 4000:
         rng = np.random.default_rng(0)
         idx = rng.choice(n, 4000, replace=False)
         t, d, risk = t[idx], d[idx], risk[idx]
+        if start is not None:
+            start = start[idx]
         n = 4000
     conc = ties = comp = 0.0
     ev = np.nonzero(d > 0)[0]
     for i in ev:
         later = (t > t[i]) | ((t == t[i]) & (d == 0))
+        if start is not None:
+            later &= start < t[i]
         comp += later.sum()
         conc += (risk[i] > risk[later]).sum()
         ties += (risk[i] == risk[later]).sum()

@@ -96,9 +96,9 @@ class ExtendedIsolationForestModel(Model):
     def is_classifier(self) -> bool:
         return False
 
-    def _predict_raw(self, frame: Frame) -> np.ndarray:
+    def _mean_path_lengths(self, frame: Frame) -> np.ndarray:
         X, _ = expand_matrix(self.data_info, frame, dtype=np.float32)
-        mean_len = np.asarray(
+        return np.asarray(
             _path_lengths(
                 jnp.asarray(X),
                 jnp.asarray(self.normals),
@@ -108,22 +108,14 @@ class ExtendedIsolationForestModel(Model):
                 self.depth,
             )
         ).astype(np.float64)
-        c = _c_factor(float(self.sample_size))
-        return np.power(2.0, -mean_len / c)
+
+    def _predict_raw(self, frame: Frame) -> np.ndarray:
+        mean_len = self._mean_path_lengths(frame)
+        return np.power(2.0, -mean_len / _c_factor(float(self.sample_size)))
 
     def predict(self, frame: Frame) -> Frame:
         """['anomaly_score', 'mean_length'] (ExtendedIsolationForestModel.java:33)."""
-        X, _ = expand_matrix(self.data_info, frame, dtype=np.float32)
-        mean_len = np.asarray(
-            _path_lengths(
-                jnp.asarray(X),
-                jnp.asarray(self.normals),
-                jnp.asarray(self.offsets),
-                jnp.asarray(self.is_split),
-                jnp.asarray(self.correction),
-                self.depth,
-            )
-        ).astype(np.float64)
+        mean_len = self._mean_path_lengths(frame)
         score = np.power(2.0, -mean_len / _c_factor(float(self.sample_size)))
         return Frame([
             Column("anomaly_score", score, ColType.NUM),

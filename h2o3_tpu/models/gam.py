@@ -31,6 +31,8 @@ from h2o3_tpu.models.glm import (
     _link_deriv,
     _link_of_mean,
     _linkinv,
+    _solve_admm,
+    _solve_ridge,
     _variance,
     deviance,
 )
@@ -225,7 +227,9 @@ class GAM(ModelBuilder):
         ybar = float((obs_w * y).sum() / wsum)
         beta = np.zeros(pc + 1)
         beta[-1] = _link_of_mean(link, ybar, p)
-        l2 = p.lambda_ * (1 - p.alpha) * wsum
+        # elastic net like GLM: l1 via ADMM soft-threshold, l2 via ridge
+        l1 = p.lambda_ * p.alpha
+        l2 = p.lambda_ * (1 - p.alpha)
 
         prev_obj = np.inf
         for it in range(p.max_iterations):
@@ -237,14 +241,21 @@ class GAM(ModelBuilder):
             wz = eta + (y - mu) * d
 
             G, q = _gram(Xd, pad(wz), pad(w))
-            A = G / wsum + Lam / wsum + (l2 / wsum) * np.eye(pc + 1)
-            A[-1, -1] -= l2 / wsum  # intercept unpenalized
-            A[np.arange(pc + 1), np.arange(pc + 1)] += 1e-10
-            beta_new = np.linalg.solve(A, q / wsum)
+            Gp = G / wsum + Lam / wsum  # smoothing penalty folded into Gram
+            if l1 > 0:
+                beta_new = _solve_admm(Gp, q / wsum, l1, l2, free=1)
+            else:
+                beta_new = _solve_ridge(Gp, q / wsum, l2, free=1)
 
             mu_new = _linkinv(link, X @ beta_new[:-1] + beta_new[-1], p)
             dev = float((obs_w * deviance(p.family, y, mu_new, p)).sum())
-            obj = dev / (2 * wsum) + float(beta_new @ Lam @ beta_new) / (2 * wsum)
+            bp = beta_new[:-1]  # intercept unpenalized
+            obj = (
+                dev / (2 * wsum)
+                + float(beta_new @ Lam @ beta_new) / (2 * wsum)
+                + l1 * float(np.abs(bp).sum())
+                + 0.5 * l2 * float(bp @ bp)
+            )
             delta = np.max(np.abs(beta_new - beta))
             beta = beta_new
             model.iterations = it + 1

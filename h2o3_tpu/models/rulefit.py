@@ -220,7 +220,9 @@ def _extract_rules(tree_model, info) -> List[Rule]:
                     return
                 f = int(feat[node])
                 b = int(sb[node])
-                thr = float(edges[f][min(b, edges.shape[1] - 1)])
+                # split sends bin<=b left, i.e. x <= edges[b]; b == nbins-1 is
+                # the all-non-NA-left (NA-only right) split -> threshold +inf
+                thr = float(edges[f][b]) if b < edges.shape[1] else float("inf")
                 na_l = bool(dl[node])
                 left = RuleCondition(f, names[f] if f < len(names) else f"C{f}", thr, True, na_l)
                 right = RuleCondition(f, names[f] if f < len(names) else f"C{f}", thr, False, na_l)

@@ -25,7 +25,7 @@ this module is the portable XLA path and the correctness oracle.
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional, Tuple
+from typing import Optional, Tuple  # noqa: F401
 
 import numpy as np
 
@@ -93,31 +93,74 @@ def _shard_histogram(bins, nodes, g, h, n_nodes: int, n_bins1: int):
     node = jnp.where(valid, nodes, 0)
     flat = (node[:, None] * F + jnp.arange(F, dtype=jnp.int32)[None, :]) * n_bins1 + bins
     w = valid.astype(g.dtype)
+    # channel-major layout: the long N*F axis must be the (128-)lane axis —
+    # a [N*F, 3] layout would pad 3 lanes to 128 on TPU (≈42x HBM blowup)
     vals = jnp.stack(
         [
             jnp.broadcast_to((g * w)[:, None], (n, F)),
             jnp.broadcast_to((h * w)[:, None], (n, F)),
             jnp.broadcast_to(w[:, None], (n, F)),
         ],
-        axis=-1,
-    )  # [n, F, 3]
-    hist = jnp.zeros((n_nodes * F * n_bins1, 3), g.dtype)
-    hist = hist.at[flat.reshape(-1)].add(vals.reshape(-1, 3))
-    return hist.reshape(n_nodes, F, n_bins1, 3)
+        axis=0,
+    )  # [3, n, F]
+    hist = jnp.zeros((3, n_nodes * F * n_bins1), g.dtype)
+    hist = hist.at[:, flat.reshape(-1)].add(vals.reshape(3, -1))
+    return jnp.moveaxis(hist.reshape(3, n_nodes, F, n_bins1), 0, -1)
 
 
-@partial(jax.jit, static_argnames=("n_nodes", "n_bins1", "mesh"))
-def build_histogram_sharded(bins, nodes, g, h, n_nodes: int, n_bins1: int, mesh=None):
+def _hist_impl(impl: Optional[str]) -> str:
+    """Resolve histogram implementation: Pallas MXU kernel on TPU, XLA
+    scatter elsewhere. Override with H2O3_TPU_HIST_IMPL=scatter|pallas."""
+    import os
+
+    impl = impl or os.environ.get("H2O3_TPU_HIST_IMPL") or (
+        "pallas" if jax.default_backend() == "tpu" else "scatter"
+    )
+    if impl not in ("scatter", "pallas"):
+        raise ValueError(
+            f"H2O3_TPU_HIST_IMPL must be 'scatter' or 'pallas', got {impl!r}"
+        )
+    return impl
+
+
+def _one_shard_histogram(bins, nodes, g, h, n_nodes, n_bins1, impl, vma=()):
+    if impl == "pallas":
+        from h2o3_tpu.ops.pallas_histogram import build_histogram_pallas
+
+        return build_histogram_pallas(
+            bins, nodes, g, h, n_nodes, n_bins1,
+            interpret=jax.default_backend() != "tpu", vma=vma,
+        )
+    return _shard_histogram(bins, nodes, g, h, n_nodes, n_bins1)
+
+
+def build_histogram_sharded(
+    bins, nodes, g, h, n_nodes: int, n_bins1: int, mesh=None,
+    impl: Optional[str] = None,
+):
     """Full distributed histogram: private scatter-add per shard, psum merge.
 
     bins:[N,F] int32 row-sharded; nodes:[N] int32 (-1 = inactive row);
     g,h:[N] float32. Returns replicated [n_nodes, F, n_bins1, 3].
     """
+    # resolve the env override OUTSIDE the jit cache so changing it between
+    # calls takes effect (the resolved impl is the static cache key)
+    return _build_histogram_jit(
+        bins, nodes, g, h, n_nodes, n_bins1, mesh, _hist_impl(impl)
+    )
+
+
+@partial(jax.jit, static_argnames=("n_nodes", "n_bins1", "mesh", "impl"))
+def _build_histogram_jit(
+    bins, nodes, g, h, n_nodes: int, n_bins1: int, mesh, impl: str
+):
     if mesh is None:
-        return _shard_histogram(bins, nodes, g, h, n_nodes, n_bins1)
+        return _one_shard_histogram(bins, nodes, g, h, n_nodes, n_bins1, impl)
 
     def fn(b, nd, gg, hh):
-        part = _shard_histogram(b, nd, gg, hh, n_nodes, n_bins1)
+        part = _one_shard_histogram(
+            b, nd, gg, hh, n_nodes, n_bins1, impl, vma=(DATA_AXIS,)
+        )
         return jax.lax.psum(part, DATA_AXIS)
 
     return _shard_map(

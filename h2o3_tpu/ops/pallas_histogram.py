@@ -1,0 +1,181 @@
+"""Pallas TPU kernel for the gradient-histogram hot op (``tpu_hist``).
+
+Reference semantics: ``hex/tree/DHistogram.java:433`` (updateHisto — per
+(node, feature, bin) accumulation of {Σg, Σh, Σw}) as driven by
+``hex/tree/ScoreBuildHistogram2.java:273-280`` (private per-thread
+histograms, then merge) and the native ``grow_gpu_hist`` updater in the
+XGBoost extension (SURVEY.md §2.3).
+
+TPU-native redesign — the scatter-add becomes dense MXU matmuls:
+
+1. XLA prep (per tree level): stable-sort the row ids by tree node, pad
+   each node's segment of the sorted order to a multiple of the row tile
+   ``R`` (padded rows carry zero values, so no masking is needed in the
+   kernel), and gather bins/values into that padded layout.  Per row-tile
+   scalars (its node id, and a first-tile-of-node flag) are precomputed.
+2. Pallas kernel: 1-D grid over row tiles with
+   ``pltpu.PrefetchScalarGridSpec``.  The output BlockSpec's index map
+   reads the prefetched node id, so each grid step's output block IS that
+   node's (F, C, B) histogram slab; consecutive tiles of the same node
+   revisit the same block and accumulate in VMEM.  Within a step, each
+   feature's histogram is ``one_hot(bins)ᵀ @ vals`` — a [B1, R] × [R, C]
+   contraction on the MXU instead of a serialized scatter.
+
+Total matmul work is N·F·B1·C MACs per level — independent of tree depth
+(the sort gives each row exactly one node slab), unlike a dense
+one-hot-over-(node,bin) formulation which would cost K× more.
+
+The portable XLA scatter path in ``h2o3_tpu/ops/histogram.py`` is the
+correctness oracle; ``tests/test_pallas_histogram.py`` checks parity in
+interpreter mode on CPU.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# channels: 0=Σg, 1=Σh, 2=Σw(count); a 4th pad channel keeps the matmul
+# operand lane-friendly.
+_C = 4
+
+
+def _hist_kernel(node_ref, first_ref, bins_ref, vals_ref, out_ref, *, n_feat, n_bins1):
+    """One grid step = one row tile of one node.
+
+    bins_ref: [R, F] int32 (VMEM); vals_ref: [R, C] f32 (VMEM);
+    out_ref:  [1, F, C, B1] f32 — the current node's slab (revisited across
+    consecutive tiles of the same node).
+    """
+    t = pl.program_id(0)
+    r = bins_ref.shape[0]
+    iota_b = jax.lax.broadcasted_iota(jnp.int32, (r, n_bins1), 1)
+    vals = vals_ref[:]  # [R, C]
+
+    slabs = []
+    for f in range(n_feat):
+        b = bins_ref[:, f]
+        onehot = (iota_b == b[:, None]).astype(jnp.float32)  # [R, B1]
+        # [C, B1] = valsᵀ[C, R] @ onehot[R, B1]  (contraction over rows)
+        h_f = jax.lax.dot_general(
+            vals, onehot, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        slabs.append(h_f)
+    slab = jnp.stack(slabs, axis=0)[None]  # [1, F, C, B1]
+
+    first = first_ref[t] == 1
+
+    @pl.when(first)
+    def _():
+        out_ref[...] = slab
+
+    @pl.when(jnp.logical_not(first))
+    def _():
+        out_ref[...] = out_ref[...] + slab
+
+
+def _prep_padded(bins, nodes, g, h, n_nodes: int, row_tile: int, t_max: int):
+    """Sort rows by node, pad each node segment to a row_tile multiple.
+
+    Returns (bins_p [T*R, F] int32, vals_p [T*R, C] f32,
+    item_node [T] int32 — dummy slot n_nodes for unused tiles,
+    item_first [T] int32).
+    """
+    n, _ = bins.shape
+    r = row_tile
+    total = t_max * r
+    # inactive rows (node < 0) -> dummy node n_nodes, dropped by OOB scatter
+    nd = jnp.where(nodes >= 0, nodes, n_nodes)
+    order = jnp.argsort(nd, stable=True)
+    nd_s = nd[order]
+
+    counts = jnp.bincount(nd, length=n_nodes + 1)[:n_nodes]
+    # every node gets >= 1 tile so empty nodes' slabs are zero-initialized,
+    # never left undefined
+    padded = jnp.maximum((counts + r - 1) // r, 1) * r
+    pad_off = jnp.concatenate([jnp.zeros((1,), padded.dtype), jnp.cumsum(padded)])
+    sort_off = jnp.concatenate([jnp.zeros((1,), counts.dtype), jnp.cumsum(counts)])
+
+    rank = jnp.arange(n) - sort_off[jnp.clip(nd_s, 0, n_nodes - 1)]
+    dest = jnp.where(
+        nd_s < n_nodes, pad_off[jnp.clip(nd_s, 0, n_nodes - 1)] + rank, total
+    ).astype(jnp.int32)
+
+    bins_p = jnp.zeros((total, bins.shape[1]), jnp.int32).at[dest].set(
+        bins[order].astype(jnp.int32), mode="drop"
+    )
+    w = (nodes >= 0).astype(jnp.float32)
+    vals = jnp.stack(
+        [g.astype(jnp.float32) * w, h.astype(jnp.float32) * w, w,
+         jnp.zeros_like(w)], axis=1
+    )
+    vals_p = jnp.zeros((total, _C), jnp.float32).at[dest].set(vals[order], mode="drop")
+
+    # tile t belongs to the node whose padded segment contains row t*r
+    tile_starts = jnp.arange(t_max) * r
+    item_node = jnp.searchsorted(pad_off[1:], tile_starts, side="right").astype(jnp.int32)
+    item_node = jnp.minimum(item_node, n_nodes)  # trailing unused tiles -> dummy slab
+    item_first = jnp.concatenate(
+        [jnp.ones((1,), jnp.int32),
+         (item_node[1:] != item_node[:-1]).astype(jnp.int32)]
+    )
+    return bins_p, vals_p, item_node, item_first
+
+
+@partial(
+    jax.jit,
+    static_argnames=("n_nodes", "n_bins1", "row_tile", "interpret", "vma"),
+)
+def build_histogram_pallas(
+    bins, nodes, g, h, n_nodes: int, n_bins1: int,
+    row_tile: int = 512, interpret: bool = False, vma: tuple = (),
+):
+    """Drop-in Pallas replacement for ``histogram._shard_histogram``.
+
+    bins: [N, F] int bin codes (NA bucket = n_bins1 - 1 handled upstream);
+    nodes: [N] int32 (-1 = inactive row); g, h: [N] float.
+    Returns [n_nodes, F, n_bins1, 3] float32 of (Σg, Σh, count).
+    """
+    n, n_feat = bins.shape
+    r = row_tile
+    t_max = (n + r - 1) // r + n_nodes  # ≤ R-1 pad rows per node
+
+    bins_p, vals_p, item_node, item_first = _prep_padded(
+        bins, nodes, g, h, n_nodes, r, t_max
+    )
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(t_max,),
+        in_specs=[
+            pl.BlockSpec((r, n_feat), lambda t, nref, fref: (t, 0)),
+            pl.BlockSpec((r, _C), lambda t, nref, fref: (t, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, n_feat, _C, n_bins1), lambda t, nref, fref: (nref[t], 0, 0, 0)
+        ),
+    )
+
+    out = pl.pallas_call(
+        partial(_hist_kernel, n_feat=n_feat, n_bins1=n_bins1),
+        grid_spec=grid_spec,
+        # slab n_nodes is the dummy for trailing all-pad tiles; vma marks the
+        # per-shard output as varying over the mesh axes when called inside
+        # shard_map (each shard builds its private histogram pre-psum)
+        out_shape=jax.ShapeDtypeStruct(
+            (n_nodes + 1, n_feat, _C, n_bins1), jnp.float32,
+            vma=frozenset(vma) if vma else None,
+        ),
+        interpret=interpret,
+    )(item_node, item_first, bins_p, vals_p)
+
+    # [K, F, C, B1] -> [K, F, B1, 3] to match the XLA oracle layout
+    return jnp.transpose(out[:n_nodes], (0, 1, 3, 2))[..., :3]

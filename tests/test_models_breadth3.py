@@ -185,3 +185,80 @@ class TestCoxPH:
         assert m.std_errors["x0"] > 0
         assert abs(m.z_values["x0"]) > 2  # strong true effect
         assert abs(m.z_values["x1"]) < 2  # null effect
+
+
+def _naive_cox_nll_trunc(beta, X, s, t, d):
+    """Breslow oracle with left truncation: risk set = {j: s_j < ti <= t_j}."""
+    eta = X @ beta
+    r = np.exp(eta)
+    ll = 0.0
+    for ti in np.unique(t[d > 0]):
+        ev = (t == ti) & (d > 0)
+        risk = (t >= ti) & (s < ti)
+        ll += eta[ev].sum() - ev.sum() * np.log(r[risk].sum())
+    return -ll
+
+
+class TestCoxPHLeftTruncation:
+    def test_matches_truncated_oracle(self, rng):
+        from scipy.optimize import minimize
+
+        from h2o3_tpu.models.coxph import CoxPH
+
+        n = 300
+        X = rng.normal(size=(n, 2))
+        lam = np.exp(X @ np.array([0.8, -0.5]))
+        t_event = rng.exponential(1.0 / lam)
+        s = rng.uniform(0, 0.3, size=n)  # delayed entry
+        t = s + t_event
+        d = np.ones(n)
+        fr = Frame.from_dict(
+            {"x0": X[:, 0], "x1": X[:, 1], "start": s, "time": t, "event": d}
+        )
+        m = CoxPH(
+            response_column="event", start_column="start", stop_column="time",
+            ties="breslow",
+        ).train(fr)
+        res = minimize(
+            _naive_cox_nll_trunc, np.zeros(2), args=(X, s, t, d), method="BFGS"
+        )
+        ours = np.array([m.coefficients["x0"], m.coefficients["x1"]])
+        assert np.allclose(ours, res.x, atol=2e-3)
+
+    def test_truncation_changes_fit(self, rng):
+        from h2o3_tpu.models.coxph import CoxPH
+
+        n = 400
+        X = rng.normal(size=(n, 1))
+        lam = np.exp(0.9 * X[:, 0])
+        t_event = rng.exponential(1.0 / lam)
+        s = rng.uniform(0, 1.0, size=n)
+        t = s + t_event
+        d = np.ones(n)
+        fr = Frame.from_dict({"x0": X[:, 0], "start": s, "time": t, "event": d})
+        m_t = CoxPH(
+            response_column="event", start_column="start", stop_column="time"
+        ).train(fr)
+        m_n = CoxPH(response_column="event", stop_column="time").train(fr)
+        assert m_t.coefficients["x0"] != m_n.coefficients["x0"]
+        # truncated fit should be closer to truth on entry-biased data
+        assert abs(m_t.coefficients["x0"] - 0.9) < abs(m_n.coefficients["x0"] - 0.9) + 0.05
+
+
+class TestGAMElasticNet:
+    def test_l1_shrinks_noise_coefs(self, rng):
+        from h2o3_tpu.models.gam import GAM
+
+        n = 800
+        x = rng.uniform(-3, 3, size=n)
+        noise = {f"n{i}": rng.normal(size=n) for i in range(4)}
+        y = np.sin(x) + rng.normal(size=n) * 0.1
+        fr = Frame.from_dict({"x": x, **noise, "y": y})
+        kw = dict(response_column="y", gam_columns=["x"], num_knots=8,
+                  family="gaussian", scale=0.1, seed=1)
+        m0 = GAM(lambda_=0.0, **kw).train(fr)
+        m1 = GAM(lambda_=0.5, alpha=1.0, **kw).train(fr)  # pure LASSO
+        c0 = np.array([m0.coefficients[f"n{i}"] for i in range(4)])
+        c1 = np.array([m1.coefficients[f"n{i}"] for i in range(4)])
+        # L1 must actually penalize: noise coefs collapse toward zero
+        assert np.abs(c1).sum() < 0.2 * np.abs(c0).sum() + 1e-6
