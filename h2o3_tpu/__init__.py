@@ -17,10 +17,6 @@ all of it (SURVEY.md §5 "Distributed communication backend").
 
 __version__ = "0.1.0"
 
-from h2o3_tpu.frame.frame import Frame, Column, ColType
-from h2o3_tpu.frame.parse import parse_csv, parse_setup
-from h2o3_tpu.keyed import KeyedStore, DKV
-
 __all__ = [
     "Frame",
     "Column",
@@ -30,3 +26,29 @@ __all__ = [
     "KeyedStore",
     "DKV",
 ]
+
+_LAZY = {
+    "Frame": ("h2o3_tpu.frame.frame", "Frame"),
+    "Column": ("h2o3_tpu.frame.frame", "Column"),
+    "ColType": ("h2o3_tpu.frame.frame", "ColType"),
+    "parse_csv": ("h2o3_tpu.frame.parse", "parse_csv"),
+    "parse_setup": ("h2o3_tpu.frame.parse", "parse_setup"),
+    "KeyedStore": ("h2o3_tpu.keyed", "KeyedStore"),
+    "DKV": ("h2o3_tpu.keyed", "DKV"),
+}
+
+
+def __getattr__(name):
+    """Lazy top-level exports (PEP 562) so the numpy-only
+    ``h2o3_tpu.genmodel`` scoring package can be imported without pulling
+    in jax (the reference ships genmodel as a dependency-light jar for the
+    same reason, SURVEY.md §2.6)."""
+    try:
+        mod_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    val = getattr(importlib.import_module(mod_name), attr)
+    globals()[name] = val
+    return val

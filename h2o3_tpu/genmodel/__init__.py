@@ -1,0 +1,20 @@
+"""h2o3_tpu.genmodel — dependency-light offline scoring (numpy only).
+
+Reference: ``h2o-genmodel/`` (21.5k LoC, SURVEY.md §2.6) — the
+standalone-jar scoring path: ``MojoModel.load``, per-algo readers in
+``h2o-genmodel/.../algos/``, and the row-wise
+``EasyPredictModelWrapper`` API.
+
+This package deliberately does NOT import jax or the training stack: a
+production scorer needs numpy alone, mirroring the reference's
+"dependency-light" genmodel jar. MOJO files written by
+``h2o3_tpu.models.mojo_export`` (zip of model.ini + data_info.json +
+meta.json + arrays.npz — same structure as the reference's
+``model.ini`` + per-algo binary blobs, hex/ModelMojoWriter.java:65-77,
+though not byte-compatible with Java H2O).
+"""
+
+from h2o3_tpu.genmodel.mojo_model import MojoModel, load_mojo
+from h2o3_tpu.genmodel.easy import EasyPredictModelWrapper
+
+__all__ = ["MojoModel", "load_mojo", "EasyPredictModelWrapper"]

@@ -168,6 +168,13 @@ class Model:
             y.astype(np.int64), raw, self.data_info.response_domain, weights=w
         )
 
+    def download_mojo(self, path: str) -> str:
+        """Export as a portable MOJO zip (Model.getMojo, /3/Models .../mojo);
+        scored offline by the numpy-only ``h2o3_tpu.genmodel`` package."""
+        from h2o3_tpu.models.mojo_export import write_mojo
+
+        return write_mojo(self, path)
+
     def __repr__(self) -> str:
         return f"<{type(self).__name__} {self.key} metrics={self.training_metrics!r}>"
 
