@@ -1,0 +1,16 @@
+"""REST API v3 — the wire surface clients speak.
+
+Reference: ``water/api/`` (~25k LoC: RequestServer route registry +
+dispatch, RequestServer.java:56-80,241; 125 v3 endpoints registered in
+RegisterV3Api.java; schema/handler pattern under api/schemas3/), served by
+the ``h2o-webserver-iface`` facade over Jetty.
+
+TPU-native: a threaded stdlib HTTP server (the cluster control plane is
+host-side Python; device compute stays in jitted programs), the same
+versioned route layout (/3/..., /99/Rapids), JSON responses shaped like the
+reference's schema objects so h2o-py-style clients port over.
+"""
+
+from h2o3_tpu.api.server import H2OServer, start_server
+
+__all__ = ["H2OServer", "start_server"]

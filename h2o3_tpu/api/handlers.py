@@ -1,0 +1,668 @@
+"""v3 endpoint implementations.
+
+Reference: ``water/api/RegisterV3Api.java`` route inventory (SURVEY.md
+Appendix B) and the per-group handlers (``FramesHandler``,
+``ParseHandler``, ``ModelBuilderHandler``, ``RapidsHandler``,
+``JobsHandler``, ``GridSearchHandler``, ``CloudHandler`` ...).  Response
+shapes follow the ``api/schemas3`` objects (FrameV3, ModelSchemaV3, JobV3,
+CloudV3, H2OErrorV3) closely enough for thin clients to port.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import json
+import os
+import tempfile
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from h2o3_tpu import __version__
+from h2o3_tpu.api.registry import algo_map
+from h2o3_tpu.api.server import H2OServer, RequestServer, RestError
+from h2o3_tpu.frame.frame import ColType, Column, Frame
+from h2o3_tpu.frame.parse import parse_csv, parse_setup
+from h2o3_tpu.keyed import DKV
+from h2o3_tpu.models.framework import Job, Model
+from h2o3_tpu.models.grid import Grid, GridSearch, SearchCriteria
+from h2o3_tpu.rapids import Session, exec_rapids
+
+
+class _RawFile:
+    """An imported-but-unparsed source (reference: raw ByteVec under a key)."""
+
+    def __init__(self, path: str, text: str) -> None:
+        self.path = path
+        self.text = text
+
+
+_SESSIONS: Dict[str, Session] = {}
+
+
+# ---------------------------------------------------------------------------
+# helpers
+
+
+def _get_frame(frame_id: str) -> Frame:
+    fr = DKV.get(frame_id)
+    if not isinstance(fr, Frame):
+        raise RestError(404, f"frame {frame_id!r} not found")
+    return fr
+
+
+def _get_model(model_id: str) -> Model:
+    m = DKV.get(model_id)
+    if not isinstance(m, Model):
+        raise RestError(404, f"model {model_id!r} not found")
+    return m
+
+
+def _frame_schema(fr: Frame, key: str, rows: int = 10) -> Dict[str, Any]:
+    """FrameV3 / FrameBaseV3 (api/schemas3/FrameV3.java)."""
+    cols = []
+    for c in fr.columns:
+        r = c.rollups if c.type in (ColType.NUM, ColType.TIME, ColType.CAT) else None
+        head = c.data[:rows]
+        if c.type is ColType.CAT:
+            data = [c.domain[v] if v >= 0 else None for v in head]
+        elif c.type is ColType.STR:
+            data = [None if v is None else str(v) for v in head]
+        else:
+            data = [None if np.isnan(v) else float(v) for v in head]
+        cols.append(
+            {
+                "label": c.name,
+                "type": c.type.name.lower(),
+                "domain": c.domain,
+                "domain_cardinality": len(c.domain) if c.domain else 0,
+                "missing_count": int(r.na_count) if r else int(c.na_count()),
+                "mins": [r.min] if r else [],
+                "maxs": [r.max] if r else [],
+                "mean": r.mean if r else None,
+                "sigma": r.sigma if r else None,
+                "data": data,
+            }
+        )
+    return {
+        "frame_id": {"name": key},
+        "rows": fr.nrows,
+        "num_columns": fr.ncols,
+        "column_names": fr.names,
+        "columns": cols,
+    }
+
+
+def _job_schema(job: Job) -> Dict[str, Any]:
+    """JobV3 (api/schemas3/JobV3.java)."""
+    return {
+        "key": {"name": job.key},
+        "description": job.description,
+        "status": job.status,
+        "progress": job.progress,
+        "msec": int(job.run_time * 1000),
+        "exception": str(job.exception) if job.exception else None,
+        "dest": getattr(job, "dest", None),
+    }
+
+
+def _metrics_schema(mm: Any) -> Optional[Dict[str, Any]]:
+    if mm is None:
+        return None
+    out = {}
+    for k in (
+        "mse rmse mae rmsle r2 mean_residual_deviance auc pr_auc gini logloss "
+        "mean_per_class_error max_f1_threshold nobs"
+    ).split():
+        v = getattr(mm, k, None)
+        if v is not None and np.isscalar(v):
+            out[k] = None if isinstance(v, float) and np.isnan(v) else v
+    return out
+
+
+def _model_schema(m: Model) -> Dict[str, Any]:
+    """ModelSchemaV3: model_id + algo + parameters + output."""
+    params = {}
+    for f in dataclasses.fields(m.params):
+        v = getattr(m.params, f.name)
+        if isinstance(v, (int, float, str, bool, type(None), list)):
+            params[f.name] = v
+    output: Dict[str, Any] = {
+        "model_category": (
+            "Binomial" if m.nclasses == 2 else
+            "Multinomial" if m.nclasses > 2 else "Regression"
+        ),
+        "training_metrics": _metrics_schema(m.training_metrics),
+        "validation_metrics": _metrics_schema(m.validation_metrics),
+        "cross_validation_metrics": _metrics_schema(m.cross_validation_metrics),
+        "names": list(m.data_info.predictor_names),
+        "domains": m.data_info.response_domain,
+        "run_time": m.run_time,
+    }
+    for attr in ("coefficients", "exp_coef", "std_errors", "p_values", "iterations"):
+        v = getattr(m, attr, None)
+        if v is not None:
+            output[attr] = v
+    vi = getattr(m, "variable_importances", None)
+    if callable(vi):
+        try:
+            output["variable_importances"] = vi()
+        except Exception:
+            pass
+    return {
+        "model_id": {"name": m.key},
+        "algo": m.algo_name,
+        "parameters": params,
+        "output": output,
+    }
+
+
+def _coerce_params(params_cls, raw: Dict[str, Any]):
+    """Form/JSON values -> typed Parameters dataclass (the schema-filling
+    that api/Handler.fillFromParms does via schema metadata)."""
+    fields = {f.name: f for f in dataclasses.fields(params_cls)}
+    kw: Dict[str, Any] = {}
+    for k, v in raw.items():
+        if k not in fields:
+            continue
+        f = fields[k]
+        ftype = f.type if isinstance(f.type, str) else getattr(f.type, "__name__", "")
+        if isinstance(v, str):
+            t = str(ftype)
+            if "bool" in t:
+                v = v.lower() in ("true", "1", "yes")
+            elif "int" in t and "List" not in t:
+                v = int(float(v))
+            elif "float" in t:
+                v = float(v)
+            elif "List" in t or "list" in t:
+                s = v.strip()
+                if s.startswith("["):
+                    v = json.loads(s.replace("'", '"'))
+                else:
+                    v = [x for x in s.split(",") if x]
+        kw[k] = v
+    try:
+        return params_cls(**kw)
+    except TypeError as e:
+        raise RestError(400, f"bad parameters: {e}")
+
+
+# ---------------------------------------------------------------------------
+# registration
+
+
+def register_all(r: RequestServer, server: H2OServer) -> None:
+    algos = algo_map()
+
+    # ---- cloud / ops ------------------------------------------------------
+    def cloud(params):
+        """CloudV3 (api/schemas3/CloudV3.java)."""
+        import jax
+
+        try:
+            devices = [str(d) for d in jax.devices()]
+        except Exception:
+            devices = []
+        return {
+            "version": __version__,
+            "cloud_name": server.name,
+            "cloud_size": 1,
+            "cloud_healthy": True,
+            "cloud_uptime_millis": int((time.time() - server.start_time) * 1000),
+            "consensus": True,
+            "locked": True,
+            "nodes": [
+                {
+                    "h2o": f"127.0.0.1:{server.port}",
+                    "healthy": True,
+                    "num_cpus": os.cpu_count(),
+                    "devices": devices,
+                }
+            ],
+        }
+
+    r.register("GET", "/3/Cloud", cloud, "cloud status")
+    r.register("GET", "/3/Cloud/status", cloud, "cloud status (minimal)")
+    r.register("GET", "/3/About", lambda p: {
+        "entries": [
+            {"name": "Build version", "value": __version__},
+            {"name": "Backend", "value": "jax/XLA (TPU-native)"},
+        ]
+    }, "about")
+    r.register("GET", "/3/Capabilities", lambda p: {
+        "capabilities": [{"name": a} for a in sorted(algos)]
+    }, "capabilities")
+    r.register("GET", "/3/Metadata/endpoints", lambda p: {
+        "routes": r.endpoints()
+    }, "endpoint metadata")
+    r.register("POST", "/3/Shutdown", lambda p: {"result": "shutting down"},
+               "shutdown (no-op acknowledgement; process owner stops server)")
+    r.register("POST", "/3/GarbageCollect", lambda p: (__import__("gc").collect(), {})[1],
+               "gc")
+
+    # ---- jobs -------------------------------------------------------------
+    def jobs_list(params):
+        out = [
+            _job_schema(DKV.get(k)) for k in DKV.keys() if isinstance(DKV.get(k), Job)
+        ]
+        return {"jobs": out}
+
+    def job_get(params, job_id):
+        j = DKV.get(job_id)
+        if not isinstance(j, Job):
+            raise RestError(404, f"job {job_id!r} not found")
+        return {"jobs": [_job_schema(j)]}
+
+    def job_cancel(params, job_id):
+        j = DKV.get(job_id)
+        if not isinstance(j, Job):
+            raise RestError(404, f"job {job_id!r} not found")
+        j.cancel()
+        return {"jobs": [_job_schema(j)]}
+
+    r.register("GET", "/3/Jobs", jobs_list, "list jobs")
+    r.register("GET", "/3/Jobs/{job_id}", job_get, "job status")
+    r.register("POST", "/3/Jobs/{job_id}/cancel", job_cancel, "cancel job")
+
+    # ---- import / parse ---------------------------------------------------
+    def import_files(params):
+        path = params.get("path")
+        if not path:
+            raise RestError(400, "path required")
+        if not os.path.exists(path):
+            raise RestError(404, f"path {path!r} not found")
+        with open(path, "r", errors="replace") as f:
+            text = f.read()
+        key = DKV.make_key("nfs:" + os.path.basename(path))
+        DKV.put(key, _RawFile(path, text))
+        return {"files": [path], "destination_frames": [key], "fails": [], "dels": []}
+
+    def post_file(params):
+        # upload_file: raw body was stashed under 'file' by the client;
+        # our client sends {"data": csv_text}
+        text = params.get("data")
+        if text is None:
+            raise RestError(400, "no file data")
+        key = params.get("destination_frame") or DKV.make_key("upload")
+        DKV.put(key, _RawFile("<upload>", text))
+        return {"destination_frame": key, "total_bytes": len(text)}
+
+    def _raw_of(key: str) -> _RawFile:
+        v = DKV.get(key)
+        if not isinstance(v, _RawFile):
+            raise RestError(404, f"no raw file under {key!r}")
+        return v
+
+    def parse_setup_ep(params):
+        srcs = params.get("source_frames")
+        if isinstance(srcs, str):
+            srcs = json.loads(srcs.replace("'", '"')) if srcs.startswith("[") else [srcs]
+        raw = _raw_of(srcs[0])
+        setup = parse_setup(raw.text)
+        return {
+            "source_frames": [{"name": s} for s in srcs],
+            "destination_frame": srcs[0].rsplit(":", 1)[-1] + ".hex",
+            "separator": ord(setup.separator),
+            "check_header": 1 if setup.header else -1,
+            "column_names": setup.column_names,
+            "column_types": [t.name.lower() for t in setup.column_types],
+            "number_columns": len(setup.column_names),
+        }
+
+    def parse_ep(params):
+        srcs = params.get("source_frames")
+        if isinstance(srcs, str):
+            srcs = json.loads(srcs.replace("'", '"')) if srcs.startswith("[") else [srcs]
+        raw = _raw_of(srcs[0])
+        dest = params.get("destination_frame") or DKV.make_key("parse")
+        kw: Dict[str, Any] = {}
+        if params.get("separator"):
+            kw["separator"] = chr(int(params["separator"]))
+        if params.get("check_header"):
+            kw["header"] = int(params["check_header"]) == 1
+        # forced types from ParseSetup must survive Parse (the reference's
+        # two-phase parse honors the client-edited setup)
+        names = params.get("column_names")
+        types = params.get("column_types")
+        if isinstance(names, str):
+            names = json.loads(names.replace("'", '"'))
+        if isinstance(types, str):
+            types = json.loads(types.replace("'", '"'))
+        if types:
+            if not names:
+                names = parse_setup(raw.text).column_names
+            kw["column_types"] = {
+                n: t for n, t in zip(names, types) if t
+            }
+        job = Job(f"parse {dest}").start()
+        try:
+            fr = parse_csv(raw.text, **kw)
+            DKV.put(dest, fr)
+            job.dest = dest
+            job.done()
+        except Exception as e:
+            job.fail(e)
+            raise RestError(400, f"parse failed: {e}")
+        return {"job": _job_schema(job), "destination_frame": {"name": dest}}
+
+    r.register("POST", "/3/ImportFiles", import_files, "import a file")
+    r.register("POST", "/3/PostFile", post_file, "upload a file body")
+    r.register("POST", "/3/ParseSetup", parse_setup_ep, "guess parse setup")
+    r.register("POST", "/3/Parse", parse_ep, "parse to frame")
+
+    # ---- frames -----------------------------------------------------------
+    def frames_list(params):
+        out = []
+        for k in DKV.keys():
+            v = DKV.get(k)
+            if isinstance(v, Frame):
+                out.append({"frame_id": {"name": k}, "rows": v.nrows,
+                            "num_columns": v.ncols})
+        return {"frames": out}
+
+    def frame_get(params, frame_id):
+        rows = int(params.get("row_count", 10))
+        return {"frames": [_frame_schema(_get_frame(frame_id), frame_id, rows)]}
+
+    def frame_summary(params, frame_id):
+        return frame_get(params, frame_id)
+
+    def frame_columns(params, frame_id):
+        fr = _get_frame(frame_id)
+        return {"columns": _frame_schema(fr, frame_id)["columns"]}
+
+    def frame_delete(params, frame_id):
+        _get_frame(frame_id)
+        DKV.remove(frame_id)
+        return {"frame_id": {"name": frame_id}}
+
+    def frames_delete_all(params):
+        for k in list(DKV.keys()):
+            if isinstance(DKV.get(k), Frame):
+                DKV.remove(k)
+        return {}
+
+    def download_dataset(params):
+        fr = _get_frame(params.get("frame_id", ""))
+        buf = io.StringIO()
+        df = fr.to_pandas()
+        df.to_csv(buf, index=False)
+        return buf.getvalue().encode()
+
+    def split_frame(params):
+        fr = _get_frame(params.get("dataset", params.get("frame_id", "")))
+        ratios = params.get("ratios", "[0.75]")
+        if isinstance(ratios, str):
+            ratios = json.loads(ratios)
+        ratios = [float(x) for x in np.atleast_1d(ratios)]
+        seed = int(params.get("seed", -1))
+        rng = np.random.default_rng(None if seed == -1 else seed)
+        u = rng.random(fr.nrows)
+        bounds = np.cumsum(ratios)
+        dests = params.get("destination_frames")
+        if isinstance(dests, str):
+            dests = json.loads(dests.replace("'", '"'))
+        keys = []
+        lo = 0.0
+        all_bounds = list(bounds)
+        if not all_bounds or all_bounds[-1] < 1.0 - 1e-12:
+            all_bounds.append(1.0)  # remainder split only if ratios < 1
+        for i, hi in enumerate(all_bounds):
+            mask = (u >= lo) & (u < hi)
+            lo = hi
+            sub = fr.rows(mask)
+            key = (dests[i] if dests and i < len(dests)
+                   else DKV.make_key("split"))
+            DKV.put(key, sub)
+            keys.append(key)
+        return {"destination_frames": [{"name": k} for k in keys]}
+
+    r.register("GET", "/3/Frames", frames_list, "list frames")
+    r.register("GET", "/3/Frames/{frame_id}", frame_get, "frame + preview")
+    r.register("GET", "/3/Frames/{frame_id}/summary", frame_summary, "frame summary")
+    r.register("GET", "/3/Frames/{frame_id}/columns", frame_columns, "frame columns")
+    r.register("DELETE", "/3/Frames/{frame_id}", frame_delete, "delete frame")
+    r.register("DELETE", "/3/Frames", frames_delete_all, "delete all frames")
+    r.register("GET", "/3/DownloadDataset", download_dataset, "frame as csv")
+    r.register("POST", "/3/SplitFrame", split_frame, "split a frame")
+
+    # ---- rapids / sessions ------------------------------------------------
+    def new_session(params):
+        s = Session()
+        _SESSIONS[s.id] = s
+        return {"session_key": s.id}
+
+    def end_session(params, session_id):
+        s = _SESSIONS.pop(session_id, None)
+        n = s.end() if s else 0
+        return {"session_key": session_id, "frames_removed": n}
+
+    def rapids_exec_ep(params):
+        ast = params.get("ast")
+        if not ast:
+            raise RestError(400, "ast required")
+        sid = params.get("session_id")
+        session = _SESSIONS.get(sid) if sid else None
+        if sid and session is None:
+            session = _SESSIONS[sid] = Session(sid)
+        try:
+            val = exec_rapids(ast, session=session)
+        except Exception as e:
+            raise RestError(400, f"rapids error: {e}")
+        # RapidsSchemaV3 family: scalar / string / frame
+        if val.is_frame():
+            fr = val.as_frame()
+            key = getattr(fr, "key", None) or DKV.make_key("rapids")
+            DKV.put(key, fr)
+            return {
+                "key": {"name": key},
+                "num_rows": fr.nrows,
+                "num_cols": fr.ncols,
+            }
+        if val.is_num():
+            return {"scalar": val.as_num()}
+        if val.is_str():
+            return {"string": val.as_str()}
+        try:
+            return {"scalar": val.as_nums().tolist()}
+        except Exception:
+            return {"string": repr(val)}
+
+    r.register("POST", "/4/sessions", new_session, "new rapids session")
+    r.register("DELETE", "/4/sessions/{session_id}", end_session, "end session")
+    r.register("POST", "/99/Rapids", rapids_exec_ep, "execute a rapids ast")
+
+    # ---- model builders ---------------------------------------------------
+    def builders_list(params):
+        return {
+            "model_builders": {
+                a: {"algo": a, "visibility": "Stable"} for a in sorted(algos)
+            }
+        }
+
+    def _default_of(f: dataclasses.Field):
+        if f.default is not dataclasses.MISSING and isinstance(
+            f.default, (int, float, str, bool, type(None))
+        ):
+            return f.default
+        return None  # default_factory or non-scalar default
+
+    def builder_get(params, algo):
+        if algo not in algos:
+            raise RestError(404, f"unknown algo {algo!r}")
+        _, pcls = algos[algo]
+        return {
+            "model_builders": {
+                algo: {
+                    "algo": algo,
+                    "parameters": [
+                        {"name": f.name, "default_value": _default_of(f)}
+                        for f in dataclasses.fields(pcls)
+                    ],
+                }
+            }
+        }
+
+    def train(params, algo):
+        if algo not in algos:
+            raise RestError(404, f"unknown algo {algo!r}")
+        bcls, pcls = algos[algo]
+        fr = _get_frame(params.get("training_frame", ""))
+        valid = (
+            _get_frame(params["validation_frame"])
+            if params.get("validation_frame")
+            else None
+        )
+        p = _coerce_params(pcls, params)
+        builder = bcls(p)
+        try:
+            model = builder.train(fr, valid)
+        except RestError:
+            raise
+        except Exception as e:
+            raise RestError(400, f"{algo} train failed: {type(e).__name__}: {e}")
+        if params.get("model_id"):
+            DKV.remove(model.key)
+            model.key = params["model_id"]
+            DKV.put(model.key, model)
+        job = builder.job  # ModelBuilder.train always creates one
+        if job is None:  # defensive: synthesize a finished job
+            job = Job(f"{algo} train").start()
+            job.done()
+        job.dest = model.key
+        return {"job": _job_schema(job), "model_id": {"name": model.key}}
+
+    r.register("GET", "/3/ModelBuilders", builders_list, "list algos")
+    r.register("GET", "/3/ModelBuilders/{algo}", builder_get, "algo parameters")
+    r.register("POST", "/3/ModelBuilders/{algo}", train, "train a model")
+
+    # ---- models -----------------------------------------------------------
+    def models_list(params):
+        out = []
+        for k in DKV.keys():
+            v = DKV.get(k)
+            if isinstance(v, Model):
+                out.append({"model_id": {"name": k}, "algo": v.algo_name})
+        return {"models": out}
+
+    def model_get(params, model_id):
+        return {"models": [_model_schema(_get_model(model_id))]}
+
+    def model_delete(params, model_id):
+        _get_model(model_id)
+        DKV.remove(model_id)
+        return {}
+
+    def models_delete_all(params):
+        for k in list(DKV.keys()):
+            if isinstance(DKV.get(k), Model):
+                DKV.remove(k)
+        return {}
+
+    def model_mojo(params, model_id):
+        m = _get_model(model_id)
+        with tempfile.NamedTemporaryFile(suffix=".mojo", delete=False) as f:
+            path = f.name
+        try:
+            m.download_mojo(path)
+            with open(path, "rb") as f:
+                return f.read()
+        finally:
+            os.unlink(path)
+
+    def predict(params, model_id, frame_id):
+        m = _get_model(model_id)
+        fr = _get_frame(frame_id)
+        pred = m.predict(fr)
+        dest = params.get("predictions_frame") or DKV.make_key("pred")
+        DKV.put(dest, pred)
+        out: Dict[str, Any] = {
+            "model_metrics": [
+                {
+                    "frame": {"name": frame_id},
+                    "model": {"name": model_id},
+                    "predictions_frame": {"name": dest},
+                }
+            ]
+        }
+        try:
+            out["model_metrics"][0].update(_metrics_schema(m.model_performance(fr)) or {})
+        except Exception:
+            pass  # frames without a response can still be scored
+        return out
+
+    r.register("GET", "/3/Models", models_list, "list models")
+    r.register("GET", "/3/Models/{model_id}", model_get, "model details")
+    r.register("DELETE", "/3/Models/{model_id}", model_delete, "delete model")
+    r.register("DELETE", "/3/Models", models_delete_all, "delete all models")
+    r.register("GET", "/3/Models/{model_id}/mojo", model_mojo, "download mojo")
+    r.register(
+        "POST", "/3/Predictions/models/{model_id}/frames/{frame_id}", predict,
+        "score a frame",
+    )
+
+    # ---- grids ------------------------------------------------------------
+    def grid_train(params, algo):
+        if algo not in algos:
+            raise RestError(404, f"unknown algo {algo!r}")
+        bcls, pcls = algos[algo]
+        fr = _get_frame(params.get("training_frame", ""))
+        hyper = params.get("hyper_parameters")
+        if isinstance(hyper, str):
+            hyper = json.loads(hyper)
+        if not isinstance(hyper, dict) or not hyper:
+            raise RestError(400, "hyper_parameters (dict) required")
+        crit_raw = params.get("search_criteria") or {}
+        if isinstance(crit_raw, str):
+            crit_raw = json.loads(crit_raw)
+        crit = SearchCriteria(**{
+            k: v for k, v in crit_raw.items()
+            if k in {f.name for f in dataclasses.fields(SearchCriteria)}
+        })
+        base = _coerce_params(pcls, params)
+        gs = GridSearch(bcls, base, hyper, crit)
+        grid = gs.train(fr)
+        return {
+            "grid_id": {"name": grid.grid_id},
+            "model_ids": [{"name": k} for k in grid.model_ids],
+            "failure_details": [msg for _, msg in grid.failures],
+        }
+
+    def grids_list(params):
+        out = []
+        for k in DKV.keys():
+            v = DKV.get(k)
+            if isinstance(v, Grid):
+                out.append({"grid_id": {"name": k}, "model_count": len(v.models)})
+        return {"grids": out}
+
+    def grid_get(params, grid_id):
+        g = DKV.get(grid_id)
+        if not isinstance(g, Grid):
+            raise RestError(404, f"grid {grid_id!r} not found")
+        sort_by = params.get("sort_by", "auto")
+        gs = g.get_grid(sort_by)
+        return {
+            "grid_id": {"name": grid_id},
+            "model_ids": [{"name": k} for k in gs.model_ids],
+            "hyper_params": gs.hyper_params,
+            "failure_details": [msg for _, msg in gs.failures],
+        }
+
+    r.register("POST", "/99/Grid/{algo}", grid_train, "grid search")
+    r.register("GET", "/99/Grids", grids_list, "list grids")
+    r.register("GET", "/99/Grids/{grid_id}", grid_get, "grid details")
+
+    # ---- diagnostics (TimeLine / logs / jstack analogues) -----------------
+    r.register("GET", "/3/Timeline", lambda p: {
+        "events": [], "now": int(time.time() * 1000)
+    }, "event timeline")
+    r.register("GET", "/3/JStack", lambda p: {
+        "traces": [
+            {"thread": t.name, "stack": []}
+            for t in __import__("threading").enumerate()
+        ]
+    }, "thread dump")

@@ -1,0 +1,69 @@
+"""Algorithm registry: algo name -> (ModelBuilder, Parameters).
+
+Reference: ``hex/api/RegisterAlgos.java:16-34`` — the authoritative list of
+algos exposed over REST (per-algo train routes are registered dynamically
+from this list), plus the extension registrations (xgboost, targetencoder).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple, Type
+
+
+def algo_map() -> Dict[str, Tuple[type, type]]:
+    from h2o3_tpu.models.aggregator import Aggregator, AggregatorParameters
+    from h2o3_tpu.models.coxph import CoxPH, CoxPHParameters
+    from h2o3_tpu.models.deeplearning import DeepLearning, DeepLearningParameters
+    from h2o3_tpu.models.ext_isolation_forest import (
+        ExtendedIsolationForest,
+        ExtendedIsolationForestParameters,
+    )
+    from h2o3_tpu.models.gam import GAM, GAMParameters
+    from h2o3_tpu.models.glm import GLM, GLMParameters
+    from h2o3_tpu.models.glrm import GLRM, GLRMParameters
+    from h2o3_tpu.models.isolation_forest import (
+        IsolationForest,
+        IsolationForestParameters,
+    )
+    from h2o3_tpu.models.kmeans import KMeans, KMeansParameters
+    from h2o3_tpu.models.naive_bayes import NaiveBayes, NaiveBayesParameters
+    from h2o3_tpu.models.pca import PCA, PCAParameters, SVD, SVDParameters
+    from h2o3_tpu.models.psvm import PSVM, PSVMParameters
+    from h2o3_tpu.models.rulefit import RuleFit, RuleFitParameters
+    from h2o3_tpu.models.stacked_ensemble import (
+        StackedEnsemble,
+        StackedEnsembleParameters,
+    )
+    from h2o3_tpu.models.target_encoder import TargetEncoder, TargetEncoderParameters
+    from h2o3_tpu.models.tree.drf import DRF, DRFParameters
+    from h2o3_tpu.models.tree.gbm import GBM, GBMParameters
+    from h2o3_tpu.models.tree.xgboost import XGBoost, XGBoostParameters
+    from h2o3_tpu.models.word2vec import Word2Vec, Word2VecParameters
+
+    return {
+        # hex/api/RegisterAlgos.java order
+        "coxph": (CoxPH, CoxPHParameters),
+        "deeplearning": (DeepLearning, DeepLearningParameters),
+        "drf": (DRF, DRFParameters),
+        "glm": (GLM, GLMParameters),
+        "glrm": (GLRM, GLRMParameters),
+        "kmeans": (KMeans, KMeansParameters),
+        "naivebayes": (NaiveBayes, NaiveBayesParameters),
+        "pca": (PCA, PCAParameters),
+        "svd": (SVD, SVDParameters),
+        "gbm": (GBM, GBMParameters),
+        "isolationforest": (IsolationForest, IsolationForestParameters),
+        "extendedisolationforest": (
+            ExtendedIsolationForest,
+            ExtendedIsolationForestParameters,
+        ),
+        "aggregator": (Aggregator, AggregatorParameters),
+        "word2vec": (Word2Vec, Word2VecParameters),
+        "stackedensemble": (StackedEnsemble, StackedEnsembleParameters),
+        "psvm": (PSVM, PSVMParameters),
+        "gam": (GAM, GAMParameters),
+        "rulefit": (RuleFit, RuleFitParameters),
+        # extensions
+        "xgboost": (XGBoost, XGBoostParameters),
+        "targetencoder": (TargetEncoder, TargetEncoderParameters),
+    }

@@ -1,0 +1,197 @@
+"""The REST server: route registry + threaded HTTP dispatch.
+
+Reference: ``water/api/RequestServer.java:56-80,157-192,241`` (route table,
+{placeholder} path params, fallback per-algo routes), ``RegisterV3Api.java``
+(endpoint registration), ``water/api/Handler.java`` (schema in/out),
+``water/api/H2OErrorV3`` (error payloads).
+
+Design notes (TPU-native): the REST layer is pure control plane — every
+handler manipulates host-side objects (frames, model keys, jobs) and the
+device work happens inside the models' jitted programs.  A
+ThreadingHTTPServer replaces Jetty; one process is one "cloud" (the
+reference's multi-JVM cloud maps to the device mesh, not to processes).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+import traceback
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from h2o3_tpu import __version__
+from h2o3_tpu.keyed import DKV
+
+Route = Tuple[str, "re.Pattern[str]", List[str], Callable, str]
+
+
+class RestError(Exception):
+    def __init__(self, status: int, msg: str) -> None:
+        super().__init__(msg)
+        self.status = status
+
+
+class RequestServer:
+    """Route registry (RequestServer.java:56-80)."""
+
+    def __init__(self) -> None:
+        self.routes: List[Route] = []
+
+    def register(self, method: str, path: str, handler: Callable, summary: str = "") -> None:
+        """path uses {name} placeholders, e.g. /3/Models/{model_id}."""
+        names = re.findall(r"\{(\w+)\}", path)
+        pattern = re.compile(
+            "^" + re.sub(r"\{(\w+)\}", r"(?P<\1>[^/]+)", path) + "$"
+        )
+        self.routes.append((method.upper(), pattern, names, handler, summary))
+
+    def dispatch(self, method: str, path: str, params: Dict[str, Any]) -> Any:
+        for m, pattern, _names, handler, _ in self.routes:
+            if m != method:
+                continue
+            match = pattern.match(path)
+            if match:
+                kw = {k: urllib.parse.unquote(v) for k, v in match.groupdict().items()}
+                return handler(params, **kw)
+        raise RestError(404, f"no route for {method} {path}")
+
+    def endpoints(self) -> List[Dict[str, str]]:
+        return [
+            {"method": m, "url_pattern": p.pattern[1:-1], "summary": s}
+            for m, p, _, _, s in self.routes
+        ]
+
+
+def _json_default(o):
+    if isinstance(o, (np.integer,)):
+        return int(o)
+    if isinstance(o, (np.floating,)):
+        v = float(o)
+        return None if np.isnan(v) else v
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    if isinstance(o, float) and np.isnan(o):
+        return None
+    raise TypeError(f"not JSON serializable: {type(o)}")
+
+
+class H2OServer:
+    """The server facade (h2o-webserver-iface HttpServerFacade analogue)."""
+
+    def __init__(self, port: int = 54321, name: str = "h2o3-tpu") -> None:
+        self.name = name
+        self.start_time = time.time()
+        self.registry = RequestServer()
+        from h2o3_tpu.api import handlers
+
+        handlers.register_all(self.registry, self)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self.port = port
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "H2OServer":
+        registry = self.registry
+
+        class Handler(BaseHTTPRequestHandler):
+            server_version = f"h2o3-tpu/{__version__}"
+
+            def log_message(self, *a):  # quiet; the Log subsystem records
+                pass
+
+            def _params(self) -> Dict[str, Any]:
+                parsed = urllib.parse.urlparse(self.path)
+                params: Dict[str, Any] = {
+                    k: v[0] if len(v) == 1 else v
+                    for k, v in urllib.parse.parse_qs(parsed.query).items()
+                }
+                length = int(self.headers.get("Content-Length") or 0)
+                if length:
+                    body = self.rfile.read(length)
+                    ctype = self.headers.get("Content-Type", "")
+                    if "json" in ctype:
+                        params.update(json.loads(body))
+                    else:  # h2o-py posts urlencoded forms
+                        params.update(
+                            {
+                                k: v[0] if len(v) == 1 else v
+                                for k, v in urllib.parse.parse_qs(
+                                    body.decode()
+                                ).items()
+                            }
+                        )
+                return params
+
+            def _respond(self, method: str) -> None:
+                parsed = urllib.parse.urlparse(self.path)
+                try:
+                    out = registry.dispatch(method, parsed.path, self._params())
+                    if isinstance(out, (bytes, bytearray)):
+                        self.send_response(200)
+                        self.send_header("Content-Type", "application/octet-stream")
+                        self.send_header("Content-Length", str(len(out)))
+                        self.end_headers()
+                        self.wfile.write(out)
+                        return
+                    payload = json.dumps(out, default=_json_default).encode()
+                    self.send_response(200)
+                except RestError as e:
+                    payload = json.dumps(
+                        {  # water/api/schemas3/H2OErrorV3 shape
+                            "http_status": e.status,
+                            "msg": str(e),
+                            "dev_msg": str(e),
+                            "exception_type": "RestError",
+                        }
+                    ).encode()
+                    self.send_response(e.status)
+                except Exception as e:  # noqa: BLE001
+                    payload = json.dumps(
+                        {
+                            "http_status": 500,
+                            "msg": f"{type(e).__name__}: {e}",
+                            "dev_msg": traceback.format_exc(),
+                            "exception_type": type(e).__name__,
+                        }
+                    ).encode()
+                    self.send_response(500)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                self._respond("GET")
+
+            def do_POST(self):
+                self._respond("POST")
+
+            def do_DELETE(self):
+                self._respond("DELETE")
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+
+def start_server(port: int = 0, name: str = "h2o3-tpu") -> H2OServer:
+    """Start a server on localhost (port 0 = OS-assigned)."""
+    return H2OServer(port=port, name=name).start()

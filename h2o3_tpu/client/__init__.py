@@ -1,0 +1,167 @@
+"""h2o3_tpu.client — the h2o-py-equivalent Python client.
+
+Reference: ``h2o-py/h2o/h2o.py`` module functions (init/connect/
+import_file/upload_file/get_frame/ls/remove, h2o.py:127,383), the lazy
+``H2OFrame``/ExprNode surface (``h2o-py/h2o/expr.py``), and the estimator
+classes (``h2o-py/h2o/estimators/``).
+
+Usage::
+
+    from h2o3_tpu import client as h2o
+    h2o.init()                       # starts an in-process server
+    fr = h2o.upload_csv("a,b\\n1,2\\n")
+    train = h2o.import_file("data.csv")
+    m = h2o.H2OGradientBoostingEstimator(ntrees=50)
+    m.train(y="label", training_frame=train)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from h2o3_tpu.client.connection import H2OConnection, H2OResponseError
+from h2o3_tpu.client.expr import ExprNode
+from h2o3_tpu.client.frame import H2OFrame
+from h2o3_tpu.client.estimators import (  # noqa: F401
+    H2OAggregatorEstimator,
+    H2OCoxProportionalHazardsEstimator,
+    H2ODeepLearningEstimator,
+    H2OEstimator,
+    H2OExtendedIsolationForestEstimator,
+    H2OGeneralizedAdditiveEstimator,
+    H2OGeneralizedLinearEstimator,
+    H2OGeneralizedLowRankEstimator,
+    H2OGradientBoostingEstimator,
+    H2OIsolationForestEstimator,
+    H2OKMeansEstimator,
+    H2OModel,
+    H2ONaiveBayesEstimator,
+    H2OPSVMEstimator,
+    H2OPrincipalComponentAnalysisEstimator,
+    H2ORandomForestEstimator,
+    H2ORuleFitEstimator,
+    H2OSingularValueDecompositionEstimator,
+    H2OStackedEnsembleEstimator,
+    H2OTargetEncoderEstimator,
+    H2OWord2vecEstimator,
+    H2OXGBoostEstimator,
+)
+
+_conn: Optional[H2OConnection] = None
+_server = None  # in-process server when init() started one
+
+
+def connection() -> H2OConnection:
+    if _conn is None:
+        raise RuntimeError("call h2o.init() or h2o.connect(url) first")
+    return _conn
+
+
+def init(url: Optional[str] = None) -> H2OConnection:
+    """Start (or connect to) a server — h2o.init (h2o-py/h2o/h2o.py:127).
+    Without a url, starts an in-process server (the reference spawns a local
+    JVM, backend/server.py:33; here the 'cluster' is this process + its
+    device mesh)."""
+    global _conn, _server
+    if url is None:
+        from h2o3_tpu.api import start_server
+
+        _server = start_server(port=0)
+        url = _server.url
+    _conn = H2OConnection(url)
+    _conn.cloud_info()  # fail fast if unreachable
+    return _conn
+
+
+def connect(url: str) -> H2OConnection:
+    return init(url)
+
+
+def shutdown() -> None:
+    global _conn, _server
+    if _conn is not None:
+        _conn.close()
+        try:
+            _conn.request("POST /3/Shutdown")
+        except H2OResponseError:
+            pass
+        _conn = None
+    if _server is not None:
+        _server.stop()
+        _server = None
+
+
+def import_file(path: str, destination_frame: Optional[str] = None) -> H2OFrame:
+    """h2o.import_file (h2o.py:383): ImportFiles -> ParseSetup -> Parse."""
+    c = connection()
+    imp = c.request("POST /3/ImportFiles", {"path": path})
+    src = imp["destination_frames"][0]
+    setup = c.request("POST /3/ParseSetup", {"source_frames": [src]})
+    dest = destination_frame or setup["destination_frame"]
+    out = c.request(
+        "POST /3/Parse",
+        {
+            "source_frames": [src],
+            "destination_frame": dest,
+            "separator": setup["separator"],
+            "check_header": setup["check_header"],
+        },
+    )
+    key = out["destination_frame"]["name"]
+    fr = c.request(f"GET /3/Frames/{key}")["frames"][0]
+    return H2OFrame.from_key(c, key, nrows=fr["rows"], ncols=fr["num_columns"])
+
+
+def upload_csv(text: str, destination_frame: Optional[str] = None) -> H2OFrame:
+    """h2o.upload_file for in-memory CSV text."""
+    c = connection()
+    up = c.request("POST /3/PostFile", {"data": text})
+    out = c.request(
+        "POST /3/Parse",
+        {
+            "source_frames": [up["destination_frame"]],
+            "destination_frame": destination_frame or "",
+        },
+    )
+    key = out["destination_frame"]["name"]
+    fr = c.request(f"GET /3/Frames/{key}")["frames"][0]
+    return H2OFrame.from_key(c, key, nrows=fr["rows"], ncols=fr["num_columns"])
+
+
+upload_file = import_file  # path-based alias
+
+
+def get_frame(frame_id: str) -> H2OFrame:
+    c = connection()
+    fr = c.request(f"GET /3/Frames/{frame_id}")["frames"][0]
+    return H2OFrame.from_key(c, frame_id, nrows=fr["rows"], ncols=fr["num_columns"])
+
+
+def ls() -> List[str]:
+    c = connection()
+    return [f["frame_id"]["name"] for f in c.request("GET /3/Frames")["frames"]]
+
+
+def remove(key: str) -> None:
+    c = connection()
+    try:
+        c.request(f"DELETE /3/Frames/{key}")
+    except H2OResponseError:
+        c.request(f"DELETE /3/Models/{key}")
+
+
+def remove_all() -> None:
+    c = connection()
+    c.request("DELETE /3/Frames")
+    c.request("DELETE /3/Models")
+
+
+def rapids(ast: str) -> Dict[str, Any]:
+    c = connection()
+    return c.request(
+        "POST /99/Rapids", {"ast": ast, "session_id": c.ensure_session()}
+    )
+
+
+def cluster_status() -> Dict[str, Any]:
+    return connection().cloud_info()

@@ -1,0 +1,79 @@
+"""REST connection core.
+
+Reference: ``h2o-py/h2o/backend/connection.py:229,409-433`` —
+``H2OConnection.request(method endpoint, data=...)``, JSON responses,
+error objects raised as exceptions, cloud-up polling.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, Optional
+
+
+class H2OResponseError(Exception):
+    def __init__(self, status: int, payload: Dict[str, Any]) -> None:
+        super().__init__(payload.get("msg", f"HTTP {status}"))
+        self.status = status
+        self.payload = payload
+
+
+class H2OConnection:
+    """A live connection to one h2o3-tpu server."""
+
+    def __init__(self, url: str) -> None:
+        self.base_url = url.rstrip("/")
+        self.session_id: Optional[str] = None
+
+    def request(
+        self,
+        endpoint: str,
+        data: Optional[Dict[str, Any]] = None,
+        raw: bool = False,
+    ) -> Any:
+        """endpoint: "METHOD /path" like h2o-py (connection.py:229)."""
+        method, path = endpoint.split(" ", 1)
+        body = None
+        headers = {}
+        if data is not None:
+            body = json.dumps(data).encode()
+            headers["Content-Type"] = "application/json"
+        req = urllib.request.Request(
+            self.base_url + path, data=body, headers=headers, method=method
+        )
+        try:
+            with urllib.request.urlopen(req) as resp:
+                payload = resp.read()
+        except urllib.error.HTTPError as e:
+            body = e.read()
+            try:
+                err = json.loads(body)
+            except (ValueError, UnicodeDecodeError):
+                # non-JSON error (proxy / wrong server): keep the status +
+                # a body excerpt instead of masking it with JSONDecodeError
+                err = {
+                    "http_status": e.code,
+                    "msg": body.decode(errors="replace")[:200] or str(e),
+                }
+            raise H2OResponseError(e.code, err)
+        return payload if raw else json.loads(payload)
+
+    # -- session (h2o-py lazily opens one for rapids) ------------------------
+    def ensure_session(self) -> str:
+        if self.session_id is None:
+            self.session_id = self.request("POST /4/sessions")["session_key"]
+        return self.session_id
+
+    def close(self) -> None:
+        if self.session_id is not None:
+            try:
+                self.request(f"DELETE /4/sessions/{self.session_id}")
+            except H2OResponseError:
+                pass
+            self.session_id = None
+
+    def cloud_info(self) -> Dict[str, Any]:
+        return self.request("GET /3/Cloud")

@@ -1,0 +1,170 @@
+"""Client-side estimator wrappers.
+
+Reference: ``h2o-py/h2o/estimators/`` (22.6k LoC, 21 estimator classes
+code-generated from the server's parameter schemas by
+``h2o-bindings/bin/gen_python.py:140``).  Here the estimators are one
+parametric base + thin per-algo subclasses generated from the same server
+registry, keeping the h2o-py surface: ``est.train(x, y, training_frame)``,
+``est.predict(frame)``, ``est.model_performance()``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from h2o3_tpu.client.connection import H2OConnection, H2OResponseError
+from h2o3_tpu.client.frame import H2OFrame
+
+
+class H2OModel:
+    """Client handle to a server-side model (h2o-py ModelBase)."""
+
+    def __init__(self, conn: H2OConnection, model_id: str) -> None:
+        self._conn = conn
+        self.model_id = model_id
+        self._schema: Optional[Dict[str, Any]] = None
+
+    def _fetch(self) -> Dict[str, Any]:
+        if self._schema is None:
+            self._schema = self._conn.request(f"GET /3/Models/{self.model_id}")[
+                "models"
+            ][0]
+        return self._schema
+
+    @property
+    def algo(self) -> str:
+        return self._fetch()["algo"]
+
+    @property
+    def params(self) -> Dict[str, Any]:
+        return self._fetch()["parameters"]
+
+    def _metrics(self, which: str) -> Optional[Dict[str, Any]]:
+        return self._fetch()["output"].get(which)
+
+    def auc(self, valid: bool = False, xval: bool = False) -> Optional[float]:
+        which = (
+            "cross_validation_metrics" if xval
+            else "validation_metrics" if valid else "training_metrics"
+        )
+        mm = self._metrics(which)
+        return mm.get("auc") if mm else None
+
+    def rmse(self, valid: bool = False) -> Optional[float]:
+        mm = self._metrics("validation_metrics" if valid else "training_metrics")
+        return mm.get("rmse") if mm else None
+
+    def logloss(self, valid: bool = False) -> Optional[float]:
+        mm = self._metrics("validation_metrics" if valid else "training_metrics")
+        return mm.get("logloss") if mm else None
+
+    def coef(self) -> Optional[Dict[str, float]]:
+        return self._fetch()["output"].get("coefficients")
+
+    def varimp(self) -> Optional[Dict[str, float]]:
+        return self._fetch()["output"].get("variable_importances")
+
+    def predict(self, frame: H2OFrame) -> H2OFrame:
+        frame.refresh()
+        out = self._conn.request(
+            f"POST /3/Predictions/models/{self.model_id}/frames/{frame.frame_id}"
+        )
+        key = out["model_metrics"][0]["predictions_frame"]["name"]
+        return H2OFrame.from_key(self._conn, key)
+
+    def model_performance(self, frame: H2OFrame) -> Dict[str, Any]:
+        frame.refresh()
+        out = self._conn.request(
+            f"POST /3/Predictions/models/{self.model_id}/frames/{frame.frame_id}"
+        )
+        return out["model_metrics"][0]
+
+    def download_mojo(self, path: str) -> str:
+        raw = self._conn.request(f"GET /3/Models/{self.model_id}/mojo", raw=True)
+        with open(path, "wb") as f:
+            f.write(raw)
+        return path
+
+    def __repr__(self) -> str:
+        return f"<H2OModel {self.model_id}>"
+
+
+class H2OEstimator:
+    """Base estimator (h2o-py estimator_base.H2OEstimator)."""
+
+    algo: str = "?"
+
+    def __init__(self, **params: Any) -> None:
+        self._params = params
+        self.model: Optional[H2OModel] = None
+
+    def train(
+        self,
+        x: Optional[List[str]] = None,
+        y: Optional[str] = None,
+        training_frame: Optional[H2OFrame] = None,
+        validation_frame: Optional[H2OFrame] = None,
+    ) -> H2OModel:
+        if training_frame is None:
+            raise ValueError("training_frame required")
+        training_frame.refresh()
+        payload: Dict[str, Any] = dict(self._params)
+        payload["training_frame"] = training_frame.frame_id
+        if validation_frame is not None:
+            validation_frame.refresh()
+            payload["validation_frame"] = validation_frame.frame_id
+        if y is not None:
+            payload["response_column"] = y
+        if x is not None:
+            ignored = [
+                c for c in training_frame.names if c not in x and c != y
+            ]
+            payload["ignored_columns"] = ignored
+        conn = training_frame._conn
+        out = conn.request(f"POST /3/ModelBuilders/{self.algo}", payload)
+        self.model = H2OModel(conn, out["model_id"]["name"])
+        return self.model
+
+    def predict(self, frame: H2OFrame) -> H2OFrame:
+        if self.model is None:
+            raise ValueError("train first")
+        return self.model.predict(frame)
+
+    def __getattr__(self, name):  # delegate metrics to the trained model
+        if name.startswith("_"):
+            raise AttributeError(name)
+        model = self.__dict__.get("model")
+        if model is not None:
+            return getattr(model, name)
+        raise AttributeError(name)
+
+
+def _make(algo: str, cls_name: str):
+    cls = type(cls_name, (H2OEstimator,), {"algo": algo})
+    cls.__doc__ = f"h2o-py style estimator for the {algo!r} REST algo."
+    return cls
+
+
+# the h2o-py estimator surface (h2o-py/h2o/estimators/, SURVEY.md Appendix C)
+H2OGradientBoostingEstimator = _make("gbm", "H2OGradientBoostingEstimator")
+H2ORandomForestEstimator = _make("drf", "H2ORandomForestEstimator")
+H2OXGBoostEstimator = _make("xgboost", "H2OXGBoostEstimator")
+H2OGeneralizedLinearEstimator = _make("glm", "H2OGeneralizedLinearEstimator")
+H2OGeneralizedAdditiveEstimator = _make("gam", "H2OGeneralizedAdditiveEstimator")
+H2ODeepLearningEstimator = _make("deeplearning", "H2ODeepLearningEstimator")
+H2OKMeansEstimator = _make("kmeans", "H2OKMeansEstimator")
+H2ONaiveBayesEstimator = _make("naivebayes", "H2ONaiveBayesEstimator")
+H2OPrincipalComponentAnalysisEstimator = _make("pca", "H2OPrincipalComponentAnalysisEstimator")
+H2OSingularValueDecompositionEstimator = _make("svd", "H2OSingularValueDecompositionEstimator")
+H2OIsolationForestEstimator = _make("isolationforest", "H2OIsolationForestEstimator")
+H2OExtendedIsolationForestEstimator = _make(
+    "extendedisolationforest", "H2OExtendedIsolationForestEstimator"
+)
+H2OCoxProportionalHazardsEstimator = _make("coxph", "H2OCoxProportionalHazardsEstimator")
+H2OGeneralizedLowRankEstimator = _make("glrm", "H2OGeneralizedLowRankEstimator")
+H2OPSVMEstimator = _make("psvm", "H2OPSVMEstimator")
+H2ORuleFitEstimator = _make("rulefit", "H2ORuleFitEstimator")
+H2OStackedEnsembleEstimator = _make("stackedensemble", "H2OStackedEnsembleEstimator")
+H2OWord2vecEstimator = _make("word2vec", "H2OWord2vecEstimator")
+H2OAggregatorEstimator = _make("aggregator", "H2OAggregatorEstimator")
+H2OTargetEncoderEstimator = _make("targetencoder", "H2OTargetEncoderEstimator")

@@ -1,0 +1,73 @@
+"""Lazy expression DAG compiled to Rapids ASTs.
+
+Reference: ``h2o-py/h2o/expr.py:27-104`` — ``ExprNode``: an op + children,
+stringified to the Lisp wire form, evaluated server-side on first use of
+shape/data, with the result cached under a session temp key.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, List, Optional, Tuple, Union
+
+
+_tmp_counter = itertools.count()
+
+
+def _quote(s: str) -> str:
+    return '"' + s.replace("\\", "\\\\").replace('"', '\\"') + '"'
+
+
+def _to_ast(x: Any) -> str:
+    """Render one argument to the Rapids wire syntax (expr.py _arg_to_expr)."""
+    from h2o3_tpu.client.frame import H2OFrame
+
+    if isinstance(x, ExprNode):
+        return x.to_rapids()
+    if isinstance(x, H2OFrame):
+        return x._ex.to_rapids()
+    if isinstance(x, bool):
+        return "1" if x else "0"
+    if isinstance(x, (int, float)):
+        return repr(x)
+    if isinstance(x, str):
+        return _quote(x)
+    if x is None:
+        return '""'
+    if isinstance(x, slice):  # [lo:hi) row/col ranges render as [lo:count]
+        if x.step not in (None, 1):
+            raise TypeError("stepped slices are not supported by rapids ranges")
+        if x.stop is None:
+            raise TypeError(
+                "open-ended slice reached the wire layer; H2OFrame.__getitem__"
+                " should have bounded it"
+            )
+        lo = x.start or 0
+        return f"[{lo}:{x.stop - lo}]"
+    if isinstance(x, (list, tuple)):
+        return "[" + " ".join(_to_ast(v) for v in x) + "]"
+    raise TypeError(f"cannot render {type(x)} into a rapids ast")
+
+
+class ExprNode:
+    """One node: op + args; leaves are frame keys / literals."""
+
+    def __init__(self, op: str, *args: Any) -> None:
+        self._op = op
+        self._args = args
+
+    def to_rapids(self) -> str:
+        if self._op == "__key__":  # leaf: a server-side frame key
+            return str(self._args[0])
+        return "(" + self._op + "".join(" " + _to_ast(a) for a in self._args) + ")"
+
+    @staticmethod
+    def key(frame_key: str) -> "ExprNode":
+        return ExprNode("__key__", frame_key)
+
+    @staticmethod
+    def tmp_key() -> str:
+        return f"py_tmp_{next(_tmp_counter)}"
+
+    def __repr__(self) -> str:
+        return f"<Expr {self.to_rapids()}>"

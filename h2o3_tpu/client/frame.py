@@ -1,0 +1,278 @@
+"""H2OFrame — the client-side lazy dataframe.
+
+Reference: ``h2o-py/h2o/frame.py:41`` (5.2k LoC H2OFrame) +
+``h2o-py/h2o/expr.py`` cache semantics: operations build an ExprNode DAG;
+the first use of shape/summary/data triggers one Rapids round trip that
+materializes the result under a session temp key and caches nrows/ncols/
+names/types client-side.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from h2o3_tpu.client.connection import H2OConnection
+from h2o3_tpu.client.expr import ExprNode, _to_ast
+
+
+class H2OFrame:
+    def __init__(self, conn: H2OConnection, ex: ExprNode) -> None:
+        self._conn = conn
+        self._ex = ex
+        self._key: Optional[str] = None  # set once materialized
+        self._nrows: Optional[int] = None
+        self._ncols: Optional[int] = None
+        self._names: Optional[List[str]] = None
+
+    # -- construction --------------------------------------------------------
+    @staticmethod
+    def from_key(conn: H2OConnection, key: str, nrows=None, ncols=None) -> "H2OFrame":
+        fr = H2OFrame(conn, ExprNode.key(key))
+        fr._key = key
+        fr._nrows, fr._ncols = nrows, ncols
+        return fr
+
+    # -- evaluation (expr.py _eager_frame / _eager_scalar) -------------------
+    def refresh(self) -> "H2OFrame":
+        """Materialize under a session temp key; cache the shape."""
+        if self._key is None:
+            sid = self._conn.ensure_session()
+            # session id in the key: two clients of one server must not
+            # clobber each other's temps (h2o-py scopes temp keys the same way)
+            tmp = f"{sid}_{ExprNode.tmp_key()}"
+            out = self._conn.request(
+                "POST /99/Rapids",
+                {"ast": f"(tmp= {tmp} {self._ex.to_rapids()})", "session_id": sid},
+            )
+            self._key = out["key"]["name"]
+            self._nrows = out["num_rows"]
+            self._ncols = out["num_cols"]
+            self._ex = ExprNode.key(self._key)
+        return self
+
+    def _scalar(self, ex: ExprNode) -> Any:
+        sid = self._conn.ensure_session()
+        out = self._conn.request(
+            "POST /99/Rapids", {"ast": ex.to_rapids(), "session_id": sid}
+        )
+        if "scalar" in out:
+            v = out["scalar"]
+            return v[0] if isinstance(v, list) and len(v) == 1 else v
+        if "string" in out:
+            return out["string"]
+        return H2OFrame(self._conn, ExprNode.key(out["key"]["name"]))
+
+    @property
+    def frame_id(self) -> str:
+        self.refresh()
+        return self._key
+
+    # -- shape ---------------------------------------------------------------
+    @property
+    def nrows(self) -> int:
+        if self._nrows is None:
+            self._nrows = int(self._scalar(ExprNode("nrow", self)))
+        return self._nrows
+
+    @property
+    def ncols(self) -> int:
+        if self._ncols is None:
+            self._ncols = int(self._scalar(ExprNode("ncol", self)))
+        return self._ncols
+
+    @property
+    def dim(self) -> List[int]:
+        return [self.nrows, self.ncols]
+
+    @property
+    def names(self) -> List[str]:
+        if self._names is None:
+            self.refresh()
+            out = self._conn.request(f"GET /3/Frames/{self._key}")
+            self._names = out["frames"][0]["column_names"]
+        return self._names
+
+    @property
+    def columns(self) -> List[str]:
+        return self.names
+
+    @property
+    def types(self) -> Dict[str, str]:
+        self.refresh()
+        out = self._conn.request(f"GET /3/Frames/{self._key}")
+        return {c["label"]: c["type"] for c in out["frames"][0]["columns"]}
+
+    # -- derived frames ------------------------------------------------------
+    def _unary(self, op: str, *extra) -> "H2OFrame":
+        return H2OFrame(self._conn, ExprNode(op, self, *extra))
+
+    def _binop(self, op: str, rhs: Any, reverse: bool = False) -> "H2OFrame":
+        a, b = (rhs, self) if reverse else (self, rhs)
+        return H2OFrame(self._conn, ExprNode(op, a, b))
+
+    def __add__(self, o): return self._binop("+", o)
+    def __radd__(self, o): return self._binop("+", o, True)
+    def __sub__(self, o): return self._binop("-", o)
+    def __rsub__(self, o): return self._binop("-", o, True)
+    def __mul__(self, o): return self._binop("*", o)
+    def __rmul__(self, o): return self._binop("*", o, True)
+    def __truediv__(self, o): return self._binop("/", o)
+    def __rtruediv__(self, o): return self._binop("/", o, True)
+    def __pow__(self, o): return self._binop("^", o)
+    def __mod__(self, o): return self._binop("%", o)
+    def __eq__(self, o): return self._binop("==", o)  # type: ignore[override]
+    def __ne__(self, o): return self._binop("!=", o)  # type: ignore[override]
+    def __lt__(self, o): return self._binop("<", o)
+    def __le__(self, o): return self._binop("<=", o)
+    def __gt__(self, o): return self._binop(">", o)
+    def __ge__(self, o): return self._binop(">=", o)
+    def __and__(self, o): return self._binop("&", o)
+    def __or__(self, o): return self._binop("|", o)
+    def __invert__(self): return self._unary("not")
+    def __neg__(self): return self._binop("-", 0, True)
+
+    def __hash__(self):  # __eq__ is element-wise, keep hashability
+        return id(self)
+
+    def _bound_rows_slice(self, s: slice) -> slice:
+        """Normalize a row slice: reject steps, bound open ends (stepped and
+        negative ranges are outside the rapids [lo:count] wire form)."""
+        if s.step not in (None, 1):
+            raise TypeError("H2OFrame slicing does not support a step")
+        start = s.start or 0
+        stop = self.nrows if s.stop is None else s.stop
+        if start < 0 or stop < 0:
+            raise TypeError("H2OFrame slicing does not support negative indices")
+        return slice(start, max(stop, start))
+
+    def __getitem__(self, item) -> "H2OFrame":
+        """fr["col"], fr[["a","b"]], fr[rows_expr, :], fr[1:5, "a"] — the
+        slicing surface of h2o-py frame.py __getitem__."""
+        if isinstance(item, str):
+            return self._unary("cols_py", item)
+        if isinstance(item, (list, tuple)) and all(isinstance(i, str) for i in item):
+            return self._unary("cols_py", list(item))
+        if isinstance(item, int):
+            return self._unary("cols_py", item)
+        if isinstance(item, slice):
+            return H2OFrame(
+                self._conn, ExprNode("rows", self, self._bound_rows_slice(item))
+            )
+        if isinstance(item, H2OFrame):  # boolean row mask
+            return H2OFrame(self._conn, ExprNode("rows", self, item))
+        if isinstance(item, tuple) and len(item) == 2:
+            rows, cols = item
+            base = self
+            if not (isinstance(cols, slice) and cols == slice(None)):
+                base = base[cols]
+            if isinstance(rows, slice):
+                if rows == slice(None):
+                    return base
+                rows = self._bound_rows_slice(rows)
+            return H2OFrame(self._conn, ExprNode("rows", base, rows))
+        raise TypeError(f"cannot index H2OFrame with {item!r}")
+
+    # -- reducers (eager scalars) -------------------------------------------
+    def mean(self, na_rm: bool = True):
+        return self._scalar(ExprNode("mean", self, na_rm, 0))
+
+    def sum(self, na_rm: bool = True):
+        return self._scalar(ExprNode("sum", self, na_rm))
+
+    def min(self):
+        return self._scalar(ExprNode("min", self, True))
+
+    def max(self):
+        return self._scalar(ExprNode("max", self, True))
+
+    def sd(self):
+        return self._scalar(ExprNode("sd", self, True))
+
+    def median(self, na_rm: bool = True):
+        return self._scalar(ExprNode("median", self, na_rm))
+
+    def nacnt(self):
+        v = self._scalar(ExprNode("naCnt", self))
+        return v if isinstance(v, list) else [v]
+
+    def unique(self) -> "H2OFrame":
+        return self._unary("unique")
+
+    def table(self) -> "H2OFrame":
+        return self._unary("table", False)
+
+    # -- munging -------------------------------------------------------------
+    def asfactor(self) -> "H2OFrame":
+        return self._unary("as.factor")
+
+    def asnumeric(self) -> "H2OFrame":
+        return self._unary("as.numeric")
+
+    def ascharacter(self) -> "H2OFrame":
+        return self._unary("as.character")
+
+    def cbind(self, other: "H2OFrame") -> "H2OFrame":
+        return H2OFrame(self._conn, ExprNode("cbind", self, other))
+
+    def rbind(self, other: "H2OFrame") -> "H2OFrame":
+        return H2OFrame(self._conn, ExprNode("rbind", self, other))
+
+    def set_names(self, names: List[str]) -> "H2OFrame":
+        fr = H2OFrame(
+            self._conn,
+            ExprNode("colnames=", self, list(range(len(names))), names),
+        )
+        return fr
+
+    def sort(self, by: Union[str, List[str]], ascending: bool = True) -> "H2OFrame":
+        cols = [by] if isinstance(by, str) else list(by)
+        idxs = [self.names.index(c) for c in cols]
+        flags = [1 if ascending else 0] * len(idxs)
+        return H2OFrame(self._conn, ExprNode("sort", self, idxs, flags))
+
+    def merge(self, other: "H2OFrame", all_x: bool = False, all_y: bool = False) -> "H2OFrame":
+        return H2OFrame(
+            self._conn,
+            ExprNode("merge", self, other, all_x, all_y, [], [], "auto"),
+        )
+
+    def group_by_sum(self, by: str, col: str) -> "H2OFrame":
+        """Minimal groupby surface: (GB fr [by] "sum" col "all")."""
+        bi = self.names.index(by)
+        ci = self.names.index(col)
+        return H2OFrame(
+            self._conn, ExprNode("GB", self, [bi], "sum", ci, "all")
+        )
+
+    # -- materialization -----------------------------------------------------
+    def get_frame_data(self) -> Dict[str, list]:
+        """Full data download via /3/DownloadDataset (frame.py
+        get_frame_data)."""
+        self.refresh()
+        raw = self._conn.request(
+            f"GET /3/DownloadDataset?frame_id={self._key}", raw=True
+        )
+        import csv
+        import io
+
+        rows = list(csv.reader(io.StringIO(raw.decode())))
+        head, body = rows[0], rows[1:]
+        return {
+            name: [r[i] if i < len(r) else None for r in body]
+            for i, name in enumerate(head)
+        }
+
+    def as_data_frame(self):
+        import pandas as pd
+
+        data = self.get_frame_data()
+        df = pd.DataFrame(data)
+        return df.apply(pd.to_numeric, errors="ignore") if hasattr(df, "apply") else df
+
+    def head(self, rows: int = 10) -> "H2OFrame":
+        return H2OFrame(self._conn, ExprNode("rows", self, slice(0, rows)))
+
+    def __repr__(self) -> str:
+        if self._key:
+            return f"<H2OFrame {self._key} {self._nrows}x{self._ncols}>"
+        return f"<H2OFrame lazy {self._ex.to_rapids()[:60]}>"

@@ -1,0 +1,156 @@
+"""Python client end-to-end over real HTTP (the h2o-py surface:
+init -> upload -> munge lazily via rapids -> train -> predict -> mojo)."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu import client as h2o
+
+
+@pytest.fixture(scope="module")
+def conn():
+    c = h2o.init()
+    yield c
+    h2o.shutdown()
+
+
+@pytest.fixture()
+def iris(conn):
+    rng = np.random.default_rng(11)
+    n = 240
+    sl = rng.normal(5.8, 0.8, n)
+    sw = rng.normal(3.0, 0.4, n)
+    species = np.where(sl + sw + rng.normal(0, 0.5, n) > 9.0, "virginica", "setosa")
+    csv = "sepal_len,sepal_wid,species\n" + "\n".join(
+        f"{a:.4f},{b:.4f},{c}" for a, b, c in zip(sl, sw, species)
+    ) + "\n"
+    return h2o.upload_csv(csv)
+
+
+class TestClientFrames:
+    def test_shape_and_names(self, iris):
+        assert iris.dim == [240, 3]
+        assert iris.names == ["sepal_len", "sepal_wid", "species"]
+        assert iris.types["species"] == "cat"
+
+    def test_lazy_expr_scalar(self, iris):
+        col = iris["sepal_len"]
+        assert col.mean() == pytest.approx(5.8, abs=0.2)
+        assert col.max() > col.min()
+        assert iris["sepal_wid"].sd() == pytest.approx(0.4, abs=0.1)
+
+    def test_arithmetic_dag(self, iris):
+        doubled = (iris["sepal_len"] * 2 + 1).mean()
+        assert doubled == pytest.approx(iris["sepal_len"].mean() * 2 + 1, rel=1e-9)
+
+    def test_boolean_row_filter(self, iris):
+        big = iris[iris["sepal_len"] > 6.0, :]
+        assert 0 < big.nrows < 240
+        assert big["sepal_len"].min() > 6.0
+
+    def test_slicing_and_cbind(self, iris):
+        two = iris[["sepal_len", "sepal_wid"]]
+        assert two.ncols == 2
+        both = two.cbind(iris["species"])
+        assert both.ncols == 3
+        head = iris.head(5)
+        assert head.nrows == 5
+
+    def test_factor_roundtrip(self, iris):
+        fr = iris["sepal_len"].asfactor()
+        assert fr.types[fr.names[0]] == "cat"
+
+    def test_download_as_dict(self, iris):
+        data = iris.get_frame_data()
+        assert set(data) == {"sepal_len", "sepal_wid", "species"}
+        assert len(data["species"]) == 240
+
+    def test_ls_and_remove(self, conn, iris):
+        iris.refresh()
+        assert iris.frame_id in h2o.ls()
+
+
+class TestClientModels:
+    def test_gbm_train_predict(self, iris):
+        est = h2o.H2OGradientBoostingEstimator(ntrees=10, max_depth=3, seed=1)
+        model = est.train(y="species", training_frame=iris)
+        assert model.algo == "gbm"
+        assert model.auc() > 0.85
+        pred = model.predict(iris)
+        assert pred.nrows == 240
+        assert "predict" in pred.names
+
+    def test_glm_coefficients(self, iris):
+        est = h2o.H2OGeneralizedLinearEstimator(family="binomial", lambda_=0.0)
+        m = est.train(
+            x=["sepal_len", "sepal_wid"], y="species", training_frame=iris
+        )
+        coefs = m.coef()
+        assert set(coefs) >= {"sepal_len", "sepal_wid"}
+
+    def test_x_subsetting_ignores_columns(self, iris):
+        est = h2o.H2OGeneralizedLinearEstimator(family="binomial")
+        m = est.train(x=["sepal_len"], y="species", training_frame=iris)
+        assert "sepal_wid" not in m.coef()
+
+    def test_kmeans(self, iris):
+        est = h2o.H2OKMeansEstimator(k=3, seed=1, ignored_columns=["species"])
+        m = est.train(training_frame=iris)
+        pred = m.predict(iris)
+        vals = {float(v) for v in pred.get_frame_data()["predict"]}
+        assert vals <= {0.0, 1.0, 2.0}
+
+    def test_mojo_download_scores_offline(self, iris, tmp_path):
+        est = h2o.H2OGradientBoostingEstimator(ntrees=5, max_depth=3, seed=2)
+        m = est.train(y="species", training_frame=iris)
+        path = str(tmp_path / "client.mojo")
+        m.download_mojo(path)
+        from h2o3_tpu.genmodel import load_mojo
+
+        mm = load_mojo(path)
+        probs = mm.score0({"sepal_len": 6.0, "sepal_wid": 3.1})
+        assert probs.shape == (2,)
+        assert abs(probs.sum() - 1.0) < 1e-9
+
+    def test_validation_frame_metrics(self, iris):
+        est = h2o.H2OGeneralizedLinearEstimator(family="binomial")
+        m = est.train(y="species", training_frame=iris, validation_frame=iris)
+        assert m.auc(valid=True) == pytest.approx(m.auc(), abs=1e-9)
+
+    def test_error_surfaces_as_exception(self, iris):
+        est = h2o.H2OGeneralizedLinearEstimator(family="not_a_family")
+        with pytest.raises(h2o.H2OResponseError, match="family"):
+            est.train(y="species", training_frame=iris)
+
+
+class TestClientReviewFixes:
+    def test_open_ended_slice_bounded(self, iris):
+        tail = iris[5:, :]
+        assert tail.nrows == 235
+
+    def test_stepped_slice_rejected(self, iris):
+        with pytest.raises(TypeError, match="step"):
+            iris[0:10:2]
+
+    def test_two_clients_do_not_clobber_temps(self, conn):
+        c2 = h2o.H2OConnection(conn.base_url) if hasattr(h2o, "H2OConnection") else None
+        from h2o3_tpu.client.connection import H2OConnection
+        from h2o3_tpu.client.frame import H2OFrame
+        from h2o3_tpu.client.expr import ExprNode
+        import h2o3_tpu.client.expr as expr_mod
+        import itertools
+
+        a = h2o.upload_csv("v\n1\n2\n3\n")
+        b_conn = H2OConnection(conn.base_url)
+        b = H2OFrame.from_key(b_conn, a.frame_id, nrows=3, ncols=1)
+        # reset the counter to simulate a second process starting at 0
+        expr_mod._tmp_counter = itertools.count()
+        da = (a["v"] * 2)
+        da.refresh()
+        expr_mod._tmp_counter = itertools.count()
+        db = (b["v"] * 3)
+        db.refresh()
+        assert da.frame_id != db.frame_id  # session-scoped keys
+        assert da["v"].mean() == pytest.approx(4.0)
+        assert db["v"].mean() == pytest.approx(6.0)
+        b_conn.close()

@@ -1,0 +1,274 @@
+"""REST v3 API over real HTTP sockets (reference tests run real sockets on
+localhost too — SURVEY.md §4 'no mocked network backends')."""
+
+import json
+import urllib.request
+import urllib.parse
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.api import start_server
+
+CSV = "sepal_len,species,weight\n5.1,setosa,1.0\n4.9,setosa,0.9\n6.3,virginica,1.4\n5.8,virginica,1.2\n6.1,virginica,1.3\n5.0,setosa,1.05\n"
+
+
+@pytest.fixture(scope="module")
+def server():
+    s = start_server(port=0)
+    yield s
+    s.stop()
+
+
+def _req(server, method, path, data=None, raw=False):
+    url = server.url + path
+    body = None
+    headers = {}
+    if data is not None:
+        body = json.dumps(data).encode()
+        headers["Content-Type"] = "application/json"
+    req = urllib.request.Request(url, data=body, headers=headers, method=method)
+    try:
+        with urllib.request.urlopen(req) as resp:
+            payload = resp.read()
+            return resp.status, payload if raw else json.loads(payload)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _upload_and_parse(server, csv, dest):
+    st, up = _req(server, "POST", "/3/PostFile", {"data": csv})
+    assert st == 200
+    st, out = _req(
+        server, "POST", "/3/Parse",
+        {"source_frames": [up["destination_frame"]], "destination_frame": dest},
+    )
+    assert st == 200, out
+    return out["destination_frame"]["name"]
+
+
+class TestCloudAndMetadata:
+    def test_cloud(self, server):
+        st, out = _req(server, "GET", "/3/Cloud")
+        assert st == 200
+        assert out["cloud_size"] == 1
+        assert out["cloud_healthy"] is True
+
+    def test_endpoints_inventory(self, server):
+        st, out = _req(server, "GET", "/3/Metadata/endpoints")
+        assert st == 200
+        assert len(out["routes"]) > 25
+
+    def test_capabilities_lists_all_algos(self, server):
+        st, out = _req(server, "GET", "/3/Capabilities")
+        names = {c["name"] for c in out["capabilities"]}
+        assert {"gbm", "glm", "deeplearning", "kmeans", "xgboost", "coxph"} <= names
+
+    def test_404_error_schema(self, server):
+        st, out = _req(server, "GET", "/3/Nope")
+        assert st == 404
+        assert "msg" in out and out["http_status"] == 404
+
+
+class TestFramesOverRest:
+    def test_upload_parse_get_delete(self, server):
+        key = _upload_and_parse(server, CSV, "iris_mini.hex")
+        assert key == "iris_mini.hex"
+        st, out = _req(server, "GET", "/3/Frames/iris_mini.hex")
+        assert st == 200
+        fr = out["frames"][0]
+        assert fr["rows"] == 6
+        assert fr["column_names"] == ["sepal_len", "species", "weight"]
+        cols = {c["label"]: c for c in fr["columns"]}
+        assert cols["species"]["type"] == "cat"
+        assert set(cols["species"]["domain"]) == {"setosa", "virginica"}
+        assert cols["sepal_len"]["mean"] == pytest.approx(5.533, abs=1e-2)
+
+        st, _ = _req(server, "DELETE", "/3/Frames/iris_mini.hex")
+        assert st == 200
+        st, _ = _req(server, "GET", "/3/Frames/iris_mini.hex")
+        assert st == 404
+
+    def test_parse_setup_guess(self, server):
+        st, up = _req(server, "POST", "/3/PostFile", {"data": CSV})
+        st, out = _req(
+            server, "POST", "/3/ParseSetup",
+            {"source_frames": [up["destination_frame"]]},
+        )
+        assert st == 200
+        assert out["column_names"] == ["sepal_len", "species", "weight"]
+        assert out["number_columns"] == 3
+
+    def test_download_roundtrip(self, server):
+        key = _upload_and_parse(server, CSV, "dl_rt.hex")
+        st, raw = _req(server, "GET", f"/3/DownloadDataset?frame_id={key}", raw=True)
+        assert st == 200
+        assert raw.decode().splitlines()[0] == "sepal_len,species,weight"
+
+    def test_split_frame(self, server):
+        csv = "x\n" + "\n".join(str(i) for i in range(200))
+        key = _upload_and_parse(server, csv, "sf.hex")
+        st, out = _req(
+            server, "POST", "/3/SplitFrame",
+            {"dataset": key, "ratios": [0.7], "seed": 42},
+        )
+        assert st == 200
+        keys = [d["name"] for d in out["destination_frames"]]
+        assert len(keys) == 2
+        sizes = []
+        for k in keys:
+            st, fo = _req(server, "GET", f"/3/Frames/{k}")
+            sizes.append(fo["frames"][0]["rows"])
+        assert sum(sizes) == 200
+        assert 110 <= sizes[0] <= 170
+
+
+class TestRapidsOverRest:
+    def test_session_and_exec(self, server):
+        st, s = _req(server, "POST", "/4/sessions")
+        assert st == 200
+        sid = s["session_key"]
+        key = _upload_and_parse(server, CSV, "rap.hex")
+        st, out = _req(
+            server, "POST", "/99/Rapids",
+            {"ast": f"(mean (cols {key} 'sepal_len') 0 0)", "session_id": sid},
+        )
+        assert st == 200, out
+        val = out.get("scalar")
+        if isinstance(val, list):
+            val = val[0]
+        assert val == pytest.approx(5.533, abs=1e-2)
+        st, out = _req(server, "DELETE", f"/4/sessions/{sid}")
+        assert st == 200
+
+    def test_rapids_error_is_400(self, server):
+        st, out = _req(server, "POST", "/99/Rapids", {"ast": "(not_a_prim 1)"})
+        assert st == 400
+
+
+class TestModelsOverRest:
+    def _train_frame(self, server, rng, dest):
+        n = 300
+        x0 = rng.normal(size=n)
+        x1 = rng.normal(size=n)
+        y = np.where(x0 + 0.5 * x1 + rng.normal(size=n) * 0.4 > 0, "yes", "no")
+        rows = "\n".join(f"{a:.5f},{b:.5f},{c}" for a, b, c in zip(x0, x1, y))
+        return _upload_and_parse(server, "x0,x1,y\n" + rows + "\n", dest)
+
+    def test_train_get_predict_delete(self, server):
+        rng = np.random.default_rng(3)
+        key = self._train_frame(server, rng, "trainfr.hex")
+        st, out = _req(
+            server, "POST", "/3/ModelBuilders/gbm",
+            {"training_frame": key, "response_column": "y", "ntrees": 5,
+             "max_depth": "3", "seed": 1, "model_id": "gbm_rest_1"},
+        )
+        assert st == 200, out
+        assert out["model_id"]["name"] == "gbm_rest_1"
+        assert out["job"]["status"] == "DONE"
+
+        st, out = _req(server, "GET", "/3/Models/gbm_rest_1")
+        assert st == 200
+        mo = out["models"][0]
+        assert mo["algo"] == "gbm"
+        assert mo["output"]["model_category"] == "Binomial"
+        assert mo["output"]["training_metrics"]["auc"] > 0.8
+        assert mo["parameters"]["ntrees"] == 5
+
+        st, out = _req(
+            server, "POST", f"/3/Predictions/models/gbm_rest_1/frames/{key}"
+        )
+        assert st == 200
+        pred_key = out["model_metrics"][0]["predictions_frame"]["name"]
+        st, out = _req(server, "GET", f"/3/Frames/{pred_key}")
+        assert out["frames"][0]["rows"] == 300
+        assert "predict" in out["frames"][0]["column_names"]
+
+        st, raw = _req(server, "GET", "/3/Models/gbm_rest_1/mojo", raw=True)
+        assert st == 200 and raw[:2] == b"PK"  # a zip
+
+        st, _ = _req(server, "DELETE", "/3/Models/gbm_rest_1")
+        assert st == 200
+        st, _ = _req(server, "GET", "/3/Models/gbm_rest_1")
+        assert st == 404
+
+    def test_train_bad_params_is_400(self, server):
+        rng = np.random.default_rng(4)
+        key = self._train_frame(server, rng, "badp.hex")
+        st, out = _req(
+            server, "POST", "/3/ModelBuilders/glm",
+            {"training_frame": key, "response_column": "y", "family": "nope"},
+        )
+        assert st == 400
+        assert "family" in out["msg"]
+
+    def test_unknown_algo_404(self, server):
+        st, _ = _req(server, "POST", "/3/ModelBuilders/nosuch", {})
+        assert st == 404
+
+    def test_grid_over_rest(self, server):
+        rng = np.random.default_rng(5)
+        key = self._train_frame(server, rng, "gridfr.hex")
+        st, out = _req(
+            server, "POST", "/99/Grid/glm",
+            {
+                "training_frame": key,
+                "response_column": "y",
+                "family": "binomial",
+                "hyper_parameters": {"lambda_": [0.0, 0.1]},
+            },
+        )
+        assert st == 200, out
+        gid = out["grid_id"]["name"]
+        assert len(out["model_ids"]) == 2
+        st, out = _req(server, "GET", f"/99/Grids/{gid}")
+        assert st == 200
+        assert len(out["model_ids"]) == 2
+
+
+class TestJobsOverRest:
+    def test_jobs_listed(self, server):
+        st, out = _req(server, "GET", "/3/Jobs")
+        assert st == 200
+        assert isinstance(out["jobs"], list)
+
+
+class TestRestReviewFixes:
+    def test_split_exact_ratios_no_empty_extra(self, server):
+        csv = "x\n" + "\n".join(str(i) for i in range(100))
+        key = _upload_and_parse(server, csv, "sf2.hex")
+        st, out = _req(
+            server, "POST", "/3/SplitFrame",
+            {"dataset": key, "ratios": [0.5, 0.5], "seed": 1,
+             "destination_frames": ["sfa.hex", "sfb.hex"]},
+        )
+        assert st == 200
+        keys = [d["name"] for d in out["destination_frames"]]
+        assert keys == ["sfa.hex", "sfb.hex"]
+
+    def test_parse_honors_forced_column_types(self, server):
+        csv = "id,v\n1,10\n2,20\n1,30\n"
+        st, up = _req(server, "POST", "/3/PostFile", {"data": csv})
+        st, out = _req(
+            server, "POST", "/3/Parse",
+            {"source_frames": [up["destination_frame"]],
+             "destination_frame": "typed.hex",
+             "column_names": ["id", "v"],
+             "column_types": ["enum", "numeric"]},
+        )
+        assert st == 200, out
+        st, out = _req(server, "GET", "/3/Frames/typed.hex")
+        cols = {c["label"]: c["type"] for c in out["frames"][0]["columns"]}
+        assert cols["id"] == "cat"
+        assert cols["v"] == "num"
+
+    def test_no_phantom_created_jobs_after_train(self, server):
+        rng = np.random.default_rng(9)
+        key = TestModelsOverRest()._train_frame(server, rng, "jobfr.hex")
+        st, _ = _req(
+            server, "POST", "/3/ModelBuilders/glm",
+            {"training_frame": key, "response_column": "y", "family": "binomial"},
+        )
+        assert st == 200
+        st, out = _req(server, "GET", "/3/Jobs")
+        assert all(j["status"] != "CREATED" for j in out["jobs"])
