@@ -245,10 +245,7 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
 
     # ---- jobs -------------------------------------------------------------
     def jobs_list(params):
-        out = [
-            _job_schema(DKV.get(k)) for k in DKV.keys() if isinstance(DKV.get(k), Job)
-        ]
-        return {"jobs": out}
+        return {"jobs": [_job_schema(DKV.get(k)) for k in DKV.keys_of_type(Job)]}
 
     def job_get(params, job_id):
         j = DKV.get(job_id)
@@ -356,11 +353,10 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
     # ---- frames -----------------------------------------------------------
     def frames_list(params):
         out = []
-        for k in DKV.keys():
+        for k in DKV.keys_of_type(Frame):
             v = DKV.get(k)
-            if isinstance(v, Frame):
-                out.append({"frame_id": {"name": k}, "rows": v.nrows,
-                            "num_columns": v.ncols})
+            out.append({"frame_id": {"name": k}, "rows": v.nrows,
+                        "num_columns": v.ncols})
         return {"frames": out}
 
     def frame_get(params, frame_id):
@@ -380,16 +376,36 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
         return {"frame_id": {"name": frame_id}}
 
     def frames_delete_all(params):
-        for k in list(DKV.keys()):
-            if isinstance(DKV.get(k), Frame):
-                DKV.remove(k)
+        for k in DKV.keys_of_type(Frame):
+            DKV.remove(k)
         return {}
 
     def download_dataset(params):
+        """CSV straight from the columns — no pandas: the pandas/pyarrow
+        string-index path is not thread-safe under ThreadingHTTPServer and
+        segfaulted the server in testing."""
+        import csv as _csv
+
         fr = _get_frame(params.get("frame_id", ""))
         buf = io.StringIO()
-        df = fr.to_pandas()
-        df.to_csv(buf, index=False)
+        w = _csv.writer(buf, lineterminator="\n")
+        w.writerow(fr.names)
+        rendered = []
+        for c in fr.columns:
+            if c.type is ColType.CAT:
+                dom = c.domain
+                rendered.append(
+                    [dom[v] if v >= 0 else "" for v in c.data]
+                )
+            elif c.type is ColType.STR:
+                rendered.append(["" if v is None else str(v) for v in c.data])
+            else:
+                rendered.append([
+                    "" if np.isnan(v) else (repr(int(v)) if float(v).is_integer() else repr(float(v)))
+                    for v in c.data
+                ])
+        for row in zip(*rendered):
+            w.writerow(row)
         return buf.getvalue().encode()
 
     def split_frame(params):
@@ -542,10 +558,8 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
     # ---- models -----------------------------------------------------------
     def models_list(params):
         out = []
-        for k in DKV.keys():
-            v = DKV.get(k)
-            if isinstance(v, Model):
-                out.append({"model_id": {"name": k}, "algo": v.algo_name})
+        for k in DKV.keys_of_type(Model):
+            out.append({"model_id": {"name": k}, "algo": DKV.get(k).algo_name})
         return {"models": out}
 
     def model_get(params, model_id):
@@ -557,9 +571,8 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
         return {}
 
     def models_delete_all(params):
-        for k in list(DKV.keys()):
-            if isinstance(DKV.get(k), Model):
-                DKV.remove(k)
+        for k in DKV.keys_of_type(Model):
+            DKV.remove(k)
         return {}
 
     def model_mojo(params, model_id):
@@ -633,10 +646,8 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
 
     def grids_list(params):
         out = []
-        for k in DKV.keys():
-            v = DKV.get(k)
-            if isinstance(v, Grid):
-                out.append({"grid_id": {"name": k}, "model_count": len(v.models)})
+        for k in DKV.keys_of_type(Grid):
+            out.append({"grid_id": {"name": k}, "model_count": len(DKV.get(k).models)})
         return {"grids": out}
 
     def grid_get(params, grid_id):
@@ -655,6 +666,57 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
     r.register("POST", "/99/Grid/{algo}", grid_train, "grid search")
     r.register("GET", "/99/Grids", grids_list, "list grids")
     r.register("GET", "/99/Grids/{grid_id}", grid_get, "grid details")
+
+    # ---- automl (h2o-automl REST: /99/AutoMLBuilder, leaderboard) ---------
+    def automl_build(params):
+        from h2o3_tpu.automl import AutoML
+
+        fr = _get_frame(params.get("training_frame", ""))
+        y = params.get("response_column")
+        if not y:
+            raise RestError(400, "response_column required")
+        kw: Dict[str, Any] = {}
+        for k, cast in (
+            ("max_models", int), ("max_runtime_secs", float), ("seed", int),
+            ("nfolds", int), ("sort_metric", str),
+        ):
+            if params.get(k) is not None:
+                kw[k] = cast(params[k])
+        for k in ("include_algos", "exclude_algos"):
+            v = params.get(k)
+            if isinstance(v, str):
+                v = json.loads(v.replace("'", '"'))
+            if v:
+                kw[k] = v
+        aml = AutoML(**kw)
+        x = params.get("x")
+        if isinstance(x, str):
+            x = json.loads(x.replace("'", '"'))
+        try:
+            aml.train(y=y, training_frame=fr, x=x)
+        except Exception as e:
+            raise RestError(400, f"automl failed: {type(e).__name__}: {e}")
+        return {
+            "automl_id": {"name": aml.project_key},
+            "leader": {"name": aml.leader.key},
+            "leaderboard": aml.leaderboard.as_table(),
+        }
+
+    def automl_get(params, automl_id):
+        from h2o3_tpu.automl import AutoML
+
+        aml = DKV.get(automl_id)
+        if not isinstance(aml, AutoML):
+            raise RestError(404, f"automl {automl_id!r} not found")
+        return {
+            "automl_id": {"name": aml.project_key},
+            "leader": {"name": aml.leader.key} if aml.leader else None,
+            "leaderboard": aml.leaderboard.as_table(),
+            "event_log": aml.event_log.events,
+        }
+
+    r.register("POST", "/99/AutoMLBuilder", automl_build, "run automl")
+    r.register("GET", "/99/AutoML/{automl_id}", automl_get, "automl results")
 
     # ---- diagnostics (TimeLine / logs / jstack analogues) -----------------
     r.register("GET", "/3/Timeline", lambda p: {

@@ -1,0 +1,12 @@
+"""AutoML — budgeted automatic model selection + stacking.
+
+Reference: ``h2o-automl`` (9.2k LoC, SURVEY.md §2.5): ``AutoML.java:40``
+orchestrator running provider-registered modeling steps
+(``modeling/{XGBoost,GLM,DRF,GBM,DeepLearning,StackedEnsemble}StepsProvider``)
+under a time/model budget (``WorkAllocations``), CV-metric leaderboard
+(``leaderboard/``), event log (``events/EventLog.java``).
+"""
+
+from h2o3_tpu.automl.automl import AutoML, EventLog, Leaderboard
+
+__all__ = ["AutoML", "EventLog", "Leaderboard"]

@@ -1,0 +1,292 @@
+"""The AutoML driver: steps, budget, leaderboard, event log.
+
+Reference call shape: ``H2OAutoML(max_models=…, max_runtime_secs=…,
+seed=…).train(y=…, training_frame=…)`` then ``aml.leaderboard`` /
+``aml.leader``.  The default modeling plan mirrors the reference's step
+sequence (AutoML.java defaultModelingPlan: XGBoost defaults, GLM, DRF,
+GBM defaults, DeepLearning, random grids, StackedEnsembles best-of-family
+and all — ``modeling/*StepsProvider``); every model is trained with
+k-fold CV and the leaderboard ranks by the CV metric, exactly the
+reference's leaderboard semantics (``leaderboard/Leaderboard.java``).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field, replace
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.keyed import DKV
+from h2o3_tpu.models.framework import Model, ModelParameters
+from h2o3_tpu.models.grid import metric_value
+
+
+class EventLog:
+    """events/EventLog.java — timestamped orchestration trace."""
+
+    def __init__(self) -> None:
+        self.events: List[Dict[str, Any]] = []
+
+    def log(self, stage: str, message: str) -> None:
+        self.events.append(
+            {"timestamp": time.time(), "stage": stage, "message": message}
+        )
+
+    def __repr__(self) -> str:
+        return f"<EventLog {len(self.events)} events>"
+
+
+class Leaderboard:
+    """leaderboard/Leaderboard.java — models ranked by the sort metric."""
+
+    def __init__(self, sort_metric: str = "auto") -> None:
+        self.sort_metric = sort_metric
+        self.models: List[Model] = []
+
+    def add(self, model: Model) -> None:
+        self.models.append(model)
+        self._sort()
+
+    def _sort(self) -> None:
+        vals = [metric_value(m, self.sort_metric) for m in self.models]
+        larger = vals[0][1] if vals else True
+        order = np.argsort([v for v, _ in vals])
+        if larger:
+            order = order[::-1]
+        order = sorted(order, key=lambda i: np.isnan(vals[i][0]))
+        self.models = [self.models[i] for i in order]
+
+    @property
+    def leader(self) -> Optional[Model]:
+        return self.models[0] if self.models else None
+
+    def as_table(self) -> List[Dict[str, Any]]:
+        out = []
+        for m in self.models:
+            v, _ = metric_value(m, self.sort_metric)
+            out.append({"model_id": m.key, "algo": m.algo_name, "metric": v})
+        return out
+
+    def __repr__(self) -> str:
+        rows = "\n".join(
+            f"  {r['model_id']}  {r['algo']}  {r['metric']:.5f}"
+            for r in self.as_table()[:10]
+        )
+        return f"<Leaderboard ({self.sort_metric})>\n{rows}"
+
+
+@dataclass
+class _Step:
+    """StepDefinition/ModelingStep — one budgeted training unit."""
+
+    id: str
+    weight: int  # work allocation units (WorkAllocations.java)
+    build: Callable[["AutoML", Frame], List[Model]]
+
+
+class AutoML:
+    """The orchestrator (AutoML.java:40)."""
+
+    def __init__(
+        self,
+        max_models: int = 10,
+        max_runtime_secs: float = 0.0,
+        seed: int = -1,
+        nfolds: int = 5,
+        sort_metric: str = "auto",
+        include_algos: Optional[Sequence[str]] = None,
+        exclude_algos: Optional[Sequence[str]] = None,
+        keep_cross_validation_predictions: bool = True,
+    ) -> None:
+        self.max_models = max_models
+        self.max_runtime_secs = max_runtime_secs
+        self.seed = seed
+        self.nfolds = max(2, nfolds)
+        self.sort_metric = sort_metric
+        self.include_algos = set(a.lower() for a in include_algos) if include_algos else None
+        self.exclude_algos = set(a.lower() for a in exclude_algos) if exclude_algos else set()
+        self.keep_cv_preds = keep_cross_validation_predictions
+        self.project_key = DKV.make_key("automl")
+        self.leaderboard = Leaderboard(sort_metric)
+        self.event_log = EventLog()
+        self._t0 = 0.0
+        self._y: Optional[str] = None
+        self._ignored: List[str] = []
+        self._nclasses: int = 1
+        DKV.put(self.project_key, self)
+
+    # -- budget (WorkAllocations.java) ---------------------------------------
+    def _out_of_budget(self) -> bool:
+        if self.max_models and len(self.leaderboard.models) >= self.max_models:
+            return True
+        if self.max_runtime_secs and (time.time() - self._t0) >= self.max_runtime_secs:
+            return True
+        return False
+
+    def _algo_allowed(self, algo: str) -> bool:
+        algo = algo.lower()
+        if self.include_algos is not None:
+            return algo in self.include_algos
+        return algo not in self.exclude_algos
+
+    # -- steps (modeling/*StepsProvider) -------------------------------------
+    def _common(self, extra: Dict[str, Any]) -> Dict[str, Any]:
+        return {
+            "response_column": self._y,
+            "ignored_columns": list(self._ignored),
+            "nfolds": self.nfolds,
+            "keep_cross_validation_predictions": self.keep_cv_preds,
+            "seed": self.seed if self.seed != -1 else 42,
+            **extra,
+        }
+
+    def _one(self, builder_cls, params_cls, frame, **extra) -> List[Model]:
+        p = params_cls(**self._common(extra))
+        m = builder_cls(p).train(frame)
+        return [m]
+
+    def _default_plan(self) -> List[_Step]:
+        from h2o3_tpu.models.deeplearning import DeepLearning, DeepLearningParameters
+        from h2o3_tpu.models.glm import GLM, GLMParameters
+        from h2o3_tpu.models.tree.drf import DRF, DRFParameters
+        from h2o3_tpu.models.tree.gbm import GBM, GBMParameters
+        from h2o3_tpu.models.tree.xgboost import XGBoost, XGBoostParameters
+
+        steps: List[_Step] = []
+
+        def add(algo: str, sid: str, weight: int, fn) -> None:
+            if self._algo_allowed(algo):
+                steps.append(_Step(f"{algo}_{sid}", weight, fn))
+
+        # the reference's default plan order (AutoML.java defaultModelingPlan)
+        add("xgboost", "def_1", 10, lambda a, f: a._one(
+            XGBoost, XGBoostParameters, f, ntrees=50, max_depth=6, learn_rate=0.1))
+        if self._nclasses <= 2:  # this GLM has no multinomial family yet
+            add("glm", "def_1", 10, lambda a, f: a._one(
+                GLM, GLMParameters, f,
+                family="binomial" if a._nclasses == 2 else "gaussian",
+                alpha=0.5, lambda_=1e-4))
+        add("drf", "def_1", 10, lambda a, f: a._one(
+            DRF, DRFParameters, f, ntrees=50, max_depth=12))
+        add("gbm", "def_1", 10, lambda a, f: a._one(
+            GBM, GBMParameters, f, ntrees=50, max_depth=5, learn_rate=0.1))
+        add("gbm", "def_2", 10, lambda a, f: a._one(
+            GBM, GBMParameters, f, ntrees=50, max_depth=3, learn_rate=0.1))
+        add("deeplearning", "def_1", 10, lambda a, f: a._one(
+            DeepLearning, DeepLearningParameters, f, hidden=[32, 32], epochs=10))
+        add("xgboost", "def_2", 10, lambda a, f: a._one(
+            XGBoost, XGBoostParameters, f, ntrees=100, max_depth=4, learn_rate=0.05))
+        add("gbm", "grid_1", 20, self._gbm_grid)
+        add("stackedensemble", "best_of_family", 5,
+            lambda a, f: a._stacked(f, best_of_family=True))
+        add("stackedensemble", "all", 5, lambda a, f: a._stacked(f, best_of_family=False))
+        return steps
+
+    def _gbm_grid(self, a: "AutoML", frame: Frame) -> List[Model]:
+        """Random GBM grid (modeling/GBMStepsProvider grid step)."""
+        from h2o3_tpu.models.grid import GridSearch, SearchCriteria
+        from h2o3_tpu.models.tree.gbm import GBM, GBMParameters
+
+        budget_models = 3
+        if self.max_models:
+            budget_models = max(
+                1, min(3, self.max_models - len(self.leaderboard.models) - 2)
+            )
+        remaining = (
+            self.max_runtime_secs - (time.time() - self._t0)
+            if self.max_runtime_secs else 0.0
+        )
+        crit = SearchCriteria(
+            strategy="RandomDiscrete",
+            max_models=budget_models,
+            max_runtime_secs=max(remaining, 0.0),
+            seed=self.seed if self.seed != -1 else 42,
+        )
+        gs = GridSearch(
+            GBM,
+            GBMParameters(**self._common({})),
+            {
+                "max_depth": [3, 5, 7, 9],
+                "learn_rate": [0.05, 0.1, 0.2],
+                "sample_rate": [0.6, 0.8, 1.0],
+            },
+            search_criteria=crit,
+        )
+        grid = gs.train(frame)
+        return list(grid.models)
+
+    def _stacked(self, frame: Frame, best_of_family: bool) -> List[Model]:
+        from h2o3_tpu.models.stacked_ensemble import (
+            StackedEnsemble,
+            StackedEnsembleParameters,
+        )
+
+        bases = [
+            m for m in self.leaderboard.models
+            if m.algo_name != "stackedensemble"
+            and getattr(m, "cv_holdout_predictions", None) is not None
+        ]
+        if best_of_family:
+            seen: Dict[str, Model] = {}
+            for m in bases:  # leaderboard is sorted best-first
+                seen.setdefault(m.algo_name, m)
+            bases = list(seen.values())
+        if len(bases) < 2:
+            self.event_log.log("ModelTraining", "skip ensemble: <2 base models")
+            return []
+        p = StackedEnsembleParameters(
+            response_column=self._y, base_models=bases
+        )
+        return [StackedEnsemble(p).train(frame)]
+
+    # -- the run (AutoML.learn) ----------------------------------------------
+    def train(
+        self,
+        y: str,
+        training_frame: Frame,
+        x: Optional[Sequence[str]] = None,
+        leaderboard_frame: Optional[Frame] = None,
+    ) -> Model:
+        self._y = y
+        self._t0 = time.time()
+        ev = self.event_log
+        ev.log("Workflow", f"AutoML build started: {self.project_key}")
+        self._ignored = (
+            [c for c in training_frame.names if c not in x and c != y]
+            if x is not None else []
+        )
+        ycol = training_frame.col(y)
+        self._nclasses = len(ycol.domain) if ycol.domain else 1
+
+        for step in self._default_plan():
+            if self._out_of_budget():
+                ev.log("Workflow", f"budget exhausted before {step.id}")
+                break
+            ev.log("ModelTraining", f"step {step.id} starting")
+            try:
+                models = step.build(self, training_frame)
+            except Exception as e:  # a failed step never kills the run
+                ev.log("ModelTraining", f"step {step.id} failed: {e}")
+                continue
+            for m in models:
+                self.leaderboard.add(m)
+                v, _ = metric_value(m, self.sort_metric)
+                ev.log("ModelTraining", f"{step.id} -> {m.key} metric={v:.5f}")
+        ev.log(
+            "Workflow",
+            f"AutoML build done: {len(self.leaderboard.models)} models in "
+            f"{time.time() - self._t0:.1f}s",
+        )
+        if self.leaderboard.leader is None:
+            raise RuntimeError("AutoML built no models (budget too small?)")
+        return self.leaderboard.leader
+
+    @property
+    def leader(self) -> Optional[Model]:
+        return self.leaderboard.leader
+
+    def __repr__(self) -> str:
+        return f"<AutoML {self.project_key} models={len(self.leaderboard.models)}>"

@@ -80,10 +80,10 @@ def connect(url: str) -> H2OConnection:
 def shutdown() -> None:
     global _conn, _server
     if _conn is not None:
-        _conn.close()
         try:
+            _conn.close()
             _conn.request("POST /3/Shutdown")
-        except H2OResponseError:
+        except Exception:  # unreachable server must not leave stale state
             pass
         _conn = None
     if _server is not None:
@@ -165,3 +165,36 @@ def rapids(ast: str) -> Dict[str, Any]:
 
 def cluster_status() -> Dict[str, Any]:
     return connection().cloud_info()
+
+
+class H2OAutoML:
+    """h2o-py/h2o/automl/H2OAutoML surface over /99/AutoMLBuilder."""
+
+    def __init__(self, **params: Any) -> None:
+        self._params = params
+        self.project_key: Optional[str] = None
+        self.leader: Optional[H2OModel] = None
+        self._leaderboard: List[Dict[str, Any]] = []
+
+    def train(
+        self,
+        y: str,
+        training_frame: H2OFrame,
+        x: Optional[List[str]] = None,
+    ) -> "H2OAutoML":
+        training_frame.refresh()
+        c = training_frame._conn
+        payload = dict(self._params)
+        payload["training_frame"] = training_frame.frame_id
+        payload["response_column"] = y
+        if x is not None:
+            payload["x"] = x
+        out = c.request("POST /99/AutoMLBuilder", payload)
+        self.project_key = out["automl_id"]["name"]
+        self.leader = H2OModel(c, out["leader"]["name"])
+        self._leaderboard = out["leaderboard"]
+        return self
+
+    @property
+    def leaderboard(self) -> List[Dict[str, Any]]:
+        return self._leaderboard

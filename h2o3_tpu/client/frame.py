@@ -140,7 +140,7 @@ class H2OFrame:
         if s.step not in (None, 1):
             raise TypeError("H2OFrame slicing does not support a step")
         start = s.start or 0
-        stop = self.nrows if s.stop is None else s.stop
+        stop = self.nrows if s.stop is None else min(s.stop, self.nrows)
         if start < 0 or stop < 0:
             raise TypeError("H2OFrame slicing does not support negative indices")
         return slice(start, max(stop, start))
@@ -270,7 +270,7 @@ class H2OFrame:
         return df.apply(pd.to_numeric, errors="ignore") if hasattr(df, "apply") else df
 
     def head(self, rows: int = 10) -> "H2OFrame":
-        return H2OFrame(self._conn, ExprNode("rows", self, slice(0, rows)))
+        return self[0:rows]  # __getitem__ clamps to nrows
 
     def __repr__(self) -> str:
         if self._key:

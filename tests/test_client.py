@@ -154,3 +154,8 @@ class TestClientReviewFixes:
         assert da["v"].mean() == pytest.approx(4.0)
         assert db["v"].mean() == pytest.approx(6.0)
         b_conn.close()
+
+    def test_head_clamps_on_small_frame(self, conn):
+        small = h2o.upload_csv("v\n1\n2\n3\n")
+        assert small.head().nrows == 3      # default 10 > 3: clamped
+        assert small[0:100].nrows == 3      # oversized slice clamped
