@@ -1,0 +1,181 @@
+"""ctypes bindings for the native (C++) runtime components.
+
+Reference mapping (SURVEY.md §2.3: native components get TPU-native
+equivalents, and the runtime around the JAX compute path is native):
+
+  * ``native/csv.cpp``    — the parser hot loop (water/parser/CsvParser.java
+    byte scanning, chunk-parallel like MultiFileParseTask)
+  * ``native/codecs.cpp`` — chunk compression codecs (water/fvec/C*Chunk)
+    + LSD radix argsort (water/rapids/RadixOrder.java analogue)
+
+Everything here degrades gracefully: if the shared library cannot be built
+(no compiler) or H2O3_TPU_NATIVE=0, callers use the numpy fallbacks.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_LIB_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libh2o3native.so"))
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        out = subprocess.run(
+            ["make", "-C", os.path.abspath(_NATIVE_DIR)],
+            capture_output=True, text=True, timeout=120,
+        )
+        return out.returncode == 0 and os.path.exists(_LIB_PATH)
+    except Exception:
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The loaded library, building it on first use; None if unavailable."""
+    global _lib, _tried
+    if _lib is not None:
+        return _lib
+    if os.environ.get("H2O3_TPU_NATIVE", "1") == "0":
+        return None
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB_PATH) and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+        lib.h2o3_count_rows.restype = ctypes.c_int64
+        lib.h2o3_count_rows.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        lib.h2o3_parse_numeric_csv.restype = ctypes.c_int64
+        lib.h2o3_parse_numeric_csv.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_char,
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+            ctypes.c_int32,
+        ]
+        lib.h2o3_codec_bound.restype = ctypes.c_int64
+        lib.h2o3_codec_bound.argtypes = [ctypes.c_int64]
+        lib.h2o3_codec_encode.restype = ctypes.c_int64
+        lib.h2o3_codec_encode.argtypes = [
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint8),
+        ]
+        lib.h2o3_codec_decode.restype = ctypes.c_int64
+        lib.h2o3_codec_decode.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_double),
+        ]
+        lib.h2o3_radix_argsort_u64.restype = None
+        lib.h2o3_radix_argsort_u64.argtypes = [
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+# ---------------------------------------------------------------------------
+# csv
+
+
+def parse_numeric_csv(
+    text: bytes, start: int, sep: str, ncols: int, nrows: int,
+    nthreads: int = 0,
+) -> Optional[np.ndarray]:
+    """All-numeric CSV body -> [nrows, ncols] float64 (NaN = NA/junk).
+    Returns None when the native lib is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    if nthreads <= 0:
+        nthreads = min(os.cpu_count() or 1, 8)
+    out = np.empty((nrows, ncols), dtype=np.float64)
+    got = lib.h2o3_parse_numeric_csv(
+        text, len(text), start, sep.encode()[:1], ncols,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), nrows, nthreads,
+    )
+    if got < 0 or got > nrows:
+        return None
+    return out[:got]
+
+
+# ---------------------------------------------------------------------------
+# chunk codecs (compressed column store)
+
+
+def codec_encode(x: np.ndarray) -> Optional[bytes]:
+    lib = get_lib()
+    if lib is None:
+        return None
+    x = np.ascontiguousarray(x, dtype=np.float64)
+    buf = np.empty(int(lib.h2o3_codec_bound(len(x))), dtype=np.uint8)
+    n = lib.h2o3_codec_encode(
+        x.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), len(x),
+        buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+    )
+    return bytes(buf[:n])
+
+
+def codec_decode(blob: bytes) -> Optional[np.ndarray]:
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = int.from_bytes(blob[1:9], "little")
+    out = np.empty(n, dtype=np.float64)
+    raw = np.frombuffer(blob, dtype=np.uint8)
+    got = lib.h2o3_codec_decode(
+        raw.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+    )
+    if got != n:
+        return None
+    return out
+
+
+# ---------------------------------------------------------------------------
+# radix argsort
+
+
+def radix_argsort(keys: np.ndarray) -> Optional[np.ndarray]:
+    """Stable LSD-radix argsort for int64/uint64/float64 keys (NaN last)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    k = np.asarray(keys)
+    if k.dtype == np.float64:
+        # order-preserving float->uint64 transform (flip sign bit / negate)
+        bits = k.view(np.uint64).copy()
+        neg = bits >> np.uint64(63) == 1
+        bits[neg] = ~bits[neg]
+        bits[~neg] |= np.uint64(1) << np.uint64(63)
+        # NaNs (exponent all-ones, mantissa != 0) end up above +inf: fine
+        u = bits
+    elif k.dtype == np.int64:
+        u = (k.astype(np.int64) ^ np.int64(-0x8000000000000000)).view(np.uint64)
+    elif k.dtype == np.uint64:
+        u = k
+    else:
+        return None
+    u = np.ascontiguousarray(u)
+    order = np.empty(len(u), dtype=np.int64)
+    lib.h2o3_radix_argsort_u64(
+        u.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), len(u),
+        order.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    return order

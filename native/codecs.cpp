@@ -1,0 +1,237 @@
+// Columnar chunk codecs — compressed column storage + spill support.
+//
+// Reference: the ~23 Chunk codecs in water/fvec/C*.java chosen by
+// NewChunk.close() (Chunk.java:35-43): constants (C0DChunk/C0LChunk),
+// biased small ints (C1/C2/C4Chunk), scaled decimals (C1S/C2S/C4SChunk),
+// floats (C4F/C8DChunk), sparse (CXI/CXFChunk), and the Cleaner's
+// user-mode swap of cold chunks (water/Cleaner.java:10-12).
+//
+// The TPU build stores host-canonical columns as float64; this codec picks
+// the cheapest lossless encoding per chunk:
+//   0 RAW64    raw little-endian doubles (fallback)
+//   1 CONST    one double (+NA bitmap if mixed)
+//   2 INT8/3 INT16/4 INT32: bias + small ints, NA = sentinel min
+//   5 SCALED16 decimal: bias + scale, int16 mantissa (C2SChunk analogue)
+//   6 SPARSE   nonzero (idx,value) pairs (CXFChunk analogue)
+// Encoded layout: [u8 tag][i64 n][payload]. All lossless: decode == input
+// bit-for-bit on the values (NaN canonicalized to one quiet NaN pattern).
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+static const double kNaN = __builtin_nan("");
+
+// Worst-case encoded size for n doubles (RAW64 + header).
+int64_t h2o3_codec_bound(int64_t n) { return 9 + n * 8 + 16; }
+
+namespace {
+
+static inline bool is_na(double v) { return std::isnan(v); }
+
+struct Stats {
+  bool any_na = false, all_na = true, all_int = true, constant = true;
+  double first = kNaN;
+  double minv = INFINITY, maxv = -INFINITY;
+  int64_t nonzero = 0;
+  bool scaled16_ok = true;  // value*100 fits int16 after bias
+};
+
+static Stats scan(const double* x, int64_t n) {
+  Stats s;
+  bool seen = false;
+  for (int64_t i = 0; i < n; ++i) {
+    double v = x[i];
+    if (is_na(v)) {
+      s.any_na = true;
+      continue;
+    }
+    s.all_na = false;
+    if (!seen) {
+      s.first = v;
+      seen = true;
+    } else if (v != s.first) {
+      s.constant = false;
+    }
+    if (v != 0.0) ++s.nonzero;
+    if (v < s.minv) s.minv = v;
+    if (v > s.maxv) s.maxv = v;
+    if (s.all_int && (v != std::floor(v) || std::fabs(v) > 9.2e18))
+      s.all_int = false;
+    if (s.scaled16_ok) {
+      double c = v * 100.0;
+      double r = std::nearbyint(c);
+      if (std::fabs(c - r) > 1e-9 || std::fabs(c) > 3.2e6) s.scaled16_ok = false;
+    }
+  }
+  return s;
+}
+
+template <typename T>
+static int64_t enc_int(const double* x, int64_t n, double bias, uint8_t tag,
+                       uint8_t* out) {
+  out[0] = tag;
+  memcpy(out + 1, &n, 8);
+  memcpy(out + 9, &bias, 8);
+  T* p = (T*)(out + 17);
+  const T sentinel = (T)((T)1 << (sizeof(T) * 8 - 1));  // min value = NA
+  for (int64_t i = 0; i < n; ++i)
+    p[i] = is_na(x[i]) ? sentinel : (T)(int64_t)(x[i] - bias);
+  return 17 + n * (int64_t)sizeof(T);
+}
+
+template <typename T>
+static void dec_int(const uint8_t* in, double* out) {
+  int64_t n;
+  double bias;
+  memcpy(&n, in + 1, 8);
+  memcpy(&bias, in + 9, 8);
+  const T* p = (const T*)(in + 17);
+  const T sentinel = (T)((T)1 << (sizeof(T) * 8 - 1));
+  for (int64_t i = 0; i < n; ++i)
+    out[i] = (p[i] == sentinel) ? kNaN : bias + (double)p[i];
+}
+
+}  // namespace
+
+// Encode n doubles; returns encoded byte length.
+int64_t h2o3_codec_encode(const double* x, int64_t n, uint8_t* out) {
+  Stats s = scan(x, n);
+
+  if (s.all_na || (s.constant && !s.any_na)) {  // CONST (C0DChunk)
+    out[0] = 1;
+    memcpy(out + 1, &n, 8);
+    double v = s.all_na ? kNaN : s.first;
+    memcpy(out + 9, &v, 8);
+    return 17;
+  }
+
+  if (s.all_int && !s.all_na) {  // biased ints (C1/C2/C4Chunk)
+    double span = s.maxv - s.minv;
+    if (span <= 254.0)
+      return enc_int<int8_t>(x, n, s.minv + 127.0, 2, out);
+    if (span <= 65534.0)
+      return enc_int<int16_t>(x, n, s.minv + 32767.0, 3, out);
+    if (span <= 4294967294.0)
+      return enc_int<int32_t>(x, n, s.minv + 2147483647.0, 4, out);
+  }
+
+  if (s.scaled16_ok && !s.all_na) {  // SCALED16 (C2SChunk: mantissa*10^-2)
+    double bias = std::nearbyint(((s.minv + s.maxv) / 2) * 100.0);
+    bool fits = true;
+    for (int64_t i = 0; i < n && fits; ++i)
+      if (!is_na(x[i])) {
+        double c = std::nearbyint(x[i] * 100.0) - bias;
+        if (c < -32767.0 || c > 32767.0) fits = false;
+      }
+    if (fits) {
+      out[0] = 5;
+      memcpy(out + 1, &n, 8);
+      memcpy(out + 9, &bias, 8);
+      int16_t* p = (int16_t*)(out + 17);
+      for (int64_t i = 0; i < n; ++i)
+        p[i] = is_na(x[i])
+                   ? (int16_t)-32768
+                   : (int16_t)(std::nearbyint(x[i] * 100.0) - bias);
+      return 17 + n * 2;
+    }
+  }
+
+  if (!s.any_na && s.nonzero * 12 + 25 < n * 8) {  // SPARSE (CXFChunk)
+    out[0] = 6;
+    memcpy(out + 1, &n, 8);
+    memcpy(out + 9, &s.nonzero, 8);
+    uint8_t* p = out + 17;
+    for (int64_t i = 0; i < n; ++i)
+      if (x[i] != 0.0) {
+        int32_t ii = (int32_t)i;
+        memcpy(p, &ii, 4);
+        memcpy(p + 4, &x[i], 8);
+        p += 12;
+      }
+    return (int64_t)(p - out);
+  }
+
+  out[0] = 0;  // RAW64 (C8DChunk)
+  memcpy(out + 1, &n, 8);
+  memcpy(out + 9, x, (size_t)n * 8);
+  return 9 + n * 8;
+}
+
+// Decode into out (length from header). Returns n, or -1 on bad tag.
+int64_t h2o3_codec_decode(const uint8_t* in, double* out) {
+  int64_t n;
+  memcpy(&n, in + 1, 8);
+  switch (in[0]) {
+    case 0:
+      memcpy(out, in + 9, (size_t)n * 8);
+      return n;
+    case 1: {
+      double v;
+      memcpy(&v, in + 9, 8);
+      for (int64_t i = 0; i < n; ++i) out[i] = v;
+      return n;
+    }
+    case 2: dec_int<int8_t>(in, out); return n;
+    case 3: dec_int<int16_t>(in, out); return n;
+    case 4: dec_int<int32_t>(in, out); return n;
+    case 5: {
+      double bias;
+      memcpy(&bias, in + 9, 8);
+      const int16_t* p = (const int16_t*)(in + 17);
+      for (int64_t i = 0; i < n; ++i)
+        out[i] = (p[i] == -32768) ? kNaN : (bias + p[i]) / 100.0;
+      return n;
+    }
+    case 6: {
+      int64_t nz;
+      memcpy(&nz, in + 9, 8);
+      memset(out, 0, (size_t)n * 8);
+      const uint8_t* p = in + 17;
+      for (int64_t k = 0; k < nz; ++k) {
+        int32_t i;
+        double v;
+        memcpy(&i, p, 4);
+        memcpy(&v, p + 4, 8);
+        out[i] = v;
+        p += 12;
+      }
+      return n;
+    }
+    default:
+      return -1;
+  }
+}
+
+// LSD radix argsort of uint64 keys (order-transformed by caller for
+// signed/float ordering). Powers rapids sort/merge
+// (water/rapids/RadixOrder.java:20 — MSB radix there; LSD here, same O(n)).
+void h2o3_radix_argsort_u64(const uint64_t* keys, int64_t n, int64_t* order) {
+  int64_t* cur = order;
+  int64_t* tmp = new int64_t[n];
+  for (int64_t i = 0; i < n; ++i) cur[i] = i;
+  int64_t count[256];
+  for (int pass = 0; pass < 8; ++pass) {
+    int shift = pass * 8;
+    memset(count, 0, sizeof(count));
+    for (int64_t i = 0; i < n; ++i)
+      ++count[(keys[cur[i]] >> shift) & 0xff];
+    if (count[0] == n) continue;  // all zero in this byte: skip pass
+    int64_t off[256], acc = 0;
+    for (int b = 0; b < 256; ++b) {
+      off[b] = acc;
+      acc += count[b];
+    }
+    for (int64_t i = 0; i < n; ++i)
+      tmp[off[(keys[cur[i]] >> shift) & 0xff]++] = cur[i];
+    int64_t* t = cur;
+    cur = tmp;
+    tmp = t;
+  }
+  if (cur != order) memcpy(order, cur, (size_t)n * 8);
+  delete[] (cur == order ? tmp : cur);
+}
+
+}  // extern "C"

@@ -1,0 +1,161 @@
+// Fast CSV tokenizer / numeric parser — the data-loader hot path.
+//
+// Reference: water/parser/CsvParser.java (byte->token->NewChunk append per
+// row, driven chunk-parallel by MultiFileParseTask, ParseDataset.java:623).
+// The TPU build keeps type guessing in Python (sampled, cheap) and moves the
+// bulk byte scanning here: one pass over the buffer, branch-light float
+// parsing, NA -> quiet NaN.  Rows are split across threads on newline
+// boundaries (the chunk-parallel structure of the reference's parse).
+
+#include <cmath>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// Count logical rows (newlines, ignoring a trailing unterminated line's
+// absence) so Python can preallocate.
+int64_t h2o3_count_rows(const char* buf, int64_t len) {
+  int64_t n = 0;
+  for (int64_t i = 0; i < len; ++i)
+    if (buf[i] == '\n') ++n;
+  if (len > 0 && buf[len - 1] != '\n') ++n;
+  return n;
+}
+
+namespace {
+
+// strtod-free fast path for plain decimal numbers; falls back to strtod for
+// exponents/specials. Returns NaN for non-numeric tokens.
+static inline double parse_token(const char* s, const char* e) {
+  while (s < e && (*s == ' ' || *s == '\t')) ++s;
+  while (e > s && (e[-1] == ' ' || e[-1] == '\t' || e[-1] == '\r')) --e;
+  if (s == e) return NAN;
+  bool neg = false;
+  const char* p = s;
+  if (*p == '-') { neg = true; ++p; }
+  else if (*p == '+') ++p;
+  int64_t ip = 0;
+  int digits = 0;
+  while (p < e && *p >= '0' && *p <= '9' && digits < 18) {
+    ip = ip * 10 + (*p - '0');
+    ++p; ++digits;
+  }
+  if (p < e && *p == '.') {
+    ++p;
+    int64_t fp = 0, scale = 1;
+    while (p < e && *p >= '0' && *p <= '9' && digits < 18) {
+      fp = fp * 10 + (*p - '0');
+      scale *= 10;
+      ++p; ++digits;
+    }
+    if (p == e && digits > 0) {
+      double v = (double)ip + (double)fp / (double)scale;
+      return neg ? -v : v;
+    }
+  } else if (p == e && digits > 0) {
+    double v = (double)ip;
+    return neg ? -v : v;
+  }
+  // exponent / >18 digits / inf / nan / junk: defer to strtod
+  char tmp[64];
+  size_t n = (size_t)(e - s);
+  if (n >= sizeof(tmp)) return NAN;
+  memcpy(tmp, s, n);
+  tmp[n] = 0;
+  char* endp = nullptr;
+  double v = strtod(tmp, &endp);
+  if (endp == tmp || (endp && *endp != 0)) return NAN;
+  return v;
+}
+
+struct Shard {
+  const char* buf;
+  int64_t begin, end;       // byte range, begin at a row start
+  int64_t row0;             // first row index in this shard
+  double* out;              // [nrows, ncols] row-major
+  int32_t ncols;
+  char sep;
+};
+
+static void parse_shard(const Shard sh) {
+  const char* p = sh.buf + sh.begin;
+  const char* lim = sh.buf + sh.end;
+  int64_t row = sh.row0;
+  while (p < lim) {
+    const char* line_end = (const char*)memchr(p, '\n', (size_t)(lim - p));
+    if (!line_end) line_end = lim;
+    double* dst = sh.out + row * sh.ncols;
+    const char* tok = p;
+    int32_t col = 0;
+    for (const char* q = p; q <= line_end && col < sh.ncols; ++q) {
+      if (q == line_end || *q == sh.sep) {
+        dst[col++] = parse_token(tok, q);
+        tok = q + 1;
+      }
+    }
+    while (col < sh.ncols) dst[col++] = NAN;  // short row: missing -> NA
+    ++row;
+    p = line_end + 1;
+  }
+}
+
+}  // namespace
+
+// Parse `nrows` x `ncols` numerics from buf into out (row-major doubles).
+// start: byte offset of the first data row (header skipped by caller).
+// Returns rows parsed. Threads split on newline boundaries.
+int64_t h2o3_parse_numeric_csv(const char* buf, int64_t len, int64_t start,
+                               char sep, int32_t ncols, double* out,
+                               int64_t nrows, int32_t nthreads) {
+  if (nthreads < 1) nthreads = 1;
+  // find shard boundaries: nthreads byte-ranges snapped to line starts
+  std::vector<int64_t> begins;
+  begins.push_back(start);
+  for (int t = 1; t < nthreads; ++t) {
+    int64_t target = start + (len - start) * t / nthreads;
+    const char* nl =
+        (const char*)memchr(buf + target, '\n', (size_t)(len - target));
+    int64_t b = nl ? (nl - buf) + 1 : len;
+    if (b > begins.back()) begins.push_back(b);
+  }
+  begins.push_back(len);
+
+  // row offsets per shard (prefix newline counts)
+  std::vector<int64_t> row0(begins.size() - 1, 0);
+  {
+    int64_t acc = 0;
+    for (size_t s = 0; s + 1 < begins.size(); ++s) {
+      row0[s] = acc;
+      const char* b = buf + begins[s];
+      const char* e = buf + begins[s + 1];
+      int64_t cnt = 0;
+      for (const char* q = b; q < e; ++q)
+        if (*q == '\n') ++cnt;
+      if (s + 2 == begins.size() && e > b && e[-1] != '\n') ++cnt;
+      acc += cnt;
+    }
+    if (acc > nrows) return -1;  // caller's preallocation too small
+  }
+
+  std::vector<std::thread> threads;
+  for (size_t s = 0; s + 1 < begins.size(); ++s) {
+    Shard sh{buf, begins[s], begins[s + 1], row0[s], out, ncols, sep};
+    threads.emplace_back(parse_shard, sh);
+  }
+  for (auto& th : threads) th.join();
+  int64_t total = 0;
+  {
+    const char* b = buf + start;
+    const char* e = buf + len;
+    for (const char* q = b; q < e; ++q)
+      if (*q == '\n') ++total;
+    if (e > b && e[-1] != '\n') ++total;
+  }
+  return total;
+}
+
+}  // extern "C"
