@@ -113,6 +113,9 @@ def parse_csv(
             column_types=column_types,
             na_strings=na_strings,
         )
+    fast = _native_numeric_fast(text, setup)
+    if fast is not None:
+        return fast
     records = _split_records(text)
     if setup.skip_blank_lines:
         records = [r for r in records if r.strip()]
@@ -144,6 +147,56 @@ def column_from_strings(
 
 # ---------------------------------------------------------------------------
 # internals
+
+
+def _native_numeric_fast(text: str, setup: ParseSetup) -> Optional[Frame]:
+    """All-numeric fast path through the native tokenizer (native/csv.cpp —
+    the CsvParser.java hot-loop equivalent, thread-parallel on newline
+    boundaries). Returns None whenever the python path's semantics could
+    diverge (quotes, blank lines, numeric NA strings, non-NUM columns) or the
+    shared library is unavailable; callers then take the pure-python path.
+    Parity is pinned by tests/test_native.py."""
+    if not setup.column_names or any(t is not ColType.NUM for t in setup.column_types):
+        return None
+    if len(setup.separator) != 1 or '"' in text:
+        return None
+    # native parses every physical line; blank or whitespace-only lines would
+    # become all-NaN rows where python (skip_blank_lines) drops them
+    if re.search(r"(?m)^[ \t\r]*$", text[:-1] if text.endswith("\n") else text):
+        return None
+    # numeric literals python accepts but the native tokenizer doesn't
+    # (underscore separators like 1_000) must take the python path
+    if "_" in text:
+        return None
+    # any NA token that parses as a number would be NA in python, numeric here
+    if any(t and _is_number(t) for t in setup.na_strings):
+        return None
+    try:
+        from h2o3_tpu import native
+    except Exception:
+        return None
+    if not native.available():
+        return None
+    raw = text.encode("utf-8")
+    start = 0
+    if setup.header:
+        nl = raw.find(b"\n")
+        if nl < 0:
+            return None
+        start = nl + 1
+    if start >= len(raw):
+        return None
+    nrows = raw.count(b"\n", start) + (0 if raw.endswith(b"\n") else 1)
+    mat = native.parse_numeric_csv(
+        raw, start, setup.separator, len(setup.column_names), nrows
+    )
+    if mat is None:
+        return None
+    cols = [
+        Column(name, np.ascontiguousarray(mat[:, j]), ColType.NUM)
+        for j, name in enumerate(setup.column_names)
+    ]
+    return Frame(cols)
 
 
 def _looks_like_path(s: str) -> bool:

@@ -44,6 +44,7 @@ class ModelParameters:
     stopping_metric: str = "auto"
     stopping_tolerance: float = 1e-3
     categorical_encoding: str = "auto"
+    checkpoint: Optional[str] = None  # model key to continue training from
 
     def actual_seed(self) -> int:
         if self.seed is None or self.seed == -1:

@@ -10,17 +10,23 @@ Reference architecture being re-designed (not translated):
     ``grow_gpu_hist`` updater (``XGBoostModel.java:382-394``), Rabit allreduce
     replaced by ``lax.psum`` (SURVEY.md §2.3).
 
-TPU-native design decisions:
+TPU-native design decisions (device-resident, round 2 rewrite):
   * global quantile binning once per training run (static uint8-range codes)
     — the reference's ``histogram_type=QuantilesGlobal`` made the default,
     because per-leaf re-binning (UniformAdaptive) implies dynamic shapes;
-  * level-wise growth with a fixed node capacity of 2^depth per level: every
-    level is one jitted program of static shape, compiled once per depth and
-    reused across all trees and all boosting rounds;
-  * rows carry a level-local node id (-1 = out of tree); the histogram is a
-    shard-private scatter-add + psum (h2o3_tpu/ops/histogram.py);
-  * split search, leaf values, and node routing are replicated O(K·F·B) jnp
-    ops — tiny next to the histogram pass;
+  * the ENTIRE tree build is one traced program: levels are unrolled inside
+    the trace with per-level static node capacity (level d has exactly 2^d
+    slots), so histogram/split/route for a whole tree — and a whole block of
+    trees via ``lax.scan`` — compile to a single XLA executable.  Bins, g/h,
+    row→node assignment and the margin never leave the device; the host sees
+    tree arrays only at block boundaries (score_tree_interval granularity),
+    exactly where the reference's driver scores (``SharedTree.java:440``);
+  * gradients/hessians are computed on device from the distribution family
+    (``hex/Distribution.java`` analogue) inside the same program;
+  * row/column subsampling and per-node mtries draw from ``jax.random`` keys
+    folded per (block, tree, level) — reproducible under jit;
+  * the histogram is a shard-private scatter-add (or Pallas MXU kernel on
+    TPU) + psum (h2o3_tpu/ops/histogram.py);
   * NA routing learns a per-split default direction by evaluating the NA
     bucket on both sides (DHistogram's trailing NA bin, XGBoost default-dir).
 """
@@ -28,7 +34,7 @@ TPU-native design decisions:
 from __future__ import annotations
 
 from dataclasses import dataclass
-from functools import partial
+from functools import lru_cache, partial
 from typing import Callable, List, Optional, Tuple
 
 import numpy as np
@@ -36,11 +42,15 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from h2o3_tpu.ops.histogram import apply_bins, build_histogram_sharded, make_bins
+from h2o3_tpu.ops.histogram import (
+    apply_bins,
+    build_histogram_sharded,
+    make_bins,
+)
 from h2o3_tpu.parallel.mesh import default_mesh, row_sharding
 
 
-@dataclass
+@dataclass(frozen=True)
 class TreeParams:
     ntrees: int = 50
     max_depth: int = 6
@@ -97,15 +107,52 @@ class Trees:
 
 
 # ---------------------------------------------------------------------------
-# jitted level-step pieces
+# device-side objective families (hex/Distribution.java analogue)
 
 
-@partial(jax.jit, static_argnames=("n_bins1", "min_rows"))
+def grad_hess_device(objective: str, y, margin):
+    """Per-row (g, h) of the loss wrt the margin, traced on device.
+
+    y: [N] labels/targets, or [N, C] fixed targets for objective='fixed'
+    (DRF: each tree independently fits the raw targets, so g=-y, h=1 gives a
+    Newton leaf equal to the in-leaf target mean). margin: [N, C] f32.
+    """
+    if objective == "fixed":
+        t = y if y.ndim == 2 else y[:, None]
+        return -t.astype(jnp.float32), jnp.ones_like(t, dtype=jnp.float32)
+    if objective == "gaussian":
+        g = margin[:, 0] - y
+        return g[:, None], jnp.ones_like(g)[:, None]
+    if objective == "bernoulli":
+        p = jax.nn.sigmoid(margin[:, 0])
+        return (p - y)[:, None], jnp.maximum(p * (1 - p), 1e-16)[:, None]
+    if objective == "multinomial":
+        p = jax.nn.softmax(margin, axis=1)
+        onehot = (y.astype(jnp.int32)[:, None] == jnp.arange(margin.shape[1])[None, :]).astype(
+            jnp.float32
+        )
+        return p - onehot, jnp.maximum(p * (1 - p), 1e-16)
+    if objective == "poisson":
+        mu = jnp.exp(margin[:, 0])
+        return (mu - y)[:, None], jnp.maximum(mu, 1e-16)[:, None]
+    if objective == "laplace":
+        g = jnp.sign(margin[:, 0] - y)
+        return g[:, None], jnp.ones_like(g)[:, None]
+    if objective == "quantile_0.5":
+        g = jnp.where(margin[:, 0] > y, 0.5, -0.5)
+        return g[:, None], jnp.ones_like(g)[:, None]
+    raise ValueError(f"unknown objective {objective!r}")
+
+
+# ---------------------------------------------------------------------------
+# traced level-step pieces
+
+
 def _split_search(hist, lam, alpha, gamma, lr, feat_mask, min_rows: float, n_bins1: int):
     """Per-node best split over (feature, bin, NA-direction).
 
     hist: [K, F, B+1, 3] (Σg, Σh, count). Returns per-node arrays:
-    feat, bin, default_left, gain, leaf_value (lr-scaled), plus can_split.
+    feat, bin, default_left, gain, leaf_value (lr-scaled).
     """
     B = n_bins1 - 1
     total = hist.sum(axis=2)  # [K, F, 3] — identical across F
@@ -161,18 +208,48 @@ def _split_search(hist, lam, alpha, gamma, lr, feat_mask, min_rows: float, n_bin
     return best_f, best_b, dl, best_gain, leaf
 
 
-@jax.jit
-def _route_rows(bins, nodes, feat, split_bin, default_left, is_split, n_bins1_arr):
-    """Advance rows one level: node k -> 2k (left) / 2k+1 (right); rows whose
-    node became a leaf leave the tree (-1)."""
-    k = jnp.where(nodes >= 0, nodes, 0)
-    f = feat[k]
-    b = jnp.take_along_axis(bins, f[:, None], axis=1)[:, 0]
-    is_na = b >= n_bins1_arr - 1
-    go_left = jnp.where(is_na, default_left[k], b <= split_bin[k])
-    child = 2 * k + jnp.where(go_left, 0, 1)
-    new_nodes = jnp.where((nodes >= 0) & is_split[k], child, -1)
-    return new_nodes.astype(jnp.int32)
+def _sel_table(table, idx):
+    """table[idx] for a small table [K] and big idx [N] — as a masked
+    reduction, NOT a gather (XLA TPU gathers are scalar-serialized: ~250ns
+    per element; this is one fused VPU pass)."""
+    K = table.shape[0]
+    mask = idx[:, None] == jnp.arange(K, dtype=idx.dtype)[None, :]
+    zero = jnp.zeros((), dtype=table.dtype)
+    return jnp.sum(jnp.where(mask, table[None, :], zero), axis=1)
+
+
+def _sel_tables(tables, idx):
+    """Select from several same-length small tables sharing one mask."""
+    K = tables[0].shape[0]
+    mask = idx[:, None] == jnp.arange(K, dtype=idx.dtype)[None, :]
+    outs = []
+    for t in tables:
+        zero = jnp.zeros((), dtype=t.dtype)
+        outs.append(jnp.sum(jnp.where(mask, t[None, :], zero), axis=1))
+    return outs
+
+
+def _sel_cols(bins, f_idx):
+    """bins[i, f_idx[i]] — per-row column select as a masked reduction."""
+    F = bins.shape[1]
+    mask = f_idx[:, None] == jnp.arange(F, dtype=f_idx.dtype)[None, :]
+    return jnp.sum(jnp.where(mask, bins, 0), axis=1)
+
+
+def _tree_walk(bins, feat, split_bin, default_left, is_split, leaf, max_depth: int, n_bins1):
+    """Heap-walk a single tree (arrays [M]); returns per-row leaf values."""
+    idx = jnp.zeros(bins.shape[0], dtype=jnp.int32)
+
+    def body(_, idx):
+        f, sb, dl, sp = _sel_tables((feat, split_bin, default_left, is_split), idx)
+        b = _sel_cols(bins, f)
+        is_na = b >= n_bins1 - 1
+        go_left = jnp.where(is_na, dl, b <= sb)
+        nxt = 2 * idx + jnp.where(go_left, 1, 2)
+        return jnp.where(sp, nxt, idx)
+
+    idx = jax.lax.fori_loop(0, max_depth, body, idx)
+    return _sel_table(leaf, idx)
 
 
 @partial(jax.jit, static_argnames=("max_depth",))
@@ -181,21 +258,150 @@ def _predict_stacked(bins, feat, split_bin, default_left, is_split, leaf, max_de
 
     def one_tree(carry, tree):
         tf, tb, tdl, tsp, tlf = tree
-        idx = jnp.zeros(bins.shape[0], dtype=jnp.int32)
+        return carry + _tree_walk(bins, tf, tb, tdl, tsp, tlf, max_depth, n_bins1_arr), None
 
-        def body(_, idx):
-            f = tf[idx]
-            b = jnp.take_along_axis(bins, f[:, None], axis=1)[:, 0]
-            is_na = b >= n_bins1_arr - 1
-            go_left = jnp.where(is_na, tdl[idx], b <= tb[idx])
-            nxt = 2 * idx + jnp.where(go_left, 1, 2)
-            return jnp.where(tsp[idx], nxt, idx)
-
-        idx = jax.lax.fori_loop(0, max_depth, body, idx)
-        return carry + tlf[idx], None
-
-    out, _ = jax.lax.scan(one_tree, jnp.zeros(bins.shape[0], jnp.float32), (feat, split_bin, default_left, is_split, leaf))
+    out, _ = jax.lax.scan(
+        one_tree,
+        jnp.zeros(bins.shape[0], jnp.float32),
+        (feat, split_bin, default_left, is_split, leaf),
+    )
     return out
+
+
+# ---------------------------------------------------------------------------
+# the device-resident training block
+
+
+def _build_one_tree(bins, g, h, sample, feat_mask, key, p: TreeParams, mesh, bins_fm=None):
+    """Grow one tree to max_depth, fully traced. Levels are unrolled with
+    per-level static node capacity 2^d (the fixed-capacity redesign of the
+    reference's dynamic DTree node growth).
+
+    Every row (sampled or not) is routed so its leaf is known at the end —
+    the margin update is then a single small-table select, with no separate
+    prediction walk over the finished tree. Only ``sample`` rows contribute
+    to histograms (row-subsampling semantics of GBM/DRF).
+
+    Returns (heap arrays [M], per-row leaf value [N]).
+    """
+    D = p.max_depth
+    n_bins1 = p.nbins + 1
+    F = bins.shape[1]
+    pos = jnp.zeros(bins.shape[0], dtype=jnp.int32)  # absolute heap position
+
+    tf_l, tb_l, tdl_l, tsp_l, tlf_l = [], [], [], [], []
+    for d in range(D + 1):
+        K = 2**d
+        lo = K - 1
+        local = pos - lo
+        in_lvl = (local >= 0) & (local < K)
+        hist_nodes = jnp.where(in_lvl & sample, local, -1).astype(jnp.int32)
+        hist = build_histogram_sharded(
+            bins, hist_nodes, g, h, n_nodes=K, n_bins1=n_bins1, mesh=mesh,
+            bins_fm=bins_fm,
+        )
+        if p.mtries > 0:
+            key, sub = jax.random.split(key)
+            r = jax.random.uniform(sub, (K, F))
+            thresh = jnp.sort(r, axis=1)[:, p.mtries - 1][:, None]
+            node_feat_mask = (r <= thresh) & feat_mask[None, :]
+        else:
+            node_feat_mask = feat_mask
+        bf, bb, dl, gain, leaf = _split_search(
+            hist,
+            jnp.float32(p.reg_lambda),
+            jnp.float32(p.reg_alpha),
+            jnp.float32(p.gamma),
+            jnp.float32(p.learn_rate),
+            node_feat_mask,
+            min_rows=float(p.min_rows),
+            n_bins1=n_bins1,
+        )
+        can = (gain > max(p.min_split_improvement, 0.0)) & jnp.isfinite(gain) & (d < D)
+        tf_l.append(bf)
+        tb_l.append(bb)
+        tdl_l.append(dl)
+        tsp_l.append(can)
+        tlf_l.append(leaf)
+        if d < D:
+            k = jnp.clip(local, 0, K - 1)
+            f, sb, dlk, cank = _sel_tables((bf, bb, dl, can), k)
+            b = _sel_cols(bins, f)
+            go_left = jnp.where(b >= n_bins1 - 1, dlk, b <= sb)
+            child = 2 * (lo + k) + jnp.where(go_left, 1, 2)
+            pos = jnp.where(in_lvl & cank, child, pos).astype(jnp.int32)
+
+    # per-level concatenation IS the heap layout: node (d, i) -> 2^d - 1 + i
+    tree = (
+        jnp.concatenate(tf_l),
+        jnp.concatenate(tb_l),
+        jnp.concatenate(tdl_l),
+        jnp.concatenate(tsp_l),
+        jnp.concatenate(tlf_l),
+    )
+    pred = _sel_table(tree[4], pos)
+    return tree, pred
+
+
+@lru_cache(maxsize=64)
+def _make_block_fn(
+    objective: str,
+    n_class_trees: int,
+    block: int,
+    p: TreeParams,
+    mesh,
+):
+    """Compile one training block: scan over `block` boosting rounds, the
+    whole thing one XLA program. Returns f(bins, y, valid, margin, key) ->
+    (margin', tree arrays [block, C, M])."""
+    D = p.max_depth
+    n_bins1 = p.nbins + 1
+    C = n_class_trees
+
+    @partial(jax.jit, donate_argnums=(3,))
+    def block_fn(bins, y, valid, margin, keys, bins_fm):
+        def one_round(margin, key_t):
+            g_all, h_all = grad_hess_device(objective, y, margin)
+            kr, kc, kt = jax.random.split(key_t, 3)
+            active = valid
+            if p.sample_rate < 1.0:
+                active = active & (
+                    jax.random.uniform(kr, active.shape) < p.sample_rate
+                )
+            F = bins.shape[1]
+            if p.col_sample_rate_per_tree < 1.0:
+                ncols = max(1, int(round(p.col_sample_rate_per_tree * F)))
+                r = jax.random.uniform(kc, (F,))
+                thresh = jnp.sort(r)[ncols - 1]
+                feat_mask = r <= thresh
+            else:
+                feat_mask = jnp.ones((F,), bool)
+
+            outs = []
+            for c in range(C):
+                tree, pred = _build_one_tree(
+                    bins,
+                    g_all[:, c].astype(jnp.float32),
+                    h_all[:, c].astype(jnp.float32),
+                    active,
+                    feat_mask,
+                    jax.random.fold_in(kt, c),
+                    p,
+                    mesh,
+                    bins_fm=bins_fm,
+                )
+                # margin update from this tree (full data, not just the sample)
+                margin = margin.at[:, c].add(pred)
+                outs.append(tree)
+            stacked = tuple(
+                jnp.stack([outs[c][i] for c in range(C)]) for i in range(5)
+            )  # each [C, M]
+            return margin, stacked
+
+        margin, trees = jax.lax.scan(one_round, margin, keys)
+        return margin, trees
+
+    return block_fn
 
 
 # ---------------------------------------------------------------------------
@@ -243,153 +449,158 @@ class BoostedTrees:
 
 def train_boosted(
     X: np.ndarray,
-    grad_hess_fn: Callable[[np.ndarray], Tuple[jnp.ndarray, jnp.ndarray]],
+    objective: str,
+    y: np.ndarray,
     n_class_trees: int,
     init_margin: np.ndarray,
     params: TreeParams,
     average: bool = False,
     monitor: Optional[Callable[[int, np.ndarray], bool]] = None,
+    score_interval: int = 1,
     mesh=None,
+    timings: Optional[dict] = None,
+    resume_from: Optional["BoostedTrees"] = None,
 ) -> BoostedTrees:
-    """Generic booster loop.
+    """Device-resident booster loop.
 
-    grad_hess_fn(margin[N, C]) -> (g[N, C], h[N, C]) on host or device.
-    monitor(tree_idx, margin) -> True to stop early (ScoreKeeper hook).
-    ``average=True`` gives DRF semantics (bagged trees, mean aggregation):
-    each tree then fits the raw targets (grad_hess_fn ignores the margin).
+    objective: a grad_hess_device family name ('gaussian', 'bernoulli',
+    'multinomial', 'poisson', 'laplace', 'quantile_0.5') or 'fixed' with
+    y = targets [N, C] (DRF bagging semantics, average=True).
+    monitor(tree_idx, margin[N, C]) -> True to stop early (ScoreKeeper hook);
+    called every `score_interval` trees, which is also the device-block size —
+    between calls nothing crosses the host boundary.
+    resume_from: checkpoint-continue (SharedTree.java:131-136): start from an
+    existing ensemble's trees + margin and train ``ntrees`` MORE trees. The
+    per-tree RNG is keyed by absolute tree index, so k trees then k more
+    reproduces a single 2k-tree run exactly.
     """
+    import time as _time
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from h2o3_tpu.ops.histogram import _hist_impl
+    from h2o3_tpu.parallel.mesh import DATA_AXIS
+
+    _t0 = _time.time()
     n, F = X.shape
     p = params
     if mesh is None:
         mesh = default_mesh()
     nshards = mesh.devices.size
 
-    edges = make_bins(X, p.nbins, seed=p.seed)
+    if resume_from is not None:
+        # continue training: reuse the checkpoint's binning + f0 exactly
+        init_margin = resume_from.init_margin
+        edges = resume_from.trees_per_class[0].edges
+        if resume_from.trees_per_class[0].n_bins1 != p.nbins + 1:
+            raise ValueError("checkpoint nbins mismatch")
+    else:
+        edges = make_bins(X, p.nbins, seed=p.seed)
     bins_host = apply_bins(X, edges)
     n_bins1 = p.nbins + 1
-    padn = (-n) % nshards
+    # pallas path: pad every shard to the kernel row tile so the prepared
+    # feature-major copy needs no per-level realignment
+    use_pallas = _hist_impl(None) == "pallas"
+    mult = nshards * 512 if use_pallas else nshards
+    padn = (-n) % mult
     if padn:
         bins_host = np.concatenate(
             [bins_host, np.zeros((padn, F), dtype=np.int32)], axis=0
         )
     bins_d = jax.device_put(bins_host, row_sharding(mesh, 2))
     n_pad = bins_host.shape[0]
-    valid_row = np.arange(n_pad) < n
+    valid_d = jax.device_put(np.arange(n_pad) < n, row_sharding(mesh, 1))
 
-    margin = np.tile(np.asarray(init_margin, dtype=np.float32), (n, 1))  # [N, C]
-    rng = np.random.default_rng(p.seed)
-    trees_per_class = [Trees(p.max_depth, n_bins1, edges) for _ in range(n_class_trees)]
+    bins_fm_d = None
+    if use_pallas:
+        from h2o3_tpu.ops.pallas_histogram import _FEAT_BLOCK
 
+        fb = min(_FEAT_BLOCK, F)
+        Fp = F + (-F) % fb
+        bfm_host = np.zeros((Fp, n_pad), dtype=np.int32)
+        bfm_host[:F] = bins_host.T
+        bins_fm_d = jax.device_put(
+            bfm_host, NamedSharding(mesh, P(None, DATA_AXIS))
+        )
+
+    C = n_class_trees
+    if objective == "fixed":
+        targets = np.asarray(y, dtype=np.float32)
+        if targets.ndim == 1:
+            targets = targets[:, None]
+        y_host = np.zeros((n_pad, targets.shape[1]), np.float32)
+        y_host[:n] = targets
+        y_d = jax.device_put(y_host, row_sharding(mesh, 2))
+    else:
+        y_host = np.zeros(n_pad, np.float32)
+        y_host[:n] = np.asarray(y, dtype=np.float32)
+        y_d = jax.device_put(y_host, row_sharding(mesh, 1))
+
+    if resume_from is not None and objective != "fixed":
+        m0 = resume_from.predict_margin(X).astype(np.float32)  # [n, C]
+        margin_host = np.tile(
+            np.asarray(init_margin, dtype=np.float32), (n_pad, 1)
+        )
+        margin_host[:n] = m0
+    else:
+        margin_host = np.tile(
+            np.asarray(init_margin, dtype=np.float32), (n_pad, 1)
+        )
+    margin = jax.device_put(margin_host, row_sharding(mesh, 2))
+
+    trees_per_class = [Trees(p.max_depth, n_bins1, edges) for _ in range(C)]
+    tree_offset = 0
+    if resume_from is not None:
+        tree_offset = resume_from.trees_per_class[0].ntrees
+        for c in range(C):
+            src = resume_from.trees_per_class[c]
+            dst = trees_per_class[c]
+            dst.feat = list(src.feat)
+            dst.split_bin = list(src.split_bin)
+            dst.default_left = list(src.default_left)
+            dst.is_split = list(src.is_split)
+            dst.leaf = list(src.leaf)
     key = jax.random.PRNGKey(p.seed)
-    for t in range(p.ntrees):
-        g_all, h_all = grad_hess_fn(margin)
-        g_all = np.asarray(g_all, dtype=np.float32)
-        h_all = np.asarray(h_all, dtype=np.float32)
-        # row subsample for this boosting round
-        if p.sample_rate < 1.0:
-            row_mask = rng.random(n) < p.sample_rate
-        else:
-            row_mask = np.ones(n, dtype=bool)
-        # per-tree column subsample
-        if p.col_sample_rate_per_tree < 1.0:
-            ncols = max(1, int(round(p.col_sample_rate_per_tree * F)))
-            chosen = rng.choice(F, ncols, replace=False)
-            feat_mask = np.zeros(F, dtype=bool)
-            feat_mask[chosen] = True
-        else:
-            feat_mask = np.ones(F, dtype=bool)
-        feat_mask_d = jnp.asarray(feat_mask)
+    jax.block_until_ready(margin)
+    _t_prep = _time.time()
 
-        for c in range(n_class_trees):
-            g = np.zeros(n_pad, dtype=np.float32)
-            h = np.zeros(n_pad, dtype=np.float32)
-            g[:n], h[:n] = g_all[:, c], h_all[:, c]
-            g_d = jax.device_put(g, row_sharding(mesh, 1))
-            h_d = jax.device_put(h, row_sharding(mesh, 1))
-            active = row_mask
-            if padn:
-                active = np.concatenate([row_mask, np.zeros(padn, dtype=bool)])
-            nodes0 = np.where(valid_row & active, 0, -1).astype(np.int32)
-            nodes = jax.device_put(nodes0, row_sharding(mesh, 1))
+    # the block program depends on neither ntrees nor seed — normalize them
+    # out of the compile-cache key
+    from dataclasses import replace as _dc_replace
 
-            M = 2 ** (p.max_depth + 1) - 1
-            t_feat = np.zeros(M, np.int32)
-            t_bin = np.zeros(M, np.int32)
-            t_dl = np.zeros(M, bool)
-            t_sp = np.zeros(M, bool)
-            t_lf = np.zeros(M, np.float32)
+    p_key = _dc_replace(p, ntrees=0, seed=0)
 
-            for d in range(p.max_depth + 1):
-                K = 2**d
-                hist = build_histogram_sharded(
-                    bins_d, nodes, g_d, h_d, n_nodes=K, n_bins1=n_bins1, mesh=mesh
+    import os
+
+    built = 0
+    default_block = int(os.environ.get("H2O3_TPU_TREE_BLOCK", "16"))
+    while built < p.ntrees:
+        block = (
+            min(score_interval, p.ntrees - built)
+            if monitor is not None
+            else min(default_block, p.ntrees - built)
+        )
+        fn = _make_block_fn(objective, C, block, p_key, mesh)
+        # one key per ABSOLUTE tree index: blocking and checkpoints never
+        # change the random stream a given tree sees
+        keys = jax.vmap(lambda t: jax.random.fold_in(key, t))(
+            jnp.arange(tree_offset + built, tree_offset + built + block)
+        )
+        margin, trees_dev = fn(bins_d, y_d, valid_d, margin, keys, bins_fm_d)
+        tf, tb, tdl, tsp, tlf = jax.device_get(trees_dev)  # [block, C, M] each
+        for t in range(block):
+            for c in range(C):
+                trees_per_class[c].append(
+                    tf[t, c], tb[t, c], tdl[t, c], tsp[t, c], tlf[t, c]
                 )
-                if p.mtries > 0:
-                    key, sub = jax.random.split(key)
-                    r = jax.random.uniform(sub, (K, F))
-                    thresh = jnp.sort(r, axis=1)[:, p.mtries - 1][:, None]
-                    node_feat_mask = (r <= thresh) & feat_mask_d[None, :]
-                else:
-                    node_feat_mask = None
-                bf, bb, dl, gain, leaf = _split_search(
-                    hist,
-                    jnp.float32(p.reg_lambda),
-                    jnp.float32(p.reg_alpha),
-                    jnp.float32(p.gamma),
-                    jnp.float32(p.learn_rate),
-                    feat_mask_d if node_feat_mask is None else node_feat_mask,
-                    min_rows=float(p.min_rows),
-                    n_bins1=n_bins1,
-                )
-                bf, bb, dl, gain, leaf = jax.device_get((bf, bb, dl, gain, leaf))
-                lo = 2**d - 1
-                can = (gain > max(p.min_split_improvement, 0.0)) & np.isfinite(gain) & (d < p.max_depth)
-                t_feat[lo : lo + K] = bf
-                t_bin[lo : lo + K] = bb
-                t_dl[lo : lo + K] = dl
-                t_sp[lo : lo + K] = can
-                t_lf[lo : lo + K] = leaf
-                if not can.any():
-                    break
-                nodes = _route_rows(
-                    bins_d,
-                    nodes,
-                    jnp.asarray(bf),
-                    jnp.asarray(bb),
-                    jnp.asarray(dl),
-                    jnp.asarray(can),
-                    jnp.int32(n_bins1),
-                )
-            trees_per_class[c].append(t_feat, t_bin, t_dl, t_sp, t_lf)
+        built += block
+        if monitor is not None:
+            margin_host = np.asarray(jax.device_get(margin), np.float64)[:n]
+            if monitor(built - 1, margin_host):
+                break
 
-            # margin update from this tree (full data, not just the sample)
-            pred = _tree_predict_single(
-                bins_d, jnp.asarray(t_feat), jnp.asarray(t_bin), jnp.asarray(t_dl),
-                jnp.asarray(t_sp), jnp.asarray(t_lf), p.max_depth, jnp.int32(n_bins1),
-            )
-            margin[:, c] += np.asarray(jax.device_get(pred))[:n]
-
-        if monitor is not None and monitor(t, margin):
-            break
-
-    if average:
-        # DRF: margins were accumulated as sums; convert to means lazily at
-        # predict; training margin conversion is the caller's concern.
-        pass
+    if timings is not None:
+        jax.block_until_ready(margin)
+        timings["prep_s"] = _t_prep - _t0
+        timings["train_s"] = _time.time() - _t_prep
     return BoostedTrees(trees_per_class, np.asarray(init_margin, np.float64), p, average=average)
-
-
-@partial(jax.jit, static_argnames=("max_depth",))
-def _tree_predict_single(bins, feat, split_bin, default_left, is_split, leaf, max_depth: int, n_bins1_arr):
-    idx = jnp.zeros(bins.shape[0], dtype=jnp.int32)
-
-    def body(_, idx):
-        f = feat[idx]
-        b = jnp.take_along_axis(bins, f[:, None], axis=1)[:, 0]
-        is_na = b >= n_bins1_arr - 1
-        go_left = jnp.where(is_na, default_left[idx], b <= split_bin[idx])
-        nxt = 2 * idx + jnp.where(go_left, 1, 2)
-        return jnp.where(is_split[idx], nxt, idx)
-
-    idx = jax.lax.fori_loop(0, max_depth, body, idx)
-    return leaf[idx]

@@ -130,6 +130,54 @@ def training_score(distribution: str, y: np.ndarray, margin: np.ndarray) -> floa
     return float(np.mean((margin[:, 0] - y) ** 2))
 
 
+def checkpoint_booster(p, n_class_trees: int, algo_name: str = None):
+    """Resolve the ``checkpoint`` param to the prior model's booster
+    (checkpoint-continue, ``hex/tree/SharedTree.java:131-136``). The
+    reference validates that non-modifiable params match the checkpoint
+    (CheckpointUtils); here: same algo, class count, depth, and binning."""
+    if not p.checkpoint:
+        return None
+    from h2o3_tpu.keyed import DKV
+
+    prior = DKV.get(p.checkpoint)
+    if prior is None:
+        raise ValueError(f"checkpoint model {p.checkpoint!r} not found")
+    b = getattr(prior, "booster", None)
+    if b is None:
+        raise ValueError(f"checkpoint model {p.checkpoint!r} is not a tree model")
+    if algo_name is not None and getattr(prior, "algo_name", None) != algo_name:
+        raise ValueError(
+            f"checkpoint model is {getattr(prior, 'algo_name', '?')!r}, "
+            f"cannot continue it as {algo_name!r}"
+        )
+    if b.nclasses_trees != n_class_trees:
+        raise ValueError("checkpoint class count differs from this training frame")
+    t0 = b.trees_per_class[0]
+    if t0.max_depth != p.max_depth:
+        raise ValueError(
+            f"checkpoint max_depth={t0.max_depth} differs from requested {p.max_depth}"
+        )
+    if t0.n_bins1 != p.nbins + 1:
+        raise ValueError(
+            f"checkpoint nbins={t0.n_bins1 - 1} differs from requested {p.nbins}"
+        )
+    return b
+
+
+def extra_trees(p, n_class_trees: int) -> int:
+    """Trees still to build on top of the checkpoint; ``ntrees`` is the TOTAL
+    (reference: restart validation requires ntrees > checkpoint's)."""
+    b = checkpoint_booster(p, n_class_trees)
+    if b is None:
+        return p.ntrees
+    built = b.trees_per_class[0].ntrees
+    if p.ntrees <= built:
+        raise ValueError(
+            f"checkpoint already has {built} trees; ntrees={p.ntrees} must exceed it"
+        )
+    return p.ntrees - built
+
+
 class TreeModelBase(Model):
     """Common prediction path for GBM/DRF/XGBoost models."""
 

@@ -20,7 +20,13 @@ from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.models.data_info import response_vector
 from h2o3_tpu.models.framework import ModelBuilder, ModelParameters
 from h2o3_tpu.models.tree.booster import TreeParams, train_boosted
-from h2o3_tpu.models.tree.common import TreeModelBase, tree_data_info, tree_matrix
+from h2o3_tpu.models.tree.common import (
+    TreeModelBase,
+    checkpoint_booster as _checkpoint_booster,
+    extra_trees as _extra_trees,
+    tree_data_info,
+    tree_matrix,
+)
 
 
 @dataclass
@@ -83,7 +89,7 @@ class DRF(ModelBuilder):
             n_class_trees = 1
 
         tp = TreeParams(
-            ntrees=p.ntrees,
+            ntrees=_extra_trees(p, n_class_trees),
             max_depth=p.max_depth,
             learn_rate=1.0,  # no shrinkage: each tree predicts the target itself
             nbins=p.nbins,
@@ -96,18 +102,17 @@ class DRF(ModelBuilder):
             seed=p.actual_seed(),
         )
 
-        # each tree independently fits the raw targets: g = -target, h = 1
-        # gives Newton leaf = mean(target in leaf)
-        def gh(_margin):
-            return -targets, np.ones_like(targets)
-
+        # objective='fixed': each tree independently fits the raw targets
+        # (g = -target, h = 1 gives Newton leaf = mean(target in leaf))
         model.booster = train_boosted(
             X,
-            grad_hess_fn=gh,
+            objective="fixed",
+            y=targets,
             n_class_trees=n_class_trees,
             init_margin=np.zeros(n_class_trees),
             params=tp,
             average=True,
+            resume_from=_checkpoint_booster(p, n_class_trees, self.algo_name),
         )
         model.ntrees_built = model.booster.trees_per_class[0].ntrees
         model.training_metrics = model.model_performance(frame)

@@ -21,7 +21,8 @@ from h2o3_tpu.models.tree.booster import TreeParams, train_boosted
 from h2o3_tpu.models.tree.common import (
     TreeModelBase,
     auto_distribution,
-    grad_hess,
+    checkpoint_booster as _checkpoint_booster,
+    extra_trees as _extra_trees,
     init_margin,
     training_score,
     tree_data_info,
@@ -69,7 +70,7 @@ class GBM(ModelBuilder):
         n_class_trees = nclasses if dist == "multinomial" else 1
 
         tp = TreeParams(
-            ntrees=p.ntrees,
+            ntrees=_extra_trees(p, n_class_trees),
             max_depth=p.max_depth,
             learn_rate=p.learn_rate,
             nbins=p.nbins,
@@ -97,11 +98,14 @@ class GBM(ModelBuilder):
 
         model.booster = train_boosted(
             X,
-            grad_hess_fn=lambda m: grad_hess(dist, y, m),
+            objective=dist,
+            y=y,
             n_class_trees=n_class_trees,
             init_margin=f0,
             params=tp,
-            monitor=monitor,
+            monitor=monitor if p.stopping_rounds > 0 else None,
+            score_interval=p.score_tree_interval,
+            resume_from=_checkpoint_booster(p, n_class_trees, self.algo_name),
         )
         model.ntrees_built = model.booster.trees_per_class[0].ntrees
         model.training_metrics = model.model_performance(frame)

@@ -159,7 +159,11 @@ def radix_argsort(keys: np.ndarray) -> Optional[np.ndarray]:
         return None
     k = np.asarray(keys)
     if k.dtype == np.float64:
-        # order-preserving float->uint64 transform (flip sign bit / negate)
+        # order-preserving float->uint64 transform (flip sign bit / negate);
+        # canonicalize NaNs (negative-sign NaNs must also sort last) and
+        # -0.0 -> +0.0 (numpy treats them as equal ties; the bit transform
+        # would otherwise order them)
+        k = np.where(np.isnan(k), np.nan, k + 0.0)
         bits = k.view(np.uint64).copy()
         neg = bits >> np.uint64(63) == 1
         bits[neg] = ~bits[neg]

@@ -123,49 +123,67 @@ def _hist_impl(impl: Optional[str]) -> str:
     return impl
 
 
-def _one_shard_histogram(bins, nodes, g, h, n_nodes, n_bins1, impl, vma=()):
+def _one_shard_histogram(bins, nodes, g, h, n_nodes, n_bins1, impl, vma=(), bins_fm=None):
     if impl == "pallas":
         from h2o3_tpu.ops.pallas_histogram import build_histogram_pallas
 
         return build_histogram_pallas(
             bins, nodes, g, h, n_nodes, n_bins1,
-            interpret=jax.default_backend() != "tpu", vma=vma,
+            interpret=jax.default_backend() != "tpu", vma=vma, bins_fm=bins_fm,
         )
     return _shard_histogram(bins, nodes, g, h, n_nodes, n_bins1)
 
 
 def build_histogram_sharded(
     bins, nodes, g, h, n_nodes: int, n_bins1: int, mesh=None,
-    impl: Optional[str] = None,
+    impl: Optional[str] = None, bins_fm=None,
 ):
     """Full distributed histogram: private scatter-add per shard, psum merge.
 
     bins:[N,F] int32 row-sharded; nodes:[N] int32 (-1 = inactive row);
-    g,h:[N] float32. Returns replicated [n_nodes, F, n_bins1, 3].
+    g,h:[N] float32. bins_fm: optional feature-major [F, N] copy of bins
+    (already padded to the kernel row tile) — callers in a training loop pass
+    it so the pallas path skips a per-call transpose.
+    Returns replicated [n_nodes, F, n_bins1, 3].
     """
     # resolve the env override OUTSIDE the jit cache so changing it between
     # calls takes effect (the resolved impl is the static cache key)
     return _build_histogram_jit(
-        bins, nodes, g, h, n_nodes, n_bins1, mesh, _hist_impl(impl)
+        bins, nodes, g, h, bins_fm, n_nodes, n_bins1, mesh, _hist_impl(impl)
     )
 
 
 @partial(jax.jit, static_argnames=("n_nodes", "n_bins1", "mesh", "impl"))
 def _build_histogram_jit(
-    bins, nodes, g, h, n_nodes: int, n_bins1: int, mesh, impl: str
+    bins, nodes, g, h, bins_fm, n_nodes: int, n_bins1: int, mesh, impl: str
 ):
     if mesh is None:
-        return _one_shard_histogram(bins, nodes, g, h, n_nodes, n_bins1, impl)
+        return _one_shard_histogram(
+            bins, nodes, g, h, n_nodes, n_bins1, impl, bins_fm=bins_fm
+        )
 
-    def fn(b, nd, gg, hh):
+    def fn(b, nd, gg, hh, bfm):
         part = _one_shard_histogram(
-            b, nd, gg, hh, n_nodes, n_bins1, impl, vma=(DATA_AXIS,)
+            b, nd, gg, hh, n_nodes, n_bins1, impl, vma=(DATA_AXIS,), bins_fm=bfm
         )
         return jax.lax.psum(part, DATA_AXIS)
 
+    if bins_fm is None:
+        def fn4(b, nd, gg, hh):
+            return fn(b, nd, gg, hh, None)
+
+        return _shard_map(
+            fn4,
+            mesh=mesh,
+            in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+            out_specs=P(),
+        )(bins, nodes, g, h)
     return _shard_map(
         fn,
         mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        in_specs=(
+            P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+            P(None, DATA_AXIS),
+        ),
         out_specs=P(),
-    )(bins, nodes, g, h)
+    )(bins, nodes, g, h, bins_fm)

@@ -1,4 +1,4 @@
-"""Pallas TPU kernel for the gradient-histogram hot op (``tpu_hist``).
+"""Pallas TPU kernels for the gradient-histogram hot op (``tpu_hist``).
 
 Reference semantics: ``hex/tree/DHistogram.java:433`` (updateHisto — per
 (node, feature, bin) accumulation of {Σg, Σh, Σw}) as driven by
@@ -6,24 +6,23 @@ Reference semantics: ``hex/tree/DHistogram.java:433`` (updateHisto — per
 histograms, then merge) and the native ``grow_gpu_hist`` updater in the
 XGBoost extension (SURVEY.md §2.3).
 
-TPU-native redesign — the scatter-add becomes dense MXU matmuls:
+Two TPU-native designs, both turning the scatter-add into dense MXU work:
 
-1. XLA prep (per tree level): stable-sort the row ids by tree node, pad
-   each node's segment of the sorted order to a multiple of the row tile
-   ``R`` (padded rows carry zero values, so no masking is needed in the
-   kernel), and gather bins/values into that padded layout.  Per row-tile
-   scalars (its node id, and a first-tile-of-node flag) are precomputed.
-2. Pallas kernel: 1-D grid over row tiles with
-   ``pltpu.PrefetchScalarGridSpec``.  The output BlockSpec's index map
-   reads the prefetched node id, so each grid step's output block IS that
-   node's (F, C, B) histogram slab; consecutive tiles of the same node
-   revisit the same block and accumulate in VMEM.  Within a step, each
-   feature's histogram is ``one_hot(bins)ᵀ @ vals`` — a [B1, R] × [R, C]
-   contraction on the MXU instead of a serialized scatter.
+**Fixed-layout node-matmul kernel** (default for K·C ≤ 512, i.e. every
+level of a depth ≤ 6 tree): rows NEVER move. Grid over (feature-block,
+row-tile); each step computes ``one_hot(bins)[R, Fb·B1]ᵀ ⊗
+node_masked_vals[R, K·C]`` as ONE dot_general on the MXU and accumulates
+into a VMEM-resident [Fb·B1, K·C] block revisited across row tiles. There
+is no sort, no scatter, no partition maintenance — the per-level prep the
+sorted kernel needs (and its O(N log N) bitonic argsort on TPU) vanishes.
+The histogram for ALL nodes of the level materializes in one pass.
 
-Total matmul work is N·F·B1·C MACs per level — independent of tree depth
-(the sort gives each row exactly one node slab), unlike a dense
-one-hot-over-(node,bin) formulation which would cost K× more.
+**Sorted tile-per-node kernel** (fallback for deep levels, K·C > 512,
+where the all-nodes output exceeds VMEM): stable-sort row ids by node, pad
+each node's segment to a row-tile multiple, then a 1-D grid with
+``pltpu.PrefetchScalarGridSpec`` where the output BlockSpec's index map
+reads the prefetched node id — each grid step's output block IS that
+node's (F, C, B) slab, accumulated in VMEM across that node's tiles.
 
 The portable XLA scatter path in ``h2o3_tpu/ops/histogram.py`` is the
 correctness oracle; ``tests/test_pallas_histogram.py`` checks parity in
@@ -45,6 +44,132 @@ from jax.experimental.pallas import tpu as pltpu
 # channels: 0=Σg, 1=Σh, 2=Σw(count); a 4th pad channel keeps the matmul
 # operand lane-friendly.
 _C = 4
+
+#: node-matmul kernel applies while K*_C <= this (VMEM budget for the
+#: [Fb*B1, K*C] accumulator + operands; ~16 MB/core on v5e)
+_NODE_MATMUL_MAX_KC = 512
+
+#: feature-block width of the node-matmul kernel grid (callers preparing an
+#: aligned feature-major bins copy must pad features to a multiple of this)
+_FEAT_BLOCK = 8
+
+
+# ---------------------------------------------------------------------------
+# fixed-layout node-matmul kernel
+
+
+def _nm_kernel(bins_ref, node_ref, vals_ref, out_ref, *, n_feat_b, n_bins1, n_nodes):
+    """One grid step = one (feature-block, row-tile).
+
+    bins_ref: [Fb, R] int32 (feature-major — Mosaic wants the long axis in
+    lanes); node_ref: [R, 1] int32 (-1 inactive; 2-D so the block layout
+    matches XLA's 1-D tiling); vals_ref: [R, C] f32;
+    out_ref: [1, Fb*B1, K*C] f32 (revisited across the row-tile grid
+    dimension — accumulates in VMEM).
+    """
+    r = node_ref.shape[0]
+    rt = pl.program_id(1)
+
+    # [Fb*B1, R] bf16 one-hot of bin codes (built in VMEM, free vs the MXU)
+    bins = bins_ref[...]  # [Fb, R]
+    iota_b = jax.lax.broadcasted_iota(jnp.int32, (n_feat_b, n_bins1, r), 1)
+    onehot = (iota_b == bins[:, None, :]).reshape(n_feat_b * n_bins1, r)
+    onehot = onehot.astype(jnp.bfloat16)
+
+    # [R, K*C] node-masked values, built lane-wise (no minor-dim reshape —
+    # Mosaic can't merge a (K, C) lane split); lane j carries node j//C,
+    # channel j%C. Channel 3 is the zero pad.
+    node = node_ref[...]  # [R, 1]
+    vals = vals_ref[...]  # [R, C]
+    kc = n_nodes * _C
+    iota_kc = jax.lax.broadcasted_iota(jnp.int32, (r, kc), 1)
+    kk = iota_kc // _C
+    cc = jax.lax.rem(iota_kc, _C)
+    m_node = kk == node  # node<0 never matches
+    vals_k = jnp.zeros((r, kc), jnp.float32)
+    for c in range(3):
+        vals_k = vals_k + jnp.where(
+            m_node & (cc == c), vals[:, c][:, None], 0.0
+        )
+    vals_k = vals_k.astype(jnp.bfloat16)
+
+    # [Fb*B1, K*C] = onehot @ vals_k — contraction over rows on the MXU
+    slab = jax.lax.dot_general(
+        onehot, vals_k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )[None]
+
+    @pl.when(rt == 0)
+    def _():
+        out_ref[...] = slab
+
+    @pl.when(rt != 0)
+    def _():
+        out_ref[...] = out_ref[...] + slab
+
+
+def _build_histogram_nodematmul(
+    bins, nodes, g, h, n_nodes: int, n_bins1: int,
+    row_tile: int, feat_block: int, interpret: bool, vma: tuple,
+    bins_fm=None,
+):
+    n, n_feat = bins.shape
+    r = row_tile
+    fb = min(feat_block, n_feat)
+    padf = (-n_feat) % fb
+    n_feat_p = n_feat + padf
+    if bins_fm is not None and bins_fm.shape == (n_feat_p, n) and n % r == 0:
+        pass  # caller prepared the aligned feature-major copy: zero prep here
+    else:
+        if n % r:
+            pad = (-n) % r
+            bins = jnp.pad(bins, ((0, pad), (0, 0)))
+            nodes = jnp.pad(nodes, (0, pad), constant_values=-1)
+            g = jnp.pad(g, (0, pad))
+            h = jnp.pad(h, (0, pad))
+            n = n + pad
+        if padf:
+            # pad features with bin code 0: sliced away after the reshape below
+            bins = jnp.pad(bins, ((0, 0), (0, padf)))
+        bins_fm = bins.T  # [Fp, N] feature-major: rows land in the lane axis
+
+    w = (nodes >= 0).astype(jnp.float32)
+    vals = jnp.stack(
+        [g.astype(jnp.float32) * w, h.astype(jnp.float32) * w, w, jnp.zeros_like(w)],
+        axis=1,
+    )  # [N, C]
+
+    n_ftiles = n_feat_p // fb
+    n_rtiles = n // r
+
+    out = pl.pallas_call(
+        partial(_nm_kernel, n_feat_b=fb, n_bins1=n_bins1, n_nodes=n_nodes),
+        grid=(n_ftiles, n_rtiles),
+        in_specs=[
+            pl.BlockSpec((fb, r), lambda f, t: (f, t)),
+            pl.BlockSpec((r, 1), lambda f, t: (t, 0)),
+            pl.BlockSpec((r, _C), lambda f, t: (t, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, fb * n_bins1, n_nodes * _C), lambda f, t: (f, 0, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct(
+            (n_ftiles, fb * n_bins1, n_nodes * _C), jnp.float32,
+            vma=frozenset(vma) if vma else None,
+        ),
+        interpret=interpret,
+    )(bins_fm, nodes[:, None], vals)
+
+    # [Ft, Fb*B1, K*C] -> [K, F, B1, 3]
+    out = out.reshape(n_ftiles, fb, n_bins1, n_nodes, _C)
+    out = jnp.transpose(out, (3, 0, 1, 2, 4)).reshape(
+        n_nodes, n_feat_p, n_bins1, _C
+    )
+    return out[:, :n_feat, :, :3]
+
+
+# ---------------------------------------------------------------------------
+# sorted tile-per-node kernel (deep levels)
 
 
 def _hist_kernel(node_ref, first_ref, bins_ref, vals_ref, out_ref, *, n_feat, n_bins1):
@@ -132,11 +257,12 @@ def _prep_padded(bins, nodes, g, h, n_nodes: int, row_tile: int, t_max: int):
 
 @partial(
     jax.jit,
-    static_argnames=("n_nodes", "n_bins1", "row_tile", "interpret", "vma"),
+    static_argnames=("n_nodes", "n_bins1", "row_tile", "interpret", "vma", "kernel"),
 )
 def build_histogram_pallas(
     bins, nodes, g, h, n_nodes: int, n_bins1: int,
     row_tile: int = 512, interpret: bool = False, vma: tuple = (),
+    kernel: str = "auto", bins_fm=None,
 ):
     """Drop-in Pallas replacement for ``histogram._shard_histogram``.
 
@@ -144,6 +270,14 @@ def build_histogram_pallas(
     nodes: [N] int32 (-1 = inactive row); g, h: [N] float.
     Returns [n_nodes, F, n_bins1, 3] float32 of (Σg, Σh, count).
     """
+    if kernel == "nodematmul" or (
+        kernel == "auto" and n_nodes * _C <= _NODE_MATMUL_MAX_KC
+    ):
+        return _build_histogram_nodematmul(
+            bins, nodes, g, h, n_nodes, n_bins1,
+            row_tile=row_tile, feat_block=_FEAT_BLOCK, interpret=interpret, vma=vma,
+            bins_fm=bins_fm,
+        )
     n, n_feat = bins.shape
     r = row_tile
     t_max = (n + r - 1) // r + n_nodes  # ≤ R-1 pad rows per node

@@ -18,6 +18,7 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 from h2o3_tpu.frame.frame import Column, ColType, Frame
+from h2o3_tpu.rapids.merge import lexsort
 
 AGGS = ("nrow", "mean", "sum", "min", "max", "sd", "var", "mode", "median", "first", "last")
 
@@ -40,7 +41,7 @@ def group_keys(fr: Frame, by: Sequence[int]) -> Tuple[np.ndarray, np.ndarray, np
             full = np.full(len(d), len(uniq), dtype=np.int64)
             full[~np.isnan(d)] = codes
             keys.append(full)
-    order = np.lexsort(tuple(reversed(keys)))
+    order = lexsort(list(reversed(keys)))
     stacked = np.stack([k[order] for k in keys], axis=1)
     change = np.any(stacked[1:] != stacked[:-1], axis=1)
     starts = np.concatenate([[0], np.nonzero(change)[0] + 1])
@@ -151,7 +152,7 @@ def rank_within_group_by(
         rows_v = rows[valid]
         if not len(rows_v):
             continue
-        sub = np.lexsort(tuple(k[valid] for k in keys))
+        sub = lexsort([k[valid] for k in keys])
         rank[rows_v[sub]] = np.arange(1, len(rows_v) + 1, dtype=np.float64)
     out = fr.add_column(Column(new_col, rank, ColType.NUM))
     return out

@@ -20,6 +20,39 @@ import numpy as np
 
 from h2o3_tpu.frame.frame import Column, ColType, Frame, _merge_domains
 
+#: below this the ctypes/key-transform overhead beats numpy's introsort
+_RADIX_MIN_N = 4096
+
+
+def stable_argsort(keys: np.ndarray) -> np.ndarray:
+    """Stable argsort, using the native LSD radix sort (native/codecs.cpp —
+    the RadixOrder.java analogue) for large int64/uint64/float64 arrays,
+    numpy otherwise. Parity pinned by tests/test_native.py."""
+    keys = np.asarray(keys)
+    if len(keys) >= _RADIX_MIN_N and keys.dtype in (
+        np.dtype(np.int64), np.dtype(np.uint64), np.dtype(np.float64)
+    ):
+        try:
+            from h2o3_tpu import native
+
+            order = native.radix_argsort(keys)
+            if order is not None:
+                return order
+        except Exception:
+            pass
+    return np.argsort(keys, kind="stable")
+
+
+def lexsort(keys: Sequence[np.ndarray]) -> np.ndarray:
+    """np.lexsort-compatible multi-key stable sort (last key primary),
+    as successive stable radix passes — LSD over whole keys, exactly the
+    composition RadixOrder.java applies byte-wise."""
+    keys = [np.asarray(k) for k in keys]
+    order = stable_argsort(keys[0])
+    for k in keys[1:]:
+        order = order[stable_argsort(k[order])]
+    return order
+
 
 def sort_frame(fr: Frame, by: Sequence[int], ascending: Optional[Sequence[bool]] = None) -> Frame:
     """(sort fr [cols] [asc]) — stable multi-key sort; NAs sort first
@@ -37,7 +70,7 @@ def sort_frame(fr: Frame, by: Sequence[int], ascending: Optional[Sequence[bool]]
             k = c.numeric_view().copy()
             k[np.isnan(k)] = -np.inf  # NAs first
         keys.append(k if asc else -k)
-    order = np.lexsort(tuple(keys))
+    order = lexsort(keys)
     return fr.rows(order)
 
 
@@ -88,7 +121,7 @@ def merge_frames(
     inner by default; all_left/all_right add unmatched rows with NAs.
     Output columns: join keys (left naming), then left non-key, right non-key."""
     lk, rk = _encode_keys(left, right, by_left, by_right)
-    r_order = np.argsort(rk, kind="stable")
+    r_order = stable_argsort(rk)
     rk_sorted = rk[r_order]
     lo = np.searchsorted(rk_sorted, lk, side="left")
     hi = np.searchsorted(rk_sorted, lk, side="right")

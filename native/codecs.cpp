@@ -20,12 +20,12 @@
 #include <cstdint>
 #include <cstring>
 
-extern "C" {
-
 static const double kNaN = __builtin_nan("");
 
+extern "C" {
 // Worst-case encoded size for n doubles (RAW64 + header).
 int64_t h2o3_codec_bound(int64_t n) { return 9 + n * 8 + 16; }
+}
 
 namespace {
 
@@ -96,6 +96,8 @@ static void dec_int(const uint8_t* in, double* out) {
 
 }  // namespace
 
+extern "C" {
+
 // Encode n doubles; returns encoded byte length.
 int64_t h2o3_codec_encode(const double* x, int64_t n, uint8_t* out) {
   Stats s = scan(x, n);
@@ -125,6 +127,10 @@ int64_t h2o3_codec_encode(const double* x, int64_t n, uint8_t* out) {
       if (!is_na(x[i])) {
         double c = std::nearbyint(x[i] * 100.0) - bias;
         if (c < -32767.0 || c > 32767.0) fits = false;
+        // exact round-trip required: the scan's epsilon test admits values
+        // like 0.1+0.2 whose decode would differ in the last ulp — lossless
+        // means decode == input bit-for-bit, so re-verify exactly
+        else if ((bias + c) / 100.0 != x[i]) fits = false;
       }
     if (fits) {
       out[0] = 5;

@@ -1,0 +1,197 @@
+"""Native runtime components: parity against the pure-python/numpy paths.
+
+Covers native/csv.cpp (CsvParser.java hot-loop analogue), native/codecs.cpp
+(C*Chunk codec lineup + RadixOrder.java-style LSD radix argsort), and the
+frame binary persist layer that rides the codecs
+(water/fvec/persist/FramePersist.java analogue).
+
+Every native path has a same-answer oracle here; if the shared library can't
+build, the library-level tests skip but the fallbacks still run.
+"""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu import native
+from h2o3_tpu.frame.frame import ColType, Column, Frame
+from h2o3_tpu.frame.parse import ParseSetup, _native_numeric_fast, parse_csv
+from h2o3_tpu.frame import persist
+from h2o3_tpu.rapids.merge import lexsort, stable_argsort
+
+HAVE = native.available()
+needs_native = pytest.mark.skipif(not HAVE, reason="native lib unavailable")
+
+
+# ---------------------------------------------------------------------------
+# csv fast path
+
+
+@needs_native
+def test_native_csv_matches_python_parse():
+    rng = np.random.default_rng(0)
+    rows = ["a,b,c"]
+    for i in range(500):
+        cells = []
+        for j in range(3):
+            r = rng.random()
+            if r < 0.1:
+                cells.append("NA")
+            elif r < 0.2:
+                cells.append(f"{rng.normal():.6e}")  # exponent form -> strtod path
+            elif r < 0.3:
+                cells.append(str(int(rng.integers(-1000, 1000))))
+            else:
+                cells.append(f"{rng.normal():.4f}")
+        rows.append(",".join(cells))
+    rows.append("1.5,2.5")  # short row: trailing cols -> NA
+    text = "\n".join(rows) + "\n"
+
+    fr_fast = parse_csv(text)
+    assert fr_fast.nrows == 501
+
+    # force the python path by making the fast-path precondition fail
+    import h2o3_tpu.frame.parse as parse_mod
+
+    orig = parse_mod._native_numeric_fast
+    parse_mod._native_numeric_fast = lambda *a, **k: None
+    try:
+        fr_py = parse_csv(text)
+    finally:
+        parse_mod._native_numeric_fast = orig
+
+    assert fr_fast.names == fr_py.names
+    for name in fr_fast.names:
+        np.testing.assert_array_equal(
+            fr_fast.col(name).data, fr_py.col(name).data
+        )
+
+
+@needs_native
+def test_native_fast_path_declines_non_numeric():
+    setup = ParseSetup(
+        separator=",", header=True, column_names=["a", "b"],
+        column_types=[ColType.NUM, ColType.CAT],
+    )
+    assert _native_numeric_fast("a,b\n1,x\n", setup) is None
+    # quoted text must decline too
+    setup2 = ParseSetup(
+        separator=",", header=True, column_names=["a"],
+        column_types=[ColType.NUM],
+    )
+    assert _native_numeric_fast('a\n"1"\n', setup2) is None
+
+
+# ---------------------------------------------------------------------------
+# codecs
+
+
+CODEC_CASES = [
+    np.full(100, 7.25),                                   # CONST
+    np.arange(100, dtype=np.float64),                     # INT8 span
+    np.arange(100, dtype=np.float64) * 300,               # INT16 span
+    np.arange(100, dtype=np.float64) * 1e6,               # INT32 span
+    np.round(np.linspace(-3, 3, 100), 2),                 # SCALED16
+    np.concatenate([np.zeros(400), [1.5, -2.25]]),        # SPARSE
+    np.array([0.1 + 0.2, 0.3, 1e-17, np.pi]),             # RAW64 (not scalable)
+    np.array([np.nan, 1.0, np.nan, 2.0]),                 # NAs in ints
+    np.full(10, np.nan),                                  # all-NA
+]
+
+
+@needs_native
+@pytest.mark.parametrize("x", CODEC_CASES, ids=range(len(CODEC_CASES)))
+def test_codec_roundtrip_bit_exact(x):
+    blob = native.codec_encode(x)
+    out = native.codec_decode(blob)
+    assert np.array_equal(out, x, equal_nan=True), f"tag={blob[0]}"
+    # python decoder reads native encodings (portable load path)
+    out_py = persist.codec_decode(blob)
+    assert np.array_equal(out_py, x, equal_nan=True)
+
+
+@needs_native
+def test_codec_compresses_small_ints():
+    x = np.asarray(np.random.default_rng(0).integers(0, 50, 10_000), dtype=np.float64)
+    blob = native.codec_encode(x)
+    assert len(blob) < 10_000 * 2  # ~1 byte/row + header, vs 8 raw
+
+
+def test_python_fallback_roundtrip():
+    x = np.array([1.5, np.nan, -2.0])
+    blob = persist.codec_encode(x)  # native or RAW64 fallback
+    out = persist.codec_decode(blob)
+    assert np.array_equal(out, x, equal_nan=True)
+
+
+# ---------------------------------------------------------------------------
+# radix argsort / lexsort
+
+
+@needs_native
+def test_radix_argsort_float_matches_numpy():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=10_000)
+    x[rng.random(10_000) < 0.05] = np.nan
+    x[0] = -np.inf
+    x[1] = np.inf
+    got = native.radix_argsort(x)
+    want = np.argsort(x, kind="stable")
+    np.testing.assert_array_equal(got, want)
+
+
+@needs_native
+def test_radix_argsort_int64_negative():
+    rng = np.random.default_rng(2)
+    x = rng.integers(-(10**12), 10**12, 5000)
+    np.testing.assert_array_equal(
+        native.radix_argsort(x), np.argsort(x, kind="stable")
+    )
+
+
+def test_stable_argsort_and_lexsort_match_numpy():
+    rng = np.random.default_rng(3)
+    # above the radix threshold so the native path engages when available
+    a = rng.integers(0, 50, 10_000).astype(np.int64)
+    b = rng.integers(0, 7, 10_000).astype(np.int64)
+    np.testing.assert_array_equal(stable_argsort(a), np.argsort(a, kind="stable"))
+    np.testing.assert_array_equal(lexsort([a, b]), np.lexsort((a, b)))
+    np.testing.assert_array_equal(lexsort([b, a]), np.lexsort((b, a)))
+
+
+# ---------------------------------------------------------------------------
+# frame persist (the codecs' production caller)
+
+
+def test_frame_save_load_roundtrip(tmp_path):
+    n = 200
+    rng = np.random.default_rng(4)
+    num = rng.normal(size=n)
+    num[:5] = np.nan
+    ints = rng.integers(0, 9, n).astype(np.float64)
+    codes = rng.integers(-1, 3, n).astype(np.int32)
+    strs = np.array(
+        [None if i % 17 == 0 else f"s{i % 5}" for i in range(n)], dtype=object
+    )
+    fr = Frame(
+        [
+            Column("num", num, ColType.NUM),
+            Column("ints", ints, ColType.NUM),
+            Column("cat", codes, ColType.CAT, ["a", "b", "c"]),
+            Column("s", strs, ColType.STR),
+            Column("t", np.abs(num) * 1e6, ColType.TIME),
+        ],
+        key="roundtrip.hex",
+    )
+    p = tmp_path / "fr.h2f"
+    persist.save_frame(fr, p)
+    back = persist.load_frame(p)
+    assert back.key == "roundtrip.hex"
+    assert back.names == fr.names
+    for name in fr.names:
+        c0, c1 = fr.col(name), back.col(name)
+        assert c0.type == c1.type
+        assert c0.domain == c1.domain
+        if c0.type is ColType.STR:
+            assert list(c0.data) == list(c1.data)
+        else:
+            np.testing.assert_array_equal(c0.data, c1.data)
