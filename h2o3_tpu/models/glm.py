@@ -37,7 +37,10 @@ from h2o3_tpu.models.data_info import (
 from h2o3_tpu.models.framework import Model, ModelBuilder, ModelParameters
 from h2o3_tpu.parallel.mesh import default_mesh, pad_rows, shard_rows
 
-FAMILIES = ("gaussian", "binomial", "quasibinomial", "poisson", "gamma", "tweedie")
+FAMILIES = (
+    "gaussian", "binomial", "quasibinomial", "poisson", "gamma", "tweedie",
+    "multinomial", "ordinal",
+)
 
 _DEFAULT_LINK = {
     "gaussian": "identity",
@@ -46,7 +49,11 @@ _DEFAULT_LINK = {
     "poisson": "log",
     "gamma": "log",
     "tweedie": "tweedie",
+    "multinomial": "multinomial",  # softmax
+    "ordinal": "ologit",  # cumulative logit (proportional odds)
 }
+
+SOLVERS = ("auto", "irlsm", "lbfgs")
 
 
 @dataclass
@@ -66,7 +73,8 @@ class GLMParameters(ModelParameters):
     tweedie_link_power: float = 0.0
     compute_p_values: bool = False
     missing_values_handling: str = "mean_imputation"
-    solver: str = "irlsm"
+    solver: str = "auto"  # auto|irlsm|lbfgs (GLMModel.java:268-334 solver enum)
+    lambda_min_ratio: float = 0.0  # 0 = auto: 1e-4 if n > p else 1e-2
 
     def actual_link(self) -> str:
         return _DEFAULT_LINK[self.family] if self.link == "family_default" else self.link
