@@ -20,6 +20,33 @@ import time
 
 import numpy as np
 
+# Persistent compilation cache (same settings the test tier uses,
+# tests/conftest.py): the unrolled boosting-block programs are large, and a
+# transient tunnel hiccup during a 30s+ remote compile is the #1 way this
+# bench has died.  A warm cache makes retries nearly free.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/h2o3_tpu_jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+
+
+def _retry(fn, attempts: int = 3, label: str = ""):
+    """Run fn(), retrying on transient runtime/compile errors.
+
+    The driver records rc=1 if the process dies; a single remote_compile
+    "response body closed" blip must not turn a real 2.7M rows/sec result
+    into an official crash (VERDICT r2 item 1).
+    """
+    last = None
+    for i in range(attempts):
+        try:
+            return fn()
+        except Exception as e:  # includes jaxlib XlaRuntimeError
+            last = e
+            print(f"# bench retry {i + 1}/{attempts} after {label} error: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            time.sleep(2.0 * (i + 1))
+    raise last
+
 
 def synth_higgs(n_rows: int, n_feat: int = 28, seed: int = 0):
     rng = np.random.default_rng(seed)
@@ -49,28 +76,35 @@ def main() -> None:
 
     # warmup run at full shape: compiles the training-block executable(s);
     # the timed run below hits the jit cache
-    train_boosted(X, "bernoulli", y, 1, f0, params)
+    _retry(lambda: train_boosted(X, "bernoulli", y, 1, f0, params),
+           label="warmup")
 
     # steady-state training throughput: the timings hook separates one-time
     # host prep (binning + device transfer over the tunnel) from the on-chip
     # boosting loop, the same split the reference's benchmarks use (DMatrix
     # build excluded from the gpu_hist training timer)
     timings = {}
-    booster = train_boosted(X, "bernoulli", y, 1, f0, params, timings=timings)
+
+    def _timed():
+        timings.clear()
+        return train_boosted(X, "bernoulli", y, 1, f0, params, timings=timings)
+
+    booster = _retry(_timed, label="timed-run")
     dt = timings["train_s"]
 
     rows_per_sec = n_rows * ntrees / dt  # row-scans per second per chip
 
     vs = 1.0
-    prior = sorted(glob.glob("BENCH_r*.json"))
-    if prior:
+    for path in sorted(glob.glob("BENCH_r*.json"), reverse=True):
         try:
-            with open(prior[-1]) as f:
+            with open(path) as f:
                 prev = json.load(f)
-            if prev.get("value"):
-                vs = rows_per_sec / float(prev["value"])
+            parsed = prev.get("parsed") or prev  # driver wraps under "parsed"
+            if parsed.get("value"):  # skip rounds that recorded a crash
+                vs = rows_per_sec / float(parsed["value"])
+                break
         except Exception:
-            pass
+            continue
 
     print(json.dumps({
         "metric": "tpu_hist_train_rows_per_sec_per_chip",
