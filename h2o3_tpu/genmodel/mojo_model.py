@@ -199,14 +199,29 @@ class GlmMojoModel(MojoModel):
 
     def _score_rows(self, rows):
         X = self.layout.expand(rows)
-        b = self._arrays["beta_std"]
-        eta = X @ b[:-1] + b[-1]
         off_col = self.meta.get("offset_column")
+        off = 0.0
         if off_col:  # GLMModel._eta adds the per-row offset
             off = np.array(
                 [float(r.get(off_col) or 0.0) for r in rows], dtype=np.float64
             )
-            eta = eta + off
+        family = self.meta["family"]
+        if family == "multinomial":  # softmax over per-class etas
+            B = self._arrays["beta_multi"]
+            eta = X @ B[:-1] + B[-1]
+            z = eta - eta.max(axis=1, keepdims=True)
+            e = np.exp(z)
+            return e / e.sum(axis=1, keepdims=True)
+        if family == "ordinal":  # P(y<=k) = sigmoid(t_k - eta), diffs
+            b = self._arrays["beta_std"]  # [P], no intercept slot
+            t = self._arrays["thresholds"]
+            eta = X @ b + off
+            cum = _sigmoid(t[None, :] - eta[:, None])
+            full = np.concatenate([cum, np.ones((len(eta), 1))], axis=1)
+            lower = np.concatenate([np.zeros((len(eta), 1)), cum], axis=1)
+            return np.maximum(full - lower, 1e-15)
+        b = self._arrays["beta_std"]
+        eta = X @ b[:-1] + b[-1] + off
         link = self.meta["link"]
         if link == "identity":
             mu = eta

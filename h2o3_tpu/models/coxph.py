@@ -180,6 +180,8 @@ class CoxPHModel(Model):
 
 
 class CoxPH(ModelBuilder):
+
+    SUPPORTED_COMMON = frozenset({"weights_column"})
     algo_name = "coxph"
 
     def __init__(self, params: Optional[CoxPHParameters] = None, **kw) -> None:

@@ -148,6 +148,8 @@ class DeepLearningModel(Model):
 
 
 class DeepLearning(ModelBuilder):
+
+    SUPPORTED_COMMON = frozenset({"stopping_rounds"})
     algo_name = "deeplearning"
 
     def __init__(self, params: Optional[DeepLearningParameters] = None, **kw) -> None:

@@ -167,6 +167,8 @@ class GAMModel(Model):
 
 
 class GAM(ModelBuilder):
+
+    SUPPORTED_COMMON = frozenset({"weights_column"})
     algo_name = "gam"
 
     def __init__(self, params: Optional[GAMParameters] = None, **kw) -> None:

@@ -239,6 +239,12 @@ class GLMModel(Model):
         self.coefficients: Dict[str, float] = {}
         self.coefficients_std: Dict[str, float] = {}
         self.beta_std: Optional[np.ndarray] = None  # [P+1] incl intercept, std space
+        # multinomial: [P+1, K] per-class betas (std space); ordinal: [P] beta
+        # + [K-1] increasing thresholds (std space), mirroring
+        # GLMModel.GLMOutput._global_beta_multinomial / ordinal intercepts
+        self.beta_multi: Optional[np.ndarray] = None
+        self.ordinal_thresholds: Optional[np.ndarray] = None
+        self.coefficients_multinomial: Optional[Dict[str, Dict[str, float]]] = None
         self.null_deviance: float = np.nan
         self.residual_deviance: float = np.nan
         self.aic: float = np.nan
@@ -246,6 +252,9 @@ class GLMModel(Model):
         self.std_errors: Optional[Dict[str, float]] = None
         self.p_values: Optional[Dict[str, float]] = None
         self.iterations: int = 0
+        # lambda_search artifacts (GLMModel.RegularizationPath)
+        self.lambda_path: Optional[List[Dict[str, float]]] = None
+        self.lambda_best: Optional[float] = None
 
     def _eta(self, frame: Frame) -> np.ndarray:
         X, _ = expand_matrix(self.data_info, frame, dtype=np.float64)
@@ -257,14 +266,42 @@ class GLMModel(Model):
 
     def _predict_raw(self, frame: Frame) -> np.ndarray:
         p: GLMParameters = self.params
+        if p.family == "multinomial":
+            X, _ = expand_matrix(self.data_info, frame, dtype=np.float64)
+            eta = X @ self.beta_multi[:-1] + self.beta_multi[-1]
+            if p.offset_column:
+                eta = eta + frame.col(p.offset_column).numeric_view()[:, None]
+            return _softmax(eta)
+        if p.family == "ordinal":
+            X, _ = expand_matrix(self.data_info, frame, dtype=np.float64)
+            eta = X @ self.beta_std
+            if p.offset_column:
+                eta = eta + frame.col(p.offset_column).numeric_view()
+            return _ordinal_probs(eta, self.ordinal_thresholds)
         mu = _linkinv(p.actual_link(), self._eta(frame), p)
         if p.family in ("binomial", "quasibinomial"):
             return np.stack([1 - mu, mu], axis=1)
         return mu
 
 
+def _softmax(eta: np.ndarray) -> np.ndarray:
+    z = eta - eta.max(axis=1, keepdims=True)
+    e = np.exp(z)
+    return e / e.sum(axis=1, keepdims=True)
+
+
+def _ordinal_probs(eta: np.ndarray, thresholds: np.ndarray) -> np.ndarray:
+    """Proportional-odds class probabilities: P(y<=k) = sigmoid(t_k - eta)."""
+    cum = 1.0 / (1.0 + np.exp(-(thresholds[None, :] - eta[:, None])))  # [N, K-1]
+    full = np.concatenate([cum, np.ones((len(eta), 1))], axis=1)
+    lower = np.concatenate([np.zeros((len(eta), 1)), cum], axis=1)
+    return np.maximum(full - lower, 1e-15)
+
+
 class GLM(ModelBuilder):
     """Builder (reference driver loop: hex/glm/GLM.java:1160 fitIRLSM)."""
+
+    SUPPORTED_COMMON = frozenset({"weights_column", "offset_column"})
 
     algo_name = "glm"
 
@@ -276,19 +313,43 @@ class GLM(ModelBuilder):
         p: GLMParameters = self.params
         if p.family not in FAMILIES:
             raise ValueError(f"family must be one of {FAMILIES}, got {p.family!r}")
+        if p.solver not in SOLVERS:
+            raise ValueError(f"solver must be one of {SOLVERS}, got {p.solver!r}")
         if not (0 <= p.alpha <= 1):
             raise ValueError("alpha must be in [0, 1]")
         if p.lambda_ < 0:
             raise ValueError("lambda must be >= 0")
-        if p.compute_p_values and p.lambda_ > 0:
+        if p.compute_p_values and (p.lambda_ > 0 or p.lambda_search):
             raise ValueError("p-values require lambda = 0 (no regularization)")
+        if p.compute_p_values and p.family in ("multinomial", "ordinal"):
+            raise ValueError(f"compute_p_values is not supported for family={p.family!r}")
+        if p.solver == "lbfgs" and p.alpha > 0 and (p.lambda_ > 0 or p.lambda_search):
+            raise ValueError(
+                "solver='lbfgs' does not support L1 (alpha > 0 with lambda > 0); "
+                "use solver='irlsm' (ADMM) or alpha=0"
+            )
+        if p.family == "ordinal":
+            if p.alpha > 0 and p.lambda_ > 0:
+                raise ValueError("family='ordinal' supports L2 regularization only (alpha=0)")
+            if p.lambda_search:
+                raise ValueError("lambda_search is not supported for family='ordinal'")
+            if p.solver == "irlsm":
+                raise ValueError(
+                    "family='ordinal' uses a gradient solver; set solver='auto' or 'lbfgs'"
+                )
+        if p.family == "multinomial" and p.offset_column:
+            # a shared offset shifts every class eta equally and cancels in the
+            # softmax — accepting it would be a silent no-op
+            raise ValueError("offset_column is not supported for family='multinomial'")
+        if p.lambda_search and p.nlambdas < 1:
+            raise ValueError("nlambdas must be >= 1")
 
     def _fit(self, frame: Frame, valid: Optional[Frame] = None) -> GLMModel:
         p: GLMParameters = self.params
         link = p.actual_link()
-        if p.family in ("binomial", "quasibinomial"):
-            # the reference requires a categorical response for binomial
-            # families; a numeric 0/1 column is auto-converted (as_factor)
+        if p.family in ("binomial", "quasibinomial", "multinomial", "ordinal"):
+            # the reference requires a categorical response for these
+            # families; a numeric column is auto-converted (as_factor)
             ycol = frame.col(p.response_column)
             if not ycol.is_categorical():
                 frame = frame.add_column(ycol.as_factor())
@@ -320,58 +381,87 @@ class GLM(ModelBuilder):
         n, pcols = X.shape
         if n == 0:
             raise ValueError("no rows left after NA handling")
-
-        # device placement: row-sharded [N, P(+1 intercept col when enabled)]
-        mesh = default_mesh()
-        nshards = mesh.devices.size
-        Xi = (
-            np.concatenate([X, np.ones((n, 1), dtype=np.float32)], axis=1)
-            if p.intercept
-            else X
-        )
-        Xd, _ = shard_rows(Xi, mesh)
-        pad = lambda a: pad_rows(a, nshards)[0]
-
         X64 = X.astype(np.float64)  # host copy for eta/deviance (made once)
         wsum = float(obs_w.sum())
+
+        # held-out data for lambda_search submodel selection
+        valid_data = None
+        if valid is not None and p.lambda_search:
+            Xv, skipv = expand_matrix(info, valid, dtype=np.float64)
+            yv = response_vector(info, valid)
+            wv = (
+                valid.col(p.weights_column).numeric_view().astype(np.float64)
+                if p.weights_column
+                else np.ones(valid.nrows)
+            )
+            ov = (
+                valid.col(p.offset_column).numeric_view().astype(np.float64)
+                if p.offset_column
+                else np.zeros(valid.nrows)
+            )
+            keepv = ~(skipv | np.isnan(yv) | np.isnan(wv))
+            valid_data = (Xv[keepv], yv[keepv], wv[keepv], ov[keepv])
+
+        if p.family == "multinomial":
+            self._fit_multinomial(model, info, X, X64, y, obs_w, offset, wsum, valid_data)
+        elif p.family == "ordinal":
+            self._fit_ordinal(model, info, X, X64, y, obs_w, offset, wsum)
+        else:
+            self._fit_gaussian_like(
+                model, info, X, X64, y, obs_w, offset, link, wsum, valid_data
+            )
+
+        model.training_metrics = model.model_performance(frame)
+        if valid is not None:
+            model.validation_metrics = model.model_performance(valid)
+        return model
+
+    # -- exponential-family path (IRLSM / L-BFGS + lambda search) ------------
+
+    def _fit_gaussian_like(
+        self, model, info, X, X64, y, obs_w, offset, link, wsum, valid_data
+    ) -> None:
+        p: GLMParameters = self.params
+        n, pcols = X.shape
         ybar = float((obs_w * y).sum() / wsum)
-        beta = np.zeros(pcols + 1)
+        beta0 = np.zeros(pcols + 1)
         # intercept warm start at the link of the response mean (GLM.java init)
         if p.intercept:
-            beta[-1] = _link_of_mean(link, ybar, p)
-        l1 = p.lambda_ * p.alpha * wsum
-        l2 = p.lambda_ * (1 - p.alpha) * wsum
-
-        prev_obj = np.inf
-        for it in range(p.max_iterations):
-            eta = X64 @ beta[:-1] + beta[-1] + offset
-            mu = _linkinv(link, eta, p)
-            d = _link_deriv(link, mu, p)
-            v = _variance(p.family, mu, p)
-            w = obs_w / np.maximum(v * d * d, 1e-12)
-            wz = (eta - offset) + (y - mu) * d
-
-            G, q = _gram(Xd, pad(wz), pad(w))
-            free = 1 if p.intercept else 0
-            if l1 > 0:
-                solved = _solve_admm(G / wsum, q / wsum, l1 / wsum, l2 / wsum, free=free)
-            else:
-                solved = _solve_ridge(G / wsum, q / wsum, l2 / wsum, free=free)
-            # without an intercept the ones column is excluded from the solve
-            # entirely (clamping after solving would converge to wrong coefs)
-            beta_new = solved if p.intercept else np.append(solved, 0.0)
-
-            dev = float((obs_w * deviance(p.family, y, _linkinv(link, X64 @ beta_new[:-1] + beta_new[-1] + offset, p), p)).sum())
-            obj = dev / (2 * wsum) + p.lambda_ * (
-                p.alpha * np.abs(beta_new[:-1]).sum() + (1 - p.alpha) / 2 * (beta_new[:-1] ** 2).sum()
+            beta0[-1] = _link_of_mean(link, ybar, p)
+        solver = "irlsm" if p.solver == "auto" else p.solver
+        if solver == "lbfgs":
+            solve = self._make_lbfgs_solver(X64, y, obs_w, offset, link, wsum)
+        else:
+            Xd, pad = self._device_design(X)
+            solve = lambda lam, b0: self._irlsm(
+                X64, Xd, pad, y, obs_w, offset, link, lam, b0, wsum
             )
-            delta = np.max(np.abs(beta_new - beta))
-            beta = beta_new
-            model.iterations = it + 1
-            if delta < p.beta_epsilon or abs(prev_obj - obj) < p.objective_epsilon * max(abs(prev_obj), 1.0):
-                prev_obj = obj
-                break
-            prev_obj = obj
+
+        if p.lambda_search:
+            lambdas = self._lambda_grid(X64, y, obs_w, offset, link, wsum, pcols, n)
+            null_dev = float(
+                (obs_w * deviance(p.family, y, np.full_like(y, ybar), p)).sum()
+            )
+
+            def dev_train(b):
+                mu = _linkinv(link, X64 @ b[:-1] + b[-1] + offset, p)
+                return float((obs_w * deviance(p.family, y, mu, p)).sum())
+
+            dev_valid = None
+            if valid_data is not None:
+                Xv, yv, wv, ov = valid_data
+
+                def dev_valid(b):
+                    muv = _linkinv(link, Xv @ b[:-1] + b[-1] + ov, p)
+                    return float((wv * deviance(p.family, yv, muv, p)).sum())
+
+            beta = self._run_lambda_path(
+                model, lambdas, solve, dev_train, dev_valid,
+                nonzeros=lambda b: int(np.sum(np.abs(b[:-1]) > 1e-12)),
+                null_dev=null_dev, state0=beta0,
+            )
+        else:
+            beta, model.iterations = solve(p.lambda_, beta0)
 
         model.beta_std = beta
         b_raw, icpt = destandardize_coefs(info, beta[:-1], beta[-1])
@@ -388,13 +478,439 @@ class GLM(ModelBuilder):
         rank = int(np.sum(np.abs(beta[:-1]) > 0)) + (1 if p.intercept else 0)
         model.aic = _aic(p.family, y, mu, obs_w, model.residual_deviance, rank)
 
-        if p.compute_p_values and p.lambda_ == 0:
+        if p.compute_p_values and p.lambda_ == 0 and not p.lambda_search:
             self._p_values(model, X, y, mu, obs_w, offset, link, p, info)
 
-        model.training_metrics = model.model_performance(frame)
-        if valid is not None:
-            model.validation_metrics = model.model_performance(valid)
-        return model
+    def _device_design(self, X: np.ndarray):
+        """Row-sharded design matrix [N, P(+1 intercept col)] + row padder."""
+        p: GLMParameters = self.params
+        mesh = default_mesh()
+        nshards = mesh.devices.size
+        Xi = (
+            np.concatenate([X, np.ones((len(X), 1), dtype=np.float32)], axis=1)
+            if p.intercept
+            else X
+        )
+        Xd, _ = shard_rows(Xi, mesh)
+        return Xd, lambda a: pad_rows(a, nshards)[0]
+
+    def _run_lambda_path(
+        self, model, lambdas, solve, dev_train, dev_valid, nonzeros, null_dev, state0
+    ):
+        """Warm-started fit along the lambda path + submodel selection
+        (GLM.java:1632 lambda search; selection by validation deviance when a
+        validation frame exists, else training deviance)."""
+        path: List[Dict[str, float]] = []
+        states: List[np.ndarray] = []
+        state = state0
+        total_iters = 0
+        for lam in lambdas:
+            state, iters = solve(float(lam), state)
+            total_iters += iters
+            dev = dev_train(state)
+            entry = {
+                "lambda": float(lam),
+                "deviance_train": dev,
+                "explained_deviance_train": 1.0 - dev / max(null_dev, 1e-300),
+                "nonzeros": nonzeros(state),
+            }
+            if dev_valid is not None:
+                entry["deviance_valid"] = dev_valid(state)
+            path.append(entry)
+            states.append(np.array(state, copy=True))
+        crit = "deviance_valid" if dev_valid is not None else "deviance_train"
+        best = int(np.argmin([e[crit] for e in path]))
+        model.lambda_path = path
+        model.lambda_best = path[best]["lambda"]
+        model.iterations = total_iters
+        return states[best]
+
+    def _grid_from_gradient(self, g: np.ndarray, wsum: float, n: int, pcols: int) -> np.ndarray:
+        """Lambda grid given the null-model gradient: lambda_max is the
+        smallest lambda that zeroes every penalized coefficient."""
+        p: GLMParameters = self.params
+        lambda_max = max(float(np.max(np.abs(g))) / (wsum * max(p.alpha, 1e-3)), 1e-10)
+        lmin_ratio = p.lambda_min_ratio or (1e-4 if n > pcols else 1e-2)
+        if p.nlambdas == 1:
+            return np.array([lambda_max])
+        return np.geomspace(lambda_max, lambda_max * lmin_ratio, p.nlambdas)
+
+    def _irlsm(
+        self, X64, Xd, pad, y, obs_w, offset, link, lam, beta0, wsum
+    ) -> Tuple[np.ndarray, int]:
+        """One IRLSM solve at a fixed lambda (GLM.java:1160 fitIRLSM)."""
+        p: GLMParameters = self.params
+        l1 = lam * p.alpha
+        l2 = lam * (1 - p.alpha)
+        beta = beta0.copy()
+        prev_obj = np.inf
+        iters = 0
+        for it in range(p.max_iterations):
+            eta = X64 @ beta[:-1] + beta[-1] + offset
+            mu = _linkinv(link, eta, p)
+            d = _link_deriv(link, mu, p)
+            v = _variance(p.family, mu, p)
+            w = obs_w / np.maximum(v * d * d, 1e-12)
+            wz = (eta - offset) + (y - mu) * d
+
+            G, q = _gram(Xd, pad(wz), pad(w))
+            free = 1 if p.intercept else 0
+            if l1 > 0:
+                solved = _solve_admm(G / wsum, q / wsum, l1, l2, free=free)
+            else:
+                solved = _solve_ridge(G / wsum, q / wsum, l2, free=free)
+            # without an intercept the ones column is excluded from the solve
+            # entirely (clamping after solving would converge to wrong coefs)
+            beta_new = solved if p.intercept else np.append(solved, 0.0)
+
+            dev = float((obs_w * deviance(p.family, y, _linkinv(link, X64 @ beta_new[:-1] + beta_new[-1] + offset, p), p)).sum())
+            obj = dev / (2 * wsum) + lam * (
+                p.alpha * np.abs(beta_new[:-1]).sum() + (1 - p.alpha) / 2 * (beta_new[:-1] ** 2).sum()
+            )
+            delta = np.max(np.abs(beta_new - beta))
+            beta = beta_new
+            iters = it + 1
+            if delta < p.beta_epsilon or abs(prev_obj - obj) < p.objective_epsilon * max(abs(prev_obj), 1.0):
+                break
+            prev_obj = obj
+        return beta, iters
+
+    def _lambda_grid(self, X64, y, obs_w, offset, link, wsum, pcols, n) -> np.ndarray:
+        """Log-spaced lambda path from lambda_max down (GLM.java:1632
+        makeLambdaSearch; lambda_max = smallest lambda that zeroes every
+        penalized coefficient, from the null-model gradient)."""
+        p: GLMParameters = self.params
+        ybar = float((obs_w * y).sum() / wsum)
+        eta0 = np.full_like(y, _link_of_mean(link, ybar, p)) + offset
+        mu0 = _linkinv(link, eta0, p)
+        d = _link_deriv(link, mu0, p)
+        v = _variance(p.family, mu0, p)
+        w = obs_w / np.maximum(v * d * d, 1e-12)
+        g = X64.T @ (w * (y - mu0) * d)
+        return self._grid_from_gradient(g, wsum, n, pcols)
+
+    _CANONICAL_LINK = {
+        "gaussian": "identity", "binomial": "logit", "quasibinomial": "logit",
+        "poisson": "log", "gamma": "log", "tweedie": "tweedie",
+    }
+
+    def _make_lbfgs_solver(self, X64, y, obs_w, offset, link, wsum):
+        """L-BFGS solver factory (hex/optimization/L_BFGS.java): device
+        arrays are placed and the value-and-grad program compiled ONCE; the
+        returned solve(lam, beta0) is reused across a lambda path. The NLL
+        below is written in eta for the canonical link of each family, so any
+        other link must be rejected (it would silently fit a different
+        model)."""
+        p: GLMParameters = self.params
+        canonical = self._CANONICAL_LINK.get(p.family)
+        if link != canonical or (p.family == "tweedie" and p.tweedie_link_power != 0):
+            raise ValueError(
+                f"solver='lbfgs' supports only the canonical link for "
+                f"family={p.family!r} ({canonical!r}"
+                + (", tweedie_link_power=0" if p.family == "tweedie" else "")
+                + f"); got link={link!r}. Use solver='irlsm'."
+            )
+        mesh = default_mesh()
+        nshards = mesh.devices.size
+        Xf, _ = shard_rows(X64.astype(np.float32), mesh)
+        wd = jnp.asarray(pad_rows(obs_w, nshards)[0], dtype=jnp.float32)
+        yd = jnp.asarray(pad_rows(y, nshards)[0], dtype=jnp.float32)
+        od = jnp.asarray(pad_rows(offset, nshards)[0], dtype=jnp.float32)
+        family = p.family
+        vpow = p.tweedie_variance_power
+        intercept = p.intercept
+
+        @jax.jit
+        def vg(params, l2):
+            def nll(params):
+                beta, icpt = params[:-1], params[-1]
+                eta = Xf @ beta + (icpt if intercept else 0.0) + od
+                if family == "gaussian":
+                    per = 0.5 * (yd - eta) ** 2
+                elif family in ("binomial", "quasibinomial"):
+                    per = jax.nn.softplus(eta) - yd * eta
+                elif family == "poisson":
+                    per = jnp.exp(eta) - yd * eta
+                elif family == "gamma":
+                    per = yd * jnp.exp(-eta) + eta
+                else:  # tweedie, log link
+                    mu = jnp.exp(eta)
+                    a = jnp.where(
+                        yd > 0,
+                        jnp.power(jnp.maximum(yd, 1e-10), 2 - vpow) / ((1 - vpow) * (2 - vpow)),
+                        0.0,
+                    )
+                    per = a - yd * jnp.power(mu, 1 - vpow) / (1 - vpow) + jnp.power(mu, 2 - vpow) / (2 - vpow)
+                return (wd * per).sum() / wsum + 0.5 * l2 * (beta ** 2).sum()
+
+            return jax.value_and_grad(nll)(params)
+
+        from scipy.optimize import minimize
+
+        def solve(lam: float, beta0: np.ndarray) -> Tuple[np.ndarray, int]:
+            l2 = jnp.float32(lam * (1 - p.alpha))
+
+            def fun(x):
+                v, g = vg(jnp.asarray(x, dtype=jnp.float32), l2)
+                g = np.asarray(g, dtype=np.float64)
+                if not intercept:
+                    g[-1] = 0.0
+                return float(v), g
+
+            res = minimize(
+                fun, beta0, jac=True, method="L-BFGS-B",
+                options={"maxiter": max(p.max_iterations * 10, 100), "ftol": 1e-12},
+            )
+            return np.asarray(res.x, dtype=np.float64), int(res.nit)
+
+        return solve
+
+    # -- multinomial (GLM.java:1160 fitIRLSM multinomial: cyclic per-class) --
+
+    def _fit_multinomial(
+        self, model, info, X, X64, y, obs_w, offset, wsum, valid_data
+    ) -> None:
+        p: GLMParameters = self.params
+        K = len(info.response_domain)
+        n, pcols = X.shape
+        yi = y.astype(np.int64)
+        Y = np.zeros((n, K))
+        Y[np.arange(n), yi] = 1.0
+        priors = np.maximum(obs_w @ Y / wsum, 1e-10)
+        B0 = np.zeros((pcols + 1, K))
+        if p.intercept:
+            B0[-1] = np.log(priors)
+
+        null_mu = np.tile(priors, (n, 1))
+        model.null_deviance = float(
+            -2.0 * (obs_w * np.log(null_mu[np.arange(n), yi])).sum()
+        )
+
+        solver = "irlsm" if p.solver == "auto" else p.solver
+        if solver == "lbfgs":
+            mn_solve = self._make_multinomial_lbfgs(X64, Y, obs_w, wsum, pcols, K)
+        else:
+            Xd, pad = self._device_design(X)
+            mn_solve = lambda lam, B0_: self._multinomial_irlsm(
+                X64, Xd, pad, Y, yi, obs_w, offset, lam, B0_, wsum
+            )
+
+        if p.lambda_search:
+            # lambda_max from the per-class null-model gradients
+            g = X64.T @ (obs_w[:, None] * (Y - null_mu))
+            lambdas = self._grid_from_gradient(g, wsum, n, pcols)
+            dev_valid = None
+            if valid_data is not None:
+                Xv, yv, wv, ov = valid_data
+                dev_valid = lambda B: self._multinomial_deviance(
+                    Xv, B, ov, yv.astype(np.int64), wv
+                )
+            B = self._run_lambda_path(
+                model, lambdas, mn_solve,
+                dev_train=lambda B: self._multinomial_deviance(X64, B, offset, yi, obs_w),
+                dev_valid=dev_valid,
+                nonzeros=lambda B: int(np.sum(np.abs(B[:-1]) > 1e-12)),
+                null_dev=model.null_deviance, state0=B0,
+            )
+        else:
+            B, model.iterations = mn_solve(p.lambda_, B0)
+
+        model.beta_multi = B
+        model.residual_deviance = self._multinomial_deviance(X64, B, offset, yi, obs_w)
+        coefs: Dict[str, Dict[str, float]] = {}
+        for k, lv in enumerate(info.response_domain):
+            b_raw, icpt = destandardize_coefs(info, B[:-1, k], B[-1, k])
+            d = dict(zip(info.coef_names, b_raw.tolist()))
+            d["Intercept"] = icpt
+            coefs[lv] = d
+        model.coefficients_multinomial = coefs
+        # flat view for generic consumers: class-suffixed names
+        model.coefficients = {
+            f"{name}_{lv}": val
+            for lv, d in coefs.items()
+            for name, val in d.items()
+        }
+
+    def _multinomial_irlsm(
+        self, X64, Xd, pad, Y, yi, obs_w, offset, lam, B0, wsum
+    ) -> Tuple[np.ndarray, int]:
+        """Cyclic per-class IRLS: for class c, a weighted least-squares solve
+        with softmax weights mu_c(1-mu_c), recomputing the softmax after each
+        class update (the reference's multinomial IRLSM sweep)."""
+        p: GLMParameters = self.params
+        l1 = lam * p.alpha
+        l2 = lam * (1 - p.alpha)
+        K = Y.shape[1]
+        n = len(yi)
+        B = B0.copy()
+        eta = X64 @ B[:-1] + B[-1] + offset[:, None]
+        prev_obj = np.inf
+        iters = 0
+        free = 1 if p.intercept else 0
+        for it in range(p.max_iterations):
+            max_delta = 0.0
+            for c in range(K):
+                mu = _softmax(eta)
+                muc = np.clip(mu[:, c], 1e-10, 1 - 1e-10)
+                vc = muc * (1 - muc)
+                w = obs_w * vc
+                wz = (eta[:, c] - offset) + (Y[:, c] - muc) / vc
+                G, q = _gram(Xd, pad(wz), pad(w))
+                if l1 > 0:
+                    solved = _solve_admm(G / wsum, q / wsum, l1, l2, free=free)
+                else:
+                    solved = _solve_ridge(G / wsum, q / wsum, l2, free=free)
+                bc = solved if p.intercept else np.append(solved, 0.0)
+                max_delta = max(max_delta, float(np.max(np.abs(bc - B[:, c]))))
+                B[:, c] = bc
+                eta[:, c] = X64 @ bc[:-1] + bc[-1] + offset
+            dev = self._multinomial_deviance(X64, B, offset, yi, obs_w)
+            obj = dev / (2 * wsum) + lam * (
+                p.alpha * np.abs(B[:-1]).sum() + (1 - p.alpha) / 2 * (B[:-1] ** 2).sum()
+            )
+            iters = it + 1
+            if max_delta < p.beta_epsilon or abs(prev_obj - obj) < p.objective_epsilon * max(abs(prev_obj), 1.0):
+                break
+            prev_obj = obj
+        return B, iters
+
+    @staticmethod
+    def _multinomial_deviance(X64, B, offset, yi, obs_w) -> float:
+        eta = X64 @ B[:-1] + B[-1]
+        if np.ndim(offset) == 1 and len(np.atleast_1d(offset)) == eta.shape[0]:
+            eta = eta + np.asarray(offset)[:, None]
+        mu = _softmax(eta)
+        pi = np.clip(mu[np.arange(len(yi)), yi], 1e-15, 1.0)
+        return float(-2.0 * (obs_w * np.log(pi)).sum())
+
+    def _make_multinomial_lbfgs(self, X64, Y, obs_w, wsum, pcols, K):
+        """Softmax cross-entropy L-BFGS over the full [P+1, K] coefficient
+        block (the reference's multinomial L_BFGS solver path); one jitted
+        value-and-grad program reused across a lambda path."""
+        p: GLMParameters = self.params
+        mesh = default_mesh()
+        nshards = mesh.devices.size
+        Xf, _ = shard_rows(X64.astype(np.float32), mesh)
+        wd = jnp.asarray(pad_rows(obs_w, nshards)[0], dtype=jnp.float32)
+        Yd = jnp.asarray(pad_rows(Y, nshards)[0], dtype=jnp.float32)
+        intercept = p.intercept
+
+        @jax.jit
+        def vg(flat, l2):
+            def nll(flat):
+                B = flat.reshape(pcols + 1, K)
+                eta = Xf @ B[:-1] + (B[-1] if intercept else 0.0)
+                logp = jax.nn.log_softmax(eta, axis=1)
+                ce = -(wd * (Yd * logp).sum(axis=1)).sum() / wsum
+                return ce + 0.5 * l2 * (B[:-1] ** 2).sum()
+
+            return jax.value_and_grad(nll)(flat)
+
+        from scipy.optimize import minimize
+
+        def solve(lam: float, B0: np.ndarray) -> Tuple[np.ndarray, int]:
+            l2 = jnp.float32(lam * (1 - p.alpha))
+
+            def fun(x):
+                v, g = vg(jnp.asarray(x, dtype=jnp.float32), l2)
+                g = np.asarray(g, dtype=np.float64).reshape(pcols + 1, K)
+                if not intercept:
+                    g[-1] = 0.0
+                return float(v), g.ravel()
+
+            res = minimize(
+                fun, np.asarray(B0, dtype=np.float64).ravel(), jac=True,
+                method="L-BFGS-B",
+                options={"maxiter": max(p.max_iterations * 10, 200), "ftol": 1e-12},
+            )
+            return np.asarray(res.x, dtype=np.float64).reshape(pcols + 1, K), int(res.nit)
+
+        return solve
+
+    # -- ordinal (proportional odds / ologit; GLM.java ordinal solver) -------
+
+    def _fit_ordinal(self, model, info, X, X64, y, obs_w, offset, wsum) -> None:
+        """Cumulative-logit fit: shared beta + K-1 increasing thresholds,
+        maximized by L-BFGS with a jitted device value-and-grad (the
+        reference's ordinal gradient solver, GLMModel ordinal family)."""
+        p: GLMParameters = self.params
+        K = len(info.response_domain)
+        if K < 2:
+            raise ValueError("ordinal family needs a categorical response with >= 2 levels")
+        n, pcols = X.shape
+        l2 = p.lambda_ * (1 - p.alpha)
+        mesh = default_mesh()
+        nshards = mesh.devices.size
+        Xf, _ = shard_rows(X, mesh)
+        wd = jnp.asarray(pad_rows(obs_w, nshards)[0], dtype=jnp.float32)
+        yk = jnp.asarray(pad_rows(y.astype(np.int32), nshards)[0], dtype=jnp.int32)
+        od = jnp.asarray(pad_rows(offset, nshards)[0], dtype=jnp.float32)
+        nth = K - 1
+
+        @jax.jit
+        def nll(params):
+            beta = params[:pcols]
+            a = params[pcols:]
+            if nth > 1:
+                t = jnp.concatenate([a[:1], a[:1] + jnp.cumsum(jax.nn.softplus(a[1:]))])
+            else:
+                t = a
+            eta = Xf @ beta + od
+            cum = jax.nn.sigmoid(t[None, :] - eta[:, None])  # [N, K-1]
+            full = jnp.concatenate([cum, jnp.ones((cum.shape[0], 1))], axis=1)
+            lower = jnp.concatenate([jnp.zeros((cum.shape[0], 1)), cum], axis=1)
+            pk = jnp.clip(full - lower, 1e-12, 1.0)
+            pi = jnp.take_along_axis(pk, yk[:, None], axis=1)[:, 0]
+            return -(wd * jnp.log(pi)).sum() / wsum + 0.5 * l2 * (beta ** 2).sum()
+
+        vg = jax.jit(jax.value_and_grad(nll))
+
+        def fun(x):
+            v, g = vg(jnp.asarray(x, dtype=jnp.float32))
+            return float(v), np.asarray(g, dtype=np.float64)
+
+        # threshold init from cumulative class priors (logit scale)
+        yi = y.astype(np.int64)
+        counts = np.bincount(yi, weights=obs_w, minlength=K)
+        cp = np.clip(np.cumsum(counts)[:-1] / wsum, 1e-6, 1 - 1e-6)
+        t0 = np.log(cp / (1 - cp))
+        a0 = np.empty(nth)
+        a0[0] = t0[0]
+        if nth > 1:
+            d = np.maximum(np.diff(t0), 1e-3)
+            a0[1:] = np.log(np.expm1(d))  # softplus inverse
+        x0 = np.concatenate([np.zeros(pcols), a0])
+
+        from scipy.optimize import minimize
+
+        res = minimize(
+            fun, x0, jac=True, method="L-BFGS-B",
+            options={"maxiter": max(p.max_iterations * 10, 200), "ftol": 1e-12},
+        )
+        sol = np.asarray(res.x, dtype=np.float64)
+        model.iterations = int(res.nit)
+        beta = sol[:pcols]
+        a = sol[pcols:]
+        t = (
+            np.concatenate([a[:1], a[0] + np.cumsum(np.log1p(np.exp(a[1:])))])
+            if nth > 1
+            else a
+        )
+        model.beta_std = beta
+        model.ordinal_thresholds = t
+
+        b_raw, icpt_shift = destandardize_coefs(info, beta, 0.0)
+        model.coefficients = dict(zip(info.coef_names, b_raw.tolist()))
+        for k in range(nth):
+            # raw-space threshold: P(y<=k) = sigmoid(t_k_raw - x.b_raw)
+            model.coefficients[f"Threshold.{info.response_domain[k]}"] = float(t[k] - icpt_shift)
+        model.coefficients_std = dict(zip(info.coef_names, beta.tolist()))
+
+        probs = _ordinal_probs(X64 @ beta + offset, t)
+        pi = probs[np.arange(n), yi]
+        model.residual_deviance = float(-2.0 * (obs_w * np.log(pi)).sum())
+        priors = np.maximum(counts / wsum, 1e-15)
+        model.null_deviance = float(-2.0 * (obs_w * np.log(priors[yi])).sum())
 
     def _p_values(self, model, X, y, mu, obs_w, offset, link, p, info) -> None:
         d = _link_deriv(link, mu, p)

@@ -96,6 +96,7 @@ class KMeans(ModelBuilder):
         super().__init__(params or KMeansParameters(**kw))
 
     def _validate(self, frame: Frame) -> None:
+        super()._validate(frame)
         if self.params.k < 1:
             raise ValueError("k must be >= 1")
         if self.params.estimate_k:

@@ -47,6 +47,15 @@ def _payload(model: Model) -> Payload:
             "tweedie_link_power": p.tweedie_link_power,
             "offset_column": p.offset_column,
         }
+        if p.family == "multinomial":
+            return meta, {"beta_multi": np.asarray(model.beta_multi, dtype=np.float64)}
+        if p.family == "ordinal":
+            return meta, {
+                # ordinal beta_std is [P] (no intercept slot; the thresholds
+                # play that role) — see GLMModel._predict_raw ordinal branch
+                "beta_std": np.asarray(model.beta_std, dtype=np.float64),
+                "thresholds": np.asarray(model.ordinal_thresholds, dtype=np.float64),
+            }
         return meta, {"beta_std": np.asarray(model.beta_std, dtype=np.float64)}
 
     if isinstance(model, TreeModelBase):

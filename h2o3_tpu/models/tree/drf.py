@@ -56,6 +56,8 @@ class DRFModel(TreeModelBase):
 
 
 class DRF(ModelBuilder):
+
+    SUPPORTED_COMMON = frozenset({"checkpoint"})
     algo_name = "drf"
 
     def __init__(self, params: Optional[DRFParameters] = None, **kw) -> None:

@@ -49,6 +49,8 @@ class GBMModel(TreeModelBase):
 
 
 class GBM(ModelBuilder):
+
+    SUPPORTED_COMMON = frozenset({"checkpoint", "stopping_rounds"})
     algo_name = "gbm"
 
     def __init__(self, params: Optional[GBMParameters] = None, **kw) -> None:

@@ -58,6 +58,8 @@ class XGBoostModel(TreeModelBase):
 
 
 class XGBoost(ModelBuilder):
+
+    SUPPORTED_COMMON = frozenset({"checkpoint", "stopping_rounds"})
     algo_name = "xgboost"
 
     def __init__(self, params: Optional[XGBoostParameters] = None, **kw) -> None:

@@ -168,6 +168,7 @@ class Word2Vec(ModelBuilder):
         super().__init__(params or Word2VecParameters(**kw))
 
     def _validate(self, frame: Frame) -> None:
+        super()._validate(frame)
         if frame.ncols != 1:
             raise ValueError("Word2Vec expects a single (string) column of words")
 

@@ -166,3 +166,186 @@ def test_no_intercept_solution(mesh, rng):
     want = float((x * y).sum() / (x * x).sum())  # closed-form no-intercept OLS
     assert m.coefficients["x"] == pytest.approx(want, rel=1e-4)
     assert m.coefficients["Intercept"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# round 3: multinomial / ordinal / lambda_search / lbfgs
+# (reference: hex/glm/GLM.java:1160 multinomial IRLSM, :1632 lambda search,
+#  GLMModel.java:268-334 solver enum)
+
+
+@pytest.fixture()
+def iris_like(rng):
+    """3-class separable-ish data shaped like iris."""
+    n_per, p = 300, 4
+    centers = np.array([
+        [0.0, 0.0, 0.0, 0.0],
+        [2.0, 1.0, -1.0, 0.5],
+        [-1.0, 2.5, 1.0, -1.5],
+    ])
+    X = np.concatenate([rng.normal(size=(n_per, p)) + c for c in centers])
+    y = np.repeat(np.array(["setosa", "versi", "virgi"]), n_per)
+    perm = rng.permutation(len(y))
+    X, y = X[perm], y[perm]
+    fr = Frame.from_dict({f"x{i}": X[:, i] for i in range(p)} | {"y": y})
+    return fr, X, y
+
+
+def test_multinomial_matches_sklearn(mesh, iris_like):
+    fr, X, y = iris_like
+    m = GLM(family="multinomial", response_column="y", lambda_=0.0).train(fr)
+    sk = LogisticRegression(penalty=None, max_iter=1000, tol=1e-10).fit(X, y)
+    ours = m._predict_raw(fr)
+    theirs = sk.predict_proba(X)
+    # probabilities agree (coefs are only identified up to a per-row shift)
+    np.testing.assert_allclose(ours, theirs, atol=0.01)
+    acc_ours = (np.array(sorted(set(y)))[ours.argmax(1)] == y).mean()
+    acc_sk = (sk.predict(X) == y).mean()
+    assert acc_ours >= acc_sk - 0.01
+    assert m.training_metrics.logloss < 0.5
+    assert m.residual_deviance < m.null_deviance
+    # per-class coefficient tables exposed
+    assert set(m.coefficients_multinomial) == {"setosa", "versi", "virgi"}
+
+
+def test_multinomial_regularized_and_predict_frame(mesh, iris_like):
+    fr, X, y = iris_like
+    m = GLM(family="multinomial", response_column="y", lambda_=0.01, alpha=0.5).train(fr)
+    pred = m.predict(fr)
+    assert pred.names[0] == "predict"
+    probs = np.stack([pred.col(f"p{lv}").numeric_view() for lv in sorted(set(y))], axis=1)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-6)
+
+
+def test_ordinal_recovers_ordering(mesh, rng):
+    """Proportional-odds data: P(y<=k) = sigmoid(t_k - x.beta)."""
+    n, p = 3000, 3
+    X = rng.normal(size=(n, p))
+    beta = np.array([1.0, -0.5, 2.0])
+    eta = X @ beta
+    t = np.array([-1.0, 1.5])
+    u = rng.random(n)
+    c0 = 1 / (1 + np.exp(-(t[0] - eta)))
+    c1 = 1 / (1 + np.exp(-(t[1] - eta)))
+    y = np.where(u < c0, "low", np.where(u < c1, "mid", "high"))
+    fr = Frame.from_dict({f"x{i}": X[:, i] for i in range(p)} | {"y": y})
+    # domain order must be the ordinal order
+    from h2o3_tpu.frame.frame import Column, ColType
+    codes = np.array([{"low": 0, "mid": 1, "high": 2}[v] for v in y], dtype=np.int32)
+    fr = fr.add_column(Column("y", codes, ColType.CAT, ["low", "mid", "high"]))
+    m = GLM(family="ordinal", response_column="y", lambda_=0.0, standardize=False).train(fr)
+    got_beta = np.array([m.coefficients[f"x{i}"] for i in range(p)])
+    np.testing.assert_allclose(got_beta, beta, atol=0.15)
+    assert m.ordinal_thresholds[0] < m.ordinal_thresholds[1]
+    np.testing.assert_allclose(m.ordinal_thresholds, t, atol=0.2)
+    probs = m._predict_raw(fr)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-6)
+    acc = (probs.argmax(1) == codes).mean()
+    assert acc > 0.6
+
+
+def test_lambda_search_path(mesh, rng):
+    n, p = 1000, 8
+    X = rng.normal(size=(n, p))
+    beta = np.array([2.0, -1.5, 1.0, 0.0, 0.0, 0.0, 0.0, 0.0])
+    y = X @ beta + rng.normal(0, 0.5, n)
+    fr = Frame.from_dict({f"x{i}": X[:, i] for i in range(p)} | {"y": y})
+    m = GLM(
+        family="gaussian", response_column="y", lambda_search=True, nlambdas=12,
+        alpha=1.0,
+    ).train(fr)
+    assert m.lambda_path is not None and len(m.lambda_path) == 12
+    lams = [e["lambda"] for e in m.lambda_path]
+    assert lams == sorted(lams, reverse=True)
+    # sparsity decreases along the path; the largest lambda kills every coef
+    nz = [e["nonzeros"] for e in m.lambda_path]
+    assert nz[0] <= 1 and nz[-1] >= 3
+    assert m.lambda_best == lams[-1]  # training-deviance selection -> smallest
+    # the selected model recovers the signal
+    got = np.array([m.coefficients[f"x{i}"] for i in range(p)])
+    np.testing.assert_allclose(got[:3], beta[:3], atol=0.1)
+
+
+def test_lambda_search_validation_selection(mesh, rng):
+    n, p = 600, 20
+    X = rng.normal(size=(n, p))
+    beta = np.zeros(p)
+    beta[:2] = [1.0, -1.0]
+    y = X @ beta + rng.normal(0, 2.0, n)  # noisy: heavy shrinkage should win
+    fr = Frame.from_dict({f"x{i}": X[:, i] for i in range(p)} | {"y": y})
+    tr = fr.rows(np.arange(n) < 400)
+    va = fr.rows(np.arange(n) >= 400)
+    m = GLM(
+        family="gaussian", response_column="y", lambda_search=True, nlambdas=15,
+        alpha=1.0,
+    ).train(tr, valid=va)
+    assert all("deviance_valid" in e for e in m.lambda_path)
+    best = min(m.lambda_path, key=lambda e: e["deviance_valid"])
+    assert m.lambda_best == best["lambda"]
+
+
+def test_lbfgs_matches_irlsm(mesh, rng):
+    n, p = 2000, 5
+    X = rng.normal(size=(n, p))
+    beta = np.array([1.0, -1.0, 0.5, 0.0, 1.5])
+    yb = (rng.random(n) < 1 / (1 + np.exp(-(X @ beta)))).astype(np.float64)
+    fr = Frame.from_dict(
+        {f"x{i}": X[:, i] for i in range(p)} | {"y": np.where(yb > 0, "y", "n")}
+    )
+    m1 = GLM(family="binomial", response_column="y", lambda_=0.01, alpha=0.0,
+             solver="irlsm").train(fr)
+    m2 = GLM(family="binomial", response_column="y", lambda_=0.01, alpha=0.0,
+             solver="lbfgs").train(fr)
+    c1 = np.array([m1.coefficients[f"x{i}"] for i in range(p)])
+    c2 = np.array([m2.coefficients[f"x{i}"] for i in range(p)])
+    np.testing.assert_allclose(c1, c2, atol=5e-3)
+
+
+def test_lbfgs_rejects_l1(mesh, lin_data):
+    fr, _, _ = lin_data
+    with pytest.raises(ValueError, match="lbfgs"):
+        GLM(family="gaussian", response_column="y", solver="lbfgs",
+            lambda_=0.1, alpha=0.5).train(fr)
+
+
+def test_multinomial_lambda_search(mesh, iris_like):
+    fr, X, y = iris_like
+    m = GLM(family="multinomial", response_column="y", lambda_search=True,
+            nlambdas=5, alpha=0.5).train(fr)
+    assert len(m.lambda_path) == 5
+    assert m.training_metrics.logloss < 1.0
+
+
+def test_multinomial_lbfgs_matches_irlsm(mesh, iris_like):
+    fr, X, y = iris_like
+    m1 = GLM(family="multinomial", response_column="y", lambda_=0.01, alpha=0.0,
+             solver="irlsm").train(fr)
+    m2 = GLM(family="multinomial", response_column="y", lambda_=0.01, alpha=0.0,
+             solver="lbfgs").train(fr)
+    np.testing.assert_allclose(m1._predict_raw(fr), m2._predict_raw(fr), atol=0.01)
+
+
+def test_lbfgs_rejects_noncanonical_link(mesh, lin_data):
+    fr, _, _ = lin_data
+    with pytest.raises(ValueError, match="canonical"):
+        GLM(family="gaussian", link="log", response_column="y",
+            solver="lbfgs").train(fr)
+
+
+def test_multinomial_rejects_offset(mesh, iris_like):
+    fr, X, y = iris_like
+    from h2o3_tpu.frame.frame import Column, ColType
+    fr = fr.add_column(Column("off", np.ones(fr.nrows), ColType.NUM))
+    with pytest.raises(ValueError, match="offset"):
+        GLM(family="multinomial", response_column="y", offset_column="off").train(fr)
+
+
+def test_ordinal_rejects_lambda_search_and_irlsm(mesh, rng):
+    fr = Frame.from_dict({"x0": rng.normal(size=50),
+                          "y": np.where(rng.random(50) > 0.5, "a", "b")})
+    with pytest.raises(ValueError, match="lambda_search"):
+        GLM(family="ordinal", response_column="y", lambda_search=True).train(fr)
+    with pytest.raises(ValueError, match="gradient solver"):
+        GLM(family="ordinal", response_column="y", solver="irlsm").train(fr)
+    with pytest.raises(ValueError, match="p_values"):
+        GLM(family="multinomial", response_column="y", compute_p_values=True).train(fr)

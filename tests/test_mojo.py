@@ -248,3 +248,45 @@ class TestMojoReviewFixes:
         assert hasattr(pred, "reconstructed")
         assert pred.reconstruction_error is not None
         assert np.isfinite(pred.reconstruction_error)
+
+
+class TestMojoGlmR3:
+    """Round-3 GLM families through the MOJO (multinomial softmax + ordinal
+    thresholds; reference scorer hex/genmodel/algos/glm/GlmMojoModel.java and
+    GlmOrdinalMojoModel.java)."""
+
+    def test_glm_multinomial(self, rng, tmp_path):
+        from h2o3_tpu.models.glm import GLM
+
+        n = 300
+        X = rng.normal(size=(n, 3))
+        y = np.array(["a", "b", "c"])[
+            np.argmax(X @ rng.normal(size=(3, 3)), axis=1)
+        ]
+        fr = Frame(
+            [Column(f"x{i}", X[:, i]) for i in range(3)]
+            + [Column("y", np.searchsorted(["a", "b", "c"], y).astype(np.int32),
+                      ColType.CAT, ["a", "b", "c"])]
+        )
+        m = GLM(response_column="y", family="multinomial", lambda_=0.01).train(fr)
+        mm = _assert_parity(m, fr, str(tmp_path / "glm_mn.mojo"))
+        pred = EasyPredictModelWrapper(mm).predict(_frame_rows(fr)[0])
+        assert pred.label in ("a", "b", "c")
+        assert len(pred.class_probabilities) == 3
+
+    def test_glm_ordinal(self, rng, tmp_path):
+        from h2o3_tpu.models.glm import GLM
+
+        n = 500
+        X = rng.normal(size=(n, 2))
+        eta = X @ np.array([1.0, -0.8])
+        u = rng.random(n)
+        c0 = 1 / (1 + np.exp(-(-0.5 - eta)))
+        c1 = 1 / (1 + np.exp(-(1.0 - eta)))
+        codes = np.where(u < c0, 0, np.where(u < c1, 1, 2)).astype(np.int32)
+        fr = Frame(
+            [Column("x0", X[:, 0]), Column("x1", X[:, 1]),
+             Column("y", codes, ColType.CAT, ["lo", "mid", "hi"])]
+        )
+        m = GLM(response_column="y", family="ordinal", lambda_=0.0).train(fr)
+        _assert_parity(m, fr, str(tmp_path / "glm_ord.mojo"))
