@@ -98,18 +98,30 @@ class _Layout:
             else np.zeros((n, 0), dtype=np.float64)
         )
 
-    def raw_matrix(self, rows: List[Dict[str, Any]]) -> np.ndarray:
-        """[N, F] raw features, cat codes as ordinals, NaN NA (tree layout,
-        h2o3_tpu/models/tree/common.py:tree_matrix)."""
+    def raw_matrix(
+        self, rows: List[Dict[str, Any]], one_hot: bool = False
+    ) -> np.ndarray:
+        """[N, F] raw features, NaN NA (tree layout,
+        h2o3_tpu/models/tree/common.py:tree_matrix). one_hot mirrors
+        categorical_encoding="one_hot_explicit": one 0/1 column per level,
+        NA rows NaN across the block."""
         cols = self._columns(rows)
         out = []
         for name in self.predictor_names:
             c = cols[name]
             if name in self.cat_domains:
-                out.append(np.where(c >= 0, c.astype(np.float64), np.nan))
+                if one_hot:
+                    dom = self.cat_domains[name]
+                    block = (c[:, None] == np.arange(len(dom))[None, :]).astype(
+                        np.float64
+                    )
+                    block[c < 0] = np.nan
+                    out.append(block)
+                else:
+                    out.append(np.where(c >= 0, c.astype(np.float64), np.nan)[:, None])
             else:
-                out.append(c)
-        return np.stack(out, axis=1).astype(np.float32)
+                out.append(c[:, None])
+        return np.concatenate(out, axis=1).astype(np.float32)
 
 
 def _as_rows(data: Union[RowLike, List[RowLike]], names: List[str]):
@@ -248,8 +260,10 @@ class TreeMojoModel(MojoModel):
     algo = "tree"
 
     def _score_rows(self, rows):
-        X = self.layout.raw_matrix(rows)
         m = self.meta
+        X = self.layout.raw_matrix(
+            rows, one_hot=m.get("tree_encoding") == "one_hot_explicit"
+        )
         edges = self._arrays["edges"]  # [F, B-1]
         n_bins1 = int(m["n_bins1"])
         nbins = n_bins1 - 1
@@ -261,6 +275,19 @@ class TreeMojoModel(MojoModel):
             bins[np.isnan(X[:, f]), f] = nbins
         init_margin = self._arrays["init_margin"]
         C = len(init_margin)
+        offset_col = m.get("offset_column")
+        offset = None
+        if offset_col:
+            offset = np.full(len(rows), np.nan)
+            for i, row in enumerate(rows):
+                v = row.get(offset_col)
+                if v is not None and v != "":
+                    offset[i] = float(v)
+            if np.isnan(offset).any():
+                raise ValueError(
+                    f"offset column {offset_col!r} must be present and "
+                    f"numeric in every scored row"
+                )
         max_depth = int(m["max_depth"])
         average = bool(m.get("average", False))
         margins = np.empty((n, C), dtype=np.float64)
@@ -285,6 +312,8 @@ class TreeMojoModel(MojoModel):
             if average and T > 0:
                 total /= T
             margins[:, c] = init_margin[c] + total
+            if offset is not None:
+                margins[:, c] += offset
         transform = m.get("transform", m["distribution"])
         if transform == "bernoulli":
             p = _sigmoid(margins[:, 0])
@@ -297,6 +326,8 @@ class TreeMojoModel(MojoModel):
                 return np.stack([1 - p1, p1], axis=1)
             p = np.clip(margins, 1e-9, None)
             return p / p.sum(axis=1, keepdims=True)
+        if transform == "exp":  # log-link regression (poisson/gamma/tweedie)
+            return np.exp(margins[:, 0])
         return margins[:, 0]
 
 

@@ -69,6 +69,8 @@ def _payload(model: Model) -> Payload:
             transform = "drf_votes" if model.is_classifier else "identity"
         elif model.distribution in ("bernoulli", "multinomial"):
             transform = model.distribution
+        elif model.distribution in ("poisson", "gamma", "tweedie"):
+            transform = "exp"  # log-link: margin -> response scale
         else:
             transform = "identity"
         meta = {
@@ -78,6 +80,10 @@ def _payload(model: Model) -> Payload:
             "n_bins1": int(t0.n_bins1),
             "max_depth": int(t0.max_depth),
             "average": bool(b.average),
+            "tree_encoding": getattr(model, "tree_encoding", "label_encoder"),
+            # offset models shift the margin by the scoring frame's offset
+            # column (Model.java offset handling) — the MOJO must too
+            "offset_column": getattr(model.params, "offset_column", None),
         }
         arrays: Dict[str, np.ndarray] = {
             "edges": np.asarray(t0.edges, dtype=np.float64),

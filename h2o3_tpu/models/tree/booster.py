@@ -49,6 +49,16 @@ from h2o3_tpu.ops.histogram import (
 )
 from h2o3_tpu.parallel.mesh import default_mesh, row_sharding
 
+#: boosting rounds fused into one XLA program when no monitor is active
+#: (overridable via H2O3_TPU_TREE_BLOCK); also the deadline-check cadence
+DEFAULT_TREE_BLOCK = 16
+
+
+def tree_block_size() -> int:
+    import os
+
+    return int(os.environ.get("H2O3_TPU_TREE_BLOCK", str(DEFAULT_TREE_BLOCK)))
+
 
 @dataclass(frozen=True)
 class TreeParams:
@@ -116,30 +126,53 @@ def grad_hess_device(objective: str, y, margin):
     y: [N] labels/targets, or [N, C] fixed targets for objective='fixed'
     (DRF: each tree independently fits the raw targets, so g=-y, h=1 gives a
     Newton leaf equal to the in-leaf target mean). margin: [N, C] f32.
+
+    Parameterized families (hex/Distribution.java analogues) encode their
+    parameter in the objective string: ``tweedie:1.5``, ``quantile:0.9``,
+    ``huber:<delta>`` — the string is the jit/compile cache key, so each
+    parameter value compiles its own program with the constant folded in.
     """
-    if objective == "fixed":
+    name, _, arg = objective.partition(":")
+    if name == "fixed":
         t = y if y.ndim == 2 else y[:, None]
         return -t.astype(jnp.float32), jnp.ones_like(t, dtype=jnp.float32)
-    if objective == "gaussian":
+    if name == "gaussian":
         g = margin[:, 0] - y
         return g[:, None], jnp.ones_like(g)[:, None]
-    if objective == "bernoulli":
+    if name == "bernoulli":
         p = jax.nn.sigmoid(margin[:, 0])
         return (p - y)[:, None], jnp.maximum(p * (1 - p), 1e-16)[:, None]
-    if objective == "multinomial":
+    if name == "multinomial":
         p = jax.nn.softmax(margin, axis=1)
         onehot = (y.astype(jnp.int32)[:, None] == jnp.arange(margin.shape[1])[None, :]).astype(
             jnp.float32
         )
         return p - onehot, jnp.maximum(p * (1 - p), 1e-16)
-    if objective == "poisson":
+    if name == "poisson":
         mu = jnp.exp(margin[:, 0])
         return (mu - y)[:, None], jnp.maximum(mu, 1e-16)[:, None]
-    if objective == "laplace":
+    if name == "gamma":
+        # deviance with log link: L = 2(y e^{-f} + f - log y - 1)
+        ymf = y * jnp.exp(-margin[:, 0])
+        return (1.0 - ymf)[:, None], jnp.maximum(ymf, 1e-16)[:, None]
+    if name == "tweedie":
+        # log link, 1<p<2: L = -y e^{(1-p)f}/(1-p) + e^{(2-p)f}/(2-p)
+        pw = float(arg)
+        a = y * jnp.exp((1.0 - pw) * margin[:, 0])
+        b = jnp.exp((2.0 - pw) * margin[:, 0])
+        g = b - a
+        h = (pw - 1.0) * a + (2.0 - pw) * b
+        return g[:, None], jnp.maximum(h, 1e-16)[:, None]
+    if name == "huber":
+        delta = float(arg)
+        r = margin[:, 0] - y
+        return jnp.clip(r, -delta, delta)[:, None], jnp.ones_like(r)[:, None]
+    if name == "laplace":
         g = jnp.sign(margin[:, 0] - y)
         return g[:, None], jnp.ones_like(g)[:, None]
-    if objective == "quantile_0.5":
-        g = jnp.where(margin[:, 0] > y, 0.5, -0.5)
+    if name == "quantile" or objective == "quantile_0.5":
+        alpha = float(arg) if arg else 0.5
+        g = jnp.where(margin[:, 0] < y, -alpha, 1.0 - alpha)
         return g[:, None], jnp.ones_like(g)[:, None]
     raise ValueError(f"unknown objective {objective!r}")
 
@@ -148,11 +181,22 @@ def grad_hess_device(objective: str, y, margin):
 # traced level-step pieces
 
 
-def _split_search(hist, lam, alpha, gamma, lr, feat_mask, min_rows: float, n_bins1: int):
+def _split_search(
+    hist, lam, alpha, gamma, lr, feat_mask, min_rows: float, n_bins1: int,
+    constraints=None, node_lo=None, node_hi=None,
+):
     """Per-node best split over (feature, bin, NA-direction).
 
     hist: [K, F, B+1, 3] (Σg, Σh, count). Returns per-node arrays:
-    feat, bin, default_left, gain, leaf_value (lr-scaled).
+    feat, bin, default_left, gain, leaf_value (lr-scaled) — plus, in
+    monotone mode, the best split's unscaled (left, right) child values.
+
+    Monotone mode (constraints: [F] in {-1,0,+1}, node_lo/node_hi: [K]
+    per-node leaf-value bounds): candidates whose child values violate the
+    feature's direction are masked out, and the terminal leaf value is
+    clipped into the node's inherited bounds — the same two-sided design as
+    the reference's GBM monotone path (hex/tree/gbm/GBM.java) and XGBoost's
+    monotone_constraints.
     """
     B = n_bins1 - 1
     total = hist.sum(axis=2)  # [K, F, 3] — identical across F
@@ -164,10 +208,17 @@ def _split_search(hist, lam, alpha, gamma, lr, feat_mask, min_rows: float, n_bin
     na = hist[:, :, B, :]  # [K, F, 3]
     cum = jnp.cumsum(real, axis=2)  # bins <= b on the left
 
+    def thresh(g):
+        return jnp.sign(g) * jnp.maximum(jnp.abs(g) - alpha, 0.0)
+
     def side_score(g, h):
         # optimal leaf objective with L1/L2: 0.5 * T(g)^2 / (h + lam)
-        t = jnp.sign(g) * jnp.maximum(jnp.abs(g) - alpha, 0.0)
+        t = thresh(g)
         return t * t / jnp.maximum(h + lam, 1e-12)
+
+    def opt_w(g, h):
+        # unscaled optimal leaf value
+        return -thresh(g) / jnp.maximum(h + lam, 1e-12)
 
     parent = side_score(G, H)  # [K]
 
@@ -177,11 +228,18 @@ def _split_search(hist, lam, alpha, gamma, lr, feat_mask, min_rows: float, n_bin
         cr = CNT[:, None, None] - cl
         gain = 0.5 * (side_score(gl, hl) + side_score(gr, hr) - parent[:, None, None]) - gamma
         ok = (cl >= min_rows) & (cr >= min_rows)
-        return jnp.where(ok, gain, -jnp.inf)
+        gain = jnp.where(ok, gain, -jnp.inf)
+        if constraints is not None:
+            wl = opt_w(gl, hl)
+            wr = opt_w(gr, hr)
+            c = constraints[None, :, None].astype(gl.dtype)
+            gain = jnp.where((c != 0) & (c * (wr - wl) < 0), -jnp.inf, gain)
+            return gain, wl, wr
+        return gain, None, None
 
     # NA right (default_left=False): left stats = cum; NA left: left += NA bucket
-    gain_r = dir_gain(cum[..., 0], cum[..., 1], cum[..., 2])
-    gain_l = dir_gain(
+    gain_r, wl_r, wr_r = dir_gain(cum[..., 0], cum[..., 1], cum[..., 2])
+    gain_l, wl_l, wr_l = dir_gain(
         cum[..., 0] + na[..., 0][:, :, None],
         cum[..., 1] + na[..., 1][:, :, None],
         cum[..., 2] + na[..., 2][:, :, None],
@@ -203,9 +261,15 @@ def _split_search(hist, lam, alpha, gamma, lr, feat_mask, min_rows: float, n_bin
     )[:, 0]
 
     # leaf value if this node terminates (Newton step, L1-thresholded, lr-scaled)
-    t = jnp.sign(G) * jnp.maximum(jnp.abs(G) - alpha, 0.0)
-    leaf = -lr * t / jnp.maximum(H + lam, 1e-12)
-    return best_f, best_b, dl, best_gain, leaf
+    raw_leaf = opt_w(G, H)
+    if constraints is not None:
+        raw_leaf = jnp.clip(raw_leaf, node_lo, node_hi)
+        wl_fb = jnp.where(go_left_better, wl_l, wl_r).reshape(flat.shape)
+        wr_fb = jnp.where(go_left_better, wr_l, wr_r).reshape(flat.shape)
+        best_wl = jnp.take_along_axis(wl_fb, best[:, None], axis=1)[:, 0]
+        best_wr = jnp.take_along_axis(wr_fb, best[:, None], axis=1)[:, 0]
+        return best_f, best_b, dl, best_gain, lr * raw_leaf, best_wl, best_wr
+    return best_f, best_b, dl, best_gain, lr * raw_leaf
 
 
 def _sel_table(table, idx):
@@ -272,7 +336,10 @@ def _predict_stacked(bins, feat, split_bin, default_left, is_split, leaf, max_de
 # the device-resident training block
 
 
-def _build_one_tree(bins, g, h, sample, feat_mask, key, p: TreeParams, mesh, bins_fm=None):
+def _build_one_tree(
+    bins, g, h, sample, feat_mask, key, p: TreeParams, mesh, bins_fm=None,
+    constraints=None, rw=None,
+):
     """Grow one tree to max_depth, fully traced. Levels are unrolled with
     per-level static node capacity 2^d (the fixed-capacity redesign of the
     reference's dynamic DTree node growth).
@@ -282,12 +349,21 @@ def _build_one_tree(bins, g, h, sample, feat_mask, key, p: TreeParams, mesh, bin
     prediction walk over the finished tree. Only ``sample`` rows contribute
     to histograms (row-subsampling semantics of GBM/DRF).
 
+    constraints: optional [F] monotone directions; when set, per-node
+    leaf-value bounds [lo, hi] are carried down the levels (children of a
+    split on a constrained feature inherit the split's midpoint as the
+    shared bound) and leaf values are clipped into them.
+
     Returns (heap arrays [M], per-row leaf value [N]).
     """
     D = p.max_depth
     n_bins1 = p.nbins + 1
     F = bins.shape[1]
     pos = jnp.zeros(bins.shape[0], dtype=jnp.int32)  # absolute heap position
+    mono = constraints is not None
+    if mono:
+        b_lo = jnp.full((1,), -jnp.inf, jnp.float32)
+        b_hi = jnp.full((1,), jnp.inf, jnp.float32)
 
     tf_l, tb_l, tdl_l, tsp_l, tlf_l = [], [], [], [], []
     for d in range(D + 1):
@@ -298,7 +374,7 @@ def _build_one_tree(bins, g, h, sample, feat_mask, key, p: TreeParams, mesh, bin
         hist_nodes = jnp.where(in_lvl & sample, local, -1).astype(jnp.int32)
         hist = build_histogram_sharded(
             bins, hist_nodes, g, h, n_nodes=K, n_bins1=n_bins1, mesh=mesh,
-            bins_fm=bins_fm,
+            bins_fm=bins_fm, rw=rw,
         )
         if p.mtries > 0:
             key, sub = jax.random.split(key)
@@ -307,7 +383,7 @@ def _build_one_tree(bins, g, h, sample, feat_mask, key, p: TreeParams, mesh, bin
             node_feat_mask = (r <= thresh) & feat_mask[None, :]
         else:
             node_feat_mask = feat_mask
-        bf, bb, dl, gain, leaf = _split_search(
+        out = _split_search(
             hist,
             jnp.float32(p.reg_lambda),
             jnp.float32(p.reg_alpha),
@@ -316,7 +392,14 @@ def _build_one_tree(bins, g, h, sample, feat_mask, key, p: TreeParams, mesh, bin
             node_feat_mask,
             min_rows=float(p.min_rows),
             n_bins1=n_bins1,
+            constraints=constraints if mono else None,
+            node_lo=b_lo if mono else None,
+            node_hi=b_hi if mono else None,
         )
+        if mono:
+            bf, bb, dl, gain, leaf, bwl, bwr = out
+        else:
+            bf, bb, dl, gain, leaf = out
         can = (gain > max(p.min_split_improvement, 0.0)) & jnp.isfinite(gain) & (d < D)
         tf_l.append(bf)
         tb_l.append(bb)
@@ -330,6 +413,16 @@ def _build_one_tree(bins, g, h, sample, feat_mask, key, p: TreeParams, mesh, bin
             go_left = jnp.where(b >= n_bins1 - 1, dlk, b <= sb)
             child = 2 * (lo + k) + jnp.where(go_left, 1, 2)
             pos = jnp.where(in_lvl & cank, child, pos).astype(jnp.int32)
+            if mono:
+                # propagate bounds: split midpoint caps the monotone side
+                c_best = jnp.take(constraints, bf).astype(jnp.float32)  # [K]
+                mid = jnp.clip(0.5 * (bwl + bwr), b_lo, b_hi)
+                lo_left = jnp.where(c_best < 0, jnp.maximum(b_lo, mid), b_lo)
+                hi_left = jnp.where(c_best > 0, jnp.minimum(b_hi, mid), b_hi)
+                lo_right = jnp.where(c_best > 0, jnp.maximum(b_lo, mid), b_lo)
+                hi_right = jnp.where(c_best < 0, jnp.minimum(b_hi, mid), b_hi)
+                b_lo = jnp.stack([lo_left, lo_right], axis=1).reshape(2 * K)
+                b_hi = jnp.stack([hi_left, hi_right], axis=1).reshape(2 * K)
 
     # per-level concatenation IS the heap layout: node (d, i) -> 2^d - 1 + i
     tree = (
@@ -350,18 +443,28 @@ def _make_block_fn(
     block: int,
     p: TreeParams,
     mesh,
+    weighted: bool = False,
+    monotone: bool = False,
 ):
     """Compile one training block: scan over `block` boosting rounds, the
-    whole thing one XLA program. Returns f(bins, y, valid, margin, key) ->
-    (margin', tree arrays [block, C, M])."""
+    whole thing one XLA program. Returns f(bins, y, valid, margin, keys,
+    bins_fm, w, mono) -> (margin', tree arrays [block, C, M]).
+    `weighted`/`monotone` are compile-time flags so the unweighted /
+    unconstrained program is byte-identical to before (w/mono are passed as
+    None and never touched)."""
     D = p.max_depth
     n_bins1 = p.nbins + 1
     C = n_class_trees
 
     @partial(jax.jit, donate_argnums=(3,))
-    def block_fn(bins, y, valid, margin, keys, bins_fm):
+    def block_fn(bins, y, valid, margin, keys, bins_fm, w, mono):
         def one_round(margin, key_t):
             g_all, h_all = grad_hess_device(objective, y, margin)
+            if weighted:
+                # fold row weights into (g, h): every Σg/Σh a histogram sees
+                # becomes the weighted sum (DHistogram's Σw-scaled stats)
+                g_all = g_all * w[:, None]
+                h_all = h_all * w[:, None]
             kr, kc, kt = jax.random.split(key_t, 3)
             active = valid
             if p.sample_rate < 1.0:
@@ -389,6 +492,8 @@ def _make_block_fn(
                     p,
                     mesh,
                     bins_fm=bins_fm,
+                    constraints=mono if monotone else None,
+                    rw=w if weighted else None,
                 )
                 # margin update from this tree (full data, not just the sample)
                 margin = margin.at[:, c].add(pred)
@@ -460,12 +565,16 @@ def train_boosted(
     mesh=None,
     timings: Optional[dict] = None,
     resume_from: Optional["BoostedTrees"] = None,
+    weights: Optional[np.ndarray] = None,
+    offset: Optional[np.ndarray] = None,
+    monotone: Optional[np.ndarray] = None,
 ) -> BoostedTrees:
     """Device-resident booster loop.
 
     objective: a grad_hess_device family name ('gaussian', 'bernoulli',
-    'multinomial', 'poisson', 'laplace', 'quantile_0.5') or 'fixed' with
-    y = targets [N, C] (DRF bagging semantics, average=True).
+    'multinomial', 'poisson', 'gamma', 'laplace', 'tweedie:<p>',
+    'huber:<delta>', 'quantile:<alpha>') or 'fixed' with y = targets [N, C]
+    (DRF bagging semantics, average=True).
     monitor(tree_idx, margin[N, C]) -> True to stop early (ScoreKeeper hook);
     called every `score_interval` trees, which is also the device-block size —
     between calls nothing crosses the host boundary.
@@ -473,6 +582,12 @@ def train_boosted(
     existing ensemble's trees + margin and train ``ntrees`` MORE trees. The
     per-tree RNG is keyed by absolute tree index, so k trees then k more
     reproduces a single 2k-tree run exactly.
+    weights: [N] per-row observation weights (weights_column,
+    hex/tree/SharedTree.java weights plumbing) folded into (g, h) on device.
+    offset: [N] per-row margin offset (offset_column) added to the initial
+    margin; single-margin objectives only. The caller owns adding the offset
+    back at scoring time (Model.score semantics).
+    monotone: [F] per-feature direction in {-1, 0, +1} (monotone_constraints).
     """
     import time as _time
 
@@ -546,7 +661,20 @@ def train_boosted(
         margin_host = np.tile(
             np.asarray(init_margin, dtype=np.float32), (n_pad, 1)
         )
+    if offset is not None:
+        if C != 1:
+            raise ValueError("offset_column requires a single-margin objective")
+        margin_host[:n, 0] += np.asarray(offset, dtype=np.float32)
     margin = jax.device_put(margin_host, row_sharding(mesh, 2))
+
+    w_d = None
+    if weights is not None:
+        w_host = np.zeros(n_pad, np.float32)
+        w_host[:n] = np.asarray(weights, dtype=np.float32)
+        w_d = jax.device_put(w_host, row_sharding(mesh, 1))
+    mono_d = None
+    if monotone is not None and np.any(np.asarray(monotone) != 0):
+        mono_d = jnp.asarray(np.asarray(monotone, dtype=np.int32))
 
     trees_per_class = [Trees(p.max_depth, n_bins1, edges) for _ in range(C)]
     tree_offset = 0
@@ -570,23 +698,26 @@ def train_boosted(
 
     p_key = _dc_replace(p, ntrees=0, seed=0)
 
-    import os
-
     built = 0
-    default_block = int(os.environ.get("H2O3_TPU_TREE_BLOCK", "16"))
+    default_block = tree_block_size()
     while built < p.ntrees:
         block = (
             min(score_interval, p.ntrees - built)
             if monitor is not None
             else min(default_block, p.ntrees - built)
         )
-        fn = _make_block_fn(objective, C, block, p_key, mesh)
+        fn = _make_block_fn(
+            objective, C, block, p_key, mesh,
+            weighted=w_d is not None, monotone=mono_d is not None,
+        )
         # one key per ABSOLUTE tree index: blocking and checkpoints never
         # change the random stream a given tree sees
         keys = jax.vmap(lambda t: jax.random.fold_in(key, t))(
             jnp.arange(tree_offset + built, tree_offset + built + block)
         )
-        margin, trees_dev = fn(bins_d, y_d, valid_d, margin, keys, bins_fm_d)
+        margin, trees_dev = fn(
+            bins_d, y_d, valid_d, margin, keys, bins_fm_d, w_d, mono_d
+        )
         tf, tb, tdl, tsp, tlf = jax.device_get(trees_dev)  # [block, C, M] each
         for t in range(block):
             for c in range(C):

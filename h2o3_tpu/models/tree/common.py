@@ -2,11 +2,13 @@
 
 Reference: trees consume raw (non-standardized) predictors with categorical
 codes; ``hex/tree/SharedTree.java`` + ``hex/DataInfo`` handle the layout and
-``hex/Distribution.java`` the gradient families. Categorical handling note:
-the reference can split categorical sets directly; this build currently
-treats categorical codes as ordinal bins (equivalent to the reference's
-``categorical_encoding=label_encoder`` / sorted enum mode) — set-valued
-splits are a planned refinement.
+``hex/Distribution.java`` the gradient families. Categorical handling:
+``categorical_encoding="label_encoder"`` (the default here) treats
+categorical codes as ordinal bins (the reference's sorted enum mode);
+``"one_hot_explicit"`` expands each level to an indicator feature
+(``hex/DataInfo`` OneHotExplicit) — the tree can then isolate any level
+subset via successive indicator splits, the dense stand-in for the
+reference's set-valued splits (``hex/tree/DTree.java``).
 """
 
 from __future__ import annotations
@@ -28,17 +30,60 @@ def tree_data_info(frame: Frame, y: str, ignored=()) -> DataInfo:
     )
 
 
-def tree_matrix(info: DataInfo, frame: Frame) -> np.ndarray:
-    """[N, F] float32 raw-feature matrix; cat codes as ordinals, NaN for NA."""
+TREE_ENCODINGS = ("auto", "enum", "label_encoder", "one_hot_explicit")
+
+
+def resolve_tree_encoding(categorical_encoding: str) -> str:
+    """Map the categorical_encoding param to a tree matrix layout."""
+    if categorical_encoding in ("auto", "enum", "label_encoder"):
+        return "label_encoder"
+    if categorical_encoding == "one_hot_explicit":
+        return "one_hot_explicit"
+    raise ValueError(
+        f"categorical_encoding {categorical_encoding!r} not supported for "
+        f"tree models; choose from {TREE_ENCODINGS}"
+    )
+
+
+def tree_feature_names(info: DataInfo, encoding: str = "label_encoder") -> List[str]:
+    """Feature names in tree_matrix column order (one-hot expands levels)."""
+    names: List[str] = []
+    for name in info.predictor_names:
+        if encoding == "one_hot_explicit" and name in info.cat_domains:
+            names += [f"{name}.{lv}" for lv in info.cat_domains[name]]
+        else:
+            names.append(name)
+    return names
+
+
+def tree_matrix(
+    info: DataInfo, frame: Frame, encoding: str = "label_encoder"
+) -> np.ndarray:
+    """[N, F] float32 raw-feature matrix; NaN for NA.
+
+    label_encoder: cat codes as ordinals (one column per predictor).
+    one_hot_explicit: one 0/1 column per level; an NA row is NaN across the
+    whole block so NA routing still learns a default direction per split.
+    """
     cols = []
     for name in info.predictor_names:
         col = frame.col(name)
         if name in info.cat_domains:
             codes = _align_codes(col, info.cat_domains[name])
-            cols.append(np.where(codes >= 0, codes.astype(np.float32), np.nan))
+            if encoding == "one_hot_explicit":
+                dom = info.cat_domains[name]
+                block = (codes[:, None] == np.arange(len(dom))[None, :]).astype(
+                    np.float32
+                )
+                block[codes < 0] = np.nan
+                cols.append(block)
+            else:
+                cols.append(
+                    np.where(codes >= 0, codes.astype(np.float32), np.nan)[:, None]
+                )
         else:
-            cols.append(col.numeric_view().astype(np.float32))
-    return np.stack(cols, axis=1)
+            cols.append(col.numeric_view().astype(np.float32)[:, None])
+    return np.concatenate(cols, axis=1)
 
 
 # -- distributions (hex/Distribution.java gradient/hessian families) ---------
@@ -56,46 +101,119 @@ def softmax(m):
 
 def grad_hess(distribution: str, y: np.ndarray, margin: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     """Per-row (g, h) of the loss wrt the margin. y: [N] (codes for classif),
-    margin: [N, C]. Returns [N, C] arrays."""
-    if distribution == "gaussian":
+    margin: [N, C]. Returns [N, C] arrays. Host oracle mirroring
+    booster.grad_hess_device (parameterized families use 'name:arg')."""
+    name, _, arg = distribution.partition(":")
+    if name == "gaussian":
         g = margin[:, 0] - y
         return g[:, None], np.ones_like(g)[:, None]
-    if distribution == "bernoulli":
+    if name == "bernoulli":
         p = sigmoid(margin[:, 0])
         return (p - y)[:, None], np.maximum(p * (1 - p), 1e-16)[:, None]
-    if distribution == "multinomial":
+    if name == "multinomial":
         p = softmax(margin)
         onehot = np.zeros_like(p)
         onehot[np.arange(len(y)), y.astype(np.int64)] = 1.0
         return p - onehot, np.maximum(p * (1 - p), 1e-16)
-    if distribution == "poisson":
+    if name == "poisson":
         mu = np.exp(margin[:, 0])
         return (mu - y)[:, None], np.maximum(mu, 1e-16)[:, None]
-    if distribution == "laplace":
+    if name == "gamma":
+        ymf = y * np.exp(-margin[:, 0])
+        return (1.0 - ymf)[:, None], np.maximum(ymf, 1e-16)[:, None]
+    if name == "tweedie":
+        pw = float(arg)
+        a = y * np.exp((1.0 - pw) * margin[:, 0])
+        b = np.exp((2.0 - pw) * margin[:, 0])
+        return (b - a)[:, None], np.maximum((pw - 1) * a + (2 - pw) * b, 1e-16)[:, None]
+    if name == "huber":
+        delta = float(arg)
+        r = margin[:, 0] - y
+        return np.clip(r, -delta, delta)[:, None], np.ones_like(r)[:, None]
+    if name == "laplace":
         g = np.sign(margin[:, 0] - y)
         return g[:, None], np.ones_like(g)[:, None]
-    if distribution == "quantile_0.5":
-        g = np.where(margin[:, 0] > y, 0.5, -0.5)
+    if name == "quantile" or distribution == "quantile_0.5":
+        alpha = float(arg) if arg else 0.5
+        g = np.where(margin[:, 0] < y, -alpha, 1.0 - alpha)
         return g[:, None], np.ones_like(g)[:, None]
     raise ValueError(f"unknown distribution {distribution!r}")
 
 
-def init_margin(distribution: str, y: np.ndarray, nclasses: int) -> np.ndarray:
-    """Initial margin f0 (SharedTree init: response moments / priors)."""
-    if distribution == "gaussian":
-        return np.array([float(np.nanmean(y))])
-    if distribution == "bernoulli":
-        p = float(np.nanmean(y))
+def _wmean(y: np.ndarray, w: Optional[np.ndarray]) -> float:
+    if w is None:
+        return float(np.nanmean(y))
+    m = ~np.isnan(y)
+    return float(np.average(y[m], weights=w[m]))
+
+
+def _family_param(params, field: str, distribution: str) -> float:
+    """A family parameter must exist on the builder's Parameters dataclass —
+    a builder that lists a distribution but lacks its parameter would
+    otherwise silently train with a hardcoded default (the
+    accepted-and-ignored failure mode the param guard exists to prevent)."""
+    val = getattr(params, field, None)
+    if val is None:
+        raise ValueError(
+            f"distribution {distribution!r} needs parameter {field!r}, which "
+            f"{type(params).__name__} does not declare"
+        )
+    return float(val)
+
+
+def resolve_objective(distribution: str, params, y: np.ndarray) -> str:
+    """Builder distribution name -> booster objective string, folding the
+    family parameter in (``hex/Distribution.java``'s per-family params).
+    huber: delta is the huber_alpha quantile of |y - median(y)| residuals
+    (the reference re-estimates it per iteration; fixed-at-init here)."""
+    if distribution in ("gamma", "poisson", "tweedie"):
+        if np.nanmin(y) < 0:
+            raise ValueError(f"{distribution} requires a non-negative response")
+    if distribution == "tweedie":
+        pw = _family_param(params, "tweedie_power", distribution)
+        if not 1.0 < pw < 2.0:
+            raise ValueError(f"tweedie_power must be in (1, 2), got {pw}")
+        return f"tweedie:{pw}"
+    if distribution == "quantile":
+        alpha = _family_param(params, "quantile_alpha", distribution)
+        if not 0.0 < alpha < 1.0:
+            raise ValueError(f"quantile_alpha must be in (0, 1), got {alpha}")
+        return f"quantile:{alpha}"
+    if distribution == "huber":
+        ha = _family_param(params, "huber_alpha", distribution)
+        r = np.abs(y - np.nanmedian(y))
+        delta = max(float(np.nanquantile(r, ha)), 1e-10)
+        return f"huber:{delta:.8g}"
+    return distribution
+
+
+def init_margin(
+    distribution: str, y: np.ndarray, nclasses: int,
+    weights: Optional[np.ndarray] = None,
+) -> np.ndarray:
+    """Initial margin f0 (SharedTree init: response moments / priors),
+    weighted when an observation-weights column is in play."""
+    name, _, arg = distribution.partition(":")
+    if name in ("gaussian", "huber"):
+        return np.array([_wmean(y, weights)])
+    if name == "bernoulli":
+        p = _wmean(y, weights)
         p = min(max(p, 1e-10), 1 - 1e-10)
         return np.array([np.log(p / (1 - p))])
-    if distribution == "multinomial":
-        pri = np.bincount(y[~np.isnan(y)].astype(np.int64), minlength=nclasses).astype(np.float64)
+    if name == "multinomial":
+        m = ~np.isnan(y)
+        w = weights[m] if weights is not None else None
+        pri = np.bincount(
+            y[m].astype(np.int64), weights=w, minlength=nclasses
+        ).astype(np.float64)
         pri = np.maximum(pri / pri.sum(), 1e-10)
         return np.log(pri)
-    if distribution == "poisson":
-        return np.array([np.log(max(float(np.nanmean(y)), 1e-10))])
-    if distribution in ("laplace", "quantile_0.5"):
+    if name in ("poisson", "gamma", "tweedie"):
+        return np.array([np.log(max(_wmean(y, weights), 1e-10))])
+    if name == "laplace" or distribution == "quantile_0.5":
         return np.array([float(np.nanmedian(y))])
+    if name == "quantile":
+        return np.array([float(np.nanquantile(y, float(arg)))])
     raise ValueError(f"unknown distribution {distribution!r}")
 
 
@@ -108,6 +226,14 @@ def margin_to_probs(distribution: str, margin: np.ndarray) -> np.ndarray:
     return margin  # regression: identity
 
 
+def link_inverse(distribution: str, margin: np.ndarray) -> np.ndarray:
+    """Regression margin -> response scale (Distribution.linkInv): the
+    log-link families train on log(mu), predictions report mu."""
+    if distribution.partition(":")[0] in ("poisson", "gamma", "tweedie"):
+        return np.exp(margin)
+    return margin
+
+
 def auto_distribution(nclasses: int) -> str:
     if nclasses == 2:
         return "bernoulli"
@@ -116,25 +242,153 @@ def auto_distribution(nclasses: int) -> str:
     return "gaussian"
 
 
-def training_score(distribution: str, y: np.ndarray, margin: np.ndarray) -> float:
-    """Scalar stopping metric from the current margin (deviance-flavored)."""
-    if distribution == "bernoulli":
+def training_score(
+    distribution: str, y: np.ndarray, margin: np.ndarray,
+    weights: Optional[np.ndarray] = None,
+) -> float:
+    """Scalar stopping metric from the current margin (deviance-flavored,
+    weighted mean when observation weights are in play)."""
+
+    def wavg(v):
+        return float(np.average(v, weights=weights))
+
+    name, _, arg = distribution.partition(":")
+    if name == "bernoulli":
         p = np.clip(sigmoid(margin[:, 0]), 1e-15, 1 - 1e-15)
-        return float(np.mean(-(y * np.log(p) + (1 - y) * np.log(1 - p))))
-    if distribution == "multinomial":
+        return wavg(-(y * np.log(p) + (1 - y) * np.log(1 - p)))
+    if name == "multinomial":
         p = softmax(margin)
-        return float(np.mean(-np.log(np.clip(p[np.arange(len(y)), y.astype(np.int64)], 1e-15, 1))))
-    if distribution == "poisson":
+        return wavg(-np.log(np.clip(p[np.arange(len(y)), y.astype(np.int64)], 1e-15, 1)))
+    if name == "poisson":
         mu = np.exp(margin[:, 0])
-        return float(np.mean(2 * (np.where(y > 0, y * np.log(np.where(y > 0, y, 1) / mu), 0) - (y - mu))))
-    return float(np.mean((margin[:, 0] - y) ** 2))
+        return wavg(2 * (np.where(y > 0, y * np.log(np.where(y > 0, y, 1) / mu), 0) - (y - mu)))
+    if name == "gamma":
+        mu = np.maximum(np.exp(margin[:, 0]), 1e-15)
+        ys = np.maximum(y, 1e-15)
+        return wavg(2 * (ys / mu - np.log(ys / mu) - 1))
+    if name == "tweedie":
+        pw = float(arg)
+        mu = np.maximum(np.exp(margin[:, 0]), 1e-15)
+        return wavg(
+            2 * (
+                np.power(np.maximum(y, 0), 2 - pw) / ((1 - pw) * (2 - pw))
+                - y * np.power(mu, 1 - pw) / (1 - pw)
+                + np.power(mu, 2 - pw) / (2 - pw)
+            )
+        )
+    if name == "huber":
+        delta = float(arg)
+        r = np.abs(margin[:, 0] - y)
+        return wavg(np.where(r <= delta, 0.5 * r * r, delta * (r - 0.5 * delta)))
+    if name == "laplace":
+        return wavg(np.abs(margin[:, 0] - y))
+    if name == "quantile" or distribution == "quantile_0.5":
+        alpha = float(arg) if arg else 0.5
+        r = y - margin[:, 0]
+        return wavg(np.where(r >= 0, alpha * r, (alpha - 1) * r))
+    return wavg((margin[:, 0] - y) ** 2)
 
 
-def checkpoint_booster(p, n_class_trees: int, algo_name: str = None):
+def extract_weights(frame: Frame, p, keep: np.ndarray):
+    """Load + validate weights_column, folding zero/NA-weight rows into the
+    keep mask (dropping them is equivalent to the reference's zero
+    contribution). Returns the [N] weights or None; index with keep after."""
+    if not p.weights_column:
+        return None
+    weights = frame.col(p.weights_column).numeric_view().astype(np.float64)
+    if np.nanmin(weights) < 0:
+        raise ValueError("weights_column must be non-negative")
+    keep &= ~np.isnan(weights) & (weights > 0)
+    return weights
+
+
+def tree_fit_setup(frame: Frame, p, model_cls, use_offset: bool):
+    """Shared GBM/XGBoost front half of _fit: layout, matrices, aux columns,
+    objective resolution, init margin, monotone validation.
+
+    Returns (model, X, y, weights, offset, objective, f0, n_class_trees,
+    mono) with the keep mask (NA response / zero-weight / NA-offset rows)
+    already applied to X/y/weights/offset."""
+    from h2o3_tpu.models.data_info import response_vector
+
+    ignored = list(p.ignored_columns)
+    aux_cols = [p.weights_column] + ([p.offset_column] if use_offset else [])
+    for aux in aux_cols:
+        if aux and aux not in ignored:
+            ignored.append(aux)
+    info = tree_data_info(frame, p.response_column, ignored)
+    y = response_vector(info, frame)
+    nclasses = len(info.response_domain) if info.response_domain else 1
+    dist = auto_distribution(nclasses) if p.distribution == "auto" else p.distribution
+
+    model = model_cls(p, info, dist)
+    enc = model.tree_encoding
+    X = tree_matrix(info, frame, encoding=enc)
+    keep = ~np.isnan(y)
+    weights = extract_weights(frame, p, keep)
+    offset = None
+    if use_offset and p.offset_column:
+        offset = frame.col(p.offset_column).numeric_view().astype(np.float64)
+        keep &= ~np.isnan(offset)
+    X, y = X[keep], y[keep]
+    if weights is not None:
+        weights = weights[keep]
+    if offset is not None:
+        offset = offset[keep]
+
+    objective = resolve_objective(dist, p, y)
+    f0 = init_margin(objective, y, nclasses, weights=weights)
+    n_class_trees = nclasses if dist == "multinomial" else 1
+    mono = monotone_array(getattr(p, "monotone_constraints", None), info, enc)
+    if mono is not None and dist == "multinomial":
+        # softmax normalization voids per-margin monotonicity; the
+        # reference rejects this combination too (GBM.java validation)
+        raise ValueError("monotone_constraints not supported for multinomial")
+    return model, X, y, weights, offset, objective, f0, n_class_trees, mono
+
+
+def make_tree_monitor(model, p, objective, y, weights, history):
+    """ScoreKeeper monitor closure shared by GBM/XGBoost: wall-clock budget
+    (max_runtime_secs) + stopping_rounds early stopping. Returns
+    (monitor_or_None, score_interval): when only the deadline is active the
+    interval stays at the device block size so the budget check does not
+    force a host sync every tree."""
+    import time as _time
+
+    from h2o3_tpu.models.tree.booster import tree_block_size
+
+    deadline = (_time.time() + p.max_runtime_secs) if p.max_runtime_secs > 0 else None
+
+    def monitor(t: int, margin: np.ndarray) -> bool:
+        model.ntrees_built = t + 1
+        if deadline is not None and _time.time() >= deadline:
+            return True
+        if p.stopping_rounds <= 0 or (t + 1) % p.score_tree_interval:
+            return False
+        history.append(training_score(objective, y, margin, weights=weights))
+        model.scoring_history.append({"tree": t + 1, "score": history[-1]})
+        return M.stop_early(
+            history, p.stopping_rounds, more_is_better=False,
+            stopping_tolerance=p.stopping_tolerance,
+        )
+
+    if p.stopping_rounds > 0:
+        return monitor, p.score_tree_interval
+    if deadline is not None:
+        return monitor, max(p.score_tree_interval, tree_block_size())
+    return None, p.score_tree_interval
+
+
+def checkpoint_booster(
+    p, n_class_trees: int, algo_name: str = None,
+    n_features: int = None, encoding: str = None,
+):
     """Resolve the ``checkpoint`` param to the prior model's booster
     (checkpoint-continue, ``hex/tree/SharedTree.java:131-136``). The
     reference validates that non-modifiable params match the checkpoint
-    (CheckpointUtils); here: same algo, class count, depth, and binning."""
+    (CheckpointUtils); here: same algo, class count, depth, binning, and
+    feature layout (count + categorical encoding) — trees from two
+    different layouts index features incompatibly."""
     if not p.checkpoint:
         return None
     from h2o3_tpu.keyed import DKV
@@ -161,6 +415,17 @@ def checkpoint_booster(p, n_class_trees: int, algo_name: str = None):
         raise ValueError(
             f"checkpoint nbins={t0.n_bins1 - 1} differs from requested {p.nbins}"
         )
+    if n_features is not None and t0.edges.shape[0] != n_features:
+        raise ValueError(
+            f"checkpoint was trained on {t0.edges.shape[0]} tree features, "
+            f"this frame/encoding produces {n_features}"
+        )
+    prior_enc = getattr(prior, "tree_encoding", None)
+    if encoding is not None and prior_enc is not None and prior_enc != encoding:
+        raise ValueError(
+            f"checkpoint categorical_encoding={prior_enc!r} differs from "
+            f"requested {encoding!r}"
+        )
     return b
 
 
@@ -178,6 +443,33 @@ def extra_trees(p, n_class_trees: int) -> int:
     return p.ntrees - built
 
 
+def monotone_array(
+    constraints: Optional[dict], info: DataInfo, encoding: str
+) -> Optional[np.ndarray]:
+    """monotone_constraints dict {col: ±1} -> per-tree-feature int array.
+
+    Reference semantics (hex/tree/gbm/GBM.java monotone validation):
+    constraints apply to numeric predictors only; unknown columns and
+    categorical columns are errors, not silently dropped."""
+    if not constraints:
+        return None
+    names = tree_feature_names(info, encoding)
+    arr = np.zeros(len(names), dtype=np.int32)
+    for col, direction in constraints.items():
+        if direction not in (-1, 0, 1):
+            raise ValueError(
+                f"monotone_constraints[{col!r}] must be -1, 0 or 1, got {direction!r}"
+            )
+        if col in info.cat_domains:
+            raise ValueError(
+                f"monotone_constraints not supported on categorical column {col!r}"
+            )
+        if col not in names:
+            raise ValueError(f"monotone_constraints column {col!r} not in predictors")
+        arr[names.index(col)] = direction
+    return arr
+
+
 class TreeModelBase(Model):
     """Common prediction path for GBM/DRF/XGBoost models."""
 
@@ -186,20 +478,39 @@ class TreeModelBase(Model):
         self.distribution = distribution
         self.booster = None  # BoostedTrees
         self.ntrees_built = 0
+        self.tree_encoding = resolve_tree_encoding(
+            getattr(params, "categorical_encoding", "auto")
+        )
 
     def _predict_raw(self, frame: Frame) -> np.ndarray:
-        X = tree_matrix(self.data_info, frame)
+        X = tree_matrix(self.data_info, frame, encoding=self.tree_encoding)
         margin = self.booster.predict_margin(X)
+        off = getattr(self.params, "offset_column", None)
+        if off:
+            # Model.score: the offset column of the SCORING frame shifts the
+            # margin (hex/Model.java adaptTestForTrain offset handling)
+            if off not in frame.names:
+                raise ValueError(
+                    f"offset_column {off!r} must be present in the scoring frame"
+                )
+            off_vals = frame.col(off).numeric_view()
+            if np.isnan(off_vals).any():
+                # match the MOJO scorer: loud, not silently-NaN predictions
+                raise ValueError(
+                    f"offset_column {off!r} has NA values in the scoring frame"
+                )
+            margin = margin + off_vals[:, None]
         return (
             margin_to_probs(self.distribution, margin)
             if self.is_classifier
-            else margin[:, 0]
+            else link_inverse(self.distribution, margin[:, 0])
         )
 
     def variable_importances(self) -> dict:
         """Split-count/gain-weighted importances (SharedTree varimp analogue:
         squared-error reduction summed per feature)."""
-        imp = np.zeros(len(self.data_info.predictor_names))
+        names = tree_feature_names(self.data_info, self.tree_encoding)
+        imp = np.zeros(len(names))
         for trees in self.booster.trees_per_class:
             for t in range(trees.ntrees):
                 sp = trees.is_split[t]
@@ -207,4 +518,4 @@ class TreeModelBase(Model):
                 np.add.at(imp, feats, 1.0)
         total = imp.sum()
         rel = imp / total if total > 0 else imp
-        return dict(zip(self.data_info.predictor_names, rel.tolist()))
+        return dict(zip(names, rel.tolist()))

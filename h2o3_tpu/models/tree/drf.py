@@ -24,6 +24,7 @@ from h2o3_tpu.models.tree.common import (
     TreeModelBase,
     checkpoint_booster as _checkpoint_booster,
     extra_trees as _extra_trees,
+    extract_weights,
     tree_data_info,
     tree_matrix,
 )
@@ -44,7 +45,7 @@ class DRFModel(TreeModelBase):
     algo_name = "drf"
 
     def _predict_raw(self, frame: Frame) -> np.ndarray:
-        X = tree_matrix(self.data_info, frame)
+        X = tree_matrix(self.data_info, frame, encoding=self.tree_encoding)
         margin = self.booster.predict_margin(X)  # averaged leaf values per class
         if not self.is_classifier:
             return margin[:, 0]
@@ -57,7 +58,9 @@ class DRFModel(TreeModelBase):
 
 class DRF(ModelBuilder):
 
-    SUPPORTED_COMMON = frozenset({"checkpoint"})
+    SUPPORTED_COMMON = frozenset(
+        {"checkpoint", "weights_column", "categorical_encoding"}
+    )
     algo_name = "drf"
 
     def __init__(self, params: Optional[DRFParameters] = None, **kw) -> None:
@@ -65,13 +68,19 @@ class DRF(ModelBuilder):
 
     def _fit(self, frame: Frame, valid: Optional[Frame] = None) -> DRFModel:
         p: DRFParameters = self.params
-        info = tree_data_info(frame, p.response_column, p.ignored_columns)
+        ignored = list(p.ignored_columns)
+        if p.weights_column and p.weights_column not in ignored:
+            ignored.append(p.weights_column)
+        info = tree_data_info(frame, p.response_column, ignored)
         y = response_vector(info, frame)
         nclasses = len(info.response_domain) if info.response_domain else 1
         model = DRFModel(p, info, "gaussian")
-        X = tree_matrix(info, frame)
+        X = tree_matrix(info, frame, encoding=model.tree_encoding)
         keep = ~np.isnan(y)
+        weights = extract_weights(frame, p, keep)
         X, y = X[keep], y[keep]
+        if weights is not None:
+            weights = weights[keep]
         F = X.shape[1]
 
         mtries = p.mtries
@@ -105,7 +114,8 @@ class DRF(ModelBuilder):
         )
 
         # objective='fixed': each tree independently fits the raw targets
-        # (g = -target, h = 1 gives Newton leaf = mean(target in leaf))
+        # (g = -target, h = 1 gives Newton leaf = mean(target in leaf);
+        # with weights g = -w*t, h = w gives the weighted in-leaf mean)
         model.booster = train_boosted(
             X,
             objective="fixed",
@@ -114,7 +124,11 @@ class DRF(ModelBuilder):
             init_margin=np.zeros(n_class_trees),
             params=tp,
             average=True,
-            resume_from=_checkpoint_booster(p, n_class_trees, self.algo_name),
+            resume_from=_checkpoint_booster(
+                p, n_class_trees, self.algo_name,
+                n_features=F, encoding=model.tree_encoding,
+            ),
+            weights=weights,
         )
         model.ntrees_built = model.booster.trees_per_class[0].ntrees
         model.training_metrics = model.model_performance(frame)

@@ -16,22 +16,16 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Optional
 
-import numpy as np
 
 from h2o3_tpu.frame.frame import Frame
-from h2o3_tpu.models import metrics as M
-from h2o3_tpu.models.data_info import response_vector
 from h2o3_tpu.models.framework import ModelBuilder, ModelParameters
 from h2o3_tpu.models.tree.booster import TreeParams, train_boosted
 from h2o3_tpu.models.tree.common import (
     TreeModelBase,
-    auto_distribution,
     checkpoint_booster as _checkpoint_booster,
     extra_trees as _extra_trees,
-    init_margin,
-    training_score,
-    tree_data_info,
-    tree_matrix,
+    make_tree_monitor,
+    tree_fit_setup,
 )
 
 
@@ -51,6 +45,8 @@ class XGBoostParameters(ModelParameters):
     tree_method: str = "tpu_hist"
     distribution: str = "auto"
     score_tree_interval: int = 1
+    tweedie_power: float = 1.5  # reg:tweedie variance power
+    monotone_constraints: Optional[dict] = None  # {col: -1|+1}
 
 
 class XGBoostModel(TreeModelBase):
@@ -59,28 +55,39 @@ class XGBoostModel(TreeModelBase):
 
 class XGBoost(ModelBuilder):
 
-    SUPPORTED_COMMON = frozenset({"checkpoint", "stopping_rounds"})
+    SUPPORTED_COMMON = frozenset(
+        {
+            "checkpoint",
+            "stopping_rounds",
+            "weights_column",
+            "categorical_encoding",
+            "max_runtime_secs",
+        }
+    )
     algo_name = "xgboost"
 
     def __init__(self, params: Optional[XGBoostParameters] = None, **kw) -> None:
         super().__init__(params or XGBoostParameters(**kw))
 
+    #: distributions the XGBoost objective surface supports (libxgboost's
+    #: reg:squarederror / binary:logistic / multi:softprob / count:poisson /
+    #: reg:gamma / reg:tweedie — no huber/quantile/laplace objectives there)
+    DISTRIBUTIONS = frozenset(
+        {"auto", "gaussian", "bernoulli", "multinomial", "poisson", "gamma", "tweedie"}
+    )
+
     def _fit(self, frame: Frame, valid: Optional[Frame] = None) -> XGBoostModel:
         p: XGBoostParameters = self.params
-        info = tree_data_info(frame, p.response_column, p.ignored_columns)
-        y = response_vector(info, frame)
-        nclasses = len(info.response_domain) if info.response_domain else 1
-        dist = auto_distribution(nclasses) if p.distribution == "auto" else p.distribution
-
-        model = XGBoostModel(p, info, dist)
-        X = tree_matrix(info, frame)
-        keep = ~np.isnan(y)
-        X, y = X[keep], y[keep]
-
-        # libxgboost starts from base_score (0.5 prob -> 0 margin); we use the
-        # data-driven init like the reference's H2O-side initial prediction
-        f0 = init_margin(dist, y, nclasses)
-        n_class_trees = nclasses if dist == "multinomial" else 1
+        if p.distribution not in self.DISTRIBUTIONS:
+            raise ValueError(
+                f"xgboost does not support distribution {p.distribution!r}; "
+                f"choose from {sorted(self.DISTRIBUTIONS)}"
+            )
+        # (libxgboost starts from base_score — 0.5 prob -> 0 margin; we use
+        # the data-driven init like the reference's H2O-side initial pred)
+        model, X, y, weights, _, objective, f0, n_class_trees, mono = (
+            tree_fit_setup(frame, p, XGBoostModel, use_offset=False)
+        )
 
         tp = TreeParams(
             ntrees=_extra_trees(p, n_class_trees),
@@ -98,28 +105,24 @@ class XGBoost(ModelBuilder):
         )
 
         history = []
-
-        def monitor(t: int, margin: np.ndarray) -> bool:
-            model.ntrees_built = t + 1
-            if p.stopping_rounds <= 0 or (t + 1) % p.score_tree_interval:
-                return False
-            history.append(training_score(dist, y, margin))
-            model.scoring_history.append({"tree": t + 1, "score": history[-1]})
-            return M.stop_early(
-                history, p.stopping_rounds, more_is_better=False,
-                stopping_tolerance=p.stopping_tolerance,
-            )
-
+        monitor, score_interval = make_tree_monitor(
+            model, p, objective, y, weights, history
+        )
         model.booster = train_boosted(
             X,
-            objective=dist,
+            objective=objective,
             y=y,
             n_class_trees=n_class_trees,
             init_margin=f0,
             params=tp,
-            monitor=monitor if p.stopping_rounds > 0 else None,
-            score_interval=p.score_tree_interval,
-            resume_from=_checkpoint_booster(p, n_class_trees, self.algo_name),
+            monitor=monitor,
+            score_interval=score_interval,
+            resume_from=_checkpoint_booster(
+                p, n_class_trees, self.algo_name,
+                n_features=X.shape[1], encoding=model.tree_encoding,
+            ),
+            weights=weights,
+            monotone=mono,
         )
         model.ntrees_built = model.booster.trees_per_class[0].ntrees
         model.training_metrics = model.model_performance(frame)

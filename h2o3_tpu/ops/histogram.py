@@ -64,6 +64,17 @@ def make_bins(
         if col.size == 0:
             edges[f] = np.arange(nbins - 1, dtype=np.float64)
             continue
+        distinct = np.unique(col)
+        if len(distinct) <= nbins:
+            # low-cardinality (incl. one-hot indicators): exact midpoint
+            # edges give every distinct value its own bin — data quantiles
+            # would collapse rare values (e.g. a 3%-frequency indicator)
+            # into their neighbor's bin and make them unsplittable
+            mids = (distinct[:-1] + distinct[1:]) / 2.0
+            e = np.full(nbins - 1, np.inf)  # inf pad: never <= any value
+            e[: len(mids)] = mids
+            edges[f] = e
+            continue
         e = np.quantile(col, qs)
         # de-duplicate while keeping monotonicity (constant-ish features)
         e = np.maximum.accumulate(e)
@@ -86,20 +97,25 @@ def apply_bins(X: np.ndarray, edges: np.ndarray) -> np.ndarray:
 # the scatter-add histogram
 
 
-def _shard_histogram(bins, nodes, g, h, n_nodes: int, n_bins1: int):
-    """Shard-private histogram: [K, F, B+1, 3] of (Σg, Σh, count)."""
+def _shard_histogram(bins, nodes, g, h, n_nodes: int, n_bins1: int, rw=None):
+    """Shard-private histogram: [K, F, B+1, 3] of (Σg, Σh, Σw).
+
+    rw: optional [N] per-row count weight — the third channel becomes the
+    weighted observation count (DHistogram Σw), so min_rows sees weighted
+    counts under a weights_column. None keeps raw row counts."""
     n, F = bins.shape
     valid = nodes >= 0
     node = jnp.where(valid, nodes, 0)
     flat = (node[:, None] * F + jnp.arange(F, dtype=jnp.int32)[None, :]) * n_bins1 + bins
     w = valid.astype(g.dtype)
+    cw = w if rw is None else w * rw
     # channel-major layout: the long N*F axis must be the (128-)lane axis —
     # a [N*F, 3] layout would pad 3 lanes to 128 on TPU (≈42x HBM blowup)
     vals = jnp.stack(
         [
             jnp.broadcast_to((g * w)[:, None], (n, F)),
             jnp.broadcast_to((h * w)[:, None], (n, F)),
-            jnp.broadcast_to(w[:, None], (n, F)),
+            jnp.broadcast_to(cw[:, None], (n, F)),
         ],
         axis=0,
     )  # [3, n, F]
@@ -123,67 +139,68 @@ def _hist_impl(impl: Optional[str]) -> str:
     return impl
 
 
-def _one_shard_histogram(bins, nodes, g, h, n_nodes, n_bins1, impl, vma=(), bins_fm=None):
+def _one_shard_histogram(
+    bins, nodes, g, h, n_nodes, n_bins1, impl, vma=(), bins_fm=None, rw=None
+):
     if impl == "pallas":
         from h2o3_tpu.ops.pallas_histogram import build_histogram_pallas
 
         return build_histogram_pallas(
             bins, nodes, g, h, n_nodes, n_bins1,
             interpret=jax.default_backend() != "tpu", vma=vma, bins_fm=bins_fm,
+            rw=rw,
         )
-    return _shard_histogram(bins, nodes, g, h, n_nodes, n_bins1)
+    return _shard_histogram(bins, nodes, g, h, n_nodes, n_bins1, rw=rw)
 
 
 def build_histogram_sharded(
     bins, nodes, g, h, n_nodes: int, n_bins1: int, mesh=None,
-    impl: Optional[str] = None, bins_fm=None,
+    impl: Optional[str] = None, bins_fm=None, rw=None,
 ):
     """Full distributed histogram: private scatter-add per shard, psum merge.
 
     bins:[N,F] int32 row-sharded; nodes:[N] int32 (-1 = inactive row);
     g,h:[N] float32. bins_fm: optional feature-major [F, N] copy of bins
     (already padded to the kernel row tile) — callers in a training loop pass
-    it so the pallas path skips a per-call transpose.
+    it so the pallas path skips a per-call transpose. rw: optional [N]
+    per-row count weight (weights_column: the count channel reports Σw).
     Returns replicated [n_nodes, F, n_bins1, 3].
     """
     # resolve the env override OUTSIDE the jit cache so changing it between
     # calls takes effect (the resolved impl is the static cache key)
     return _build_histogram_jit(
-        bins, nodes, g, h, bins_fm, n_nodes, n_bins1, mesh, _hist_impl(impl)
+        bins, nodes, g, h, bins_fm, rw, n_nodes, n_bins1, mesh, _hist_impl(impl)
     )
 
 
 @partial(jax.jit, static_argnames=("n_nodes", "n_bins1", "mesh", "impl"))
 def _build_histogram_jit(
-    bins, nodes, g, h, bins_fm, n_nodes: int, n_bins1: int, mesh, impl: str
+    bins, nodes, g, h, bins_fm, rw, n_nodes: int, n_bins1: int, mesh, impl: str
 ):
     if mesh is None:
         return _one_shard_histogram(
-            bins, nodes, g, h, n_nodes, n_bins1, impl, bins_fm=bins_fm
+            bins, nodes, g, h, n_nodes, n_bins1, impl, bins_fm=bins_fm, rw=rw
         )
 
-    def fn(b, nd, gg, hh, bfm):
+    # optional row-sharded / feature-major extras enter the shard_map only
+    # when present so the base program is unchanged without them
+    extras = []
+    if bins_fm is not None:
+        extras.append(("bins_fm", bins_fm, P(None, DATA_AXIS)))
+    if rw is not None:
+        extras.append(("rw", rw, P(DATA_AXIS)))
+
+    def fn(b, nd, gg, hh, *rest):
+        kw = dict(zip([name for name, _, _ in extras], rest))
         part = _one_shard_histogram(
-            b, nd, gg, hh, n_nodes, n_bins1, impl, vma=(DATA_AXIS,), bins_fm=bfm
+            b, nd, gg, hh, n_nodes, n_bins1, impl, vma=(DATA_AXIS,), **kw
         )
         return jax.lax.psum(part, DATA_AXIS)
 
-    if bins_fm is None:
-        def fn4(b, nd, gg, hh):
-            return fn(b, nd, gg, hh, None)
-
-        return _shard_map(
-            fn4,
-            mesh=mesh,
-            in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
-            out_specs=P(),
-        )(bins, nodes, g, h)
     return _shard_map(
         fn,
         mesh=mesh,
-        in_specs=(
-            P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
-            P(None, DATA_AXIS),
-        ),
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS))
+        + tuple(spec for _, _, spec in extras),
         out_specs=P(),
-    )(bins, nodes, g, h, bins_fm)
+    )(bins, nodes, g, h, *[a for _, a, _ in extras])

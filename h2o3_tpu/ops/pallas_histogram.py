@@ -111,7 +111,7 @@ def _nm_kernel(bins_ref, node_ref, vals_ref, out_ref, *, n_feat_b, n_bins1, n_no
 def _build_histogram_nodematmul(
     bins, nodes, g, h, n_nodes: int, n_bins1: int,
     row_tile: int, feat_block: int, interpret: bool, vma: tuple,
-    bins_fm=None,
+    bins_fm=None, rw=None,
 ):
     n, n_feat = bins.shape
     r = row_tile
@@ -127,6 +127,8 @@ def _build_histogram_nodematmul(
             nodes = jnp.pad(nodes, (0, pad), constant_values=-1)
             g = jnp.pad(g, (0, pad))
             h = jnp.pad(h, (0, pad))
+            if rw is not None:
+                rw = jnp.pad(rw, (0, pad))
             n = n + pad
         if padf:
             # pad features with bin code 0: sliced away after the reshape below
@@ -134,8 +136,9 @@ def _build_histogram_nodematmul(
         bins_fm = bins.T  # [Fp, N] feature-major: rows land in the lane axis
 
     w = (nodes >= 0).astype(jnp.float32)
+    cw = w if rw is None else w * rw.astype(jnp.float32)
     vals = jnp.stack(
-        [g.astype(jnp.float32) * w, h.astype(jnp.float32) * w, w, jnp.zeros_like(w)],
+        [g.astype(jnp.float32) * w, h.astype(jnp.float32) * w, cw, jnp.zeros_like(w)],
         axis=1,
     )  # [N, C]
 
@@ -207,7 +210,7 @@ def _hist_kernel(node_ref, first_ref, bins_ref, vals_ref, out_ref, *, n_feat, n_
         out_ref[...] = out_ref[...] + slab
 
 
-def _prep_padded(bins, nodes, g, h, n_nodes: int, row_tile: int, t_max: int):
+def _prep_padded(bins, nodes, g, h, n_nodes: int, row_tile: int, t_max: int, rw=None):
     """Sort rows by node, pad each node segment to a row_tile multiple.
 
     Returns (bins_p [T*R, F] int32, vals_p [T*R, C] f32,
@@ -238,8 +241,9 @@ def _prep_padded(bins, nodes, g, h, n_nodes: int, row_tile: int, t_max: int):
         bins[order].astype(jnp.int32), mode="drop"
     )
     w = (nodes >= 0).astype(jnp.float32)
+    cw = w if rw is None else w * rw.astype(jnp.float32)
     vals = jnp.stack(
-        [g.astype(jnp.float32) * w, h.astype(jnp.float32) * w, w,
+        [g.astype(jnp.float32) * w, h.astype(jnp.float32) * w, cw,
          jnp.zeros_like(w)], axis=1
     )
     vals_p = jnp.zeros((total, _C), jnp.float32).at[dest].set(vals[order], mode="drop")
@@ -262,13 +266,14 @@ def _prep_padded(bins, nodes, g, h, n_nodes: int, row_tile: int, t_max: int):
 def build_histogram_pallas(
     bins, nodes, g, h, n_nodes: int, n_bins1: int,
     row_tile: int = 512, interpret: bool = False, vma: tuple = (),
-    kernel: str = "auto", bins_fm=None,
+    kernel: str = "auto", bins_fm=None, rw=None,
 ):
     """Drop-in Pallas replacement for ``histogram._shard_histogram``.
 
     bins: [N, F] int bin codes (NA bucket = n_bins1 - 1 handled upstream);
-    nodes: [N] int32 (-1 = inactive row); g, h: [N] float.
-    Returns [n_nodes, F, n_bins1, 3] float32 of (Σg, Σh, count).
+    nodes: [N] int32 (-1 = inactive row); g, h: [N] float; rw: optional [N]
+    per-row count weight (weights_column -> the count channel reports Σw).
+    Returns [n_nodes, F, n_bins1, 3] float32 of (Σg, Σh, Σw).
     """
     if kernel == "nodematmul" or (
         kernel == "auto" and n_nodes * _C <= _NODE_MATMUL_MAX_KC
@@ -276,14 +281,14 @@ def build_histogram_pallas(
         return _build_histogram_nodematmul(
             bins, nodes, g, h, n_nodes, n_bins1,
             row_tile=row_tile, feat_block=_FEAT_BLOCK, interpret=interpret, vma=vma,
-            bins_fm=bins_fm,
+            bins_fm=bins_fm, rw=rw,
         )
     n, n_feat = bins.shape
     r = row_tile
     t_max = (n + r - 1) // r + n_nodes  # ≤ R-1 pad rows per node
 
     bins_p, vals_p, item_node, item_first = _prep_padded(
-        bins, nodes, g, h, n_nodes, r, t_max
+        bins, nodes, g, h, n_nodes, r, t_max, rw=rw
     )
 
     grid_spec = pltpu.PrefetchScalarGridSpec(

@@ -1,0 +1,341 @@
+"""Tree-algo feature completeness: weights, offset, monotone constraints,
+extra distributions, categorical encodings.
+
+Reference analogues: hex/tree/SharedTree.java weights plumbing,
+hex/tree/gbm/GBM.java monotone path, hex/Distribution.java families,
+hex/DataInfo one-hot (SURVEY.md §2.2). VERDICT r2 item 3."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu import Frame
+from h2o3_tpu.models.tree import DRF, GBM, XGBoost
+
+
+def _reg_frame(rng, n=2000, f=4, extra=None):
+    X = rng.normal(size=(n, f))
+    y = 2.0 * X[:, 0] - X[:, 1] + 0.3 * rng.normal(size=n)
+    d = {f"x{i}": X[:, i] for i in range(f)}
+    d["y"] = y
+    if extra:
+        d.update(extra)
+    return Frame.from_dict(d), X, y
+
+
+# ---------------------------------------------------------------------------
+# weights_column
+
+
+@pytest.mark.parametrize("algo", [GBM, XGBoost])
+def test_integer_weights_equal_row_replication(algo, rng):
+    """A row with weight k must act exactly like k copies of the row
+    (SharedTree weighted Σg/Σh semantics). Discrete feature values so the
+    quantile bin edges partition both frames' rows identically."""
+    n = 600
+    X = rng.integers(0, 8, size=(n, 3)).astype(np.float64)
+    y = X[:, 0] - 0.5 * X[:, 1] + 0.1 * rng.normal(size=n)
+    w = rng.integers(1, 4, size=n).astype(np.float64)
+
+    fr_w = Frame.from_dict(
+        {"x0": X[:, 0], "x1": X[:, 1], "x2": X[:, 2], "y": y, "w": w}
+    )
+    rep = np.repeat(np.arange(n), w.astype(int))
+    fr_rep = Frame.from_dict(
+        {"x0": X[rep, 0], "x1": X[rep, 1], "x2": X[rep, 2], "y": y[rep]}
+    )
+
+    kw = dict(response_column="y", ntrees=5, max_depth=3, seed=7, min_rows=1.0)
+    m_w = algo(weights_column="w", **kw).train(fr_w)
+    m_rep = algo(**kw).train(fr_rep)
+
+    pred_w = m_w.predict(fr_w).col("predict").numeric_view()
+    pred_rep = (
+        m_rep.predict(fr_w[["x0", "x1", "x2"]]).col("predict").numeric_view()
+    )
+    np.testing.assert_allclose(pred_w, pred_rep, rtol=1e-4, atol=1e-5)
+
+
+def test_zero_weight_rows_are_ignored(rng):
+    n = 500
+    X = rng.normal(size=(n, 2))
+    y = X[:, 0] + 0.1 * rng.normal(size=n)
+    # poison half the rows with garbage labels but weight 0
+    y_poisoned = y.copy()
+    poison = rng.random(n) < 0.5
+    y_poisoned[poison] = 1000.0
+    w = np.where(poison, 0.0, 1.0)
+
+    fr = Frame.from_dict({"x0": X[:, 0], "x1": X[:, 1], "y": y_poisoned, "w": w})
+    fr_clean = Frame.from_dict(
+        {"x0": X[~poison, 0], "x1": X[~poison, 1], "y": y[~poison]}
+    )
+    kw = dict(response_column="y", ntrees=5, max_depth=3, seed=3, min_rows=1.0)
+    m = GBM(weights_column="w", **kw).train(fr)
+    m_clean = GBM(**kw).train(fr_clean)
+    grid = fr[["x0", "x1"]]
+    np.testing.assert_allclose(
+        m.predict(grid).col("predict").numeric_view(),
+        m_clean.predict(grid).col("predict").numeric_view(),
+        rtol=1e-4, atol=1e-5,
+    )
+
+
+def test_drf_weights_run_and_beat_garbage(rng):
+    fr, X, y = _reg_frame(rng, n=800, extra={"w": np.ones(800)})
+    m = DRF(response_column="y", weights_column="w", ntrees=10, seed=1).train(fr)
+    assert m.training_metrics.r2 > 0.5
+
+
+# ---------------------------------------------------------------------------
+# offset_column
+
+
+def test_offset_is_baseline_margin(rng):
+    """y = offset + signal: with offset_column the model learns only the
+    signal, and scoring adds the frame's offset back (Model.score)."""
+    n = 1500
+    x = rng.normal(size=n)
+    off = rng.choice([0.0, 5.0], size=n)
+    y = off + 2.0 * x + 0.1 * rng.normal(size=n)
+    fr = Frame.from_dict({"x": x, "off": off, "y": y})
+    m = GBM(
+        response_column="y", offset_column="off",
+        ntrees=20, max_depth=3, seed=5, min_rows=5.0,
+    ).train(fr)
+    pred = m.predict(fr).col("predict").numeric_view()
+    resid = y - pred
+    assert np.sqrt(np.mean(resid**2)) < 0.6
+    # a model that ignored the offset would be off by ~2.5 on half the rows
+    m_no = GBM(response_column="y", ignored_columns=["off"], ntrees=20,
+               max_depth=3, seed=5, min_rows=5.0).train(fr)
+    rmse_no = np.sqrt(
+        np.mean((y - m_no.predict(fr[["x"]]).col("predict").numeric_view()) ** 2)
+    )
+    assert np.sqrt(np.mean(resid**2)) < rmse_no / 2
+
+    # offset column must be present at scoring time
+    with pytest.raises(ValueError, match="offset"):
+        m.predict(fr[["x"]])
+
+
+# ---------------------------------------------------------------------------
+# monotone constraints
+
+
+@pytest.mark.parametrize("algo", [GBM, XGBoost])
+@pytest.mark.parametrize("direction", [1, -1])
+def test_monotone_constraint_property(algo, direction, rng):
+    """Predictions must be monotone in the constrained feature for any
+    fixed values of the others — even when the data is noisy enough that an
+    unconstrained fit is not."""
+    n = 3000
+    x = rng.uniform(-3, 3, size=n)
+    z = rng.normal(size=n)
+    y = direction * x + 0.3 * z + 1.5 * rng.normal(size=n)
+    fr = Frame.from_dict({"x": x, "z": z, "y": y})
+    m = algo(
+        response_column="y",
+        monotone_constraints={"x": direction},
+        ntrees=30, max_depth=4, seed=11, min_rows=5.0,
+    ).train(fr)
+
+    grid_x = np.linspace(-3, 3, 101)
+    for zval in (-1.0, 0.0, 1.0):
+        g = Frame.from_dict({"x": grid_x, "z": np.full_like(grid_x, zval)})
+        p = m.predict(g).col("predict").numeric_view()
+        diffs = direction * np.diff(p)
+        assert (diffs >= -1e-6).all(), (
+            f"monotonicity violated at z={zval}: min step {diffs.min()}"
+        )
+    # the constraint shouldn't destroy the fit
+    assert m.training_metrics.r2 > 0.3
+
+
+def test_monotone_constraint_validation(rng):
+    fr, _, _ = _reg_frame(rng, n=200)
+    with pytest.raises(ValueError, match="not in predictors"):
+        GBM(response_column="y", monotone_constraints={"nope": 1},
+            ntrees=2).train(fr)
+    with pytest.raises(ValueError, match="must be -1, 0 or 1"):
+        GBM(response_column="y", monotone_constraints={"x0": 2},
+            ntrees=2).train(fr)
+
+
+# ---------------------------------------------------------------------------
+# distributions (hex/Distribution.java families)
+
+
+def test_tweedie_deviance_decreases(rng):
+    n = 3000
+    x = rng.normal(size=n)
+    mu = np.exp(0.5 * x)
+    # tweedie-ish: poisson-gamma mixture with exact zeros
+    y = np.where(rng.random(n) < 0.3, 0.0, rng.gamma(2.0, mu / 2.0))
+    fr = Frame.from_dict({"x": x, "y": y})
+    m = GBM(
+        response_column="y", distribution="tweedie", tweedie_power=1.5,
+        ntrees=30, max_depth=3, seed=2, stopping_rounds=0,
+        score_tree_interval=5, min_rows=10.0,
+    ).train(fr)
+    # deviance trace from scoring_history requires stopping_rounds; instead
+    # check fit quality directly: predictions on response scale, positive
+    pred = m.predict(fr).col("predict").numeric_view()
+    assert (pred > 0).all()
+    corr = np.corrcoef(pred, mu)[0, 1]
+    assert corr > 0.7
+
+
+def test_gamma_distribution(rng):
+    n = 3000
+    x = rng.normal(size=n)
+    mu = np.exp(1.0 + 0.7 * x)
+    y = rng.gamma(3.0, mu / 3.0)
+    fr = Frame.from_dict({"x": x, "y": y})
+    m = GBM(response_column="y", distribution="gamma", ntrees=30,
+            max_depth=3, seed=2, min_rows=10.0).train(fr)
+    pred = m.predict(fr).col("predict").numeric_view()
+    assert (pred > 0).all()
+    assert np.corrcoef(np.log(pred), np.log(mu))[0, 1] > 0.85
+
+
+def test_huber_is_robust_to_outliers(rng):
+    n = 2000
+    x = rng.normal(size=n)
+    y = 2.0 * x + 0.2 * rng.normal(size=n)
+    out = rng.random(n) < 0.05
+    y[out] += rng.choice([-1, 1], size=out.sum()) * 50.0
+    fr = Frame.from_dict({"x": x, "y": y})
+    kw = dict(response_column="y", ntrees=30, max_depth=3, seed=4, min_rows=10.0)
+    m_h = GBM(distribution="huber", **kw).train(fr)
+    m_g = GBM(distribution="gaussian", **kw).train(fr)
+    clean = ~out
+    pred_h = m_h.predict(fr).col("predict").numeric_view()
+    pred_g = m_g.predict(fr).col("predict").numeric_view()
+    rmse_h = np.sqrt(np.mean((pred_h[clean] - 2 * x[clean]) ** 2))
+    rmse_g = np.sqrt(np.mean((pred_g[clean] - 2 * x[clean]) ** 2))
+    assert rmse_h < rmse_g
+
+
+def test_quantile_alpha(rng):
+    n = 4000
+    x = rng.normal(size=n)
+    y = x + rng.normal(size=n)
+    fr = Frame.from_dict({"x": x, "y": y})
+    m = GBM(response_column="y", distribution="quantile", quantile_alpha=0.9,
+            ntrees=40, max_depth=3, seed=6, min_rows=20.0).train(fr)
+    frac_below = np.mean(y <= m.predict(fr).col("predict").numeric_view())
+    assert 0.82 < frac_below < 0.97
+
+
+def test_negative_response_rejected_for_log_links(rng):
+    fr = Frame.from_dict({"x": np.arange(10.0), "y": np.linspace(-1, 1, 10)})
+    for dist in ("poisson", "gamma"):
+        with pytest.raises(ValueError, match="non-negative"):
+            GBM(response_column="y", distribution=dist, ntrees=2).train(fr)
+
+
+# ---------------------------------------------------------------------------
+# categorical_encoding
+
+
+def test_one_hot_explicit_isolates_levels(rng):
+    """A target depending on a single mid-domain level is hard for ordinal
+    splits (needs 2 cuts) but trivial for one-hot (1 cut)."""
+    n = 3000
+    levels = np.array(["a", "b", "c", "d", "e"])
+    codes = rng.integers(0, 5, size=n)
+    y = (codes == 2).astype(np.float64) * 3.0 + 0.1 * rng.normal(size=n)
+    fr = Frame.from_dict({"cat": levels[codes], "y": y})
+    m = GBM(
+        response_column="y", categorical_encoding="one_hot_explicit",
+        ntrees=40, learn_rate=0.3, max_depth=2, seed=9, min_rows=10.0,
+    ).train(fr)
+    assert m.training_metrics.r2 > 0.95
+    vi = m.variable_importances()
+    assert "cat.c" in vi  # expanded names
+    assert vi["cat.c"] == max(vi.values())
+
+    # mojo round-trip respects the encoding
+    import os
+    import tempfile
+
+    from h2o3_tpu.genmodel import load_mojo
+    from h2o3_tpu.models.mojo_export import write_mojo
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "m.mojo")
+        write_mojo(m, path)
+        mm = load_mojo(path)
+        scored = mm.score({"cat": levels[codes[:50]].tolist()})
+        np.testing.assert_allclose(
+            scored, m.predict(fr[["cat"]]).col("predict").numeric_view()[:50],
+            rtol=1e-5, atol=1e-6,
+        )
+
+
+def test_bad_categorical_encoding_rejected(rng):
+    fr, _, _ = _reg_frame(rng, n=100)
+    with pytest.raises(ValueError, match="categorical_encoding"):
+        GBM(response_column="y", categorical_encoding="eigen", ntrees=2).train(fr)
+
+
+# ---------------------------------------------------------------------------
+# review follow-ups: weighted min_rows, monotone validation, MOJO offset
+
+
+def test_min_rows_uses_weighted_counts(rng):
+    """min_rows compares against the weighted observation count (DHistogram
+    Σw): tiny-weight rows must not satisfy it by headcount alone."""
+    n = 60
+    x = np.r_[np.zeros(n // 2), np.ones(n // 2)]
+    y = x * 10.0
+    w = np.full(n, 0.1)
+    fr = Frame.from_dict({"x": x, "y": y, "w": w})
+    # each side has 30 rows but Σw = 3 < min_rows=4: the root must not split
+    m = GBM(response_column="y", weights_column="w", ntrees=1, max_depth=2,
+            learn_rate=1.0, min_rows=4.0, seed=1).train(fr)
+    p = m.predict(fr[["x"]]).col("predict").numeric_view()
+    assert np.allclose(p, p[0]), "tiny-weight rows satisfied min_rows by headcount"
+    # same data with weight 1.0 rows: Σw = 30 >= 4, split happens
+    fr2 = Frame.from_dict({"x": x, "y": y, "w": np.ones(n)})
+    m2 = GBM(response_column="y", weights_column="w", ntrees=1, max_depth=2,
+             learn_rate=1.0, min_rows=4.0, seed=1).train(fr2)
+    p2 = m2.predict(fr2[["x"]]).col("predict").numeric_view()
+    assert not np.allclose(p2, p2[0])
+
+
+def test_monotone_multinomial_rejected(rng):
+    n = 300
+    fr = Frame.from_dict({
+        "x": rng.normal(size=n),
+        "y": np.array(["a", "b", "c"])[rng.integers(0, 3, n)],
+    })
+    with pytest.raises(ValueError, match="multinomial"):
+        GBM(response_column="y", monotone_constraints={"x": 1}, ntrees=2).train(fr)
+
+
+def test_mojo_offset_parity(rng):
+    import os
+    import tempfile
+
+    from h2o3_tpu.genmodel import load_mojo
+    from h2o3_tpu.models.mojo_export import write_mojo
+
+    n = 800
+    x = rng.normal(size=n)
+    off = rng.choice([0.0, 3.0], size=n)
+    y = off + x + 0.1 * rng.normal(size=n)
+    fr = Frame.from_dict({"x": x, "off": off, "y": y})
+    m = GBM(response_column="y", offset_column="off", ntrees=10,
+            max_depth=3, seed=8, min_rows=5.0).train(fr)
+    want = m.predict(fr).col("predict").numeric_view()
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "m.mojo")
+        write_mojo(m, path)
+        mm = load_mojo(path)
+        got = mm.score({"x": x, "off": off})
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+        # missing offset column must raise, not silently shift
+        with pytest.raises(ValueError, match="off"):
+            mm.score({"x": x[:5]})
