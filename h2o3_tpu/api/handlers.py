@@ -526,7 +526,12 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
         if algo not in algos:
             raise RestError(404, f"unknown algo {algo!r}")
         bcls, pcls = algos[algo]
-        fr = _get_frame(params.get("training_frame", ""))
+        # generic "trains" from an artifact, not a frame (hex/generic)
+        fr = (
+            _get_frame(params.get("training_frame", ""))
+            if algo != "generic"
+            else None
+        )
         valid = (
             _get_frame(params["validation_frame"])
             if params.get("validation_frame")
@@ -541,9 +546,7 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
         except Exception as e:
             raise RestError(400, f"{algo} train failed: {type(e).__name__}: {e}")
         if params.get("model_id"):
-            DKV.remove(model.key)
-            model.key = params["model_id"]
-            DKV.put(model.key, model)
+            DKV.rekey(model, params["model_id"])
         job = builder.job  # ModelBuilder.train always creates one
         if job is None:  # defensive: synthesize a finished job
             job = Job(f"{algo} train").start()
@@ -607,11 +610,100 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
             pass  # frames without a response can still be scored
         return out
 
+    # ---- binary persistence (Model.exportBinaryModel / importBinaryModel,
+    # /3/Models/.../save + /99/Models.bin; FramePersist save/load) ----------
+    def _server_path(params, default_name: str) -> str:
+        """'dir' is a target DIRECTORY (the h2o-py save_model contract) —
+        created if missing — unless it names a file explicitly via a known
+        artifact extension."""
+        d = params.get("dir")
+        if not d:
+            raise RestError(400, "missing 'dir' (server-side target path)")
+        d = os.path.expanduser(d)
+        if os.path.splitext(d)[1] in (".bin", ".h2f", ".mojo", ".zip"):
+            os.makedirs(os.path.dirname(d) or ".", exist_ok=True)
+            return d
+        os.makedirs(d, exist_ok=True)
+        return os.path.join(d, default_name)
+
+    def model_save(params, model_id):
+        from h2o3_tpu.models.persist import save_model as _save_model
+
+        m = _get_model(model_id)
+        path = _server_path(params, f"{model_id}.bin")
+        force = str(params.get("force", "true")).lower() in ("true", "1", "yes")
+        if os.path.exists(path) and not force:
+            raise RestError(409, f"{path} exists and force is false")
+        return {"dir": _save_model(m, path)}
+
+    def model_load(params):
+        from h2o3_tpu.models.persist import load_model as _load_model
+
+        d = params.get("dir")
+        if not d:
+            raise RestError(400, "missing 'dir' (server-side model file)")
+        try:
+            # key override goes through load_model itself so the file's
+            # saved key is never touched (no clobbering a live model)
+            m = _load_model(os.path.expanduser(d), key=params.get("model_id"))
+        except FileNotFoundError:
+            raise RestError(404, f"no model file at {d!r}")
+        except Exception as e:
+            raise RestError(400, f"model load failed: {type(e).__name__}: {e}")
+        return {"models": [{"model_id": {"name": m.key}, "algo": m.algo_name}]}
+
+    def frame_save(params, frame_id):
+        from h2o3_tpu.frame.persist import save_frame as _save_frame
+
+        fr = _get_frame(frame_id)
+        path = _server_path(params, f"{frame_id}.h2f")
+        return {"dir": _save_frame(fr, path)}
+
+    def frame_load(params):
+        from h2o3_tpu.frame.persist import load_frame as _load_frame
+
+        d = params.get("dir")
+        if not d:
+            raise RestError(400, "missing 'dir' (server-side frame file)")
+        try:
+            fr = _load_frame(os.path.expanduser(d))
+        except FileNotFoundError:
+            raise RestError(404, f"no frame file at {d!r}")
+        key = params.get("frame_id") or fr.key or DKV.make_key("frame")
+        fr.key = key
+        DKV.put(key, fr)
+        return {"frames": [{"frame_id": {"name": key}, "rows": fr.nrows,
+                            "num_columns": fr.ncols}]}
+
+    def mojo_import(params):
+        """Import a MOJO archive as a servable Generic model (hex/generic)."""
+        from h2o3_tpu.models.generic import import_mojo as _import_mojo
+
+        path = params.get("dir") or params.get("path")
+        if not path:
+            raise RestError(400, "missing 'dir' (server-side mojo path)")
+        try:
+            m = _import_mojo(os.path.expanduser(path), params.get("model_id"))
+        except FileNotFoundError:
+            raise RestError(404, f"no mojo at {path!r}")
+        except Exception as e:
+            raise RestError(400, f"mojo import failed: {type(e).__name__}: {e}")
+        return {"models": [{"model_id": {"name": m.key}, "algo": m.algo_name,
+                            "source_algo": m.source_algo}]}
+
     r.register("GET", "/3/Models", models_list, "list models")
     r.register("GET", "/3/Models/{model_id}", model_get, "model details")
     r.register("DELETE", "/3/Models/{model_id}", model_delete, "delete model")
     r.register("DELETE", "/3/Models", models_delete_all, "delete all models")
     r.register("GET", "/3/Models/{model_id}/mojo", model_mojo, "download mojo")
+    r.register("POST", "/3/Models/{model_id}/save", model_save,
+               "save model binary server-side")
+    r.register("POST", "/99/Models.bin", model_load, "load model binary")
+    r.register("POST", "/3/Frames/{frame_id}/save", frame_save,
+               "save frame server-side")
+    r.register("POST", "/3/Frames/load", frame_load, "load a saved frame")
+    r.register("POST", "/99/Models.mojo", mojo_import,
+               "import a MOJO as a Generic model")
     r.register(
         "POST", "/3/Predictions/models/{model_id}/frames/{frame_id}", predict,
         "score a frame",

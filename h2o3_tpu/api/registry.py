@@ -19,6 +19,7 @@ def algo_map() -> Dict[str, Tuple[type, type]]:
         ExtendedIsolationForestParameters,
     )
     from h2o3_tpu.models.gam import GAM, GAMParameters
+    from h2o3_tpu.models.generic import Generic, GenericParameters
     from h2o3_tpu.models.glm import GLM, GLMParameters
     from h2o3_tpu.models.glrm import GLRM, GLRMParameters
     from h2o3_tpu.models.isolation_forest import (
@@ -63,6 +64,7 @@ def algo_map() -> Dict[str, Tuple[type, type]]:
         "psvm": (PSVM, PSVMParameters),
         "gam": (GAM, GAMParameters),
         "rulefit": (RuleFit, RuleFitParameters),
+        "generic": (Generic, GenericParameters),
         # extensions
         "xgboost": (XGBoost, XGBoostParameters),
         "targetencoder": (TargetEncoder, TargetEncoderParameters),

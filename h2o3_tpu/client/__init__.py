@@ -156,6 +156,50 @@ def remove_all() -> None:
     c.request("DELETE /3/Models")
 
 
+def save_model(model_or_id, dir: str, force: bool = True) -> str:
+    """h2o.save_model: binary model export server-side; returns the path."""
+    model_id = getattr(model_or_id, "model_id", model_or_id)
+    out = connection().request(
+        f"POST /3/Models/{model_id}/save", {"dir": dir, "force": str(force).lower()}
+    )
+    return out["dir"]
+
+
+def load_model(path: str):
+    """h2o.load_model: load a binary model file server-side."""
+    from h2o3_tpu.client.estimators import H2OModel
+
+    out = connection().request("POST /99/Models.bin", {"dir": path})
+    return H2OModel(connection(), out["models"][0]["model_id"]["name"])
+
+
+def import_mojo(path: str, model_id: Optional[str] = None):
+    """h2o.import_mojo: import a MOJO archive as a servable Generic model."""
+    from h2o3_tpu.client.estimators import H2OModel
+
+    params = {"dir": path}
+    if model_id:
+        params["model_id"] = model_id
+    out = connection().request("POST /99/Models.mojo", params)
+    return H2OModel(connection(), out["models"][0]["model_id"]["name"])
+
+
+def save_frame(frame_or_id, dir: str) -> str:
+    """h2o.save_frame analogue (water/fvec/persist/FramePersist)."""
+    frame_id = getattr(frame_or_id, "frame_id", frame_or_id)
+    out = connection().request(f"POST /3/Frames/{frame_id}/save", {"dir": dir})
+    return out["dir"]
+
+
+def load_frame(path: str, frame_id: Optional[str] = None) -> "H2OFrame":
+    """h2o.load_frame analogue: load a saved frame file server-side."""
+    params = {"dir": path}
+    if frame_id:
+        params["frame_id"] = frame_id
+    out = connection().request("POST /3/Frames/load", params)
+    return get_frame(out["frames"][0]["frame_id"]["name"])
+
+
 def rapids(ast: str) -> Dict[str, Any]:
     c = connection()
     return c.request(

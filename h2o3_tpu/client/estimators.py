@@ -80,7 +80,11 @@ class H2OModel:
         return out["model_metrics"][0]
 
     def download_mojo(self, path: str) -> str:
+        import os
+
         raw = self._conn.request(f"GET /3/Models/{self.model_id}/mojo", raw=True)
+        if os.path.isdir(path):  # h2o-py accepts a target directory
+            path = os.path.join(path, f"{self.model_id}.mojo")
         with open(path, "wb") as f:
             f.write(raw)
         return path

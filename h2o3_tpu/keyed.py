@@ -45,6 +45,21 @@ class KeyedStore:
         with self._lock:
             self._store.pop(key, None)
 
+    def rekey(self, obj: Any, new_key: str) -> str:
+        """Re-register ``obj`` (which carries a ``.key`` attribute) under
+        ``new_key``. The old registration is dropped only if it still points
+        at ``obj`` — renaming never destroys an unrelated live object that
+        happens to share the old key."""
+        with self._lock:
+            old = getattr(obj, "key", None)
+            if old and self._store.get(old) is obj:
+                self._store.pop(old, None)
+            obj.key = new_key
+            self._store[new_key] = obj
+            if self._scopes:
+                self._scopes[-1].append(new_key)
+        return new_key
+
     def keys(self) -> List[str]:
         with self._lock:
             return list(self._store.keys())

@@ -1,0 +1,113 @@
+"""Generic model — import a scoring artifact (MOJO) back as a first-class
+servable model.
+
+Reference: ``h2o-algos/src/main/java/hex/generic/`` — ``Generic`` is a
+ModelBuilder whose "training" is reading a MOJO; the resulting
+``GenericModel`` scores through the embedded MojoModel and is otherwise a
+normal in-cluster model (predict routes, metrics on demand, DKV key).
+
+TPU-native: the embedded scorer is the numpy-only ``h2o3_tpu.genmodel``
+MojoModel; batch scoring feeds it whole columns, so imported models score
+vectorized like native ones (the reference's row-wise EasyPredict wrapper
+is for streaming use).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from h2o3_tpu.frame.frame import ColType, Frame
+from h2o3_tpu.keyed import DKV
+from h2o3_tpu.models.data_info import DataInfo
+from h2o3_tpu.models.framework import Job, Model, ModelBuilder, ModelParameters
+
+
+@dataclass
+class GenericParameters(ModelParameters):
+    #: server-side path of the MOJO archive to import (hex/generic's
+    #: GenericModelParameters._path / model_key upload)
+    path: Optional[str] = None
+
+
+class GenericModel(Model):
+    algo_name = "generic"
+
+    def __init__(self, params: GenericParameters, data_info: DataInfo, mojo) -> None:
+        super().__init__(params, data_info)
+        self.mojo = mojo
+
+    @property
+    def source_algo(self) -> str:
+        return self.mojo.meta.get("algo", "?")
+
+    def _predict_raw(self, frame: Frame) -> np.ndarray:
+        # feed the MojoModel whole columns (it reads only what it needs:
+        # predictors + an optional offset column)
+        data = {}
+        for col in frame.columns:
+            if col.type is ColType.CAT:
+                data[col.name] = [
+                    col.domain[v] if v >= 0 else None for v in col.data
+                ]
+            elif col.type is ColType.STR:
+                data[col.name] = list(col.data)
+            else:
+                data[col.name] = col.numeric_view()
+        return self.mojo.score(data)
+
+    def variable_importances(self) -> dict:
+        raise NotImplementedError("imported MOJOs carry no variable importances")
+
+
+class Generic(ModelBuilder):
+    """hex/generic/Generic.java — "training" = loading the artifact."""
+
+    algo_name = "generic"
+
+    def __init__(self, params: Optional[GenericParameters] = None, **kw) -> None:
+        super().__init__(params or GenericParameters(**kw))
+
+    def train(self, frame: Optional[Frame] = None, valid: Optional[Frame] = None) -> GenericModel:
+        # no training frame: the artifact defines the layout
+        self.job = Job("generic import").start()
+        try:
+            model = self._fit(frame, valid)
+            self.job.done()
+            return model
+        except BaseException as e:
+            self.job.fail(e)
+            raise
+
+    def _fit(self, frame: Optional[Frame] = None, valid: Optional[Frame] = None) -> GenericModel:
+        p: GenericParameters = self.params
+        if not p.path:
+            raise ValueError("generic import requires `path` to a MOJO archive")
+        from h2o3_tpu.genmodel import load_mojo
+
+        mojo = load_mojo(p.path)
+        lay = mojo.layout
+        info = DataInfo(
+            predictor_names=list(lay.predictor_names),
+            response_name=lay.response_name,
+            use_all_factor_levels=lay.use_all_factor_levels,
+            standardize=lay.standardize,
+            missing_values_handling=lay.missing_values_handling,
+            num_means=dict(lay.num_means),
+            num_sds=dict(lay.num_sds),
+            cat_domains={k: list(v) for k, v in lay.cat_domains.items()},
+            cat_mode=dict(lay.cat_mode),
+            coef_names=list(lay.coef_names),
+            response_domain=list(lay.response_domain) if lay.response_domain else None,
+        )
+        return GenericModel(p, info, mojo)
+
+
+def import_mojo(path: str, model_id: Optional[str] = None) -> GenericModel:
+    """h2o.import_mojo analogue: MOJO file -> servable Generic model."""
+    model = Generic(path=path).train()
+    if model_id:
+        DKV.rekey(model, model_id)
+    return model

@@ -187,8 +187,12 @@ def save_model(model, path: Union[str, os.PathLike]) -> str:
     return path
 
 
-def load_model(path: Union[str, os.PathLike]):
-    """Load a model written by ``save_model`` and register it in the DKV."""
+def load_model(path: Union[str, os.PathLike], key: Optional[str] = None):
+    """Load a model written by ``save_model`` and register it in the DKV.
+
+    key: register under this key instead of the file's saved key — the saved
+    key is then left untouched, so restoring a snapshot under a new id never
+    clobbers a live model that happens to share the original key."""
     from h2o3_tpu.keyed import DKV
 
     path = os.fspath(path)
@@ -199,6 +203,9 @@ def load_model(path: Union[str, os.PathLike]):
         tree = json.loads(z.read("model.json"))
         arrays = np.load(io.BytesIO(z.read("arrays.npz")), allow_pickle=False)
         model = _Decoder(arrays).dec(tree)
-    if getattr(model, "key", None):
+    if key:
+        model.key = key
+        DKV.put(key, model)
+    elif getattr(model, "key", None):
         DKV.put(model.key, model)
     return model

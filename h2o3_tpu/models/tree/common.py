@@ -166,7 +166,12 @@ def resolve_objective(distribution: str, params, y: np.ndarray) -> str:
     family parameter in (``hex/Distribution.java``'s per-family params).
     huber: delta is the huber_alpha quantile of |y - median(y)| residuals
     (the reference re-estimates it per iteration; fixed-at-init here)."""
-    if distribution in ("gamma", "poisson", "tweedie"):
+    if distribution == "gamma":
+        # gamma deviance needs strictly positive y (zero rows give ~0
+        # hessians and exploding leaves; the reference validates this too)
+        if np.nanmin(y) <= 0:
+            raise ValueError("gamma requires a strictly positive response")
+    elif distribution in ("poisson", "tweedie"):
         if np.nanmin(y) < 0:
             raise ValueError(f"{distribution} requires a non-negative response")
     if distribution == "tweedie":

@@ -159,3 +159,42 @@ class TestClientReviewFixes:
         small = h2o.upload_csv("v\n1\n2\n3\n")
         assert small.head().nrows == 3      # default 10 > 3: clamped
         assert small[0:100].nrows == 3      # oversized slice clamped
+
+
+class TestClientPersistence:
+    """h2o.save_model / load_model / import_mojo / save_frame / load_frame."""
+
+    def test_binary_model_roundtrip(self, iris, tmp_path):
+        est = h2o.H2OGradientBoostingEstimator(ntrees=5, max_depth=3, seed=1)
+        model = est.train(y="species", training_frame=iris)
+        before = model.predict(iris).get_frame_data()
+
+        path = h2o.save_model(model, str(tmp_path) + "/")
+        h2o.remove(model.model_id)
+        loaded = h2o.load_model(path)
+        assert loaded.model_id == model.model_id
+        after = loaded.predict(iris).get_frame_data()
+        assert before == after
+
+    def test_mojo_import_roundtrip(self, iris, tmp_path):
+        est = h2o.H2OGradientBoostingEstimator(ntrees=5, max_depth=3, seed=2)
+        model = est.train(y="species", training_frame=iris)
+        mojo_path = model.download_mojo(str(tmp_path))
+        generic = h2o.import_mojo(mojo_path)
+        assert generic.algo == "generic"
+        a = model.predict(iris).get_frame_data()
+        b = generic.predict(iris).get_frame_data()
+        # probabilities match exactly; the label column may differ where p is
+        # near the cut (the source model scores with its trained max-F1
+        # threshold, the imported model with the default 0.5 — as in the
+        # reference's Generic)
+        np.testing.assert_allclose(
+            np.asarray(a["pvirginica"], float),
+            np.asarray(b["pvirginica"], float), rtol=1e-6,
+        )
+
+    def test_frame_roundtrip(self, iris, tmp_path):
+        path = h2o.save_frame(iris, str(tmp_path) + "/")
+        loaded = h2o.load_frame(path, frame_id="iris_reloaded")
+        assert loaded.dim == iris.dim
+        assert loaded.names == iris.names

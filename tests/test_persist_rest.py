@@ -1,0 +1,209 @@
+"""REST binary persistence + Generic (MOJO import) — VERDICT r2 item 4.
+
+Reference: Model.exportBinaryModel / importBinaryModel behind
+``/3/Models/{id}/save`` + ``/99/Models.bin``, FramePersist save/load, and
+``hex/generic/`` (MOJO -> first-class servable model). All over real HTTP.
+"""
+
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.api import start_server
+
+CSV = "x0,x1,y\n" + "\n".join(
+    f"{a:.3f},{b:.3f},{'yes' if a + b > 0 else 'no'}"
+    for a, b in np.random.default_rng(5).normal(size=(300, 2))
+)
+
+
+@pytest.fixture(scope="module")
+def server():
+    s = start_server(port=0)
+    yield s
+    s.stop()
+
+
+def _req(server, method, path, data=None):
+    url = server.url + path
+    body = None
+    headers = {}
+    if data is not None:
+        body = json.dumps(data).encode()
+        headers["Content-Type"] = "application/json"
+    req = urllib.request.Request(url, data=body, headers=headers, method=method)
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _upload_and_parse(server, csv, dest):
+    st, up = _req(server, "POST", "/3/PostFile", {"data": csv})
+    assert st == 200
+    st, out = _req(
+        server, "POST", "/3/Parse",
+        {"source_frames": [up["destination_frame"]], "destination_frame": dest},
+    )
+    assert st == 200, out
+    return out["destination_frame"]["name"]
+
+
+def _train_gbm(server, frame_id, model_id):
+    st, out = _req(
+        server, "POST", "/3/ModelBuilders/gbm",
+        {"training_frame": frame_id, "response_column": "y", "ntrees": 5,
+         "max_depth": 3, "seed": 42, "min_rows": 5, "model_id": model_id},
+    )
+    assert st == 200, out
+    return out["model_id"]["name"]
+
+
+def _predictions(server, model_id, frame_id):
+    st, out = _req(
+        server, "POST", f"/3/Predictions/models/{model_id}/frames/{frame_id}", {}
+    )
+    assert st == 200, out
+    pred_id = out["model_metrics"][0]["predictions_frame"]["name"]
+    st, fr = _req(server, "GET", f"/3/Frames/{pred_id}?row_count=300")
+    assert st == 200
+    return fr
+
+
+class TestBinaryModelPersistOverRest:
+    def test_save_restart_load_predict_parity(self, server, tmp_path):
+        fid = _upload_and_parse(server, CSV, "persist_train")
+        mid = _train_gbm(server, fid, "gbm_persist")
+        before = _predictions(server, mid, fid)
+
+        st, out = _req(server, "POST", f"/3/Models/{mid}/save",
+                       {"dir": str(tmp_path) + os.sep})
+        assert st == 200, out
+        path = out["dir"]
+        assert os.path.exists(path)
+
+        # simulate restart: remove the model from the DKV entirely
+        st, _ = _req(server, "DELETE", f"/3/Models/{mid}")
+        assert st == 200
+        st, out = _req(server, "GET", f"/3/Models/{mid}")
+        assert st == 404
+
+        st, out = _req(server, "POST", "/99/Models.bin", {"dir": path})
+        assert st == 200, out
+        assert out["models"][0]["model_id"]["name"] == mid
+
+        after = _predictions(server, mid, fid)
+        # exact value parity (the prediction frame key itself is random)
+        b = {c["label"]: c["data"] for c in before["frames"][0]["columns"]}
+        a = {c["label"]: c["data"] for c in after["frames"][0]["columns"]}
+        assert b == a
+
+    def test_save_missing_dir_is_400(self, server):
+        fid = _upload_and_parse(server, CSV, "persist_train2")
+        mid = _train_gbm(server, fid, "gbm_persist2")
+        st, out = _req(server, "POST", f"/3/Models/{mid}/save", {})
+        assert st == 400
+
+    def test_load_missing_file_is_404(self, server):
+        st, out = _req(server, "POST", "/99/Models.bin",
+                       {"dir": "/nonexistent/m.bin"})
+        assert st == 404
+
+
+class TestFramePersistOverRest:
+    def test_frame_save_load_roundtrip(self, server, tmp_path):
+        fid = _upload_and_parse(server, CSV, "fp_frame")
+        st, before = _req(server, "GET", f"/3/Frames/{fid}")
+        assert st == 200
+
+        st, out = _req(server, "POST", f"/3/Frames/{fid}/save",
+                       {"dir": str(tmp_path) + os.sep})
+        assert st == 200, out
+        path = out["dir"]
+
+        st, _ = _req(server, "DELETE", f"/3/Frames/{fid}")
+        assert st == 200
+
+        st, out = _req(server, "POST", "/3/Frames/load",
+                       {"dir": path, "frame_id": fid})
+        assert st == 200, out
+        st, after = _req(server, "GET", f"/3/Frames/{fid}")
+        assert st == 200
+        assert before["frames"][0]["rows"] == after["frames"][0]["rows"]
+        assert before["frames"][0]["columns"] == after["frames"][0]["columns"]
+
+
+class TestGenericMojoImport:
+    def test_mojo_roundtrip_over_http(self, server, tmp_path):
+        """train -> download mojo -> import as Generic -> predict parity."""
+        fid = _upload_and_parse(server, CSV, "mojo_train")
+        mid = _train_gbm(server, fid, "gbm_mojo_src")
+        before = _predictions(server, mid, fid)
+
+        # download the mojo archive over HTTP
+        url = server.url + f"/3/Models/{mid}/mojo"
+        with urllib.request.urlopen(url) as resp:
+            blob = resp.read()
+        mojo_path = tmp_path / "m.mojo"
+        mojo_path.write_bytes(blob)
+
+        st, out = _req(server, "POST", "/99/Models.mojo",
+                       {"dir": str(mojo_path), "model_id": "generic_1"})
+        assert st == 200, out
+        assert out["models"][0]["algo"] == "generic"
+        assert out["models"][0]["source_algo"] == "gbm"
+
+        after = _predictions(server, "generic_1", fid)
+        # same probabilities (labels may use a default threshold)
+        b = {c["label"]: c["data"] for c in before["frames"][0]["columns"]}
+        a = {c["label"]: c["data"] for c in after["frames"][0]["columns"]}
+        for col in ("pyes", "pno"):
+            np.testing.assert_allclose(a[col], b[col], rtol=1e-5, atol=1e-6)
+
+    def test_generic_via_modelbuilders_route(self, server, tmp_path):
+        """hex/generic registers as an algo: POST /3/ModelBuilders/generic."""
+        fid = _upload_and_parse(server, CSV, "mojo_train3")
+        mid = _train_gbm(server, fid, "gbm_mojo_src3")
+        url = server.url + f"/3/Models/{mid}/mojo"
+        with urllib.request.urlopen(url) as resp:
+            blob = resp.read()
+        mojo_path = tmp_path / "m3.mojo"
+        mojo_path.write_bytes(blob)
+
+        st, out = _req(server, "POST", "/3/ModelBuilders/generic",
+                       {"path": str(mojo_path)})
+        assert st == 200, out
+        gid = out["model_id"]["name"]
+        st, out = _req(server, "GET", f"/3/Models/{gid}")
+        assert st == 200
+
+    def test_import_missing_mojo_is_404(self, server):
+        st, out = _req(server, "POST", "/99/Models.mojo",
+                       {"dir": "/nonexistent/m.mojo"})
+        assert st == 404
+
+
+class TestLoadDoesNotClobber:
+    def test_load_with_new_id_keeps_live_model(self, server, tmp_path):
+        """Restoring a snapshot under a NEW id must not destroy the live
+        model still registered under the file's saved key."""
+        fid = _upload_and_parse(server, CSV, "clobber_train")
+        mid = _train_gbm(server, fid, "gbm_live")
+        st, out = _req(server, "POST", f"/3/Models/{mid}/save",
+                       {"dir": str(tmp_path)})
+        assert st == 200
+        path = out["dir"]
+
+        st, out = _req(server, "POST", "/99/Models.bin",
+                       {"dir": path, "model_id": "gbm_copy"})
+        assert st == 200, out
+        assert out["models"][0]["model_id"]["name"] == "gbm_copy"
+        # the original stays live and scorable
+        st, _ = _req(server, "GET", f"/3/Models/{mid}")
+        assert st == 200
+        _predictions(server, mid, fid)
+        _predictions(server, "gbm_copy", fid)

@@ -229,9 +229,13 @@ def test_quantile_alpha(rng):
 
 def test_negative_response_rejected_for_log_links(rng):
     fr = Frame.from_dict({"x": np.arange(10.0), "y": np.linspace(-1, 1, 10)})
-    for dist in ("poisson", "gamma"):
-        with pytest.raises(ValueError, match="non-negative"):
+    for dist in ("poisson", "gamma", "tweedie"):
+        with pytest.raises(ValueError, match="negative|positive"):
             GBM(response_column="y", distribution=dist, ntrees=2).train(fr)
+    # gamma additionally rejects zeros (near-zero hessians explode leaves)
+    fr0 = Frame.from_dict({"x": np.arange(10.0), "y": np.r_[0.0, np.ones(9)]})
+    with pytest.raises(ValueError, match="strictly positive"):
+        GBM(response_column="y", distribution="gamma", ntrees=2).train(fr0)
 
 
 # ---------------------------------------------------------------------------
