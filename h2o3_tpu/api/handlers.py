@@ -643,13 +643,20 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
         if not d:
             raise RestError(400, "missing 'dir' (server-side model file)")
         try:
-            # key override goes through load_model itself so the file's
-            # saved key is never touched (no clobbering a live model)
-            m = _load_model(os.path.expanduser(d), key=params.get("model_id"))
+            # decode without touching the DKV so a non-model file (e.g. a
+            # grid export) can be rejected with no side effects
+            m = _load_model(os.path.expanduser(d), register=False)
         except FileNotFoundError:
             raise RestError(404, f"no model file at {d!r}")
         except Exception as e:
             raise RestError(400, f"model load failed: {type(e).__name__}: {e}")
+        if not isinstance(m, Model):
+            raise RestError(400, f"{d!r} is not a model export")
+        if params.get("model_id"):
+            # new key only — the file's saved key stays untouched so a live
+            # model sharing it is never clobbered
+            m.key = params["model_id"]
+        DKV.put(m.key, m)
         return {"models": [{"model_id": {"name": m.key}, "algo": m.algo_name}]}
 
     def frame_save(params, frame_id):
@@ -755,9 +762,31 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
             "failure_details": [msg for _, msg in gs.failures],
         }
 
+    def grid_export(params, grid_id):
+        """export_grid (hex/grid Grid.exportBinary): pickle-free archive."""
+        g = DKV.get(grid_id)
+        if not isinstance(g, Grid):
+            raise RestError(404, f"grid {grid_id!r} not found")
+        path = _server_path(params, f"{grid_id}.bin")
+        return {"dir": g.save(path)}
+
+    def grid_import(params):
+        d = params.get("dir")
+        if not d:
+            raise RestError(400, "missing 'dir' (server-side grid file)")
+        try:
+            g = Grid.load(os.path.expanduser(d))
+        except FileNotFoundError:
+            raise RestError(404, f"no grid file at {d!r}")
+        except Exception as e:
+            raise RestError(400, f"grid import failed: {type(e).__name__}: {e}")
+        return {"grid_id": {"name": g.grid_id}, "model_ids": g.model_ids}
+
     r.register("POST", "/99/Grid/{algo}", grid_train, "grid search")
     r.register("GET", "/99/Grids", grids_list, "list grids")
     r.register("GET", "/99/Grids/{grid_id}", grid_get, "grid details")
+    r.register("POST", "/99/Grids/{grid_id}/export", grid_export, "export grid")
+    r.register("POST", "/99/Grids/import", grid_import, "import grid")
 
     # ---- automl (h2o-automl REST: /99/AutoMLBuilder, leaderboard) ---------
     def automl_build(params):

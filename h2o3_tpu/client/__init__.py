@@ -156,8 +156,9 @@ def remove_all() -> None:
     c.request("DELETE /3/Models")
 
 
-def save_model(model_or_id, dir: str, force: bool = True) -> str:
-    """h2o.save_model: binary model export server-side; returns the path."""
+def save_model(model_or_id, dir: str, force: bool = False) -> str:
+    """h2o.save_model: binary model export server-side; returns the path.
+    force=False (the h2o-py default) refuses to overwrite an existing file."""
     model_id = getattr(model_or_id, "model_id", model_or_id)
     out = connection().request(
         f"POST /3/Models/{model_id}/save", {"dir": dir, "force": str(force).lower()}

@@ -25,6 +25,23 @@ RowLike = Union[Dict[str, Any], Sequence[Any]]
 # h2o3_tpu/models/data_info.py:expand_matrix — kept in sync by parity tests)
 
 
+class _Columns:
+    """Column-major batch input: a dict of equal-length column arrays.
+
+    The batch scoring fast path — ``_Layout._columns`` converts each column
+    in one vectorized pass instead of materializing N per-row dicts."""
+
+    def __init__(self, data: Dict[str, Any]) -> None:
+        self._data = data
+        self._n = len(next(iter(data.values()))) if data else 0
+
+    def __len__(self) -> int:
+        return self._n
+
+    def column(self, name: str):
+        return self._data.get(name)
+
+
 class _Layout:
     def __init__(self, info: Dict[str, Any]) -> None:
         self.predictor_names: List[str] = info["predictor_names"]
@@ -39,8 +56,48 @@ class _Layout:
         self.coef_names: List[str] = info.get("coef_names", [])
         self.response_domain: Optional[List[str]] = info.get("response_domain")
 
-    def _columns(self, rows: List[Dict[str, Any]]):
-        """Per-predictor raw columns: float array (num) or int codes (cat)."""
+    def _columns(self, rows):
+        """Per-predictor raw columns: float array (num) or int codes (cat).
+        Accepts a list of row dicts (streaming path) or a _Columns column
+        dict (batch path — one vectorized pass per column, no per-row
+        Python objects)."""
+        if isinstance(rows, _Columns):
+            out = {}
+            for name in self.predictor_names:
+                v = rows.column(name)
+                if v is None:
+                    out[name] = (
+                        np.full(len(rows), -1, np.int64)
+                        if name in self.cat_domains
+                        else np.full(len(rows), np.nan)
+                    )
+                elif name in self.cat_domains:
+                    index = {lv: i for i, lv in enumerate(self.cat_domains[name])}
+                    codes = np.fromiter(
+                        (
+                            -1
+                            if x is None or (isinstance(x, float) and np.isnan(x))
+                            else index.get(str(x), -1)
+                            for x in v
+                        ),
+                        dtype=np.int64,
+                        count=len(rows),
+                    )
+                    out[name] = codes
+                else:
+                    try:
+                        x = np.asarray(v, dtype=np.float64)
+                    except (TypeError, ValueError):
+                        x = np.fromiter(
+                            (
+                                np.nan if e is None or e == "" else float(e)
+                                for e in v
+                            ),
+                            dtype=np.float64,
+                            count=len(rows),
+                        )
+                    out[name] = x
+            return out
         n = len(rows)
         out = {}
         for name in self.predictor_names:
@@ -169,7 +226,13 @@ class MojoModel:
         return self.layout.response_domain
 
     def score(self, data) -> np.ndarray:
-        """Batch scores: [N] regression / [N, K] class probabilities."""
+        """Batch scores: [N] regression / [N, K] class probabilities.
+        A dict of column arrays takes the vectorized column path (no
+        per-row dict materialization)."""
+        if isinstance(data, dict) and data and all(
+            np.iterable(v) and not isinstance(v, str) for v in data.values()
+        ):
+            return self._score_rows(_Columns(data))
         rows, _ = _as_rows(data, self.names)
         return self._score_rows(rows)
 
@@ -214,9 +277,17 @@ class GlmMojoModel(MojoModel):
         off_col = self.meta.get("offset_column")
         off = 0.0
         if off_col:  # GLMModel._eta adds the per-row offset
-            off = np.array(
-                [float(r.get(off_col) or 0.0) for r in rows], dtype=np.float64
-            )
+            if isinstance(rows, _Columns):
+                v = rows.column(off_col)
+                off = (
+                    np.nan_to_num(np.asarray(v, dtype=np.float64))
+                    if v is not None
+                    else 0.0
+                )
+            else:
+                off = np.array(
+                    [float(r.get(off_col) or 0.0) for r in rows], dtype=np.float64
+                )
         family = self.meta["family"]
         if family == "multinomial":  # softmax over per-class etas
             B = self._arrays["beta_multi"]
@@ -278,11 +349,19 @@ class TreeMojoModel(MojoModel):
         offset_col = m.get("offset_column")
         offset = None
         if offset_col:
-            offset = np.full(len(rows), np.nan)
-            for i, row in enumerate(rows):
-                v = row.get(offset_col)
-                if v is not None and v != "":
-                    offset[i] = float(v)
+            if isinstance(rows, _Columns):
+                v = rows.column(offset_col)
+                offset = (
+                    np.asarray(v, dtype=np.float64)
+                    if v is not None
+                    else np.full(len(rows), np.nan)
+                )
+            else:
+                offset = np.full(len(rows), np.nan)
+                for i, row in enumerate(rows):
+                    v = row.get(offset_col)
+                    if v is not None and v != "":
+                        offset[i] = float(v)
             if np.isnan(offset).any():
                 raise ValueError(
                     f"offset column {offset_col!r} must be present and "

@@ -106,6 +106,9 @@ class DeepLearningModel(Model):
         self.net_params = None
         self.loss_kind = loss_kind
         self.epochs_trained = 0.0
+        #: flattened optimizer-state leaves, kept so checkpoint-continue
+        #: resumes ADADELTA accumulators / momentum / step counters exactly
+        self.opt_leaves = None
 
     def _forward_np(self, frame: Frame) -> np.ndarray:
         X, _ = expand_matrix(self.data_info, frame, dtype=np.float32)
@@ -149,8 +152,45 @@ class DeepLearningModel(Model):
 
 class DeepLearning(ModelBuilder):
 
-    SUPPORTED_COMMON = frozenset({"stopping_rounds"})
+    SUPPORTED_COMMON = frozenset({"stopping_rounds", "checkpoint"})
     algo_name = "deeplearning"
+
+    def _resolve_checkpoint(self, info, loss_kind: str):
+        """checkpoint-continue (SharedTree.java:131-136 covers DL via
+        CheckpointUtils): validate the non-modifiable params match, return
+        the prior model. ``epochs`` is the TOTAL target, like trees' ntrees."""
+        p = self.params
+        if not p.checkpoint:
+            return None
+        from h2o3_tpu.keyed import DKV
+
+        prior = DKV.get(p.checkpoint)
+        if prior is None:
+            raise ValueError(f"checkpoint model {p.checkpoint!r} not found")
+        if getattr(prior, "algo_name", None) != self.algo_name:
+            raise ValueError("checkpoint model is not a deeplearning model")
+        pp = prior.params
+        for f in ("hidden", "activation", "adaptive_rate", "standardize",
+                  "autoencoder", "mini_batch_size"):
+            if getattr(pp, f) != getattr(p, f):
+                raise ValueError(
+                    f"checkpoint {f}={getattr(pp, f)!r} differs from "
+                    f"requested {getattr(p, f)!r}"
+                )
+        if prior.data_info.coef_names != info.coef_names:
+            raise ValueError("checkpoint design-matrix layout differs from this frame")
+        if prior.data_info.response_domain != info.response_domain:
+            # different classes (or order) would gather out-of-range labels
+            # against the prior output layer — silently, under jit
+            raise ValueError("checkpoint response domain differs from this frame")
+        if prior.loss_kind != loss_kind:
+            raise ValueError("checkpoint loss differs from this training setup")
+        if p.epochs <= prior.epochs_trained:
+            raise ValueError(
+                f"checkpoint already has {prior.epochs_trained} epochs; "
+                f"epochs={p.epochs} must exceed it"
+            )
+        return prior
 
     def __init__(self, params: Optional[DeepLearningParameters] = None, **kw) -> None:
         super().__init__(params or DeepLearningParameters(**kw))
@@ -184,12 +224,22 @@ class DeepLearning(ModelBuilder):
                 d_out, loss_kind = 1, "quadratic" if p.loss in ("auto", "quadratic") else p.loss
                 Y = y.astype(np.float32)
 
+        # resolve (and validate) the checkpoint BEFORE constructing the
+        # model: Model.__init__ registers in the DKV, and a failed
+        # validation must not leak a phantom untrained model
+        prior = self._resolve_checkpoint(info, loss_kind)
         model = DeepLearningModel(p, info, loss_kind)
         act = _activation(p.activation)
         sizes = [d_in] + list(p.hidden) + [d_out]
-        key = jax.random.PRNGKey(p.actual_seed())
-        key, init_key = jax.random.split(key)
-        net = _init_params(init_key, sizes)
+        base_seed = p.actual_seed()
+        base_key = jax.random.PRNGKey(base_seed)
+        if prior is not None:
+            net = [
+                (jnp.asarray(W), jnp.asarray(b)) for W, b in prior.net_params
+            ]
+        else:
+            _, init_key = jax.random.split(base_key)
+            net = _init_params(init_key, sizes)
 
         use_momentum = (p.momentum_start > 0) or (p.momentum_stable > 0)
         if p.adaptive_rate:
@@ -214,6 +264,14 @@ class DeepLearning(ModelBuilder):
             else:
                 opt = optax.sgd(sched)
         opt_state = opt.init(net)
+        # getattr: models saved before opt_leaves existed decode without it
+        if prior is not None and getattr(prior, "opt_leaves", None) is not None:
+            # resume the optimizer exactly (accumulators + step counters)
+            treedef = jax.tree_util.tree_structure(opt_state)
+            leaves = [jnp.asarray(l) for l in prior.opt_leaves]
+            if len(leaves) != treedef.num_leaves:
+                raise ValueError("checkpoint optimizer state is incompatible")
+            opt_state = jax.tree_util.tree_unflatten(treedef, leaves)
 
         hidden_do = tuple(p.hidden_dropout_ratios) if p.hidden_dropout_ratios else None
 
@@ -242,20 +300,26 @@ class DeepLearning(ModelBuilder):
         nshards = mesh.devices.size
         bs = max(p.mini_batch_size, nshards)
         bs -= bs % nshards  # static sharded batch shape
-        rng = np.random.default_rng(p.actual_seed())
         steps_per_epoch = max(n // bs, 1)
         total_epochs = int(np.ceil(p.epochs))
+        start_epoch = int(prior.epochs_trained) if prior is not None else 0
         history: List[float] = []
 
-        for epoch in range(total_epochs):
-            perm = rng.permutation(n)
+        # RNG keyed by ABSOLUTE epoch/step index: k epochs then k more
+        # reproduces a straight 2k-epoch run exactly (same design as the
+        # tree booster's absolute-tree-index keys)
+        for epoch in range(start_epoch, total_epochs):
+            perm = np.random.default_rng(
+                base_seed + 1_000_003 * (epoch + 1)
+            ).permutation(n)
+            ekey = jax.random.fold_in(base_key, epoch + 1)
             for s in range(steps_per_epoch):
                 idx = perm[s * bs : (s + 1) * bs]
                 if len(idx) < bs:  # static shapes: cycle the permutation
                     idx = np.resize(perm, bs)
                 xb = jax.device_put(X[idx], row_sharding(mesh, 2))
                 yb = jax.device_put(Y[idx], row_sharding(mesh, Y.ndim))
-                key, dk = jax.random.split(key)
+                dk = jax.random.fold_in(ekey, s)
                 net, opt_state, loss = train_step(net, opt_state, xb, yb, dk)
             model.epochs_trained = epoch + 1
             if p.stopping_rounds > 0 and (epoch + 1) % p.score_interval == 0:
@@ -269,6 +333,9 @@ class DeepLearning(ModelBuilder):
                 self.job.update((epoch + 1) / total_epochs)
 
         model.net_params = jax.device_get(net)
+        model.opt_leaves = [
+            np.asarray(l) for l in jax.tree_util.tree_leaves(jax.device_get(opt_state))
+        ]
         if not p.autoencoder:
             model.training_metrics = model.model_performance(frame)
             if valid is not None:

@@ -215,7 +215,9 @@ class ModelBuilder:
         self.job: Optional[Job] = None
 
     # -- validation (ModelBuilder.init) --------------------------------------
-    def _validate(self, frame: Frame) -> None:
+    def _validate_params(self) -> None:
+        """Frame-independent checks: the no-silent-param guard + CV combos.
+        Frame-free builders (generic) run this directly."""
         p = self.params
         for name, default in self._GUARDED_DEFAULTS.items():
             val = getattr(p, name, default)
@@ -225,14 +227,18 @@ class ModelBuilder:
                     f"(got {val!r}); supported common params: "
                     f"{sorted(self.SUPPORTED_COMMON) or 'none'}"
                 )
-        if p.response_column and p.response_column not in frame.names:
-            raise ValueError(f"response_column {p.response_column!r} not in frame")
-        if p.weights_column and p.weights_column not in frame.names:
-            raise ValueError(f"weights_column {p.weights_column!r} not in frame")
         if p.nfolds == 1:
             raise ValueError("nfolds must be 0 or >= 2")
         if p.nfolds and p.fold_column:
             raise ValueError("cannot use both nfolds and fold_column")
+
+    def _validate(self, frame: Frame) -> None:
+        self._validate_params()
+        p = self.params
+        if p.response_column and p.response_column not in frame.names:
+            raise ValueError(f"response_column {p.response_column!r} not in frame")
+        if p.weights_column and p.weights_column not in frame.names:
+            raise ValueError(f"weights_column {p.weights_column!r} not in frame")
 
     def _fit(self, frame: Frame, valid: Optional[Frame] = None) -> Model:
         raise NotImplementedError

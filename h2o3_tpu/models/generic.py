@@ -71,7 +71,12 @@ class Generic(ModelBuilder):
         super().__init__(params or GenericParameters(**kw))
 
     def train(self, frame: Optional[Frame] = None, valid: Optional[Frame] = None) -> GenericModel:
-        # no training frame: the artifact defines the layout
+        # no training frame: the artifact defines the layout — but the
+        # no-silent-param guard still applies (frameless half of _validate)
+        self._validate_params()
+        p: GenericParameters = self.params
+        if p.nfolds or p.fold_column:
+            raise ValueError("generic import does not support cross-validation")
         self.job = Job("generic import").start()
         try:
             model = self._fit(frame, valid)

@@ -17,7 +17,6 @@ fatal (GridSearch.java's failed-params tracking).
 from __future__ import annotations
 
 import itertools
-import pickle
 import time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field, replace
@@ -117,15 +116,25 @@ class Grid:
         return out
 
     # -- persistence (export_grid / import_grid REST routes) ----------------
-    def save(self, path: str) -> None:
-        with open(path, "wb") as f:
-            pickle.dump(self, f)
+    def save(self, path: str) -> str:
+        """Pickle-free export on the allowlisted object-tree format
+        (models/persist.py) — same container the binary model routes use
+        (hex/grid/Grid.java importBinary/exportBinary)."""
+        from h2o3_tpu.models.persist import save_model
+
+        return save_model(self, path)
 
     @staticmethod
     def load(path: str) -> "Grid":
-        with open(path, "rb") as f:
-            g = pickle.load(f)
+        from h2o3_tpu.models.persist import load_model
+
+        # decode first, mutate the DKV only after the type check passes
+        g = load_model(path, register=False)
+        if not isinstance(g, Grid):
+            raise ValueError(f"{path!r} is not a grid export")
         DKV.put(g.grid_id, g)
+        for m in g.models:  # member models become addressable again too
+            DKV.put(m.key, m)
         return g
 
     def __repr__(self) -> str:

@@ -187,12 +187,17 @@ def save_model(model, path: Union[str, os.PathLike]) -> str:
     return path
 
 
-def load_model(path: Union[str, os.PathLike], key: Optional[str] = None):
+def load_model(
+    path: Union[str, os.PathLike], key: Optional[str] = None, register: bool = True
+):
     """Load a model written by ``save_model`` and register it in the DKV.
 
     key: register under this key instead of the file's saved key — the saved
     key is then left untouched, so restoring a snapshot under a new id never
-    clobbers a live model that happens to share the original key."""
+    clobbers a live model that happens to share the original key.
+    register=False: decode only, touch nothing — callers that must
+    type-check the payload first (a grid route handed a model file, or vice
+    versa) register explicitly after checking."""
     from h2o3_tpu.keyed import DKV
 
     path = os.fspath(path)
@@ -203,6 +208,8 @@ def load_model(path: Union[str, os.PathLike], key: Optional[str] = None):
         tree = json.loads(z.read("model.json"))
         arrays = np.load(io.BytesIO(z.read("arrays.npz")), allow_pickle=False)
         model = _Decoder(arrays).dec(tree)
+    if not register:
+        return model
     if key:
         model.key = key
         DKV.put(key, model)

@@ -190,3 +190,37 @@ class TestGridSegmentsReviewFixes:
         assert len(sm.segments) == 3
         assert sum(s["g"] is None for s in sm.segments) == 1
         assert all(e is None for e in sm.errors)
+
+    def test_grid_export_is_not_pickle(self, rng, tmp_path):
+        """Grid.save uses the allowlisted zip format, never pickle
+        (round-1/2 ADVICE item; pickle loads arbitrary code)."""
+        import zipfile
+
+        fr = _binomial_frame(rng)
+        grid = GridSearch(
+            GLM,
+            GLMParameters(response_column="y", family="binomial"),
+            {"lambda_": [0.0]},
+        ).train(fr)
+        p = str(tmp_path / "grid.bin")
+        grid.save(p)
+        assert zipfile.is_zipfile(p)
+        with zipfile.ZipFile(p) as z:
+            assert {"meta.json", "model.json", "arrays.npz"} <= set(z.namelist())
+
+    def test_no_pickle_anywhere_in_package(self):
+        """No `import pickle` in the product package (tests may use it)."""
+        import pathlib
+
+        import h2o3_tpu
+
+        root = pathlib.Path(h2o3_tpu.__file__).parent
+        offenders = [
+            str(f)
+            for f in root.rglob("*.py")
+            if any(
+                line.strip().startswith(("import pickle", "from pickle"))
+                for line in f.read_text().splitlines()
+            )
+        ]
+        assert offenders == []

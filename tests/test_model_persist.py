@@ -150,3 +150,41 @@ def test_checkpoint_requires_more_trees():
     with pytest.raises(ValueError, match="must exceed"):
         GBM(ntrees=4, max_depth=2, response_column="y", seed=7,
             checkpoint=half.key).train(fr)
+
+
+class TestDeepLearningCheckpoint:
+    """DL checkpoint-continue (CheckpointUtils covers DL too;
+    SharedTree.java:131-136): k epochs then k more == straight 2k."""
+
+    def test_k_plus_k_equals_2k(self, rng):
+        from h2o3_tpu.models.deeplearning import DeepLearning
+
+        n = 600
+        X = rng.normal(size=(n, 4)).astype(np.float64)
+        y = X[:, 0] - 0.5 * X[:, 1] + 0.1 * rng.normal(size=n)
+        fr = Frame.from_dict({f"x{i}": X[:, i] for i in range(4)} | {"y": y})
+        kw = dict(response_column="y", hidden=[8], seed=11, mini_batch_size=64)
+
+        straight = DeepLearning(epochs=6, **kw).train(fr)
+        first = DeepLearning(epochs=3, **kw).train(fr)
+        resumed = DeepLearning(epochs=6, checkpoint=first.key, **kw).train(fr)
+
+        assert resumed.epochs_trained == straight.epochs_trained == 6
+        for (W1, b1), (W2, b2) in zip(resumed.net_params, straight.net_params):
+            np.testing.assert_allclose(W1, W2, rtol=1e-5, atol=1e-6)
+            np.testing.assert_allclose(b1, b2, rtol=1e-5, atol=1e-6)
+
+    def test_checkpoint_validation(self, rng):
+        from h2o3_tpu.models.deeplearning import DeepLearning
+
+        n = 200
+        X = rng.normal(size=(n, 3))
+        y = X[:, 0] + 0.1 * rng.normal(size=n)
+        fr = Frame.from_dict({f"x{i}": X[:, i] for i in range(3)} | {"y": y})
+        m = DeepLearning(response_column="y", hidden=[8], epochs=2, seed=1).train(fr)
+        with pytest.raises(ValueError, match="hidden"):
+            DeepLearning(response_column="y", hidden=[16], epochs=4,
+                         checkpoint=m.key, seed=1).train(fr)
+        with pytest.raises(ValueError, match="must exceed"):
+            DeepLearning(response_column="y", hidden=[8], epochs=2,
+                         checkpoint=m.key, seed=1).train(fr)

@@ -207,3 +207,31 @@ class TestLoadDoesNotClobber:
         assert st == 200
         _predictions(server, mid, fid)
         _predictions(server, "gbm_copy", fid)
+
+
+class TestGridPersistOverRest:
+    def test_grid_export_import_roundtrip(self, server, tmp_path):
+        fid = _upload_and_parse(server, CSV, "grid_train")
+        st, out = _req(server, "POST", "/99/Grid/gbm",
+                       {"training_frame": fid, "response_column": "y",
+                        "ntrees": 3, "seed": 1, "min_rows": 5,
+                        "hyper_parameters": {"max_depth": [2, 3]}})
+        assert st == 200, out
+        gid = out["grid_id"]["name"]
+        st, before = _req(server, "GET", f"/99/Grids/{gid}")
+        assert st == 200
+
+        st, out = _req(server, "POST", f"/99/Grids/{gid}/export",
+                       {"dir": str(tmp_path)})
+        assert st == 200, out
+        path = out["dir"]
+
+        st, out = _req(server, "POST", "/99/Grids/import", {"dir": path})
+        assert st == 200, out
+        assert out["grid_id"]["name"] == gid
+        names = {
+            m["name"] if isinstance(m, dict) else m for m in before["model_ids"]
+        }
+        assert set(out["model_ids"]) == names
+        # member models are scorable again
+        _predictions(server, out["model_ids"][0], fid)
