@@ -23,6 +23,9 @@ __all__ = [
     "ColType",
     "parse_csv",
     "parse_setup",
+    "import_parse",
+    "parse_svmlight",
+    "parse_arff",
     "KeyedStore",
     "DKV",
 ]
@@ -33,6 +36,9 @@ _LAZY = {
     "ColType": ("h2o3_tpu.frame.frame", "ColType"),
     "parse_csv": ("h2o3_tpu.frame.parse", "parse_csv"),
     "parse_setup": ("h2o3_tpu.frame.parse", "parse_setup"),
+    "import_parse": ("h2o3_tpu.frame.ingest", "import_parse"),
+    "parse_svmlight": ("h2o3_tpu.frame.ingest", "parse_svmlight"),
+    "parse_arff": ("h2o3_tpu.frame.ingest", "parse_arff"),
     "KeyedStore": ("h2o3_tpu.keyed", "KeyedStore"),
     "DKV": ("h2o3_tpu.keyed", "DKV"),
 }

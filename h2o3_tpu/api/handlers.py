@@ -32,11 +32,25 @@ from h2o3_tpu.rapids import Session, exec_rapids
 
 
 class _RawFile:
-    """An imported-but-unparsed source (reference: raw ByteVec under a key)."""
+    """An imported-but-unparsed source (reference: raw ByteVec under a key).
+    Keeps the ORIGINAL bytes (a multi-entry zip must reach the parser
+    whole); name/data expose the first decompressed part for sniffing."""
 
-    def __init__(self, path: str, text: str) -> None:
+    def __init__(self, path: str, text: Optional[str] = None,
+                 data: Optional[bytes] = None) -> None:
+        from h2o3_tpu.frame.ingest import _decompress
+
         self.path = path
-        self.text = text
+        if text is not None:
+            self.raw_name, self.raw_data = path, text.encode()
+        else:
+            self.raw_name = os.path.basename(path) or path
+            self.raw_data = data or b""
+        self.name, self.data = _decompress(self.raw_name, self.raw_data)
+
+    @property
+    def text(self) -> str:
+        return self.data.decode("utf-8", errors="replace")
 
 
 _SESSIONS: Dict[str, Session] = {}
@@ -266,16 +280,35 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
 
     # ---- import / parse ---------------------------------------------------
     def import_files(params):
+        """Path / glob / directory / URI -> raw sources (ImportFilesHandler
+        + PersistManager scheme dispatch; water/persist/)."""
+        from h2o3_tpu.frame.ingest import list_sources, resolve_persist
+
         path = params.get("path")
         if not path:
             raise RestError(400, "path required")
-        if not os.path.exists(path):
-            raise RestError(404, f"path {path!r} not found")
-        with open(path, "r", errors="replace") as f:
-            text = f.read()
-        key = DKV.make_key("nfs:" + os.path.basename(path))
-        DKV.put(key, _RawFile(path, text))
-        return {"files": [path], "destination_frames": [key], "fails": [], "dels": []}
+        try:
+            sources = list_sources(path)
+        except FileNotFoundError as e:
+            raise RestError(404, f"path {e} not found")
+        except ValueError as e:
+            raise RestError(400, str(e))
+        keys: List[str] = []
+        fails: List[str] = []
+        for src in sources:
+            try:
+                backend, p = resolve_persist(src)
+                key = DKV.make_key("nfs:" + os.path.basename(p))
+                DKV.put(key, _RawFile(p, data=backend.read_bytes(p)))
+                keys.append(key)
+            except Exception:
+                fails.append(src)
+        return {
+            "files": sources,
+            "destination_frames": keys,
+            "fails": fails,
+            "dels": [],
+        }
 
     def post_file(params):
         # upload_file: raw body was stashed under 'file' by the client;
@@ -294,20 +327,28 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
         return v
 
     def parse_setup_ep(params):
+        from h2o3_tpu.frame.ingest import sniff_format
+
         srcs = params.get("source_frames")
         if isinstance(srcs, str):
             srcs = json.loads(srcs.replace("'", '"')) if srcs.startswith("[") else [srcs]
         raw = _raw_of(srcs[0])
-        setup = parse_setup(raw.text)
-        return {
+        fmt = sniff_format(raw.name, raw.data)
+        out = {
             "source_frames": [{"name": s} for s in srcs],
             "destination_frame": srcs[0].rsplit(":", 1)[-1] + ".hex",
-            "separator": ord(setup.separator),
-            "check_header": 1 if setup.header else -1,
-            "column_names": setup.column_names,
-            "column_types": [t.name.lower() for t in setup.column_types],
-            "number_columns": len(setup.column_names),
+            "parse_type": fmt.upper(),
         }
+        if fmt == "csv":
+            setup = parse_setup(raw.text)
+            out.update(
+                separator=ord(setup.separator),
+                check_header=1 if setup.header else -1,
+                column_names=setup.column_names,
+                column_types=[t.name.lower() for t in setup.column_types],
+                number_columns=len(setup.column_names),
+            )
+        return out
 
     def parse_ep(params):
         srcs = params.get("source_frames")
@@ -336,7 +377,15 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
             }
         job = Job(f"parse {dest}").start()
         try:
-            fr = parse_csv(raw.text, **kw)
+            from h2o3_tpu.frame.ingest import parse_bytes, rbind_all
+
+            # multi-file: parse each + rbind (ParseDataset parseAllKeys)
+            fr = rbind_all(
+                [
+                    parse_bytes(_raw_of(s).raw_name, _raw_of(s).raw_data, **kw)
+                    for s in srcs
+                ]
+            )
             DKV.put(dest, fr)
             job.dest = dest
             job.done()

@@ -92,21 +92,21 @@ def shutdown() -> None:
 
 
 def import_file(path: str, destination_frame: Optional[str] = None) -> H2OFrame:
-    """h2o.import_file (h2o.py:383): ImportFiles -> ParseSetup -> Parse."""
+    """h2o.import_file (h2o.py:383): ImportFiles -> ParseSetup -> Parse.
+    path may be a file, glob, directory, or URI — ALL matched sources parse
+    into one frame (the reference's multi-file ParseDataset)."""
     c = connection()
     imp = c.request("POST /3/ImportFiles", {"path": path})
-    src = imp["destination_frames"][0]
-    setup = c.request("POST /3/ParseSetup", {"source_frames": [src]})
+    srcs = imp["destination_frames"]
+    setup = c.request("POST /3/ParseSetup", {"source_frames": srcs})
     dest = destination_frame or setup["destination_frame"]
-    out = c.request(
-        "POST /3/Parse",
-        {
-            "source_frames": [src],
-            "destination_frame": dest,
-            "separator": setup["separator"],
-            "check_header": setup["check_header"],
-        },
-    )
+    payload = {"source_frames": srcs, "destination_frame": dest}
+    # separator/check_header exist only for CSV sources (non-CSV formats
+    # carry their own structure)
+    if "separator" in setup:
+        payload["separator"] = setup["separator"]
+        payload["check_header"] = setup["check_header"]
+    out = c.request("POST /3/Parse", payload)
     key = out["destination_frame"]["name"]
     fr = c.request(f"GET /3/Frames/{key}")["frames"][0]
     return H2OFrame.from_key(c, key, nrows=fr["rows"], ncols=fr["num_columns"])

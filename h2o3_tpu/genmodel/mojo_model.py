@@ -88,13 +88,17 @@ class _Layout:
                     try:
                         x = np.asarray(v, dtype=np.float64)
                     except (TypeError, ValueError):
+                        # element-wise with the row path's semantics:
+                        # non-numeric values become NA, never an exception
+                        def _f(e):
+                            if e is None or e == "":
+                                return np.nan
+                            try:
+                                return float(e)
+                            except (TypeError, ValueError):
+                                return np.nan
                         x = np.fromiter(
-                            (
-                                np.nan if e is None or e == "" else float(e)
-                                for e in v
-                            ),
-                            dtype=np.float64,
-                            count=len(rows),
+                            (_f(e) for e in v), dtype=np.float64, count=len(rows)
                         )
                     out[name] = x
             return out
@@ -279,11 +283,9 @@ class GlmMojoModel(MojoModel):
         if off_col:  # GLMModel._eta adds the per-row offset
             if isinstance(rows, _Columns):
                 v = rows.column(off_col)
-                off = (
-                    np.nan_to_num(np.asarray(v, dtype=np.float64))
-                    if v is not None
-                    else 0.0
-                )
+                # NaN propagates like the row path; only an ABSENT column
+                # means zero offset
+                off = np.asarray(v, dtype=np.float64) if v is not None else 0.0
             else:
                 off = np.array(
                     [float(r.get(off_col) or 0.0) for r in rows], dtype=np.float64

@@ -1,0 +1,287 @@
+"""Ingest breadth: SVMLight/ARFF parsers, gzip/zip decompression, glob &
+multi-file import, URI-scheme Persist dispatch — VERDICT r2 item 6.
+
+Reference: water/parser/{SVMLightParser,ARFFParser,ZipUtil},
+water/persist/PersistManager.java, ParseDataset multi-file parse."""
+
+import gzip
+import json
+import os
+import urllib.request
+import zipfile
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.frame.frame import ColType
+from h2o3_tpu.frame.ingest import (
+    import_parse,
+    list_sources,
+    parse_arff,
+    parse_source,
+    parse_svmlight,
+    resolve_persist,
+    sniff_format,
+)
+
+SVM = """\
+1 1:0.5 3:2.0  # comment
+-1 2:1.5
+1 1:1.0 2:2.0 3:3.0
+"""
+
+ARFF = """\
+% a comment
+@RELATION weather
+@ATTRIBUTE outlook {sunny, overcast, rainy}
+@ATTRIBUTE temperature NUMERIC
+@ATTRIBUTE humidity real
+@ATTRIBUTE windy {TRUE, FALSE}
+@ATTRIBUTE play string
+@DATA
+sunny,85,85,FALSE,no
+overcast,83,?,TRUE,yes
+rainy,?,96,FALSE,yes
+"""
+
+CSV = "a,b\n1,x\n2,y\n3,z\n"
+
+
+class TestSvmLight:
+    def test_parse(self):
+        fr = parse_svmlight(SVM)
+        assert fr.names == ["target", "C1", "C2", "C3"]
+        np.testing.assert_array_equal(
+            fr.col("target").data, [1.0, -1.0, 1.0]
+        )
+        # absent entries are 0 (sparse semantics), not NA
+        np.testing.assert_array_equal(fr.col("C2").data, [0.0, 1.5, 2.0])
+        np.testing.assert_array_equal(fr.col("C3").data, [2.0, 0.0, 3.0])
+
+    def test_bad_index_order_raises(self):
+        with pytest.raises(ValueError, match="increasing"):
+            parse_svmlight("1 3:1 2:1\n")
+
+    def test_sniff(self):
+        assert sniff_format("x.svm", b"") == "svmlight"
+        assert sniff_format("data.txt", SVM.encode()) == "svmlight"
+
+
+class TestArff:
+    def test_parse(self):
+        fr = parse_arff(ARFF)
+        assert fr.names == ["outlook", "temperature", "humidity", "windy", "play"]
+        out = fr.col("outlook")
+        assert out.type is ColType.CAT
+        # declared domain order preserved (not data-sorted)
+        assert out.domain == ["sunny", "overcast", "rainy"]
+        np.testing.assert_array_equal(out.data, [0, 1, 2])
+        temp = fr.col("temperature")
+        assert temp.type is ColType.NUM
+        assert np.isnan(temp.data[2])  # '?' is NA
+        assert fr.col("play").type is ColType.STR
+
+    def test_sniff(self):
+        assert sniff_format("weather.arff", b"") == "arff"
+        assert sniff_format("w.txt", ARFF.encode()) == "arff"
+
+    def test_sparse_rows_rejected(self):
+        arff = "@relation r\n@attribute a numeric\n@data\n{0 5}\n"
+        with pytest.raises(ValueError, match="sparse"):
+            parse_arff(arff)
+
+
+class TestDecompression:
+    def test_gzip(self, tmp_path):
+        p = tmp_path / "data.csv.gz"
+        p.write_bytes(gzip.compress(CSV.encode()))
+        fr = parse_source(str(p))
+        assert fr.nrows == 3 and fr.names == ["a", "b"]
+
+    def test_zip_single(self, tmp_path):
+        p = tmp_path / "data.zip"
+        with zipfile.ZipFile(p, "w") as z:
+            z.writestr("inner.csv", CSV)
+        fr = parse_source(str(p))
+        assert fr.nrows == 3
+
+    def test_zip_of_gzip_of_svm(self, tmp_path):
+        """nested wrapping unwraps recursively (ZipUtil semantics)."""
+        p = tmp_path / "d.zip"
+        with zipfile.ZipFile(p, "w") as z:
+            z.writestr("inner.svm.gz", gzip.compress(SVM.encode()))
+        fr = parse_source(str(p))
+        assert fr.names[0] == "target"
+
+
+class TestMultiFileImport:
+    def test_glob_rbind(self, tmp_path):
+        (tmp_path / "part1.csv").write_text("a,b\n1,x\n2,y\n")
+        (tmp_path / "part2.csv").write_text("a,b\n3,z\n")
+        fr = import_parse(str(tmp_path / "part*.csv"))
+        assert fr.nrows == 3
+        assert set(fr.col("b").domain) >= {"x", "y", "z"}
+
+    def test_directory_import(self, tmp_path):
+        (tmp_path / "p1.csv").write_text("a\n1\n")
+        (tmp_path / "p2.csv").write_text("a\n2\n")
+        fr = import_parse(str(tmp_path))
+        assert fr.nrows == 2
+
+    def test_missing_glob_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            import_parse(str(tmp_path / "nope*.csv"))
+
+
+class TestPersistDispatch:
+    def test_file_scheme(self, tmp_path):
+        p = tmp_path / "x.csv"
+        p.write_text(CSV)
+        fr = parse_source(f"file://{p}")
+        assert fr.nrows == 3
+
+    def test_unavailable_scheme_named(self):
+        with pytest.raises(ValueError, match="h2o-persist-s3"):
+            resolve_persist("s3://bucket/key.csv")
+        with pytest.raises(ValueError, match="hdfs"):
+            resolve_persist("hdfs://nn/x.csv")
+
+    def test_unknown_scheme(self):
+        with pytest.raises(ValueError, match="unknown URI scheme"):
+            resolve_persist("weird://x")
+
+    def test_http_scheme_roundtrip(self, tmp_path):
+        """eager-HTTP persist against a local socket server."""
+        import http.server
+        import threading
+
+        (tmp_path / "h.csv").write_text(CSV)
+        handler = lambda *a, **kw: http.server.SimpleHTTPRequestHandler(
+            *a, directory=str(tmp_path), **kw
+        )
+        srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            url = f"http://127.0.0.1:{srv.server_port}/h.csv"
+            fr = parse_source(url)
+            assert fr.nrows == 3
+        finally:
+            srv.shutdown()
+
+    def test_parquet_gate_names_module(self):
+        import importlib.util
+
+        if importlib.util.find_spec("pyarrow") is not None:
+            pytest.skip("pyarrow available; gate not reachable")
+        from h2o3_tpu.frame.ingest import parse_parquet
+
+        with pytest.raises(ValueError, match="pyarrow"):
+            parse_parquet(b"PAR1....")
+
+
+class TestRestImport:
+    @pytest.fixture(scope="class")
+    def server(self):
+        from h2o3_tpu.api import start_server
+
+        s = start_server(port=0)
+        yield s
+        s.stop()
+
+    def _req(self, server, method, path, data=None):
+        body = json.dumps(data).encode() if data is not None else None
+        req = urllib.request.Request(
+            server.url + path, data=body,
+            headers={"Content-Type": "application/json"} if body else {},
+            method=method,
+        )
+        try:
+            with urllib.request.urlopen(req) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    def test_import_directory_and_parse(self, server, tmp_path):
+        (tmp_path / "a.csv").write_text("x,y\n1,2\n")
+        (tmp_path / "b.csv").write_text("x,y\n3,4\n")
+        st, out = self._req(server, "POST", "/3/ImportFiles",
+                            {"path": str(tmp_path)})
+        assert st == 200, out
+        assert len(out["destination_frames"]) == 2
+        st, out = self._req(server, "POST", "/3/Parse",
+                            {"source_frames": out["destination_frames"],
+                             "destination_frame": "multi"})
+        assert st == 200, out
+        st, fr = self._req(server, "GET", "/3/Frames/multi")
+        assert st == 200
+        assert fr["frames"][0]["rows"] == 2
+
+    def test_import_svmlight_over_rest(self, server, tmp_path):
+        (tmp_path / "d.svm").write_text(SVM)
+        st, out = self._req(server, "POST", "/3/ImportFiles",
+                            {"path": str(tmp_path / "d.svm")})
+        assert st == 200
+        st, setup = self._req(server, "POST", "/3/ParseSetup",
+                              {"source_frames": out["destination_frames"]})
+        assert st == 200 and setup["parse_type"] == "SVMLIGHT"
+        st, out = self._req(server, "POST", "/3/Parse",
+                            {"source_frames": out["destination_frames"],
+                             "destination_frame": "svm1"})
+        assert st == 200, out
+        st, fr = self._req(server, "GET", "/3/Frames/svm1")
+        assert fr["frames"][0]["rows"] == 3
+
+    def test_import_gzip_arff_over_rest(self, server, tmp_path):
+        (tmp_path / "w.arff.gz").write_bytes(gzip.compress(ARFF.encode()))
+        st, out = self._req(server, "POST", "/3/ImportFiles",
+                            {"path": str(tmp_path / "w.arff.gz")})
+        assert st == 200
+        st, out = self._req(server, "POST", "/3/Parse",
+                            {"source_frames": out["destination_frames"],
+                             "destination_frame": "arff1"})
+        assert st == 200, out
+        st, fr = self._req(server, "GET", "/3/Frames/arff1")
+        assert fr["frames"][0]["rows"] == 3
+
+
+class TestReviewFollowups:
+    def test_multi_entry_zip_rbinds_parts(self, tmp_path):
+        """each zip entry parses separately (headers never embed mid-data)."""
+        p = tmp_path / "multi.zip"
+        with zipfile.ZipFile(p, "w") as z:
+            z.writestr("a.csv", "x,y\n1,2\n")
+            z.writestr("b.csv", "x,y\n3,4\n")
+        fr = parse_source(str(p))
+        assert fr.nrows == 2
+        assert fr.col("x").type is ColType.NUM
+        np.testing.assert_array_equal(sorted(fr.col("x").data), [1.0, 3.0])
+
+    def test_svmlight_differing_widths_unify(self, tmp_path):
+        (tmp_path / "a.svm").write_text("1 1:1.0 4:4.0\n")
+        (tmp_path / "b.svm").write_text("0 2:2.0\n")
+        fr = import_parse(str(tmp_path / "*.svm"))
+        assert fr.nrows == 2
+        assert fr.names == ["target", "C1", "C2", "C3", "C4"]
+        # the narrow file's absent high columns are 0 (sparse semantics)
+        np.testing.assert_array_equal(sorted(fr.col("C4").data), [0.0, 4.0])
+
+    def test_columns_fast_path_bad_numeric_is_na(self, tmp_path):
+        """mojo batch (column) scoring treats non-numeric as NA like the
+        row path, instead of raising."""
+        from h2o3_tpu import Frame
+        from h2o3_tpu.genmodel import load_mojo
+        from h2o3_tpu.models.mojo_export import write_mojo
+        from h2o3_tpu.models.tree import GBM
+
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=300)
+        fr = Frame.from_dict({"x": x, "y": 2 * x})
+        m = GBM(response_column="y", ntrees=3, max_depth=2, seed=1,
+                min_rows=5.0).train(fr)
+        path = str(tmp_path / "m.mojo")
+        write_mojo(m, path)
+        mm = load_mojo(path)
+        got = mm.score({"x": ["1.0", "abc", None]})
+        want = mm.score([{"x": "1.0"}, {"x": "abc"}, {"x": None}])
+        np.testing.assert_allclose(got, want)
