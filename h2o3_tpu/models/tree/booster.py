@@ -372,6 +372,26 @@ def _build_one_tree(
         local = pos - lo
         in_lvl = (local >= 0) & (local < K)
         hist_nodes = jnp.where(in_lvl & sample, local, -1).astype(jnp.int32)
+        if d == D:
+            # terminal level: no split is possible, so the full
+            # [K, F, B+1, 3] histogram (the widest of the tree) is pure
+            # waste — per-node (Σg, Σh) totals give the leaf values
+            from h2o3_tpu.ops.histogram import node_totals_sharded
+
+            tot = node_totals_sharded(hist_nodes, g, h, K, mesh=mesh, rw=rw)
+            G, H = tot[:, 0], tot[:, 1]
+            t = jnp.sign(G) * jnp.maximum(
+                jnp.abs(G) - jnp.float32(p.reg_alpha), 0.0
+            )
+            raw_leaf = -t / jnp.maximum(H + jnp.float32(p.reg_lambda), 1e-12)
+            if mono:
+                raw_leaf = jnp.clip(raw_leaf, b_lo, b_hi)
+            tf_l.append(jnp.zeros(K, jnp.int32))
+            tb_l.append(jnp.zeros(K, jnp.int32))
+            tdl_l.append(jnp.zeros(K, bool))
+            tsp_l.append(jnp.zeros(K, bool))
+            tlf_l.append(jnp.float32(p.learn_rate) * raw_leaf)
+            break
         hist = build_histogram_sharded(
             bins, hist_nodes, g, h, n_nodes=K, n_bins1=n_bins1, mesh=mesh,
             bins_fm=bins_fm, rw=rw,
@@ -616,7 +636,12 @@ def train_boosted(
     # pallas path: pad every shard to the kernel row tile so the prepared
     # feature-major copy needs no per-level realignment
     use_pallas = _hist_impl(None) == "pallas"
-    mult = nshards * 512 if use_pallas else nshards
+    if use_pallas:
+        from h2o3_tpu.ops.pallas_histogram import _ROW_TILE
+
+        mult = nshards * _ROW_TILE
+    else:
+        mult = nshards
     padn = (-n) % mult
     if padn:
         bins_host = np.concatenate(
@@ -698,6 +723,8 @@ def train_boosted(
 
     p_key = _dc_replace(p, ntrees=0, seed=0)
 
+    from h2o3_tpu.util import timeline
+
     built = 0
     default_block = tree_block_size()
     while built < p.ntrees:
@@ -715,9 +742,14 @@ def train_boosted(
         keys = jax.vmap(lambda t: jax.random.fold_in(key, t))(
             jnp.arange(tree_offset + built, tree_offset + built + block)
         )
-        margin, trees_dev = fn(
-            bins_d, y_d, valid_d, margin, keys, bins_fm_d, w_d, mono_d
-        )
+        with timeline.timed(
+            "tree_block", objective=objective, trees=block, rows=n,
+            first_tree=tree_offset + built,
+        ):
+            margin, trees_dev = fn(
+                bins_d, y_d, valid_d, margin, keys, bins_fm_d, w_d, mono_d
+            )
+            jax.block_until_ready(margin)
         tf, tb, tdl, tsp, tlf = jax.device_get(trees_dev)  # [block, C, M] each
         for t in range(block):
             for c in range(C):

@@ -124,6 +124,48 @@ def _shard_histogram(bins, nodes, g, h, n_nodes: int, n_bins1: int, rw=None):
     return jnp.moveaxis(hist.reshape(3, n_nodes, F, n_bins1), 0, -1)
 
 
+def _shard_node_totals(nodes, g, h, n_nodes: int, rw=None):
+    """Per-node (Σg, Σh, Σw) [K, 3] — one masked one-hot contraction.
+
+    The terminal tree level needs only these totals (leaf values), not the
+    full per-(feature, bin) histogram: splitting is impossible at max
+    depth, so the [K, F, B+1, 3] build there would be pure waste — and it
+    is the widest (most expensive) level of the whole tree."""
+    valid = nodes >= 0
+    w = valid.astype(g.dtype)
+    cw = w if rw is None else w * rw
+    onehot = (
+        nodes[:, None] == jnp.arange(n_nodes, dtype=nodes.dtype)[None, :]
+    ).astype(g.dtype)  # [N, K]; node<0 never matches
+    vals = jnp.stack([g * w, h * w, cw], axis=1)  # [N, 3]
+    return jax.lax.dot_general(
+        onehot, vals, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [K, 3]
+
+
+def node_totals_sharded(nodes, g, h, n_nodes: int, mesh=None, rw=None):
+    """Distributed per-node totals: shard-private contraction + psum."""
+    if mesh is None:
+        return _shard_node_totals(nodes, g, h, n_nodes, rw=rw)
+
+    extras = [] if rw is None else [rw]
+
+    def fn(nd, gg, hh, *rest):
+        part = _shard_node_totals(
+            nd, gg, hh, n_nodes, rw=rest[0] if rest else None
+        )
+        return jax.lax.psum(part, DATA_AXIS)
+
+    return _shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS))
+        + tuple(P(DATA_AXIS) for _ in extras),
+        out_specs=P(),
+    )(nodes, g, h, *extras)
+
+
 def _hist_impl(impl: Optional[str]) -> str:
     """Resolve histogram implementation: Pallas MXU kernel on TPU, XLA
     scatter elsewhere. Override with H2O3_TPU_HIST_IMPL=scatter|pallas."""

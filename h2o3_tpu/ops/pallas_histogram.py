@@ -53,28 +53,49 @@ _NODE_MATMUL_MAX_KC = 512
 #: aligned feature-major bins copy must pad features to a multiple of this)
 _FEAT_BLOCK = 8
 
+#: row-tile height; callers pre-padding rows must use a multiple of this
+#: (bigger tiles amortize per-step VPU overhead; 2048 overflows VMEM)
+_ROW_TILE = 512
+
 
 # ---------------------------------------------------------------------------
 # fixed-layout node-matmul kernel
 
 
-def _nm_kernel(bins_ref, node_ref, vals_ref, out_ref, *, n_feat_b, n_bins1, n_nodes):
+def _nm_kernel(
+    jmod_ref, bins_ref, node_ref, vals_ref, out_ref, oh_ref, *,
+    n_feat_b, n_bins1, n_nodes
+):
     """One grid step = one (feature-block, row-tile).
 
-    bins_ref: [Fb, R] int32 (feature-major — Mosaic wants the long axis in
-    lanes); node_ref: [R, 1] int32 (-1 inactive; 2-D so the block layout
-    matches XLA's 1-D tiling); vals_ref: [R, C] f32;
-    out_ref: [1, Fb*B1, K*C] f32 (revisited across the row-tile grid
-    dimension — accumulates in VMEM).
+    jmod_ref: [B1, 1] f32 CONSTANT (the bin-index iota), loaded once —
+    replaces a per-step 3-D int32 iota materialization (the VPU pass that
+    used to dominate the whole kernel); bins_ref: [Fb, R] int32
+    (feature-major — Mosaic wants the long axis in lanes); node_ref:
+    [R, 1] int32 (-1 inactive; 2-D so the block layout matches XLA's 1-D
+    tiling); vals_ref: [R, C] f32; out_ref: [1, K*C, Fb*B1] f32 (revisited
+    across the row-tile grid dimension — accumulates in VMEM).
+
+    Orientation: the MXU lane (N) dimension is Fb*B1 (~2000, always full);
+    K*C sits in the sublane (M) dimension whose padding granularity is 8.
+    The transposed orientation ([Fb*B1, K*C]) padded K*C up to 128 lanes,
+    wasting up to 97% of the MXU at shallow levels (K*C = 4 at the root).
     """
     r = node_ref.shape[0]
     rt = pl.program_id(1)
 
-    # [Fb*B1, R] bf16 one-hot of bin codes (built in VMEM, free vs the MXU)
-    bins = bins_ref[...]  # [Fb, R]
-    iota_b = jax.lax.broadcasted_iota(jnp.int32, (n_feat_b, n_bins1, r), 1)
-    onehot = (iota_b == bins[:, None, :]).reshape(n_feat_b * n_bins1, r)
-    onehot = onehot.astype(jnp.bfloat16)
+    # [Fb*B1, R] one-hot of bin codes, written per-feature into a VMEM
+    # scratch: each 2-D compare pairs a lane-splat ([B1, 1] iota constant)
+    # with a sublane-splat ([1, R] bin row) — both native broadcasts, so
+    # the whole construction is ~one write pass (no 3-D broadcast
+    # materialization, no concat). Bin codes <= 256 are exact in f32.
+    binsb = bins_ref[...].astype(jnp.float32)  # [Fb, R] (tiny)
+    jm = jmod_ref[...]  # [B1, 1] f32 iota constant
+    for f in range(n_feat_b):
+        oh_ref[f * n_bins1 : (f + 1) * n_bins1, :] = (
+            jm == binsb[f][None, :]
+        ).astype(jnp.float32)
+    onehot = oh_ref[...]
 
     # [R, K*C] node-masked values, built lane-wise (no minor-dim reshape —
     # Mosaic can't merge a (K, C) lane split); lane j carries node j//C,
@@ -91,11 +112,11 @@ def _nm_kernel(bins_ref, node_ref, vals_ref, out_ref, *, n_feat_b, n_bins1, n_no
         vals_k = vals_k + jnp.where(
             m_node & (cc == c), vals[:, c][:, None], 0.0
         )
-    vals_k = vals_k.astype(jnp.bfloat16)
 
-    # [Fb*B1, K*C] = onehot @ vals_k — contraction over rows on the MXU
+
+    # [K*C, Fb*B1] = vals_kᵀ ⊗ onehotᵀ — contraction over rows on the MXU
     slab = jax.lax.dot_general(
-        onehot, vals_k, (((1,), (0,)), ((), ())),
+        vals_k, onehot, (((0,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )[None]
 
@@ -145,27 +166,34 @@ def _build_histogram_nodematmul(
     n_ftiles = n_feat_p // fb
     n_rtiles = n // r
 
+    # resident constant: one-hot sublane b (within a feature) covers bin b
+    jmod = jnp.asarray(np.arange(n_bins1)[:, None], dtype=jnp.float32)
+    if vma:
+        jmod = jax.lax.pvary(jmod, tuple(vma))
+
     out = pl.pallas_call(
         partial(_nm_kernel, n_feat_b=fb, n_bins1=n_bins1, n_nodes=n_nodes),
         grid=(n_ftiles, n_rtiles),
         in_specs=[
+            pl.BlockSpec((n_bins1, 1), lambda f, t: (0, 0)),
             pl.BlockSpec((fb, r), lambda f, t: (f, t)),
             pl.BlockSpec((r, 1), lambda f, t: (t, 0)),
             pl.BlockSpec((r, _C), lambda f, t: (t, 0)),
         ],
+        scratch_shapes=[pltpu.VMEM((fb * n_bins1, r), jnp.float32)],
         out_specs=pl.BlockSpec(
-            (1, fb * n_bins1, n_nodes * _C), lambda f, t: (f, 0, 0)
+            (1, n_nodes * _C, fb * n_bins1), lambda f, t: (f, 0, 0)
         ),
         out_shape=jax.ShapeDtypeStruct(
-            (n_ftiles, fb * n_bins1, n_nodes * _C), jnp.float32,
+            (n_ftiles, n_nodes * _C, fb * n_bins1), jnp.float32,
             vma=frozenset(vma) if vma else None,
         ),
         interpret=interpret,
-    )(bins_fm, nodes[:, None], vals)
+    )(jmod, bins_fm, nodes[:, None], vals)
 
-    # [Ft, Fb*B1, K*C] -> [K, F, B1, 3]
-    out = out.reshape(n_ftiles, fb, n_bins1, n_nodes, _C)
-    out = jnp.transpose(out, (3, 0, 1, 2, 4)).reshape(
+    # [Ft, K*C, Fb*B1] -> [K, F, B1, 3]
+    out = out.reshape(n_ftiles, n_nodes, _C, fb, n_bins1)
+    out = jnp.transpose(out, (1, 0, 3, 4, 2)).reshape(
         n_nodes, n_feat_p, n_bins1, _C
     )
     return out[:, :n_feat, :, :3]
@@ -265,7 +293,7 @@ def _prep_padded(bins, nodes, g, h, n_nodes: int, row_tile: int, t_max: int, rw=
 )
 def build_histogram_pallas(
     bins, nodes, g, h, n_nodes: int, n_bins1: int,
-    row_tile: int = 512, interpret: bool = False, vma: tuple = (),
+    row_tile: int = None, interpret: bool = False, vma: tuple = (),
     kernel: str = "auto", bins_fm=None, rw=None,
 ):
     """Drop-in Pallas replacement for ``histogram._shard_histogram``.
@@ -280,11 +308,11 @@ def build_histogram_pallas(
     ):
         return _build_histogram_nodematmul(
             bins, nodes, g, h, n_nodes, n_bins1,
-            row_tile=row_tile, feat_block=_FEAT_BLOCK, interpret=interpret, vma=vma,
-            bins_fm=bins_fm, rw=rw,
+            row_tile=row_tile or _ROW_TILE, feat_block=_FEAT_BLOCK,
+            interpret=interpret, vma=vma, bins_fm=bins_fm, rw=rw,
         )
     n, n_feat = bins.shape
-    r = row_tile
+    r = row_tile or 512  # sorted kernel keeps its original tile height
     t_max = (n + r - 1) // r + n_nodes  # ≤ R-1 pad rows per node
 
     bins_p, vals_p, item_node, item_first = _prep_padded(
