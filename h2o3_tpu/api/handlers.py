@@ -303,6 +303,10 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
                 keys.append(key)
             except Exception:
                 fails.append(src)
+        if not keys:
+            raise RestError(
+                400, f"no readable sources among {sources!r} (failed: {fails})"
+            )
         return {
             "files": sources,
             "destination_frames": keys,
@@ -571,10 +575,21 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
             }
         }
 
+    #: request fields consumed by the route itself, not the algo params
+    _TRAIN_EXTRA = frozenset({"training_frame", "validation_frame", "model_id"})
+
     def train(params, algo):
         if algo not in algos:
             raise RestError(404, f"unknown algo {algo!r}")
         bcls, pcls = algos[algo]
+        # an unknown param must 400, not silently drop (the REST face of
+        # the no-silent-param guard; reference: ModelBuilderHandler rejects
+        # unknown schema fields)
+        unknown = set(params) - {f.name for f in dataclasses.fields(pcls)} - _TRAIN_EXTRA
+        if unknown:
+            raise RestError(
+                400, f"unknown parameters for {algo}: {sorted(unknown)}"
+            )
         # generic "trains" from an artifact, not a frame (hex/generic)
         fr = (
             _get_frame(params.get("training_frame", ""))
@@ -889,12 +904,366 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
     r.register("GET", "/99/AutoML/{automl_id}", automl_get, "automl results")
 
     # ---- diagnostics (TimeLine / logs / jstack analogues) -----------------
-    r.register("GET", "/3/Timeline", lambda p: {
-        "events": [], "now": int(time.time() * 1000)
-    }, "event timeline")
-    r.register("GET", "/3/JStack", lambda p: {
-        "traces": [
-            {"thread": t.name, "stack": []}
-            for t in __import__("threading").enumerate()
-        ]
-    }, "thread dump")
+    # ---- observability (water/TimeLine.java, util/Log.java, JStack) -------
+    def timeline_ep(params):
+        """Real event ring: compiles, training blocks, REST requests
+        (water/TimeLine.java:22,75 snapshot semantics)."""
+        from h2o3_tpu.util import timeline
+
+        n = int(params.get("count", 1000))
+        return {
+            "events": timeline.snapshot(n),
+            "total_events": timeline.total_events(),
+            "now": int(time.time() * 1000),
+        }
+
+    def jstack(params):
+        """Real per-thread stack dump (util/JStackCollectorTask.java)."""
+        import threading
+        import traceback as tb
+
+        frames = __import__("sys")._current_frames()
+        traces = []
+        for t in threading.enumerate():
+            stack = tb.format_stack(frames[t.ident]) if t.ident in frames else []
+            traces.append({"thread": t.name, "alive": t.is_alive(),
+                           "daemon": t.daemon, "stack": stack})
+        return {"traces": traces}
+
+    def logs_ep(params):
+        from h2o3_tpu.util import log as L
+
+        L.init()
+        return {
+            "lines": L.recent(int(params.get("count", 1000))),
+            "log_file": L.log_file(),
+        }
+
+    def logs_download(params):
+        from h2o3_tpu.util import log as L
+
+        L.init()
+        return ("\n".join(L.recent(100000)) + "\n").encode()
+
+    def watermeter(params):
+        """CPU tick counters (api/WaterMeterCpuTicksHandler.java:6)."""
+        try:
+            with open("/proc/stat") as f:
+                first = f.readline().split()
+        except OSError:  # non-Linux host: degrade gracefully, not a 500
+            return {"cpu_ticks": [], "columns": [], "available": False}
+        # user nice system idle iowait irq softirq
+        ticks = [int(x) for x in first[1:8]]
+        return {"cpu_ticks": [ticks], "columns": [
+            "user", "nice", "system", "idle", "iowait", "irq", "softirq"
+        ], "available": True}
+
+    r.register("GET", "/3/Timeline", timeline_ep, "event timeline")
+    r.register("GET", "/3/JStack", jstack, "thread dump")
+    r.register("GET", "/3/Logs", logs_ep, "recent log lines")
+    r.register("GET", "/3/Logs/download", logs_download, "full log download")
+    r.register("GET", "/3/WaterMeterCpuTicks", watermeter, "cpu tick meter")
+    r.register("GET", "/3/Ping", lambda p: {"ok": True, "now": int(time.time() * 1000)},
+               "liveness probe")
+
+    # ---- model introspection (varimp / PDP / trees / word2vec) ------------
+    def model_varimp(params, model_id):
+        """Variable importances (ModelOutput varimp + /3/Models makeFI)."""
+        m = _get_model(model_id)
+        fn = getattr(m, "variable_importances", None)
+        if fn is None:
+            raise RestError(400, f"{m.algo_name} has no variable importances")
+        try:
+            vi = fn()
+        except NotImplementedError as e:
+            raise RestError(400, str(e))
+        ordered = sorted(vi.items(), key=lambda kv: -kv[1])
+        total = sum(v for _, v in ordered) or 1.0
+        return {
+            "varimp": [
+                {"variable": k, "relative_importance": v,
+                 "scaled_importance": v / (ordered[0][1] or 1.0),
+                 "percentage": v / total}
+                for k, v in ordered
+            ]
+        }
+
+    def partial_dependence(params):
+        """Synchronous PDP (api/ModelBuilders makePDP/fetchPDP): for each
+        requested column, sweep a grid and average the model's predictions
+        over the frame with that column overridden."""
+        m = _get_model(params.get("model_id", ""))
+        fr = _get_frame(params.get("frame_id", ""))
+        cols = params.get("cols") or []
+        if isinstance(cols, str):
+            if cols.startswith("["):
+                try:  # proper JSON first; python-repr fallback second
+                    cols = json.loads(cols)
+                except json.JSONDecodeError:
+                    cols = json.loads(cols.replace("'", '"'))
+            else:
+                cols = [cols]
+        if not cols:
+            raise RestError(400, "cols required")
+        nbins = int(params.get("nbins", 20))
+        out_tables = []
+        for col in cols:
+            if col not in fr.names:
+                raise RestError(404, f"column {col!r} not in frame")
+            c = fr.col(col)
+            if c.type is ColType.CAT:
+                values: List[Any] = list(range(len(c.domain)))
+                labels = list(c.domain)
+            else:
+                v = c.numeric_view()
+                lo, hi = float(np.nanmin(v)), float(np.nanmax(v))
+                values = list(np.linspace(lo, hi, nbins))
+                labels = [f"{x:.6g}" for x in values]
+            dom = m.data_info.response_domain if m.is_classifier else None
+            mean_resp: List[Any] = []
+            per_class: Dict[str, List[float]] = {lv: [] for lv in (dom or [])}
+            for val in values:
+                cols_copy = []
+                for cc in fr.columns:
+                    if cc.name == col:
+                        if cc.type is ColType.CAT:
+                            data = np.full(fr.nrows, val, dtype=np.int32)
+                            cols_copy.append(Column(cc.name, data, ColType.CAT, cc.domain))
+                        else:
+                            data = np.full(fr.nrows, float(val))
+                            cols_copy.append(Column(cc.name, data, ColType.NUM))
+                    else:
+                        cols_copy.append(cc)
+                pred = m.predict(Frame(cols_copy))
+                if m.is_classifier:
+                    # per-class probability curves (the reference's PDP is
+                    # per class; averaging one arbitrary column would be
+                    # silently wrong for multinomial)
+                    for lv in dom:
+                        per_class[lv].append(
+                            float(np.nanmean(pred.col(f"p{lv}").numeric_view()))
+                        )
+                else:
+                    mean_resp.append(
+                        float(np.nanmean(pred.col("predict").numeric_view()))
+                    )
+            table = {"column": col, "values": labels}
+            if m.is_classifier:
+                table["classes"] = dom
+                table["mean_response_per_class"] = per_class
+                # convenience: positive-class curve for binomial
+                table["mean_response"] = per_class[dom[-1]]
+            else:
+                table["mean_response"] = mean_resp
+            out_tables.append(table)
+        return {"partial_dependence_data": out_tables}
+
+    def tree_inspect(params, model_id, tree_number):
+        """Tree inspection (hex/schemas TreeV3 / h2o-py h2o.tree): node
+        arrays of one tree in heap layout."""
+        from h2o3_tpu.models.tree.common import TreeModelBase, tree_feature_names
+
+        m = _get_model(model_id)
+        if not isinstance(m, TreeModelBase):
+            raise RestError(400, f"{m.algo_name} is not a tree model")
+        t = int(tree_number)
+        cls = int(params.get("tree_class", 0))
+        b = m.booster
+        if not 0 <= cls < len(b.trees_per_class):
+            raise RestError(404, f"tree_class {cls} out of range")
+        trees = b.trees_per_class[cls]
+        if not 0 <= t < trees.ntrees:
+            raise RestError(404, f"tree {t} out of range (ntrees={trees.ntrees})")
+        names = tree_feature_names(m.data_info, m.tree_encoding)
+        feat = trees.feat[t]
+        is_split = trees.is_split[t]
+        edges = trees.edges
+        import math
+
+        thresholds = []
+        for i in range(len(feat)):
+            if is_split[i]:
+                f, sb = int(feat[i]), int(trees.split_bin[t][i])
+                # split 'bins <= sb go left' -> raw threshold = edge[sb];
+                # sb == nbins-1 separates non-NA from NA only (no finite
+                # threshold), and inf edge padding (low-cardinality
+                # features) is not valid JSON — both report null
+                if sb >= edges.shape[1]:
+                    thresholds.append(None)
+                else:
+                    e = float(edges[f][sb])
+                    thresholds.append(e if math.isfinite(e) else None)
+            else:
+                thresholds.append(None)
+        return {
+            "model_id": {"name": model_id},
+            "tree_number": t,
+            "tree_class": cls,
+            "features": [names[int(f)] if is_split[i] else None
+                         for i, f in enumerate(feat)],
+            "thresholds": thresholds,
+            "is_split": [bool(x) for x in is_split],
+            "default_left": [bool(x) for x in trees.default_left[t]],
+            "predictions": [float(x) for x in trees.leaf[t]],
+            "layout": "heap: children of node i are 2i+1 (left) / 2i+2",
+        }
+
+    def w2v_synonyms(params):
+        """/3/Word2VecSynonyms (word2vec REST extension)."""
+        from h2o3_tpu.models.word2vec import Word2VecModel
+
+        m = _get_model(params.get("model_id", ""))
+        if not isinstance(m, Word2VecModel):
+            raise RestError(400, f"{m.algo_name} is not a word2vec model")
+        word = params.get("word")
+        if not word:
+            raise RestError(400, "word required")
+        count = int(params.get("count", 10))
+        syn = m.find_synonyms(word, count)
+        return {"synonyms": list(syn.keys()), "scores": list(syn.values())}
+
+    def w2v_transform(params):
+        """/3/Word2VecTransform: words frame -> embedding frame."""
+        from h2o3_tpu.models.word2vec import Word2VecModel
+
+        m = _get_model(params.get("model_id", ""))
+        if not isinstance(m, Word2VecModel):
+            raise RestError(400, f"{m.algo_name} is not a word2vec model")
+        fr = _get_frame(params.get("words_frame", ""))
+        agg = params.get("aggregate_method", "none").lower()
+        vecs = m.transform(fr, aggregate_method=agg)
+        dest = params.get("destination_frame") or DKV.make_key("w2v")
+        DKV.put(dest, vecs)
+        return {"vectors_frame": {"name": dest}}
+
+    r.register("GET", "/3/Models/{model_id}/varimp", model_varimp,
+               "variable importances")
+    r.register("POST", "/3/PartialDependence", partial_dependence,
+               "partial dependence plot data")
+    r.register("GET", "/3/Trees/{model_id}/{tree_number}", tree_inspect,
+               "tree node inspection")
+    r.register("POST", "/3/Word2VecSynonyms", w2v_synonyms, "word synonyms")
+    r.register("POST", "/3/Word2VecTransform", w2v_transform,
+               "words -> embeddings")
+
+    # ---- synthetic data + munging utilities -------------------------------
+    def create_frame(params):
+        """/3/CreateFrame (hex/createframe recipes, simplified)."""
+        rows = int(params.get("rows", 10000))
+        cols = int(params.get("cols", 10))
+        seed = int(params.get("seed", -1))
+        rng = np.random.default_rng(None if seed == -1 else seed)
+        cat_frac = float(params.get("categorical_fraction", 0.2))
+        int_frac = float(params.get("integer_fraction", 0.2))
+        bin_frac = float(params.get("binary_fraction", 0.1))
+        missing_frac = float(params.get("missing_fraction", 0.0))
+        factors = int(params.get("factors", 5))
+        real_range = float(params.get("real_range", 100.0))
+        has_response = str(params.get("has_response", "false")).lower() in (
+            "true", "1", "yes")
+        response_factors = int(params.get("response_factors", 2))
+
+        n_cat = int(round(cols * cat_frac))
+        n_int = int(round(cols * int_frac))
+        n_bin = int(round(cols * bin_frac))
+        n_real = max(cols - n_cat - n_int - n_bin, 0)
+        out_cols: List[Column] = []
+        i = 0
+        for _ in range(n_real):
+            i += 1
+            data = rng.uniform(-real_range, real_range, rows)
+            if missing_frac:
+                data[rng.random(rows) < missing_frac] = np.nan
+            out_cols.append(Column(f"C{i}", data, ColType.NUM))
+        for _ in range(n_int):
+            i += 1
+            data = rng.integers(-100, 100, rows).astype(np.float64)
+            if missing_frac:
+                data[rng.random(rows) < missing_frac] = np.nan
+            out_cols.append(Column(f"C{i}", data, ColType.NUM))
+        for _ in range(n_bin):
+            i += 1
+            data = (rng.random(rows) < 0.5).astype(np.float64)
+            if missing_frac:
+                data[rng.random(rows) < missing_frac] = np.nan
+            out_cols.append(Column(f"C{i}", data, ColType.NUM))
+        for _ in range(n_cat):
+            i += 1
+            dom = [f"c{i}.l{j}" for j in range(factors)]
+            codes = rng.integers(0, factors, rows).astype(np.int32)
+            if missing_frac:
+                codes[rng.random(rows) < missing_frac] = -1
+            out_cols.append(Column(f"C{i}", codes, ColType.CAT, dom))
+        if has_response:
+            if response_factors > 1:
+                dom = [f"r{j}" for j in range(response_factors)]
+                codes = rng.integers(0, response_factors, rows).astype(np.int32)
+                out_cols.insert(0, Column("response", codes, ColType.CAT, dom))
+            else:
+                out_cols.insert(
+                    0, Column("response", rng.normal(size=rows), ColType.NUM)
+                )
+        dest = params.get("dest") or params.get("destination_frame") or DKV.make_key("frame")
+        fr = Frame(out_cols)
+        fr.key = dest
+        DKV.put(dest, fr)
+        return {"destination_frame": {"name": dest},
+                "rows": fr.nrows, "cols": fr.ncols}
+
+    def missing_inserter(params):
+        """/3/MissingInserter: punch NAs into a frame in place."""
+        key = params.get("dataset") or params.get("frame_id") or ""
+        fr = _get_frame(key)
+        frac = float(params.get("fraction", 0.1))
+        seed = int(params.get("seed", -1))
+        rng = np.random.default_rng(None if seed == -1 else seed)
+        new_cols = []
+        for c in fr.columns:
+            mask = rng.random(fr.nrows) < frac
+            if c.type is ColType.CAT:
+                data = np.where(mask, -1, c.data).astype(np.int32)
+                new_cols.append(Column(c.name, data, ColType.CAT, c.domain))
+            elif c.type in (ColType.NUM, ColType.TIME):
+                data = np.where(mask, np.nan, c.data.astype(np.float64))
+                new_cols.append(Column(c.name, data, c.type))
+            else:
+                data = c.data.copy()
+                data[mask] = None
+                new_cols.append(Column(c.name, data, c.type))
+        out = Frame(new_cols)
+        out.key = key
+        DKV.put(key, out)
+        return {"frame_id": {"name": key}}
+
+    r.register("POST", "/3/CreateFrame", create_frame, "synthetic frame")
+    r.register("POST", "/3/MissingInserter", missing_inserter, "insert NAs")
+
+    # ---- schema metadata (water/api/SchemaMetadata -> bindings codegen) ---
+    def _schema_of(pcls) -> Dict[str, Any]:
+        return {
+            "name": pcls.__name__,
+            "fields": [
+                {
+                    "name": f.name,
+                    "type": str(f.type),
+                    "default_value": _default_of(f),
+                }
+                for f in dataclasses.fields(pcls)
+            ],
+        }
+
+    def schemas_list(params):
+        return {"schemas": [
+            _schema_of(pcls) for _, pcls in sorted(
+                (a, p) for a, (_, p) in algos.items()
+            )
+        ]}
+
+    def schema_get(params, name):
+        for a, (_, pcls) in algos.items():
+            if pcls.__name__ == name or a == name.lower():
+                return {"schemas": [_schema_of(pcls)]}
+        raise RestError(404, f"no schema {name!r}")
+
+    r.register("GET", "/3/Metadata/schemas", schemas_list, "parameter schemas")
+    r.register("GET", "/3/Metadata/schemas/{name}", schema_get, "one schema")

@@ -129,9 +129,14 @@ class H2OServer:
                 return params
 
             def _respond(self, method: str) -> None:
+                from h2o3_tpu.util import timeline
+                from h2o3_tpu.util.log import get_logger
+
                 parsed = urllib.parse.urlparse(self.path)
+                get_logger("rest").info("%s %s", method, parsed.path)
                 try:
-                    out = registry.dispatch(method, parsed.path, self._params())
+                    with timeline.timed("rest", method=method, path=parsed.path):
+                        out = registry.dispatch(method, parsed.path, self._params())
                     if isinstance(out, (bytes, bytearray)):
                         self.send_response(200)
                         self.send_header("Content-Type", "application/octet-stream")

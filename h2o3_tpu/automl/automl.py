@@ -100,6 +100,8 @@ class AutoML:
         include_algos: Optional[Sequence[str]] = None,
         exclude_algos: Optional[Sequence[str]] = None,
         keep_cross_validation_predictions: bool = True,
+        preprocessing: Optional[Sequence[str]] = None,
+        exploitation_ratio: float = 0.1,
     ) -> None:
         self.max_models = max_models
         self.max_runtime_secs = max_runtime_secs
@@ -109,6 +111,15 @@ class AutoML:
         self.include_algos = set(a.lower() for a in include_algos) if include_algos else None
         self.exclude_algos = set(a.lower() for a in exclude_algos) if exclude_algos else set()
         self.keep_cv_preds = keep_cross_validation_predictions
+        #: ["target_encoding"] enables the TE preprocessing step
+        #: (h2o-automl/.../preprocessing/TargetEncoding.java)
+        self.preprocessing = [p.lower() for p in (preprocessing or [])]
+        for p_ in self.preprocessing:
+            if p_ != "target_encoding":
+                raise ValueError(f"unknown preprocessing step {p_!r}")
+        #: fraction of the budget reserved for refining the best model
+        #: (the reference's exploitation phase, AutoML exploitation_ratio)
+        self.exploitation_ratio = float(exploitation_ratio)
         self.project_key = DKV.make_key("automl")
         self.leaderboard = Leaderboard(sort_metric)
         self.event_log = EventLog()
@@ -116,15 +127,22 @@ class AutoML:
         self._y: Optional[str] = None
         self._ignored: List[str] = []
         self._nclasses: int = 1
+        self._te_model = None
         DKV.put(self.project_key, self)
 
     # -- budget (WorkAllocations.java) ---------------------------------------
-    def _out_of_budget(self) -> bool:
-        if self.max_models and len(self.leaderboard.models) >= self.max_models:
-            return True
-        if self.max_runtime_secs and (time.time() - self._t0) >= self.max_runtime_secs:
-            return True
-        return False
+    def _max_models_reached(self) -> bool:
+        # the reference does not count Stacked Ensembles against max_models
+        n = len([
+            m for m in self.leaderboard.models
+            if m.algo_name != "stackedensemble"
+        ])
+        return bool(self.max_models) and n >= self.max_models
+
+    def _out_of_time(self) -> bool:
+        return bool(self.max_runtime_secs) and (
+            time.time() - self._t0
+        ) >= self.max_runtime_secs
 
     def _algo_allowed(self, algo: str) -> bool:
         algo = algo.lower()
@@ -144,9 +162,59 @@ class AutoML:
         }
 
     def _one(self, builder_cls, params_cls, frame, **extra) -> List[Model]:
+        # pass the remaining wall-clock budget into builders that can
+        # enforce it mid-build (the booster's monitor hook); others keep
+        # step-boundary enforcement only
+        if self.max_runtime_secs and "max_runtime_secs" in getattr(
+            builder_cls, "SUPPORTED_COMMON", ()
+        ):
+            remaining = self.max_runtime_secs - (time.time() - self._t0)
+            if remaining > 0:
+                extra.setdefault("max_runtime_secs", remaining)
         p = params_cls(**self._common(extra))
         m = builder_cls(p).train(frame)
         return [m]
+
+    # -- preprocessing (preprocessing/TargetEncoding.java) -------------------
+    def _apply_target_encoding(self, frame: Frame) -> Frame:
+        """Fit a k-fold-leakage-safe target encoder on the training frame
+        and append <col>_te columns; the encoder model itself joins the DKV
+        so predict-time frames can be transformed identically."""
+        from h2o3_tpu.frame.frame import ColType
+        from h2o3_tpu.models.target_encoder import (
+            TargetEncoder,
+            TargetEncoderParameters,
+        )
+
+        cat_cols = [
+            c.name for c in frame.columns
+            if c.type is ColType.CAT and c.name != self._y
+            and c.name not in self._ignored
+        ]
+        if not cat_cols:
+            self.event_log.log(
+                "DataProcessing", "target encoding skipped: no categorical columns"
+            )
+            return frame
+        # nfolds stays 0 on the params (no model-level CV for a transform);
+        # the encoder's k_fold leakage handling defaults to 5 folds itself
+        te = TargetEncoder(
+            TargetEncoderParameters(
+                response_column=self._y,
+                columns_to_encode=cat_cols,
+                data_leakage_handling="k_fold",
+                blending=True,
+                seed=self.seed if self.seed != -1 else 42,
+            )
+        ).train(frame)
+        self._te_model = te
+        out = te.transform(frame, as_training=True)
+        self.event_log.log(
+            "DataProcessing",
+            f"target encoding applied to {len(cat_cols)} columns "
+            f"(k_fold leakage handling) -> {te.key}",
+        )
+        return out
 
     def _default_plan(self) -> List[_Step]:
         from h2o3_tpu.models.deeplearning import DeepLearning, DeepLearningParameters
@@ -164,11 +232,13 @@ class AutoML:
         # the reference's default plan order (AutoML.java defaultModelingPlan)
         add("xgboost", "def_1", 10, lambda a, f: a._one(
             XGBoost, XGBoostParameters, f, ntrees=50, max_depth=6, learn_rate=0.1))
-        if self._nclasses <= 2:  # this GLM has no multinomial family yet
-            add("glm", "def_1", 10, lambda a, f: a._one(
-                GLM, GLMParameters, f,
-                family="binomial" if a._nclasses == 2 else "gaussian",
-                alpha=0.5, lambda_=1e-4))
+        add("glm", "def_1", 10, lambda a, f: a._one(
+            GLM, GLMParameters, f,
+            family=(
+                "multinomial" if a._nclasses > 2
+                else "binomial" if a._nclasses == 2 else "gaussian"
+            ),
+            alpha=0.5, lambda_=1e-4))
         add("drf", "def_1", 10, lambda a, f: a._one(
             DRF, DRFParameters, f, ntrees=50, max_depth=12))
         add("gbm", "def_1", 10, lambda a, f: a._one(
@@ -180,6 +250,8 @@ class AutoML:
         add("xgboost", "def_2", 10, lambda a, f: a._one(
             XGBoost, XGBoostParameters, f, ntrees=100, max_depth=4, learn_rate=0.05))
         add("gbm", "grid_1", 20, self._gbm_grid)
+        if self.exploitation_ratio > 0:
+            steps.append(_Step("exploitation", 10, lambda a, f: a._exploitation(f)))
         add("stackedensemble", "best_of_family", 5,
             lambda a, f: a._stacked(f, best_of_family=True))
         add("stackedensemble", "all", 5, lambda a, f: a._stacked(f, best_of_family=False))
@@ -217,6 +289,47 @@ class AutoML:
         )
         grid = gs.train(frame)
         return list(grid.models)
+
+    def _exploitation(self, frame: Frame) -> List[Model]:
+        """Refine the current best tree model (the reference's exploitation
+        phase: AutoML spends exploitation_ratio of the budget improving the
+        champion rather than exploring): checkpoint-continue the leader's
+        booster with more trees at a lower learning rate."""
+        # only boosted champions: DRF has no learn_rate (nor mid-build
+        # budget support), and refining bagging with more trees at a lower
+        # rate is a boosting notion
+        leaders = [
+            m for m in self.leaderboard.models
+            if m.algo_name in ("gbm", "xgboost")
+        ]
+        if not leaders:
+            self.event_log.log("ModelTraining", "skip exploitation: no boosted leader")
+            return []
+        best = leaders[0]  # leaderboard sorted best-first
+        p = best.params
+        import dataclasses as _dc
+
+        # more boosting rounds at a lower learning rate around the champion
+        # (the reference's GBM lr-annealing / XGBoost lr exploitation steps)
+        kw = {f.name: getattr(p, f.name) for f in _dc.fields(p)}
+        kw.update(
+            ntrees=int(p.ntrees * 1.5) + 10,
+            learn_rate=max(getattr(p, "learn_rate", 0.1) * 0.75, 0.01),
+        )
+        if self.max_runtime_secs:
+            remaining = self.max_runtime_secs - (time.time() - self._t0)
+            if remaining <= 0:
+                return []
+            kw["max_runtime_secs"] = remaining
+        from h2o3_tpu.api.registry import algo_map
+
+        bcls, pcls = algo_map()[best.algo_name]
+        self.event_log.log(
+            "ModelTraining",
+            f"exploitation: refining {best.key} "
+            f"(ntrees {p.ntrees} -> {kw['ntrees']})",
+        )
+        return [bcls(pcls(**kw)).train(frame)]
 
     def _stacked(self, frame: Frame, best_of_family: bool) -> List[Model]:
         from h2o3_tpu.models.stacked_ensemble import (
@@ -261,10 +374,23 @@ class AutoML:
         ycol = training_frame.col(y)
         self._nclasses = len(ycol.domain) if ycol.domain else 1
 
+        if "target_encoding" in self.preprocessing:
+            try:
+                training_frame = self._apply_target_encoding(training_frame)
+            except Exception as e:  # preprocessing failure never kills the run
+                ev.log("DataProcessing", f"target encoding failed: {e}")
+
         for step in self._default_plan():
-            if self._out_of_budget():
-                ev.log("Workflow", f"budget exhausted before {step.id}")
+            if self._out_of_time():
+                ev.log("Workflow", f"time budget exhausted before {step.id}")
                 break
+            if self._max_models_reached() and not step.id.startswith(
+                "stackedensemble"
+            ):
+                # ensembles still run: they are not counted (reference
+                # AutoML max_models semantics)
+                ev.log("Workflow", f"max_models reached, skipping {step.id}")
+                continue
             ev.log("ModelTraining", f"step {step.id} starting")
             try:
                 models = step.build(self, training_frame)
@@ -272,6 +398,10 @@ class AutoML:
                 ev.log("ModelTraining", f"step {step.id} failed: {e}")
                 continue
             for m in models:
+                if self._te_model is not None:
+                    # raw frames score correctly: the model re-applies the
+                    # encoder at predict time (Model._apply_preprocessors)
+                    m.preprocessors = [self._te_model]
                 self.leaderboard.add(m)
                 v, _ = metric_value(m, self.sort_metric)
                 ev.log("ModelTraining", f"{step.id} -> {m.key} metric={v:.5f}")

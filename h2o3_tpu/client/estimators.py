@@ -94,11 +94,39 @@ class H2OModel:
 
 
 class H2OEstimator:
-    """Base estimator (h2o-py estimator_base.H2OEstimator)."""
+    """Base estimator (h2o-py estimator_base.H2OEstimator).
+
+    Every builder parameter is exposed: the accepted kwargs are exactly the
+    server-side Parameters dataclass fields (the h2o-py estimators are
+    code-generated from the same schemas, h2o-bindings/bin/gen_python.py:140)
+    — an unknown kwarg raises immediately instead of being silently dropped
+    at train time."""
 
     algo: str = "?"
+    _param_cache: Optional[frozenset] = None
+
+    @classmethod
+    def param_names(cls) -> frozenset:
+        """The server-side Parameters dataclass field names for this algo."""
+        if cls._param_cache is None:
+            import dataclasses
+
+            from h2o3_tpu.api.registry import algo_map
+
+            _, pcls = algo_map()[cls.algo]
+            cls._param_cache = frozenset(
+                f.name for f in dataclasses.fields(pcls)
+            )
+        return cls._param_cache
 
     def __init__(self, **params: Any) -> None:
+        if self.algo != "?":
+            unknown = set(params) - self.param_names() - {"model_id"}
+            if unknown:
+                raise TypeError(
+                    f"{type(self).__name__} got unknown parameters "
+                    f"{sorted(unknown)}; accepted: {sorted(self.param_names())}"
+                )
         self._params = params
         self.model: Optional[H2OModel] = None
 
@@ -110,6 +138,15 @@ class H2OEstimator:
         validation_frame: Optional[H2OFrame] = None,
     ) -> H2OModel:
         if training_frame is None:
+            if self.algo == "generic":  # artifact import needs no frame
+                from h2o3_tpu.client import connection
+
+                conn = connection()
+                out = conn.request(
+                    f"POST /3/ModelBuilders/{self.algo}", dict(self._params)
+                )
+                self.model = H2OModel(conn, out["model_id"]["name"])
+                return self.model
             raise ValueError("training_frame required")
         training_frame.refresh()
         payload: Dict[str, Any] = dict(self._params)
@@ -172,3 +209,4 @@ H2OStackedEnsembleEstimator = _make("stackedensemble", "H2OStackedEnsembleEstima
 H2OWord2vecEstimator = _make("word2vec", "H2OWord2vecEstimator")
 H2OAggregatorEstimator = _make("aggregator", "H2OAggregatorEstimator")
 H2OTargetEncoderEstimator = _make("targetencoder", "H2OTargetEncoderEstimator")
+H2OGenericEstimator = _make("generic", "H2OGenericEstimator")

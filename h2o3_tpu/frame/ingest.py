@@ -94,7 +94,9 @@ class PersistHTTP(Persist):
     def read_bytes(self, path: str) -> bytes:
         import urllib.request
 
-        with urllib.request.urlopen(path) as resp:
+        # bounded: an unresponsive host must error, not hang the importing
+        # thread forever
+        with urllib.request.urlopen(path, timeout=60) as resp:
             return resp.read()
 
     def list(self, path: str) -> List[str]:

@@ -283,9 +283,15 @@ class GlmMojoModel(MojoModel):
         if off_col:  # GLMModel._eta adds the per-row offset
             if isinstance(rows, _Columns):
                 v = rows.column(off_col)
-                # NaN propagates like the row path; only an ABSENT column
-                # means zero offset
-                off = np.asarray(v, dtype=np.float64) if v is not None else 0.0
+                if v is None:
+                    off = 0.0
+                else:
+                    # match the row path exactly: None entries are a zero
+                    # offset; NaN values propagate
+                    off = np.fromiter(
+                        (0.0 if e is None else float(e) for e in v),
+                        dtype=np.float64, count=len(rows),
+                    )
             else:
                 off = np.array(
                     [float(r.get(off_col) or 0.0) for r in rows], dtype=np.float64

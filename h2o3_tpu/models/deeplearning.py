@@ -152,7 +152,9 @@ class DeepLearningModel(Model):
 
 class DeepLearning(ModelBuilder):
 
-    SUPPORTED_COMMON = frozenset({"stopping_rounds", "checkpoint"})
+    SUPPORTED_COMMON = frozenset(
+        {"stopping_rounds", "checkpoint", "max_runtime_secs"}
+    )
     algo_name = "deeplearning"
 
     def _resolve_checkpoint(self, info, loss_kind: str):
@@ -304,6 +306,11 @@ class DeepLearning(ModelBuilder):
         total_epochs = int(np.ceil(p.epochs))
         start_epoch = int(prior.epochs_trained) if prior is not None else 0
         history: List[float] = []
+        import time as _time
+
+        deadline = (
+            _time.time() + p.max_runtime_secs if p.max_runtime_secs > 0 else None
+        )
 
         # RNG keyed by ABSOLUTE epoch/step index: k epochs then k more
         # reproduces a straight 2k-epoch run exactly (same design as the
@@ -322,6 +329,8 @@ class DeepLearning(ModelBuilder):
                 dk = jax.random.fold_in(ekey, s)
                 net, opt_state, loss = train_step(net, opt_state, xb, yb, dk)
             model.epochs_trained = epoch + 1
+            if deadline is not None and _time.time() >= deadline:
+                break
             if p.stopping_rounds > 0 and (epoch + 1) % p.score_interval == 0:
                 history.append(float(jax.device_get(loss)))
                 if M.stop_early(

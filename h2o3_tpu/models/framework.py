@@ -133,8 +133,22 @@ class Model:
     def _predict_raw(self, frame: Frame) -> np.ndarray:
         raise NotImplementedError
 
+    def _apply_preprocessors(self, frame: Frame) -> Frame:
+        """Models trained on a preprocessed frame (e.g. AutoML target
+        encoding) carry their transformers in ``self.preprocessors`` so a
+        RAW frame scores correctly (the reference embeds TE in the model
+        pipeline; here the transform re-applies at score time). A frame
+        already carrying the derived columns passes through untouched."""
+        for pre in getattr(self, "preprocessors", None) or []:
+            outs = [f"{name}_te" for name in getattr(pre, "encodings", {})]
+            if outs and all(o in frame.names for o in outs):
+                continue  # already transformed (e.g. the training frame)
+            frame = pre.transform(frame)
+        return frame
+
     def predict(self, frame: Frame) -> Frame:
         """Predictions frame: 'predict' (+ per-class probability columns)."""
+        frame = self._apply_preprocessors(frame)
         raw = self._predict_raw(frame)
         if not self.is_classifier:
             return Frame([Column("predict", raw.astype(np.float64), ColType.NUM)])
@@ -154,6 +168,7 @@ class Model:
         """Score a frame and build the right ModelMetrics (Model.score + MM builders)."""
         from h2o3_tpu.models.data_info import response_vector
 
+        frame = self._apply_preprocessors(frame)
         raw = self._predict_raw(frame)
         y = response_vector(self.data_info, frame)
         w = (
@@ -244,18 +259,33 @@ class ModelBuilder:
         raise NotImplementedError
 
     def train(self, frame: Frame, valid: Optional[Frame] = None) -> Model:
+        from h2o3_tpu.util import timeline
+        from h2o3_tpu.util.log import get_logger
+
+        log = get_logger("train")
         self._validate(frame)
         self.job = Job(f"{self.algo_name} train").start()
         t0 = time.time()
+        log.info(
+            "%s train start: %d rows x %d cols, response=%r",
+            self.algo_name, frame.nrows, frame.ncols,
+            self.params.response_column,
+        )
         try:
-            model = self._fit(frame, valid)
-            if self.params.nfolds >= 2 or self.params.fold_column:
-                self._cross_validate(model, frame)
+            with timeline.timed("train", algo=self.algo_name, rows=frame.nrows):
+                model = self._fit(frame, valid)
+                if self.params.nfolds >= 2 or self.params.fold_column:
+                    self._cross_validate(model, frame)
             model.run_time = time.time() - t0
             self.job.done()
+            log.info(
+                "%s train done in %.2fs -> %s", self.algo_name,
+                model.run_time, model.key,
+            )
             return model
         except BaseException as e:
             self.job.fail(e)
+            log.error("%s train failed: %s: %s", self.algo_name, type(e).__name__, e)
             raise
 
     # -- cross-validation (ModelBuilder.computeCrossValidation) --------------

@@ -1,0 +1,1 @@
+"""Host-side utilities: logging, timeline tracing, diagnostics."""

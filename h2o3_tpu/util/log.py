@@ -1,0 +1,79 @@
+"""Process-wide logging (water/util/Log.java analogue).
+
+Reference: log4j-backed ``Log`` with per-node files in the ice dir,
+buffered early logging before the file location is known, and ``/3/Logs``
+download from any node (``water/util/Log.java:26,103,258-269``,
+``util/GetLogsFromNode.java``).
+
+TPU-native/single-process: stdlib ``logging`` under the ``h2o3_tpu`` root
+logger, with (a) an in-memory ring of recent records that the ``/3/Logs``
+route serves without touching disk, and (b) an optional rotating file in
+the ice dir (``H2O3_TPU_LOG_DIR`` or init(dir=...)).
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import os
+import threading
+import time
+from typing import Deque, List, Optional
+
+_FORMAT = "%(asctime)s %(levelname)-5s %(name)s: %(message)s"
+_ROOT = "h2o3_tpu"
+
+_lock = threading.Lock()
+_ring: Deque[str] = collections.deque(maxlen=4096)
+_file_path: Optional[str] = None
+_initialized = False
+
+
+class _RingHandler(logging.Handler):
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            _ring.append(self.format(record))
+        except Exception:  # pragma: no cover - never raise from logging
+            pass
+
+
+def init(dir: Optional[str] = None, level: int = logging.INFO) -> None:
+    """Install the ring (+ optional file) handlers once; idempotent."""
+    global _initialized, _file_path
+    with _lock:
+        if _initialized:
+            return
+        root = logging.getLogger(_ROOT)
+        root.setLevel(level)
+        fmt = logging.Formatter(_FORMAT)
+        rh = _RingHandler()
+        rh.setFormatter(fmt)
+        root.addHandler(rh)
+        dir = dir or os.environ.get("H2O3_TPU_LOG_DIR")
+        if dir:
+            os.makedirs(dir, exist_ok=True)
+            _file_path = os.path.join(
+                dir, f"h2o3_tpu_{os.getpid()}_{int(time.time())}.log"
+            )
+            fh = logging.FileHandler(_file_path)
+            fh.setFormatter(fmt)
+            root.addHandler(fh)
+        _initialized = True
+
+
+def get_logger(name: str) -> logging.Logger:
+    """Module logger under the package root; auto-initializes the sinks."""
+    init()
+    if not name.startswith(_ROOT):
+        name = f"{_ROOT}.{name}"
+    return logging.getLogger(name)
+
+
+def recent(n: int = 1000) -> List[str]:
+    """Last n formatted log lines (the /3/Logs payload)."""
+    with _lock:
+        return list(_ring)[-n:]
+
+
+def log_file() -> Optional[str]:
+    return _file_path

@@ -1,0 +1,71 @@
+"""TimeLine — in-memory event ring for tracing (water/TimeLine.java).
+
+Reference: a lock-free ring of every UDP/TCP send/recv with ns timestamps,
+snapshotted over ``/3/Timeline`` (``water/TimeLine.java:22,75-110``,
+``init/TimelineSnapshot.java``).
+
+TPU-native: the interesting events are not packets (XLA owns transport)
+but the compute lifecycle — jit compiles, training blocks, REST requests,
+parse jobs, collectives-bearing steps. Each event is (ns timestamp, kind,
+fields); the ring keeps the most recent ``CAPACITY`` events and the
+``/3/Timeline`` route serves a snapshot.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Deque, Dict, List
+
+CAPACITY = 8192
+
+_lock = threading.Lock()
+_ring: Deque[Dict[str, Any]] = collections.deque(maxlen=CAPACITY)
+_counter = 0
+
+
+def record(kind: str, **fields: Any) -> None:
+    """Append one event; cheap enough for per-block/per-request use."""
+    global _counter
+    evt = {"ns": time.time_ns(), "kind": kind, **fields}
+    with _lock:
+        _counter += 1
+        evt["seq"] = _counter
+        _ring.append(evt)
+
+
+class timed:
+    """Context manager: records kind with duration_ms on exit."""
+
+    def __init__(self, kind: str, **fields: Any) -> None:
+        self.kind = kind
+        self.fields = fields
+
+    def __enter__(self) -> "timed":
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        record(
+            self.kind,
+            duration_ms=round((time.perf_counter() - self.t0) * 1e3, 3),
+            ok=exc[0] is None,
+            **self.fields,
+        )
+
+
+def snapshot(n: int = 1000) -> List[Dict[str, Any]]:
+    with _lock:
+        return list(_ring)[-n:]
+
+
+def total_events() -> int:
+    return _counter
+
+
+def clear() -> None:
+    global _counter
+    with _lock:
+        _ring.clear()
+        _counter = 0

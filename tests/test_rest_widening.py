@@ -1,0 +1,241 @@
+"""REST widening + observability — VERDICT r2 items 7 and 9.
+
+New routes: varimp, PartialDependence, Trees inspection, Word2Vec
+synonyms/transform, CreateFrame, MissingInserter, Metadata schemas,
+Logs, Timeline (real ring), JStack (real stacks), WaterMeterCpuTicks.
+Also: the no-silent-param guard at the REST boundary and estimator
+kwargs == builder dataclass fields."""
+
+import dataclasses
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.api import start_server
+
+CSV = "x0,x1,cat,y\n" + "\n".join(
+    f"{a:.3f},{b:.3f},{'u' if a > 0 else 'v'},{'yes' if a + b > 0 else 'no'}"
+    for a, b in np.random.default_rng(7).normal(size=(400, 2))
+)
+
+
+@pytest.fixture(scope="module")
+def server():
+    s = start_server(port=0)
+    yield s
+    s.stop()
+
+
+def _req(server, method, path, data=None, raw=False):
+    body = json.dumps(data).encode() if data is not None else None
+    req = urllib.request.Request(
+        server.url + path, data=body,
+        headers={"Content-Type": "application/json"} if body else {},
+        method=method,
+    )
+    try:
+        with urllib.request.urlopen(req) as resp:
+            payload = resp.read()
+            return resp.status, payload if raw else json.loads(payload)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+@pytest.fixture(scope="module")
+def gbm(server):
+    st, up = _req(server, "POST", "/3/PostFile", {"data": CSV})
+    assert st == 200
+    st, out = _req(server, "POST", "/3/Parse",
+                   {"source_frames": [up["destination_frame"]],
+                    "destination_frame": "wide_train"})
+    assert st == 200, out
+    st, out = _req(server, "POST", "/3/ModelBuilders/gbm",
+                   {"training_frame": "wide_train", "response_column": "y",
+                    "ntrees": 5, "max_depth": 3, "seed": 1, "min_rows": 5,
+                    "model_id": "wide_gbm"})
+    assert st == 200, out
+    return "wide_gbm"
+
+
+class TestModelIntrospection:
+    def test_varimp(self, server, gbm):
+        st, out = _req(server, "GET", f"/3/Models/{gbm}/varimp")
+        assert st == 200, out
+        vi = out["varimp"]
+        assert vi and vi[0]["scaled_importance"] == 1.0
+        assert abs(sum(v["percentage"] for v in vi) - 1.0) < 1e-6
+        names = {v["variable"] for v in vi}
+        assert {"x0", "x1"} <= names
+
+    def test_partial_dependence(self, server, gbm):
+        st, out = _req(server, "POST", "/3/PartialDependence",
+                       {"model_id": gbm, "frame_id": "wide_train",
+                        "cols": ["x0"], "nbins": 5})
+        assert st == 200, out
+        pd = out["partial_dependence_data"][0]
+        assert pd["column"] == "x0"
+        assert len(pd["values"]) == 5 and len(pd["mean_response"]) == 5
+        # x0 drives y upward: mean response should increase overall
+        assert pd["mean_response"][-1] > pd["mean_response"][0]
+
+    def test_tree_inspection(self, server, gbm):
+        st, out = _req(server, "GET", f"/3/Trees/{gbm}/0")
+        assert st == 200, out
+        assert out["tree_number"] == 0
+        assert any(out["is_split"])
+        # split nodes carry a feature + raw threshold
+        i = out["is_split"].index(True)
+        assert out["features"][i] in ("x0", "x1", "cat")
+        assert out["thresholds"][i] is not None
+        st, out = _req(server, "GET", f"/3/Trees/{gbm}/999")
+        assert st == 404
+
+    def test_word2vec_synonyms_and_transform(self, server):
+        # one (tokenized) word per row, like the reference's words frame
+        docs = (["king", "queen", "royal", "palace"] * 30
+                + ["dog", "cat", "pet", "animal"] * 30)
+        csv = "text\n" + "\n".join(docs)
+        st, up = _req(server, "POST", "/3/PostFile", {"data": csv})
+        st, out = _req(server, "POST", "/3/Parse",
+                       {"source_frames": [up["destination_frame"]],
+                        "destination_frame": "w2v_docs",
+                        "column_types": json.dumps(["string"])})
+        assert st == 200, out
+        st, out = _req(server, "POST", "/3/ModelBuilders/word2vec",
+                       {"training_frame": "w2v_docs", "vec_size": 8,
+                        "epochs": 2, "seed": 1, "model_id": "w2v_1"})
+        assert st == 200, out
+        st, out = _req(server, "POST", "/3/Word2VecSynonyms",
+                       {"model_id": "w2v_1", "word": "king", "count": 3})
+        assert st == 200, out
+        assert len(out["synonyms"]) <= 3
+        st, out = _req(server, "POST", "/3/Word2VecTransform",
+                       {"model_id": "w2v_1", "words_frame": "w2v_docs",
+                        "aggregate_method": "average"})
+        assert st == 200, out
+        assert "vectors_frame" in out
+
+
+class TestSyntheticData:
+    def test_create_frame(self, server):
+        st, out = _req(server, "POST", "/3/CreateFrame",
+                       {"rows": 500, "cols": 10, "seed": 3,
+                        "categorical_fraction": 0.2, "has_response": "true"})
+        assert st == 200, out
+        key = out["destination_frame"]["name"]
+        st, fr = _req(server, "GET", f"/3/Frames/{key}")
+        assert fr["frames"][0]["rows"] == 500
+        assert fr["frames"][0]["num_columns"] == 11  # + response
+
+    def test_missing_inserter(self, server):
+        st, out = _req(server, "POST", "/3/CreateFrame",
+                       {"rows": 400, "cols": 4, "seed": 4,
+                        "dest": "mi_frame"})
+        assert st == 200
+        st, out = _req(server, "POST", "/3/MissingInserter",
+                       {"dataset": "mi_frame", "fraction": 0.3, "seed": 5})
+        assert st == 200, out
+        st, fr = _req(server, "GET", "/3/Frames/mi_frame")
+        missing = sum(c["missing_count"] for c in fr["frames"][0]["columns"])
+        assert missing > 400 * 4 * 0.15  # ~30% +- noise
+
+
+class TestSchemasMetadata:
+    def test_schemas_list(self, server):
+        st, out = _req(server, "GET", "/3/Metadata/schemas")
+        assert st == 200
+        names = {s["name"] for s in out["schemas"]}
+        assert {"GBMParameters", "GLMParameters", "DRFParameters"} <= names
+
+    def test_schema_get(self, server):
+        st, out = _req(server, "GET", "/3/Metadata/schemas/GBMParameters")
+        assert st == 200
+        fields = {f["name"] for f in out["schemas"][0]["fields"]}
+        assert {"ntrees", "learn_rate", "monotone_constraints"} <= fields
+
+
+class TestObservability:
+    def test_training_leaves_timeline_trace(self, server, gbm):
+        """A GBM train leaves an inspectable trace over REST (VERDICT item
+        9 'done' criterion)."""
+        st, out = _req(server, "GET", "/3/Timeline?count=5000")
+        assert st == 200
+        kinds = {e["kind"] for e in out["events"]}
+        assert "train" in kinds
+        assert "tree_block" in kinds
+        assert "rest" in kinds
+        train_evts = [e for e in out["events"] if e["kind"] == "train"]
+        assert any(e.get("algo") == "gbm" and e.get("ok") for e in train_evts)
+        assert all("duration_ms" in e for e in train_evts)
+
+    def test_logs_capture_training(self, server, gbm):
+        st, out = _req(server, "GET", "/3/Logs")
+        assert st == 200
+        joined = "\n".join(out["lines"])
+        assert "gbm train start" in joined
+        assert "gbm train done" in joined
+
+    def test_logs_download(self, server):
+        st, raw = _req(server, "GET", "/3/Logs/download", raw=True)
+        assert st == 200
+        assert b"INFO" in raw
+
+    def test_jstack_has_real_stacks(self, server):
+        st, out = _req(server, "GET", "/3/JStack")
+        assert st == 200
+        main = [t for t in out["traces"] if t["stack"]]
+        assert main, "no thread produced a stack"
+        assert any("h2o3_tpu" in "".join(t["stack"]) for t in out["traces"])
+
+    def test_watermeter(self, server):
+        st, out = _req(server, "GET", "/3/WaterMeterCpuTicks")
+        assert st == 200
+        assert len(out["cpu_ticks"][0]) == 7
+
+    def test_ping(self, server):
+        st, out = _req(server, "GET", "/3/Ping")
+        assert st == 200 and out["ok"]
+
+
+class TestParamStrictness:
+    def test_unknown_train_param_is_400(self, server, gbm):
+        st, out = _req(server, "POST", "/3/ModelBuilders/gbm",
+                       {"training_frame": "wide_train", "response_column": "y",
+                        "ntreees": 5})
+        assert st == 400
+        assert "ntreees" in out["msg"]
+
+    def test_route_count(self, server):
+        st, out = _req(server, "GET", "/3/Metadata/endpoints")
+        assert st == 200
+        assert len(out["routes"]) >= 60, f"only {len(out['routes'])} routes"
+
+
+class TestEstimatorSurface:
+    def test_estimator_kwargs_match_builder_dataclasses(self):
+        """Every estimator exposes exactly its builder's params
+        (VERDICT item 7 'done' criterion)."""
+        import h2o3_tpu.client.estimators as est
+        from h2o3_tpu.api.registry import algo_map
+
+        algos = algo_map()
+        covered = set()
+        for name in dir(est):
+            cls = getattr(est, name)
+            if isinstance(cls, type) and issubclass(cls, est.H2OEstimator) \
+                    and cls is not est.H2OEstimator:
+                _, pcls = algos[cls.algo]
+                want = frozenset(f.name for f in dataclasses.fields(pcls))
+                assert cls.param_names() == want, cls.algo
+                covered.add(cls.algo)
+        assert covered >= set(algos) - {"svd"} or covered >= set(algos), (
+            sorted(set(algos) - covered)
+        )
+
+    def test_unknown_estimator_kwarg_raises(self):
+        from h2o3_tpu.client.estimators import H2OGradientBoostingEstimator
+
+        with pytest.raises(TypeError, match="ntreees"):
+            H2OGradientBoostingEstimator(ntreees=5)
