@@ -398,6 +398,34 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
             raise RestError(400, f"parse failed: {e}")
         return {"job": _job_schema(job), "destination_frame": {"name": dest}}
 
+    def import_sql(params):
+        """/3/ImportSQLTable (water/jdbc/SQLManager.java; sqlite here)."""
+        from h2o3_tpu.frame.ingest import import_sql_table
+
+        url = params.get("connection_url")
+        if not url:
+            raise RestError(400, "connection_url required")
+        cols = params.get("columns")
+        if isinstance(cols, str) and cols:
+            cols = [c for c in cols.split(",") if c]
+        try:
+            fr = import_sql_table(
+                url,
+                table=params.get("table"),
+                select_query=params.get("select_query"),
+                columns=cols or None,
+            )
+        except FileNotFoundError as e:
+            raise RestError(404, f"database not found: {e}")
+        except ValueError as e:
+            raise RestError(400, str(e))
+        dest = params.get("destination_frame") or DKV.make_key("sql")
+        fr.key = dest
+        DKV.put(dest, fr)
+        return {"destination_frame": {"name": dest},
+                "rows": fr.nrows, "cols": fr.ncols}
+
+    r.register("POST", "/3/ImportSQLTable", import_sql, "import a SQL table")
     r.register("POST", "/3/ImportFiles", import_files, "import a file")
     r.register("POST", "/3/PostFile", post_file, "upload a file body")
     r.register("POST", "/3/ParseSetup", parse_setup_ep, "guess parse setup")
@@ -407,7 +435,10 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
     def frames_list(params):
         out = []
         for k in DKV.keys_of_type(Frame):
-            v = DKV.get(k)
+            # peek: listing a spilled frame must not fault it back in
+            v = DKV.peek(k)
+            if v is None:
+                continue
             out.append({"frame_id": {"name": k}, "rows": v.nrows,
                         "num_columns": v.ncols})
         return {"frames": out}
@@ -846,6 +877,28 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
             raise RestError(400, f"grid import failed: {type(e).__name__}: {e}")
         return {"grid_id": {"name": g.grid_id}, "model_ids": g.model_ids}
 
+    def recovery_resume(params):
+        """/3/Recovery/resume (hex/faulttolerance autoRecover): resume an
+        interrupted Recoverable from its auto-recovery directory."""
+        from h2o3_tpu.recovery import Recovery, auto_recover
+
+        d = params.get("dir") or params.get("recovery_dir")
+        if not d:
+            raise RestError(400, "missing 'dir' (auto-recovery directory)")
+        if not Recovery.present(d):
+            raise RestError(404, f"no recovery snapshot in {d!r}")
+        try:
+            result = auto_recover(d)
+        except Exception as e:
+            raise RestError(400, f"recovery failed: {type(e).__name__}: {e}")
+        out: Dict[str, Any] = {"resumed": True}
+        if isinstance(result, Grid):
+            out["grid_id"] = {"name": result.grid_id}
+            out["model_ids"] = result.model_ids
+        return out
+
+    r.register("POST", "/3/Recovery/resume", recovery_resume,
+               "resume from auto-recovery snapshot")
     r.register("POST", "/99/Grid/{algo}", grid_train, "grid search")
     r.register("GET", "/99/Grids", grids_list, "list grids")
     r.register("GET", "/99/Grids/{grid_id}", grid_get, "grid details")
@@ -1267,3 +1320,52 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
 
     r.register("GET", "/3/Metadata/schemas", schemas_list, "parameter schemas")
     r.register("GET", "/3/Metadata/schemas/{name}", schema_get, "one schema")
+
+    # ---- Flow-lite (h2o-web: the notebook UI, here a minimal live console)
+    _FLOW_HTML = """<!DOCTYPE html>
+<html><head><title>h2o3-tpu Flow</title>
+<style>
+ body{font-family:monospace;margin:2em;background:#fafafa;color:#222}
+ h1{font-size:1.3em} h2{font-size:1.05em;margin-top:1.4em}
+ table{border-collapse:collapse;margin:.5em 0}
+ td,th{border:1px solid #ccc;padding:.25em .6em;text-align:left}
+ .muted{color:#888}
+</style></head>
+<body>
+<h1>h2o3-tpu <span class=muted>Flow-lite</span></h1>
+<div id=cloud class=muted>loading&hellip;</div>
+<h2>Frames</h2><table id=frames></table>
+<h2>Models</h2><table id=models></table>
+<h2>Jobs</h2><table id=jobs></table>
+<script>
+async function j(p){const r=await fetch(p);return r.json()}
+function row(t,cells,th){const tr=document.createElement('tr');
+ for(const c of cells){const td=document.createElement(th?'th':'td');
+  td.textContent=c;tr.appendChild(td)} t.appendChild(tr)}
+async function refresh(){
+ const c=await j('/3/Cloud');
+ document.getElementById('cloud').textContent=
+  c.cloud_name+' — '+c.version+' — devices: '+(c.devices||[]).join(', ');
+ const f=document.getElementById('frames');f.innerHTML='';
+ row(f,['frame','rows','cols'],true);
+ for(const fr of (await j('/3/Frames')).frames)
+  row(f,[fr.frame_id.name,fr.rows,fr.num_columns]);
+ const m=document.getElementById('models');m.innerHTML='';
+ row(m,['model','algo'],true);
+ for(const mo of (await j('/3/Models')).models)
+  row(m,[mo.model_id.name,mo.algo]);
+ const jb=document.getElementById('jobs');jb.innerHTML='';
+ row(jb,['job','status','progress','description'],true);
+ for(const job of (await j('/3/Jobs')).jobs)
+  row(jb,[job.key.name,job.status,Math.round(job.progress*100)+'%',job.description]);
+}
+refresh();setInterval(refresh,5000);
+</script></body></html>"""
+
+    def flow_page(params):
+        # (bytes, content-type): the server renders it as HTML, not a
+        # download (the plain-bytes branch is octet-stream for models)
+        return (_FLOW_HTML.encode(), "text/html; charset=utf-8")
+
+    r.register("GET", "/", flow_page, "Flow-lite console")
+    r.register("GET", "/flow/index.html", flow_page, "Flow-lite console")

@@ -82,9 +82,21 @@ def _json_default(o):
 
 
 class H2OServer:
-    """The server facade (h2o-webserver-iface HttpServerFacade analogue)."""
+    """The server facade (h2o-webserver-iface HttpServerFacade analogue).
 
-    def __init__(self, port: int = 54321, name: str = "h2o3-tpu") -> None:
+    Security (water/network + LoginType hash-file auth): ``ssl_cert``/
+    ``ssl_key`` wrap the listening socket in TLS (the reference's jetty SSL
+    config); ``auth_file`` — lines of ``user:sha256(password)`` — enables
+    HTTP Basic auth on every route (LoginType.HASH_FILE)."""
+
+    def __init__(
+        self,
+        port: int = 54321,
+        name: str = "h2o3-tpu",
+        ssl_cert: Optional[str] = None,
+        ssl_key: Optional[str] = None,
+        auth_file: Optional[str] = None,
+    ) -> None:
         self.name = name
         self.start_time = time.time()
         self.registry = RequestServer()
@@ -94,13 +106,45 @@ class H2OServer:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self.port = port
+        self.ssl_cert = ssl_cert
+        self.ssl_key = ssl_key
+        self._auth: Optional[Dict[str, str]] = None
+        if auth_file:
+            self._auth = {}
+            with open(auth_file) as f:
+                for line in f:
+                    line = line.strip()
+                    if line and ":" in line:
+                        user, hashed = line.split(":", 1)
+                        self._auth[user] = hashed.lower()
+
+    def _check_auth(self, header: Optional[str]) -> bool:
+        if self._auth is None:
+            return True
+        if not header or not header.startswith("Basic "):
+            return False
+        import base64
+        import hashlib
+
+        try:
+            user, _, password = (
+                base64.b64decode(header[6:]).decode().partition(":")
+            )
+        except Exception:
+            return False
+        want = self._auth.get(user)
+        return want is not None and (
+            hashlib.sha256(password.encode()).hexdigest() == want
+        )
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "H2OServer":
         registry = self.registry
+        srv = self
 
         class Handler(BaseHTTPRequestHandler):
             server_version = f"h2o3-tpu/{__version__}"
+            timeout = 120  # a dead client must not pin its thread forever
 
             def log_message(self, *a):  # quiet; the Log subsystem records
                 pass
@@ -134,12 +178,29 @@ class H2OServer:
 
                 parsed = urllib.parse.urlparse(self.path)
                 get_logger("rest").info("%s %s", method, parsed.path)
+                if not srv._check_auth(self.headers.get("Authorization")):
+                    body = json.dumps(
+                        {"http_status": 401, "msg": "authentication required"}
+                    ).encode()
+                    self.send_response(401)
+                    self.send_header("WWW-Authenticate", 'Basic realm="h2o3-tpu"')
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 try:
                     with timeline.timed("rest", method=method, path=parsed.path):
                         out = registry.dispatch(method, parsed.path, self._params())
+                    ctype = "application/octet-stream"
+                    if (
+                        isinstance(out, tuple) and len(out) == 2
+                        and isinstance(out[0], (bytes, bytearray))
+                    ):
+                        out, ctype = out
                     if isinstance(out, (bytes, bytearray)):
                         self.send_response(200)
-                        self.send_header("Content-Type", "application/octet-stream")
+                        self.send_header("Content-Type", ctype)
                         self.send_header("Content-Length", str(len(out)))
                         self.end_headers()
                         self.wfile.write(out)
@@ -181,6 +242,19 @@ class H2OServer:
                 self._respond("DELETE")
 
         self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
+        if self.ssl_cert:
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(self.ssl_cert, self.ssl_key)
+            # lazy handshake: with do_handshake_on_connect the handshake
+            # would run inside accept(), letting one stalled client block
+            # the accept loop for everyone; deferred, it happens on first
+            # read inside the per-connection handler thread
+            self._httpd.socket = ctx.wrap_socket(
+                self._httpd.socket, server_side=True,
+                do_handshake_on_connect=False,
+            )
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
         self._thread.start()
@@ -194,9 +268,11 @@ class H2OServer:
 
     @property
     def url(self) -> str:
-        return f"http://127.0.0.1:{self.port}"
+        scheme = "https" if self.ssl_cert else "http"
+        return f"{scheme}://127.0.0.1:{self.port}"
 
 
-def start_server(port: int = 0, name: str = "h2o3-tpu") -> H2OServer:
-    """Start a server on localhost (port 0 = OS-assigned)."""
-    return H2OServer(port=port, name=name).start()
+def start_server(port: int = 0, name: str = "h2o3-tpu", **kw) -> H2OServer:
+    """Start a server on localhost (port 0 = OS-assigned). Keyword args
+    pass through to H2OServer (ssl_cert/ssl_key/auth_file)."""
+    return H2OServer(port=port, name=name, **kw).start()

@@ -448,3 +448,75 @@ def import_parse(
     return rbind_all(
         [parse_source(src, fmt=fmt, **csv_kw) for src in sources]
     )
+
+
+# ---------------------------------------------------------------------------
+# SQL import (water/jdbc/SQLManager.java)
+
+
+def import_sql_table(
+    connection_url: str,
+    table: Optional[str] = None,
+    select_query: Optional[str] = None,
+    columns: Optional[Sequence[str]] = None,
+) -> Frame:
+    """Import a SQL table/query result as a Frame.
+
+    Reference: ``water/jdbc/SQLManager.java`` — range-partitioned parallel
+    selects over a JDBC driver. This build ships the driver available in a
+    pure-Python image: sqlite via the stdlib (``sqlite:/path`` or
+    ``jdbc:sqlite:/path`` URLs). Other engines raise an actionable error
+    naming the reference module, like the persist scheme registry does.
+    """
+    import sqlite3
+
+    url = connection_url
+    for prefix in ("jdbc:sqlite:", "sqlite://", "sqlite:"):
+        if url.lower().startswith(prefix):
+            path = url[len(prefix):]
+            break
+    else:
+        raise ValueError(
+            f"unsupported SQL connection url {connection_url!r}; this build "
+            f"ships sqlite ('sqlite:/path/db'); other engines need the "
+            f"reference's JDBC drivers (water/jdbc/SQLManager.java)"
+        )
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    if select_query is None:
+        if not table:
+            raise ValueError("either table or select_query is required")
+        if not re.fullmatch(r"[A-Za-z_][A-Za-z0-9_]*", table):
+            raise ValueError(f"invalid table name {table!r}")
+        cols_sql = "*"
+        if columns:
+            for c in columns:
+                if not re.fullmatch(r"[A-Za-z_][A-Za-z0-9_]*", c):
+                    raise ValueError(f"invalid column name {c!r}")
+            cols_sql = ", ".join(columns)
+        select_query = f"SELECT {cols_sql} FROM {table}"
+    conn = sqlite3.connect(path)
+    try:
+        cur = conn.execute(select_query)
+        names = [d[0] for d in cur.description]
+        rows = cur.fetchall()
+    finally:
+        conn.close()
+    from h2o3_tpu.frame.parse import column_from_strings
+
+    out: List[Column] = []
+    for j, name in enumerate(names):
+        vals = [r[j] for r in rows]
+        non_null = [v for v in vals if v is not None]
+        if all(isinstance(v, (int, float)) for v in non_null):
+            data = np.array(
+                [np.nan if v is None else float(v) for v in vals], np.float64
+            )
+            out.append(Column(name, data, ColType.NUM))
+        else:
+            out.append(
+                column_from_strings(
+                    name, [None if v is None else str(v) for v in vals]
+                )
+            )
+    return Frame(out)

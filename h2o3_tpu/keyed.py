@@ -17,23 +17,192 @@ import uuid
 from typing import Any, Dict, Iterator, List, Optional
 
 
+class _SpilledFrame:
+    """Disk-resident stand-in for a spilled Frame (the reference Cleaner's
+    LRU-persisted Value, ``water/Cleaner.java:10-12,155-162``). Carries the
+    listing metadata (nrows/ncols/names) so catalogs and /3/Frames never
+    fault the frame back in just to display it."""
+
+    def __init__(self, path: str, nbytes: int, nrows: int, ncols: int,
+                 names: List[str]) -> None:
+        self.path = path
+        self.nbytes = nbytes
+        self.nrows = nrows
+        self.ncols = ncols
+        self.names = names
+
+
+def _frame_nbytes(obj: Any) -> int:
+    cols = getattr(obj, "columns", None)
+    if cols is None or not hasattr(obj, "nrows"):
+        return 0
+    total = 0
+    try:
+        for c in cols:
+            data = getattr(c, "data", None)
+            total += getattr(data, "nbytes", 0)
+    except TypeError:
+        return 0
+    return total
+
+
 class KeyedStore:
-    """Process-local keyed object store with scoped temp-key tracking."""
+    """Process-local keyed object store with scoped temp-key tracking.
+
+    Memory manager: an optional host-memory budget for Frames
+    (``water/MemoryManager.java`` + the ``Cleaner`` "user-mode swap-to-disk",
+    ``water/Cleaner.java:10-12,155-162``): when resident frame bytes exceed
+    the budget, least-recently-used frames spill to the ice dir through
+    FramePersist and reload transparently on next access."""
 
     def __init__(self) -> None:
         self._store: Dict[str, Any] = {}
         self._lock = threading.RLock()
         self._scopes: List[List[str]] = []
+        self._budget: Optional[int] = None
+        self._ice_dir: Optional[str] = None
+        self._access: Dict[str, int] = {}  # frame key -> access counter
+        self._tick = 0
+
+    # -- memory manager / Cleaner --------------------------------------------
+    def set_memory_budget(
+        self, nbytes: Optional[int], ice_dir: Optional[str] = None
+    ) -> None:
+        """Enable (or disable with None) frame spilling above ``nbytes``."""
+        import os
+        import tempfile
+
+        with self._lock:
+            self._budget = nbytes
+            if nbytes is not None:
+                self._ice_dir = ice_dir or os.environ.get(
+                    "H2O3_TPU_ICE_ROOT"
+                ) or os.path.join(tempfile.gettempdir(), "h2o3_tpu_ice")
+                os.makedirs(self._ice_dir, exist_ok=True)
+            self._maybe_spill()
+
+    def resident_frame_bytes(self) -> int:
+        with self._lock:
+            return sum(_frame_nbytes(v) for v in self._store.values())
+
+    def spilled_keys(self) -> List[str]:
+        with self._lock:
+            return [
+                k for k, v in self._store.items() if isinstance(v, _SpilledFrame)
+            ]
+
+    def _maybe_spill(self) -> None:
+        """Spill LRU frames until under budget. Disk writes happen OUTSIDE
+        the store lock (a multi-hundred-MB serialize must not freeze every
+        concurrent DKV operation); the marker swap re-checks under the lock
+        that the frame was not replaced meanwhile."""
+        if self._budget is None:
+            return
+        import os
+
+        from h2o3_tpu.util.log import get_logger
+
+        while True:
+            with self._lock:
+                if self._budget is None:
+                    return
+                frames = {
+                    k: _frame_nbytes(v)
+                    for k, v in self._store.items()
+                    if _frame_nbytes(v) > 0
+                }
+                used = sum(frames.values())
+                if used <= self._budget or len(frames) <= 1:
+                    return
+                # oldest access first; never the most recently touched
+                newest = max(frames, key=lambda k: self._access.get(k, 0))
+                victims = sorted(frames, key=lambda k: self._access.get(k, 0))
+                victim = next((k for k in victims if k != newest), None)
+                if victim is None:
+                    return
+                fr = self._store[victim]
+                nbytes = frames[victim]
+                ice = self._ice_dir
+            path = os.path.join(ice, f"{victim}.h2f")
+            from h2o3_tpu.frame.persist import save_frame
+
+            save_frame(fr, path)  # I/O with no lock held
+            with self._lock:
+                if self._store.get(victim) is fr:  # unchanged meanwhile
+                    self._store[victim] = _SpilledFrame(
+                        path, nbytes, fr.nrows, fr.ncols, list(fr.names)
+                    )
+                    get_logger("cleaner").info(
+                        "spilled frame %s (%.1f MB) to %s",
+                        victim, nbytes / 1e6, path,
+                    )
+                else:
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+
+    def _unspill(self, key: str, marker: _SpilledFrame) -> Any:
+        """Reload a spilled frame; the disk read happens without the lock."""
+        import os
+
+        from h2o3_tpu.frame.persist import load_frame
+
+        fr = load_frame(marker.path)  # I/O with no lock held
+        fr.key = key
+        with self._lock:
+            cur = self._store.get(key)
+            if cur is marker:
+                self._store[key] = fr
+                try:
+                    os.unlink(marker.path)
+                except OSError:
+                    pass
+            elif not isinstance(cur, _SpilledFrame) and cur is not None:
+                fr = cur  # raced: someone else already restored/replaced it
+            self._tick += 1
+            self._access[key] = self._tick
+        self._maybe_spill()  # reloading may push another frame out
+        return fr
+
+    def _drop_value(self, key: str, v: Any) -> None:
+        # caller holds the lock; spill files die with their entries
+        import os
+
+        self._access.pop(key, None)
+        if isinstance(v, _SpilledFrame):
+            try:
+                os.unlink(v.path)
+            except OSError:
+                pass
 
     # -- DKV.put/get/remove (water/DKV.java:30-62) ---------------------------
     def put(self, key: str, value: Any) -> str:
+        spillable = _frame_nbytes(value) > 0
         with self._lock:
             self._store[key] = value
             if self._scopes:
                 self._scopes[-1].append(key)
+            if spillable:
+                self._tick += 1
+                self._access[key] = self._tick
+        if spillable:
+            self._maybe_spill()
         return key
 
     def get(self, key: str, default: Any = None) -> Any:
+        with self._lock:
+            v = self._store.get(key, default)
+            if not isinstance(v, _SpilledFrame):
+                if _frame_nbytes(v) > 0:
+                    self._tick += 1
+                    self._access[key] = self._tick
+                return v
+        return self._unspill(key, v)
+
+    def peek(self, key: str, default: Any = None) -> Any:
+        """The stored value WITHOUT faulting a spilled frame back in —
+        listings read nrows/ncols/names straight off the marker."""
         with self._lock:
             return self._store.get(key, default)
 
@@ -43,7 +212,8 @@ class KeyedStore:
 
     def remove(self, key: str) -> None:
         with self._lock:
-            self._store.pop(key, None)
+            v = self._store.pop(key, None)
+            self._drop_value(key, v)
 
     def rekey(self, obj: Any, new_key: str) -> str:
         """Re-register ``obj`` (which carries a ``.key`` attribute) under
@@ -66,10 +236,17 @@ class KeyedStore:
 
     def keys_of_type(self, cls: type) -> List[str]:
         with self._lock:
-            return [k for k, v in self._store.items() if isinstance(v, cls)]
+            return [
+                k for k, v in self._store.items()
+                if isinstance(v, cls)
+                # spilled frames are still frames to every listing
+                or (isinstance(v, _SpilledFrame) and cls.__name__ == "Frame")
+            ]
 
     def clear(self) -> None:
         with self._lock:
+            for k, v in list(self._store.items()):
+                self._drop_value(k, v)
             self._store.clear()
 
     @staticmethod
@@ -89,7 +266,8 @@ class KeyedStore:
                 return
             for k in self._scopes.pop():
                 if k not in keep_set:
-                    self._store.pop(k, None)
+                    v = self._store.pop(k, None)
+                    self._drop_value(k, v)
 
     def scope(self) -> "_ScopeCtx":
         return _ScopeCtx(self)

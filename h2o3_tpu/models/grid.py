@@ -188,19 +188,102 @@ class GridSearch:
         hyper_params: Dict[str, Sequence[Any]],
         search_criteria: Optional[SearchCriteria] = None,
         parallelism: int = 1,
+        recovery_dir: Optional[str] = None,
     ) -> None:
         self.builder_cls = builder_cls
         self.params = params
         self.hyper_params = dict(hyper_params)
         self.criteria = search_criteria or SearchCriteria()
         self.parallelism = max(1, int(parallelism))
+        #: auto-recovery snapshots (hex/faulttolerance/Recovery.java):
+        #: frames + params at start, every finished model as it completes
+        self.recovery_dir = recovery_dir
+        if recovery_dir and self.parallelism > 1:
+            raise ValueError("recovery_dir requires parallelism=1")
+        if (
+            recovery_dir
+            and self.criteria.strategy.lower() in ("randomdiscrete", "random_discrete")
+            and self.criteria.seed in (-1, None)
+        ):
+            # resume replays the walker; an unseeded random walk would skip
+            # DIFFERENT combos than the ones already trained
+            raise ValueError(
+                "recovery_dir with RandomDiscrete requires an explicit "
+                "search_criteria.seed (resume must replay the same walk)"
+            )
         for k in self.hyper_params:
             if not hasattr(params, k):
                 raise ValueError(f"unknown hyperparameter {k!r} for {builder_cls.__name__}")
 
     def train(self, frame: Frame, valid: Optional[Frame] = None) -> Grid:
-        c = self.criteria
+        rec = None
+        if self.recovery_dir:
+            from h2o3_tpu.recovery import Recovery
+
+            rec = Recovery(self.recovery_dir)
+            frames = {"train": frame}
+            if valid is not None:
+                frames["valid"] = valid
+            rec.on_start(
+                "grid",
+                {
+                    "algo": self.builder_cls.algo_name,
+                    "params": self.params,
+                    "hyper_params": self.hyper_params,
+                    "criteria": self.criteria,
+                },
+                frames,
+            )
+        grid = self._run(Grid(), frame, valid, rec, skip=0, scores=[])
+        if rec is not None:
+            rec.on_done()
+        return grid
+
+    @staticmethod
+    def _resume(rec, state, frames, models) -> Grid:
+        """Continue an interrupted search: finished models are NOT
+        re-trained; the walker replays deterministically and skips them
+        (Recovery.autoRecover best-effort continuation)."""
+        from h2o3_tpu.api.registry import algo_map
+
+        bcls, _ = algo_map()[state["algo"]]
+        gs = GridSearch(
+            bcls, state["params"], state["hyper_params"],
+            search_criteria=state["criteria"],
+        )
         grid = Grid()
+        meta = rec._read_meta()
+        scores: List[float] = []
+        larger = True
+        for entry, m in zip(meta["models"], models):
+            DKV.put(m.key, m)
+            grid.models.append(m)
+            grid.hyper_params.append(entry.get("hp", {}))
+            v, larger = metric_value(m, gs.criteria.stopping_metric)
+            scores.append(v)
+        failures = meta.get("failures", [])
+        for f_ in failures:
+            grid.failures.append((f_.get("hp", {}), f_.get("error", "?")))
+        # failed combos consumed walker positions too
+        grid = gs._run(
+            grid, frames["train"], frames.get("valid"), rec,
+            skip=len(models) + len(failures), scores=scores,
+            init_larger=larger,
+        )
+        rec.on_done()
+        return grid
+
+    def _run(
+        self,
+        grid: Grid,
+        frame: Frame,
+        valid: Optional[Frame],
+        rec,
+        skip: int,
+        scores: List[float],
+        init_larger: bool = True,
+    ) -> Grid:
+        c = self.criteria
         t0 = time.time()
         if c.strategy.lower() == "cartesian":
             walker = _cartesian(self.hyper_params)
@@ -208,13 +291,12 @@ class GridSearch:
             walker = _random_discrete(self.hyper_params, c.seed)
         else:
             raise ValueError(f"unknown strategy {c.strategy!r}")
-
-        scores: List[float] = []
+        if skip:
+            walker = itertools.islice(walker, skip, None)
         # metric direction comes from the first finished model (set in
-        # _record); True only as the pre-first-model placeholder — the
-        # stopped_early 2k-models guard means it is never actually consulted
-        # before a model exists
-        direction = {"larger": True}
+        # _record); on resume the preloaded scores arrive with their
+        # recovered direction so early stopping never compares inverted
+        direction = {"larger": init_larger}
 
         def build_one(hp: Dict[str, Any]):
             p = replace(self.params, **hp)
@@ -246,7 +328,7 @@ class GridSearch:
             for hp in walker:
                 if out_of_budget() or stopped_early():
                     break
-                self._build_into(grid, hp, build_one, scores, c, direction)
+                self._build_into(grid, hp, build_one, scores, c, direction, rec=rec)
         else:
             with ThreadPoolExecutor(max_workers=self.parallelism) as pool:
                 pending = []
@@ -269,12 +351,17 @@ class GridSearch:
         scores.append(v)
         direction["larger"] = larger
 
-    def _build_into(self, grid, hp, build_one, scores, c, direction) -> None:
+    def _build_into(self, grid, hp, build_one, scores, c, direction, rec=None) -> None:
         try:
             m = build_one(hp)
             self._record(grid, hp, m, scores, c, direction)
+            if rec is not None:  # durable progress: finished work survives a crash
+                rec.on_model(m, info={"hp": hp})
         except Exception as e:  # failed combos are recorded, not fatal
-            grid.failures.append((hp, f"{type(e).__name__}: {e}"))
+            msg = f"{type(e).__name__}: {e}"
+            grid.failures.append((hp, msg))
+            if rec is not None:  # failures consume walker positions too
+                rec.on_failure({"hp": hp, "error": msg})
 
     def _drain(self, grid, pending, scores, c, direction) -> None:
         for hp, fut in pending:

@@ -1,0 +1,143 @@
+"""Job-level fault tolerance: snapshot + resume (auto recovery).
+
+Reference: ``hex/faulttolerance/Recovery.java:21-53`` / ``Recoverable.java``
+— a Recoverable process (Grid search, AutoML) writes, under
+``-auto_recovery_dir``: its parameters and references (``recovery.json``),
+the referenced frames (FramePersist) and every finished model (binary
+export) as it completes; ``autoRecover`` finds that state after a restart
+and resumes the process so finished work is never re-trained. ``onDone``
+cleans the directory.
+
+TPU-native/single-process: the same split the reference chose — no
+in-flight elasticity (a died process loses the partial device program) but
+durable job state on disk, on the pickle-free persist formats. The
+snapshot is self-describing: ``resume()`` needs only the directory.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from typing import Any, Dict, List, Optional
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.keyed import DKV
+from h2o3_tpu.util.log import get_logger
+
+RECOVERY_META_FILE = "recovery.json"
+
+log = get_logger("recovery")
+
+
+class Recovery:
+    """Manages one Recoverable process's on-disk state."""
+
+    def __init__(self, dir: str) -> None:
+        self.dir = os.path.expanduser(dir)
+
+    # -- write side (Recovery.onStart / onModel / onDone) --------------------
+    def on_start(self, kind: str, state: Dict[str, Any], frames: Dict[str, Frame]) -> None:
+        """Persist everything needed to re-instantiate the process:
+        ``state`` goes through the allowlisted object-tree format, frames
+        through FramePersist."""
+        from h2o3_tpu.frame.persist import save_frame
+        from h2o3_tpu.models.persist import save_model
+
+        os.makedirs(self.dir, exist_ok=True)
+        frame_files = {}
+        for name, fr in frames.items():
+            frame_files[name] = os.path.basename(
+                save_frame(fr, os.path.join(self.dir, f"frame_{name}.h2f"))
+            )
+        save_model(state, os.path.join(self.dir, "state.bin"))
+        meta = {
+            "kind": kind,
+            "started": time.time(),
+            "frames": frame_files,
+            "models": [],
+        }
+        with open(os.path.join(self.dir, RECOVERY_META_FILE), "w") as f:
+            json.dump(meta, f)
+        log.info("recovery snapshot started in %s (%s)", self.dir, kind)
+
+    def on_model(self, model, info: Optional[Dict[str, Any]] = None) -> None:
+        """Persist one finished model and record it — after a crash, resume
+        skips everything listed here (best-effort continuation)."""
+        from h2o3_tpu.models.persist import save_model
+
+        path = os.path.join(self.dir, f"model_{model.key}.bin")
+        save_model(model, path)
+        meta = self._read_meta()
+        meta["models"].append(
+            {"key": model.key, "file": os.path.basename(path), **(info or {})}
+        )
+        with open(os.path.join(self.dir, RECOVERY_META_FILE), "w") as f:
+            json.dump(meta, f)
+
+    def on_failure(self, info: Dict[str, Any]) -> None:
+        """Record a combo that FAILED (not crashed): failures consume walker
+        positions too, so resume must account for them or it would re-train
+        duplicates and drop trailing combos."""
+        meta = self._read_meta()
+        meta.setdefault("failures", []).append(info)
+        with open(os.path.join(self.dir, RECOVERY_META_FILE), "w") as f:
+            json.dump(meta, f)
+
+    def on_done(self) -> None:
+        """Successful completion: recovery state is no longer needed."""
+        if os.path.isdir(self.dir):
+            shutil.rmtree(self.dir)
+        log.info("recovery snapshot cleaned up: %s", self.dir)
+
+    # -- read side (Recovery.autoRecover) ------------------------------------
+    def _read_meta(self) -> Dict[str, Any]:
+        with open(os.path.join(self.dir, RECOVERY_META_FILE)) as f:
+            return json.load(f)
+
+    @staticmethod
+    def present(dir: str) -> bool:
+        return os.path.exists(os.path.join(os.path.expanduser(dir), RECOVERY_META_FILE))
+
+    def load(self):
+        """Restore the snapshot: frames and finished models re-enter the
+        DKV; returns (kind, state, frames_by_name, models_in_order)."""
+        from h2o3_tpu.frame.persist import load_frame
+        from h2o3_tpu.models.persist import load_model
+
+        meta = self._read_meta()
+        frames = {}
+        for name, fname in meta["frames"].items():
+            fr = load_frame(os.path.join(self.dir, fname))
+            if fr.key:
+                DKV.put(fr.key, fr)
+            frames[name] = fr
+        models = []
+        for entry in meta["models"]:
+            try:
+                models.append(load_model(os.path.join(self.dir, entry["file"])))
+            except FileNotFoundError:
+                log.warning("recovery: model file %s missing, will retrain",
+                            entry["file"])
+        state = load_model(os.path.join(self.dir, "state.bin"), register=False)
+        log.info(
+            "recovery: restored %s with %d frames, %d finished models",
+            meta["kind"], len(frames), len(models),
+        )
+        return meta["kind"], state, frames, models
+
+
+def auto_recover(dir: Optional[str]):
+    """Resume an interrupted Recoverable found in ``dir`` (Recovery
+    .autoRecover). Currently Grid searches register themselves; returns the
+    finished result or None when there is nothing to recover."""
+    if not dir or not Recovery.present(dir):
+        return None
+    rec = Recovery(dir)
+    kind, state, frames, models = rec.load()
+    if kind == "grid":
+        from h2o3_tpu.models.grid import GridSearch
+
+        return GridSearch._resume(rec, state, frames, models)
+    raise ValueError(f"unknown recoverable kind {kind!r}")

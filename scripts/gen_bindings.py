@@ -1,0 +1,156 @@
+"""Client-bindings code generator.
+
+Reference: ``h2o-bindings/bin/gen_python.py:140,354`` — the h2o-py estimator
+classes are GENERATED from the server's live parameter schemas (via
+``/3/Metadata/schemas``), so the client surface can never drift from the
+server. This generator does the same: point it at a running server (or let
+it import the registry in-process) and it emits a static module of
+estimator classes with explicit keyword signatures and docstrings.
+
+Usage:
+    python scripts/gen_bindings.py out.py                # in-process registry
+    python scripts/gen_bindings.py out.py http://host:port  # over REST
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import urllib.request
+
+# runnable from anywhere: the repo root hosts the package
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+HEADER = '''"""GENERATED client estimator bindings — do not edit by hand.
+
+Regenerate with scripts/gen_bindings.py (the h2o-bindings/bin/gen_python.py
+analogue): the kwargs below are exactly the server-side Parameters
+dataclass fields at generation time.
+"""
+
+from h2o3_tpu.client.estimators import H2OEstimator
+
+'''
+
+CLASS_NAMES = {
+    "gbm": "H2OGradientBoostingEstimator",
+    "drf": "H2ORandomForestEstimator",
+    "xgboost": "H2OXGBoostEstimator",
+    "glm": "H2OGeneralizedLinearEstimator",
+    "gam": "H2OGeneralizedAdditiveEstimator",
+    "deeplearning": "H2ODeepLearningEstimator",
+    "kmeans": "H2OKMeansEstimator",
+    "naivebayes": "H2ONaiveBayesEstimator",
+    "pca": "H2OPrincipalComponentAnalysisEstimator",
+    "svd": "H2OSingularValueDecompositionEstimator",
+    "isolationforest": "H2OIsolationForestEstimator",
+    "extendedisolationforest": "H2OExtendedIsolationForestEstimator",
+    "coxph": "H2OCoxProportionalHazardsEstimator",
+    "glrm": "H2OGeneralizedLowRankEstimator",
+    "psvm": "H2OPSVMEstimator",
+    "rulefit": "H2ORuleFitEstimator",
+    "stackedensemble": "H2OStackedEnsembleEstimator",
+    "word2vec": "H2OWord2vecEstimator",
+    "aggregator": "H2OAggregatorEstimator",
+    "targetencoder": "H2OTargetEncoderEstimator",
+    "generic": "H2OGenericEstimator",
+}
+
+
+def schemas_from_registry():
+    import dataclasses
+
+    from h2o3_tpu.api.registry import algo_map
+
+    out = []
+    for algo, (_, pcls) in algo_map().items():
+        out.append({
+            "algo": algo,
+            "name": pcls.__name__,
+            "fields": [
+                {
+                    "name": f.name,
+                    "type": str(f.type),
+                    "default_value": (
+                        f.default
+                        if f.default is not dataclasses.MISSING
+                        and isinstance(f.default, (int, float, str, bool, type(None)))
+                        else None
+                    ),
+                }
+                for f in dataclasses.fields(pcls)
+            ],
+        })
+    return out
+
+
+def schemas_from_server(base_url: str):
+    with urllib.request.urlopen(base_url + "/3/Metadata/schemas") as resp:
+        schemas = json.loads(resp.read())["schemas"]
+    # map schema name back to algo via /3/ModelBuilders
+    with urllib.request.urlopen(base_url + "/3/ModelBuilders") as resp:
+        algos = list(json.loads(resp.read())["model_builders"])
+    by_name = {s["name"]: s for s in schemas}
+    out = []
+    for algo in algos:
+        for s in schemas:
+            stem = s["name"].replace("Parameters", "").lower()
+            if stem == algo.replace("_", ""):
+                out.append({**s, "algo": algo})
+                break
+    return out or [dict(s, algo=s["name"].replace("Parameters", "").lower())
+                   for s in by_name.values()]
+
+
+def generate(schemas) -> str:
+    chunks = [HEADER]
+    for s in sorted(schemas, key=lambda s: s["algo"]):
+        cls = CLASS_NAMES.get(s["algo"])
+        if cls is None:
+            continue
+        sig_parts = []
+        for f in s["fields"]:
+            d = f["default_value"]
+            sig_parts.append(f"        {f['name']}={d!r},  # {f['type']}")
+        sig = "\n".join(sig_parts)
+        chunks.append(
+            f'''class {cls}(H2OEstimator):
+    """Estimator for the {s["algo"]!r} algo ({s["name"]})."""
+
+    algo = "{s["algo"]}"
+
+    def __init__(
+        self,
+        *,
+{sig}
+        model_id=None,
+        **extra,
+    ):
+        kw = {{k: v for k, v in locals().items()
+              if k not in ("self", "extra", "__class__")}}
+        kw.update(extra)
+        # only non-default values travel to the server
+        defaults = {{{", ".join(f"{f['name']!r}: {f['default_value']!r}" for f in s["fields"])}, "model_id": None}}
+        kw = {{k: v for k, v in kw.items() if defaults.get(k, object()) != v}}
+        super().__init__(**kw)
+
+
+''')
+    return "".join(chunks)
+
+
+def main() -> None:
+    out_path = sys.argv[1] if len(sys.argv) > 1 else "generated_estimators.py"
+    if len(sys.argv) > 2:
+        schemas = schemas_from_server(sys.argv[2].rstrip("/"))
+    else:
+        schemas = schemas_from_registry()
+    code = generate(schemas)
+    with open(out_path, "w") as f:
+        f.write(code)
+    print(f"wrote {out_path}: {code.count('class ')} estimator classes")
+
+
+if __name__ == "__main__":
+    main()
