@@ -1,0 +1,118 @@
+"""CLI launcher: ``python -m h2o3_tpu`` starts a serving node.
+
+Reference: ``water/H2O.java:352-616,2238`` — the ``OptArgs`` CLI surface of
+``java -jar h2o.jar`` (-name -port -baseport -ice_root -nthreads -cleaner
+-auto_recovery_dir -jks/-hash_login ...) and the launcher modules
+(``h2o-app/H2OApp.java:3``, SURVEY.md L11).
+
+TPU-native: one process is one cloud (the device mesh is the "cluster");
+the launcher parses the OptArgs subset that still has meaning here, starts
+the REST server, optionally resumes interrupted Recoverables, and serves
+until interrupted.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m h2o3_tpu",
+        description="Start an h2o3-tpu serving node (REST API + Flow-lite).",
+    )
+    p.add_argument("--name", default="h2o3-tpu",
+                   help="cloud name (-name)")
+    p.add_argument("--port", type=int, default=54321,
+                   help="REST port (-port); 0 picks a free port")
+    p.add_argument("--ice-root", default=None,
+                   help="spill/log directory (-ice_root)")
+    p.add_argument("--max-mem", default=None,
+                   help="host-memory budget for frames before spilling, "
+                        "e.g. 4g / 512m (the -Xmx + -cleaner pair)")
+    p.add_argument("--auto-recovery-dir", default=None,
+                   help="resume an interrupted grid/AutoML from this "
+                        "directory at startup (-auto_recovery_dir)")
+    p.add_argument("--ssl-cert", default=None, help="TLS certificate (PEM)")
+    p.add_argument("--ssl-key", default=None, help="TLS private key (PEM)")
+    p.add_argument("--hash-login-file", default=None,
+                   help="user:sha256(password) lines enabling Basic auth "
+                        "(-hash_login)")
+    p.add_argument("--log-dir", default=None,
+                   help="write logs here in addition to the in-memory ring")
+    return p
+
+
+def _parse_mem(s: str) -> int:
+    s = s.strip().lower()
+    mult = 1
+    if s.endswith("g"):
+        mult, s = 1 << 30, s[:-1]
+    elif s.endswith("m"):
+        mult, s = 1 << 20, s[:-1]
+    elif s.endswith("k"):
+        mult, s = 1 << 10, s[:-1]
+    return int(float(s) * mult)
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from h2o3_tpu.util import log as L
+
+    L.init(dir=args.log_dir or args.ice_root)
+    logger = L.get_logger("launcher")
+
+    if args.max_mem:
+        from h2o3_tpu.keyed import DKV
+
+        DKV.set_memory_budget(_parse_mem(args.max_mem), ice_dir=args.ice_root)
+        logger.info("frame memory budget: %s (ice: %s)",
+                    args.max_mem, args.ice_root or "<tmp>")
+
+    from h2o3_tpu.api import start_server
+
+    server = start_server(
+        port=args.port,
+        name=args.name,
+        ssl_cert=args.ssl_cert,
+        ssl_key=args.ssl_key,
+        auth_file=args.hash_login_file,
+    )
+    logger.info("%s listening on %s", args.name, server.url)
+    print(f"h2o3-tpu node '{args.name}' up at {server.url}", flush=True)
+
+    if args.auto_recovery_dir:
+        from h2o3_tpu.recovery import Recovery, auto_recover
+
+        if Recovery.present(args.auto_recovery_dir):
+            logger.info("auto-recovering from %s", args.auto_recovery_dir)
+            try:
+                result = auto_recover(args.auto_recovery_dir)
+                logger.info("auto-recovery finished: %r", result)
+            except Exception as e:
+                logger.error("auto-recovery failed: %s: %s",
+                             type(e).__name__, e)
+
+    stop = {"flag": False}
+
+    def _sig(signum, frame):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGINT, _sig)
+    signal.signal(signal.SIGTERM, _sig)
+    try:
+        import time
+
+        while not stop["flag"]:
+            time.sleep(0.5)
+    finally:
+        server.stop()
+        logger.info("node stopped")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

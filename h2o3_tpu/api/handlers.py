@@ -456,13 +456,20 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
 
     def frame_delete(params, frame_id):
         _get_frame(frame_id)
-        DKV.remove(frame_id)
+        try:
+            DKV.remove(frame_id)
+        except ValueError as e:  # Lockable: in use by a running job
+            raise RestError(409, str(e))
         return {"frame_id": {"name": frame_id}}
 
     def frames_delete_all(params):
+        skipped = []
         for k in DKV.keys_of_type(Frame):
-            DKV.remove(k)
-        return {}
+            try:
+                DKV.remove(k)
+            except ValueError:  # locked by a running job: skip, not fail
+                skipped.append(k)
+        return {"skipped_locked": skipped}
 
     def download_dataset(params):
         """CSV straight from the columns — no pandas: the pandas/pyarrow
@@ -897,6 +904,46 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
             out["model_ids"] = result.model_ids
         return out
 
+    def udf_upload(params):
+        """/3/CustomMetric upload (water/udf CFuncRef; gated by
+        H2O3_TPU_ENABLE_UDF=1 — uploaded code is code execution)."""
+        from h2o3_tpu import udf
+
+        name = params.get("name")
+        source = params.get("source")
+        if not name or not source:
+            raise RestError(400, "name and source required")
+        try:
+            udf.compile_metric(name, source)
+        except PermissionError as e:
+            raise RestError(403, str(e))
+        except Exception as e:
+            raise RestError(400, f"bad UDF: {type(e).__name__}: {e}")
+        return {"name": name}
+
+    def udf_eval(params):
+        """Evaluate a registered custom metric on (model, frame)."""
+        from h2o3_tpu import udf
+
+        m = _get_model(params.get("model_id", ""))
+        fr = _get_frame(params.get("frame_id", ""))
+        name = params.get("name")
+        if not name:
+            raise RestError(400, "name required")
+        try:
+            fn = udf.get_metric(name)
+        except KeyError as e:
+            raise RestError(404, str(e))
+        try:
+            value = udf.custom_metric(m, fr, fn)
+        except Exception as e:  # data errors are the caller's 400, not 404
+            raise RestError(
+                400, f"metric evaluation failed: {type(e).__name__}: {e}"
+            )
+        return {"name": name, "value": value}
+
+    r.register("POST", "/3/CustomMetric", udf_upload, "upload a metric UDF")
+    r.register("POST", "/3/CustomMetric/eval", udf_eval, "evaluate a metric UDF")
     r.register("POST", "/3/Recovery/resume", recovery_resume,
                "resume from auto-recovery snapshot")
     r.register("POST", "/99/Grid/{algo}", grid_train, "grid search")

@@ -63,6 +63,36 @@ class KeyedStore:
         self._ice_dir: Optional[str] = None
         self._access: Dict[str, int] = {}  # frame key -> access counter
         self._tick = 0
+        #: Lockable (water/Lockable.java): key -> owners holding a read
+        #: lock; a read-locked key cannot be removed (a frame in use by a
+        #: running training job must not vanish under it)
+        self._read_locks: Dict[str, set] = {}
+
+    # -- Lockable (water/Lockable.java read/write locking) --------------------
+    def read_lock(self, key: str, owner: str) -> None:
+        with self._lock:
+            self._read_locks.setdefault(key, set()).add(owner)
+
+    def read_unlock(self, key: str, owner: str) -> None:
+        with self._lock:
+            owners = self._read_locks.get(key)
+            if owners is not None:
+                owners.discard(owner)
+                if not owners:
+                    del self._read_locks[key]
+
+    def locked_by(self, key: str) -> List[str]:
+        with self._lock:
+            return sorted(self._read_locks.get(key, ()))
+
+    def _check_unlocked(self, key: str) -> None:
+        # caller holds the lock
+        owners = self._read_locks.get(key)
+        if owners:
+            raise ValueError(
+                f"{key!r} is locked by {sorted(owners)} and cannot be "
+                f"removed or replaced (Lockable)"
+            )
 
     # -- memory manager / Cleaner --------------------------------------------
     def set_memory_budget(
@@ -180,6 +210,11 @@ class KeyedStore:
     def put(self, key: str, value: Any) -> str:
         spillable = _frame_nbytes(value) > 0
         with self._lock:
+            # replacing a read-locked registration with a DIFFERENT object
+            # is deletion in disguise (Lockable); re-putting the same
+            # object is a harmless refresh
+            if key in self._read_locks and self._store.get(key) is not value:
+                self._check_unlocked(key)
             self._store[key] = value
             if self._scopes:
                 self._scopes[-1].append(key)
@@ -212,6 +247,7 @@ class KeyedStore:
 
     def remove(self, key: str) -> None:
         with self._lock:
+            self._check_unlocked(key)
             v = self._store.pop(key, None)
             self._drop_value(key, v)
 
@@ -223,6 +259,7 @@ class KeyedStore:
         with self._lock:
             old = getattr(obj, "key", None)
             if old and self._store.get(old) is obj:
+                self._check_unlocked(old)
                 self._store.pop(old, None)
             obj.key = new_key
             self._store[new_key] = obj
@@ -244,7 +281,9 @@ class KeyedStore:
             ]
 
     def clear(self) -> None:
+        """Nuke the world (tests / shutdown): locks clear with the store."""
         with self._lock:
+            self._read_locks.clear()
             for k, v in list(self._store.items()):
                 self._drop_value(k, v)
             self._store.clear()
@@ -265,9 +304,12 @@ class KeyedStore:
             if not self._scopes:
                 return
             for k in self._scopes.pop():
-                if k not in keep_set:
-                    v = self._store.pop(k, None)
-                    self._drop_value(k, v)
+                if k in keep_set:
+                    continue
+                if self._read_locks.get(k):
+                    continue  # in use by a running job: defer, never yank
+                v = self._store.pop(k, None)
+                self._drop_value(k, v)
 
     def scope(self) -> "_ScopeCtx":
         return _ScopeCtx(self)

@@ -271,6 +271,13 @@ class ModelBuilder:
             self.algo_name, frame.nrows, frame.ncols,
             self.params.response_column,
         )
+        # Lockable: the training frame(s) must not be deleted mid-build
+        locked = [
+            fr.key for fr in (frame, valid)
+            if fr is not None and getattr(fr, "key", None)
+        ]
+        for k in locked:
+            DKV.read_lock(k, self.job.key)
         try:
             with timeline.timed("train", algo=self.algo_name, rows=frame.nrows):
                 model = self._fit(frame, valid)
@@ -287,6 +294,9 @@ class ModelBuilder:
             self.job.fail(e)
             log.error("%s train failed: %s: %s", self.algo_name, type(e).__name__, e)
             raise
+        finally:
+            for k in locked:
+                DKV.read_unlock(k, self.job.key)
 
     # -- cross-validation (ModelBuilder.computeCrossValidation) --------------
     def _cross_validate(self, main_model: Model, frame: Frame) -> None:

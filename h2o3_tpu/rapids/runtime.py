@@ -163,10 +163,15 @@ class Session:
             self.temps.remove(key)
 
     def end(self) -> int:
-        """Sweep temps (Session.end)."""
+        """Sweep temps (Session.end). A temp read-locked by a running
+        training job is skipped (Lockable) — aborting the sweep on it
+        would leak every remaining temp."""
         n = len(self.temps)
         for key in list(self.temps):
-            self.remove(key)
+            try:
+                self.remove(key)
+            except ValueError:
+                self.frames.pop(key, None)  # in use: leave it in the DKV
         self.temps.clear()
         return n
 

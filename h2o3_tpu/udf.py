@@ -1,0 +1,79 @@
+"""User-defined functions: custom model metrics.
+
+Reference: ``water/udf/`` — ``CFuncRef``/``CMetricFunc``: users upload
+metric code that runs in-cluster during scoring (``CFuncTask``); the
+jython-cfunc extension loads Python sources the same way.
+
+TPU-native/single-process: a custom metric is a plain Python callable
+``fn(actual, predicted) -> float`` over numpy arrays. In-process callers
+pass the callable directly; the REST route accepts SOURCE TEXT and is
+gated behind ``H2O3_TPU_ENABLE_UDF=1`` because compiling uploaded code is
+arbitrary code execution — the same trust model as the reference's
+uploaded Jython, but opt-in instead of default-on.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict
+
+import numpy as np
+
+from h2o3_tpu.util.log import get_logger
+
+MetricFunc = Callable[[np.ndarray, np.ndarray], float]
+
+#: registered custom metrics by name (CFuncRef's DKV-backed registry)
+_REGISTRY: Dict[str, MetricFunc] = {}
+
+
+def register_metric(name: str, fn: MetricFunc) -> str:
+    """Register a callable metric under a name (in-process API)."""
+    _REGISTRY[name] = fn
+    return name
+
+
+def get_metric(name: str) -> MetricFunc:
+    if name not in _REGISTRY:
+        raise KeyError(f"no custom metric {name!r} registered")
+    return _REGISTRY[name]
+
+
+def compile_metric(name: str, source: str) -> str:
+    """Compile uploaded metric SOURCE (a module defining ``metric(actual,
+    predicted)``) and register it. Gated: uploaded code is code execution.
+
+    Reference: water/udf/CFuncRef + jython-cfunc — the reference runs
+    uploaded code by default; here the operator must opt in."""
+    if os.environ.get("H2O3_TPU_ENABLE_UDF") != "1":
+        raise PermissionError(
+            "uploaded UDFs are disabled; set H2O3_TPU_ENABLE_UDF=1 to allow "
+            "compiling user metric code on this node"
+        )
+    namespace: Dict[str, object] = {"np": np, "numpy": np}
+    exec(compile(source, f"<udf:{name}>", "exec"), namespace)
+    fn = namespace.get("metric")
+    if not callable(fn):
+        raise ValueError("UDF source must define a callable `metric(actual, predicted)`")
+    get_logger("udf").info("registered uploaded metric %r", name)
+    _REGISTRY[name] = fn  # type: ignore[assignment]
+    return name
+
+
+def custom_metric(model, frame, fn_or_name) -> float:
+    """Evaluate a custom metric for a model on a frame
+    (ModelMetrics.CustomMetric analogue): actual response vs the model's
+    primary prediction (positive-class probability for binomial, class
+    index for multinomial, value for regression)."""
+    from h2o3_tpu.models.data_info import response_vector
+
+    fn = get_metric(fn_or_name) if isinstance(fn_or_name, str) else fn_or_name
+    frame = model._apply_preprocessors(frame)
+    raw = model._predict_raw(frame)
+    y = response_vector(model.data_info, frame)
+    if model.is_classifier:
+        pred = raw[:, 1] if model.nclasses == 2 else raw.argmax(axis=1)
+    else:
+        pred = raw
+    keep = ~np.isnan(y)
+    return float(fn(y[keep], np.asarray(pred)[keep]))
