@@ -1236,6 +1236,28 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
         DKV.put(dest, vecs)
         return {"vectors_frame": {"name": dest}}
 
+    def predict_contribs(params, model_id, frame_id):
+        """SHAP contributions over REST (the predict_contributions flag of
+        /3/Predictions in the reference)."""
+        m = _get_model(model_id)
+        fr = _get_frame(frame_id)
+        fn = getattr(m, "predict_contributions", None)
+        if fn is None:
+            raise RestError(400, f"{m.algo_name} has no SHAP contributions")
+        try:
+            contribs = fn(fr)
+        except ValueError as e:
+            raise RestError(400, str(e))
+        dest = params.get("predictions_frame") or DKV.make_key("contrib")
+        contribs.key = dest
+        DKV.put(dest, contribs)
+        return {"predictions_frame": {"name": dest},
+                "columns": contribs.names}
+
+    r.register(
+        "POST", "/3/PredictContributions/models/{model_id}/frames/{frame_id}",
+        predict_contribs, "SHAP prediction contributions",
+    )
     r.register("GET", "/3/Models/{model_id}/varimp", model_varimp,
                "variable importances")
     r.register("POST", "/3/PartialDependence", partial_dependence,

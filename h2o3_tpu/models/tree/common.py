@@ -17,7 +17,7 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
-from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.frame.frame import ColType, Frame
 from h2o3_tpu.models.data_info import DataInfo, _align_codes, build_data_info
 from h2o3_tpu.models.framework import Model
 from h2o3_tpu.models import metrics as M
@@ -510,6 +510,22 @@ class TreeModelBase(Model):
             if self.is_classifier
             else link_inverse(self.distribution, margin[:, 0])
         )
+
+    def predict_contributions(self, frame: Frame, background_frame=None) -> Frame:
+        """Exact per-feature SHAP contributions on the margin scale
+        (Model.scoreContributions / TreeSHAPPredictor): one column per tree
+        feature plus BiasTerm; rows sum to the raw margin exactly."""
+        from h2o3_tpu.frame.frame import Column
+        from h2o3_tpu.models.tree.shap import predict_contributions as _pc
+
+        contribs = _pc(self, frame, background_frame=background_frame)
+        names = tree_feature_names(self.data_info, self.tree_encoding)
+        cols = [
+            Column(names[j], contribs[:, j], ColType.NUM)
+            for j in range(len(names))
+        ]
+        cols.append(Column("BiasTerm", contribs[:, -1], ColType.NUM))
+        return Frame(cols)
 
     def variable_importances(self) -> dict:
         """Split-count/gain-weighted importances (SharedTree varimp analogue:

@@ -1,0 +1,167 @@
+"""TreeSHAP prediction contributions.
+
+Reference: h2o-genmodel/.../algos/tree/TreeSHAP.java (+Predictor) — exact
+Shapley values with the path-dependent (cover-weighted) conditional
+expectation. Oracles: local accuracy (contributions + bias == margin,
+exactly) and brute-force subset-enumeration Shapley on small feature sets.
+"""
+
+import itertools
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from h2o3_tpu import Frame
+from h2o3_tpu.models.tree import GBM
+from h2o3_tpu.models.tree.shap import node_covers, predict_contributions
+
+
+def _expvalue(feat, sb, dl, sp, leaf, covers, x_bins, n_bins1, S):
+    """Brute-force EXPVALUE(x, S): follow x for features in S, else
+    cover-weighted average over children (the path-dependent semantics)."""
+
+    def go(node):
+        if not sp[node]:
+            return float(leaf[node])
+        f = int(feat[node])
+        l, r = 2 * node + 1, 2 * node + 2
+        if f in S:
+            b = int(x_bins[f])
+            go_left = dl[node] if b >= n_bins1 - 1 else b <= int(sb[node])
+            return go(l if go_left else r)
+        cov = covers[node] or 1.0
+        return (covers[l] * go(l) + covers[r] * go(r)) / cov
+
+    return go(0)
+
+
+def _brute_shapley(feat, sb, dl, sp, leaf, covers, x_bins, n_bins1, F):
+    import math
+
+    phi = np.zeros(F)
+    feats = list(range(F))
+    for j in feats:
+        others = [f for f in feats if f != j]
+        for k in range(len(others) + 1):
+            for S in itertools.combinations(others, k):
+                w = (
+                    math.factorial(len(S))
+                    * math.factorial(F - len(S) - 1)
+                    / math.factorial(F)
+                )
+                v1 = _expvalue(feat, sb, dl, sp, leaf, covers, x_bins,
+                               n_bins1, set(S) | {j})
+                v0 = _expvalue(feat, sb, dl, sp, leaf, covers, x_bins,
+                               n_bins1, set(S))
+                phi[j] += w * (v1 - v0)
+    return phi
+
+
+@pytest.fixture()
+def reg_model(rng):
+    n = 800
+    X = rng.normal(size=(n, 3))
+    y = 2 * X[:, 0] - X[:, 1] + 0.5 * X[:, 0] * X[:, 2] + 0.1 * rng.normal(size=n)
+    fr = Frame.from_dict({f"x{i}": X[:, i] for i in range(3)} | {"y": y})
+    m = GBM(response_column="y", ntrees=8, max_depth=3, seed=3,
+            min_rows=5.0).train(fr)
+    return m, fr
+
+
+class TestTreeShap:
+    def test_local_accuracy_regression(self, reg_model):
+        """Σ contributions + bias == raw margin, exactly (TreeSHAP's
+        defining property)."""
+        m, fr = reg_model
+        contribs = predict_contributions(m, fr)
+        margin = m.booster.predict_margin(
+            np.asarray(
+                np.stack([fr.col(f"x{i}").data for i in range(3)], axis=1),
+                dtype=np.float32,
+            )
+        )[:, 0]
+        np.testing.assert_allclose(contribs.sum(axis=1), margin,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_matches_brute_force_shapley(self, reg_model):
+        """Exact parity with subset-enumeration Shapley values per tree."""
+        from h2o3_tpu.models.tree.common import tree_matrix
+        from h2o3_tpu.ops.histogram import apply_bins
+
+        m, fr = reg_model
+        trees = m.booster.trees_per_class[0]
+        X = tree_matrix(m.data_info, fr)
+        bins = apply_bins(X, trees.edges)
+        contribs = predict_contributions(m, fr)
+
+        # check a handful of rows against the brute-force oracle, summed
+        # over all trees
+        for i in (0, 7, 123):
+            want = np.zeros(3)
+            for t in range(trees.ntrees):
+                covers = node_covers(
+                    trees.feat[t], trees.split_bin[t], trees.default_left[t],
+                    trees.is_split[t], bins, trees.n_bins1, trees.max_depth,
+                )
+                want += _brute_shapley(
+                    trees.feat[t], trees.split_bin[t], trees.default_left[t],
+                    trees.is_split[t], trees.leaf[t].astype(np.float64),
+                    covers, bins[i], trees.n_bins1, 3,
+                )
+            np.testing.assert_allclose(contribs[i, :3], want, rtol=1e-6,
+                                       atol=1e-8)
+
+    def test_binomial_and_background_frame(self, rng):
+        n = 600
+        X = rng.normal(size=(n, 2))
+        y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(int)
+        fr = Frame.from_dict({
+            "x0": X[:, 0], "x1": X[:, 1],
+            "y": np.where(y > 0, "yes", "no"),
+        })
+        m = GBM(response_column="y", ntrees=5, max_depth=3, seed=1,
+                min_rows=5.0).train(fr)
+        contribs = predict_contributions(m, fr, background_frame=fr[["x0", "x1"]])
+        # local accuracy on the logit margin
+        from h2o3_tpu.models.tree.common import tree_matrix
+
+        margin = m.booster.predict_margin(tree_matrix(m.data_info, fr))[:, 0]
+        np.testing.assert_allclose(contribs.sum(axis=1), margin,
+                                   rtol=1e-5, atol=1e-5)
+        # the signal feature dominates the contributions
+        assert np.abs(contribs[:, 0]).mean() > np.abs(contribs[:, 1]).mean()
+
+    def test_multinomial_rejected(self, rng):
+        n = 300
+        fr = Frame.from_dict({
+            "x": rng.normal(size=n),
+            "y": np.array(["a", "b", "c"])[rng.integers(0, 3, n)],
+        })
+        m = GBM(response_column="y", ntrees=2, max_depth=2, seed=1).train(fr)
+        with pytest.raises(ValueError, match="regression/binomial"):
+            predict_contributions(m, fr)
+
+    def test_over_rest(self, reg_model):
+        from h2o3_tpu.api import start_server
+        from h2o3_tpu.keyed import DKV
+
+        m, fr = reg_model
+        fr.key = "shap_fr"
+        DKV.put(fr.key, fr)
+        s = start_server(port=0)
+        try:
+            req = urllib.request.Request(
+                s.url + f"/3/PredictContributions/models/{m.key}/frames/shap_fr",
+                data=b"{}", headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req) as resp:
+                out = json.loads(resp.read())
+            assert out["columns"][-1] == "BiasTerm"
+            contribs = DKV.get(out["predictions_frame"]["name"])
+            assert contribs.nrows == fr.nrows
+        finally:
+            s.stop()
+            DKV.remove("shap_fr")
