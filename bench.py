@@ -9,59 +9,89 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 vs_baseline is relative to the previous round's recorded value when a
 BENCH_r*.json exists, else 1.0 (the reference repo publishes no numbers —
 SURVEY.md §6).
+
+Architecture (round 4): orchestrator + worker subprocesses.
+
+A wedged remote TPU backend blocks *inside a C call holding the GIL*, so
+an in-process SIGALRM never fires (measured: a 90s alarm around
+``import jax; jax.devices()`` never ran its handler).  Rounds 2 and 3 both
+lost their official number to exactly this.  The only robust envelope is
+external: this file, run with no args, is a pure-Python orchestrator that
+never imports jax.  It
+
+1. pre-flights the backend in a subprocess (``--probe``) under a hard
+   60s wall clock — a wedged backend yields a structured
+   ``{"error": "backend unreachable"}`` JSON line and exit 0, so the
+   driver records a diagnosis instead of rc=124;
+2. runs the real measurement in a subprocess (``--worker``) under a
+   ~500s wall clock, retrying up to 3 times.  The persistent XLA compile
+   cache makes each killed attempt's compilation progress durable, so
+   retries resume where the last attempt died;
+3. mirrors any successful result to BENCH_PARTIAL.json immediately, so a
+   later crash cannot erase it.
+
+Worst case budget: 60 + 3*500 + slack ≈ 27 min, inside any plausible
+driver window (round 3's single 1500s attempt was not).
 """
 
-import glob
 import json
 import os
-import re
+import subprocess
 import sys
 import time
 
-import numpy as np
+# Persistent compilation cache: the unrolled boosting-block programs are
+# large, and a transient tunnel hiccup during a 30s+ remote compile is the
+# #1 way this bench has died.  A warm cache makes retries nearly free, and
+# makes *partial* compilation progress survive a killed attempt.
+_CACHE_ENV = {
+    "JAX_COMPILATION_CACHE_DIR": "/tmp/h2o3_tpu_jax_cache",
+    "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0.5",
+    "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES": "0",
+}
+# BENCH_FORCE_CPU (the test hook) must NOT touch the persistent cache:
+# XLA:CPU AOT entries both contaminate the TPU cache and intermittently
+# SIGSEGV at load (tests/conftest.py documents the hazard).  Removal (not
+# just skipping the setdefault) so an externally exported cache dir can't
+# reach CPU children either.
+if os.environ.get("BENCH_FORCE_CPU"):
+    for _k in _CACHE_ENV:
+        os.environ.pop(_k, None)
+else:
+    for _k, _v in _CACHE_ENV.items():
+        os.environ.setdefault(_k, _v)
 
-# Persistent compilation cache (same settings the test tier uses,
-# tests/conftest.py): the unrolled boosting-block programs are large, and a
-# transient tunnel hiccup during a 30s+ remote compile is the #1 way this
-# bench has died.  A warm cache makes retries nearly free.
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/h2o3_tpu_jax_cache")
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+_HERE = os.path.dirname(os.path.abspath(__file__))
+PROBE_TIMEOUT = int(os.environ.get("BENCH_PROBE_TIMEOUT", 60))
+# First attempt gets a longer budget: if the largest single executable's
+# compile exceeds the per-attempt bound, the cache checkpoints nothing and
+# no number of retries helps.  Observed compiles split into many cacheable
+# executables, so 600/500 is a hedge, not a requirement.
+ATTEMPT1_TIMEOUT = int(os.environ.get("BENCH_ATTEMPT1_TIMEOUT", 600))
+ATTEMPT_TIMEOUT = int(os.environ.get("BENCH_ATTEMPT_TIMEOUT", 500))
+ATTEMPTS = int(os.environ.get("BENCH_ATTEMPTS", 3))
+PARTIAL_PATH = os.path.join(_HERE, "BENCH_PARTIAL.json")
 
 
-def _retry(fn, attempts: int = 3, label: str = "", attempt_timeout: int = 1500):
-    """Run fn(), retrying on transient runtime/compile errors.
+def _fail(stage: str, detail: str) -> None:
+    """Print a structured single-line diagnosis and exit 0.
 
-    The driver records rc=1 if the process dies; a single remote_compile
-    "response body closed" blip must not turn a real 2.7M rows/sec result
-    into an official crash (VERDICT r2 item 1). A SIGALRM bounds each
-    attempt: a WEDGED remote backend (init that never returns) must raise
-    and retry instead of silently eating the driver's whole window.
+    Exit 0 is deliberate: the driver records stdout either way, and a
+    parseable diagnosis beats rc=124 with a truncated log (VERDICT r3
+    item 1).
     """
-    import signal
-
-    last = None
-    for i in range(attempts):
-        def _alarm(signum, frame):
-            raise TimeoutError(f"{label} attempt exceeded {attempt_timeout}s")
-
-        old = signal.signal(signal.SIGALRM, _alarm)
-        signal.alarm(attempt_timeout)
-        try:
-            return fn()
-        except Exception as e:  # includes jaxlib XlaRuntimeError
-            signal.alarm(0)  # disarm BEFORE the backoff sleep
-            last = e
-            print(f"# bench retry {i + 1}/{attempts} after {label} error: "
-                  f"{type(e).__name__}: {e}", file=sys.stderr)
-            time.sleep(5.0 * (i + 1))
-        finally:
-            signal.alarm(0)
-            signal.signal(signal.SIGALRM, old)
-    raise last
+    print(json.dumps({
+        "metric": "tpu_hist_train_rows_per_sec_per_chip",
+        "value": 0.0,
+        "unit": "rows/sec",
+        "vs_baseline": 0.0,
+        "error": f"{stage}: {detail}",
+    }))
+    sys.exit(0)
 
 
 def synth_higgs(n_rows: int, n_feat: int = 28, seed: int = 0):
+    import numpy as np
     rng = np.random.default_rng(seed)
     X = rng.normal(size=(n_rows, n_feat)).astype(np.float32)
     w = rng.normal(size=n_feat) / np.sqrt(n_feat)
@@ -70,12 +100,29 @@ def synth_higgs(n_rows: int, n_feat: int = 28, seed: int = 0):
     return X, y
 
 
-def main() -> None:
+def _probe() -> None:
+    """Child: touch the backend, print device count, exit."""
+    import jax
+    if os.environ.get("BENCH_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    print(json.dumps({"devices": len(jax.devices()),
+                      "platform": jax.devices()[0].platform}))
+
+
+def _worker() -> None:
+    """Child: the real measurement.  Prints the result JSON as its last
+    stdout line; the orchestrator relays it."""
     n_rows = int(os.environ.get("BENCH_ROWS", 2_000_000))
     ntrees = int(os.environ.get("BENCH_TREES", 10))
     max_depth = int(os.environ.get("BENCH_DEPTH", 6))
 
-    import jax
+    if os.environ.get("BENCH_FORCE_CPU"):
+        # test hook: exercise the worker logic without the TPU tunnel.
+        # Env vars alone don't switch platforms here (sitecustomize pins
+        # the axon backend); the config update before first backend use is
+        # authoritative, same as tests/conftest.py.
+        import jax
+        jax.config.update("jax_platforms", "cpu")
 
     from h2o3_tpu.models.tree.booster import TreeParams, train_boosted
     from h2o3_tpu.models.tree.common import init_margin
@@ -89,26 +136,25 @@ def main() -> None:
 
     # warmup run at full shape: compiles the training-block executable(s);
     # the timed run below hits the jit cache
-    _retry(lambda: train_boosted(X, "bernoulli", y, 1, f0, params),
-           label="warmup")
+    t0 = time.time()
+    train_boosted(X, "bernoulli", y, 1, f0, params)
+    warmup_s = time.time() - t0
+    print(f"# warmup done in {warmup_s:.1f}s", file=sys.stderr)
 
     # steady-state training throughput: the timings hook separates one-time
     # host prep (binning + device transfer over the tunnel) from the on-chip
     # boosting loop, the same split the reference's benchmarks use (DMatrix
     # build excluded from the gpu_hist training timer)
     timings = {}
-
-    def _timed():
-        timings.clear()
-        return train_boosted(X, "bernoulli", y, 1, f0, params, timings=timings)
-
-    booster = _retry(_timed, label="timed-run")
+    train_boosted(X, "bernoulli", y, 1, f0, params, timings=timings)
     dt = timings["train_s"]
 
     rows_per_sec = n_rows * ntrees / dt  # row-scans per second per chip
 
     vs = 1.0
-    for path in sorted(glob.glob("BENCH_r*.json"), reverse=True):
+    import glob
+    for path in sorted(glob.glob(os.path.join(_HERE, "BENCH_r*.json")),
+                       reverse=True):
         try:
             with open(path) as f:
                 prev = json.load(f)
@@ -124,8 +170,95 @@ def main() -> None:
         "value": round(rows_per_sec, 1),
         "unit": "rows/sec (n_rows*ntrees/train_time, Higgs-shaped 28f)",
         "vs_baseline": round(vs, 3),
+        "detail": {"n_rows": n_rows, "ntrees": ntrees,
+                   "max_depth": max_depth, "train_s": round(dt, 3),
+                   "warmup_s": round(warmup_s, 1)},
     }))
 
 
+def _run_child(arg: str, timeout: int):
+    """Run this file with `arg` in a subprocess under a hard timeout.
+
+    Returns (ok, last_json_line_or_None, note).  The child is killed on
+    timeout — over the axon tunnel that is the only way to bound a
+    backend-init hang (in-process signals never fire; see module doc).
+    """
+    cmd = [sys.executable, os.path.abspath(__file__), arg]
+    try:
+        proc = subprocess.run(
+            cmd, timeout=timeout, capture_output=True, text=True, cwd=_HERE)
+    except subprocess.TimeoutExpired as e:
+        def _text(b):
+            return b.decode(errors="replace") if isinstance(b, bytes) \
+                else (b or "")
+        # a worker can finish the measurement and then wedge in backend
+        # teardown at interpreter exit — a result line already on stdout
+        # must count as success, not burn the remaining attempts
+        for line in reversed(_text(e.stdout).strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    return True, json.loads(line), "result before teardown hang"
+                except json.JSONDecodeError:
+                    continue
+        # the stderr captured before the kill is the only evidence of
+        # where the worker hung (e.g. a "# warmup done" progress line
+        # distinguishes init-hang from timed-run-hang)
+        tail = ""
+        err = _text(e.stderr)
+        if err:
+            sys.stderr.write(err[-4000:])
+            tail = "; last stderr: " + " | ".join(
+                err.strip().splitlines()[-2:])
+        return False, None, f"killed after {timeout}s (backend hang){tail}"
+    if proc.stderr:
+        sys.stderr.write(proc.stderr[-4000:])
+    if proc.returncode != 0:
+        tail = (proc.stderr or "").strip().splitlines()[-3:]
+        return False, None, f"rc={proc.returncode}: {' | '.join(tail)}"
+    for line in reversed((proc.stdout or "").strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return True, json.loads(line), ""
+            except json.JSONDecodeError:
+                continue
+    return False, None, "no JSON line in child stdout"
+
+
+def main() -> None:
+    t_start = time.time()
+    ok, info, note = _run_child("--probe", PROBE_TIMEOUT)
+    if not ok:
+        _fail("backend unreachable (pre-flight probe)", note)
+    print(f"# probe ok: {info} in {time.time() - t_start:.1f}s",
+          file=sys.stderr)
+
+    last_note = ""
+    for i in range(ATTEMPTS):
+        ok, result, note = _run_child(
+            "--worker", ATTEMPT1_TIMEOUT if i == 0 else ATTEMPT_TIMEOUT)
+        if ok and result and result.get("value"):
+            # mirror immediately so a later crash can't erase the number
+            try:
+                with open(PARTIAL_PATH, "w") as f:
+                    json.dump(result, f)
+            except OSError:
+                pass
+            print(json.dumps(result))
+            return
+        last_note = note or "worker returned no result"
+        print(f"# bench attempt {i + 1}/{ATTEMPTS} failed: {last_note}",
+              file=sys.stderr)
+        if i < ATTEMPTS - 1:
+            time.sleep(3.0 * (i + 1))
+    _fail(f"all {ATTEMPTS} attempts failed", last_note)
+
+
 if __name__ == "__main__":
-    main()
+    if "--probe" in sys.argv:
+        _probe()
+    elif "--worker" in sys.argv:
+        _worker()
+    else:
+        main()
