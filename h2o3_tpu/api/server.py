@@ -125,6 +125,7 @@ class H2OServer:
             return False
         import base64
         import hashlib
+        import hmac
 
         try:
             user, _, password = (
@@ -133,8 +134,10 @@ class H2OServer:
         except Exception:
             return False
         want = self._auth.get(user)
-        return want is not None and (
-            hashlib.sha256(password.encode()).hexdigest() == want
+        # constant-time digest compare: the hash-file scheme mirrors the
+        # reference's, but == on hex digests leaks timing for free
+        return want is not None and hmac.compare_digest(
+            hashlib.sha256(password.encode()).hexdigest(), want
         )
 
     # -- lifecycle -----------------------------------------------------------

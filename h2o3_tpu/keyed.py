@@ -24,12 +24,15 @@ class _SpilledFrame:
     fault the frame back in just to display it."""
 
     def __init__(self, path: str, nbytes: int, nrows: int, ncols: int,
-                 names: List[str]) -> None:
+                 names: List[str], cls: type) -> None:
         self.path = path
         self.nbytes = nbytes
         self.nrows = nrows
         self.ncols = ncols
         self.names = names
+        #: concrete class of the spilled object, so type-keyed listings
+        #: (keys_of_type) answer for subclasses and renamed Frame types
+        self.cls = cls
 
 
 def _frame_nbytes(obj: Any) -> int:
@@ -67,6 +70,10 @@ class KeyedStore:
         #: lock; a read-locked key cannot be removed (a frame in use by a
         #: running training job must not vanish under it)
         self._read_locks: Dict[str, set] = {}
+        #: keys with a spill write in flight — concurrent _maybe_spill
+        #: calls must never pick the same victim (two writers to one
+        #: path + a lost-race unlink would delete the winner's file)
+        self._spilling: set = set()
 
     # -- Lockable (water/Lockable.java read/write locking) --------------------
     def read_lock(self, key: str, owner: str) -> None:
@@ -144,33 +151,44 @@ class KeyedStore:
                 used = sum(frames.values())
                 if used <= self._budget or len(frames) <= 1:
                     return
-                # oldest access first; never the most recently touched
+                # oldest access first; never the most recently touched,
+                # never one another thread is already spilling
                 newest = max(frames, key=lambda k: self._access.get(k, 0))
                 victims = sorted(frames, key=lambda k: self._access.get(k, 0))
-                victim = next((k for k in victims if k != newest), None)
+                victim = next(
+                    (k for k in victims
+                     if k != newest and k not in self._spilling), None)
                 if victim is None:
                     return
+                self._spilling.add(victim)
                 fr = self._store[victim]
                 nbytes = frames[victim]
                 ice = self._ice_dir
-            path = os.path.join(ice, f"{victim}.h2f")
+            # unique path per spill attempt: even a lost race against a
+            # concurrent put() unlinks only this attempt's own file
+            path = os.path.join(ice, f"{victim}.{uuid.uuid4().hex[:8]}.h2f")
             from h2o3_tpu.frame.persist import save_frame
 
-            save_frame(fr, path)  # I/O with no lock held
-            with self._lock:
-                if self._store.get(victim) is fr:  # unchanged meanwhile
-                    self._store[victim] = _SpilledFrame(
-                        path, nbytes, fr.nrows, fr.ncols, list(fr.names)
-                    )
-                    get_logger("cleaner").info(
-                        "spilled frame %s (%.1f MB) to %s",
-                        victim, nbytes / 1e6, path,
-                    )
-                else:
-                    try:
-                        os.unlink(path)
-                    except OSError:
-                        pass
+            try:
+                save_frame(fr, path)  # I/O with no lock held
+                with self._lock:
+                    if self._store.get(victim) is fr:  # unchanged meanwhile
+                        self._store[victim] = _SpilledFrame(
+                            path, nbytes, fr.nrows, fr.ncols, list(fr.names),
+                            cls=type(fr),
+                        )
+                        get_logger("cleaner").info(
+                            "spilled frame %s (%.1f MB) to %s",
+                            victim, nbytes / 1e6, path,
+                        )
+                    else:
+                        try:
+                            os.unlink(path)
+                        except OSError:
+                            pass
+            finally:
+                with self._lock:
+                    self._spilling.discard(victim)
 
     def _unspill(self, key: str, marker: _SpilledFrame) -> Any:
         """Reload a spilled frame; the disk read happens without the lock."""
@@ -276,8 +294,9 @@ class KeyedStore:
             return [
                 k for k, v in self._store.items()
                 if isinstance(v, cls)
-                # spilled frames are still frames to every listing
-                or (isinstance(v, _SpilledFrame) and cls.__name__ == "Frame")
+                # spilled frames are still frames (or Frame subclasses)
+                # to every listing: match on the recorded concrete class
+                or (isinstance(v, _SpilledFrame) and issubclass(v.cls, cls))
             ]
 
     def clear(self) -> None:

@@ -17,6 +17,7 @@ fatal (GridSearch.java's failed-params tracking).
 from __future__ import annotations
 
 import itertools
+import json
 import time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field, replace
@@ -255,19 +256,27 @@ class GridSearch:
         meta = rec._read_meta()
         scores: List[float] = []
         larger = True
+        # models is aligned 1:1 with meta["models"] (None = snapshot file
+        # went missing): survivors pair with THEIR OWN hp entry, and a
+        # missing combo stays unconsumed so the walker retrains exactly it
+        consumed: List[Dict[str, Any]] = []
         for entry, m in zip(meta["models"], models):
+            if m is None:
+                continue
             DKV.put(m.key, m)
             grid.models.append(m)
             grid.hyper_params.append(entry.get("hp", {}))
             v, larger = metric_value(m, gs.criteria.stopping_metric)
             scores.append(v)
+            consumed.append(entry.get("hp", {}))
         failures = meta.get("failures", [])
         for f_ in failures:
             grid.failures.append((f_.get("hp", {}), f_.get("error", "?")))
-        # failed combos consumed walker positions too
+            # failed combos consumed walker positions too
+            consumed.append(f_.get("hp", {}))
         grid = gs._run(
             grid, frames["train"], frames.get("valid"), rec,
-            skip=len(models) + len(failures), scores=scores,
+            consumed=consumed, scores=scores,
             init_larger=larger,
         )
         rec.on_done()
@@ -279,10 +288,12 @@ class GridSearch:
         frame: Frame,
         valid: Optional[Frame],
         rec,
-        skip: int,
-        scores: List[float],
+        skip: int = 0,
+        scores: List[float] = None,
         init_larger: bool = True,
+        consumed: Optional[List[Dict[str, Any]]] = None,
     ) -> Grid:
+        scores = [] if scores is None else scores
         c = self.criteria
         t0 = time.time()
         if c.strategy.lower() == "cartesian":
@@ -293,6 +304,27 @@ class GridSearch:
             raise ValueError(f"unknown strategy {c.strategy!r}")
         if skip:
             walker = itertools.islice(walker, skip, None)
+        if consumed:
+            # resume: skip each already-consumed combo ONCE, by value —
+            # positional skipping misaligns when a snapshot file vanished
+            # (that combo must be retrained). Multiset semantics so a
+            # random walk that repeats a combo isn't over-skipped.
+            from collections import Counter
+
+            def _hpkey(hp: Dict[str, Any]) -> str:
+                return json.dumps(hp, sort_keys=True, default=str)
+
+            budget = Counter(_hpkey(hp) for hp in consumed)
+
+            def _filtered(inner):
+                for hp in inner:
+                    k = _hpkey(hp)
+                    if budget.get(k):
+                        budget[k] -= 1
+                        continue
+                    yield hp
+
+            walker = _filtered(walker)
         # metric direction comes from the first finished model (set in
         # _record); on resume the preloaded scores arrive with their
         # recovered direction so early stopping never compares inverted

@@ -102,7 +102,13 @@ class Recovery:
 
     def load(self):
         """Restore the snapshot: frames and finished models re-enter the
-        DKV; returns (kind, state, frames_by_name, models_in_order)."""
+        DKV; returns (kind, state, frames_by_name, models_in_order).
+
+        ``models_in_order`` is aligned 1:1 with the snapshot's model
+        entries — a missing/corrupt model file yields ``None`` at its
+        position rather than silently shortening the list, so a resume
+        can pair each survivor with the RIGHT hyper-parameter entry and
+        retrain exactly the missing combos (ADVICE r3)."""
         from h2o3_tpu.frame.persist import load_frame
         from h2o3_tpu.models.persist import load_model
 
@@ -117,13 +123,15 @@ class Recovery:
         for entry in meta["models"]:
             try:
                 models.append(load_model(os.path.join(self.dir, entry["file"])))
-            except FileNotFoundError:
-                log.warning("recovery: model file %s missing, will retrain",
-                            entry["file"])
+            except Exception as e:  # missing OR corrupt (truncated write)
+                log.warning("recovery: model file %s unreadable (%s: %s), "
+                            "will retrain", entry["file"], type(e).__name__, e)
+                models.append(None)
         state = load_model(os.path.join(self.dir, "state.bin"), register=False)
         log.info(
-            "recovery: restored %s with %d frames, %d finished models",
-            meta["kind"], len(frames), len(models),
+            "recovery: restored %s with %d frames, %d/%d finished models",
+            meta["kind"], len(frames),
+            sum(m is not None for m in models), len(models),
         )
         return meta["kind"], state, frames, models
 

@@ -78,6 +78,65 @@ class TestRecovery:
         for m in grid.models:
             assert m.predict(fr).nrows == fr.nrows
 
+    def test_resume_with_missing_snapshot_file_retrains_right_combo(
+            self, rng, tmp_path):
+        """ADVICE r3 (medium): a vanished model file must not shift the
+        survivor/hp pairing — resume retrains exactly the missing combo,
+        keeps the survivor under its own hp, and trains no duplicates."""
+        d = str(tmp_path / "recm")
+        fr = _frame(rng)
+        lambdas = [0.0, 0.01, 0.1, 1.0]
+        params = GLMParameters(response_column="y", family="binomial", seed=1)
+
+        built = {"n": 0}
+        orig_fit = GLM._fit
+
+        def dying_fit(self, frame, valid=None):
+            if built["n"] >= 2:
+                raise KeyboardInterrupt("simulated crash")
+            built["n"] += 1
+            return orig_fit(self, frame, valid)
+
+        gs = GridSearch(GLM, params, {"lambda_": lambdas}, recovery_dir=d)
+        GLM._fit = dying_fit
+        try:
+            with pytest.raises(KeyboardInterrupt):
+                gs.train(fr)
+        finally:
+            GLM._fit = orig_fit
+
+        # sabotage: the FIRST finished combo's snapshot file vanishes
+        import json as _json
+        with open(os.path.join(d, "recovery.json")) as f:
+            meta = _json.load(f)
+        assert len(meta["models"]) == 2
+        lost_hp = meta["models"][0]["hp"]
+        kept_hp = meta["models"][1]["hp"]
+        os.unlink(os.path.join(d, meta["models"][0]["file"]))
+
+        # resume must retrain lost_hp (and the 2 never-trained combos),
+        # NOT retrain kept_hp, and end with all 4 combos exactly once
+        trained = []
+
+        def counting_fit(self, frame, valid=None):
+            trained.append(float(self.params.lambda_))
+            return orig_fit(self, frame, valid)
+
+        GLM._fit = counting_fit
+        try:
+            grid = auto_recover(d)
+        finally:
+            GLM._fit = orig_fit
+        assert isinstance(grid, Grid)
+        assert sorted(trained) == sorted(
+            [lost_hp["lambda_"]] +
+            [l for l in lambdas
+             if l not in (lost_hp["lambda_"], kept_hp["lambda_"])]
+        )
+        assert len(grid.models) == 4
+        assert sorted(hp["lambda_"] for hp in grid.hyper_params) == \
+            sorted(lambdas)
+
     def test_resume_over_rest(self, rng, tmp_path):
         import json
         import urllib.request
@@ -157,6 +216,52 @@ class TestMemoryManagerSpill:
                 fr2.col("x0").data, frames[k]
             )
             assert k not in DKV.spilled_keys()
+        finally:
+            DKV.set_memory_budget(None)
+            for k in frames:
+                DKV.remove(k)
+
+    def test_concurrent_spill_never_loses_frames(self, rng, tmp_path):
+        """ADVICE r3 (medium): two threads racing _maybe_spill must never
+        pick the same victim — the lost-race unlink used to delete the
+        winner's spill file, permanently losing the frame."""
+        import threading
+
+        from h2o3_tpu.keyed import DKV
+
+        frames = {}
+        try:
+            for i in range(6):
+                fr = _frame(rng, n=4000)
+                key = f"race_f{i}"
+                fr.key = key
+                DKV.put(key, fr)
+                frames[key] = np.array(fr.col("x0").data)
+            DKV._budget = 1  # enable without triggering a spill yet
+            DKV._ice_dir = str(tmp_path)
+            barrier = threading.Barrier(4)
+            errors = []
+
+            def spill():
+                try:
+                    barrier.wait()
+                    DKV._maybe_spill()
+                except Exception as e:  # pragma: no cover
+                    errors.append(e)
+
+            ts = [threading.Thread(target=spill) for _ in range(4)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert not errors
+            # EVERY frame must reload with intact data — a lost spill file
+            # surfaces here as FileNotFoundError or wrong contents
+            DKV._budget = None
+            for k, x0 in frames.items():
+                fr2 = DKV.get(k)
+                assert isinstance(fr2, Frame), k
+                np.testing.assert_array_equal(fr2.col("x0").data, x0)
         finally:
             DKV.set_memory_budget(None)
             for k in frames:
