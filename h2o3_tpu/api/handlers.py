@@ -252,8 +252,17 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
     r.register("GET", "/3/Metadata/endpoints", lambda p: {
         "routes": r.endpoints()
     }, "endpoint metadata")
-    r.register("POST", "/3/Shutdown", lambda p: {"result": "shutting down"},
-               "shutdown (no-op acknowledgement; process owner stops server)")
+    def shutdown(params):
+        # stop the HTTP server for real (ShutdownHandler) — delayed so
+        # this response still reaches the client; the hosting process
+        # stays alive (it owns the TPU runtime), matching h2o.shutdown()
+        # semantics of "the cluster stops answering"
+        import threading as _threading
+
+        _threading.Timer(0.3, server.stop).start()
+        return {"result": "shutting down"}
+
+    r.register("POST", "/3/Shutdown", shutdown, "stop the REST server")
     r.register("POST", "/3/GarbageCollect", lambda p: (__import__("gc").collect(), {})[1],
                "gc")
 
@@ -707,7 +716,13 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
             ]
         }
         try:
-            out["model_metrics"][0].update(_metrics_schema(m.model_performance(fr)) or {})
+            mm = m.model_performance(fr)
+            out["model_metrics"][0].update(_metrics_schema(mm) or {})
+            # leave the DKV-resident scoring record the /3/ModelMetrics
+            # routes fetch/delete (hex/ModelMetrics.buildKey)
+            from h2o3_tpu.api.handlers_ops import record_scoring
+
+            record_scoring(m, frame_id, mm)
         except Exception:
             pass  # frames without a response can still be scored
         return out
@@ -839,6 +854,14 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
         base = _coerce_params(pcls, params)
         gs = GridSearch(bcls, base, hyper, crit)
         grid = gs.train(fr)
+        want = params.get("grid_id")
+        if want and want != grid.grid_id:
+            # client-chosen grid id (GridSearchHandler honors grid_id)
+            old = grid.grid_id
+            grid.grid_id = want
+            DKV.put(want, grid)
+            if old in DKV:
+                DKV.remove(old)
         return {
             "grid_id": {"name": grid.grid_id},
             "model_ids": [{"name": k} for k in grid.model_ids],
@@ -1438,3 +1461,10 @@ refresh();setInterval(refresh,5000);
 
     r.register("GET", "/", flow_page, "Flow-lite console")
     r.register("GET", "/flow/index.html", flow_page, "Flow-lite console")
+
+    # ---- round-4 route groups (ModelMetrics CRUD, model io by URI, NPS,
+    # munging utilities, diagnostics) — registered last so they see the
+    # fully-populated registry for dispatch-based reuse ----------------------
+    from h2o3_tpu.api import handlers_ops
+
+    handlers_ops.register(r, server)

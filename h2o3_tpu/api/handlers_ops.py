@@ -1,0 +1,825 @@
+"""REST route groups added in round 4 — ModelMetrics CRUD, model
+import/export by URI, NPS, munging utilities, diagnostics.
+
+Reference: ``water/api/RegisterV3Api.java`` (the route inventory),
+``ModelMetricsHandler.java`` (fetch/delete/score/make),
+``ModelsHandler.java`` (importModel/exportModel/uploadModel),
+``NodePersistentStorageHandler.java``, ``water/util/Tabulate.java``,
+``hex/Interaction.java``, ``DCTTransformer``, ``TypeaheadHandler``,
+``ProfileCollectorTask`` and friends. Split from handlers.py to keep
+each registration file readable; ``handlers.register_all`` calls
+``register(r, server)`` here last, so these routes see the same DKV and
+server facade.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from h2o3_tpu.api.server import H2OServer, RequestServer, RestError
+from h2o3_tpu.frame.frame import ColType, Column, Frame
+from h2o3_tpu.keyed import DKV
+from h2o3_tpu.models.framework import Job, Model
+from h2o3_tpu.models.metrics import ScoringRecord, make_metrics
+
+
+def _schema_of_record(rec: ScoringRecord) -> Dict[str, Any]:
+    from h2o3_tpu.api.handlers import _metrics_schema
+
+    out = {
+        "model": {"name": rec.model_id},
+        "frame": {"name": rec.frame_id},
+        "model_category": rec.model_category,
+        "scoring_time": int(rec.scoring_time * 1000),
+    }
+    out.update(_metrics_schema(rec.metrics) or {})
+    return out
+
+
+def record_scoring(model: Model, frame_id: str, metrics: Any) -> None:
+    """Cache a scoring result in the DKV (hex/ModelMetrics.buildKey)."""
+    cat = ("Binomial" if model.nclasses == 2 else
+           "Multinomial" if model.nclasses > 2 else "Regression")
+    rec = ScoringRecord(model.key, frame_id, metrics, cat, time.time())
+    DKV.put(ScoringRecord.key_for(model.key, frame_id), rec)
+
+
+def register(r: RequestServer, server: H2OServer) -> None:  # noqa: C901
+    from h2o3_tpu.api.handlers import (
+        _frame_schema,
+        _get_frame,
+        _get_model,
+        _model_schema,
+    )
+
+    # ---- ModelMetrics CRUD + score + make (ModelMetricsHandler.java) ------
+    def _records(model: Optional[str] = None,
+                 frame: Optional[str] = None) -> List[ScoringRecord]:
+        out = []
+        for k in DKV.keys_of_type(ScoringRecord):
+            rec = DKV.get(k)
+            if not isinstance(rec, ScoringRecord):
+                continue
+            if model and rec.model_id != model:
+                continue
+            if frame and rec.frame_id != frame:
+                continue
+            out.append(rec)
+        return sorted(out, key=lambda rec: rec.scoring_time)
+
+    def mm_fetch(params, model=None, frame=None):
+        return {"model_metrics": [
+            _schema_of_record(rec) for rec in _records(model, frame)
+        ]}
+
+    def mm_delete(params, model=None, frame=None):
+        removed = []
+        for rec in _records(model, frame):
+            DKV.remove(ScoringRecord.key_for(rec.model_id, rec.frame_id))
+            removed.append({"model": rec.model_id, "frame": rec.frame_id})
+        return {"deleted": removed}
+
+    def mm_score(params, model, frame):
+        m = _get_model(model)
+        fr = _get_frame(frame)
+        key = ScoringRecord.key_for(model, frame)
+        cached = DKV.get(key)
+        force = str(params.get("force", "false")).lower() in ("true", "1")
+        if not isinstance(cached, ScoringRecord) or force:
+            record_scoring(m, frame, m.model_performance(fr))
+            cached = DKV.get(key)
+        return {"model_metrics": [_schema_of_record(cached)]}
+
+    def mm_make(params, predictions_frame, actuals_frame):
+        pf = _get_frame(predictions_frame)
+        af = _get_frame(actuals_frame)
+        domain = params.get("domain")
+        if isinstance(domain, str):
+            s = domain.strip()
+            domain = (json.loads(s.replace("'", '"')) if s.startswith("[")
+                      else [x for x in s.split(",") if x])
+        dist = params.get("distribution") or "gaussian"
+        P = np.column_stack([
+            c.numeric_view() if c.type is not ColType.CAT else c.data
+            for c in pf.columns
+        ])
+        # predictions frames from /3/Predictions lead with the label
+        # column for classifiers; make_metrics handles the K+1 shape
+        ac = af.columns[0]
+        if ac.type is ColType.CAT:
+            actual = np.asarray(ac.data, dtype=np.int64)
+            if domain is None:
+                domain = list(ac.domain)
+        else:
+            actual = ac.numeric_view()
+            if domain is not None and len(domain) > 2:
+                # numeric actuals under a domain are class ids; NA rows
+                # must drop BEFORE the int cast (int64(NaN) is garbage,
+                # not a missing marker)
+                ok = ~np.isnan(actual)
+                actual = actual[ok].astype(np.int64)
+                P = P[ok]
+            # binomial keeps float64: binomial_metrics masks NaN itself
+        mm = make_metrics(P, actual, domain=domain, distribution=dist)
+        from h2o3_tpu.api.handlers import _metrics_schema
+
+        out = {"model_category": ("Binomial" if domain and len(domain) == 2
+                                  else "Multinomial" if domain
+                                  else "Regression")}
+        out.update(_metrics_schema(mm) or {})
+        return {"model_metrics": [out]}
+
+    r.register("GET", "/3/ModelMetrics", mm_fetch, "all scoring records")
+    r.register("GET", "/3/ModelMetrics/models/{model}", mm_fetch,
+               "scoring records for a model")
+    r.register("GET", "/3/ModelMetrics/frames/{frame}", mm_fetch,
+               "scoring records for a frame")
+    r.register("GET", "/3/ModelMetrics/models/{model}/frames/{frame}",
+               mm_fetch, "scoring record for (model, frame)")
+    r.register("GET", "/3/ModelMetrics/frames/{frame}/models/{model}",
+               mm_fetch, "scoring record for (model, frame)")
+    r.register("DELETE", "/3/ModelMetrics", mm_delete, "delete all records")
+    r.register("DELETE", "/3/ModelMetrics/models/{model}", mm_delete,
+               "delete records for a model")
+    r.register("DELETE", "/3/ModelMetrics/frames/{frame}", mm_delete,
+               "delete records for a frame")
+    r.register("DELETE", "/3/ModelMetrics/models/{model}/frames/{frame}",
+               mm_delete, "delete one record")
+    r.register("DELETE", "/3/ModelMetrics/frames/{frame}/models/{model}",
+               mm_delete, "delete one record")
+    r.register("POST", "/3/ModelMetrics/models/{model}/frames/{frame}",
+               mm_score, "score a frame, cache + return metrics")
+    r.register(
+        "POST",
+        "/3/ModelMetrics/predictions_frame/{predictions_frame}"
+        "/actuals_frame/{actuals_frame}",
+        mm_make, "metrics from raw predictions + actuals (makeMetrics)")
+
+    # ---- async predictions (POST /4/Predictions..., predictAsync) ---------
+    def predict_async(params, model, frame):
+        m = _get_model(model)
+        fr = _get_frame(frame)
+        dest = params.get("predictions_frame") or DKV.make_key("pred")
+        job = Job(f"predict {model} on {frame}").start()
+
+        def run():
+            try:
+                pred = m.predict(fr)
+                DKV.put(dest, pred)
+                try:
+                    record_scoring(m, frame, m.model_performance(fr))
+                except Exception:
+                    pass  # response-less frames still score
+                job.done()
+            except Exception as e:  # noqa: BLE001
+                job.fail(e)
+
+        threading.Thread(target=run, daemon=True).start()
+        return {"job": {"key": {"name": job.key}},
+                "predictions_frame": {"name": dest}}
+
+    r.register("POST", "/4/Predictions/models/{model}/frames/{frame}",
+               predict_async, "async scoring job")
+
+    # ---- model import/export by URI (ModelsHandler.java) ------------------
+    def model_export(params, model_id):
+        from h2o3_tpu.models.persist import save_model
+
+        m = _get_model(model_id)
+        d = os.path.expanduser(params.get("dir") or ".")
+        if os.path.splitext(d)[1] != ".bin":
+            os.makedirs(d, exist_ok=True)
+            d = os.path.join(d, model_id)
+        force = str(params.get("force", "true")).lower() in ("true", "1")
+        if os.path.exists(d) and not force:
+            raise RestError(409, f"{d} exists and force is false")
+        return {"dir": save_model(m, d)}
+
+    def model_import(params, model_id):
+        from h2o3_tpu.models.persist import load_model
+
+        d = os.path.expanduser(params.get("dir") or ".")
+        if os.path.isdir(d):
+            d = os.path.join(d, model_id)
+        try:
+            m = load_model(d, register=False)
+        except FileNotFoundError:
+            raise RestError(404, f"no model file at {d!r}")
+        except Exception as e:  # corrupt / non-model file: client error
+            raise RestError(400, f"model load failed: {type(e).__name__}: {e}")
+        if not isinstance(m, Model):
+            raise RestError(400, f"{d!r} is not a model export")
+        m.key = model_id
+        DKV.put(m.key, m)
+        return {"models": [_model_schema(m)]}
+
+    def model_upload(params, model_id):
+        from h2o3_tpu.models.persist import load_model
+
+        body = params.get("_raw_body")
+        if not body:
+            raise RestError(400, "binary model body required "
+                                 "(Content-Type: application/octet-stream)")
+        import tempfile
+
+        with tempfile.NamedTemporaryFile(suffix=".bin", delete=False) as f:
+            f.write(body)
+            tmp = f.name
+        try:
+            m = load_model(tmp, register=False)
+        except Exception as e:
+            raise RestError(400, f"model load failed: {type(e).__name__}: {e}")
+        finally:
+            os.unlink(tmp)
+        if not isinstance(m, Model):
+            raise RestError(400, "uploaded bytes are not a model export")
+        m.key = model_id
+        DKV.put(m.key, m)
+        return {"models": [_model_schema(m)]}
+
+    def model_details(params, model_id):
+        return {"models": [_model_schema(_get_model(model_id))]}
+
+    def new_model_id(params, algo):
+        return {"model_id": {"name": DKV.make_key(f"{algo}_model")}}
+
+    r.register("GET", "/99/Models.bin/{model_id}", model_export,
+               "export model binary to a server path")
+    r.register("POST", "/99/Models.bin/{model_id}", model_import,
+               "import model binary from a server path")
+    r.register("POST", "/99/Models.upload.bin/{model_id}", model_upload,
+               "upload model binary in the request body")
+    r.register("GET", "/99/Models/{model_id}/json", model_details,
+               "full model details as JSON")
+    r.register("POST", "/3/ModelBuilders/{algo}/model_id", new_model_id,
+               "mint a fresh model id")
+
+    # ---- munging utilities: Tabulate / Interaction / DCT ------------------
+    def _col_bins(c: Column, nbins: int):
+        """(bin_index_per_row, labels) for a column — levels for cats,
+        equal-width bins for numerics (water/util/Tabulate.java)."""
+        if c.type is ColType.CAT:
+            return np.asarray(c.data, dtype=np.int64), list(c.domain)
+        x = c.numeric_view()
+        finite = x[~np.isnan(x)]
+        if finite.size == 0:
+            return np.zeros(len(x), dtype=np.int64), ["NA"]
+        lo, hi = float(finite.min()), float(finite.max())
+        span = (hi - lo) or 1.0
+        idx = np.clip(((x - lo) / span * nbins).astype(np.int64), 0,
+                      nbins - 1)
+        idx = np.where(np.isnan(x), -1, idx)
+        labels = [f"[{lo + span * i / nbins:.4g}, "
+                  f"{lo + span * (i + 1) / nbins:.4g})"
+                  for i in range(nbins)]
+        return idx, labels
+
+    def tabulate(params):
+        fr = _get_frame(params.get("dataset") or params.get("frame_id", ""))
+        pred = params.get("predictor")
+        resp = params.get("response")
+        if not pred or not resp:
+            raise RestError(400, "predictor and response are required")
+        try:
+            pc, rc = fr.col(pred), fr.col(resp)
+        except KeyError as e:
+            raise RestError(404, str(e))
+        w = (fr.col(params["weight"]).numeric_view()
+             if params.get("weight") else np.ones(fr.nrows))
+        nb_p = int(params.get("nbins_predictor", 20))
+        nb_r = int(params.get("nbins_response", 10))
+        pi, pl = _col_bins(pc, nb_p)
+        ri, rl = _col_bins(rc, nb_r)
+        ok = (pi >= 0) & (ri >= 0)
+        counts = np.zeros((len(pl), len(rl)))
+        np.add.at(counts, (pi[ok], ri[ok]), w[ok])
+        # per-predictor-bin mean response (the "response chart")
+        rv = (rc.numeric_view() if rc.type is not ColType.CAT
+              else np.asarray(rc.data, dtype=np.float64))
+        sums = np.zeros(len(pl))
+        wsum = np.zeros(len(pl))
+        np.add.at(sums, pi[ok], (w * np.nan_to_num(rv))[ok])
+        np.add.at(wsum, pi[ok], w[ok])
+        mean_resp = np.where(wsum > 0, sums / np.maximum(wsum, 1e-300),
+                             np.nan)
+        return {
+            "count_table": {"predictor_labels": pl, "response_labels": rl,
+                            "counts": counts.tolist()},
+            "response_table": {"predictor_labels": pl,
+                               "mean_response": mean_resp.tolist(),
+                               "counts": wsum.tolist()},
+        }
+
+    def interaction(params):
+        """Categorical interaction features (hex/Interaction.java): a new
+        frame with the concatenated-level column(s), trimmed to the
+        most frequent ``max_factors`` levels."""
+        fr = _get_frame(params.get("source_frame")
+                        or params.get("dataset", ""))
+        cols = params.get("factor_columns") or params.get("factors")
+        if isinstance(cols, str):
+            s = cols.strip()
+            cols = (json.loads(s.replace("'", '"')) if s.startswith("[")
+                    else [x for x in s.split(",") if x])
+        if not cols or len(cols) < 2:
+            raise RestError(400, "need >= 2 factor_columns")
+        pairwise = str(params.get("pairwise", "false")).lower() in (
+            "true", "1")
+        max_factors = int(params.get("max_factors", 100))
+        min_occ = int(params.get("min_occurrence", 1))
+
+        def combine(names: List[str]) -> Column:
+            srcs = []
+            for n in names:
+                try:
+                    c = fr.col(n)
+                except KeyError as e:
+                    raise RestError(404, str(e))
+                if c.type is not ColType.CAT:
+                    raise RestError(400, f"{n!r} is not categorical")
+                srcs.append(c)
+            labels = []
+            for i in range(fr.nrows):
+                parts = [
+                    (c.domain[c.data[i]] if c.data[i] >= 0 else "NA")
+                    for c in srcs
+                ]
+                labels.append("_".join(parts))
+            vals, counts = np.unique(labels, return_counts=True)
+            keep = [v for v, n in sorted(zip(vals, counts),
+                                         key=lambda t: (-t[1], t[0]))
+                    if n >= min_occ][:max_factors]
+            keep_set = set(keep)
+            domain = sorted(keep_set) + ["other"]
+            lut = {v: i for i, v in enumerate(domain)}
+            other = lut["other"]
+            ids = np.array([lut.get(s, other) for s in labels],
+                           dtype=np.int64)
+            return Column("_".join(names), ids, ColType.CAT, domain)
+
+        out_cols = []
+        if pairwise:
+            for i in range(len(cols)):
+                for j in range(i + 1, len(cols)):
+                    out_cols.append(combine([cols[i], cols[j]]))
+        else:
+            out_cols.append(combine(cols))
+        dest = (params.get("dest") or params.get("destination_frame")
+                or DKV.make_key("interaction"))
+        out = Frame(out_cols)
+        out.key = dest
+        DKV.put(dest, out)
+        job = Job(f"interaction {dest}").start()
+        job.done()
+        return {"job": {"key": {"name": job.key}},
+                "destination_frame": {"name": dest},
+                "domains": [c.domain for c in out_cols]}
+
+    def dct_transform(params):
+        """Orthonormal DCT-II over each row reshaped to (h, w, depth) —
+        the reference's MXNet-backed DCTTransformer, here via scipy."""
+        fr = _get_frame(params.get("dataset") or params.get("frame_id", ""))
+        dims = params.get("dimensions", "[0,0,0]")
+        if isinstance(dims, str):
+            dims = json.loads(dims)
+        dims = [int(x) for x in dims]
+        while len(dims) < 3:
+            dims.append(1)
+        h, w, d = (x or 1 for x in dims[:3])
+        need = h * w * d
+        if need != fr.ncols:
+            raise RestError(
+                400, f"dimensions {h}x{w}x{d}={need} != ncols {fr.ncols}")
+        from scipy.fft import dctn
+
+        X = np.column_stack([c.numeric_view() for c in fr.columns])
+        tens = X.reshape(fr.nrows, h, w, d)
+        out = dctn(tens, axes=(1, 2, 3), norm="ortho").reshape(
+            fr.nrows, need)
+        dest = (params.get("destination_frame")
+                or DKV.make_key("dct"))
+        cols = [Column(f"DCT_{i}", out[:, i].astype(np.float64))
+                for i in range(need)]
+        of = Frame(cols)
+        of.key = dest
+        DKV.put(dest, of)
+        return {"destination_frame": {"name": dest}}
+
+    r.register("POST", "/99/Tabulate", tabulate, "co-occurrence tables")
+    r.register("POST", "/3/Interaction", interaction,
+               "categorical interaction column(s)")
+    r.register("POST", "/99/DCTTransformer", dct_transform,
+               "row-wise orthonormal DCT")
+
+    # ---- node-persistent storage (8 routes) -------------------------------
+    from h2o3_tpu.util import nps
+
+    def nps_put_named(params, category, name):
+        body = params.get("_raw_body")
+        if body is None:
+            body = (params.get("value") or "").encode()
+        return nps.put(category, name, body)
+
+    def nps_put(params, category):
+        name = nps.new_name()
+        out = nps_put_named(params, category, name)
+        return out
+
+    def nps_get(params, category, name):
+        try:
+            return nps.get(category, name), "application/octet-stream"
+        except FileNotFoundError:
+            raise RestError(404, f"no NPS value {category}/{name}")
+
+    r.register("GET", "/3/NodePersistentStorage/configured",
+               lambda p: {"configured": nps.configured()}, "NPS configured?")
+    r.register("GET",
+               "/3/NodePersistentStorage/categories/{category}/exists",
+               lambda p, category: {"exists": nps.exists(category)},
+               "NPS category exists?")
+    r.register(
+        "GET",
+        "/3/NodePersistentStorage/categories/{category}/names/{name}/exists",
+        lambda p, category, name: {"exists": nps.exists(category, name)},
+        "NPS entry exists?")
+    r.register("POST", "/3/NodePersistentStorage/{category}/{name}",
+               nps_put_named, "store a named NPS value")
+    r.register("POST", "/3/NodePersistentStorage/{category}", nps_put,
+               "store an NPS value under a fresh name")
+    r.register("GET", "/3/NodePersistentStorage/{category}/{name}", nps_get,
+               "read an NPS value")
+    r.register("DELETE", "/3/NodePersistentStorage/{category}/{name}",
+               lambda p, category, name: {
+                   "deleted": nps.delete(category, name)},
+               "delete an NPS value")
+    r.register("GET", "/3/NodePersistentStorage/{category}",
+               lambda p, category: {"entries": nps.list_entries(category)},
+               "list NPS entries")
+
+    # ---- frame drill-down -------------------------------------------------
+    def _find_col(fr: Frame, column: str) -> Column:
+        try:
+            return fr.col(column)
+        except KeyError as e:
+            raise RestError(404, str(e))
+
+    def frame_column(params, frame_id, column):
+        fr = _get_frame(frame_id)
+        _find_col(fr, column)  # 404 before paging
+        off = int(params.get("row_offset", 0))
+        n = int(params.get("row_count", 100))
+        sub = fr.rows(np.arange(off, min(off + n, fr.nrows))).cols([column])
+        return _frame_schema(sub, frame_id, rows=n)
+
+    def frame_column_summary(params, frame_id, column):
+        c = _find_col(_get_frame(frame_id), column)
+        out: Dict[str, Any] = {"label": c.name,
+                               "type": c.type.name.lower(),
+                               "missing_count": int(c.na_count())}
+        if c.type in (ColType.NUM, ColType.TIME):
+            x = c.numeric_view()
+            fin = x[~np.isnan(x)]
+            if fin.size:
+                qs = np.percentile(
+                    fin, [0.1, 1, 10, 25, 33.3, 50, 66.7, 75, 90, 99, 99.9])
+                out.update({
+                    "mins": np.sort(fin)[:5].tolist(),
+                    "maxs": np.sort(fin)[-5:][::-1].tolist(),
+                    "mean": float(fin.mean()),
+                    "sigma": float(fin.std(ddof=1)) if fin.size > 1 else 0.0,
+                    "percentiles": qs.tolist(),
+                    "histogram_bins": np.histogram(fin, bins=20)[0].tolist(),
+                })
+        elif c.type is ColType.CAT:
+            ids = np.asarray(c.data)
+            counts = np.bincount(ids[ids >= 0], minlength=len(c.domain))
+            out["domain"] = c.domain
+            out["domain_counts"] = counts.tolist()
+        return {"frames": [{"frame_id": {"name": frame_id},
+                            "columns": [out]}]}
+
+    def frame_column_domain(params, frame_id, column):
+        c = _find_col(_get_frame(frame_id), column)
+        if c.type is not ColType.CAT:
+            raise RestError(400, f"{column!r} is not categorical")
+        return {"domain": [c.domain], "map_keys": {"string": c.domain}}
+
+    def frame_light(params, frame_id):
+        fr = _get_frame(frame_id)
+        return {"frames": [{
+            "frame_id": {"name": frame_id},
+            "rows": fr.nrows, "num_columns": fr.ncols,
+            "column_names": fr.names,
+            "byte_size": sum(getattr(c.data, "nbytes", 0)
+                             for c in fr.columns),
+            "is_text": False,
+        }]}
+
+    def frame_chunks(params, frame_id):
+        fr = _get_frame(frame_id)
+        # host-resident columnar layout: one logical chunk per column
+        # (device sharding is owned by FrameTable/Mesh, not the catalog)
+        return {"chunks": [
+            {"column": c.name,
+             "chunk_count": 1,
+             "byte_size": int(getattr(c.data, "nbytes", 0))}
+            for c in fr.columns
+        ]}
+
+    def find_in_frame(params):
+        fr = _get_frame(params.get("key", ""))
+        col = params.get("column")
+        row = int(params.get("row", 0))
+        match = params.get("match")
+        cols = [_find_col(fr, col)] if col else fr.columns
+        prev_hit, next_hit = -1, -1
+        for c in cols:
+            if c.type is ColType.CAT:
+                try:
+                    want = c.domain.index(match)
+                except ValueError:
+                    continue
+                hits = np.flatnonzero(np.asarray(c.data) == want)
+            elif c.type is ColType.STR:
+                hits = np.array([i for i, v in enumerate(c.data)
+                                 if v is not None and str(v) == match])
+            else:
+                if match is None:
+                    continue
+                try:
+                    want_f = float(match)
+                except ValueError:
+                    continue
+                hits = np.flatnonzero(c.numeric_view() == want_f)
+            before = hits[hits < row]
+            after = hits[hits >= row]
+            if before.size:
+                prev_hit = max(prev_hit, int(before[-1]))
+            if after.size:
+                next_hit = (int(after[0]) if next_hit < 0
+                            else min(next_hit, int(after[0])))
+        return {"prev": prev_hit, "next": next_hit}
+
+    def download_bin(params):
+        out = r.dispatch("GET", "/3/DownloadDataset", params)
+        return out, "application/octet-stream"
+
+    r.register("GET", "/3/Frames/{frame_id}/columns/{column}", frame_column,
+               "one column with a data page")
+    r.register("GET", "/3/Frames/{frame_id}/columns/{column}/summary",
+               frame_column_summary, "column rollups + percentiles")
+    r.register("GET", "/3/Frames/{frame_id}/columns/{column}/domain",
+               frame_column_domain, "categorical levels")
+    r.register("GET", "/3/Frames/{frame_id}/light", frame_light,
+               "frame header without data")
+    r.register("GET", "/3/FrameChunks/{frame_id}", frame_chunks,
+               "chunk layout")
+    r.register("GET", "/3/Find", find_in_frame, "find a value in a frame")
+    r.register("GET", "/3/DownloadDataset.bin", download_bin,
+               "frame as csv (binary endpoint)")
+
+    # ---- cluster ops ------------------------------------------------------
+    def dkv_delete(params, key):
+        if key not in DKV:
+            raise RestError(404, f"no key {key!r}")
+        DKV.remove(key)
+        return {"key": {"name": key}}
+
+    def dkv_delete_all(params):
+        skipped = []
+        for k in list(DKV.keys()):
+            try:
+                DKV.remove(k)
+            except ValueError:
+                skipped.append(k)
+        return {"skipped_locked": skipped}
+
+    def log_and_echo(params):
+        from h2o3_tpu.util.log import get_logger
+
+        msg = params.get("message", "")
+        get_logger("echo").info("%s", msg)
+        return {"message": msg}
+
+    def kill_minus_3(params):
+        # the reference sends SIGQUIT to itself to dump stacks to stdout;
+        # here the dump goes to the Log subsystem
+        from h2o3_tpu.util.log import get_logger
+
+        log = get_logger("jstack")
+        for t in r.dispatch("GET", "/3/JStack", params)["traces"]:
+            log.info("thread %s%s", t["thread"],
+                     " (daemon)" if t["daemon"] else "")
+            for chunk in t["stack"]:
+                for ln in chunk.rstrip().splitlines():
+                    log.info("%s", ln)
+        return {}
+
+    def unlock_keys(params):
+        DKV.unlock_all()
+        return {}
+
+    def cloud_lock(params):
+        # single-control-plane cloud: the membership set is fixed at mesh
+        # init, so the cloud is ALWAYS locked; record the caller's reason
+        from h2o3_tpu.util.log import get_logger
+
+        get_logger("cloud").info(
+            "cloud lock requested: %s", params.get("reason", ""))
+        return {"locked": True}
+
+    def network_test(params):
+        # ICI/DCN byte-moving lives in XLA collectives; the REST-visible
+        # network is the loopback control plane — measure that honestly
+        import socket
+
+        sizes = [1, 1024, 1024 * 1024]
+        n_round = 10
+        results = []
+        for sz in sizes:
+            payload = b"x" * sz
+            a, b = socket.socketpair()
+
+            # drain concurrently: sendall on a full socketpair buffer
+            # would deadlock a single-threaded echo loop
+            def drain(sock=b, total=sz * n_round):
+                got = 0
+                while got < total:
+                    data = sock.recv(1 << 20)
+                    if not data:
+                        break
+                    got += len(data)
+
+            t = threading.Thread(target=drain, daemon=True)
+            t.start()
+            t0 = time.perf_counter()
+            for _ in range(n_round):
+                a.sendall(payload)
+            t.join(timeout=10)
+            dt = (time.perf_counter() - t0) / n_round
+            a.close(); b.close()
+            results.append({"bytes": sz,
+                            "microseconds": round(dt * 1e6, 1),
+                            "mb_per_sec": round(sz / max(dt, 1e-9) / 1e6, 1)})
+        return {"table": results, "nodes": ["localhost"]}
+
+    def watermeter_io(params, nodeidx=None):
+        try:
+            with open("/proc/self/io") as f:
+                kv = dict(line.strip().split(": ") for line in f)
+            return {"persist_stats": [{
+                "read_bytes": int(kv.get("read_bytes", 0)),
+                "write_bytes": int(kv.get("write_bytes", 0)),
+                "syscr": int(kv.get("syscr", 0)),
+                "syscw": int(kv.get("syscw", 0)),
+            }], "available": True}
+        except OSError:
+            return {"persist_stats": [], "available": False}
+
+    def watermeter_cpu_node(params, nodeidx):
+        return r.dispatch("GET", "/3/WaterMeterCpuTicks", params)
+
+    def logs_node_file(params, nodeidx, name):
+        from h2o3_tpu.util import log as L
+
+        L.init()
+        return ("\n".join(L.recent(10000)) + "\n").encode(), "text/plain"
+
+    r.register("DELETE", "/3/DKV/{key}", dkv_delete, "remove one key")
+    r.register("DELETE", "/3/DKV", dkv_delete_all, "remove all keys")
+    r.register("POST", "/3/LogAndEcho", log_and_echo, "log a message")
+    r.register("GET", "/3/KillMinus3", kill_minus_3,
+               "dump thread stacks to the log")
+    r.register("POST", "/3/UnlockKeys", unlock_keys, "drop all read locks")
+    r.register("POST", "/3/CloudLock", cloud_lock, "lock cloud membership")
+    r.register("GET", "/3/NetworkTest", network_test,
+               "loopback control-plane throughput")
+    r.register("GET", "/3/WaterMeterIo", watermeter_io, "io counters")
+    r.register("GET", "/3/WaterMeterIo/{nodeidx}", watermeter_io,
+               "io counters (node)")
+    r.register("GET", "/3/WaterMeterCpuTicks/{nodeidx}", watermeter_cpu_node,
+               "cpu ticks (node)")
+    r.register("GET", "/3/Logs/nodes/{nodeidx}/files/{name}", logs_node_file,
+               "log file for a node")
+
+    # ---- typeahead / rapids help / capabilities / misc --------------------
+    def typeahead_files(params):
+        import glob as _glob
+
+        src = os.path.expanduser(params.get("src", ""))
+        limit = int(params.get("limit", 100))
+        if os.path.isdir(src):
+            pattern = os.path.join(src, "*")
+        else:
+            pattern = src + "*"
+        matches = sorted(_glob.glob(pattern))[:max(limit, 0)]
+        return {"src": src, "matches": matches}
+
+    def rapids_help(params):
+        from h2o3_tpu.rapids.prims import PRIMS
+
+        sigs = []
+        for name in sorted(PRIMS):
+            fn = PRIMS[name]
+            doc = (fn.__doc__ or "").strip().splitlines()
+            sigs.append({"name": name,
+                         "description": doc[0] if doc else ""})
+        return {"syntaxes": sigs}
+
+    def capabilities_core(params):
+        return {"capabilities": [
+            {"name": n} for n in
+            ("frames", "rapids", "models", "grid", "automl", "persist",
+             "recovery", "timeline", "mesh-sharding", "pallas-kernels")
+        ]}
+
+    def capabilities_api(params):
+        return {"capabilities": [
+            {"name": f"{m} {p.pattern[1:-1]}"}
+            for m, p, _n, _h, _s in r.routes
+        ]}
+
+    r.register("GET", "/3/Typeahead/files", typeahead_files,
+               "file path suggestions")
+    r.register("GET", "/99/Rapids/help", rapids_help, "rapids primitives")
+    r.register("GET", "/3/Capabilities/Core", capabilities_core,
+               "core capabilities")
+    r.register("GET", "/3/Capabilities/API", capabilities_api,
+               "REST capabilities")
+    r.register("GET", "/99/Sample", lambda p: {
+        "status": "experimental example endpoint"}, "sample endpoint")
+    r.register("GET", "/3/SteamMetrics", lambda p: {
+        "malloced_bytes": DKV.resident_frame_bytes()}, "steam metrics")
+
+    # ---- grid import/export by reference URI ------------------------------
+    def grid_bin_export(params, grid_id):
+        return r.dispatch("POST", f"/99/Grids/{grid_id}/export", params)
+
+    def grid_bin_import(params):
+        return r.dispatch("POST", "/99/Grids/import", params)
+
+    r.register("POST", "/3/Grid.bin/{grid_id}/export", grid_bin_export,
+               "export grid (reference URI)")
+    r.register("POST", "/3/Grid.bin/import", grid_bin_import,
+               "import grid (reference URI)")
+
+    # ---- metadata drill-down ----------------------------------------------
+    def endpoint_meta(params, path):
+        eps = r.endpoints()
+        try:
+            num = int(path)
+            if not 0 <= num < len(eps):
+                raise RestError(404, f"no endpoint #{num}")
+            return {"routes": [eps[num]]}
+        except ValueError:
+            hits = [e for e in eps if path in e["url_pattern"]]
+            if not hits:
+                raise RestError(404, f"no endpoint matching {path!r}")
+            return {"routes": hits}
+
+    def schema_class_meta(params, classname):
+        return r.dispatch(
+            "GET", f"/3/Metadata/schemas/{classname}", params)
+
+    r.register("GET", "/3/Metadata/endpoints/{path}", endpoint_meta,
+               "endpoint metadata by number or substring")
+    r.register("GET", "/3/Metadata/schemaclasses/{classname}",
+               schema_class_meta, "schema metadata by class name")
+
+    # ---- profiler (ProfileCollectorTask -> /3/Profiler; TPU half:
+    # jax.profiler trace toggle) --------------------------------------------
+    def profiler_ep(params):
+        from h2o3_tpu.util import profiler
+
+        return {"nodes": [{
+            "node_name": "localhost",
+            "profile": profiler.collect(
+                duration_s=float(params.get("duration", 0.25)),
+                depth=int(params.get("depth", 10))),
+        }]}
+
+    def profiler_trace(params):
+        from h2o3_tpu.util.profiler import TRACE
+
+        action = params.get("action", "")
+        try:
+            if action == "start":
+                d = params.get("dir") or os.path.join(
+                    os.environ.get("H2O3_TPU_ICE_ROOT", "/tmp"),
+                    f"jax_trace_{int(time.time())}")
+                return TRACE.start(d)
+            if action == "stop":
+                return TRACE.stop()
+        except RuntimeError as e:
+            raise RestError(409, str(e))
+        raise RestError(400, "action must be 'start' or 'stop'")
+
+    r.register("GET", "/3/Profiler", profiler_ep, "sampled python stacks")
+    r.register("POST", "/3/Profiler/trace", profiler_trace,
+               "toggle jax.profiler trace capture")

@@ -164,15 +164,22 @@ class H2OServer:
                     ctype = self.headers.get("Content-Type", "")
                     if "json" in ctype:
                         params.update(json.loads(body))
+                    elif "octet-stream" in ctype:
+                        # binary upload (model files, NPS blobs): handlers
+                        # read the bytes under _raw_body
+                        params["_raw_body"] = body
                     else:  # h2o-py posts urlencoded forms
-                        params.update(
-                            {
-                                k: v[0] if len(v) == 1 else v
-                                for k, v in urllib.parse.parse_qs(
-                                    body.decode()
-                                ).items()
-                            }
-                        )
+                        try:
+                            params.update(
+                                {
+                                    k: v[0] if len(v) == 1 else v
+                                    for k, v in urllib.parse.parse_qs(
+                                        body.decode()
+                                    ).items()
+                                }
+                            )
+                        except UnicodeDecodeError:
+                            params["_raw_body"] = body
                 return params
 
             def _respond(self, method: str) -> None:
@@ -264,10 +271,12 @@ class H2OServer:
         return self
 
     def stop(self) -> None:
-        if self._httpd:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None
+        # idempotent + thread-safe: /3/Shutdown schedules a delayed stop
+        # that may race the owner's own stop() call
+        httpd, self._httpd = self._httpd, None
+        if httpd:
+            httpd.shutdown()
+            httpd.server_close()
 
     @property
     def url(self) -> str:

@@ -92,6 +92,12 @@ class KeyedStore:
         with self._lock:
             return sorted(self._read_locks.get(key, ()))
 
+    def unlock_all(self) -> None:
+        """Drop every read lock (UnlockTask / POST /3/UnlockKeys — the
+        operator's escape hatch when a crashed job left locks behind)."""
+        with self._lock:
+            self._read_locks.clear()
+
     def _check_unlocked(self, key: str) -> None:
         # caller holds the lock
         owners = self._read_locks.get(key)

@@ -387,3 +387,83 @@ def stop_early(
         return bool(not np.isnan(ratio) and ratio <= 1 + stopping_tolerance)
     ratio = min_in / last_before
     return bool(not np.isnan(ratio) and ratio >= 1 - stopping_tolerance)
+
+
+# ---------------------------------------------------------------------------
+# DKV-resident scoring records + makeMetrics
+
+
+@dataclass
+class ScoringRecord:
+    """A cached scoring result, queryable over REST.
+
+    Reference: ``hex/ModelMetrics.java`` ``buildKey``/``getFromDKV`` —
+    scoring a frame with a model leaves a ModelMetrics object in the DKV
+    keyed by (model, frame), which the 10 /3/ModelMetrics routes fetch,
+    filter and delete."""
+
+    model_id: str
+    frame_id: str
+    metrics: object
+    model_category: str
+    scoring_time: float
+
+    @staticmethod
+    def key_for(model_id: str, frame_id: str) -> str:
+        return f"modelmetrics_{model_id}@{frame_id}"
+
+
+def make_metrics(
+    predictions: np.ndarray,
+    actuals: np.ndarray,
+    domain: Optional[List[str]] = None,
+    distribution: str = "gaussian",
+    weights: Optional[np.ndarray] = None,
+):
+    """Build metrics from raw predictions + actuals with no model.
+
+    Reference: ``ModelMetricsHandler.make`` (the ``h2o.make_metrics``
+    client call): a domain means classification (binomial for 2 levels,
+    multinomial above), otherwise regression under ``distribution``.
+
+    ``predictions`` column conventions match the reference's: regression
+    takes one column; binomial takes p1 directly, [p0 p1], or
+    [predict p0 p1] (the extra leading column is the label and is
+    dropped); multinomial likewise K or 1+K columns.
+    """
+    P = np.asarray(predictions, dtype=np.float64)
+    if P.ndim == 1:
+        P = P[:, None]
+    if domain is None:
+        if P.shape[1] != 1:
+            raise ValueError(
+                f"regression expects 1 prediction column, got {P.shape[1]}")
+        y = np.asarray(actuals, dtype=np.float64)
+        dev = None
+        if distribution and distribution != "gaussian":
+            from h2o3_tpu.models.glm import GLMParameters, deviance
+
+            dev = deviance(distribution, y, P[:, 0],
+                           GLMParameters(response_column=""))
+        return regression_metrics(y, P[:, 0], weights=weights, deviance=dev)
+    K = len(domain)
+    if K == 2:
+        if P.shape[1] == 1:
+            p1 = P[:, 0]
+        elif P.shape[1] == 2:
+            p1 = P[:, 1]
+        elif P.shape[1] == 3:
+            p1 = P[:, 2]
+        else:
+            raise ValueError(
+                f"binomial expects 1, 2 or 3 prediction columns, got {P.shape[1]}")
+        return binomial_metrics(np.asarray(actuals, dtype=np.float64), p1,
+                                weights=weights)
+    if P.shape[1] == K + 1:
+        P = P[:, 1:]
+    if P.shape[1] != K:
+        raise ValueError(
+            f"multinomial expects {K} or {K + 1} prediction columns, "
+            f"got {P.shape[1]}")
+    return multinomial_metrics(np.asarray(actuals).astype(np.int64), P,
+                               domain, weights=weights)

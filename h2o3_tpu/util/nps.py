@@ -1,0 +1,85 @@
+"""Node-persistent storage — named blobs that survive the process.
+
+Reference: ``water/init/NodePersistentStorage.java`` + the 8
+``/3/NodePersistentStorage`` routes (``RegisterV3Api.java``): a tiny
+category/name -> value store Flow uses to save notebooks. Here it is a
+directory tree under the ice root (one file per value); names are
+sanitised to single path segments so a crafted name can never escape
+the root.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import tempfile
+import time
+from typing import Dict, List, Optional
+
+_SAFE = re.compile(r"[^A-Za-z0-9._-]")
+
+
+def _root() -> str:
+    return os.environ.get("H2O3_TPU_NPS_ROOT") or os.path.join(
+        os.environ.get("H2O3_TPU_ICE_ROOT")
+        or os.path.join(tempfile.gettempdir(), "h2o3_tpu_ice"),
+        "nps",
+    )
+
+
+def _seg(name: str) -> str:
+    s = _SAFE.sub("_", name or "")
+    if not s or s in (".", ".."):
+        raise ValueError(f"bad NPS name {name!r}")
+    return s
+
+
+def configured() -> bool:
+    return True  # always backed by the ice dir (no -flow_dir flag needed)
+
+
+def put(category: str, name: str, value: bytes) -> Dict[str, object]:
+    d = os.path.join(_root(), _seg(category))
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, _seg(name))
+    with open(path, "wb") as f:
+        f.write(value)
+    return {"category": category, "name": name, "total_bytes": len(value)}
+
+
+def get(category: str, name: str) -> bytes:
+    path = os.path.join(_root(), _seg(category), _seg(name))
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def exists(category: str, name: Optional[str] = None) -> bool:
+    if name is None:
+        return os.path.isdir(os.path.join(_root(), _seg(category)))
+    return os.path.isfile(os.path.join(_root(), _seg(category), _seg(name)))
+
+
+def delete(category: str, name: str) -> bool:
+    path = os.path.join(_root(), _seg(category), _seg(name))
+    try:
+        os.unlink(path)
+        return True
+    except FileNotFoundError:
+        return False
+
+
+def list_entries(category: str) -> List[Dict[str, object]]:
+    d = os.path.join(_root(), _seg(category))
+    out = []
+    if os.path.isdir(d):
+        for n in sorted(os.listdir(d)):
+            p = os.path.join(d, n)
+            st = os.stat(p)
+            out.append({"category": category, "name": n,
+                        "size": st.st_size,
+                        "timestamp_millis": int(st.st_mtime * 1000)})
+    return out
+
+
+def new_name() -> str:
+    return f"nps_{int(time.time() * 1000)}"

@@ -1,0 +1,89 @@
+"""Sampling profiler + device trace capture.
+
+Reference: ``water/util/ProfileCollectorTask.java`` (+ the ``/3/Profiler``
+route): every node samples its JVM stack traces and returns the collapsed
+stacks with counts. Here the same idea runs over ``sys._current_frames``
+— and, because the interesting time on a TPU host is spent inside XLA
+programs, a second facility wraps ``jax.profiler`` trace capture (the
+TPU-native half; SURVEY.md §5 maps ProfileCollector to jax.profiler).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import traceback
+from collections import Counter
+from typing import Any, Dict, List
+
+
+def collect(duration_s: float = 0.25, interval_s: float = 0.005,
+            depth: int = 10) -> List[Dict[str, Any]]:
+    """Sample every live thread's stack for ``duration_s``; return
+    collapsed stacks sorted by sample count (ProfileCollectorTask's
+    per-node result shape)."""
+    counts: Counter = Counter()
+    me = threading.get_ident()
+    end = time.monotonic() + max(duration_s, interval_s)
+    n_samples = 0
+    while time.monotonic() < end:
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue  # the profiler thread itself is noise
+            stack = traceback.extract_stack(frame)[-depth:]
+            sig = ";".join(
+                f"{os.path.basename(f.filename)}:{f.lineno}:{f.name}"
+                for f in stack
+            )
+            counts[sig] += 1
+        n_samples += 1
+        time.sleep(interval_s)
+    total = sum(counts.values())
+    return [
+        {"stacktrace": sig.split(";"), "count": c,
+         "pct": round(100.0 * c / total, 1) if total else 0.0}
+        for sig, c in counts.most_common(50)
+    ]
+
+
+class TraceCapture:
+    """jax.profiler trace toggle: POST start/stop over REST, read the
+    resulting TensorBoard/Perfetto trace directory off the server."""
+
+    def __init__(self) -> None:
+        self._dir: str = ""
+        self._lock = threading.Lock()
+
+    @property
+    def active(self) -> bool:
+        return bool(self._dir)
+
+    def start(self, log_dir: str) -> Dict[str, Any]:
+        import jax
+
+        with self._lock:
+            if self._dir:
+                raise RuntimeError(f"trace already running in {self._dir}")
+            os.makedirs(log_dir, exist_ok=True)
+            jax.profiler.start_trace(log_dir)
+            self._dir = log_dir
+        return {"trace_dir": log_dir, "active": True}
+
+    def stop(self) -> Dict[str, Any]:
+        import jax
+
+        with self._lock:
+            if not self._dir:
+                raise RuntimeError("no trace running")
+            d, self._dir = self._dir, ""
+            jax.profiler.stop_trace()
+        files = []
+        for root, _dirs, names in os.walk(d):
+            files += [os.path.relpath(os.path.join(root, n), d)
+                      for n in names]
+        return {"trace_dir": d, "active": False, "files": sorted(files)[:100]}
+
+
+TRACE = TraceCapture()

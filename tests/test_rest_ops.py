@@ -1,0 +1,509 @@
+"""Round-4 REST groups: ModelMetrics CRUD + makeMetrics, model io by URI,
+NPS, munging utilities (Tabulate/Interaction/DCT), frame drill-down,
+cluster ops, typeahead/help/capabilities, profiler, real shutdown.
+
+Reference: water/api/RegisterV3Api.java (URIs matched exactly),
+ModelMetricsHandler.java, ModelsHandler.java,
+NodePersistentStorageHandler.java, water/util/Tabulate.java,
+hex/Interaction.java, ProfileCollectorTask.java."""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.api import start_server
+
+rng0 = np.random.default_rng(11)
+CSV = "x0,x1,c1,c2,y\n" + "\n".join(
+    f"{a:.3f},{b:.3f},{'u' if a > 0 else 'v'},{'p' if b > 0 else 'q'},"
+    f"{'yes' if a + b > 0 else 'no'}"
+    for a, b in rng0.normal(size=(400, 2))
+)
+
+
+@pytest.fixture(scope="module")
+def server():
+    s = start_server(port=0)
+    yield s
+    s.stop()
+
+
+def _req(server, method, path, data=None, raw=False, body_bytes=None):
+    if body_bytes is not None:
+        body = body_bytes
+        headers = {"Content-Type": "application/octet-stream"}
+    else:
+        body = json.dumps(data).encode() if data is not None else None
+        headers = {"Content-Type": "application/json"} if body else {}
+    req = urllib.request.Request(
+        server.url + path, data=body, headers=headers, method=method)
+    try:
+        with urllib.request.urlopen(req) as resp:
+            payload = resp.read()
+            return resp.status, payload if raw else json.loads(payload)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+@pytest.fixture(scope="module")
+def glm(server):
+    st, up = _req(server, "POST", "/3/PostFile", {"data": CSV})
+    assert st == 200
+    st, out = _req(server, "POST", "/3/Parse",
+                   {"source_frames": [up["destination_frame"]],
+                    "destination_frame": "ops_train"})
+    assert st == 200, out
+    st, out = _req(server, "POST", "/3/ModelBuilders/glm",
+                   {"training_frame": "ops_train", "response_column": "y",
+                    "family": "binomial", "model_id": "ops_glm"})
+    assert st == 200, out
+    return "ops_glm"
+
+
+class TestModelMetricsCRUD:
+    def test_score_caches_record(self, server, glm):
+        st, out = _req(server, "POST",
+                       f"/3/ModelMetrics/models/{glm}/frames/ops_train")
+        assert st == 200, out
+        mm = out["model_metrics"][0]
+        assert mm["model"]["name"] == glm
+        assert mm["frame"]["name"] == "ops_train"
+        assert 0.5 < mm["auc"] <= 1.0
+
+    def test_fetch_filters(self, server, glm):
+        _req(server, "POST", f"/3/ModelMetrics/models/{glm}/frames/ops_train")
+        for path in ("/3/ModelMetrics",
+                     f"/3/ModelMetrics/models/{glm}",
+                     "/3/ModelMetrics/frames/ops_train",
+                     f"/3/ModelMetrics/models/{glm}/frames/ops_train",
+                     f"/3/ModelMetrics/frames/ops_train/models/{glm}"):
+            st, out = _req(server, "GET", path)
+            assert st == 200, (path, out)
+            assert any(rec["model"]["name"] == glm
+                       for rec in out["model_metrics"]), path
+        # a filter that matches nothing returns empty, not 404
+        st, out = _req(server, "GET", "/3/ModelMetrics/models/nope")
+        assert st == 200 and out["model_metrics"] == []
+
+    def test_delete(self, server, glm):
+        _req(server, "POST", f"/3/ModelMetrics/models/{glm}/frames/ops_train")
+        st, out = _req(server, "DELETE",
+                       f"/3/ModelMetrics/models/{glm}/frames/ops_train")
+        assert st == 200 and out["deleted"]
+        st, out = _req(server, "GET",
+                       f"/3/ModelMetrics/models/{glm}/frames/ops_train")
+        assert out["model_metrics"] == []
+
+    def test_predictions_route_leaves_record(self, server, glm):
+        _req(server, "DELETE", "/3/ModelMetrics")
+        st, _ = _req(server, "POST",
+                     f"/3/Predictions/models/{glm}/frames/ops_train")
+        assert st == 200
+        st, out = _req(server, "GET", f"/3/ModelMetrics/models/{glm}")
+        assert st == 200 and out["model_metrics"]
+
+
+class TestMakeMetrics:
+    def _pred_frame(self, server, glm):
+        st, out = _req(server, "POST",
+                       f"/3/Predictions/models/{glm}/frames/ops_train",
+                       {"predictions_frame": "ops_preds"})
+        assert st == 200, out
+
+    def test_binomial_make_matches_score(self, server, glm):
+        self._pred_frame(server, glm)
+        # actuals = the response column only
+        st, out = _req(server, "POST", "/99/Rapids", {
+            "ast": "(= ops_actuals (cols_py ops_train 'y'))"})
+        assert st == 200, out
+        st, made = _req(
+            server, "POST",
+            "/3/ModelMetrics/predictions_frame/ops_preds"
+            "/actuals_frame/ops_actuals")
+        assert st == 200, made
+        mm = made["model_metrics"][0]
+        st, scored = _req(server, "POST",
+                          f"/3/ModelMetrics/models/{glm}/frames/ops_train",
+                          {"force": True})
+        want = scored["model_metrics"][0]
+        assert abs(mm["auc"] - want["auc"]) < 1e-6
+        assert abs(mm["logloss"] - want["logloss"]) < 1e-6
+
+    def test_regression_make(self, server, glm):
+        # numeric predictions vs numeric actuals, gaussian
+        st, _ = _req(server, "POST", "/99/Rapids", {
+            "ast": "(= ops_px (cols_py ops_train 'x0'))"})
+        st, _ = _req(server, "POST", "/99/Rapids", {
+            "ast": "(= ops_ax (cols_py ops_train 'x1'))"})
+        st, made = _req(
+            server, "POST",
+            "/3/ModelMetrics/predictions_frame/ops_px"
+            "/actuals_frame/ops_ax")
+        assert st == 200, made
+        assert made["model_metrics"][0]["rmse"] > 0
+
+
+class TestAsyncPredictions:
+    def test_v4_predict_job(self, server, glm):
+        st, out = _req(server, "POST",
+                       f"/4/Predictions/models/{glm}/frames/ops_train")
+        assert st == 200, out
+        job = out["job"]["key"]["name"]
+        dest = out["predictions_frame"]["name"]
+        for _ in range(100):
+            st, j = _req(server, "GET", f"/3/Jobs/{job}")
+            if j["jobs"][0]["status"] in ("DONE", "FAILED"):
+                break
+            time.sleep(0.05)
+        assert j["jobs"][0]["status"] == "DONE", j
+        st, fr = _req(server, "GET", f"/3/Frames/{dest}")
+        assert st == 200 and fr["frames"][0]["rows"] == 400
+
+
+class TestModelIO:
+    def test_export_import_roundtrip(self, server, glm, tmp_path):
+        st, out = _req(server, "GET",
+                       f"/99/Models.bin/{glm}?dir={tmp_path}")
+        assert st == 200, out
+        st, _ = _req(server, "DELETE", "/3/Models/ops_glm_copy")
+        st, out = _req(server, "POST",
+                       f"/99/Models.bin/ops_glm_copy?dir={tmp_path}/{glm}")
+        assert st == 200, out
+        assert out["models"][0]["model_id"]["name"] == "ops_glm_copy"
+
+    def test_upload_model_binary(self, server, glm, tmp_path):
+        st, out = _req(server, "GET",
+                       f"/99/Models.bin/{glm}?dir={tmp_path}/up")
+        assert st == 200, out
+        blob = open(out["dir"], "rb").read()
+        st, out = _req(server, "POST", "/99/Models.upload.bin/ops_glm_up",
+                       body_bytes=blob)
+        assert st == 200, out
+        st, out = _req(server, "GET", "/99/Models/ops_glm_up/json")
+        assert st == 200 and out["models"][0]["algo"] == "glm"
+
+    def test_new_model_id(self, server):
+        st, out = _req(server, "POST", "/3/ModelBuilders/gbm/model_id")
+        assert st == 200 and out["model_id"]["name"].startswith("gbm_model")
+
+
+class TestMungingUtilities:
+    def test_tabulate(self, server, glm):
+        st, out = _req(server, "POST", "/99/Tabulate", {
+            "dataset": "ops_train", "predictor": "x0", "response": "y",
+            "nbins_predictor": 5})
+        assert st == 200, out
+        ct = out["count_table"]
+        assert len(ct["predictor_labels"]) == 5
+        assert sum(map(sum, ct["counts"])) == 400
+        # x0 drives y: mean response should rise across x0 bins
+        mr = out["response_table"]["mean_response"]
+        assert mr[-1] > mr[0]
+
+    def test_interaction(self, server, glm):
+        st, out = _req(server, "POST", "/3/Interaction", {
+            "source_frame": "ops_train", "factor_columns": ["c1", "c2"],
+            "dest": "ops_inter"})
+        assert st == 200, out
+        st, fr = _req(server, "GET", "/3/Frames/ops_inter")
+        assert fr["frames"][0]["rows"] == 400
+        dom = set(out["domains"][0])
+        assert {"u_p", "u_q", "v_p", "v_q"} <= dom
+
+    def test_interaction_max_factors_trims(self, server, glm):
+        st, out = _req(server, "POST", "/3/Interaction", {
+            "source_frame": "ops_train", "factor_columns": ["c1", "c2"],
+            "max_factors": 2, "dest": "ops_inter2"})
+        assert st == 200, out
+        assert len(out["domains"][0]) == 3  # 2 kept + "other"
+
+    def test_dct(self, server, glm):
+        st, out = _req(server, "POST", "/99/Rapids", {
+            "ast": "(= ops_num (cols_py ops_train ['x0' 'x1']))"})
+        assert st == 200, out
+        st, out = _req(server, "POST", "/99/DCTTransformer", {
+            "dataset": "ops_num", "dimensions": [2, 1, 1],
+            "destination_frame": "ops_dct"})
+        assert st == 200, out
+        st, fr = _req(server, "GET", "/3/Frames/ops_dct")
+        assert fr["frames"][0]["num_columns"] == 2
+        # orthonormal DCT preserves the L2 norm of each row
+        from h2o3_tpu.keyed import DKV
+
+        src, dst = DKV.get("ops_num"), DKV.get("ops_dct")
+        X = np.column_stack([c.numeric_view() for c in src.columns])
+        Y = np.column_stack([c.numeric_view() for c in dst.columns])
+        np.testing.assert_allclose(
+            np.linalg.norm(X, axis=1), np.linalg.norm(Y, axis=1), rtol=1e-6)
+
+
+class TestNPS:
+    def test_full_lifecycle(self, server):
+        st, out = _req(server, "GET", "/3/NodePersistentStorage/configured")
+        assert st == 200 and out["configured"]
+        st, out = _req(server, "POST", "/3/NodePersistentStorage/nb/one",
+                       {"value": "hello flow"})
+        assert st == 200, out
+        st, out = _req(server, "GET",
+                       "/3/NodePersistentStorage/categories/nb/exists")
+        assert out["exists"]
+        st, out = _req(
+            server, "GET",
+            "/3/NodePersistentStorage/categories/nb/names/one/exists")
+        assert out["exists"]
+        st, raw = _req(server, "GET", "/3/NodePersistentStorage/nb/one",
+                       raw=True)
+        assert raw == b"hello flow"
+        st, out = _req(server, "GET", "/3/NodePersistentStorage/nb")
+        assert any(e["name"] == "one" for e in out["entries"])
+        st, out = _req(server, "POST", "/3/NodePersistentStorage/nb",
+                       {"value": "auto-named"})
+        assert st == 200 and out["name"]
+        st, out = _req(server, "DELETE", "/3/NodePersistentStorage/nb/one")
+        assert out["deleted"]
+        st, out = _req(
+            server, "GET",
+            "/3/NodePersistentStorage/categories/nb/names/one/exists")
+        assert not out["exists"]
+
+    def test_binary_body_put(self, server):
+        st, out = _req(server, "POST", "/3/NodePersistentStorage/nb/bin",
+                       body_bytes=b"\x00\x01\xff")
+        assert st == 200, out
+        st, raw = _req(server, "GET", "/3/NodePersistentStorage/nb/bin",
+                       raw=True)
+        assert raw == b"\x00\x01\xff"
+
+    def test_path_escape_rejected(self, server):
+        st, out = _req(server, "POST",
+                       "/3/NodePersistentStorage/nb/..%2F..%2Fetc",
+                       {"value": "nope"})
+        # sanitised into a plain segment (no traversal), never a 500 crash
+        assert st in (200, 400)
+        import os
+
+        assert not os.path.exists("/tmp/etc")
+
+
+class TestFrameDrillDown:
+    def test_column_page(self, server, glm):
+        st, out = _req(server, "GET",
+                       "/3/Frames/ops_train/columns/x0?row_count=7")
+        assert st == 200, out
+        assert out["columns"][0]["label"] == "x0"
+        assert len(out["columns"][0]["data"]) == 7
+
+    def test_column_summary(self, server, glm):
+        st, out = _req(server, "GET",
+                       "/3/Frames/ops_train/columns/x0/summary")
+        assert st == 200, out
+        c = out["frames"][0]["columns"][0]
+        assert len(c["percentiles"]) == 11
+        assert sum(c["histogram_bins"]) == 400
+
+    def test_column_domain(self, server, glm):
+        st, out = _req(server, "GET",
+                       "/3/Frames/ops_train/columns/y/domain")
+        assert st == 200 and out["domain"][0] == ["no", "yes"]
+        st, out = _req(server, "GET",
+                       "/3/Frames/ops_train/columns/x0/domain")
+        assert st == 400
+
+    def test_light_and_chunks(self, server, glm):
+        st, out = _req(server, "GET", "/3/Frames/ops_train/light")
+        assert st == 200 and out["frames"][0]["rows"] == 400
+        assert "columns" not in out["frames"][0]
+        st, out = _req(server, "GET", "/3/FrameChunks/ops_train")
+        assert st == 200 and len(out["chunks"]) == 5
+
+    def test_find(self, server, glm):
+        st, out = _req(server, "GET",
+                       "/3/Find?key=ops_train&column=c1&match=u&row=0")
+        assert st == 200, out
+        assert out["next"] >= 0
+        st, out2 = _req(
+            server, "GET",
+            f"/3/Find?key=ops_train&column=c1&match=u&row={out['next'] + 1}")
+        assert out2["prev"] <= out["next"] or out2["prev"] == out["next"]
+
+    def test_download_bin(self, server, glm):
+        st, raw = _req(server, "GET",
+                       "/3/DownloadDataset.bin?frame_id=ops_train", raw=True)
+        assert st == 200
+        lines = raw.decode().splitlines()
+        assert lines[0] == "x0,x1,c1,c2,y" and len(lines) == 401
+
+
+class TestClusterOps:
+    def test_dkv_delete_key(self, server):
+        from h2o3_tpu.frame.frame import Column, Frame
+        from h2o3_tpu.keyed import DKV
+
+        fr = Frame([Column("a", np.arange(3.0))])
+        DKV.put("ops_tmp", fr)
+        st, out = _req(server, "DELETE", "/3/DKV/ops_tmp")
+        assert st == 200 and "ops_tmp" not in DKV
+        st, out = _req(server, "DELETE", "/3/DKV/ops_tmp")
+        assert st == 404
+
+    def test_log_and_echo(self, server):
+        from h2o3_tpu.util import log as L
+
+        st, out = _req(server, "POST", "/3/LogAndEcho",
+                       {"message": "ops-echo-sentinel"})
+        assert st == 200 and out["message"] == "ops-echo-sentinel"
+        assert any("ops-echo-sentinel" in line for line in L.recent(50))
+
+    def test_kill_minus_3(self, server):
+        from h2o3_tpu.util import log as L
+
+        st, _ = _req(server, "GET", "/3/KillMinus3")
+        assert st == 200
+        assert any("thread" in line.lower() for line in L.recent(200))
+
+    def test_unlock_keys(self, server):
+        from h2o3_tpu.keyed import DKV
+
+        DKV.read_lock("ops_lock_target", "test-owner")
+        st, _ = _req(server, "POST", "/3/UnlockKeys")
+        assert st == 200
+        assert DKV.locked_by("ops_lock_target") == []
+
+    def test_cloud_lock(self, server):
+        st, out = _req(server, "POST", "/3/CloudLock", {"reason": "test"})
+        assert st == 200 and out["locked"]
+
+    def test_network_test(self, server):
+        st, out = _req(server, "GET", "/3/NetworkTest")
+        assert st == 200
+        assert len(out["table"]) == 3
+        assert all(row["microseconds"] > 0 for row in out["table"])
+
+    def test_watermeter_io(self, server):
+        st, out = _req(server, "GET", "/3/WaterMeterIo")
+        assert st == 200
+        if out["available"]:
+            assert out["persist_stats"][0]["read_bytes"] >= 0
+        st, out2 = _req(server, "GET", "/3/WaterMeterIo/0")
+        assert st == 200
+
+    def test_watermeter_cpu_node(self, server):
+        st, out = _req(server, "GET", "/3/WaterMeterCpuTicks/0")
+        assert st == 200
+
+    def test_logs_node_file(self, server):
+        st, raw = _req(server, "GET", "/3/Logs/nodes/0/files/default",
+                       raw=True)
+        assert st == 200 and raw
+
+
+class TestDiscovery:
+    def test_typeahead(self, server, tmp_path):
+        for n in ("data1.csv", "data2.csv", "other.txt"):
+            (tmp_path / n).write_text("a\n1\n")
+        st, out = _req(server, "GET",
+                       f"/3/Typeahead/files?src={tmp_path}/data&limit=10")
+        assert st == 200
+        assert len(out["matches"]) == 2
+        st, out = _req(server, "GET",
+                       f"/3/Typeahead/files?src={tmp_path}")
+        assert len(out["matches"]) == 3
+
+    def test_rapids_help(self, server):
+        st, out = _req(server, "GET", "/99/Rapids/help")
+        assert st == 200
+        names = {s["name"] for s in out["syntaxes"]}
+        assert len(names) > 150
+        assert {"cols_py", "merge", "sort"} <= names
+
+    def test_capabilities(self, server):
+        st, core = _req(server, "GET", "/3/Capabilities/Core")
+        assert st == 200 and core["capabilities"]
+        st, api = _req(server, "GET", "/3/Capabilities/API")
+        assert st == 200 and len(api["capabilities"]) >= 100
+
+    def test_sample_and_steam(self, server):
+        st, _ = _req(server, "GET", "/99/Sample")
+        assert st == 200
+        st, out = _req(server, "GET", "/3/SteamMetrics")
+        assert st == 200 and "malloced_bytes" in out
+
+    def test_endpoint_metadata_by_number_and_substring(self, server):
+        st, out = _req(server, "GET", "/3/Metadata/endpoints/0")
+        assert st == 200 and len(out["routes"]) == 1
+        st, out = _req(server, "GET", "/3/Metadata/endpoints/ModelMetrics")
+        assert st == 200 and len(out["routes"]) >= 10
+
+    def test_schemaclasses_alias(self, server):
+        st, names = _req(server, "GET", "/3/Metadata/schemas")
+        assert st == 200
+        name = names["schemas"][0]["name"]
+        st, out = _req(server, "GET",
+                       f"/3/Metadata/schemaclasses/{name}")
+        assert st == 200
+
+
+class TestGridBinURIs:
+    def test_grid_bin_roundtrip(self, server, glm, tmp_path):
+        st, out = _req(server, "POST", "/99/Grid/glm", {
+            "training_frame": "ops_train", "response_column": "y",
+            "family": "binomial", "grid_id": "ops_grid",
+            "hyper_parameters": {"lambda_": [0.0, 0.1]}})
+        assert st == 200, out
+        st, out = _req(server, "POST",
+                       f"/3/Grid.bin/ops_grid/export?dir={tmp_path}")
+        assert st == 200, out
+        st, out = _req(server, "POST",
+                       f"/3/Grid.bin/import?dir={tmp_path}/ops_grid.bin")
+        assert st == 200, out
+
+
+class TestProfiler:
+    def test_sampled_stacks_nonempty(self, server):
+        import threading
+
+        stop = threading.Event()
+
+        def busy():
+            x = 0
+            while not stop.is_set():
+                x += 1
+
+        t = threading.Thread(target=busy, daemon=True)
+        t.start()
+        try:
+            st, out = _req(server, "GET", "/3/Profiler?duration=0.2")
+        finally:
+            stop.set()
+        assert st == 200, out
+        prof = out["nodes"][0]["profile"]
+        assert prof and prof[0]["count"] > 0
+
+    def test_trace_toggle(self, server, tmp_path):
+        st, out = _req(server, "POST", "/3/Profiler/trace",
+                       {"action": "start", "dir": str(tmp_path / "tr")})
+        if st == 500:
+            pytest.skip(f"jax.profiler unavailable: {out['msg']}")
+        assert st == 200 and out["active"]
+        # double start conflicts
+        st, _ = _req(server, "POST", "/3/Profiler/trace",
+                     {"action": "start", "dir": str(tmp_path / "tr2")})
+        assert st == 409
+        st, out = _req(server, "POST", "/3/Profiler/trace",
+                       {"action": "stop"})
+        assert st == 200 and not out["active"]
+        st, _ = _req(server, "POST", "/3/Profiler/trace", {"action": "stop"})
+        assert st == 409
+
+
+class TestRealShutdown:
+    def test_shutdown_stops_answering(self):
+        s = start_server(port=0)
+        st, out = _req(s, "POST", "/3/Shutdown")
+        assert st == 200
+        time.sleep(0.8)
+        with pytest.raises(Exception):
+            urllib.request.urlopen(s.url + "/3/Ping", timeout=2)
