@@ -90,6 +90,108 @@ def _agg_fn(name: str):
     raise ValueError(f"unknown aggregate {name!r}")
 
 
+#: aggregates the device segment-reduction path covers (order statistics
+#: like mode/median stay host-side)
+_DEVICE_AGGS = {"nrow", "mean", "sum", "min", "max", "sd", "var"}
+
+
+def _group_by_device(
+    fr: Frame, by: Sequence[int], aggs: Sequence[Tuple[str, int, str]]
+) -> Optional[Frame]:
+    """Mesh path: factorize the key tuple host-side (one pass), then every
+    aggregate is a per-shard segment reduction + psum on the device mesh
+    (``dist.device_group_aggregate`` — AstGroup's distributed reduction,
+    TPU-native). Covers {nrow, mean, sum, min, max, sd, var} with NA
+    removal; anything else falls back to the host engine (None)."""
+    from h2o3_tpu.rapids import dist
+
+    if fr.nrows < dist.DIST_SORT_MIN:
+        return None
+    if not all(
+        a in _DEVICE_AGGS and (na == "rm" or a == "nrow")
+        for a, _j, na in aggs
+    ):
+        return None
+    # composite key code, first column most significant — so sorted
+    # composites enumerate groups in the host engine's exact order
+    keys = []
+    for j in by:
+        c = fr.col(j)
+        if c.type is ColType.CAT:
+            keys.append((c.data.astype(np.int64), len(c.domain) + 1))
+        elif c.type in (ColType.STR, ColType.UUID):
+            _, codes = np.unique(np.asarray(
+                [("" if v is None else str(v)) for v in c.data]),
+                return_inverse=True)
+            keys.append((codes.astype(np.int64), int(codes.max()) + 2))
+        else:
+            d = c.data
+            uniq, codes = np.unique(d[~np.isnan(d)], return_inverse=True)
+            full = np.full(len(d), len(uniq), dtype=np.int64)
+            full[~np.isnan(d)] = codes
+            keys.append((full, len(uniq) + 2))
+    comp = np.zeros(fr.nrows, dtype=np.int64)
+    for k, card in keys:
+        if int(comp.max(initial=0)) > (2**62) // card:
+            return None  # composite would overflow: host path
+        comp = comp * card + (k + 1)
+    uniq_codes, first_rows, inv = np.unique(
+        comp, return_index=True, return_inverse=True)
+    G = len(uniq_codes)
+    inv = inv.astype(np.int32)
+
+    out_cols: List[Column] = []
+    for j in by:
+        c = fr.col(j)
+        out_cols.append(Column(c.name, c.data[first_rows], c.type, c.domain))
+    cache: dict = {}
+    for agg_name, j, na in aggs:
+        if agg_name == "nrow" and (na != "rm" or j < 0):
+            cnt = np.bincount(inv, minlength=G).astype(np.float64)
+            out_cols.append(Column("nrow", cnt, ColType.NUM))
+            continue
+        col = fr.col(j)
+        if j not in cache:
+            vals = col.numeric_view()
+            # center before the f32 device accumulate: shifts cancel in
+            # var and are added back to sum/mean exactly once, and the
+            # conditioning of sumsq improves by orders of magnitude
+            with np.errstate(all="ignore"):
+                shift = float(np.nanmean(vals)) if len(vals) else 0.0
+            if np.isnan(shift):
+                shift = 0.0
+            agg = dist.device_group_aggregate(inv, vals - shift, G)
+            cache[j] = (agg, shift)
+        agg, shift = cache[j]
+        n, s = agg["count"], agg["sum"]
+        if agg_name == "nrow":
+            res = n
+        elif agg_name == "sum":
+            # empty post-rm segment is NA, matching the host oracle
+            res = np.where(n > 0, s + n * shift, np.nan)
+        elif agg_name == "mean":
+            res = np.where(n > 0, s / np.maximum(n, 1) + shift, np.nan)
+        elif agg_name == "min":
+            res = np.where(n > 0, agg["min"] + shift, np.nan)
+        elif agg_name == "max":
+            res = np.where(n > 0, agg["max"] + shift, np.nan)
+        else:  # sd / var on centered moments
+            var = np.where(
+                n > 1,
+                (agg["sumsq"] - s * s / np.maximum(n, 1)) / np.maximum(n - 1, 1),
+                np.nan,
+            )
+            var = np.maximum(var, 0.0)
+            res = np.sqrt(var) if agg_name == "sd" else var
+        name = f"{agg_name}_{col.name}"
+        base, k2 = name, 1
+        while any(c.name == name for c in out_cols):
+            name = f"{base}_{k2}"
+            k2 += 1
+        out_cols.append(Column(name, np.asarray(res, np.float64), ColType.NUM))
+    return Frame(out_cols)
+
+
 def group_by(
     fr: Frame,
     by: Sequence[int],
@@ -97,7 +199,17 @@ def group_by(
 ) -> Frame:
     """aggs: list of (agg_name, col_idx, na_handling) with na in all|rm|ignore.
     Output: one row per group — key columns then one column per aggregate,
-    named ``{agg}_{col}`` (matches reference output naming)."""
+    named ``{agg}_{col}`` (matches reference output naming).
+
+    Large frames aggregate on the device mesh (segment reduction + psum,
+    ``rapids/dist.py``); the host engine below is the small-N path, the
+    order-statistics (mode/median) path, and the parity oracle."""
+    try:
+        dev = _group_by_device(fr, by, aggs)
+    except Exception:
+        dev = None
+    if dev is not None:
+        return dev
     order, starts, stacked = group_keys(fr, by)
     bounds = np.append(starts, fr.nrows)
     out_cols: List[Column] = []
