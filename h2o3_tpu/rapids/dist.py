@@ -63,6 +63,7 @@ def encode_f64(x: np.ndarray, ascending: bool = True,
     RadixOrder's byte-order trick): flip sign bit for positives, all bits
     for negatives; NaN pinned to the low (or high) end."""
     x = np.ascontiguousarray(x, dtype=np.float64)
+    x = x + 0.0  # canonicalize -0.0 == +0.0, matching the host oracle
     u = x.view(np.uint64).copy()
     neg = (u >> np.uint64(63)) != 0
     u[neg] = ~u[neg]
@@ -181,6 +182,26 @@ def _pair_less(th, tl, qh, ql, or_equal: bool):
     return lt
 
 
+def _pair_bisect(thi, tlo, qh, ql, or_equal: bool):
+    """Binary search for one (qh, ql) pair in the sorted pair table —
+    the single probe body both searchsorted programs share."""
+    N = thi.shape[0]
+
+    def cond(state):
+        lft, rgt = state
+        return lft < rgt
+
+    def body(state):
+        lft, rgt = state
+        mid = (lft + rgt) // 2
+        go_right = _pair_less(thi[mid], tlo[mid], qh, ql, or_equal)
+        return jnp.where(go_right, mid + 1, lft), \
+            jnp.where(go_right, rgt, mid)
+
+    lft, _ = jax.lax.while_loop(cond, body, (jnp.int32(0), jnp.int32(N)))
+    return lft
+
+
 @partial(jax.jit, static_argnames=("mesh_size", "side"))
 def _searchsorted_program(thi, tlo, qhi, qlo, *, mesh_size: int,
                           side: str):
@@ -189,27 +210,11 @@ def _searchsorted_program(thi, tlo, qhi, qlo, *, mesh_size: int,
     replicated, the queries row-sharded (every node probes its rows —
     BinaryMerge's binary-search leg)."""
     mesh = default_mesh(mesh_size)
-    N = thi.shape[0]
     or_equal = side == "right"
 
-    def one(qh, ql):
-        def cond(state):
-            lft, rgt = state
-            return lft < rgt
-
-        def body(state):
-            lft, rgt = state
-            mid = (lft + rgt) // 2
-            go_right = _pair_less(thi[mid], tlo[mid], qh, ql, or_equal)
-            return jnp.where(go_right, mid + 1, lft), \
-                jnp.where(go_right, rgt, mid)
-
-        lft, _ = jax.lax.while_loop(
-            cond, body, (jnp.int32(0), jnp.int32(N)))
-        return lft
-
     def shard_fn(qh, ql):
-        return jax.vmap(one)(qh, ql)
+        return jax.vmap(
+            lambda a, b: _pair_bisect(thi, tlo, a, b, or_equal))(qh, ql)
 
     return shard_map(
         shard_fn, mesh=mesh,
@@ -224,27 +229,12 @@ def _searchsorted_both_program(thi, tlo, qhi, qlo, *, mesh_size: int):
     """Both probe sides in ONE program: a large join would otherwise
     ship the table + queries to the mesh twice."""
     mesh = default_mesh(mesh_size)
-    N = thi.shape[0]
-
-    def one(qh, ql, or_equal):
-        def cond(state):
-            lft, rgt = state
-            return lft < rgt
-
-        def body(state):
-            lft, rgt = state
-            mid = (lft + rgt) // 2
-            go_right = _pair_less(thi[mid], tlo[mid], qh, ql, or_equal)
-            return jnp.where(go_right, mid + 1, lft), \
-                jnp.where(go_right, rgt, mid)
-
-        lft, _ = jax.lax.while_loop(
-            cond, body, (jnp.int32(0), jnp.int32(N)))
-        return lft
 
     def shard_fn(qh, ql):
-        lo = jax.vmap(lambda a, b: one(a, b, False))(qh, ql)
-        hi = jax.vmap(lambda a, b: one(a, b, True))(qh, ql)
+        lo = jax.vmap(
+            lambda a, b: _pair_bisect(thi, tlo, a, b, False))(qh, ql)
+        hi = jax.vmap(
+            lambda a, b: _pair_bisect(thi, tlo, a, b, True))(qh, ql)
         return lo, hi
 
     return shard_map(

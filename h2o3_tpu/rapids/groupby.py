@@ -183,7 +183,8 @@ def _group_by_device(
             )
             var = np.maximum(var, 0.0)
             res = np.sqrt(var) if agg_name == "sd" else var
-        name = f"{agg_name}_{col.name}"
+        # the host engine names every nrow aggregate plain "nrow"
+        name = "nrow" if agg_name == "nrow" else f"{agg_name}_{col.name}"
         base, k2 = name, 1
         while any(c.name == name for c in out_cols):
             name = f"{base}_{k2}"

@@ -141,6 +141,12 @@ def merge_frames(
     lk, rk = _encode_keys(left, right, by_left, by_right)
     from h2o3_tpu.rapids import dist
 
+    def _host_probe():
+        order = stable_argsort(rk)
+        srt = rk[order]
+        return (order, srt, np.searchsorted(srt, lk, side="left"),
+                np.searchsorted(srt, lk, side="right"))
+
     if max(left.nrows, right.nrows) >= dist.DIST_SORT_MIN:
         # device mesh: distributed sort of the build side + sharded
         # binary-search probe (RadixOrder + BinaryMerge, TPU-native);
@@ -152,15 +158,9 @@ def merge_frames(
             lo, hi = dist.device_searchsorted_both(
                 rk_sorted.astype(np.uint64), lk.astype(np.uint64))
         except Exception:
-            r_order = stable_argsort(rk)
-            rk_sorted = rk[r_order]
-            lo = np.searchsorted(rk_sorted, lk, side="left")
-            hi = np.searchsorted(rk_sorted, lk, side="right")
+            r_order, rk_sorted, lo, hi = _host_probe()
     else:
-        r_order = stable_argsort(rk)
-        rk_sorted = rk[r_order]
-        lo = np.searchsorted(rk_sorted, lk, side="left")
-        hi = np.searchsorted(rk_sorted, lk, side="right")
+        r_order, rk_sorted, lo, hi = _host_probe()
     counts = hi - lo
     matched = counts > 0
 

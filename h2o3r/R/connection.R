@@ -1,0 +1,99 @@
+# Connection + raw HTTP layer.
+#
+# Reference: h2o-r/h2o-package/R/connection.R (h2o.init / h2o.connect)
+# and communication.R (.h2o.doRawREST). The transport here is a minimal
+# HTTP/1.1 client over base-R socketConnection — no RCurl/httr — which
+# is all a localhost control-plane needs.
+
+.h2o.env <- new.env(parent = emptyenv())
+
+h2o.connect <- function(ip = "127.0.0.1", port = 54321, https = FALSE) {
+  if (https) stop("h2o3r speaks plain HTTP; front TLS with a proxy")
+  .h2o.env$ip <- ip
+  .h2o.env$port <- as.integer(port)
+  about <- .h2o.GET("/3/About")
+  invisible(structure(list(ip = ip, port = port, about = about),
+                      class = "H2OConnection"))
+}
+
+h2o.init <- function(ip = "127.0.0.1", port = 54321, ...) {
+  # h2o-r's h2o.init launches a JVM when none is running; here the
+  # server is a python process the operator owns, so init == connect
+  h2o.connect(ip = ip, port = port)
+}
+
+h2o.clusterStatus <- function() .h2o.GET("/3/Cloud")
+
+.h2o.url <- function() {
+  if (is.null(.h2o.env$ip)) stop("no connection: call h2o.init() first")
+  paste0(.h2o.env$ip, ":", .h2o.env$port)
+}
+
+.h2o.request <- function(method, path, body = NULL,
+                         content_type = "application/json") {
+  con <- socketConnection(.h2o.env$ip, .h2o.env$port, blocking = TRUE,
+                          open = "r+b", timeout = 120)
+  on.exit(close(con), add = TRUE)
+  payload <- if (is.null(body)) raw(0) else charToRaw(body)
+  head <- paste0(
+    method, " ", path, " HTTP/1.1\r\n",
+    "Host: ", .h2o.url(), "\r\n",
+    "Content-Type: ", content_type, "\r\n",
+    "Content-Length: ", length(payload), "\r\n",
+    "Connection: close\r\n\r\n")
+  writeBin(c(charToRaw(head), payload), con)
+  flush(con)
+  # status line + headers
+  status_line <- readLines(con, n = 1L)
+  status <- as.integer(strsplit(status_line, " ")[[1]][2])
+  clen <- -1L
+  repeat {
+    h <- readLines(con, n = 1L)
+    if (length(h) == 0 || h == "") break
+    kv <- strsplit(h, ": ?")[[1]]
+    if (tolower(kv[1]) == "content-length") clen <- as.integer(kv[2])
+  }
+  body_raw <- if (clen >= 0) readBin(con, what = "raw", n = clen) else {
+    acc <- raw(0)
+    repeat {
+      chunk <- readBin(con, what = "raw", n = 65536L)
+      if (length(chunk) == 0) break
+      acc <- c(acc, chunk)
+    }
+    acc
+  }
+  list(status = status, body = rawToChar(body_raw))
+}
+
+.h2o.check <- function(resp) {
+  if (resp$status >= 400) {
+    msg <- tryCatch(.h2o.fromJSON(resp$body)$msg, error = function(e) resp$body)
+    stop("HTTP ", resp$status, ": ", msg)
+  }
+  resp
+}
+
+.h2o.GET <- function(path) {
+  .h2o.fromJSON(.h2o.check(.h2o.request("GET", path))$body)
+}
+
+.h2o.DELETE <- function(path) {
+  .h2o.fromJSON(.h2o.check(.h2o.request("DELETE", path))$body)
+}
+
+.h2o.POST <- function(path, params = NULL) {
+  body <- if (is.null(params)) "{}" else .h2o.toJSON(params)
+  .h2o.fromJSON(.h2o.check(.h2o.request("POST", path, body))$body)
+}
+
+.h2o.GETraw <- function(path) {
+  .h2o.check(.h2o.request("GET", path))$body
+}
+
+h2o.shutdown <- function(prompt = FALSE) {
+  invisible(.h2o.POST("/3/Shutdown"))
+}
+
+h2o.logAndEcho <- function(message) {
+  .h2o.POST("/3/LogAndEcho", list(message = message))$message
+}

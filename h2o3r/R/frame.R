@@ -1,0 +1,99 @@
+# H2OFrame: a handle to a server-side frame.
+#
+# Reference: h2o-r/h2o-package/R/frame.R (~10k LoC of lazy AST builders).
+# This client keeps frames as thin key handles and ships munging to the
+# server as Rapids text — the same wire contract, a fraction of the
+# surface; as.data.frame round-trips through the CSV download route.
+
+.h2o.frameHandle <- function(key) {
+  info <- .h2o.GET(paste0("/3/Frames/", utils::URLencode(key, reserved = TRUE),
+                          "/light"))$frames[[1]]
+  structure(list(key = key,
+                 nrows = info$rows,
+                 ncols = info$num_columns,
+                 names = unlist(info$column_names)),
+            class = "H2OFrame")
+}
+
+h2o.getFrame <- function(id) .h2o.frameHandle(id)
+
+h2o.uploadFile <- function(path, destination_frame = NULL, header = TRUE) {
+  text <- paste(readLines(path, warn = FALSE), collapse = "\n")
+  h2o.uploadText(text, destination_frame)
+}
+
+h2o.uploadText <- function(text, destination_frame = NULL) {
+  up <- .h2o.POST("/3/PostFile", list(data = text))
+  dest <- if (is.null(destination_frame))
+    paste0("frame_", format(as.numeric(Sys.time()) * 1000, scientific = FALSE))
+  else destination_frame
+  .h2o.POST("/3/Parse", list(
+    source_frames = list(up$destination_frame),
+    destination_frame = dest))
+  .h2o.frameHandle(dest)
+}
+
+h2o.importFile <- function(path, destination_frame = NULL) {
+  imp <- .h2o.POST("/3/ImportFiles", list(path = path))
+  dest <- if (is.null(destination_frame))
+    paste0("frame_", format(as.numeric(Sys.time()) * 1000, scientific = FALSE))
+  else destination_frame
+  .h2o.POST("/3/Parse", list(
+    source_frames = as.list(unlist(imp$destination_frames)),
+    destination_frame = dest))
+  .h2o.frameHandle(dest)
+}
+
+as.data.frame.H2OFrame <- function(x, ...) {
+  csv <- .h2o.GETraw(paste0("/3/DownloadDataset?frame_id=",
+                            utils::URLencode(x$key, reserved = TRUE)))
+  utils::read.csv(text = csv, stringsAsFactors = FALSE)
+}
+
+print.H2OFrame <- function(x, ...) {
+  cat("H2OFrame", x$key, ":", x$nrows, "rows x", x$ncols, "cols\n")
+  cat("columns:", paste(x$names, collapse = ", "), "\n")
+  invisible(x)
+}
+
+dim.H2OFrame <- function(x) c(x$nrows, x$ncols)
+
+h2o.nrow <- function(fr) fr$nrows
+h2o.ncol <- function(fr) fr$ncols
+h2o.colnames <- function(fr) fr$names
+
+h2o.ls <- function() {
+  frames <- .h2o.GET("/3/Frames")$frames
+  data.frame(key = vapply(frames, function(f) f$frame_id$name, character(1)),
+             rows = vapply(frames, function(f) as.numeric(f$rows), numeric(1)),
+             stringsAsFactors = FALSE)
+}
+
+h2o.rm <- function(x) {
+  key <- if (inherits(x, "H2OFrame") || inherits(x, "H2OModel")) x$key else x
+  invisible(.h2o.DELETE(paste0("/3/DKV/",
+                               utils::URLencode(key, reserved = TRUE))))
+}
+
+h2o.removeAll <- function() invisible(.h2o.DELETE("/3/DKV"))
+
+h2o.splitFrame <- function(fr, ratios = 0.75, destination_frames = NULL,
+                           seed = -1) {
+  params <- list(dataset = fr$key, ratios = as.list(ratios), seed = seed)
+  if (!is.null(destination_frames))
+    params$destination_frames <- as.list(destination_frames)
+  out <- .h2o.POST("/3/SplitFrame", params)
+  lapply(out$destination_frames, function(d) .h2o.frameHandle(d$name))
+}
+
+h2o.rapids <- function(ast) .h2o.POST("/99/Rapids", list(ast = ast))
+
+h2o.describe <- function(fr) {
+  .h2o.GET(paste0("/3/Frames/", utils::URLencode(fr$key, reserved = TRUE),
+                  "/summary"))$frames[[1]]$columns
+}
+
+h2o.group_by <- function(fr, by, ...) {
+  # munging rides Rapids, exactly like the python client's lazy Expr
+  stop("compose a Rapids AST with h2o.rapids(); see /99/Rapids/help")
+}

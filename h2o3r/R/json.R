@@ -1,0 +1,149 @@
+# Minimal JSON codec in base R — no jsonlite dependency.
+#
+# Reference: h2o-r leans on jsonlite/RCurl (h2o-r/h2o-package/R/
+# communication.R); this package stays dependency-free so it loads on a
+# bare R, which is also why the server keeps its responses to plain
+# objects/arrays/scalars.
+
+.h2o.toJSON <- function(x) {
+  if (is.null(x)) return("null")
+  if (is.list(x) && !is.null(names(x)) && length(x) > 0) {
+    parts <- vapply(seq_along(x), function(i) {
+      paste0(.h2o.jsonString(names(x)[i]), ":", .h2o.toJSON(x[[i]]))
+    }, character(1))
+    return(paste0("{", paste(parts, collapse = ","), "}"))
+  }
+  if (is.list(x) || length(x) > 1) {
+    return(paste0("[", paste(vapply(as.list(x), .h2o.toJSON, character(1)),
+                             collapse = ","), "]"))
+  }
+  if (length(x) == 0) return("[]")
+  if (is.character(x)) return(.h2o.jsonString(x))
+  if (is.logical(x)) return(if (is.na(x)) "null" else if (x) "true" else "false")
+  if (is.na(x)) return("null")
+  if (is.numeric(x)) {
+    if (is.infinite(x) || is.nan(x)) return("null")
+    return(format(x, scientific = FALSE, digits = 17, trim = TRUE))
+  }
+  stop("cannot serialize type: ", class(x)[1])
+}
+
+.h2o.jsonString <- function(s) {
+  s <- gsub("\\\\", "\\\\\\\\", s)
+  s <- gsub('"', '\\\\"', s)
+  s <- gsub("\n", "\\\\n", s)
+  s <- gsub("\r", "\\\\r", s)
+  s <- gsub("\t", "\\\\t", s)
+  paste0('"', s, '"')
+}
+
+# -- parser ------------------------------------------------------------------
+
+.h2o.fromJSON <- function(txt) {
+  st <- new.env(parent = emptyenv())
+  st$s <- txt
+  st$i <- 1L
+  st$n <- nchar(txt)
+  v <- .h2o.jsParseValue(st)
+  v
+}
+
+.h2o.jsPeek <- function(st) substr(st$s, st$i, st$i)
+
+.h2o.jsSkipWs <- function(st) {
+  while (st$i <= st$n && .h2o.jsPeek(st) %in% c(" ", "\n", "\t", "\r"))
+    st$i <- st$i + 1L
+}
+
+.h2o.jsParseValue <- function(st) {
+  .h2o.jsSkipWs(st)
+  ch <- .h2o.jsPeek(st)
+  if (ch == "{") return(.h2o.jsParseObject(st))
+  if (ch == "[") return(.h2o.jsParseArray(st))
+  if (ch == '"') return(.h2o.jsParseString(st))
+  rest <- substr(st$s, st$i, min(st$n, st$i + 4L))
+  if (startsWith(rest, "true"))  { st$i <- st$i + 4L; return(TRUE) }
+  if (startsWith(rest, "false")) { st$i <- st$i + 5L; return(FALSE) }
+  if (startsWith(rest, "null"))  { st$i <- st$i + 4L; return(NULL) }
+  .h2o.jsParseNumber(st)
+}
+
+.h2o.jsParseObject <- function(st) {
+  st$i <- st$i + 1L  # {
+  out <- list()
+  .h2o.jsSkipWs(st)
+  if (.h2o.jsPeek(st) == "}") { st$i <- st$i + 1L; return(out) }
+  repeat {
+    .h2o.jsSkipWs(st)
+    key <- .h2o.jsParseString(st)
+    .h2o.jsSkipWs(st)
+    if (.h2o.jsPeek(st) != ":") stop("JSON: expected ':' at ", st$i)
+    st$i <- st$i + 1L
+    val <- .h2o.jsParseValue(st)
+    out[[key]] <- if (is.null(val)) NA else val
+    .h2o.jsSkipWs(st)
+    ch <- .h2o.jsPeek(st)
+    st$i <- st$i + 1L
+    if (ch == "}") return(out)
+    if (ch != ",") stop("JSON: expected ',' or '}' at ", st$i)
+  }
+}
+
+.h2o.jsParseArray <- function(st) {
+  st$i <- st$i + 1L  # [
+  out <- list()
+  .h2o.jsSkipWs(st)
+  if (.h2o.jsPeek(st) == "]") { st$i <- st$i + 1L; return(out) }
+  repeat {
+    val <- .h2o.jsParseValue(st)
+    out[[length(out) + 1L]] <- if (is.null(val)) NA else val
+    .h2o.jsSkipWs(st)
+    ch <- .h2o.jsPeek(st)
+    st$i <- st$i + 1L
+    if (ch == "]") return(out)
+    if (ch != ",") stop("JSON: expected ',' or ']' at ", st$i)
+  }
+}
+
+.h2o.jsParseString <- function(st) {
+  if (.h2o.jsPeek(st) != '"') stop("JSON: expected string at ", st$i)
+  st$i <- st$i + 1L
+  out <- character(0)
+  buf_start <- st$i
+  while (st$i <= st$n) {
+    ch <- .h2o.jsPeek(st)
+    if (ch == '"') {
+      out <- c(out, substr(st$s, buf_start, st$i - 1L))
+      st$i <- st$i + 1L
+      return(paste0(out, collapse = ""))
+    }
+    if (ch == "\\") {
+      out <- c(out, substr(st$s, buf_start, st$i - 1L))
+      esc <- substr(st$s, st$i + 1L, st$i + 1L)
+      rep <- switch(esc, n = "\n", t = "\t", r = "\r", b = "\b", f = "\f",
+                    "u" = NA, esc)
+      if (identical(rep, NA)) {
+        code <- strtoi(substr(st$s, st$i + 2L, st$i + 5L), 16L)
+        rep <- intToUtf8(code)
+        st$i <- st$i + 6L
+      } else {
+        st$i <- st$i + 2L
+      }
+      out <- c(out, rep)
+      buf_start <- st$i
+    } else {
+      st$i <- st$i + 1L
+    }
+  }
+  stop("JSON: unterminated string")
+}
+
+.h2o.jsParseNumber <- function(st) {
+  j <- st$i
+  while (j <= st$n && substr(st$s, j, j) %in%
+         c("-", "+", ".", "e", "E", as.character(0:9)))
+    j <- j + 1L
+  num <- as.numeric(substr(st$s, st$i, j - 1L))
+  st$i <- j
+  num
+}

@@ -1,0 +1,139 @@
+# Model training / scoring / metrics.
+#
+# Reference: h2o-r/h2o-package/R/models.R (.h2o.modelJob / h2o.performance /
+# h2o.predict and the metric accessors). Estimator wrappers (h2o.gbm,
+# h2o.glm, ...) are GENERATED into estimators_gen.R from the server's
+# parameter schemas by scripts/gen_bindings.py --r.
+
+.h2o.train <- function(algo, params) {
+  params <- Filter(function(v) !is.null(v), params)
+  # frames travel as keys
+  for (k in c("training_frame", "validation_frame")) {
+    if (!is.null(params[[k]]) && inherits(params[[k]], "H2OFrame"))
+      params[[k]] <- params[[k]]$key
+  }
+  out <- .h2o.POST(paste0("/3/ModelBuilders/", algo), params)
+  key <- out$models[[1]]$model_id$name
+  h2o.getModel(key)
+}
+
+h2o.getModel <- function(model_id) {
+  out <- .h2o.GET(paste0("/3/Models/",
+                         utils::URLencode(model_id, reserved = TRUE)))
+  m <- out$models[[1]]
+  structure(list(key = model_id, algo = m$algo,
+                 parameters = m$parameters, output = m$output),
+            class = "H2OModel")
+}
+
+print.H2OModel <- function(x, ...) {
+  cat("H2OModel", x$key, "(", x$algo, ")\n")
+  tm <- x$output$training_metrics
+  if (!is.null(tm) && !identical(tm, NA)) {
+    for (k in names(tm)) cat(" ", k, "=", format(tm[[k]]), "\n")
+  }
+  invisible(x)
+}
+
+h2o.predict <- function(object, newdata, predictions_frame = NULL) {
+  params <- list()
+  if (!is.null(predictions_frame)) params$predictions_frame <- predictions_frame
+  out <- .h2o.POST(paste0(
+    "/3/Predictions/models/", utils::URLencode(object$key, reserved = TRUE),
+    "/frames/", utils::URLencode(newdata$key, reserved = TRUE)), params)
+  .h2o.frameHandle(out$model_metrics[[1]]$predictions_frame$name)
+}
+
+h2o.performance <- function(model, newdata = NULL) {
+  if (is.null(newdata)) return(model$output$training_metrics)
+  out <- .h2o.POST(paste0(
+    "/3/ModelMetrics/models/", utils::URLencode(model$key, reserved = TRUE),
+    "/frames/", utils::URLencode(newdata$key, reserved = TRUE)),
+    list(force = TRUE))
+  out$model_metrics[[1]]
+}
+
+h2o.make_metrics <- function(predicted, actuals, domain = NULL,
+                             distribution = "gaussian") {
+  params <- list(distribution = distribution)
+  if (!is.null(domain)) params$domain <- as.list(domain)
+  out <- .h2o.POST(paste0(
+    "/3/ModelMetrics/predictions_frame/",
+    utils::URLencode(predicted$key, reserved = TRUE),
+    "/actuals_frame/", utils::URLencode(actuals$key, reserved = TRUE)),
+    params)
+  out$model_metrics[[1]]
+}
+
+.h2o.metric <- function(mm, name) {
+  if (inherits(mm, "H2OModel")) mm <- mm$output$training_metrics
+  v <- mm[[name]]
+  if (is.null(v)) NA_real_ else as.numeric(v)
+}
+
+h2o.auc     <- function(mm) .h2o.metric(mm, "auc")
+h2o.aucpr   <- function(mm) .h2o.metric(mm, "pr_auc")
+h2o.logloss <- function(mm) .h2o.metric(mm, "logloss")
+h2o.rmse    <- function(mm) .h2o.metric(mm, "rmse")
+h2o.mse     <- function(mm) .h2o.metric(mm, "mse")
+h2o.mae     <- function(mm) .h2o.metric(mm, "mae")
+h2o.r2      <- function(mm) .h2o.metric(mm, "r2")
+h2o.giniCoef <- function(mm) .h2o.metric(mm, "gini")
+h2o.mean_per_class_error <- function(mm) .h2o.metric(mm, "mean_per_class_error")
+
+h2o.varimp <- function(model) {
+  out <- .h2o.GET(paste0("/3/Models/",
+                         utils::URLencode(model$key, reserved = TRUE),
+                         "/varimp"))
+  out$varimp
+}
+
+h2o.saveModel <- function(object, path, force = TRUE) {
+  out <- .h2o.GET(paste0("/99/Models.bin/",
+                         utils::URLencode(object$key, reserved = TRUE),
+                         "?dir=", utils::URLencode(path, reserved = TRUE),
+                         "&force=", tolower(as.character(force))))
+  out$dir
+}
+
+h2o.loadModel <- function(path, model_id = NULL) {
+  id <- if (is.null(model_id))
+    paste0("model_", format(as.numeric(Sys.time()) * 1000,
+                            scientific = FALSE))
+  else model_id
+  out <- .h2o.POST(paste0("/99/Models.bin/",
+                          utils::URLencode(id, reserved = TRUE),
+                          "?dir=", utils::URLencode(path, reserved = TRUE)))
+  h2o.getModel(out$models[[1]]$model_id$name)
+}
+
+h2o.listModels <- function() {
+  models <- .h2o.GET("/3/Models")$models
+  vapply(models, function(m) m$model_id$name, character(1))
+}
+
+h2o.getGrid <- function(grid_id) {
+  .h2o.GET(paste0("/99/Grids/", utils::URLencode(grid_id, reserved = TRUE)))
+}
+
+h2o.grid <- function(algo, hyper_params, grid_id = NULL, ...) {
+  params <- list(...)
+  for (k in c("training_frame", "validation_frame")) {
+    if (!is.null(params[[k]]) && inherits(params[[k]], "H2OFrame"))
+      params[[k]] <- params[[k]]$key
+  }
+  params$hyper_parameters <- hyper_params
+  if (!is.null(grid_id)) params$grid_id <- grid_id
+  .h2o.POST(paste0("/99/Grid/", algo), params)
+}
+
+h2o.automl <- function(training_frame, y, max_models = NULL,
+                       max_runtime_secs = NULL, ...) {
+  params <- list(...)
+  params$training_frame <- if (inherits(training_frame, "H2OFrame"))
+    training_frame$key else training_frame
+  params$response_column <- y
+  if (!is.null(max_models)) params$max_models <- max_models
+  if (!is.null(max_runtime_secs)) params$max_runtime_secs <- max_runtime_secs
+  .h2o.POST("/99/AutoMLBuilder", params)
+}

@@ -10,6 +10,11 @@ estimator classes with explicit keyword signatures and docstrings.
 Usage:
     python scripts/gen_bindings.py out.py                # in-process registry
     python scripts/gen_bindings.py out.py http://host:port  # over REST
+    python scripts/gen_bindings.py --r h2o3r/R/estimators_gen.R  # R emitter
+
+The R emitter is the ``h2o-bindings/bin/gen_R.py`` analogue: it emits one
+``h2o.<algo>`` wrapper per registered algorithm (h2o-r naming), each with
+the full keyword surface of the server-side Parameters dataclass.
 """
 
 from __future__ import annotations
@@ -140,16 +145,107 @@ def generate(schemas) -> str:
     return "".join(chunks)
 
 
+R_FUNC_NAMES = {
+    "gbm": "h2o.gbm",
+    "drf": "h2o.randomForest",
+    "xgboost": "h2o.xgboost",
+    "glm": "h2o.glm",
+    "gam": "h2o.gam",
+    "deeplearning": "h2o.deeplearning",
+    "kmeans": "h2o.kmeans",
+    "naivebayes": "h2o.naiveBayes",
+    "pca": "h2o.prcomp",
+    "svd": "h2o.svd",
+    "isolationforest": "h2o.isolationForest",
+    "extendedisolationforest": "h2o.extendedIsolationForest",
+    "coxph": "h2o.coxph",
+    "glrm": "h2o.glrm",
+    "psvm": "h2o.psvm",
+    "rulefit": "h2o.rulefit",
+    "stackedensemble": "h2o.stackedEnsemble",
+    "word2vec": "h2o.word2vec",
+    "aggregator": "h2o.aggregator",
+    "targetencoder": "h2o.targetencoder",
+    "generic": "h2o.genericModel",
+}
+
+R_HEADER = """# GENERATED estimator wrappers -- do not edit by hand.
+#
+# Regenerate with: python scripts/gen_bindings.py --r h2o3r/R/estimators_gen.R
+# (the h2o-bindings/bin/gen_R.py analogue). Each wrapper's arguments are
+# exactly the server-side Parameters dataclass fields at generation time;
+# non-NULL arguments travel to POST /3/ModelBuilders/{algo}.
+
+"""
+
+
+def _r_default(v) -> str:
+    if v is None:
+        return "NULL"
+    if isinstance(v, bool):
+        return "TRUE" if v else "FALSE"
+    if isinstance(v, str):
+        return '"' + v.replace('"', '\\"') + '"'
+    if isinstance(v, float) and v != v:
+        return "NaN"
+    if isinstance(v, float) and v in (float("inf"), float("-inf")):
+        return "Inf" if v > 0 else "-Inf"
+    return repr(v)
+
+
+def _r_name(py_name: str) -> str:
+    # trailing-underscore python names (lambda_) keep the h2o-r spelling
+    return py_name.rstrip("_") if py_name.endswith("_") else py_name
+
+
+def generate_r(schemas) -> str:
+    chunks = [R_HEADER]
+    for s in sorted(schemas, key=lambda s: s["algo"]):
+        fn = R_FUNC_NAMES.get(s["algo"])
+        if fn is None:
+            continue
+        args, body = [], []
+        args.append("training_frame")
+        args.append("validation_frame = NULL")
+        body.append('  params <- list()')
+        body.append('  params$training_frame <- training_frame')
+        body.append('  params$validation_frame <- validation_frame')
+        seen = {"training_frame", "validation_frame"}
+        for f in s["fields"]:
+            rn = _r_name(f["name"])
+            if rn in seen:
+                continue
+            seen.add(rn)
+            args.append(f"{rn} = {_r_default(f['default_value'])}")
+            body.append(f'  params${f["name"]} <- {rn}')
+        args.append("model_id = NULL")
+        body.append('  params$model_id <- model_id')
+        sep = ",\n                "  # hoisted: pre-3.12 f-strings reject \n
+        chunks.append(
+            f"{fn} <- function({sep.join(args)}) {{\n"
+            + "\n".join(body)
+            + f'\n  .h2o.train("{s["algo"]}", params)\n}}\n\n'
+        )
+    return "".join(chunks)
+
+
 def main() -> None:
-    out_path = sys.argv[1] if len(sys.argv) > 1 else "generated_estimators.py"
-    if len(sys.argv) > 2:
-        schemas = schemas_from_server(sys.argv[2].rstrip("/"))
+    argv = list(sys.argv[1:])
+    r_mode = "--r" in argv
+    if r_mode:
+        argv.remove("--r")
+    out_path = argv[0] if argv else (
+        "h2o3r/R/estimators_gen.R" if r_mode else "generated_estimators.py")
+    if len(argv) > 1:
+        schemas = schemas_from_server(argv[1].rstrip("/"))
     else:
         schemas = schemas_from_registry()
-    code = generate(schemas)
+    code = generate_r(schemas) if r_mode else generate(schemas)
     with open(out_path, "w") as f:
         f.write(code)
-    print(f"wrote {out_path}: {code.count('class ')} estimator classes")
+    unit = "wrappers" if r_mode else "estimator classes"
+    n = code.count("<- function(") if r_mode else code.count("class ")
+    print(f"wrote {out_path}: {n} {unit}")
 
 
 if __name__ == "__main__":

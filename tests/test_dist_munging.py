@@ -38,11 +38,6 @@ def force_device(monkeypatch):
     assert calls["n"] > 0, "device path never executed — parity test vacuous"
 
 
-@pytest.fixture
-def force_host(monkeypatch):
-    monkeypatch.setattr(dist, "DIST_SORT_MIN", 1 << 60)
-
-
 class TestDeviceArgsort:
     def test_exact_vs_numpy_1m(self):
         rng = np.random.default_rng(0)
@@ -66,6 +61,13 @@ class TestDeviceArgsort:
         assert np.isnan(got[:n_nan]).all()  # NAs first (Merge.sort)
         rest = got[n_nan:]
         assert (rest[:-1] <= rest[1:]).all()
+
+    def test_negative_zero_ties_with_positive_zero(self):
+        # host oracles treat -0.0 == 0.0; the encoding must too, or
+        # multi-key sorts order the tie block differently than numpy
+        x = np.tile(np.array([-0.0, 0.0, 1.0, -1.0]), 5000)
+        order = dist.device_argsort_u64(dist.encode_f64(x))
+        np.testing.assert_array_equal(order, np.argsort(x, kind="stable"))
 
     def test_descending(self):
         rng = np.random.default_rng(2)
@@ -196,6 +198,24 @@ class TestGroupByParity:
         np.testing.assert_allclose(dev.col("var_v").data,
                                    host.col("var_v").data,
                                    rtol=1e-2, atol=1e-4)
+
+    def test_nrow_rm_column_name_matches_host(self, force_device,
+                                              monkeypatch):
+        rng = np.random.default_rng(12)
+        n = 50_000
+        v = rng.normal(size=n)
+        v[:100] = np.nan
+        fr = Frame([
+            Column("g", rng.integers(0, 4, n).astype(np.int32),
+                   ColType.CAT, list("wxyz")),
+            Column("v", v),
+        ])
+        dev = group_by(fr, [0], [("nrow", 1, "rm")])
+        monkeypatch.setattr(dist, "DIST_SORT_MIN", 1 << 60)
+        host = group_by(fr, [0], [("nrow", 1, "rm")])
+        assert dev.names == host.names == ["g", "nrow"]
+        np.testing.assert_array_equal(dev.col("nrow").data,
+                                      host.col("nrow").data)
 
     def test_mode_median_fall_back_to_host(self, monkeypatch):
         monkeypatch.setattr(dist, "DIST_SORT_MIN", 1)

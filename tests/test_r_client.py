@@ -1,0 +1,162 @@
+"""R client package (VERDICT r3 item 5).
+
+Reference: h2o-r/h2o-package/R/{connection,frame,models}.R and
+h2o-bindings/bin/gen_R.py. The image has no R runtime, so the contract
+here is golden-file + structural: the generated wrappers must stay in
+lockstep with the server registry (regeneration is drift), every
+registered algo must have its h2o-r-named wrapper with exactly the
+server's parameter surface, and the handwritten R sources must at least
+be brace/paren balanced and route-correct. When an Rscript appears in
+the image, the smoke test below runs a real train/predict."""
+
+import dataclasses
+import os
+import re
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RPKG = os.path.join(REPO, "h2o3r")
+
+
+def _read(name):
+    with open(os.path.join(RPKG, "R", name)) as f:
+        return f.read()
+
+
+class TestGeneratedWrappers:
+    def test_no_drift_vs_registry(self, tmp_path):
+        """Regenerating from the live registry must reproduce the
+        committed file byte-for-byte — the same guarantee the python
+        estimator bindings test pins."""
+        out = tmp_path / "gen.R"
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "gen_bindings.py"),
+             "--r", str(out)],
+            capture_output=True, text=True, cwd=REPO)
+        assert proc.returncode == 0, proc.stderr
+        assert out.read_text() == _read("estimators_gen.R")
+
+    def test_every_algo_has_a_wrapper(self):
+        from h2o3_tpu.api.registry import algo_map
+        from scripts.gen_bindings import R_FUNC_NAMES
+
+        code = _read("estimators_gen.R")
+        for algo in algo_map():
+            fn = R_FUNC_NAMES.get(algo)
+            assert fn, f"no R name mapped for {algo}"
+            assert f"{fn} <- function(" in code, fn
+
+    def test_wrapper_args_match_server_params(self):
+        from h2o3_tpu.api.registry import algo_map
+
+        code = _read("estimators_gen.R")
+        # gbm as the canary: every Parameters field surfaces as an arg
+        _, pcls = algo_map()["gbm"]
+        m = re.search(r"h2o\.gbm <- function\((.*?)\)\s*\{", code, re.S)
+        assert m
+        args = {a.split("=")[0].strip() for a in m.group(1).split(",")}
+        for f in dataclasses.fields(pcls):
+            rn = f.name.rstrip("_") if f.name.endswith("_") else f.name
+            assert rn in args, f"gbm wrapper missing {f.name}"
+
+    def test_wrappers_post_to_model_builders(self):
+        code = _read("estimators_gen.R")
+        assert code.count('.h2o.train("') == code.count("<- function(")
+
+
+class TestHandwrittenSources:
+    FILES = ["json.R", "connection.R", "frame.R", "models.R"]
+
+    @pytest.mark.parametrize("name", FILES)
+    def test_balanced_delimiters(self, name):
+        code = _read(name)
+        # strip strings and comments line-wise before counting
+        stripped = []
+        for line in code.splitlines():
+            line = re.sub(r'"(\\.|[^"\\])*"', '""', line)
+            line = re.sub(r"#.*$", "", line)
+            stripped.append(line)
+        text = "\n".join(stripped)
+        for o, c in ("()", "{}", "[]"):
+            assert text.count(o) == text.count(c), (name, o)
+
+    def test_routes_exist_on_server(self):
+        """Every REST path the R sources mention must be a registered
+        route — the R client can never drift onto a dead endpoint."""
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from h2o3_tpu.api.server import H2OServer
+
+        srv = H2OServer(port=0)
+        known = [(m, p.pattern) for m, p, _n, _h, _s in srv.registry.routes]
+
+        def served(method, path):
+            path = path.split("?")[0]
+            return any(
+                m == method and re.match(pat, path)
+                for m, pat in known
+            )
+
+        code = "\n".join(_read(n) for n in self.FILES)
+        for m_ in re.finditer(
+                r'\.h2o\.(GET|POST|DELETE|GETraw)\(paste0\("([^"]+)"', code):
+            verb, prefix = m_.group(1), m_.group(2)
+            verb = "GET" if verb == "GETraw" else verb
+            # complete the template with a dummy segment per paste0 arg
+            probe = prefix + "x"
+            if not prefix.endswith("/"):
+                probe = prefix.rstrip("?&") if "?" in prefix else prefix + "/x"
+                probe = probe.split("?")[0]
+                if not served(verb, probe):
+                    probe = prefix.split("?")[0]
+            assert served(verb, probe), (verb, prefix)
+        for m_ in re.finditer(r'\.h2o\.(GET|POST|DELETE)\("([^"]+)"', code):
+            verb, path = m_.group(1), m_.group(2)
+            assert served(verb, path), (verb, path)
+
+    def test_package_metadata(self):
+        assert os.path.exists(os.path.join(RPKG, "DESCRIPTION"))
+        assert os.path.exists(os.path.join(RPKG, "NAMESPACE"))
+        desc = open(os.path.join(RPKG, "DESCRIPTION")).read()
+        assert "Package: h2o3r" in desc
+
+
+@pytest.mark.skipif(shutil.which("Rscript") is None,
+                    reason="no R runtime in this image")
+class TestRSmoke:
+    def test_train_predict_over_rest(self, tmp_path):
+        import numpy as np
+
+        from h2o3_tpu.api import start_server
+
+        rng = np.random.default_rng(3)
+        csv = "x0,x1,y\n" + "\n".join(
+            f"{a:.4f},{b:.4f},{'yes' if a + b > 0 else 'no'}"
+            for a, b in rng.normal(size=(300, 2)))
+        data = tmp_path / "train.csv"
+        data.write_text(csv)
+        s = start_server(port=0)
+        try:
+            script = f"""
+source_dir <- file.path("{RPKG}", "R")
+for (f in list.files(source_dir, full.names = TRUE)) source(f)
+h2o.init(port = {s.port})
+fr <- h2o.uploadFile("{data}")
+m <- h2o.glm(fr, response_column = "y", family = "binomial")
+stopifnot(h2o.auc(m) > 0.6)
+p <- h2o.predict(m, fr)
+stopifnot(h2o.nrow(p) == 300)
+cat("R-SMOKE-OK\\n")
+"""
+            proc = subprocess.run(
+                ["Rscript", "-e", script], capture_output=True, text=True,
+                timeout=300)
+            assert proc.returncode == 0, proc.stderr
+            assert "R-SMOKE-OK" in proc.stdout
+        finally:
+            s.stop()
