@@ -249,6 +249,33 @@ def register(r: RequestServer, server: H2OServer) -> None:  # noqa: C901
     def new_model_id(params, algo):
         return {"model_id": {"name": DKV.make_key(f"{algo}_model")}}
 
+    def _pojo_lang(params) -> str:
+        # one normalizer for both routes: preview must preview exactly
+        # what the full route serves
+        return "c" if str(params.get("lang", "java")).lower() == "c" \
+            else "java"
+
+    def model_java(params, model_id):
+        m = _get_model(model_id)
+        try:
+            src = m.pojo(_pojo_lang(params))
+        except ValueError as e:
+            raise RestError(400, str(e))
+        return src.encode(), "text/plain; charset=utf-8"
+
+    def model_preview(params, model_id):
+        m = _get_model(model_id)
+        try:
+            src = m.pojo(_pojo_lang(params))
+        except ValueError as e:
+            raise RestError(400, str(e))
+        head = "\n".join(src.splitlines()[:60])
+        return head.encode(), "text/plain; charset=utf-8"
+
+    r.register("GET", "/3/Models.java/{model_id}", model_java,
+               "POJO scoring source (java; ?lang=c for the C emitter)")
+    r.register("GET", "/3/Models.java/{model_id}/preview", model_preview,
+               "POJO source preview")
     r.register("GET", "/99/Models.bin/{model_id}", model_export,
                "export model binary to a server path")
     r.register("POST", "/99/Models.bin/{model_id}", model_import,

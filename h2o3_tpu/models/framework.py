@@ -184,6 +184,14 @@ class Model:
             y.astype(np.int64), raw, self.data_info.response_domain, weights=w
         )
 
+    def pojo(self, lang: str = "c") -> str:
+        """Standalone scoring source (hex/tree/TreeJCodeGen / water/codegen
+        POJO export, /3/Models.java): C (compiles with any C99 toolchain)
+        or Java (genmodel score0 shape). Tree models + GLM."""
+        from h2o3_tpu.models.pojo import pojo_source
+
+        return pojo_source(self, lang)
+
     def download_mojo(self, path: str) -> str:
         """Export as a portable MOJO zip (Model.getMojo, /3/Models .../mojo);
         scored offline by the numpy-only ``h2o3_tpu.genmodel`` package."""

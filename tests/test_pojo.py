@@ -1,0 +1,282 @@
+"""POJO codegen: standalone C/Java scoring source (VERDICT r3 item 6).
+
+Reference: hex/tree/TreeJCodeGen.java, water/codegen/, the
+/3/Models.java route. The C emitter is compiled with the image's real
+gcc and executed via ctypes; predictions must match the in-framework
+predict path (the reference's POJO-vs-model parity contract,
+testPojoConsistency)."""
+
+import ctypes
+import os
+import subprocess
+import urllib.request
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.frame.frame import ColType, Column, Frame
+
+
+def _frame(rng, n=500, nclass=2):
+    X = rng.normal(size=(n, 4))
+    cat = rng.integers(0, 3, size=n).astype(np.int32)
+    logit = X[:, 0] - 0.8 * X[:, 1] + 0.5 * cat
+    if nclass == 2:
+        y = (logit + rng.normal(size=n) * 0.3 > 0).astype(np.int32)
+        ycol = Column("y", y, ColType.CAT, ["n", "p"])
+    elif nclass > 2:
+        y = np.clip(np.digitize(logit, [-1.0, 1.0]), 0, 2).astype(np.int32)
+        ycol = Column("y", y, ColType.CAT, ["a", "b", "c"])
+    else:
+        ycol = Column("y", logit + rng.normal(size=n) * 0.1)
+    cols = [Column(f"x{i}", X[:, i]) for i in range(4)]
+    cols.append(Column("c", cat, ColType.CAT, ["u", "v", "w"]))
+    cols.append(ycol)
+    fr = Frame(cols)
+    # sprinkle NAs so default-direction routing is exercised
+    xs = fr.col("x0").data
+    xs[rng.random(n) < 0.05] = np.nan
+    return fr
+
+
+def _compile(src: str, tmp_path, name: str):
+    c_path = tmp_path / f"{name}.c"
+    so_path = tmp_path / f"{name}.so"
+    c_path.write_text(src)
+    proc = subprocess.run(
+        ["gcc", "-O2", "-shared", "-fPIC", "-o", str(so_path), str(c_path),
+         "-lm"],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+    return ctypes.CDLL(str(so_path))
+
+
+def _tree_score_all(lib, X32: np.ndarray, n_out: int) -> np.ndarray:
+    lib.score.argtypes = [ctypes.POINTER(ctypes.c_float),
+                          ctypes.POINTER(ctypes.c_double)]
+    out = np.zeros((X32.shape[0], n_out))
+    buf = np.zeros(n_out, dtype=np.float64)
+    for i in range(X32.shape[0]):
+        row = np.ascontiguousarray(X32[i], dtype=np.float32)
+        lib.score(row.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                  buf.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+        out[i] = buf
+    return out
+
+
+class TestTreePojoC:
+    @pytest.mark.parametrize("algo", ["gbm", "drf"])
+    def test_binomial_parity(self, rng, tmp_path, algo):
+        from h2o3_tpu.models.tree.common import tree_matrix
+        from h2o3_tpu.models.tree.drf import DRF
+        from h2o3_tpu.models.tree.gbm import GBM
+
+        fr = _frame(rng)
+        cls = GBM if algo == "gbm" else DRF
+        m = cls(ntrees=8, max_depth=4, response_column="y", seed=1,
+                min_rows=2).train(fr)
+        lib = _compile(m.pojo("c"), tmp_path, f"{algo}_bin")
+        X32 = tree_matrix(m.data_info, fr, encoding=m.tree_encoding)
+        got = _tree_score_all(lib, X32, 3)
+        want = m._predict_raw(fr)  # [N, 2] probabilities
+        np.testing.assert_allclose(got[:, 1:], want, rtol=1e-5, atol=1e-6)
+
+    def test_multinomial_parity(self, rng, tmp_path):
+        from h2o3_tpu.models.tree.common import tree_matrix
+        from h2o3_tpu.models.tree.gbm import GBM
+
+        fr = _frame(rng, nclass=3)
+        m = GBM(ntrees=5, max_depth=3, response_column="y", seed=2,
+                min_rows=2).train(fr)
+        lib = _compile(m.pojo("c"), tmp_path, "gbm_multi")
+        X32 = tree_matrix(m.data_info, fr, encoding=m.tree_encoding)
+        got = _tree_score_all(lib, X32, 4)
+        want = m._predict_raw(fr)  # [N, 3]
+        np.testing.assert_allclose(got[:, 1:], want, rtol=1e-5, atol=1e-6)
+        np.testing.assert_array_equal(got[:, 0], want.argmax(axis=1))
+
+    def test_drf_multinomial_parity(self, rng, tmp_path):
+        from h2o3_tpu.models.tree.common import tree_matrix
+        from h2o3_tpu.models.tree.drf import DRF
+
+        fr = _frame(rng, nclass=3)
+        m = DRF(ntrees=6, max_depth=3, response_column="y", seed=9,
+                min_rows=2).train(fr)
+        lib = _compile(m.pojo("c"), tmp_path, "drf_multi")
+        X32 = tree_matrix(m.data_info, fr, encoding=m.tree_encoding)
+        got = _tree_score_all(lib, X32, 4)
+        want = m._predict_raw(fr)
+        np.testing.assert_allclose(got[:, 1:], want, rtol=1e-5, atol=1e-6)
+
+    def test_regression_parity_log_link(self, rng, tmp_path):
+        from h2o3_tpu.models.tree.common import tree_matrix
+        from h2o3_tpu.models.tree.gbm import GBM
+
+        fr = _frame(rng, nclass=0)
+        # poisson needs nonnegative response
+        y = fr.col("y").data
+        y[:] = np.exp(np.clip(y, -3, 2))
+        m = GBM(ntrees=6, max_depth=3, response_column="y", seed=3,
+                min_rows=2, distribution="poisson").train(fr)
+        lib = _compile(m.pojo("c"), tmp_path, "gbm_pois")
+        X32 = tree_matrix(m.data_info, fr, encoding=m.tree_encoding)
+        got = _tree_score_all(lib, X32, 1)
+        want = m._predict_raw(fr)
+        np.testing.assert_allclose(got[:, 0], want, rtol=1e-5)
+
+    def test_one_hot_encoding_parity(self, rng, tmp_path):
+        from h2o3_tpu.models.tree.common import tree_matrix
+        from h2o3_tpu.models.tree.gbm import GBM
+
+        fr = _frame(rng)
+        m = GBM(ntrees=5, max_depth=3, response_column="y", seed=4,
+                min_rows=2,
+                categorical_encoding="one_hot_explicit").train(fr)
+        lib = _compile(m.pojo("c"), tmp_path, "gbm_onehot")
+        X32 = tree_matrix(m.data_info, fr, encoding=m.tree_encoding)
+        got = _tree_score_all(lib, X32, 3)
+        want = m._predict_raw(fr)
+        np.testing.assert_allclose(got[:, 1:], want, rtol=1e-5, atol=1e-6)
+
+
+class TestGLMPojoC:
+    def test_binomial_parity(self, rng, tmp_path):
+        from h2o3_tpu.models.data_info import expand_matrix
+        from h2o3_tpu.models.glm import GLM, GLMParameters
+
+        fr = _frame(rng)
+        m = GLM(GLMParameters(response_column="y", family="binomial",
+                              lambda_=0.01)).train(fr)
+        lib = _compile(m.pojo("c"), tmp_path, "glm_bin")
+        lib.score.argtypes = [ctypes.POINTER(ctypes.c_double),
+                              ctypes.POINTER(ctypes.c_double)]
+        X, _ = expand_matrix(m.data_info, fr, dtype=np.float64)
+        assert X.shape[1] == len(m.data_info.coef_names)
+        out = np.zeros(3)
+        want = m._predict_raw(fr)
+        for i in range(0, fr.nrows, 7):
+            row = np.ascontiguousarray(X[i])
+            lib.score(row.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                      out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+            np.testing.assert_allclose(out[1:], want[i], rtol=1e-10)
+
+    def test_binomial_noncanonical_link_parity(self, rng, tmp_path):
+        """A binomial GLM with link='log' must score through ITS link,
+        not a hardcoded sigmoid (review finding)."""
+        from h2o3_tpu.models.data_info import expand_matrix
+        from h2o3_tpu.models.glm import GLM, GLMParameters
+
+        fr = _frame(rng)
+        m = GLM(GLMParameters(response_column="y", family="binomial",
+                              link="log")).train(fr)
+        lib = _compile(m.pojo("c"), tmp_path, "glm_loglink")
+        lib.score.argtypes = [ctypes.POINTER(ctypes.c_double),
+                              ctypes.POINTER(ctypes.c_double)]
+        X, _ = expand_matrix(m.data_info, fr, dtype=np.float64)
+        want = m._predict_raw(fr)
+        out = np.zeros(3)
+        for i in range(0, fr.nrows, 13):
+            row = np.ascontiguousarray(X[i])
+            lib.score(row.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                      out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+            np.testing.assert_allclose(out[1:], want[i], rtol=1e-10)
+
+    def test_unsupported_glm_families_raise(self, rng):
+        from h2o3_tpu.models.glm import GLM, GLMParameters
+
+        fr = _frame(rng, nclass=3)
+        m = GLM(GLMParameters(response_column="y",
+                              family="multinomial")).train(fr)
+        with pytest.raises(ValueError, match="single-eta"):
+            m.pojo("c")
+
+    def test_offset_models_refuse(self, rng):
+        from h2o3_tpu.models.tree.gbm import GBM
+
+        fr = _frame(rng, nclass=0)
+        m = GBM(ntrees=3, max_depth=3, response_column="y", seed=8,
+                min_rows=2, offset_column="x3").train(fr)
+        with pytest.raises(ValueError, match="offset_column"):
+            m.pojo("c")
+
+    def test_gamma_inverse_link_parity(self, rng, tmp_path):
+        from h2o3_tpu.models.data_info import expand_matrix
+        from h2o3_tpu.models.glm import GLM, GLMParameters
+
+        fr = _frame(rng, nclass=0)
+        y = fr.col("y").data
+        y[:] = np.exp(np.clip(y, -2, 2)) + 0.1
+        m = GLM(GLMParameters(response_column="y", family="gamma")).train(fr)
+        lib = _compile(m.pojo("c"), tmp_path, "glm_gamma")
+        lib.score.argtypes = [ctypes.POINTER(ctypes.c_double),
+                              ctypes.POINTER(ctypes.c_double)]
+        X, _ = expand_matrix(m.data_info, fr, dtype=np.float64)
+        want = m._predict_raw(fr)
+        out = np.zeros(1)
+        for i in range(0, fr.nrows, 11):
+            row = np.ascontiguousarray(X[i])
+            lib.score(row.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                      out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+            np.testing.assert_allclose(out[0], want[i], rtol=1e-10)
+
+
+class TestJavaEmitterAndRoutes:
+    def test_java_source_structure(self, rng):
+        from h2o3_tpu.models.tree.gbm import GBM
+
+        fr = _frame(rng)
+        m = GBM(ntrees=3, max_depth=3, response_column="y", seed=5,
+                min_rows=2).train(fr)
+        src = m.pojo("java")
+        assert "public class POJO_" in src
+        assert "public static double[] score0(double[] row" in src
+        assert src.count("{") == src.count("}")
+        # every tree surfaces as a walk call
+        assert src.count("s += walk(") == 3
+
+    def test_rest_routes(self, rng):
+        from h2o3_tpu.api import start_server
+        from h2o3_tpu.models.tree.gbm import GBM
+
+        fr = _frame(rng)
+        m = GBM(ntrees=3, max_depth=3, response_column="y", seed=6,
+                min_rows=2).train(fr)
+        s = start_server(port=0)
+        try:
+            with urllib.request.urlopen(
+                    f"{s.url}/3/Models.java/{m.key}") as resp:
+                java = resp.read().decode()
+            assert "score0" in java
+            with urllib.request.urlopen(
+                    f"{s.url}/3/Models.java/{m.key}?lang=c") as resp:
+                c_src = resp.read().decode()
+            assert "void score(const float *x" in c_src
+            with urllib.request.urlopen(
+                    f"{s.url}/3/Models.java/{m.key}/preview") as resp:
+                prev = resp.read().decode()
+            assert len(prev.splitlines()) <= 60
+        finally:
+            s.stop()
+
+    def test_unsupported_model_is_clean_400(self, rng):
+        from h2o3_tpu.models.kmeans import KMeans, KMeansParameters
+
+        fr = _frame(rng)
+        m = KMeans(KMeansParameters(k=3)).train(fr.drop("y"))
+        with pytest.raises(ValueError, match="POJO export supports"):
+            m.pojo()
+
+    @pytest.mark.skipif(not os.path.exists("/usr/bin/javac"),
+                        reason="no JDK in this image")
+    def test_java_compiles(self, rng, tmp_path):
+        from h2o3_tpu.models.tree.gbm import GBM
+
+        fr = _frame(rng)
+        m = GBM(ntrees=3, max_depth=3, response_column="y", seed=7,
+                min_rows=2).train(fr)
+        src = m.pojo("java")
+        cls = src.split("public class ")[1].split(" ")[0]
+        (tmp_path / f"{cls}.java").write_text(src)
+        proc = subprocess.run(["javac", f"{cls}.java"], cwd=tmp_path,
+                              capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr
