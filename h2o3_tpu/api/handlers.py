@@ -423,6 +423,8 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
                 table=params.get("table"),
                 select_query=params.get("select_query"),
                 columns=cols or None,
+                partition_column=params.get("partition_column"),
+                num_partitions=int(params.get("num_partitions", 1)),
             )
         except FileNotFoundError as e:
             raise RestError(404, f"database not found: {e}")

@@ -14,10 +14,10 @@ Reference:
     (``ImportFilesHandler`` + ``ParseDataset.java:241 parseAllKeys``).
 
 TPU-native: all of this is host-side IO; the parsed product is dense
-columnar numpy that shards onto the mesh. S3/HDFS/GCS backends are not
-implementable in this image (no network egress, no SDKs baked in) — the
-scheme registry raises a clear error naming the missing backend instead of
-silently treating the URI as a local path.
+columnar numpy that shards onto the mesh. The S3/GCS/HDFS schemes are
+served by stdlib HTTP backends (``frame/cloud.py`` — SigV4, GCS JSON
+API, WebHDFS), registered below exactly like the reference's
+h2o-persist-* modules register with the PersistManager.
 """
 
 from __future__ import annotations
@@ -109,15 +109,20 @@ _PERSIST: Dict[str, Persist] = {
     "https": PersistHTTP(),
 }
 
-#: schemes the reference supports through optional modules that cannot run
-#: in this image (no egress / SDKs); named so the error is actionable
-_KNOWN_UNAVAILABLE = ("s3", "s3a", "s3n", "hdfs", "gs", "gcs", "jdbc")
+#: schemes served elsewhere: jdbc goes through import_sql_table (the
+#: SQLManager analogue), not the byte-oriented persist layer
+_KNOWN_UNAVAILABLE = ("jdbc",)
 
 _SCHEME_RE = re.compile(r"^([a-zA-Z][a-zA-Z0-9+.-]*)://")
 
 
 def resolve_persist(uri: str) -> Tuple[Persist, str]:
     """URI -> (backend, backend-local path). Plain paths map to file."""
+    if uri.lower().startswith("jdbc:"):  # JDBC urls have no '//'
+        raise ValueError(
+            "jdbc sources import through import_sql_table / "
+            "/99/ImportSQLTable (water/jdbc/SQLManager.java), not the "
+            "byte-oriented persist layer")
     m = _SCHEME_RE.match(uri)
     if not m:
         return _PERSIST["file"], uri
@@ -156,6 +161,14 @@ def decompress_parts(name: str, data: bytes) -> List[Tuple[str, bytes]]:
         inner = name[:-3] if name.lower().endswith(".gz") else name
         return decompress_parts(inner, gzip.decompress(data))
     if data[:4] == b"PK\x03\x04":  # zip
+        # an .xlsx IS a zip — it must reach the XLSX parser whole, not be
+        # exploded into its XML entries
+        try:
+            with zipfile.ZipFile(io.BytesIO(data)) as z:
+                if "[Content_Types].xml" in z.namelist():
+                    return [(name, data)]
+        except zipfile.BadZipFile:
+            return [(name, data)]
         with zipfile.ZipFile(io.BytesIO(data)) as z:
             names = sorted(
                 n for n in z.namelist()
@@ -183,6 +196,14 @@ def sniff_format(name: str, data: bytes) -> str:
     low = name.lower()
     if data[:4] == b"PAR1" or low.endswith(".parquet"):
         return "parquet"
+    if data[:3] == b"ORC" or low.endswith(".orc"):
+        return "orc"
+    if data[:4] == b"Obj\x01" or low.endswith(".avro"):
+        return "avro"
+    if data[:4] == b"PK\x03\x04" or low.endswith(".xlsx"):
+        return "xlsx"
+    if data[:4] == b"\xd0\xcf\x11\xe0" or low.endswith(".xls"):
+        return "xls"
     if low.endswith((".svm", ".svmlight")):
         return "svmlight"
     if low.endswith(".arff"):
@@ -337,16 +358,8 @@ def parse_arff(text: str, na_strings: Sequence[str] = DEFAULT_NA_STRINGS) -> Fra
     return Frame(cols)
 
 
-def parse_parquet(data: bytes) -> Frame:
-    """Parquet via pyarrow when available (h2o-parquet-parser analogue)."""
-    try:
-        import pyarrow.parquet as pq
-    except ImportError:
-        raise ValueError(
-            "parquet ingest needs pyarrow, which is not available in this "
-            "build (reference module: h2o-parquet-parser)"
-        )
-    table = pq.read_table(io.BytesIO(data))
+def _frame_from_arrow(table) -> Frame:
+    """Shared pyarrow Table -> Frame conversion (parquet + orc)."""
     cols: List[Column] = []
     for name in table.column_names:
         arr = table.column(name).to_pandas()
@@ -367,6 +380,242 @@ def parse_parquet(data: bytes) -> Frame:
                 )
             )
     return Frame(cols)
+
+
+def parse_parquet(data: bytes) -> Frame:
+    """Parquet via pyarrow when available (h2o-parquet-parser analogue)."""
+    try:
+        import pyarrow.parquet as pq
+    except ImportError:
+        raise ValueError(
+            "parquet ingest needs pyarrow, which is not available in this "
+            "build (reference module: h2o-parquet-parser)"
+        )
+    return _frame_from_arrow(pq.read_table(io.BytesIO(data)))
+
+
+def parse_orc(data: bytes) -> Frame:
+    """ORC via pyarrow (h2o-orc-parser analogue)."""
+    try:
+        import pyarrow.orc as po
+    except ImportError:
+        raise ValueError(
+            "orc ingest needs pyarrow.orc, which is not available in this "
+            "build (reference module: h2o-orc-parser)"
+        )
+    return _frame_from_arrow(po.ORCFile(io.BytesIO(data)).read())
+
+
+# ---------------------------------------------------------------------------
+# Avro object-container files (h2o-avro-parser analogue, stdlib-only)
+
+
+class _AvroReader:
+    """Minimal Avro binary decoder: primitives, unions with null, enums —
+    the flat-record shape tabular Avro files use."""
+
+    def __init__(self, data: bytes) -> None:
+        self.data = data
+        self.pos = 0
+
+    def read(self, n: int) -> bytes:
+        b = self.data[self.pos:self.pos + n]
+        if len(b) < n:
+            raise ValueError("avro: truncated file")
+        self.pos += n
+        return b
+
+    def long(self) -> int:
+        # zigzag varint
+        shift, acc = 0, 0
+        while True:
+            b = self.data[self.pos]
+            self.pos += 1
+            acc |= (b & 0x7F) << shift
+            if not b & 0x80:
+                break
+            shift += 7
+        return (acc >> 1) ^ -(acc & 1)
+
+    def bytes_(self) -> bytes:
+        return self.read(self.long())
+
+    def string(self) -> str:
+        return self.bytes_().decode("utf-8")
+
+    def value(self, schema):
+        import struct
+
+        if isinstance(schema, str):
+            t = schema
+        elif isinstance(schema, dict):
+            t = schema["type"]
+        elif isinstance(schema, list):  # union: branch index then value
+            branch = schema[self.long()]
+            return self.value(branch)
+        else:
+            raise ValueError(f"avro: bad schema node {schema!r}")
+        if t == "null":
+            return None
+        if t == "boolean":
+            return bool(self.read(1)[0])
+        if t in ("int", "long"):
+            return self.long()
+        if t == "float":
+            return struct.unpack("<f", self.read(4))[0]
+        if t == "double":
+            return struct.unpack("<d", self.read(8))[0]
+        if t == "bytes":
+            return self.bytes_()
+        if t == "string":
+            return self.string()
+        if t == "enum":
+            return schema["symbols"][self.long()]
+        raise ValueError(f"avro: unsupported field type {t!r} "
+                         f"(flat tabular records only)")
+
+
+def parse_avro(data: bytes) -> Frame:
+    """Avro object-container file -> Frame.
+
+    Reference: ``h2o-parsers/h2o-avro-parser`` (AvroParser.java): one
+    column per record field; null/deflate codecs; unions with null are
+    nullable columns."""
+    import zlib
+
+    if data[:4] != b"Obj\x01":
+        raise ValueError("not an Avro object container file")
+    r = _AvroReader(data)
+    r.pos = 4
+    meta: Dict[str, bytes] = {}
+    while True:
+        n = r.long()
+        if n == 0:
+            break
+        if n < 0:  # block with explicit byte size
+            r.long()
+            n = -n
+        for _ in range(n):
+            k = r.string()
+            meta[k] = r.bytes_()
+    sync = r.read(16)
+    import json as _json
+
+    schema = _json.loads(meta["avro.schema"].decode())
+    codec = meta.get("avro.codec", b"null").decode()
+    if schema.get("type") != "record":
+        raise ValueError("avro: top-level schema must be a record")
+    fields = schema["fields"]
+    names = [f["name"] for f in fields]
+    rows: List[list] = []
+    while r.pos < len(r.data):
+        count = r.long()
+        size = r.long()
+        block = r.read(size)
+        if codec == "deflate":
+            block = zlib.decompress(block, -15)
+        elif codec != "null":
+            raise ValueError(f"avro: unsupported codec {codec!r}")
+        br = _AvroReader(block)
+        for _ in range(count):
+            rows.append([br.value(f["type"]) for f in fields])
+        if r.read(16) != sync:
+            raise ValueError("avro: sync marker mismatch")
+    from h2o3_tpu.frame.parse import column_from_strings
+
+    cols: List[Column] = []
+    for j, name in enumerate(names):
+        vals = [row[j] for row in rows]
+        non_null = [v for v in vals if v is not None]
+        if all(isinstance(v, bool) for v in non_null) and non_null:
+            cols.append(Column(name, np.array(
+                [np.nan if v is None else float(v) for v in vals]),
+                ColType.NUM))
+        elif all(isinstance(v, (int, float)) for v in non_null):
+            cols.append(Column(name, np.array(
+                [np.nan if v is None else float(v) for v in vals]),
+                ColType.NUM))
+        else:
+            cols.append(column_from_strings(
+                name,
+                [None if v is None else
+                 (v.decode("utf-8", "replace") if isinstance(v, bytes)
+                  else str(v)) for v in vals]))
+    return Frame(cols)
+
+
+# ---------------------------------------------------------------------------
+# XLSX (water/parser/XlsParser analogue; stdlib zip + xml)
+
+
+def _xlsx_col_index(ref: str) -> int:
+    """'BC12' -> zero-based column 54."""
+    acc = 0
+    for ch in ref:
+        if ch.isalpha():
+            acc = acc * 26 + (ord(ch.upper()) - ord("A") + 1)
+        else:
+            break
+    return acc - 1
+
+
+def parse_xlsx(data: bytes) -> Frame:
+    """First worksheet of an .xlsx workbook; row 1 is the header."""
+    import xml.etree.ElementTree as ET
+
+    ns = "{http://schemas.openxmlformats.org/spreadsheetml/2006/main}"
+    with zipfile.ZipFile(io.BytesIO(data)) as z:
+        shared: List[str] = []
+        if "xl/sharedStrings.xml" in z.namelist():
+            root = ET.fromstring(z.read("xl/sharedStrings.xml"))
+            for si in root.findall(f"{ns}si"):
+                shared.append("".join(t.text or "" for t in si.iter(f"{ns}t")))
+        sheet_names = sorted(
+            n for n in z.namelist()
+            if re.fullmatch(r"xl/worksheets/sheet\d+\.xml", n))
+        if not sheet_names:
+            raise ValueError("xlsx: no worksheets")
+        root = ET.fromstring(z.read(sheet_names[0]))
+    grid: List[Dict[int, Optional[str]]] = []
+    for row in root.iter(f"{ns}row"):
+        cells: Dict[int, Optional[str]] = {}
+        for c in row.findall(f"{ns}c"):
+            ref = c.get("r", "")
+            j = _xlsx_col_index(ref) if ref else len(cells)
+            t = c.get("t", "n")
+            v = c.find(f"{ns}v")
+            if t == "inlineStr":
+                is_el = c.find(f"{ns}is")
+                cells[j] = "".join(
+                    t_.text or "" for t_ in is_el.iter(f"{ns}t")
+                ) if is_el is not None else None
+            elif v is None or v.text is None:
+                cells[j] = None
+            elif t == "s":
+                cells[j] = shared[int(v.text)]
+            elif t == "b":
+                cells[j] = "1" if v.text == "1" else "0"
+            else:
+                cells[j] = v.text
+        grid.append(cells)
+    if not grid:
+        raise ValueError("xlsx: empty sheet")
+    width = max(max(r.keys(), default=-1) for r in grid) + 1
+    header = [grid[0].get(j) or f"C{j + 1}" for j in range(width)]
+    from h2o3_tpu.frame.parse import column_from_strings
+
+    cols = []
+    for j in range(width):
+        vals = [r.get(j) for r in grid[1:]]
+        cols.append(column_from_strings(header[j], vals))
+    return Frame(cols)
+
+
+def parse_xls_legacy(data: bytes) -> Frame:
+    raise ValueError(
+        "legacy .xls (BIFF) ingest is not supported in this build; save "
+        "as .xlsx or csv (reference: water/parser/XlsParser.java)"
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -419,6 +668,14 @@ def parse_bytes(
             frames.append(parse_arff(part.decode("utf-8", errors="replace")))
         elif f == "parquet":
             frames.append(parse_parquet(part))
+        elif f == "orc":
+            frames.append(parse_orc(part))
+        elif f == "avro":
+            frames.append(parse_avro(part))
+        elif f == "xlsx":
+            frames.append(parse_xlsx(part))
+        elif f == "xls":
+            frames.append(parse_xls_legacy(part))
         else:
             raise ValueError(f"unknown format {f!r}")
     return rbind_all(frames)
@@ -454,54 +711,55 @@ def import_parse(
 # SQL import (water/jdbc/SQLManager.java)
 
 
-def import_sql_table(
-    connection_url: str,
-    table: Optional[str] = None,
-    select_query: Optional[str] = None,
-    columns: Optional[Sequence[str]] = None,
-) -> Frame:
-    """Import a SQL table/query result as a Frame.
+def _db_connect(connection_url: str):
+    """connection url -> a fresh DB-API connection.
 
-    Reference: ``water/jdbc/SQLManager.java`` — range-partitioned parallel
-    selects over a JDBC driver. This build ships the driver available in a
-    pure-Python image: sqlite via the stdlib (``sqlite:/path`` or
-    ``jdbc:sqlite:/path`` URLs). Other engines raise an actionable error
-    naming the reference module, like the persist scheme registry does.
-    """
-    import sqlite3
-
+    sqlite ships with the stdlib; postgresql/mysql connect through their
+    conventional python drivers when importable; anything else raises an
+    actionable error naming the reference module."""
     url = connection_url
+    low = url.lower()
     for prefix in ("jdbc:sqlite:", "sqlite://", "sqlite:"):
-        if url.lower().startswith(prefix):
+        if low.startswith(prefix):
+            import sqlite3
+
             path = url[len(prefix):]
-            break
-    else:
-        raise ValueError(
-            f"unsupported SQL connection url {connection_url!r}; this build "
-            f"ships sqlite ('sqlite:/path/db'); other engines need the "
-            f"reference's JDBC drivers (water/jdbc/SQLManager.java)"
-        )
-    if not os.path.exists(path):
-        raise FileNotFoundError(path)
-    if select_query is None:
-        if not table:
-            raise ValueError("either table or select_query is required")
-        if not re.fullmatch(r"[A-Za-z_][A-Za-z0-9_]*", table):
-            raise ValueError(f"invalid table name {table!r}")
-        cols_sql = "*"
-        if columns:
-            for c in columns:
-                if not re.fullmatch(r"[A-Za-z_][A-Za-z0-9_]*", c):
-                    raise ValueError(f"invalid column name {c!r}")
-            cols_sql = ", ".join(columns)
-        select_query = f"SELECT {cols_sql} FROM {table}"
-    conn = sqlite3.connect(path)
-    try:
-        cur = conn.execute(select_query)
-        names = [d[0] for d in cur.description]
-        rows = cur.fetchall()
-    finally:
-        conn.close()
+            if not os.path.exists(path):
+                raise FileNotFoundError(path)
+            # each partition thread opens its own connection; sqlite
+            # handles concurrent readers
+            return sqlite3.connect(path)
+    if low.startswith(("postgresql://", "postgres://", "jdbc:postgresql:")):
+        try:
+            import psycopg2  # type: ignore
+
+            return psycopg2.connect(url.replace("jdbc:postgresql:",
+                                                "postgresql:"))
+        except ImportError:
+            raise ValueError(
+                "postgresql import needs psycopg2, which is not available "
+                "in this build (reference: water/jdbc/SQLManager.java)")
+    if low.startswith(("mysql://", "jdbc:mysql:")):
+        try:
+            import pymysql  # type: ignore
+            from urllib.parse import urlparse
+
+            p = urlparse(url)
+            return pymysql.connect(
+                host=p.hostname, port=p.port or 3306, user=p.username,
+                password=p.password or "", database=p.path.lstrip("/"))
+        except ImportError:
+            raise ValueError(
+                "mysql import needs pymysql, which is not available in "
+                "this build (reference: water/jdbc/SQLManager.java)")
+    raise ValueError(
+        f"unsupported SQL connection url {connection_url!r}; supported: "
+        f"sqlite:/path (stdlib), postgresql:// (psycopg2), mysql:// "
+        f"(pymysql) — the reference loads arbitrary JDBC drivers "
+        f"(water/jdbc/SQLManager.java)")
+
+
+def _rows_to_frame(names: Sequence[str], rows: List[tuple]) -> Frame:
     from h2o3_tpu.frame.parse import column_from_strings
 
     out: List[Column] = []
@@ -520,3 +778,104 @@ def import_sql_table(
                 )
             )
     return Frame(out)
+
+
+_SQL_IDENT = r"[A-Za-z_][A-Za-z0-9_]*"
+
+
+def import_sql_table(
+    connection_url: str,
+    table: Optional[str] = None,
+    select_query: Optional[str] = None,
+    columns: Optional[Sequence[str]] = None,
+    partition_column: Optional[str] = None,
+    num_partitions: int = 1,
+) -> Frame:
+    """Import a SQL table/query result as a Frame.
+
+    Reference: ``water/jdbc/SQLManager.java`` — range-partitioned
+    parallel selects over a JDBC connection. ``partition_column`` (a
+    numeric column) + ``num_partitions`` reproduce that: the range
+    [min, max] splits into equal-width slices, each fetched on its own
+    connection in its own thread, concatenated in range order."""
+    cols_sql = "*"
+    if select_query is None:
+        if not table:
+            raise ValueError("either table or select_query is required")
+        if not re.fullmatch(_SQL_IDENT, table):
+            raise ValueError(f"invalid table name {table!r}")
+        if columns:
+            for c in columns:
+                if not re.fullmatch(_SQL_IDENT, c):
+                    raise ValueError(f"invalid column name {c!r}")
+            cols_sql = ", ".join(columns)
+        select_query = f"SELECT {cols_sql} FROM {table}"
+    else:
+        table = None  # an explicit query wins; partitions wrap it
+
+    def fetch(query: str):
+        conn = _db_connect(connection_url)
+        try:
+            cur = conn.cursor()
+            try:
+                cur.execute(query)
+            except Exception as e:  # DB-API Error hierarchies vary
+                raise ValueError(
+                    f"SQL import query failed: {type(e).__name__}: {e}")
+            return [d[0] for d in cur.description], cur.fetchall()
+        finally:
+            conn.close()
+
+    if partition_column and num_partitions > 1:
+        if not re.fullmatch(_SQL_IDENT, partition_column):
+            raise ValueError(f"invalid column name {partition_column!r}")
+        base = f"({select_query}) AS t" if table is None else table
+        _, bounds = fetch(
+            f"SELECT MIN({partition_column}), MAX({partition_column}) "
+            f"FROM {base}")
+        lo, hi = bounds[0]
+        if lo is None:
+            return _rows_to_frame(*fetch(select_query))
+        lo, hi = float(lo), float(hi)
+        edges = [lo + (hi - lo) * i / num_partitions
+                 for i in range(num_partitions + 1)]
+        from concurrent.futures import ThreadPoolExecutor
+
+        def part(i: int):
+            cond = (
+                f"{partition_column} >= {edges[i]!r} AND "
+                + (f"{partition_column} <= {edges[i + 1]!r}" if
+                   i == num_partitions - 1 else
+                   f"{partition_column} < {edges[i + 1]!r}")
+            )
+            # NULL partition keys ride with the first slice, so no row
+            # is dropped (SQLManager fetches them separately)
+            if i == 0:
+                cond = f"({cond}) OR {partition_column} IS NULL"
+            if table is not None:
+                # filter on the TABLE so a column projection that drops
+                # the partition column still partitions (SQLManager
+                # applies the range on the base table)
+                return fetch(f"SELECT {cols_sql} FROM {table} "
+                             f"WHERE {cond}")
+            return fetch(f"SELECT * FROM ({select_query}) AS q "
+                         f"WHERE {cond}")
+
+        with ThreadPoolExecutor(max_workers=min(num_partitions, 8)) as pool:
+            results = list(pool.map(part, range(num_partitions)))
+        names = results[0][0]
+        frames = [_rows_to_frame(names, rows) for _, rows in results
+                  if rows]
+        if not frames:
+            return _rows_to_frame(names, [])
+        return rbind_all(frames)
+
+    return _rows_to_frame(*fetch(select_query))
+
+
+# cloud persist schemes register at import (the PersistManager module
+# registration h2o-persist-{s3,gcs,hdfs} performs on the classpath).
+# Plain module import — cloud.py self-registers at its bottom, and the
+# module-object import (unlike a from-import of a name) is safe in both
+# import orders of this circular pair.
+from h2o3_tpu.frame import cloud as _cloud  # noqa: E402, F401

@@ -140,11 +140,14 @@ class TestPersistDispatch:
         fr = parse_source(f"file://{p}")
         assert fr.nrows == 3
 
-    def test_unavailable_scheme_named(self):
-        with pytest.raises(ValueError, match="h2o-persist-s3"):
-            resolve_persist("s3://bucket/key.csv")
-        with pytest.raises(ValueError, match="hdfs"):
-            resolve_persist("hdfs://nn/x.csv")
+    def test_cloud_schemes_resolve(self):
+        """s3/gs/hdfs now have real stdlib backends (frame/cloud.py,
+        round 4); they resolve instead of raising."""
+        for uri in ("s3://bucket/key.csv", "gs://bucket/key.csv",
+                    "hdfs://nn/x.csv"):
+            backend, path = resolve_persist(uri)
+            assert backend.scheme == uri.split(":")[0]
+            assert path == uri
 
     def test_unknown_scheme(self):
         with pytest.raises(ValueError, match="unknown URI scheme"):
