@@ -424,7 +424,7 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
                 select_query=params.get("select_query"),
                 columns=cols or None,
                 partition_column=params.get("partition_column"),
-                num_partitions=int(params.get("num_partitions", 1)),
+                num_partitions=int(params.get("num_partitions") or 1),
             )
         except FileNotFoundError as e:
             raise RestError(404, f"database not found: {e}")
@@ -1428,11 +1428,66 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
 <body>
 <h1>h2o3-tpu <span class=muted>Flow-lite</span></h1>
 <div id=cloud class=muted>loading&hellip;</div>
+<h2>Cell <span class=muted>(Rapids — see /99/Rapids/help)</span></h2>
+<div><textarea id=cell rows=3 cols=80
+ placeholder="(sort frame_id [0] [1])"></textarea><br>
+<button id=run>Run</button>
+<span class=muted>runs the expression server-side; assignments
+ ((= name expr)) appear under Frames</span></div>
+<pre id=cellout class=muted></pre>
+<h2>Import <span class=muted>(path/glob/URI on the server)</span></h2>
+<div><input id=ipath size=60 placeholder="/data/train.csv">
+<input id=iname size=20 placeholder="frame name (optional)">
+<button id=imp>Import &amp; parse</button></div>
+<pre id=impout class=muted></pre>
+<h2>Train</h2>
+<div><select id=talgo></select>
+<input id=tframe size=20 placeholder="training frame">
+<input id=tresp size=14 placeholder="response col">
+<input id=tparams size=40 placeholder='extra params JSON, e.g. {"ntrees":20}'>
+<button id=train>Train</button></div>
+<pre id=trainout class=muted></pre>
 <h2>Frames</h2><table id=frames></table>
 <h2>Models</h2><table id=models></table>
 <h2>Jobs</h2><table id=jobs></table>
 <script>
 async function j(p){const r=await fetch(p);return r.json()}
+async function post(p,body){const r=await fetch(p,{method:'POST',
+ headers:{'Content-Type':'application/json'},body:JSON.stringify(body)});
+ return r.json()}
+function show(id,v){document.getElementById(id).textContent=
+ typeof v==='string'?v:JSON.stringify(v,null,1)}
+document.addEventListener('DOMContentLoaded',()=>{
+ document.getElementById('run').onclick=async()=>{
+  const ast=document.getElementById('cell').value.trim();
+  if(!ast)return;
+  show('cellout',await post('/99/Rapids',{ast}));refresh()};
+ document.getElementById('imp').onclick=async()=>{
+  const path=document.getElementById('ipath').value.trim();
+  if(!path)return;
+  const up=await post('/3/ImportFiles',{path});
+  if(up.http_status){show('impout',up);return}
+  const dest=document.getElementById('iname').value.trim()||undefined;
+  const srcs=up.destination_frames?up.destination_frames:[up.destination_frame];
+  show('impout',await post('/3/Parse',
+   {source_frames:srcs,destination_frame:dest}));refresh()};
+ document.getElementById('train').onclick=async()=>{
+  const algo=document.getElementById('talgo').value;
+  let extra={};
+  const t=document.getElementById('tparams').value.trim();
+  if(t){try{extra=JSON.parse(t)}catch(e){show('trainout','bad JSON: '+e);return}}
+  const body=Object.assign({
+   training_frame:document.getElementById('tframe').value.trim(),
+   response_column:document.getElementById('tresp').value.trim()||undefined},
+   extra);
+  show('trainout','training…');
+  show('trainout',await post('/3/ModelBuilders/'+algo,body));refresh()};
+ j('/3/ModelBuilders').then(b=>{
+  const sel=document.getElementById('talgo');
+  for(const a of Object.keys(b.model_builders).sort()){
+   const o=document.createElement('option');o.value=a;o.textContent=a;
+   sel.appendChild(o)}});
+});
 function row(t,cells,th){const tr=document.createElement('tr');
  for(const c of cells){const td=document.createElement(th?'th':'td');
   td.textContent=c;tr.appendChild(td)} t.appendChild(tr)}

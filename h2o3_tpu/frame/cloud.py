@@ -146,14 +146,27 @@ class PersistS3(Persist):
     def list(self, path: str) -> List[str]:
         bucket, key = self._split(path)
         if not key or key.endswith("/"):
-            xml_doc = self._request(self._url(
-                bucket, "", "list-type=2&prefix=" +
-                urllib.parse.quote(key, safe="")))
-            ns = {"s3": "http://s3.amazonaws.com/doc/2006-03-01/"}
-            root = ET.fromstring(xml_doc)
-            keys = [el.text for el in root.iter()
-                    if el.tag.endswith("Key") and el.text
-                    and not el.text.endswith("/")]
+            keys: List[str] = []
+            token = None
+            while True:  # follow ListObjectsV2 pagination to the end
+                q = ("list-type=2&prefix=" +
+                     urllib.parse.quote(key, safe=""))
+                if token:
+                    q += ("&continuation-token=" +
+                          urllib.parse.quote(token, safe=""))
+                root = ET.fromstring(self._request(self._url(bucket, "", q)))
+                keys += [el.text for el in root.iter()
+                         if el.tag.endswith("Key") and el.text
+                         and not el.text.endswith("/")]
+                token = next(
+                    (el.text for el in root.iter()
+                     if el.tag.endswith("NextContinuationToken") and el.text),
+                    None)
+                truncated = next(
+                    (el.text for el in root.iter()
+                     if el.tag.endswith("IsTruncated")), "false")
+                if not token or truncated != "true":
+                    break
             if not keys:
                 raise FileNotFoundError(f"no objects under {path!r}")
             return [f"s3://{bucket}/{k}" for k in sorted(keys)]
@@ -194,11 +207,19 @@ class PersistGCS(Persist):
     def list(self, path: str) -> List[str]:
         bucket, key = self._split(path)
         if not key or key.endswith("/"):
-            url = (f"{self._base()}/storage/v1/b/{bucket}/o?prefix="
-                   f"{urllib.parse.quote(key, safe='')}")
-            doc = json.loads(_http(url, self._headers()))
-            names = [it["name"] for it in doc.get("items", [])
-                     if not it["name"].endswith("/")]
+            names: List[str] = []
+            token = None
+            while True:  # follow nextPageToken pagination to the end
+                url = (f"{self._base()}/storage/v1/b/{bucket}/o?prefix="
+                       f"{urllib.parse.quote(key, safe='')}")
+                if token:
+                    url += "&pageToken=" + urllib.parse.quote(token, safe="")
+                doc = json.loads(_http(url, self._headers()))
+                names += [it["name"] for it in doc.get("items", [])
+                          if not it["name"].endswith("/")]
+                token = doc.get("nextPageToken")
+                if not token:
+                    break
             if not names:
                 raise FileNotFoundError(f"no objects under {path!r}")
             return [f"gs://{bucket}/{n}" for n in sorted(names)]

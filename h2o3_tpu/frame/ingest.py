@@ -527,11 +527,7 @@ def parse_avro(data: bytes) -> Frame:
     for j, name in enumerate(names):
         vals = [row[j] for row in rows]
         non_null = [v for v in vals if v is not None]
-        if all(isinstance(v, bool) for v in non_null) and non_null:
-            cols.append(Column(name, np.array(
-                [np.nan if v is None else float(v) for v in vals]),
-                ColType.NUM))
-        elif all(isinstance(v, (int, float)) for v in non_null):
+        if all(isinstance(v, (int, float)) for v in non_null):  # incl. bool
             cols.append(Column(name, np.array(
                 [np.nan if v is None else float(v) for v in vals]),
                 ColType.NUM))
@@ -836,9 +832,15 @@ def import_sql_table(
         lo, hi = bounds[0]
         if lo is None:
             return _rows_to_frame(*fetch(select_query))
-        lo, hi = float(lo), float(hi)
-        edges = [lo + (hi - lo) * i / num_partitions
-                 for i in range(num_partitions + 1)]
+        if isinstance(lo, int) and isinstance(hi, int):
+            # integer keys stay integer: float() truncates above 2^53
+            # (snowflake-style 64-bit ids) and would drop the max rows
+            edges = [lo + (hi - lo) * i // num_partitions
+                     for i in range(num_partitions)] + [hi]
+        else:
+            lo, hi = float(lo), float(hi)
+            edges = [lo + (hi - lo) * i / num_partitions
+                     for i in range(num_partitions + 1)]
         from concurrent.futures import ThreadPoolExecutor
 
         def part(i: int):

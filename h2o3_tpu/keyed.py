@@ -61,7 +61,10 @@ class KeyedStore:
     def __init__(self) -> None:
         self._store: Dict[str, Any] = {}
         self._lock = threading.RLock()
-        self._scopes: List[List[str]] = []
+        # Scope stacks are PER-THREAD (water/Scope.java is thread-local
+        # too): concurrent builds (parallel grid, REST train threads)
+        # must never see — or pop — each other's scopes
+        self._scopes_tl = threading.local()
         self._budget: Optional[int] = None
         self._ice_dir: Optional[str] = None
         self._access: Dict[str, int] = {}  # frame key -> access counter
@@ -74,6 +77,13 @@ class KeyedStore:
         #: calls must never pick the same victim (two writers to one
         #: path + a lost-race unlink would delete the winner's file)
         self._spilling: set = set()
+
+    @property
+    def _scopes(self) -> List[List[str]]:
+        stack = getattr(self._scopes_tl, "stack", None)
+        if stack is None:
+            stack = self._scopes_tl.stack = []
+        return stack
 
     # -- Lockable (water/Lockable.java read/write locking) --------------------
     def read_lock(self, key: str, owner: str) -> None:

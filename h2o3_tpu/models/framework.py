@@ -286,6 +286,11 @@ class ModelBuilder:
         ]
         for k in locked:
             DKV.read_lock(k, self.job.key)
+        # a failed build must not strand a half-constructed model in the
+        # DKV (Lockable.delete on builder failure); keys registered
+        # during _fit are scope-tracked and swept unless the build wins
+        DKV.scope_enter()
+        keep = [self.job.key]
         try:
             with timeline.timed("train", algo=self.algo_name, rows=frame.nrows):
                 model = self._fit(frame, valid)
@@ -293,6 +298,7 @@ class ModelBuilder:
                     self._cross_validate(model, frame)
             model.run_time = time.time() - t0
             self.job.done()
+            keep = None  # success: everything the build registered lives
             log.info(
                 "%s train done in %.2fs -> %s", self.algo_name,
                 model.run_time, model.key,
@@ -303,6 +309,10 @@ class ModelBuilder:
             log.error("%s train failed: %s: %s", self.algo_name, type(e).__name__, e)
             raise
         finally:
+            if keep is None:
+                DKV.scope_exit(keep=DKV.keys())  # keep all
+            else:
+                DKV.scope_exit(keep=keep)
             for k in locked:
                 DKV.read_unlock(k, self.job.key)
 

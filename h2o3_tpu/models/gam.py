@@ -42,9 +42,19 @@ from h2o3_tpu.parallel.mesh import default_mesh, pad_rows, shard_rows
 @dataclass
 class GAMParameters(GLMParameters):
     gam_columns: List[str] = field(default_factory=list)
-    num_knots: int = 10
-    scale: float = 1.0  # smoothing λ (per gam column; reference: scale array)
-    bs: int = 0  # 0 = cubic regression spline (the reference default)
+    #: knots per gam column — int (shared) or list aligned with gam_columns
+    num_knots: object = 10
+    #: smoothing λ per gam column — float (shared) or aligned list
+    scale: object = 1.0
+    #: spline family per column (GAMParametersV3 bs codes): 0 = cubic
+    #: regression spline, 1 = thin-plate, 2 = monotone I-splines,
+    #: 3 = M-splines; int (shared) or aligned list
+    bs: object = 0
+    #: explicit knot locations per gam column (reference knot_ids frames);
+    #: None = quantile placement
+    knots: Optional[List[Optional[List[float]]]] = None
+    #: I-spline coefficients constrained >= 0 (monotone non-decreasing)
+    splines_non_negative: bool = True
 
 
 # ---------------------------------------------------------------------------
@@ -106,34 +116,156 @@ def cr_basis(x: np.ndarray, knots: np.ndarray) -> np.ndarray:
     return basis
 
 
+# ---------------------------------------------------------------------------
+# other spline families (hex/gam/GamSplines: ThinPlate*, NBSplinesTypeI/II)
+
+
+def tp_basis(x: np.ndarray, knots: np.ndarray) -> np.ndarray:
+    """1-D thin-plate basis: {x, |x-k|³ per knot} (the polynomial-plus-
+    radial construction of ThinPlateRegressionUtils, d=1 → η(r)=r³)."""
+    r = np.abs(x[:, None] - knots[None, :]) ** 3
+    return np.concatenate([x[:, None], r], axis=1)
+
+
+def tp_penalty(knots: np.ndarray) -> np.ndarray:
+    """Bending-energy quadratic form on the radial coefficients; the
+    linear term is unpenalized (thin-plate null space)."""
+    K = len(knots)
+    E = np.abs(knots[:, None] - knots[None, :]) ** 3
+    S = np.zeros((K + 1, K + 1))
+    S[1:, 1:] = E + 1e-8 * np.eye(K)  # PSD guard
+    return S
+
+
+def _bspline_knots(knots: np.ndarray, degree: int) -> np.ndarray:
+    return np.concatenate([
+        np.repeat(knots[0], degree), knots, np.repeat(knots[-1], degree)
+    ])
+
+
+def m_basis(x: np.ndarray, knots: np.ndarray, degree: int = 3) -> np.ndarray:
+    """M-spline (normalized B-spline) basis via scipy (NBSplinesTypeII)."""
+    from scipy.interpolate import BSpline
+
+    t = _bspline_knots(knots, degree)
+    xc = np.clip(x, knots[0], knots[-1])
+    dm = BSpline.design_matrix(xc, t, degree, extrapolate=False).toarray()
+    return dm
+
+
+def m_penalty(n_basis: int) -> np.ndarray:
+    """Second-difference P-spline penalty D₂ᵀD₂ (Eilers/Marx — the
+    curvature surrogate the reference's NBSpline penalty plays)."""
+    D = np.diff(np.eye(n_basis), n=2, axis=0)
+    return D.T @ D
+
+
+def i_basis(x: np.ndarray, knots: np.ndarray, degree: int = 3) -> np.ndarray:
+    """I-spline basis (NBSplinesTypeI): running integrals of M-splines —
+    each basis function is monotone non-decreasing 0→1, so non-negative
+    coefficients give a monotone smooth."""
+    from scipy.interpolate import BSpline
+
+    t = _bspline_knots(knots, degree + 1)
+    xc = np.clip(x, knots[0], knots[-1])
+    dm = BSpline.design_matrix(xc, t, degree + 1, extrapolate=False).toarray()
+    # I_j(x) = sum of higher-order B-splines from j+1 on (de Boor)
+    return np.cumsum(dm[:, ::-1], axis=1)[:, ::-1][:, 1:]
+
+
 @dataclass
 class GamSpec:
     column: str
     knots: np.ndarray
-    Z: np.ndarray  # [K, K-1] identifiability transform (⊥ training column means)
-    penalty: np.ndarray  # [K-1, K-1] Zᵀ S Z
+    Z: Optional[np.ndarray]  # identifiability transform (None: raw basis)
+    penalty: np.ndarray
     na_fill: float
+    kind: int = 0  # bs code
+    nonneg: bool = False  # coefficients constrained >= 0 (monotone)
+
+    def raw_basis(self, x: np.ndarray) -> np.ndarray:
+        if self.kind == 1:
+            return tp_basis(x, self.knots)
+        if self.kind == 2:
+            return i_basis(x, self.knots)
+        if self.kind == 3:
+            return m_basis(x, self.knots)
+        return cr_basis(x, self.knots)
 
     def expand(self, x: np.ndarray) -> np.ndarray:
         x = np.where(np.isnan(x), self.na_fill, x)
-        return cr_basis(x, self.knots) @ self.Z
+        b = self.raw_basis(x)
+        return b @ self.Z if self.Z is not None else b
 
 
-def _make_spec(name: str, x: np.ndarray, num_knots: int) -> GamSpec:
+def _make_spec(name: str, x: np.ndarray, num_knots: int, bs: int = 0,
+               user_knots: Optional[List[float]] = None,
+               nonneg: bool = True) -> GamSpec:
     ok = ~np.isnan(x)
     xs = x[ok]
-    qs = np.quantile(xs, np.linspace(0, 1, num_knots))
-    knots = np.unique(qs)
+    if user_knots is not None:
+        knots = np.unique(np.asarray(user_knots, np.float64))
+    else:
+        qs = np.quantile(xs, np.linspace(0, 1, num_knots))
+        knots = np.unique(qs)
     if len(knots) < 3:
         raise ValueError(f"gam column {name!r} has too few distinct values for splines")
-    basis = cr_basis(xs, knots)
+    na_fill = float(np.median(xs))
+    if bs == 1:
+        S = tp_penalty(knots)
+        basis = tp_basis(xs, knots)
+    elif bs == 2:
+        # monotone I-splines: NO centering transform — non-negativity
+        # must hold on the actual coefficients (the monotone cone does
+        # not survive a rotation); identifiability comes from the basis
+        # having no constant function in its span
+        basis = i_basis(xs, knots)
+        return GamSpec(name, knots, None, m_penalty(basis.shape[1]),
+                       na_fill, kind=2, nonneg=nonneg)
+    elif bs == 3:
+        basis = m_basis(xs, knots)
+        S = m_penalty(basis.shape[1])
+    else:
+        S = cr_penalty(knots)
+        basis = cr_basis(xs, knots)
     m = basis.mean(axis=0)
     # Z: orthonormal basis of the null space of mᵀ (H2O's centering transform
     # — gamified columns stay orthogonal to the intercept)
     _, _, Vt = np.linalg.svd(m[None, :], full_matrices=True)
-    Z = Vt[1:].T  # [K, K-1]
-    S = cr_penalty(knots)
-    return GamSpec(name, knots, Z, Z.T @ S @ Z, float(np.median(xs)))
+    Z = Vt[1:].T
+    return GamSpec(name, knots, Z, Z.T @ S @ Z, na_fill, kind=bs)
+
+
+def _per_column(value, n: int, name: str) -> list:
+    if isinstance(value, (list, tuple)):
+        if len(value) != n:
+            raise ValueError(
+                f"{name} list must align with gam_columns "
+                f"({len(value)} != {n})")
+        return list(value)
+    return [value] * n
+
+
+def _project_nonneg(Gp, q, l2, nonneg_idx, solver):
+    """Active-set projection: solve, clamp negative monotone-block coefs
+    to zero (drop them from the system), repeat until none violate —
+    the NNLS shape the reference's I-spline constraint solve takes."""
+    n = len(q)
+    clamped = np.zeros(n, dtype=bool)
+    nonneg = np.zeros(n, dtype=bool)
+    nonneg[nonneg_idx] = True
+    beta = np.zeros(n)
+    for _ in range(len(nonneg_idx) + 1):
+        idxs = np.nonzero(~clamped)[0]
+        sub = solver(Gp[np.ix_(idxs, idxs)], q[idxs])
+        beta = np.zeros(n)
+        beta[idxs] = sub
+        bad = nonneg & (beta < -1e-12) & ~clamped
+        if not bad.any():
+            break
+        clamped |= bad
+    beta[nonneg] = np.maximum(beta[nonneg], 0.0)
+    return beta
 
 
 class GAMModel(Model):
@@ -196,9 +328,21 @@ class GAM(ModelBuilder):
             missing_values_handling=p.missing_values_handling,
         )
         model = GAMModel(p, info)
+        ncols = len(p.gam_columns)
+        nk_list = _per_column(p.num_knots, ncols, "num_knots")
+        bs_list = _per_column(p.bs, ncols, "bs")
+        scale_list = _per_column(p.scale, ncols, "scale")
+        knots_list = (list(p.knots) if p.knots is not None
+                      else [None] * ncols)
+        if len(knots_list) != ncols:
+            raise ValueError("knots list must align with gam_columns")
         model.specs = [
-            _make_spec(c, frame.col(c).numeric_view().astype(np.float64), p.num_knots)
-            for c in p.gam_columns
+            _make_spec(
+                c, frame.col(c).numeric_view().astype(np.float64),
+                int(nk_list[i]), bs=int(bs_list[i]),
+                user_knots=knots_list[i], nonneg=p.splines_non_negative,
+            )
+            for i, c in enumerate(p.gam_columns)
         ]
 
         X = model._design(frame)
@@ -212,12 +356,17 @@ class GAM(ModelBuilder):
         n, pc = X.shape
         n_lin = info.n_coefs
 
-        # block-diagonal smoothing penalty, zero on linear coefs + intercept
+        # block-diagonal smoothing penalty, zero on linear coefs +
+        # intercept; per-column scale (GAMParametersV3 scale array)
         Lam = np.zeros((pc + 1, pc + 1))
+        nonneg_idx: List[int] = []
         off = n_lin
-        for s in model.specs:
+        for i, s in enumerate(model.specs):
             kz = s.penalty.shape[0]
-            Lam[off : off + kz, off : off + kz] = p.scale * s.penalty
+            Lam[off : off + kz, off : off + kz] = \
+                float(scale_list[i]) * s.penalty
+            if s.nonneg:
+                nonneg_idx.extend(range(off, off + kz))
             off += kz
 
         mesh = default_mesh()
@@ -244,8 +393,16 @@ class GAM(ModelBuilder):
 
             G, q = _gram(Xd, pad(wz), pad(w))
             Gp = G / wsum + Lam / wsum  # smoothing penalty folded into Gram
-            if l1 > 0:
+            if l1 > 0 and nonneg_idx:
+                beta_new = _project_nonneg(
+                    Gp, q / wsum, l2, nonneg_idx,
+                    lambda Gs, qs: _solve_admm(Gs, qs, l1, l2, free=1))
+            elif l1 > 0:
                 beta_new = _solve_admm(Gp, q / wsum, l1, l2, free=1)
+            elif nonneg_idx:
+                beta_new = _project_nonneg(
+                    Gp, q / wsum, l2, nonneg_idx,
+                    lambda Gs, qs: _solve_ridge(Gs, qs, l2, free=1))
             else:
                 beta_new = _solve_ridge(Gp, q / wsum, l2, free=1)
 
@@ -267,8 +424,11 @@ class GAM(ModelBuilder):
 
         model.beta = beta
         names = list(info.coef_names)
+        fam_tag = {0: "cr", 1: "tp", 2: "is", 3: "ms"}
         for s in model.specs:
-            names += [f"{s.column}_cr_{i}" for i in range(s.penalty.shape[0])]
+            tag = fam_tag.get(s.kind, "cr")
+            names += [f"{s.column}_{tag}_{i}"
+                      for i in range(s.penalty.shape[0])]
         model.coefficients = dict(zip(names, beta[:-1].tolist()))
         model.coefficients["Intercept"] = float(beta[-1])
 

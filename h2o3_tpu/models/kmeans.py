@@ -99,10 +99,6 @@ class KMeans(ModelBuilder):
         super()._validate(frame)
         if self.params.k < 1:
             raise ValueError("k must be >= 1")
-        if self.params.estimate_k:
-            raise NotImplementedError(
-                "estimate_k is not implemented yet; pass an explicit k"
-            )
 
     def _fit(self, frame: Frame, valid: Optional[Frame] = None) -> KMeansModel:
         p: KMeansParameters = self.params
@@ -115,26 +111,62 @@ class KMeans(ModelBuilder):
         model = KMeansModel(p, info)
         rng = np.random.default_rng(p.actual_seed())
 
-        C = _init_centers(X, p.k, p.init, rng)
-
         mesh = default_mesh()
         Xd, _ = shard_rows(X, mesh)
         maskd = row_mask(n, Xd.shape[0], mesh)
-        Cd = jnp.asarray(C)
 
-        prev_wss = np.inf
-        assign = counts = wss_k = None
-        for it in range(p.max_iterations):
-            assign, Cd, counts, wss, wss_k = _lloyd_step(Xd, maskd, Cd, p.k)
-            model.iterations = it + 1
-            wss = float(jax.device_get(wss))
-            if abs(prev_wss - wss) < 1e-6 * max(abs(prev_wss), 1.0):
-                break
-            prev_wss = wss
+        def run_lloyd(C0: np.ndarray):
+            """Lloyd to convergence from C0; returns the fitted state."""
+            k = C0.shape[0]
+            Cd = jnp.asarray(C0)
+            prev_wss = np.inf
+            iters = 0
+            assign = counts = wss_k = None
+            wss = np.inf
+            for it in range(p.max_iterations):
+                assign, Cd, counts, wss, wss_k = _lloyd_step(
+                    Xd, maskd, Cd, k)
+                iters = it + 1
+                wss = float(jax.device_get(wss))
+                if abs(prev_wss - wss) < 1e-6 * max(abs(prev_wss), 1.0):
+                    break
+                prev_wss = wss
+            return (np.asarray(jax.device_get(Cd), np.float64),
+                    np.asarray(jax.device_get(counts), np.int64),
+                    np.asarray(jax.device_get(wss_k), np.float64),
+                    wss, iters, np.asarray(jax.device_get(assign)))
 
-        model.centers_std = np.asarray(jax.device_get(Cd), dtype=np.float64)
-        model.size = np.asarray(jax.device_get(counts), dtype=np.int64)
-        model.withinss = np.asarray(jax.device_get(wss_k), dtype=np.float64)
+        if p.estimate_k:
+            # KMeans.java estimate_k (:278,301,398-414): deterministic —
+            # start at k=1, split the largest cluster each outer round,
+            # stop when relative tot_withinss improvement drops under
+            # min(0.02 + 10/n + 2.5/F², 0.8); k is the CAP
+            cutoff = min(0.02 + 10.0 / max(n, 1) + 2.5 / max(D, 1) ** 2,
+                         0.8)
+            C = X.mean(axis=0, keepdims=True).astype(np.float32)
+            best = run_lloyd(C)
+            prev_wss = best[3]
+            total_iters = best[4]
+            for k in range(2, p.k + 1):
+                C = _split_largest_cluster(X, best[0], best[5], maskd)
+                cur = run_lloyd(C)
+                total_iters += cur[4]
+                rel = (1.0 if prev_wss == 0
+                       else (prev_wss - cur[3]) / prev_wss)
+                if k > 1 and rel < cutoff:
+                    break  # keep the previous (best) model
+                best = cur
+                prev_wss = cur[3]
+            centers_std, counts, wss_k, _wss, _it, _assign = best
+            model.iterations = total_iters
+        else:
+            C = _init_centers(X, p.k, p.init, rng)
+            centers_std, counts, wss_k, _wss, iters, _assign = run_lloyd(C)
+            model.iterations = iters
+
+        model.centers_std = centers_std
+        model.size = counts
+        model.withinss = wss_k
         model.tot_withinss = float(model.withinss.sum())
         gmean = X.mean(axis=0)
         model.totss = float(((X - gmean) ** 2).sum())
@@ -142,6 +174,27 @@ class KMeans(ModelBuilder):
         model.centers = _destandardize_centers(info, model.centers_std)
         model.training_metrics = model.model_performance(frame)
         return model
+
+
+def _split_largest_cluster(X: np.ndarray, C: np.ndarray,
+                           assign_padded: np.ndarray, maskd) -> np.ndarray:
+    """KMeans.splitLargestCluster analogue, deterministic: the cluster
+    with the most rows donates a second center at its farthest member."""
+    import jax as _jax
+
+    mask = np.asarray(_jax.device_get(maskd))
+    assign = np.asarray(assign_padded)[: len(X)]
+    mask = mask[: len(X)]
+    assign = np.where(mask, assign, -1)
+    counts = np.bincount(assign[assign >= 0], minlength=C.shape[0])
+    big = int(counts.argmax())
+    rows = np.nonzero(assign == big)[0]
+    if len(rows) <= 1:  # nothing to split: duplicate with a nudge
+        new = C[big] + 1e-3
+    else:
+        d2 = ((X[rows] - C[big].astype(np.float32)) ** 2).sum(axis=1)
+        new = X[rows[int(d2.argmax())]]
+    return np.vstack([C, new[None, :]]).astype(np.float32)
 
 
 def _init_centers(X: np.ndarray, k: int, init: str, rng) -> np.ndarray:

@@ -133,6 +133,15 @@ def grad_hess_device(objective: str, y, margin):
     parameter value compiles its own program with the constant folded in.
     """
     name, _, arg = objective.partition(":")
+    if name == "custom":
+        # user objective (udf.register_distribution — the
+        # CDistributionFunc analogue): written with jnp ops, so it traces
+        # straight into this device program
+        from h2o3_tpu.udf import get_distribution
+
+        g, h = get_distribution(arg)["grad_hess"](y, margin[:, 0])
+        return (jnp.asarray(g, jnp.float32)[:, None],
+                jnp.maximum(jnp.asarray(h, jnp.float32), 1e-16)[:, None])
     if name == "fixed":
         t = y if y.ndim == 2 else y[:, None]
         return -t.astype(jnp.float32), jnp.ones_like(t, dtype=jnp.float32)

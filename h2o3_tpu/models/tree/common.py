@@ -104,6 +104,12 @@ def grad_hess(distribution: str, y: np.ndarray, margin: np.ndarray) -> Tuple[np.
     margin: [N, C]. Returns [N, C] arrays. Host oracle mirroring
     booster.grad_hess_device (parameterized families use 'name:arg')."""
     name, _, arg = distribution.partition(":")
+    if name == "custom":
+        from h2o3_tpu.udf import get_distribution
+
+        g, h = get_distribution(arg)["grad_hess"](y, margin[:, 0])
+        return (np.asarray(g, np.float64)[:, None],
+                np.maximum(np.asarray(h, np.float64), 1e-16)[:, None])
     if name == "gaussian":
         g = margin[:, 0] - y
         return g[:, None], np.ones_like(g)[:, None]
@@ -166,6 +172,15 @@ def resolve_objective(distribution: str, params, y: np.ndarray) -> str:
     family parameter in (``hex/Distribution.java``'s per-family params).
     huber: delta is the huber_alpha quantile of |y - median(y)| residuals
     (the reference re-estimates it per iteration; fixed-at-init here)."""
+    if distribution.partition(":")[0] == "custom":
+        from h2o3_tpu.udf import get_distribution
+
+        name = distribution.partition(":")[2]
+        if not name:
+            raise ValueError(
+                "custom distribution needs a name: 'custom:<registered>'")
+        get_distribution(name)  # unregistered name fails HERE, not mid-train
+        return distribution
     if distribution == "gamma":
         # gamma deviance needs strictly positive y (zero rows give ~0
         # hessians and exploding leaves; the reference validates this too)
@@ -199,6 +214,12 @@ def init_margin(
     """Initial margin f0 (SharedTree init: response moments / priors),
     weighted when an observation-weights column is in play."""
     name, _, arg = distribution.partition(":")
+    if name == "custom":
+        from h2o3_tpu.udf import get_distribution
+
+        init = get_distribution(arg)["init"]
+        return np.array([float(init(y, weights)) if init is not None
+                         else _wmean(y, weights)])
     if name in ("gaussian", "huber"):
         return np.array([_wmean(y, weights)])
     if name == "bernoulli":
@@ -234,7 +255,14 @@ def margin_to_probs(distribution: str, margin: np.ndarray) -> np.ndarray:
 def link_inverse(distribution: str, margin: np.ndarray) -> np.ndarray:
     """Regression margin -> response scale (Distribution.linkInv): the
     log-link families train on log(mu), predictions report mu."""
-    if distribution.partition(":")[0] in ("poisson", "gamma", "tweedie"):
+    name, _, arg = distribution.partition(":")
+    if name == "custom":
+        from h2o3_tpu.udf import get_distribution
+
+        inv = get_distribution(arg)["link_inv"]
+        return np.asarray(inv(margin), np.float64) if inv is not None \
+            else margin
+    if name in ("poisson", "gamma", "tweedie"):
         return np.exp(margin)
     return margin
 

@@ -77,3 +77,45 @@ def custom_metric(model, frame, fn_or_name) -> float:
         pred = raw
     keep = ~np.isnan(y)
     return float(fn(y[keep], np.asarray(pred)[keep]))
+
+
+# ---------------------------------------------------------------------------
+# custom distributions (water/udf/CDistributionFunc.java)
+
+
+#: registered custom distributions by name: dicts with grad_hess / init /
+#: link_inv entries (CDistributionFunc's link/init/gradient/gamma quartet)
+_DISTRIBUTIONS: Dict[str, dict] = {}
+
+
+def register_distribution(name: str, grad_hess, init=None,
+                          link_inv=None) -> str:
+    """Register a custom boosting objective for GBM's distribution family.
+
+    Reference: ``water/udf/CDistributionFunc.java:12`` — a user-supplied
+    (link, init, gradient, gamma) quartet plugged into SharedTree. The
+    TPU-native contract: ``grad_hess(y, margin)`` is written with
+    **jax.numpy ops** over 1-D arrays and returns ``(g, h)`` — it is
+    traced INTO the device training program, so a custom objective runs
+    at native kernel speed instead of a per-row host callback.
+
+    ``init(y, weights) -> float`` seeds the starting margin (default:
+    weighted mean). ``link_inv(margin) -> mu`` maps margins to the
+    response scale at predict time (default: identity).
+
+    Compiled training programs are cached by the objective string
+    (``custom:<name>``): re-registering different code under a USED name
+    will not recompile already-traced programs — pick a fresh name.
+    """
+    _DISTRIBUTIONS[name] = {
+        "grad_hess": grad_hess, "init": init, "link_inv": link_inv,
+    }
+    return name
+
+
+def get_distribution(name: str) -> dict:
+    if name not in _DISTRIBUTIONS:
+        raise KeyError(
+            f"no custom distribution {name!r} registered "
+            f"(udf.register_distribution)")
+    return _DISTRIBUTIONS[name]

@@ -45,6 +45,64 @@ def rng():
     return np.random.default_rng(42)
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "leaks_keys: legacy test/module exempt from the strict DKV "
+        "key-leak check (keys are still swept after the test)",
+    )
+
+
+def _sweep_keys(keys):
+    from h2o3_tpu.keyed import DKV
+
+    DKV.unlock_all()
+    for k in keys:
+        try:
+            DKV.remove(k)
+        except Exception:
+            pass
+
+
+@pytest.fixture(autouse=True)
+def _check_dkv_keys(request):
+    """CheckKeysTask analogue (h2o-test-support/.../runner/
+    CheckKeysTask.java): every test must leave the DKV exactly as it
+    found it. Keys created and not removed FAIL the test (and are swept
+    so one failure cannot cascade). Tests/modules marked ``leaks_keys``
+    are exempt — their state persists (module-scoped fixtures share
+    keys) and the module-level sweeper below cleans up at module end."""
+    from h2o3_tpu.keyed import DKV
+    from h2o3_tpu.models.framework import Job
+
+    before = set(DKV.keys())
+    yield
+    # Jobs persist by design: the /3/Jobs listing is the history of past
+    # work (reference: Job keys are CheckKeysTask-exempt the same way)
+    leaked = sorted(
+        k for k in set(DKV.keys()) - before
+        if not isinstance(DKV.peek(k), Job)
+    )
+    if leaked and request.node.get_closest_marker("leaks_keys") is None:
+        _sweep_keys(leaked)
+        pytest.fail(
+            f"DKV key leak: {len(leaked)} key(s) left behind "
+            f"(CheckKeysTask): {leaked[:10]}{'...' if len(leaked) > 10 else ''}"
+        )
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _sweep_dkv_between_modules():
+    """Whatever a module's tests/fixtures accumulated (including marked
+    leaks_keys debt) is removed at module end, so no module ever sees
+    another module's keys."""
+    from h2o3_tpu.keyed import DKV
+
+    before = set(DKV.keys())
+    yield
+    _sweep_keys(sorted(set(DKV.keys()) - before))
+
+
 @pytest.fixture(scope="module", autouse=True)
 def _clear_jax_caches_between_modules():
     """Release compiled executables after each test module.

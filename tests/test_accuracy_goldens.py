@@ -19,6 +19,12 @@ from h2o3_tpu.models.glm import GLM
 from h2o3_tpu.models.kmeans import KMeans
 from h2o3_tpu.models.tree import DRF, GBM, XGBoost
 
+
+# legacy module predating the CheckKeysTask fixture: tests here
+# share/train keys without per-test cleanup; the module-level
+# sweeper still removes everything at module end
+pytestmark = pytest.mark.leaks_keys
+
 #: golden metrics; regenerate deliberately (never casually) with
 #: the snippet in this file's git history if an intentional algorithm
 #: change shifts them

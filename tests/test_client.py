@@ -7,6 +7,12 @@ import pytest
 from h2o3_tpu import client as h2o
 
 
+# legacy module predating the CheckKeysTask fixture: tests here
+# share/train keys without per-test cleanup; the module-level
+# sweeper still removes everything at module end
+pytestmark = pytest.mark.leaks_keys
+
+
 @pytest.fixture(scope="module")
 def conn():
     c = h2o.init()

@@ -11,6 +11,12 @@ from h2o3_tpu import Frame
 from h2o3_tpu.models.glm import GLM, GLMParameters
 
 
+# legacy module predating the CheckKeysTask fixture: tests here
+# share/train keys without per-test cleanup; the module-level
+# sweeper still removes everything at module end
+pytestmark = pytest.mark.leaks_keys
+
+
 @pytest.fixture()
 def lin_data(rng):
     n, p = 2000, 5

@@ -9,6 +9,12 @@ from h2o3_tpu.models.grid import Grid, GridSearch, SearchCriteria, metric_value
 from h2o3_tpu.models.segments import SegmentModelsBuilder
 
 
+# legacy module predating the CheckKeysTask fixture: tests here
+# share/train keys without per-test cleanup; the module-level
+# sweeper still removes everything at module end
+pytestmark = pytest.mark.leaks_keys
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)

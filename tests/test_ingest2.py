@@ -353,3 +353,40 @@ class TestSQLImport:
 
         with pytest.raises(ValueError, match="jdbc"):
             resolve_persist("jdbc:oracle:thin@x")
+
+
+class TestS3Pagination:
+    def test_list_follows_continuation_tokens(self, monkeypatch):
+        pages = {
+            None: (
+                '<?xml version="1.0"?><ListBucketResult>'
+                "<IsTruncated>true</IsTruncated>"
+                "<NextContinuationToken>tok2</NextContinuationToken>"
+                "<Contents><Key>d/a.csv</Key></Contents>"
+                "</ListBucketResult>"),
+            "tok2": (
+                '<?xml version="1.0"?><ListBucketResult>'
+                "<IsTruncated>false</IsTruncated>"
+                "<Contents><Key>d/b.csv</Key></Contents>"
+                "</ListBucketResult>"),
+        }
+
+        def route_list(path):
+            tok = None
+            if "continuation-token=" in path:
+                tok = path.split("continuation-token=")[1].split("&")[0]
+            return 200, "application/xml", pages[tok].encode()
+
+        def route_obj(path):
+            return 200, "text/csv", CSV.encode()
+
+        fake = _Fake([
+            (lambda p: "list-type=2" in p, route_list),
+            (lambda p: p.startswith("/bkt/d/"), route_obj),
+        ])
+        try:
+            monkeypatch.setenv("H2O3_TPU_S3_ENDPOINT", fake.url)
+            fr = import_parse("s3://bkt/d/")
+            assert fr.nrows == 6  # BOTH pages' objects imported
+        finally:
+            fake.stop()

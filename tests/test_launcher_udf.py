@@ -14,6 +14,12 @@ import pytest
 
 from h2o3_tpu.frame.frame import ColType, Column, Frame
 
+
+# legacy module predating the CheckKeysTask fixture: tests here
+# share/train keys without per-test cleanup; the module-level
+# sweeper still removes everything at module end
+pytestmark = pytest.mark.leaks_keys
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

@@ -14,6 +14,12 @@ from h2o3_tpu.frame.frame import ColType, Column, Frame
 from h2o3_tpu.models.persist import load_model, save_model
 
 
+# legacy module predating the CheckKeysTask fixture: tests here
+# share/train keys without per-test cleanup; the module-level
+# sweeper still removes everything at module end
+pytestmark = pytest.mark.leaks_keys
+
+
 def _toy_frame(n=400, seed=0, classify=True):
     rng = np.random.default_rng(seed)
     x1 = rng.normal(size=n)

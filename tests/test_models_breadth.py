@@ -17,6 +17,12 @@ from h2o3_tpu.models.naive_bayes import NaiveBayes
 from h2o3_tpu.models.pca import PCA, SVD
 
 
+# legacy module predating the CheckKeysTask fixture: tests here
+# share/train keys without per-test cleanup; the module-level
+# sweeper still removes everything at module end
+pytestmark = pytest.mark.leaks_keys
+
+
 @pytest.fixture()
 def blobs(rng):
     X, y = datasets.make_blobs(

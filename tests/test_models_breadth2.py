@@ -8,6 +8,12 @@ from h2o3_tpu import Frame
 from h2o3_tpu.frame.frame import ColType, Column
 
 
+# legacy module predating the CheckKeysTask fixture: tests here
+# share/train keys without per-test cleanup; the module-level
+# sweeper still removes everything at module end
+pytestmark = pytest.mark.leaks_keys
+
+
 def _cat_frame(rng, n=600):
     levels = np.array(["a", "b", "c"])
     codes = rng.integers(0, 3, size=n)

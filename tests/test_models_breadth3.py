@@ -7,6 +7,12 @@ from h2o3_tpu import Frame
 from h2o3_tpu.frame.frame import ColType, Column
 
 
+# legacy module predating the CheckKeysTask fixture: tests here
+# share/train keys without per-test cleanup; the module-level
+# sweeper still removes everything at module end
+pytestmark = pytest.mark.leaks_keys
+
+
 class TestGAM:
     def test_recovers_nonlinear_effect(self, rng):
         from h2o3_tpu.models.gam import GAM

@@ -10,6 +10,12 @@ from h2o3_tpu.frame.frame import ColType, Column, Frame
 from h2o3_tpu.genmodel import EasyPredictModelWrapper, load_mojo
 
 
+# legacy module predating the CheckKeysTask fixture: tests here
+# share/train keys without per-test cleanup; the module-level
+# sweeper still removes everything at module end
+pytestmark = pytest.mark.leaks_keys
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(7)

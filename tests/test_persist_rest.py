@@ -14,6 +14,12 @@ import pytest
 
 from h2o3_tpu.api import start_server
 
+
+# legacy module predating the CheckKeysTask fixture: tests here
+# share/train keys without per-test cleanup; the module-level
+# sweeper still removes everything at module end
+pytestmark = pytest.mark.leaks_keys
+
 CSV = "x0,x1,y\n" + "\n".join(
     f"{a:.3f},{b:.3f},{'yes' if a + b > 0 else 'no'}"
     for a, b in np.random.default_rng(5).normal(size=(300, 2))

@@ -17,6 +17,12 @@ import pytest
 from h2o3_tpu.frame.frame import ColType, Column, Frame
 
 
+# legacy module predating the CheckKeysTask fixture: tests here
+# share/train keys without per-test cleanup; the module-level
+# sweeper still removes everything at module end
+pytestmark = pytest.mark.leaks_keys
+
+
 def _frame(rng, n=500, nclass=2):
     X = rng.normal(size=(n, 4))
     cat = rng.integers(0, 3, size=n).astype(np.int32)

@@ -15,6 +15,12 @@ from h2o3_tpu.models.grid import Grid, GridSearch, SearchCriteria
 from h2o3_tpu.recovery import Recovery, auto_recover
 
 
+# legacy module predating the CheckKeysTask fixture: tests here
+# share/train keys without per-test cleanup; the module-level
+# sweeper still removes everything at module end
+pytestmark = pytest.mark.leaks_keys
+
+
 def _frame(rng, n=300):
     X = rng.normal(size=(n, 3))
     y = (X[:, 0] - X[:, 1] + 0.3 * rng.normal(size=n) > 0).astype(np.int32)
@@ -421,8 +427,12 @@ class TestSqlImport:
     def test_unsupported_engine_named(self):
         from h2o3_tpu.frame.ingest import import_sql_table
 
-        with pytest.raises(ValueError, match="JDBC"):
+        # postgresql now routes to psycopg2 (round 4); absent driver
+        # names the missing module and the reference's JDBC layer
+        with pytest.raises(ValueError, match="psycopg2"):
             import_sql_table("jdbc:postgresql://h/db", table="t")
+        with pytest.raises(ValueError, match="JDBC|SQLManager"):
+            import_sql_table("jdbc:oracle:thin:@x", table="t")
 
 
 class TestFlowLite:

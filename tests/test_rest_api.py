@@ -10,6 +10,12 @@ import pytest
 
 from h2o3_tpu.api import start_server
 
+
+# legacy module predating the CheckKeysTask fixture: tests here
+# share/train keys without per-test cleanup; the module-level
+# sweeper still removes everything at module end
+pytestmark = pytest.mark.leaks_keys
+
 CSV = "sepal_len,species,weight\n5.1,setosa,1.0\n4.9,setosa,0.9\n6.3,virginica,1.4\n5.8,virginica,1.2\n6.1,virginica,1.3\n5.0,setosa,1.05\n"
 
 

@@ -16,6 +16,12 @@ import pytest
 
 from h2o3_tpu.api import start_server
 
+
+# legacy module predating the CheckKeysTask fixture: tests here
+# share/train keys without per-test cleanup; the module-level
+# sweeper still removes everything at module end
+pytestmark = pytest.mark.leaks_keys
+
 rng0 = np.random.default_rng(11)
 CSV = "x0,x1,c1,c2,y\n" + "\n".join(
     f"{a:.3f},{b:.3f},{'u' if a > 0 else 'v'},{'p' if b > 0 else 'q'},"

@@ -1,0 +1,279 @@
+"""Round-4 stub closures: KMeans estimate_k, custom-distribution UDFs,
+and the key-leak fixture itself.
+
+Reference: hex/kmeans/KMeans.java:80,278,301,398-414 (deterministic
+k-finder: split largest cluster, stop on relative tot_withinss
+improvement), water/udf/CDistributionFunc.java:12 (user link/init/
+gradient quartet plugged into SharedTree)."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.frame.frame import ColType, Column, Frame
+
+
+def _blobs(rng, k=4, per=150, spread=0.25):
+    centers = rng.normal(size=(k, 3)) * 6
+    X = np.concatenate([
+        centers[i] + rng.normal(size=(per, 3)) * spread for i in range(k)
+    ])
+    rng.shuffle(X)
+    return Frame([Column(f"x{j}", X[:, j]) for j in range(3)])
+
+
+class TestEstimateK:
+    def test_finds_obvious_cluster_count(self, rng):
+        from h2o3_tpu.models.kmeans import KMeans, KMeansParameters
+
+        fr = _blobs(rng, k=4)
+        m = KMeans(KMeansParameters(k=10, estimate_k=True,
+                                    max_iterations=20)).train(fr)
+        k_found = m.centers_std.shape[0]
+        assert k_found == 4, f"expected 4 clusters, estimated {k_found}"
+        # every found cluster is populated
+        assert (m.size > 0).all()
+        from h2o3_tpu.keyed import DKV
+
+        DKV.remove(m.key)
+
+    def test_k_is_the_cap(self, rng):
+        from h2o3_tpu.models.kmeans import KMeans, KMeansParameters
+
+        fr = _blobs(rng, k=6)
+        m = KMeans(KMeansParameters(k=3, estimate_k=True,
+                                    max_iterations=15)).train(fr)
+        assert m.centers_std.shape[0] <= 3
+        from h2o3_tpu.keyed import DKV
+
+        DKV.remove(m.key)
+
+    def test_deterministic(self, rng):
+        from h2o3_tpu.keyed import DKV
+        from h2o3_tpu.models.kmeans import KMeans, KMeansParameters
+
+        fr = _blobs(rng, k=3)
+        m1 = KMeans(KMeansParameters(k=8, estimate_k=True, seed=1,
+                                     max_iterations=15)).train(fr)
+        m2 = KMeans(KMeansParameters(k=8, estimate_k=True, seed=999,
+                                     max_iterations=15)).train(fr)
+        # seed is ignored under estimate_k (KMeans.java:86) — identical
+        np.testing.assert_allclose(np.sort(m1.centers_std, axis=0),
+                                   np.sort(m2.centers_std, axis=0),
+                                   rtol=1e-5)
+        DKV.remove(m1.key)
+        DKV.remove(m2.key)
+
+
+class TestCustomDistribution:
+    def test_custom_gaussian_matches_builtin(self, rng):
+        """A custom objective implementing the gaussian gradients must
+        train the same trees as distribution='gaussian'."""
+        import jax.numpy as jnp
+
+        from h2o3_tpu import udf
+        from h2o3_tpu.keyed import DKV
+        from h2o3_tpu.models.tree.gbm import GBM
+
+        udf.register_distribution(
+            "mygauss",
+            grad_hess=lambda y, f: (f - y, jnp.ones_like(f)),
+            init=lambda y, w: float(np.average(
+                y, weights=w if w is not None else None)),
+        )
+        n = 400
+        X = rng.normal(size=(n, 3))
+        y = X[:, 0] * 2 - X[:, 1] + rng.normal(size=n) * 0.1
+        fr = Frame([Column(f"x{j}", X[:, j]) for j in range(3)]
+                   + [Column("y", y)])
+        kw = dict(ntrees=5, max_depth=3, response_column="y", seed=3,
+                  min_rows=2)
+        m_custom = GBM(distribution="custom:mygauss", **kw).train(fr)
+        m_ref = GBM(distribution="gaussian", **kw).train(fr)
+        np.testing.assert_allclose(
+            m_custom.predict(fr).col("predict").data,
+            m_ref.predict(fr).col("predict").data, rtol=1e-5)
+        DKV.remove(m_custom.key)
+        DKV.remove(m_ref.key)
+
+    def test_custom_link_inverse_applies(self, rng):
+        import jax.numpy as jnp
+
+        from h2o3_tpu import udf
+        from h2o3_tpu.keyed import DKV
+        from h2o3_tpu.models.tree.gbm import GBM
+
+        udf.register_distribution(
+            "mypoisson",
+            grad_hess=lambda y, f: (jnp.exp(f) - y,
+                                    jnp.maximum(jnp.exp(f), 1e-16)),
+            init=lambda y, w: float(np.log(max(np.mean(y), 1e-10))),
+            link_inv=lambda m: np.exp(m),
+        )
+        n = 500
+        X = rng.normal(size=(n, 2))
+        y = rng.poisson(np.exp(0.5 * X[:, 0] + 0.2))
+        fr = Frame([Column("x0", X[:, 0]), Column("x1", X[:, 1]),
+                    Column("y", y.astype(np.float64))])
+        kw = dict(ntrees=8, max_depth=3, response_column="y", seed=4,
+                  min_rows=4)
+        m_custom = GBM(distribution="custom:mypoisson", **kw).train(fr)
+        m_ref = GBM(distribution="poisson", **kw).train(fr)
+        p_c = m_custom.predict(fr).col("predict").data
+        p_r = m_ref.predict(fr).col("predict").data
+        assert (p_c > 0).all()  # link applied: response scale
+        np.testing.assert_allclose(p_c, p_r, rtol=1e-4)
+        DKV.remove(m_custom.key)
+        DKV.remove(m_ref.key)
+
+    def test_unregistered_name_fails_fast(self, rng):
+        from h2o3_tpu.models.tree.gbm import GBM
+
+        fr = Frame([Column("x", np.arange(50.0)),
+                    Column("y", np.arange(50.0) * 2)])
+        with pytest.raises(KeyError, match="no custom distribution"):
+            GBM(distribution="custom:nope", ntrees=2,
+                response_column="y").train(fr)
+
+
+class TestKeyLeakFixture:
+    def test_clean_test_passes(self, rng):
+        from h2o3_tpu.keyed import DKV
+
+        fr = Frame([Column("a", np.arange(4.0))])
+        DKV.put("leakcheck_tmp", fr)
+        DKV.remove("leakcheck_tmp")
+
+    @pytest.mark.leaks_keys
+    def test_marked_test_may_leak(self, rng):
+        from h2o3_tpu.keyed import DKV
+
+        fr = Frame([Column("a", np.arange(4.0))])
+        DKV.put("leakcheck_marked", fr)
+        # no cleanup: the module sweeper removes it; unmarked, this
+        # would fail with "DKV key leak"
+
+
+class TestGAMFamilies:
+    """Round-4 GAM depth: thin-plate (bs=1), monotone I-splines (bs=2),
+    M-splines (bs=3), per-column specs, user knots (hex/gam/GamSplines:
+    ThinPlate*, NBSplinesTypeI/II)."""
+
+    def _wavy(self, rng, n=600):
+        x = rng.uniform(-3, 3, size=n)
+        y = np.sin(x) * 2 + 0.1 * rng.normal(size=n)
+        return Frame([Column("x", x),
+                      Column("z", rng.normal(size=n)),
+                      Column("y", y)])
+
+    @pytest.mark.parametrize("bs", [0, 1, 3])
+    def test_families_fit_nonlinear_signal(self, rng, bs):
+        from h2o3_tpu.keyed import DKV
+        from h2o3_tpu.models.gam import GAM
+
+        fr = self._wavy(rng)
+        m = GAM(response_column="y", gam_columns=["x"], num_knots=10,
+                bs=bs, scale=0.1).train(fr)
+        pred = m.predict(fr).col("predict").data
+        y = fr.col("y").data
+        ss_res = ((y - pred) ** 2).sum()
+        ss_tot = ((y - y.mean()) ** 2).sum()
+        assert 1 - ss_res / ss_tot > 0.9, f"bs={bs} underfits"
+        DKV.remove(m.key)
+
+    def test_monotone_isplines_are_monotone(self, rng):
+        from h2o3_tpu.keyed import DKV
+        from h2o3_tpu.models.gam import GAM
+
+        n = 600
+        x = rng.uniform(0, 4, size=n)
+        # monotone signal + noise that tempts a wiggle
+        y = np.log1p(x) * 3 + rng.normal(size=n) * 0.4
+        fr = Frame([Column("x", x), Column("y", y)])
+        m = GAM(response_column="y", gam_columns=["x"], num_knots=8,
+                bs=2, scale=0.01).train(fr)
+        grid = Frame([Column("x", np.linspace(0.05, 3.95, 200))])
+        pred = m.predict(grid).col("predict").data
+        assert (np.diff(pred) >= -1e-8).all(), "I-spline fit not monotone"
+        # and it actually fits
+        tr = m.predict(fr).col("predict").data
+        assert np.corrcoef(tr, y)[0, 1] > 0.9
+        DKV.remove(m.key)
+
+    def test_per_column_specs_and_user_knots(self, rng):
+        from h2o3_tpu.keyed import DKV
+        from h2o3_tpu.models.gam import GAM
+
+        n = 500
+        x1 = rng.uniform(-2, 2, size=n)
+        x2 = rng.uniform(0, 5, size=n)
+        y = np.sin(x1 * 2) + 0.5 * x2 + 0.1 * rng.normal(size=n)
+        fr = Frame([Column("x1", x1), Column("x2", x2), Column("y", y)])
+        m = GAM(response_column="y", gam_columns=["x1", "x2"],
+                num_knots=[10, 5], bs=[0, 3], scale=[0.05, 1.0],
+                knots=[None, [0.0, 1.0, 2.5, 4.0, 5.0]]).train(fr)
+        assert any(k.startswith("x1_cr_") for k in m.coefficients)
+        assert any(k.startswith("x2_ms_") for k in m.coefficients)
+        pred = m.predict(fr).col("predict").data
+        assert np.corrcoef(pred, y)[0, 1] > 0.95
+        DKV.remove(m.key)
+
+    def test_misaligned_lists_rejected(self, rng):
+        from h2o3_tpu.models.gam import GAM
+
+        fr = self._wavy(rng)
+        with pytest.raises(ValueError, match="align"):
+            GAM(response_column="y", gam_columns=["x"],
+                num_knots=[5, 6]).train(fr)
+
+
+class TestConcurrentBuildScopes:
+    def test_failing_build_cannot_delete_concurrent_builds_keys(self, rng):
+        """Scope stacks are per-thread (water/Scope.java): a build that
+        fails in one thread must sweep ONLY its own keys, never a
+        concurrently-running build's model (review finding)."""
+        import threading
+        import time
+
+        from h2o3_tpu.keyed import DKV
+        from h2o3_tpu.models.glm import GLM, GLMParameters
+
+        n = 300
+        X = rng.normal(size=(n, 3))
+        y = (X[:, 0] > 0).astype(np.int32)
+        fr = Frame([Column(f"x{j}", X[:, j]) for j in range(3)]
+                   + [Column("y", y, ColType.CAT, ["n", "p"])])
+        orig_fit = GLM._fit
+        barrier = threading.Barrier(2)
+
+        def slow_fit(self, frame, valid=None):
+            m = orig_fit(self, frame, valid)
+            barrier.wait(timeout=30)  # hold until the failing build dies
+            time.sleep(0.3)
+            return m
+
+        def dying_fit(self, frame, valid=None):
+            barrier.wait(timeout=30)
+            raise RuntimeError("boom")
+
+        results = {}
+
+        def good():
+            GLM._fit = slow_fit  # patched per-thread via closure order
+            results["model"] = GLM(GLMParameters(
+                response_column="y", family="binomial")).train(fr)
+
+        # run the good build in a thread with slow_fit, the bad one here
+        t = threading.Thread(target=good)
+        t.start()
+        time.sleep(0.1)
+        bad = GLM(GLMParameters(response_column="y", family="binomial"))
+        bad._fit = dying_fit.__get__(bad)
+        with pytest.raises(RuntimeError):
+            bad.train(fr)
+        t.join(timeout=60)
+        GLM._fit = orig_fit
+        m = results.get("model")
+        assert m is not None, "good build never finished"
+        # the survivor's model key must still resolve
+        assert DKV.get(m.key) is m
+        DKV.remove(m.key)

@@ -12,6 +12,12 @@ from h2o3_tpu import Frame
 from h2o3_tpu.models.tree import DRF, GBM, XGBoost
 
 
+# legacy module predating the CheckKeysTask fixture: tests here
+# share/train keys without per-test cleanup; the module-level
+# sweeper still removes everything at module end
+pytestmark = pytest.mark.leaks_keys
+
+
 def _reg_frame(rng, n=2000, f=4, extra=None):
     X = rng.normal(size=(n, f))
     y = 2.0 * X[:, 0] - X[:, 1] + 0.3 * rng.normal(size=n)

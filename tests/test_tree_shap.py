@@ -18,6 +18,12 @@ from h2o3_tpu.models.tree import GBM
 from h2o3_tpu.models.tree.shap import node_covers, predict_contributions
 
 
+# legacy module predating the CheckKeysTask fixture: tests here
+# share/train keys without per-test cleanup; the module-level
+# sweeper still removes everything at module end
+pytestmark = pytest.mark.leaks_keys
+
+
 def _expvalue(feat, sb, dl, sp, leaf, covers, x_bins, n_bins1, S):
     """Brute-force EXPVALUE(x, S): follow x for features in S, else
     cover-weighted average over children (the path-dependent semantics)."""

@@ -14,6 +14,12 @@ from h2o3_tpu.ops.histogram import apply_bins, build_histogram_sharded, make_bin
 import jax.numpy as jnp
 
 
+# legacy module predating the CheckKeysTask fixture: tests here
+# share/train keys without per-test cleanup; the module-level
+# sweeper still removes everything at module end
+pytestmark = pytest.mark.leaks_keys
+
+
 def _classif_frame(rng, n=4000, informative=True):
     X = rng.normal(size=(n, 6)).astype(np.float64)
     logit = 1.5 * X[:, 0] - 2.0 * X[:, 1] + X[:, 2] * X[:, 3]
