@@ -97,21 +97,18 @@ def _nm_kernel(
         ).astype(jnp.float32)
     onehot = oh_ref[...]
 
-    # [R, K*C] node-masked values, built lane-wise (no minor-dim reshape —
-    # Mosaic can't merge a (K, C) lane split); lane j carries node j//C,
-    # channel j%C. Channel 3 is the zero pad.
+    # [R, K*C] node-masked values in ~ONE VPU pass: lane j carries node
+    # j//C, channel j%C. A lane CONCAT of K copies of vals (Mosaic
+    # handles lane concat; it cannot merge a (K, C) reshape) replaces
+    # the former per-channel where+add loop (3 select passes -> 1).
+    # Channel 3 is already the zero pad, so no extra masking per channel.
     node = node_ref[...]  # [R, 1]
     vals = vals_ref[...]  # [R, C]
     kc = n_nodes * _C
     iota_kc = jax.lax.broadcasted_iota(jnp.int32, (r, kc), 1)
-    kk = iota_kc // _C
-    cc = jax.lax.rem(iota_kc, _C)
-    m_node = kk == node  # node<0 never matches
-    vals_k = jnp.zeros((r, kc), jnp.float32)
-    for c in range(3):
-        vals_k = vals_k + jnp.where(
-            m_node & (cc == c), vals[:, c][:, None], 0.0
-        )
+    m_node = (iota_kc // _C) == node  # node<0 never matches
+    tiled = jnp.concatenate([vals] * n_nodes, axis=1)  # [R, K*C]
+    vals_k = jnp.where(m_node, tiled, 0.0)
 
 
     # [K*C, Fb*B1] = vals_kᵀ ⊗ onehotᵀ — contraction over rows on the MXU

@@ -1,32 +1,84 @@
-"""Compare the Pallas tpu_hist kernel vs the XLA scatter path on real TPU."""
-import time
-import numpy as np
-import jax
+"""Probe the Pallas tpu_hist kernel vs the XLA scatter path on real TPU.
 
-from h2o3_tpu.ops.histogram import _shard_histogram
-from h2o3_tpu.ops.pallas_histogram import build_histogram_pallas
+Writes KERNEL_PROBE_r04.json (per-K ms, rows/sec, achieved-vs-peak MXU
+FLOPs) so kernel-level evidence lands on disk the moment the TPU is
+reachable, independent of the end-to-end bench (VERDICT r3 item 1d).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/h2o3_tpu_jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+
+import jax  # noqa: E402
+
+from h2o3_tpu.ops.histogram import _shard_histogram  # noqa: E402
+from h2o3_tpu.ops.pallas_histogram import _C, build_histogram_pallas  # noqa: E402
 
 N, F, B1 = 2_000_000, 28, 257
-rng = np.random.default_rng(0)
-bins = jax.device_put(rng.integers(0, B1, size=(N, F)).astype(np.int32))
-g = jax.device_put(rng.normal(size=N).astype(np.float32))
-h = jax.device_put(rng.random(N).astype(np.float32))
+#: TPU v5e chip peak: ~197 TFLOPs bf16; f32 matmuls run at ~half that
+PEAK_F32_TFLOPS = 98.5
 
-scatter = jax.jit(_shard_histogram, static_argnums=(4, 5))
 
-for K in (1, 8, 64):
-    nodes = jax.device_put(rng.integers(0, K, size=N).astype(np.int32))
+def main() -> None:
+    rng = np.random.default_rng(0)
+    bins = jax.device_put(rng.integers(0, B1, size=(N, F)).astype(np.int32))
+    g = jax.device_put(rng.normal(size=N).astype(np.float32))
+    h = jax.device_put(rng.random(N).astype(np.float32))
+    scatter = jax.jit(_shard_histogram, static_argnums=(4, 5))
 
-    def timeit(fn, reps=3):
-        fn().block_until_ready()  # compile+warm
-        t0 = time.perf_counter()
-        for _ in range(reps):
-            out = fn()
-        out.block_until_ready()
-        return (time.perf_counter() - t0) / reps, out
+    results = []
+    for K in (1, 8, 64):
+        nodes = jax.device_put(rng.integers(0, K, size=N).astype(np.int32))
 
-    t_x, out_x = timeit(lambda: scatter(bins, nodes, g, h, K, B1))
-    t_p, out_p = timeit(lambda: build_histogram_pallas(bins, nodes, g, h, K, B1))
-    err = float(np.max(np.abs(np.asarray(out_x) - np.asarray(out_p))))
-    print(f"K={K:3d}  xla_scatter={t_x*1e3:8.2f}ms  pallas={t_p*1e3:8.2f}ms  "
-          f"speedup={t_x/t_p:6.2f}x  max_abs_err={err:.3e}")
+        def timeit(fn, reps=5):
+            fn().block_until_ready()  # compile+warm
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                out = fn()
+            out.block_until_ready()
+            return (time.perf_counter() - t0) / reps, out
+
+        t_x, out_x = timeit(lambda: scatter(bins, nodes, g, h, K, B1))
+        t_p, out_p = timeit(
+            lambda: build_histogram_pallas(bins, nodes, g, h, K, B1))
+        err = float(np.max(np.abs(np.asarray(out_x) - np.asarray(out_p))))
+        # dense-matmul FLOPs actually ISSUED: the kernel pads features to
+        # a _FEAT_BLOCK multiple and rows to a _ROW_TILE multiple
+        from h2o3_tpu.ops.pallas_histogram import _FEAT_BLOCK, _ROW_TILE
+
+        f_pad = F + (-F) % _FEAT_BLOCK
+        n_pad = N + (-N) % _ROW_TILE
+        flops = 2.0 * n_pad * (f_pad * B1) * (K * _C)
+        achieved = flops / t_p / 1e12
+        row = {
+            "K": K,
+            "xla_scatter_ms": round(t_x * 1e3, 2),
+            "pallas_ms": round(t_p * 1e3, 2),
+            "speedup": round(t_x / t_p, 2),
+            "pallas_rows_per_sec": round(N / t_p, 0),
+            "achieved_tflops_f32": round(achieved, 2),
+            "pct_of_peak": round(100 * achieved / PEAK_F32_TFLOPS, 1),
+            "max_abs_err": err,
+        }
+        results.append(row)
+        print(row, flush=True)
+
+    artifact = {
+        "config": {"n_rows": N, "n_feat": F, "n_bins1": B1,
+                   "device": str(jax.devices()[0])},
+        "results": results,
+    }
+    out_path = sys.argv[1] if len(sys.argv) > 1 else "KERNEL_PROBE_r04.json"
+    with open(out_path, "w") as f:
+        json.dump(artifact, f, indent=1)
+    print(f"wrote {out_path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -24,6 +24,11 @@ from h2o3_tpu.frame.ingest import (
     sniff_format,
 )
 
+# legacy module predating the CheckKeysTask fixture: the REST
+# import tests leave parsed frames behind; the module-level
+# sweeper removes everything at module end
+pytestmark = pytest.mark.leaks_keys
+
 SVM = """\
 1 1:0.5 3:2.0  # comment
 -1 2:1.5
