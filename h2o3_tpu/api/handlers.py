@@ -1181,7 +1181,14 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
             else:
                 table["mean_response"] = mean_resp
             out_tables.append(table)
-        return {"partial_dependence_data": out_tables}
+        payload = {"partial_dependence_data": out_tables}
+        # store for GET /3/PartialDependence/{name} (fetchPDP)
+        from h2o3_tpu.api.handlers_ext import PDPResult
+
+        dest = params.get("destination_key") or DKV.make_key("pdp")
+        DKV.put(dest, PDPResult(payload))
+        payload["destination_key"] = {"name": dest}
+        return payload
 
     def tree_inspect(params, model_id, tree_number):
         """Tree inspection (hex/schemas TreeV3 / h2o-py h2o.tree): node
@@ -1522,6 +1529,7 @@ refresh();setInterval(refresh,5000);
     # ---- round-4 route groups (ModelMetrics CRUD, model io by URI, NPS,
     # munging utilities, diagnostics) — registered last so they see the
     # fully-populated registry for dispatch-based reuse ----------------------
-    from h2o3_tpu.api import handlers_ops
+    from h2o3_tpu.api import handlers_ext, handlers_ops
 
     handlers_ops.register(r, server)
+    handlers_ext.register(r, server)

@@ -1,0 +1,275 @@
+"""Round-4 batch 2: model analysis (feature interactions, Friedman–
+Popescu H, fetchable PDPs), frame export by URI, remaining ingest route
+forms, and the Assembly pipeline.
+
+Reference: ``ModelsHandler.makeFeatureInteraction`` (/3/FeatureInteraction),
+``makeFriedmansPopescusH`` (/3/FriedmansPopescusH), ``fetchPartialDependence``
+(GET /3/PartialDependence/{name}), ``FramesHandler.export``,
+``ImportFilesHandler`` multi/GET forms, ``ParseSVMLight``,
+``DecryptionSetup``/Hive (module-gated), ``AssemblyHandler``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List
+
+import numpy as np
+
+from h2o3_tpu.api.server import H2OServer, RequestServer, RestError
+from h2o3_tpu.frame.frame import ColType, Column, Frame
+from h2o3_tpu.keyed import DKV
+
+
+class PDPResult:
+    """DKV-resident partial-dependence result (fetchable by name)."""
+
+    def __init__(self, payload: Dict[str, Any]) -> None:
+        self.payload = payload
+
+
+def _parse_list(value) -> List[str]:
+    """Query/body value -> list of strings: proper JSON first, the
+    python-repr fallback second, else comma split (one shared parser —
+    the quote-swap-only variant corrupts legitimate apostrophes)."""
+    if isinstance(value, (list, tuple)):
+        return list(value)
+    s = (value or "").strip()
+    if s.startswith("["):
+        try:
+            return json.loads(s)
+        except json.JSONDecodeError:
+            return json.loads(s.replace("'", '"'))
+    return [x for x in s.split(",") if x]
+
+
+def register(r: RequestServer, server: H2OServer) -> None:  # noqa: C901
+    from h2o3_tpu.api.handlers import _get_frame, _get_model
+
+    # ---- fetchable PDP ----------------------------------------------------
+    def fetch_pdp(params, name):
+        v = DKV.get(name)
+        if not isinstance(v, PDPResult):
+            raise RestError(404, f"no partial dependence under {name!r}")
+        return v.payload
+
+    r.register("GET", "/3/PartialDependence/{name}", fetch_pdp,
+               "fetch a stored PDP by name")
+
+    # ---- feature interactions (tree models) -------------------------------
+    def feature_interaction(params):
+        """Pairwise split-adjacency interaction counts: a parent split on
+        f1 whose child splits on f2 is one (f1, f2) interaction
+        (FeatureInteraction.java's depth-1 path statistic)."""
+        from h2o3_tpu.models.tree.common import (
+            TreeModelBase,
+            tree_feature_names,
+        )
+
+        m = _get_model(params.get("model_id", ""))
+        if not isinstance(m, TreeModelBase):
+            raise RestError(400, f"{m.algo_name} is not a tree model")
+        # this statistic is depth-1 adjacency only, so the reference's
+        # max_interaction_depth (path length) does not apply; top_n caps
+        # the RESPONSE size explicitly instead of overloading it
+        top_n = int(params.get("top_n", 100))
+        names = tree_feature_names(m.data_info, m.tree_encoding)
+        pair_counts: Dict[tuple, int] = {}
+        single_counts: Dict[int, int] = {}
+        for trees in m.booster.trees_per_class:
+            for t in range(trees.ntrees):
+                feat = trees.feat[t]
+                sp = trees.is_split[t]
+                M = len(feat)
+                for i in range(M):
+                    if not sp[i]:
+                        continue
+                    f1 = int(feat[i])
+                    single_counts[f1] = single_counts.get(f1, 0) + 1
+                    for child in (2 * i + 1, 2 * i + 2):
+                        if child < M and sp[child]:
+                            pair = tuple(sorted((f1, int(feat[child]))))
+                            pair_counts[pair] = pair_counts.get(pair, 0) + 1
+        ranked = sorted(pair_counts.items(), key=lambda kv: -kv[1])[:top_n]
+        return {
+            "feature_interaction": [
+                {"feature_pair": f"{names[a]}|{names[b]}",
+                 "interaction_count": n}
+                for (a, b), n in ranked
+            ],
+            "split_counts": {names[f]: n for f, n in sorted(
+                single_counts.items(), key=lambda kv: -kv[1])},
+        }
+
+    r.register("POST", "/3/FeatureInteraction", feature_interaction,
+               "pairwise split interactions")
+
+    # ---- Friedman–Popescu H -----------------------------------------------
+    def friedmans_h(params):
+        """H² statistic for a variable pair: the variance of the joint
+        partial dependence not explained by the additive parts
+        (hex/tree/FriedmanPopescusH.java), estimated over a row sample."""
+        m = _get_model(params.get("model_id", ""))
+        fr = _get_frame(params.get("frame", params.get("frame_id", "")))
+        variables = _parse_list(params.get("variables") or [])
+        if len(variables) != 2:
+            raise RestError(400, "variables must name exactly 2 columns")
+        va, vb = variables
+        for v in variables:
+            if v not in fr.names:
+                raise RestError(404, f"column {v!r} not in frame")
+        n_sample = min(int(params.get("n_sample", 50)), fr.nrows)
+        rng = np.random.default_rng(42)
+        rows = rng.choice(fr.nrows, size=n_sample, replace=False)
+        sub = fr.rows(np.sort(rows))
+
+        def raw_margin(frame: Frame) -> np.ndarray:
+            p = m._predict_raw(frame)
+            return p[:, -1] if p.ndim == 2 else p
+
+        def pd_over(cols_fixed: List[str]) -> np.ndarray:
+            """PD(x_S) at each sample point in ONE prediction: block i of
+            an [n_sample², ...] frame pins the S-columns to sample i's
+            values over a full copy of the sample; the block mean is
+            PD(x_S = sample_i)."""
+            n2 = n_sample * n_sample
+            cols = []
+            for c in sub.columns:
+                if c.name in cols_fixed:
+                    data = np.repeat(c.data, n_sample)  # [i..i..] blocks
+                else:
+                    data = np.tile(c.data, n_sample)
+                cols.append(Column(c.name, data, c.type, c.domain))
+            margins = raw_margin(Frame(cols)).reshape(n_sample, n_sample)
+            assert margins.size == n2
+            return np.nanmean(margins, axis=1)
+
+        pd_ab = pd_over([va, vb])
+        pd_a = pd_over([va])
+        pd_b = pd_over([vb])
+        pd_ab -= pd_ab.mean()
+        pd_a -= pd_a.mean()
+        pd_b -= pd_b.mean()
+        denom = float((pd_ab ** 2).sum())
+        h2 = (float(((pd_ab - pd_a - pd_b) ** 2).sum()) / denom
+              if denom > 0 else 0.0)
+        return {"h": float(np.sqrt(max(h2, 0.0))), "h_squared": h2,
+                "variables": [va, vb], "n_sample": n_sample}
+
+    r.register("POST", "/3/FriedmansPopescusH", friedmans_h,
+               "Friedman-Popescu H statistic for a variable pair")
+
+    # ---- frame export by URI ----------------------------------------------
+    def _export_frame(fr: Frame, frame_id: str, path: str,
+                      force: bool) -> Dict[str, Any]:
+        path = os.path.expanduser(path)
+        if os.path.exists(path) and not force:
+            raise RestError(409, f"{path} exists and force is false")
+        csv = r.dispatch("GET", "/3/DownloadDataset",
+                         {"frame_id": frame_id})
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(csv)
+        return {"path": path, "bytes": len(csv)}
+
+    def frame_export_post(params, frame_id):
+        fr = _get_frame(frame_id)
+        path = params.get("path")
+        if not path:
+            raise RestError(400, "path required")
+        force = str(params.get("force", "true")).lower() in ("true", "1")
+        return _export_frame(fr, frame_id, path, force)
+
+    def frame_export_get(params, frame_id, path, force):
+        fr = _get_frame(frame_id)
+        return _export_frame(fr, frame_id, path,
+                             str(force).lower() in ("true", "1"))
+
+    r.register("POST", "/3/Frames/{frame_id}/export", frame_export_post,
+               "export frame as csv to a server path")
+    r.register("GET", "/3/Frames/{frame_id}/export/{path}/overwrite/{force}",
+               frame_export_get, "export frame (URI form)")
+
+    # ---- remaining ingest route forms -------------------------------------
+    def import_files_multi(params):
+        paths = _parse_list(params.get("paths") or [])
+        if not paths:
+            raise RestError(400, "paths required")
+        outs = [r.dispatch("POST", "/3/ImportFiles", {"path": p})
+                for p in paths]
+        return {"destination_frames": [
+            k for o in outs
+            for k in (o.get("destination_frames") or
+                      [o.get("destination_frame")])
+        ]}
+
+    def import_files_get(params):
+        return r.dispatch("POST", "/3/ImportFiles", params)
+
+    def parse_svmlight_ep(params):
+        params = dict(params)
+        params["format"] = "svmlight"
+        return r.dispatch("POST", "/3/Parse", params)
+
+    def decryption_setup(params):
+        raise RestError(
+            400,
+            "encrypted-archive ingest (DecryptionSetup / AES zip) is not "
+            "available in this build; decrypt before import (reference: "
+            "water/parser/DecryptionTool.java)")
+
+    def hive_unavailable(params):
+        raise RestError(
+            400,
+            "Hive import/export needs the Hive metastore client, which is "
+            "not available in this build (reference: h2o-ext-hive / "
+            "water/hive/HiveTableImporter.java); export the table to "
+            "parquet/orc/csv and import that")
+
+    r.register("POST", "/3/ImportFilesMulti", import_files_multi,
+               "import several paths")
+    r.register("GET", "/3/ImportFiles", import_files_get,
+               "import a file (GET form)")
+    r.register("POST", "/3/ParseSVMLight", parse_svmlight_ep,
+               "parse svmlight sources")
+    r.register("POST", "/3/DecryptionSetup", decryption_setup,
+               "encrypted ingest (unavailable, actionable error)")
+    r.register("POST", "/3/ImportHiveTable", hive_unavailable,
+               "hive import (unavailable, actionable error)")
+    r.register("POST", "/3/SaveToHiveTable", hive_unavailable,
+               "hive export (unavailable, actionable error)")
+
+    # ---- assembly ----------------------------------------------------------
+    def assembly_fit(params):
+        from h2o3_tpu.models.assembly import fit_assembly
+
+        fr = _get_frame(params.get("frame", params.get("frame_id", "")))
+        steps = params.get("steps")
+        if isinstance(steps, str):
+            steps = json.loads(steps)
+        if not isinstance(steps, list) or not steps:
+            raise RestError(400, "steps (non-empty list) required")
+        try:
+            asm, out = fit_assembly(steps, fr)
+        except (ValueError, KeyError) as e:
+            raise RestError(400, str(e))
+        dest = params.get("destination_frame") or DKV.make_key("assembly_out")
+        out.key = dest
+        DKV.put(dest, out)
+        return {"assembly": {"name": asm.key},
+                "result": {"name": dest},
+                "out_names": asm.out_names}
+
+    def assembly_java(params, assembly_id, pojo_name):
+        from h2o3_tpu.models.assembly import Assembly
+
+        asm = DKV.get(assembly_id)
+        if not isinstance(asm, Assembly):
+            raise RestError(404, f"no assembly {assembly_id!r}")
+        return asm.to_java(pojo_name).encode(), "text/plain; charset=utf-8"
+
+    r.register("POST", "/99/Assembly", assembly_fit,
+               "fit a munging pipeline")
+    r.register("GET", "/99/Assembly.java/{assembly_id}/{pojo_name}",
+               assembly_java, "assembly as standalone java munger")

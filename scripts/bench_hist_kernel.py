@@ -21,8 +21,22 @@ from h2o3_tpu.ops.histogram import _shard_histogram  # noqa: E402
 from h2o3_tpu.ops.pallas_histogram import _C, build_histogram_pallas  # noqa: E402
 
 N, F, B1 = 2_000_000, 28, 257
-#: TPU v5e chip peak: ~197 TFLOPs bf16; f32 matmuls run at ~half that
-PEAK_F32_TFLOPS = 98.5
+#: f32 MXU peak per chip generation (bf16 peak / 2); pct_of_peak is
+#: omitted when the device string matches none of these
+PEAK_F32_TFLOPS_BY_DEVICE = {
+    "v6": 459.0,   # bf16 ~918
+    "v5p": 229.5,  # bf16 ~459
+    "v5": 98.5,    # v5e/lite: bf16 ~197
+    "v4": 137.5,   # bf16 ~275
+}
+
+
+def _peak_for(device: str):
+    d = device.lower()
+    for key, peak in PEAK_F32_TFLOPS_BY_DEVICE.items():
+        if key in d:
+            return peak
+    return None
 
 
 def main() -> None:
@@ -56,6 +70,7 @@ def main() -> None:
         n_pad = N + (-N) % _ROW_TILE
         flops = 2.0 * n_pad * (f_pad * B1) * (K * _C)
         achieved = flops / t_p / 1e12
+        peak = _peak_for(str(jax.devices()[0]))
         row = {
             "K": K,
             "xla_scatter_ms": round(t_x * 1e3, 2),
@@ -63,9 +78,10 @@ def main() -> None:
             "speedup": round(t_x / t_p, 2),
             "pallas_rows_per_sec": round(N / t_p, 0),
             "achieved_tflops_f32": round(achieved, 2),
-            "pct_of_peak": round(100 * achieved / PEAK_F32_TFLOPS, 1),
             "max_abs_err": err,
         }
+        if peak is not None:
+            row["pct_of_peak_f32"] = round(100 * achieved / peak, 1)
         results.append(row)
         print(row, flush=True)
 

@@ -1,0 +1,215 @@
+"""Round-4 batch 2 routes: FeatureInteraction, FriedmansPopescusH,
+fetchable PDP, frame export by URI, ingest route forms, Assembly.
+
+Reference: ModelsHandler.{makeFeatureInteraction,makeFriedmansPopescusH,
+fetchPartialDependence}, FramesHandler.export, ImportFilesHandler,
+AssemblyHandler + h2o-py H2OAssembly."""
+
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.api import start_server
+
+# module fixtures share server-side keys; swept at module end
+pytestmark = pytest.mark.leaks_keys
+
+rng0 = np.random.default_rng(21)
+CSV = "x0,x1,y\n" + "\n".join(
+    f"{a:.4f},{b:.4f},{'yes' if a * b > 0 else 'no'}"
+    for a, b in rng0.normal(size=(500, 2))
+)
+
+
+@pytest.fixture(scope="module")
+def server():
+    s = start_server(port=0)
+    yield s
+    s.stop()
+
+
+def _req(server, method, path, data=None, raw=False):
+    body = json.dumps(data).encode() if data is not None else None
+    req = urllib.request.Request(
+        server.url + path, data=body,
+        headers={"Content-Type": "application/json"} if body else {},
+        method=method)
+    try:
+        with urllib.request.urlopen(req) as resp:
+            payload = resp.read()
+            return resp.status, payload if raw else json.loads(payload)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+@pytest.fixture(scope="module")
+def gbm(server):
+    st, up = _req(server, "POST", "/3/PostFile", {"data": CSV})
+    assert st == 200
+    st, out = _req(server, "POST", "/3/Parse",
+                   {"source_frames": [up["destination_frame"]],
+                    "destination_frame": "ext_train"})
+    assert st == 200, out
+    st, out = _req(server, "POST", "/3/ModelBuilders/gbm",
+                   {"training_frame": "ext_train", "response_column": "y",
+                    "ntrees": 10, "max_depth": 4, "seed": 1, "min_rows": 3,
+                    "model_id": "ext_gbm"})
+    assert st == 200, out
+    return "ext_gbm"
+
+
+class TestFeatureInteraction:
+    def test_xor_signal_interacts(self, server, gbm):
+        st, out = _req(server, "POST", "/3/FeatureInteraction",
+                       {"model_id": gbm})
+        assert st == 200, out
+        pairs = out["feature_interaction"]
+        assert pairs, "no interactions found"
+        # y = sign(x0*x1) is a pure interaction: x0|x1 must rank first
+        assert pairs[0]["feature_pair"] in ("x0|x1", "x1|x0")
+        assert out["split_counts"]
+
+    def test_non_tree_model_400(self, server, gbm):
+        st, out = _req(server, "POST", "/3/ModelBuilders/glm",
+                       {"training_frame": "ext_train",
+                        "response_column": "y", "family": "binomial",
+                        "model_id": "ext_glm"})
+        assert st == 200, out
+        st, out = _req(server, "POST", "/3/FeatureInteraction",
+                       {"model_id": "ext_glm"})
+        assert st == 400
+
+
+class TestFriedmansH:
+    def test_interacting_pair_has_high_h(self, server, gbm):
+        st, out = _req(server, "POST", "/3/FriedmansPopescusH",
+                       {"model_id": gbm, "frame": "ext_train",
+                        "variables": ["x0", "x1"], "nbins": 40})
+        assert st == 200, out
+        # multiplicative signal: H should be decisively non-additive
+        assert out["h"] > 0.3, out
+
+    def test_bad_variables_400(self, server, gbm):
+        st, _ = _req(server, "POST", "/3/FriedmansPopescusH",
+                     {"model_id": gbm, "frame": "ext_train",
+                      "variables": ["x0"]})
+        assert st == 400
+
+
+class TestFetchPDP:
+    def test_make_then_fetch(self, server, gbm):
+        st, out = _req(server, "POST", "/3/PartialDependence",
+                       {"model_id": gbm, "frame_id": "ext_train",
+                        "cols": ["x0"], "nbins": 5,
+                        "destination_key": "ext_pdp"})
+        assert st == 200, out
+        assert out["destination_key"]["name"] == "ext_pdp"
+        st, fetched = _req(server, "GET", "/3/PartialDependence/ext_pdp")
+        assert st == 200
+        assert fetched["partial_dependence_data"][0]["column"] == "x0"
+        st, _ = _req(server, "GET", "/3/PartialDependence/nope")
+        assert st == 404
+
+
+class TestFrameExport:
+    def test_post_form(self, server, gbm, tmp_path):
+        path = str(tmp_path / "out.csv")
+        st, out = _req(server, "POST", "/3/Frames/ext_train/export",
+                       {"path": path})
+        assert st == 200, out
+        lines = open(path).read().splitlines()
+        assert lines[0] == "x0,x1,y" and len(lines) == 501
+        # force=false on existing file conflicts
+        st, _ = _req(server, "POST", "/3/Frames/ext_train/export",
+                     {"path": path, "force": False})
+        assert st == 409
+
+    def test_get_uri_form(self, server, gbm, tmp_path):
+        path = str(tmp_path / "out2.csv")
+        enc = urllib.request.quote(path, safe="")
+        st, out = _req(server, "GET",
+                       f"/3/Frames/ext_train/export/{enc}/overwrite/true")
+        assert st == 200, out
+        assert os.path.exists(path)
+
+
+class TestIngestForms:
+    def test_import_files_multi(self, server, tmp_path):
+        (tmp_path / "m1.csv").write_text("a\n1\n")
+        (tmp_path / "m2.csv").write_text("a\n2\n")
+        st, out = _req(server, "POST", "/3/ImportFilesMulti",
+                       {"paths": [str(tmp_path / "m1.csv"),
+                                  str(tmp_path / "m2.csv")]})
+        assert st == 200, out
+        assert len(out["destination_frames"]) == 2
+
+    def test_import_get_form(self, server, tmp_path):
+        (tmp_path / "g.csv").write_text("a\n1\n")
+        st, out = _req(server, "GET",
+                       f"/3/ImportFiles?path={tmp_path}/g.csv")
+        assert st == 200, out
+
+    def test_parse_svmlight_route(self, server):
+        st, up = _req(server, "POST", "/3/PostFile",
+                      {"data": "1 1:0.5 2:1.0\n-1 2:2.0\n"})
+        assert st == 200
+        st, out = _req(server, "POST", "/3/ParseSVMLight",
+                       {"source_frames": [up["destination_frame"]],
+                        "destination_frame": "ext_svm"})
+        assert st == 200, out
+        st, fr = _req(server, "GET", "/3/Frames/ext_svm")
+        assert fr["frames"][0]["column_names"][0] == "target"
+
+    def test_gated_routes_actionable(self, server):
+        st, out = _req(server, "POST", "/3/DecryptionSetup", {})
+        assert st == 400 and "Decryption" in out["msg"]
+        for p in ("/3/ImportHiveTable", "/3/SaveToHiveTable"):
+            st, out = _req(server, "POST", p, {})
+            assert st == 400 and "Hive" in out["msg"]
+
+
+class TestAssembly:
+    def test_fit_and_java(self, server, gbm, tmp_path):
+        steps = [
+            {"op": "ColOp", "fun": "abs", "col": "x0",
+             "new_col_name": "ax0"},
+            {"op": "BinaryOp", "fun": "*", "left": "x0", "right": "x1",
+             "new_col_name": "x0x1"},
+            {"op": "BinaryOp", "fun": "+", "left": "ax0", "right": 10.0,
+             "new_col_name": "shifted"},
+            {"op": "ColSelect", "cols": ["x0x1", "shifted"]},
+        ]
+        st, out = _req(server, "POST", "/99/Assembly",
+                       {"frame": "ext_train", "steps": steps,
+                        "destination_frame": "ext_asm_out"})
+        assert st == 200, out
+        assert out["out_names"] == ["x0x1", "shifted"]
+        st, fr = _req(server, "GET", "/3/Frames/ext_asm_out")
+        assert fr["frames"][0]["rows"] == 500
+        # numeric correctness of the fitted pipeline
+        from h2o3_tpu.keyed import DKV
+
+        src, dst = DKV.get("ext_train"), DKV.get("ext_asm_out")
+        x0 = src.col("x0").numeric_view()
+        x1 = src.col("x1").numeric_view()
+        np.testing.assert_allclose(dst.col("x0x1").data, x0 * x1)
+        np.testing.assert_allclose(dst.col("shifted").data,
+                                   np.abs(x0) + 10.0)
+        # java emitter
+        asm_id = out["assembly"]["name"]
+        st, java = _req(server, "GET",
+                        f"/99/Assembly.java/{asm_id}/MyMunger", raw=True)
+        assert st == 200
+        java = java.decode()
+        assert "public class MyMunger" in java
+        assert "public static double[] fit(double[] row)" in java
+        assert java.count("{") == java.count("}")
+
+    def test_bad_step_400(self, server, gbm):
+        st, out = _req(server, "POST", "/99/Assembly",
+                       {"frame": "ext_train",
+                        "steps": [{"op": "Nope"}]})
+        assert st == 400
