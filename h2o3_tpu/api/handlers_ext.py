@@ -40,7 +40,12 @@ def _parse_list(value) -> List[str]:
         try:
             return json.loads(s)
         except json.JSONDecodeError:
-            return json.loads(s.replace("'", '"'))
+            try:
+                return json.loads(s.replace("'", '"'))
+            except json.JSONDecodeError:
+                raise RestError(
+                    400, f"cannot parse list value {s[:80]!r}: use JSON "
+                         f"or comma-separated tokens")
     return [x for x in s.split(",") if x]
 
 
@@ -124,6 +129,13 @@ def register(r: RequestServer, server: H2OServer) -> None:  # noqa: C901
         rows = rng.choice(fr.nrows, size=n_sample, replace=False)
         sub = fr.rows(np.sort(rows))
 
+        if getattr(m, "nclasses", 1) > 2:
+            # per-class H needs the reference's full per-class sweep;
+            # narrowing to one class silently would mislead
+            raise RestError(
+                400, "FriedmansPopescusH supports regression and binomial "
+                     "models only in this build")
+
         def raw_margin(frame: Frame) -> np.ndarray:
             p = m._predict_raw(frame)
             return p[:, -1] if p.ndim == 2 else p
@@ -161,8 +173,9 @@ def register(r: RequestServer, server: H2OServer) -> None:  # noqa: C901
                "Friedman-Popescu H statistic for a variable pair")
 
     # ---- frame export by URI ----------------------------------------------
-    def _export_frame(fr: Frame, frame_id: str, path: str,
+    def _export_frame(frame_id: str, path: str,
                       force: bool) -> Dict[str, Any]:
+        _get_frame(frame_id)  # 404 before touching the filesystem
         path = os.path.expanduser(path)
         if os.path.exists(path) and not force:
             raise RestError(409, f"{path} exists and force is false")
@@ -174,16 +187,16 @@ def register(r: RequestServer, server: H2OServer) -> None:  # noqa: C901
         return {"path": path, "bytes": len(csv)}
 
     def frame_export_post(params, frame_id):
-        fr = _get_frame(frame_id)
         path = params.get("path")
         if not path:
             raise RestError(400, "path required")
-        force = str(params.get("force", "true")).lower() in ("true", "1")
-        return _export_frame(fr, frame_id, path, force)
+        # force defaults FALSE: silent overwrite is the reference's
+        # opt-in, not its default (FramesHandler.export)
+        force = str(params.get("force", "false")).lower() in ("true", "1")
+        return _export_frame(frame_id, path, force)
 
     def frame_export_get(params, frame_id, path, force):
-        fr = _get_frame(frame_id)
-        return _export_frame(fr, frame_id, path,
+        return _export_frame(frame_id, path,
                              str(force).lower() in ("true", "1"))
 
     r.register("POST", "/3/Frames/{frame_id}/export", frame_export_post,

@@ -212,6 +212,92 @@ def cluster_status() -> Dict[str, Any]:
     return connection().cloud_info()
 
 
+def _key_of(obj) -> str:
+    for attr in ("key", "model_id", "frame_id"):
+        v = getattr(obj, attr, None)
+        if isinstance(v, str) and v:
+            return v
+    if not isinstance(obj, str):
+        raise TypeError(f"expected an id string or a keyed object, "
+                        f"got {type(obj).__name__}")
+    return obj
+
+
+def make_metrics(predicted, actuals, domain: Optional[List[str]] = None,
+                 distribution: str = "gaussian") -> Dict[str, Any]:
+    """Metrics from raw predictions + actuals frames with no model
+    (h2o.make_metrics -> POST /3/ModelMetrics/predictions_frame/...)."""
+    params: Dict[str, Any] = {"distribution": distribution}
+    if domain is not None:
+        params["domain"] = list(domain)
+    out = connection().request(
+        f"POST /3/ModelMetrics/predictions_frame/{_key_of(predicted)}"
+        f"/actuals_frame/{_key_of(actuals)}", params)
+    return out["model_metrics"][0]
+
+
+def feature_interaction(model_or_id, top_n: int = 100) -> Dict[str, Any]:
+    """Pairwise split interactions of a tree model (/3/FeatureInteraction)."""
+    return connection().request(
+        "POST /3/FeatureInteraction",
+        {"model_id": _key_of(model_or_id), "top_n": top_n})
+
+
+def h_statistic(model_or_id, frame_or_id, variables: List[str],
+                n_sample: int = 50) -> float:
+    """Friedman-Popescu H for a variable pair (/3/FriedmansPopescusH)."""
+    out = connection().request(
+        "POST /3/FriedmansPopescusH",
+        {"model_id": _key_of(model_or_id), "frame": _key_of(frame_or_id),
+         "variables": list(variables), "n_sample": n_sample})
+    return out["h"]
+
+
+def tabulate(frame_or_id, predictor: str, response: str,
+             weight: Optional[str] = None, nbins_predictor: int = 20,
+             nbins_response: int = 10) -> Dict[str, Any]:
+    """Co-occurrence + mean-response tables (h2o.tabulate -> /99/Tabulate)."""
+    params: Dict[str, Any] = {
+        "dataset": _key_of(frame_or_id), "predictor": predictor,
+        "response": response, "nbins_predictor": nbins_predictor,
+        "nbins_response": nbins_response,
+    }
+    if weight:
+        params["weight"] = weight
+    return connection().request("POST /99/Tabulate", params)
+
+
+def interaction(frame_or_id, factor_columns: List[str],
+                pairwise: bool = False, max_factors: int = 100,
+                min_occurrence: int = 1,
+                destination_frame: Optional[str] = None) -> "H2OFrame":
+    """Categorical interaction columns (h2o.interaction -> /3/Interaction)."""
+    params: Dict[str, Any] = {
+        "source_frame": _key_of(frame_or_id),
+        "factor_columns": list(factor_columns), "pairwise": pairwise,
+        "max_factors": max_factors, "min_occurrence": min_occurrence,
+    }
+    if destination_frame:
+        params["dest"] = destination_frame
+    out = connection().request("POST /3/Interaction", params)
+    return get_frame(out["destination_frame"]["name"])
+
+
+def export_file(frame_or_id, path: str, force: bool = False) -> str:
+    """Write a frame as CSV on the server (h2o.export_file)."""
+    out = connection().request(
+        f"POST /3/Frames/{_key_of(frame_or_id)}/export",
+        {"path": path, "force": force})
+    return out["path"]
+
+
+def download_pojo(model_or_id, lang: str = "java") -> str:
+    """Standalone scoring source (h2o.download_pojo -> /3/Models.java)."""
+    out = connection().request(
+        f"GET /3/Models.java/{_key_of(model_or_id)}?lang={lang}", raw=True)
+    return out.decode() if isinstance(out, bytes) else out
+
+
 class H2OAutoML:
     """h2o-py/h2o/automl/H2OAutoML surface over /99/AutoMLBuilder."""
 

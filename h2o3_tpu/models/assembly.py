@@ -118,19 +118,16 @@ class Assembly:
         ]
         for n, i in idx.items():
             lines.append(f'    v.put("{n}", row[{i}]);')
-        names = list(self.in_names)
+        # the output projection comes from out_names (recorded at fit);
+        # ColSelect steps only affect which names fit() kept
         for step in self.steps:
             op = step.get("op")
-            if op == "ColSelect":
-                names = list(step.get("cols") or [])
-            elif op == "ColOp":
+            if op == "ColOp":
                 fun, col = step["fun"], step["col"]
                 new = (col if step.get("inplace")
                        else (step.get("new_col_name") or f"{fun}_{col}"))
                 expr = _UNI[fun][1].replace("v", f'v.get("{col}")')
                 lines.append(f'    v.put("{new}", {expr});')
-                if not step.get("inplace") and new not in names:
-                    names.append(new)
             elif op == "BinaryOp":
                 fun = _BIN[step["fun"]]
                 left = f'v.get("{step["left"]}")'
@@ -139,8 +136,6 @@ class Assembly:
                          else repr(float(rhs)))
                 new = step.get("new_col_name") or f"{step['left']}_{step['fun']}"
                 lines.append(f'    v.put("{new}", {left} {fun} {right});')
-                if new not in names:
-                    names.append(new)
         lines.append(f"    double[] out = new double[{len(self.out_names)}];")
         for j, n in enumerate(self.out_names):
             lines.append(f'    out[{j}] = v.get("{n}");')

@@ -213,3 +213,39 @@ class TestAssembly:
                        {"frame": "ext_train",
                         "steps": [{"op": "Nope"}]})
         assert st == 400
+
+
+class TestClientModuleFunctions:
+    """h2o-py-module-level calls added round 4 (h2o.make_metrics,
+    h2o.tabulate, h2o.interaction, h2o.export_file, h2o.download_pojo,
+    feature_interaction / h_statistic)."""
+
+    @pytest.fixture()
+    def client(self, server, gbm):
+        from h2o3_tpu import client as h2o
+
+        h2o.connect(server.url)
+        return h2o
+
+    def test_make_metrics_and_analysis(self, client, gbm):
+        h2o = client
+        st = h2o.rapids("(= ext_p (cols_py ext_train 'x0'))")
+        h2o.rapids("(= ext_a (cols_py ext_train 'x1'))")
+        mm = h2o.make_metrics("ext_p", "ext_a")
+        assert mm["rmse"] > 0
+        fi = h2o.feature_interaction(gbm)
+        assert fi["feature_interaction"]
+        h = h2o.h_statistic(gbm, "ext_train", ["x0", "x1"], n_sample=25)
+        assert 0.0 <= h <= 1.5
+
+    def test_tabulate_interaction_export(self, client, gbm, tmp_path):
+        h2o = client
+        t = h2o.tabulate("ext_train", "x0", "y", nbins_predictor=4)
+        assert len(t["count_table"]["predictor_labels"]) == 4
+        path = str(tmp_path / "exp.csv")
+        out = h2o.export_file("ext_train", path)
+        assert out == path and os.path.exists(path)
+        src = h2o.download_pojo(gbm, lang="c")
+        assert "void score(const float *x" in src
+        java = h2o.download_pojo(gbm)
+        assert "score0" in java
