@@ -696,7 +696,22 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
         with tempfile.NamedTemporaryFile(suffix=".mojo", delete=False) as f:
             path = f.name
         try:
-            m.download_mojo(path)
+            fmt = str(params.get("format", "")).strip().lower()
+            if fmt == "reference":
+                # the actual H2O-3 MOJO zip layout (models/mojo_ref.py)
+                from h2o3_tpu.models.mojo_ref import write_mojo as _write_ref
+
+                try:
+                    _write_ref(m, path)
+                except ValueError as e:
+                    raise RestError(400, str(e))
+            elif fmt in ("", "native"):
+                m.download_mojo(path)
+            else:
+                # an explicit unknown format must not silently fall back:
+                # the client would feed the wrong artifact downstream
+                raise RestError(400, f"unknown mojo format {fmt!r} "
+                                     f"(use 'native' or 'reference')")
             with open(path, "rb") as f:
                 return f.read()
         finally:

@@ -1,0 +1,313 @@
+"""Reference-format MOJO export: the actual H2O-3 MOJO zip layout.
+
+Reference (format spec, mirrored byte-for-byte):
+  * container: ``hex/ModelMojoWriter.java`` — a zip of ``model.ini``
+    ([info] key=value, [columns], [domains] sections), ``domains/d*.txt``
+    and binary blobs;
+  * compressed trees: ``hex/tree/DTree.java:727-815`` (``size``/
+    ``compress``) — per decided node: 1B nodeType (equal bits 8/12,
+    left-leaf |=48, else skip-size bits; right-leaf |=0xC0), 2B colId,
+    1B naSplitDir, 4B float split value, skip offset in 1..4 bytes,
+    then the left and right subtrees inline; leaves are a bare 4B
+    float; a root-leaf is ``00 FF FF`` + float
+    (``DTree.java:855``);
+  * reader contract: ``hex/genmodel/ModelMojoReader.readAll`` (required
+    [info] keys), ``SharedTreeMojoReader`` (n_trees/n_trees_per_class/
+    tree blob names), ``GbmMojoModel.score0/unifyPreds`` (init_f +
+    link inverse; multinomial softmax over per-class tree sums).
+
+The writer emits GBM models in this exact layout; ``read_mojo`` is an
+INDEPENDENT decoder implementing the ``SharedTreeMojoModel.scoreTree``
+byte-walk, used by the parity tests (write -> decode -> score must
+equal in-framework predict). It handles float splits only; bitset
+categorical splits are rejected loudly — this framework's boosters
+label-encode categoricals, so the writer never emits them.
+"""
+
+from __future__ import annotations
+
+import io
+import struct
+import uuid as _uuid
+import zipfile
+from typing import Dict, List, Optional
+
+import numpy as np
+
+_NA_LEFT = 2
+_NA_RIGHT = 3
+
+_LINK_BY_DIST = {
+    "bernoulli": "logit",
+    "multinomial": "identity",  # softmax applied in unifyPreds
+    "poisson": "log",
+    "gamma": "log",
+    "tweedie": "log",
+}
+
+
+# ---------------------------------------------------------------------------
+# tree encoder (DTree.size + DTree.compress)
+
+
+def _encode_subtree(trees, t: int, i: int, edges) -> bytes:
+    """Compress the heap subtree rooted at node i of tree t."""
+    is_split = trees.is_split[t]
+    if not is_split[i]:
+        return struct.pack("<f", float(trees.leaf[t][i]))
+    f = int(trees.feat[t][i])
+    sb = int(trees.split_bin[t][i])
+    thr = (np.inf if sb >= edges.shape[1]
+           else float(edges[f][sb]))
+    left = _encode_subtree(trees, t, 2 * i + 1, edges)
+    right = _encode_subtree(trees, t, 2 * i + 2, edges)
+    left_leaf = not is_split[i * 2 + 1] if 2 * i + 1 < len(is_split) else True
+    right_leaf = not is_split[i * 2 + 2] if 2 * i + 2 < len(is_split) else True
+
+    node_type = 0  # equal == 0: float compare
+    if left_leaf:
+        node_type |= 48
+        offset = b""
+    else:
+        lsz = len(left)
+        slen = 0 if lsz < 256 else (1 if lsz < 65535 else
+                                    (2 if lsz < (1 << 24) else 3))
+        node_type |= slen
+        offset = lsz.to_bytes(slen + 1, "little")
+    if right_leaf:
+        node_type |= (48 << 2) & 0xFF
+
+    na_dir = _NA_LEFT if trees.default_left[t][i] else _NA_RIGHT
+    out = bytearray()
+    out.append(node_type)
+    out += struct.pack("<H", f)
+    out.append(na_dir)
+    out += struct.pack("<f", thr)
+    out += offset
+    out += left
+    out += right
+    return bytes(out)
+
+
+def _encode_tree(trees, t: int, leaf_shift: float = 0.0) -> bytes:
+    if leaf_shift:
+        # bake the class's WHOLE init margin into THIS tree's leaves
+        # (the caller picks tree 0): the MOJO carries one scalar init_f
+        # only, and margins are additive, so every root-to-leaf path of
+        # one tree carrying +init_c reproduces the class offset exactly
+        import copy
+
+        trees = copy.copy(trees)
+        trees.leaf = [lf.copy() for lf in trees.leaf]
+        trees.leaf[t] = (trees.leaf[t].astype(np.float64)
+                         + leaf_shift).astype(np.float32)
+    if not trees.is_split[t][0]:
+        return b"\x00\xff\xff" + struct.pack(
+            "<f", float(trees.leaf[t][0]))
+    return _encode_subtree(trees, t, 0, trees.edges)
+
+
+# ---------------------------------------------------------------------------
+# writer
+
+
+def write_mojo(model, path: str) -> str:
+    """Serialize a GBM model into the reference MOJO zip layout."""
+    from h2o3_tpu.models.tree.common import tree_feature_names
+
+    if model.algo_name != "gbm":
+        raise ValueError(
+            "reference-format MOJO export currently covers GBM; use the "
+            "native .mojo (models/mojo_export.py) or POJO codegen for "
+            f"{model.algo_name}")
+    if getattr(model.params, "offset_column", None):
+        raise ValueError("reference-format MOJO export does not support "
+                         "offset_column models")
+    b = model.booster
+    names = tree_feature_names(model.data_info, model.tree_encoding)
+    dom = model.data_info.response_domain
+    nclasses = model.nclasses
+    dist = model.distribution
+    K = len(b.trees_per_class)
+    ntrees = b.trees_per_class[0].ntrees
+    supervised = True
+    columns = list(names) + [model.params.response_column]
+    cat_domains: Dict[int, List[str]] = {}
+    # label-encoded tree features are numeric to the MOJO; only the
+    # response carries a domain
+    if dom:
+        cat_domains[len(columns) - 1] = list(dom)
+
+    if nclasses == 2:
+        init_f = float(b.init_margin[0])
+        category = "Binomial"
+    elif nclasses > 2:
+        init_f = 0.0  # per-class inits are baked into tree 0's leaves
+        category = "Multinomial"
+    else:
+        init_f = float(b.init_margin[0])
+        category = "Regression"
+
+    info = [
+        ("algorithm", "Gradient Boosting Machine"),
+        ("algo", "gbm"),
+        ("category", category),
+        ("uuid", str(_uuid.uuid4())),
+        ("supervised", "true" if supervised else "false"),
+        ("n_features", len(names)),
+        ("n_classes", nclasses if nclasses > 1 else 1),
+        ("n_columns", len(columns)),
+        ("n_domains", len(cat_domains)),
+        ("balance_classes", "false"),
+        ("default_threshold", 0.5),
+        ("prior_class_distrib", "null"),
+        ("model_class_distrib", "null"),
+        ("offset_column", "null"),
+        ("mojo_version", "1.40"),
+        ("h2o_version", "h2o3-tpu"),
+        ("n_trees", ntrees),
+        ("n_trees_per_class", K),
+        ("distribution", dist),
+        ("link_function", _LINK_BY_DIST.get(dist, "identity")),
+        ("init_f", repr(init_f)),
+    ]
+    lines = ["[info]"]
+    lines += [f"{k} = {v}" for k, v in info]
+    lines.append("")
+    lines.append("[columns]")
+    lines += columns
+    lines.append("")
+    lines.append("[domains]")
+    for ci, (col, d) in enumerate(sorted(cat_domains.items())):
+        lines.append(f"{col}: d{ci:03d}.txt")
+
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr("model.ini", "\n".join(lines) + "\n")
+        for ci, (col, d) in enumerate(sorted(cat_domains.items())):
+            z.writestr(f"domains/d{ci:03d}.txt", "\n".join(d) + "\n")
+        for c, trees in enumerate(b.trees_per_class):
+            for t in range(trees.ntrees):
+                shift = (float(b.init_margin[c])
+                         if (nclasses > 2 and t == 0) else 0.0)
+                z.writestr(f"trees/t{c:02d}_{t:03d}.bin",
+                           _encode_tree(trees, t, leaf_shift=shift))
+    with open(path, "wb") as f:
+        f.write(buf.getvalue())
+    return path
+
+
+# ---------------------------------------------------------------------------
+# independent reader (SharedTreeMojoModel.scoreTree byte-walk)
+
+
+class RefMojo:
+    def __init__(self) -> None:
+        self.info: Dict[str, str] = {}
+        self.columns: List[str] = []
+        self.domains: Dict[int, List[str]] = {}
+        self.trees: List[List[bytes]] = []  # [class][tree]
+
+    @property
+    def nclasses(self) -> int:
+        return int(self.info.get("n_classes", 1))
+
+    def score_tree(self, tree: bytes, row: np.ndarray) -> float:
+        """Exact scoreTree walk (SharedTreeMojoModel.java:130-215),
+        float-split subset."""
+        pos = 0
+        while True:
+            node_type = tree[pos]; pos += 1
+            col_id = struct.unpack_from("<H", tree, pos)[0]; pos += 2
+            if col_id == 65535:
+                return struct.unpack_from("<f", tree, pos)[0]
+            na_dir = tree[pos]; pos += 1
+            na_vs_rest = na_dir == 1
+            leftward = na_dir in (2, 4)
+            lmask = node_type & 51
+            equal = node_type & 12
+            if equal != 0:
+                raise ValueError(
+                    "bitset categorical splits are not supported by this "
+                    "reader (label-encoded models use float splits)")
+            split_val = None
+            if not na_vs_rest:
+                split_val = struct.unpack_from("<f", tree, pos)[0]; pos += 4
+            d = row[col_id]
+            if np.isnan(d):
+                go_right = not leftward
+            elif na_vs_rest:
+                go_right = False
+            else:
+                go_right = d >= split_val
+            if go_right:
+                if lmask <= 3:
+                    n = int.from_bytes(tree[pos:pos + lmask + 1], "little")
+                    pos += lmask + 1
+                    pos += n
+                elif lmask == 48:
+                    pos += 4
+                else:
+                    raise ValueError(f"illegal lmask {lmask}")
+                lmask = (node_type & 0xC0) >> 2
+            else:
+                if lmask <= 3:
+                    pos += lmask + 1
+            if lmask & 16:
+                return struct.unpack_from("<f", tree, pos)[0]
+
+    def score0(self, row: np.ndarray) -> np.ndarray:
+        """GbmMojoModel.unifyPreds semantics over the decoded trees."""
+        init_f = float(self.info.get("init_f", 0.0))
+        dist = self.info.get("distribution", "gaussian")
+        link = self.info.get("link_function", "identity")
+        sums = np.array([
+            np.sum([self.score_tree(t, row) for t in cls], dtype=np.float32)
+            for cls in self.trees
+        ], dtype=np.float64)
+        if dist == "bernoulli":
+            f = sums[0] + init_f
+            p1 = 1.0 / (1.0 + np.exp(-f))
+            return np.array([1.0 - p1, p1])
+        if self.nclasses > 2:
+            e = np.exp(sums - sums.max())
+            return e / e.sum()
+        f = sums[0] + init_f
+        return np.array([np.exp(f) if link == "log" else f])
+
+
+def read_mojo(path: str) -> RefMojo:
+    m = RefMojo()
+    with zipfile.ZipFile(path) as z:
+        section = 0
+        columns: List[str] = []
+        domain_files: Dict[int, str] = {}
+        for raw in z.read("model.ini").decode().splitlines():
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            if line == "[info]":
+                section = 1
+            elif line == "[columns]":
+                section = 2
+            elif line == "[domains]":
+                section = 3
+            elif section == 1:
+                k, _, v = line.partition("=")
+                m.info[k.strip()] = v.strip()
+            elif section == 2:
+                columns.append(line)
+            elif section == 3:
+                ci, _, fname = line.partition(":")
+                domain_files[int(ci)] = fname.strip()
+        m.columns = columns
+        for ci, fname in domain_files.items():
+            m.domains[ci] = z.read(f"domains/{fname}").decode().splitlines()
+        K = int(m.info.get("n_trees_per_class", 1))
+        ntrees = int(m.info.get("n_trees", 0))
+        for c in range(K):
+            m.trees.append([
+                z.read(f"trees/t{c:02d}_{t:03d}.bin")
+                for t in range(ntrees)
+            ])
+    return m

@@ -1,0 +1,162 @@
+"""Reference-format MOJO (VERDICT r3 Missing #6, second half).
+
+Reference: hex/ModelMojoWriter.java (container), hex/tree/DTree.java
+compress (tree bytes), hex/genmodel/ModelMojoReader + SharedTreeMojoModel
+.scoreTree + GbmMojoModel.unifyPreds (consumer contract). The reader here
+is an INDEPENDENT decoder of the byte format — write → decode → score
+parity against in-framework predict validates both sides."""
+
+import struct
+import zipfile
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.frame.frame import ColType, Column, Frame
+from h2o3_tpu.models.mojo_ref import read_mojo, write_mojo
+
+pytestmark = pytest.mark.leaks_keys
+
+
+def _frame(rng, n=500, nclass=2):
+    X = rng.normal(size=(n, 4))
+    logit = X[:, 0] - 0.8 * X[:, 1] + 0.4 * X[:, 2] * X[:, 3]
+    if nclass == 2:
+        y = (logit + rng.normal(size=n) * 0.3 > 0).astype(np.int32)
+        ycol = Column("y", y, ColType.CAT, ["n", "p"])
+    elif nclass > 2:
+        y = np.clip(np.digitize(logit, [-1.0, 1.0]), 0, 2).astype(np.int32)
+        ycol = Column("y", y, ColType.CAT, ["a", "b", "c"])
+    else:
+        ycol = Column("y", logit + rng.normal(size=n) * 0.1)
+    cols = [Column(f"x{i}", X[:, i]) for i in range(4)]
+    cols.append(ycol)
+    fr = Frame(cols)
+    xs = fr.col("x0").data
+    xs[rng.random(n) < 0.06] = np.nan  # exercise NA routing bytes
+    return fr
+
+
+def _score_all(mojo, X32):
+    return np.stack([
+        mojo.score0(X32[i].astype(np.float64)) for i in range(len(X32))
+    ])
+
+
+class TestReferenceMojoParity:
+    def test_binomial(self, rng, tmp_path):
+        from h2o3_tpu.models.tree.common import tree_matrix
+        from h2o3_tpu.models.tree.gbm import GBM
+
+        fr = _frame(rng)
+        m = GBM(ntrees=7, max_depth=4, response_column="y", seed=1,
+                min_rows=2).train(fr)
+        path = str(tmp_path / "m.zip")
+        write_mojo(m, path)
+        mojo = read_mojo(path)
+        assert mojo.info["algo"] == "gbm"
+        assert mojo.info["category"] == "Binomial"
+        X32 = tree_matrix(m.data_info, fr, encoding=m.tree_encoding)
+        got = _score_all(mojo, X32)
+        want = m._predict_raw(fr)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_multinomial_bakes_class_inits(self, rng, tmp_path):
+        from h2o3_tpu.models.tree.common import tree_matrix
+        from h2o3_tpu.models.tree.gbm import GBM
+
+        fr = _frame(rng, nclass=3)
+        m = GBM(ntrees=4, max_depth=3, response_column="y", seed=2,
+                min_rows=2).train(fr)
+        path = str(tmp_path / "m3.zip")
+        write_mojo(m, path)
+        mojo = read_mojo(path)
+        assert int(mojo.info["n_trees_per_class"]) == 3
+        X32 = tree_matrix(m.data_info, fr, encoding=m.tree_encoding)
+        got = _score_all(mojo, X32)
+        want = m._predict_raw(fr)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    @pytest.mark.parametrize("dist", ["gaussian", "poisson"])
+    def test_regression_links(self, rng, tmp_path, dist):
+        from h2o3_tpu.models.tree.common import tree_matrix
+        from h2o3_tpu.models.tree.gbm import GBM
+
+        fr = _frame(rng, nclass=0)
+        if dist == "poisson":
+            y = fr.col("y").data
+            y[:] = np.exp(np.clip(y, -3, 2))
+        m = GBM(ntrees=6, max_depth=3, response_column="y", seed=3,
+                min_rows=2, distribution=dist).train(fr)
+        path = str(tmp_path / f"r_{dist}.zip")
+        write_mojo(m, path)
+        mojo = read_mojo(path)
+        X32 = tree_matrix(m.data_info, fr, encoding=m.tree_encoding)
+        got = _score_all(mojo, X32)[:, 0]
+        want = m._predict_raw(fr)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+class TestContainerLayout:
+    def test_zip_structure_matches_reference(self, rng, tmp_path):
+        from h2o3_tpu.models.tree.gbm import GBM
+
+        fr = _frame(rng)
+        m = GBM(ntrees=3, max_depth=3, response_column="y", seed=4,
+                min_rows=2).train(fr)
+        path = str(tmp_path / "layout.zip")
+        write_mojo(m, path)
+        with zipfile.ZipFile(path) as z:
+            names = set(z.namelist())
+            assert "model.ini" in names
+            assert "trees/t00_000.bin" in names
+            assert "trees/t00_002.bin" in names
+            assert "domains/d000.txt" in names  # response domain
+            ini = z.read("model.ini").decode()
+            for key in ("mojo_version", "n_columns", "supervised",
+                        "init_f", "link_function", "distribution"):
+                assert key in ini, key
+            assert "[columns]" in ini and "[domains]" in ini
+            # domain file carries the response levels
+            assert z.read("domains/d000.txt").decode().split() == ["n", "p"]
+
+    def test_root_leaf_special_encoding(self):
+        """A root-leaf blob is 00 FF FF + float (DTree.java:855) and the
+        reader must return exactly that float."""
+        from h2o3_tpu.models.mojo_ref import RefMojo
+
+        blob = b"\x00\xff\xff" + struct.pack("<f", 2.5)
+        m = RefMojo()
+        assert m.score_tree(blob, np.zeros(3)) == 2.5
+
+    def test_non_gbm_refuses(self, rng):
+        from h2o3_tpu.models.tree.drf import DRF
+
+        fr = _frame(rng)
+        m = DRF(ntrees=3, max_depth=3, response_column="y", seed=5,
+                min_rows=2).train(fr)
+        with pytest.raises(ValueError, match="GBM"):
+            write_mojo(m, "/tmp/nope.zip")
+
+
+class TestRestExport:
+    def test_reference_format_over_rest(self, rng, tmp_path):
+        import io
+        import urllib.request
+
+        from h2o3_tpu.api import start_server
+        from h2o3_tpu.models.tree.gbm import GBM
+
+        fr = _frame(rng)
+        m = GBM(ntrees=3, max_depth=3, response_column="y", seed=6,
+                min_rows=2).train(fr)
+        s = start_server(port=0)
+        try:
+            with urllib.request.urlopen(
+                    f"{s.url}/3/Models/{m.key}/mojo?format=reference") as r:
+                blob = r.read()
+            with zipfile.ZipFile(io.BytesIO(blob)) as z:
+                assert "model.ini" in z.namelist()
+                assert any(n.startswith("trees/") for n in z.namelist())
+        finally:
+            s.stop()
