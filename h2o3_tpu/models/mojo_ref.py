@@ -59,10 +59,12 @@ def _encode_subtree(trees, t: int, i: int, edges) -> bytes:
     sb = int(trees.split_bin[t][i])
     thr = (np.inf if sb >= edges.shape[1]
            else float(edges[f][sb]))
+    # a split node's children always exist in the heap (splits stop one
+    # level above the leaf frontier)
     left = _encode_subtree(trees, t, 2 * i + 1, edges)
     right = _encode_subtree(trees, t, 2 * i + 2, edges)
-    left_leaf = not is_split[i * 2 + 1] if 2 * i + 1 < len(is_split) else True
-    right_leaf = not is_split[i * 2 + 2] if 2 * i + 2 < len(is_split) else True
+    left_leaf = not is_split[2 * i + 1]
+    right_leaf = not is_split[2 * i + 2]
 
     node_type = 0  # equal == 0: float compare
     if left_leaf:
@@ -89,18 +91,25 @@ def _encode_subtree(trees, t: int, i: int, edges) -> bytes:
     return bytes(out)
 
 
-def _encode_tree(trees, t: int, leaf_shift: float = 0.0) -> bytes:
-    if leaf_shift:
-        # bake the class's WHOLE init margin into THIS tree's leaves
-        # (the caller picks tree 0): the MOJO carries one scalar init_f
-        # only, and margins are additive, so every root-to-leaf path of
-        # one tree carrying +init_c reproduces the class offset exactly
+def _encode_tree(trees, t: int, leaf_shift: float = 0.0,
+                 leaf_flip: bool = False) -> bytes:
+    if leaf_flip or leaf_shift:
+        # copy-on-write of THIS tree's leaves only (a shallow list copy;
+        # deep-copying every tree here would make export O(ntrees²)).
+        # leaf_shift bakes the class's WHOLE init margin into this tree
+        # (the caller picks tree 0): the MOJO carries one scalar init_f,
+        # and margins are additive, so one tree carrying +init_c on
+        # every root-to-leaf path reproduces the class offset exactly.
+        # leaf_flip turns per-tree p1 leaves into the class-0
+        # probabilities DrfMojoModel expects.
         import copy
 
         trees = copy.copy(trees)
-        trees.leaf = [lf.copy() for lf in trees.leaf]
-        trees.leaf[t] = (trees.leaf[t].astype(np.float64)
-                         + leaf_shift).astype(np.float32)
+        trees.leaf = list(trees.leaf)
+        lf = trees.leaf[t].astype(np.float64)
+        if leaf_flip:
+            lf = 1.0 - lf
+        trees.leaf[t] = (lf + leaf_shift).astype(np.float32)
     if not trees.is_split[t][0]:
         return b"\x00\xff\xff" + struct.pack(
             "<f", float(trees.leaf[t][0]))
@@ -112,14 +121,15 @@ def _encode_tree(trees, t: int, leaf_shift: float = 0.0) -> bytes:
 
 
 def write_mojo(model, path: str) -> str:
-    """Serialize a GBM model into the reference MOJO zip layout."""
+    """Serialize a GBM or DRF model into the reference MOJO zip layout."""
     from h2o3_tpu.models.tree.common import tree_feature_names
 
-    if model.algo_name != "gbm":
+    algo = model.algo_name
+    if algo not in ("gbm", "drf"):
         raise ValueError(
-            "reference-format MOJO export currently covers GBM; use the "
-            "native .mojo (models/mojo_export.py) or POJO codegen for "
-            f"{model.algo_name}")
+            "reference-format MOJO export currently covers GBM and DRF; "
+            "use the native .mojo (models/mojo_export.py) or POJO codegen "
+            f"for {algo}")
     if getattr(model.params, "offset_column", None):
         raise ValueError("reference-format MOJO export does not support "
                          "offset_column models")
@@ -147,10 +157,13 @@ def write_mojo(model, path: str) -> str:
     else:
         init_f = float(b.init_margin[0])
         category = "Regression"
+    if algo == "drf":
+        init_f = 0.0  # DRF trains from zero margin; DrfMojoModel has no init
 
     info = [
-        ("algorithm", "Gradient Boosting Machine"),
-        ("algo", "gbm"),
+        ("algorithm", "Gradient Boosting Machine" if algo == "gbm"
+         else "Distributed Random Forest"),
+        ("algo", algo),
         ("category", category),
         ("uuid", str(_uuid.uuid4())),
         ("supervised", "true" if supervised else "false"),
@@ -171,6 +184,14 @@ def write_mojo(model, path: str) -> str:
         ("link_function", _LINK_BY_DIST.get(dist, "identity")),
         ("init_f", repr(init_f)),
     ]
+    if algo == "drf":
+        info.append(("binomial_double_trees", "false"))
+    # mojo_version >= 1.40 readers call readkv("_genmodel_encoding")
+    # .toString() unconditionally (SharedTreeMojoReader.java:25-28)
+    enc = getattr(model, "tree_encoding", "label_encoder")
+    info.append(("_genmodel_encoding",
+                 "OneHotExplicit" if enc == "one_hot_explicit"
+                 else "LabelEncoder"))
     lines = ["[info]"]
     lines += [f"{k} = {v}" for k, v in info]
     lines.append("")
@@ -179,7 +200,9 @@ def write_mojo(model, path: str) -> str:
     lines.append("")
     lines.append("[domains]")
     for ci, (col, d) in enumerate(sorted(cat_domains.items())):
-        lines.append(f"{col}: d{ci:03d}.txt")
+        # reference parseModelDomains expects '<col>: <n_elements> <file>'
+        # (ModelMojoReader.java splits on space and parses the count)
+        lines.append(f"{col}: {len(d)} d{ci:03d}.txt")
 
     buf = io.BytesIO()
     with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
@@ -189,9 +212,15 @@ def write_mojo(model, path: str) -> str:
         for c, trees in enumerate(b.trees_per_class):
             for t in range(trees.ntrees):
                 shift = (float(b.init_margin[c])
-                         if (nclasses > 2 and t == 0) else 0.0)
+                         if (algo == "gbm" and nclasses > 2 and t == 0)
+                         else 0.0)
+                # DrfMojoModel's binomial preds[1] is the CLASS-0
+                # probability (preds[2] = 1 - preds[1]); our DRF trees
+                # predict p1 per tree, so leaves flip to 1 - p
+                flip = (algo == "drf" and nclasses == 2)
                 z.writestr(f"trees/t{c:02d}_{t:03d}.bin",
-                           _encode_tree(trees, t, leaf_shift=shift))
+                           _encode_tree(trees, t, leaf_shift=shift,
+                                        leaf_flip=flip))
     with open(path, "wb") as f:
         f.write(buf.getvalue())
     return path
@@ -257,14 +286,24 @@ class RefMojo:
                 return struct.unpack_from("<f", tree, pos)[0]
 
     def score0(self, row: np.ndarray) -> np.ndarray:
-        """GbmMojoModel.unifyPreds semantics over the decoded trees."""
+        """Gbm/DrfMojoModel.unifyPreds semantics over the decoded trees."""
         init_f = float(self.info.get("init_f", 0.0))
         dist = self.info.get("distribution", "gaussian")
         link = self.info.get("link_function", "identity")
+        algo = self.info.get("algo", "gbm")
         sums = np.array([
             np.sum([self.score_tree(t, row) for t in cls], dtype=np.float32)
             for cls in self.trees
         ], dtype=np.float64)
+        if algo == "drf":  # DrfMojoModel.unifyPreds
+            ntrees = int(self.info.get("n_trees", 1))
+            if self.nclasses == 1:
+                return np.array([sums[0] / ntrees])
+            if self.nclasses == 2:
+                p0 = sums[0] / ntrees  # trees carry CLASS-0 probability
+                return np.array([p0, 1.0 - p0])
+            total = sums.sum()
+            return sums / total if total > 0 else sums
         if dist == "bernoulli":
             f = sums[0] + init_f
             p1 = 1.0 / (1.0 + np.exp(-f))
@@ -298,8 +337,11 @@ def read_mojo(path: str) -> RefMojo:
             elif section == 2:
                 columns.append(line)
             elif section == 3:
-                ci, _, fname = line.partition(":")
-                domain_files[int(ci)] = fname.strip()
+                ci, _, rest = line.partition(":")
+                # '<col>: <n_elements> <file>' (count optional for
+                # tolerance with older writers)
+                toks = rest.split()
+                domain_files[int(ci)] = toks[-1]
         m.columns = columns
         for ci, fname in domain_files.items():
             m.domains[ci] = z.read(f"domains/{fname}").decode().splitlines()

@@ -97,6 +97,28 @@ class TestReferenceMojoParity:
         np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
 
 
+class TestDrfParity:
+    @pytest.mark.parametrize("nclass", [0, 2, 3])
+    def test_drf_families(self, rng, tmp_path, nclass):
+        from h2o3_tpu.models.tree.common import tree_matrix
+        from h2o3_tpu.models.tree.drf import DRF
+
+        fr = _frame(rng, nclass=nclass)
+        m = DRF(ntrees=6, max_depth=4, response_column="y", seed=7,
+                min_rows=2).train(fr)
+        path = str(tmp_path / f"drf_{nclass}.zip")
+        write_mojo(m, path)
+        mojo = read_mojo(path)
+        assert mojo.info["algo"] == "drf"
+        assert mojo.info["binomial_double_trees"] == "false"
+        X32 = tree_matrix(m.data_info, fr, encoding=m.tree_encoding)
+        got = _score_all(mojo, X32)
+        want = m._predict_raw(fr)
+        if nclass == 0:
+            got = got[:, 0]
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
 class TestContainerLayout:
     def test_zip_structure_matches_reference(self, rng, tmp_path):
         from h2o3_tpu.models.tree.gbm import GBM
@@ -129,13 +151,13 @@ class TestContainerLayout:
         m = RefMojo()
         assert m.score_tree(blob, np.zeros(3)) == 2.5
 
-    def test_non_gbm_refuses(self, rng):
-        from h2o3_tpu.models.tree.drf import DRF
+    def test_unsupported_algo_refuses(self, rng):
+        from h2o3_tpu.models.glm import GLM, GLMParameters
 
         fr = _frame(rng)
-        m = DRF(ntrees=3, max_depth=3, response_column="y", seed=5,
-                min_rows=2).train(fr)
-        with pytest.raises(ValueError, match="GBM"):
+        m = GLM(GLMParameters(response_column="y",
+                              family="binomial")).train(fr)
+        with pytest.raises(ValueError, match="GBM and DRF"):
             write_mojo(m, "/tmp/nope.zip")
 
 
