@@ -120,19 +120,117 @@ def _encode_tree(trees, t: int, leaf_shift: float = 0.0,
 # writer
 
 
+def _zip_write(path: str, ini_lines: List[str],
+               domain_texts: Dict[str, str],
+               blobs: Dict[str, bytes]) -> str:
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr("model.ini", "\n".join(ini_lines) + "\n")
+        for name, text in domain_texts.items():
+            z.writestr(name, text)
+        for name, blob in blobs.items():
+            z.writestr(name, blob)
+    with open(path, "wb") as f:
+        f.write(buf.getvalue())
+    return path
+
+
+def _write_glm_mojo(model, path: str) -> str:
+    """GLM in the reference layout (GLMMojoWriter.writeModelData /
+    GlmMojoModel.glmScore0): cats-first row layout, catOffsets into a
+    flat raw-scale beta, num block, intercept last."""
+    p = model.params
+    if p.family in ("multinomial", "ordinal"):
+        raise ValueError("reference-format GLM MOJO covers single-eta "
+                         "families only (not multinomial/ordinal)")
+    info_d = model.data_info
+    cats = [n for n in info_d.predictor_names if n in info_d.cat_domains]
+    nums = [n for n in info_d.predictor_names
+            if n not in info_d.cat_domains]
+    skip = 0 if info_d.use_all_factor_levels else 1
+    cat_offsets = [0]
+    beta: List[float] = []
+    for c in cats:
+        dom = info_d.cat_domains[c]
+        for lv in dom[skip:]:
+            beta.append(float(model.coefficients.get(f"{c}.{lv}", 0.0)))
+        cat_offsets.append(len(beta))
+    for n in nums:
+        beta.append(float(model.coefficients.get(n, 0.0)))
+    beta.append(float(model.coefficients.get("Intercept", 0.0)))
+
+    columns = cats + nums + [p.response_column]
+    dom_texts: Dict[str, str] = {}
+    dom_lines = []
+    di = 0
+    for ci, c in enumerate(cats):
+        dom = info_d.cat_domains[c]
+        dom_lines.append(f"{ci}: {len(dom)} d{di:03d}.txt")
+        dom_texts[f"domains/d{di:03d}.txt"] = "\n".join(dom) + "\n"
+        di += 1
+    rdom = info_d.response_domain
+    if rdom:
+        dom_lines.append(f"{len(columns) - 1}: {len(rdom)} d{di:03d}.txt")
+        dom_texts[f"domains/d{di:03d}.txt"] = "\n".join(rdom) + "\n"
+
+    nclasses = model.nclasses
+    category = ("Binomial" if nclasses == 2 else "Regression")
+    kv = [
+        ("algorithm", "Generalized Linear Model"),
+        ("algo", "glm"),
+        ("category", category),
+        ("uuid", str(_uuid.uuid4())),
+        ("supervised", "true"),
+        ("n_features", len(cats) + len(nums)),
+        ("n_classes", nclasses if nclasses > 1 else 1),
+        ("n_columns", len(columns)),
+        ("n_domains", len(dom_lines)),
+        ("balance_classes", "false"),
+        ("default_threshold", 0.5),
+        ("prior_class_distrib", "null"),
+        ("model_class_distrib", "null"),
+        ("offset_column", "null"),
+        ("mojo_version", "1.00"),
+        ("h2o_version", "h2o3-tpu"),
+        ("use_all_factor_levels",
+         "true" if info_d.use_all_factor_levels else "false"),
+        ("cats", len(cats)),
+        ("cat_modes", "[" + ", ".join(
+            str(info_d.cat_mode[c]) for c in cats) + "]"),
+        ("cat_offsets", "[" + ", ".join(map(str, cat_offsets)) + "]"),
+        ("nums", len(nums)),
+        ("num_means", "[" + ", ".join(
+            repr(info_d.num_means[n]) for n in nums) + "]"),
+        ("mean_imputation",
+         "true" if info_d.missing_values_handling == "mean_imputation"
+         else "false"),
+        ("beta", "[" + ", ".join(repr(b) for b in beta) + "]"),
+        ("family", p.family),
+        ("link", p.actual_link()),
+        ("tweedie_link_power", p.tweedie_link_power),
+    ]
+    lines = ["[info]"]
+    lines += [f"{k} = {v}" for k, v in kv]
+    lines += ["", "[columns]"] + columns + ["", "[domains]"] + dom_lines
+    return _zip_write(path, lines, dom_texts, {})
+
+
 def write_mojo(model, path: str) -> str:
-    """Serialize a GBM or DRF model into the reference MOJO zip layout."""
+    """Serialize a GBM, DRF or GLM model into the reference MOJO layout."""
     from h2o3_tpu.models.tree.common import tree_feature_names
 
     algo = model.algo_name
-    if algo not in ("gbm", "drf"):
-        raise ValueError(
-            "reference-format MOJO export currently covers GBM and DRF; "
-            "use the native .mojo (models/mojo_export.py) or POJO codegen "
-            f"for {algo}")
     if getattr(model.params, "offset_column", None):
+        # the format has no offset term; exporting would silently drop it
         raise ValueError("reference-format MOJO export does not support "
                          "offset_column models")
+    if algo == "glm":
+        return _write_glm_mojo(model, path)
+    if algo not in ("gbm", "drf"):
+        raise ValueError(
+            "reference-format MOJO export currently covers GBM, DRF and "
+            "GLM; use the native .mojo (models/mojo_export.py) or POJO "
+            f"codegen for {algo}")
     b = model.booster
     names = tree_feature_names(model.data_info, model.tree_encoding)
     dom = model.data_info.response_domain
@@ -204,26 +302,23 @@ def write_mojo(model, path: str) -> str:
         # (ModelMojoReader.java splits on space and parses the count)
         lines.append(f"{col}: {len(d)} d{ci:03d}.txt")
 
-    buf = io.BytesIO()
-    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
-        z.writestr("model.ini", "\n".join(lines) + "\n")
-        for ci, (col, d) in enumerate(sorted(cat_domains.items())):
-            z.writestr(f"domains/d{ci:03d}.txt", "\n".join(d) + "\n")
-        for c, trees in enumerate(b.trees_per_class):
-            for t in range(trees.ntrees):
-                shift = (float(b.init_margin[c])
-                         if (algo == "gbm" and nclasses > 2 and t == 0)
-                         else 0.0)
-                # DrfMojoModel's binomial preds[1] is the CLASS-0
-                # probability (preds[2] = 1 - preds[1]); our DRF trees
-                # predict p1 per tree, so leaves flip to 1 - p
-                flip = (algo == "drf" and nclasses == 2)
-                z.writestr(f"trees/t{c:02d}_{t:03d}.bin",
-                           _encode_tree(trees, t, leaf_shift=shift,
-                                        leaf_flip=flip))
-    with open(path, "wb") as f:
-        f.write(buf.getvalue())
-    return path
+    dom_texts = {
+        f"domains/d{ci:03d}.txt": "\n".join(d) + "\n"
+        for ci, (col, d) in enumerate(sorted(cat_domains.items()))
+    }
+    blobs: Dict[str, bytes] = {}
+    for c, trees in enumerate(b.trees_per_class):
+        for t in range(trees.ntrees):
+            shift = (float(b.init_margin[c])
+                     if (algo == "gbm" and nclasses > 2 and t == 0)
+                     else 0.0)
+            # DrfMojoModel's binomial preds[1] is the CLASS-0
+            # probability (preds[2] = 1 - preds[1]); our DRF trees
+            # predict p1 per tree, so leaves flip to 1 - p
+            flip = (algo == "drf" and nclasses == 2)
+            blobs[f"trees/t{c:02d}_{t:03d}.bin"] = _encode_tree(
+                trees, t, leaf_shift=shift, leaf_flip=flip)
+    return _zip_write(path, lines, dom_texts, blobs)
 
 
 # ---------------------------------------------------------------------------
@@ -285,12 +380,87 @@ class RefMojo:
             if lmask & 16:
                 return struct.unpack_from("<f", tree, pos)[0]
 
+    def _glm_arrays(self):
+        """Parse the GLM kv arrays ONCE and cache (score0 is per-row)."""
+        cached = getattr(self, "_glm_cache", None)
+        if cached is not None:
+            return cached
+
+        def arr(key, cast=float):
+            s = self.info[key].strip()
+            body = s[1:-1].strip()
+            return ([] if not body
+                    else [cast(x) for x in body.split(",")])
+
+        cached = {
+            "cats": int(self.info["cats"]),
+            "nums": int(self.info["nums"]),
+            "cat_offsets": arr("cat_offsets", int),
+            "beta": np.asarray(arr("beta"), np.float64),
+            "cat_modes": (arr("cat_modes", int)
+                          if "cat_modes" in self.info else []),
+            "num_means": (arr("num_means")
+                          if "num_means" in self.info else []),
+        }
+        self._glm_cache = cached
+        return cached
+
+    def _glm_score0(self, row: np.ndarray) -> np.ndarray:
+        """GlmMojoModelBase.score0 + GlmMojoModel.glmScore0: cats-first
+        row, mean imputation, catOffsets beta lookup, link inverse."""
+        g = self._glm_arrays()
+        cats, nums = g["cats"], g["nums"]
+        cat_offsets, beta = g["cat_offsets"], g["beta"]
+        data = np.asarray(row, np.float64).copy()
+        if self.info.get("mean_imputation") == "true":
+            for i in range(cats):
+                if np.isnan(data[i]):
+                    data[i] = g["cat_modes"][i]
+            for i in range(nums):
+                if np.isnan(data[cats + i]):
+                    data[cats + i] = g["num_means"][i]
+        eta = 0.0
+        use_all = self.info.get("use_all_factor_levels") == "true"
+        for i in range(cats):
+            # Java's (int) NaN is 0 — an unimputed NaN categorical maps
+            # to level 0 exactly like the reference runtime
+            iv = data[i]
+            ival = (0 if np.isnan(iv) else int(iv)) - (0 if use_all else 1)
+            if ival < 0:
+                continue
+            ival += cat_offsets[i]
+            if ival < cat_offsets[i + 1]:
+                eta += beta[ival]
+        noff = cat_offsets[cats] - cats
+        for i in range(cats, len(beta) - 1 - noff):
+            eta += beta[noff + i] * data[i]
+        eta += beta[-1]
+        link = self.info.get("link", "identity")
+        if link == "logit":
+            mu = 1.0 / (1.0 + np.exp(-eta))
+        elif link == "log":
+            mu = np.exp(eta)
+        elif link == "inverse":
+            d = eta if abs(eta) >= 1e-10 else (
+                1e-10 if eta + 1e-30 >= 0 else -1e-10)
+            mu = 1.0 / d
+        elif link == "tweedie":
+            lp = float(self.info.get("tweedie_link_power", 0.0))
+            mu = np.exp(eta) if lp == 0 else max(eta, 1e-10) ** (1.0 / lp)
+        else:
+            mu = eta
+        if self.info.get("family") in ("binomial", "quasibinomial"):
+            return np.array([1.0 - mu, mu])
+        return np.array([mu])
+
     def score0(self, row: np.ndarray) -> np.ndarray:
-        """Gbm/DrfMojoModel.unifyPreds semantics over the decoded trees."""
+        """Gbm/Drf/GlmMojoModel semantics over the decoded payload."""
+        algo = self.info.get("algo", "gbm")
+        if algo == "glm":  # no trees to walk
+            return self._glm_score0(row)
         init_f = float(self.info.get("init_f", 0.0))
         dist = self.info.get("distribution", "gaussian")
         link = self.info.get("link_function", "identity")
-        algo = self.info.get("algo", "gbm")
         sums = np.array([
             np.sum([self.score_tree(t, row) for t in cls], dtype=np.float32)
             for cls in self.trees

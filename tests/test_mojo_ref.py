@@ -152,12 +152,11 @@ class TestContainerLayout:
         assert m.score_tree(blob, np.zeros(3)) == 2.5
 
     def test_unsupported_algo_refuses(self, rng):
-        from h2o3_tpu.models.glm import GLM, GLMParameters
+        from h2o3_tpu.models.kmeans import KMeans, KMeansParameters
 
-        fr = _frame(rng)
-        m = GLM(GLMParameters(response_column="y",
-                              family="binomial")).train(fr)
-        with pytest.raises(ValueError, match="GBM and DRF"):
+        fr = _frame(rng).drop("y")
+        m = KMeans(KMeansParameters(k=3)).train(fr)
+        with pytest.raises(ValueError, match="GBM, DRF and"):
             write_mojo(m, "/tmp/nope.zip")
 
 
@@ -182,3 +181,68 @@ class TestRestExport:
                 assert any(n.startswith("trees/") for n in z.namelist())
         finally:
             s.stop()
+
+
+class TestGlmReferenceMojo:
+    def _cat_frame(self, rng, n=400):
+        X = rng.normal(size=(n, 2))
+        g = rng.integers(0, 3, size=n).astype(np.int32)
+        logit = X[:, 0] - X[:, 1] + 0.8 * (g == 2)
+        y = (logit + rng.normal(size=n) * 0.3 > 0).astype(np.int32)
+        fr = Frame([
+            Column("g", g, ColType.CAT, ["u", "v", "w"]),
+            Column("x0", X[:, 0]),
+            Column("x1", X[:, 1]),
+            Column("y", y, ColType.CAT, ["n", "p"]),
+        ])
+        xs = fr.col("x0").data
+        xs[rng.random(n) < 0.05] = np.nan
+        return fr
+
+    def test_binomial_with_categoricals(self, rng, tmp_path):
+        from h2o3_tpu.models.glm import GLM, GLMParameters
+
+        fr = self._cat_frame(rng)
+        m = GLM(GLMParameters(response_column="y",
+                              family="binomial")).train(fr)
+        path = str(tmp_path / "glm.zip")
+        write_mojo(m, path)
+        mojo = read_mojo(path)
+        assert mojo.info["algo"] == "glm"
+        assert mojo.info["family"] == "binomial"
+        # cats-first row layout: [g_code, x0, x1]
+        want = m._predict_raw(fr)
+        g = fr.col("g").data.astype(np.float64)
+        x0 = fr.col("x0").data
+        x1 = fr.col("x1").data
+        for i in range(0, fr.nrows, 17):
+            row = np.array([g[i], x0[i], x1[i]])
+            got = mojo.score0(row)
+            np.testing.assert_allclose(got, want[i], rtol=1e-8, atol=1e-10)
+
+    def test_gamma_regression(self, rng, tmp_path):
+        from h2o3_tpu.models.glm import GLM, GLMParameters
+
+        fr = self._cat_frame(rng)
+        y = np.exp(np.clip(fr.col("x0").numeric_view(), -2, 2)) + 0.1
+        fr = fr.drop("y").add_column(Column("y", y))
+        m = GLM(GLMParameters(response_column="y", family="gamma")).train(fr)
+        path = str(tmp_path / "glm_gamma.zip")
+        write_mojo(m, path)
+        mojo = read_mojo(path)
+        want = m._predict_raw(fr)
+        g = fr.col("g").data.astype(np.float64)
+        x0 = fr.col("x0").data
+        x1 = fr.col("x1").data
+        for i in range(0, fr.nrows, 23):
+            got = mojo.score0(np.array([g[i], x0[i], x1[i]]))
+            np.testing.assert_allclose(got[0], want[i], rtol=1e-8)
+
+    def test_multinomial_glm_refuses(self, rng, tmp_path):
+        from h2o3_tpu.models.glm import GLM, GLMParameters
+
+        fr = _frame(rng, nclass=3)
+        m = GLM(GLMParameters(response_column="y",
+                              family="multinomial")).train(fr)
+        with pytest.raises(ValueError, match="single-eta"):
+            write_mojo(m, str(tmp_path / "x.zip"))
