@@ -291,6 +291,17 @@ def export_file(frame_or_id, path: str, force: bool = False) -> str:
     return out["path"]
 
 
+def download_mojo(model_or_id, path: str, format: str = "native") -> str:
+    """Save a model's MOJO archive locally (h2o.download_mojo).
+    format='reference' emits the actual H2O-3 MOJO zip layout."""
+    from h2o3_tpu.client.estimators import H2OModel
+
+    # one implementation: the model method owns the endpoint + directory
+    # handling; the module function just resolves the id
+    m = H2OModel(connection(), _key_of(model_or_id))
+    return m.download_mojo(path, format=format)
+
+
 def download_pojo(model_or_id, lang: str = "java") -> str:
     """Standalone scoring source (h2o.download_pojo -> /3/Models.java)."""
     out = connection().request(

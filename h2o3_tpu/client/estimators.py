@@ -79,10 +79,15 @@ class H2OModel:
         )
         return out["model_metrics"][0]
 
-    def download_mojo(self, path: str) -> str:
+    def download_mojo(self, path: str, format: str = "native") -> str:
+        """format='reference' emits the actual H2O-3 MOJO zip layout."""
         import os
+        import urllib.parse
 
-        raw = self._conn.request(f"GET /3/Models/{self.model_id}/mojo", raw=True)
+        raw = self._conn.request(
+            f"GET /3/Models/{urllib.parse.quote(self.model_id, safe='')}"
+            f"/mojo?format={urllib.parse.quote(format, safe='')}",
+            raw=True)
         if os.path.isdir(path):  # h2o-py accepts a target directory
             path = os.path.join(path, f"{self.model_id}.mojo")
         with open(path, "wb") as f:

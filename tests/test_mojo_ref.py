@@ -246,3 +246,29 @@ class TestGlmReferenceMojo:
                               family="multinomial")).train(fr)
         with pytest.raises(ValueError, match="single-eta"):
             write_mojo(m, str(tmp_path / "x.zip"))
+
+
+class TestClientDownloadMojo:
+    def test_both_formats(self, rng, tmp_path):
+        from h2o3_tpu import client as h2o
+        from h2o3_tpu.api import start_server
+        from h2o3_tpu.models.tree.gbm import GBM
+
+        fr = _frame(rng)
+        m = GBM(ntrees=3, max_depth=3, response_column="y", seed=8,
+                min_rows=2).train(fr)
+        s = start_server(port=0)
+        try:
+            h2o.connect(s.url)
+            ref = h2o.download_mojo(m, str(tmp_path / "ref.zip"),
+                                    format="reference")
+            with zipfile.ZipFile(ref) as z:
+                assert "model.ini" in z.namelist()
+            nat = h2o.download_mojo(m, str(tmp_path / "nat.mojo"))
+            from h2o3_tpu.genmodel import load_mojo
+
+            scorer = load_mojo(nat)
+            assert scorer is not None
+        finally:
+            h2o.shutdown()  # reset the module connection for later tests
+            s.stop()
