@@ -135,9 +135,13 @@ def _worker() -> None:
     f0 = init_margin("bernoulli", y, 1)
 
     # warmup run at full shape: compiles the training-block executable(s);
-    # the timed run below hits the jit cache
+    # the timed run below hits the jit cache.  A DIFFERENT seed keeps every
+    # warmup device execution's input values distinct from the timed run's
+    # (the axon relay must never be able to serve a timed step from any
+    # cache of already-executed identical computations).
+    from dataclasses import replace as _dc_replace
     t0 = time.time()
-    train_boosted(X, "bernoulli", y, 1, f0, params)
+    train_boosted(X, "bernoulli", y, 1, f0, _dc_replace(params, seed=12345))
     warmup_s = time.time() - t0
     print(f"# warmup done in {warmup_s:.1f}s", file=sys.stderr)
 

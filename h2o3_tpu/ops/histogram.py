@@ -109,18 +109,17 @@ def _shard_histogram(bins, nodes, g, h, n_nodes: int, n_bins1: int, rw=None):
     flat = (node[:, None] * F + jnp.arange(F, dtype=jnp.int32)[None, :]) * n_bins1 + bins
     w = valid.astype(g.dtype)
     cw = w if rw is None else w * rw
-    # channel-major layout: the long N*F axis must be the (128-)lane axis —
-    # a [N*F, 3] layout would pad 3 lanes to 128 on TPU (≈42x HBM blowup)
-    vals = jnp.stack(
-        [
-            jnp.broadcast_to((g * w)[:, None], (n, F)),
-            jnp.broadcast_to((h * w)[:, None], (n, F)),
-            jnp.broadcast_to(cw[:, None], (n, F)),
-        ],
-        axis=0,
-    )  # [3, n, F]
-    hist = jnp.zeros((3, n_nodes * F * n_bins1), g.dtype)
-    hist = hist.at[:, flat.reshape(-1)].add(vals.reshape(3, -1))
+    # one 1-D scatter per channel: scatter updates must stay 1-D — any
+    # [N*F, 3] (or batched [3, N*F]) update tensor gets canonicalized by
+    # XLA:TPU into a copy whose 3-lane axis pads to 128 (≈42x HBM blowup;
+    # observed as a 28.6 GB allocation at N=2M, F=28)
+    flat = flat.reshape(-1)
+    size = n_nodes * F * n_bins1
+    chans = []
+    for v in (g * w, h * w, cw):
+        upd = jnp.broadcast_to(v[:, None], (n, F)).reshape(-1)
+        chans.append(jnp.zeros(size, g.dtype).at[flat].add(upd))
+    hist = jnp.stack(chans, axis=0)
     return jnp.moveaxis(hist.reshape(3, n_nodes, F, n_bins1), 0, -1)
 
 
@@ -182,7 +181,8 @@ def _hist_impl(impl: Optional[str]) -> str:
 
 
 def _one_shard_histogram(
-    bins, nodes, g, h, n_nodes, n_bins1, impl, vma=(), bins_fm=None, rw=None
+    bins, nodes, g, h, n_nodes, n_bins1, impl, vma=(), bins_fm=None, rw=None,
+    dtype="auto",
 ):
     if impl == "pallas":
         from h2o3_tpu.ops.pallas_histogram import build_histogram_pallas
@@ -190,7 +190,7 @@ def _one_shard_histogram(
         return build_histogram_pallas(
             bins, nodes, g, h, n_nodes, n_bins1,
             interpret=jax.default_backend() != "tpu", vma=vma, bins_fm=bins_fm,
-            rw=rw,
+            rw=rw, dtype=dtype,
         )
     return _shard_histogram(bins, nodes, g, h, n_nodes, n_bins1, rw=rw)
 
@@ -208,20 +208,35 @@ def build_histogram_sharded(
     per-row count weight (weights_column: the count channel reports Σw).
     Returns replicated [n_nodes, F, n_bins1, 3].
     """
-    # resolve the env override OUTSIDE the jit cache so changing it between
-    # calls takes effect (the resolved impl is the static cache key)
+    # resolve the env overrides OUTSIDE the jit cache so changing them
+    # between calls takes effect (the resolved values are static cache keys);
+    # the scatter impl ignores dtype — pin it so flipping the dtype env var
+    # neither recompiles nor (if invalid) breaks the path that never reads it
+    impl = _hist_impl(impl)
+    if impl == "pallas":
+        from h2o3_tpu.ops.pallas_histogram import _resolve_hist_dtype
+
+        dtype = (
+            "bf16" if _resolve_hist_dtype("auto") == jnp.bfloat16 else "f32"
+        )
+    else:
+        dtype = "f32"
     return _build_histogram_jit(
-        bins, nodes, g, h, bins_fm, rw, n_nodes, n_bins1, mesh, _hist_impl(impl)
+        bins, nodes, g, h, bins_fm, rw, n_nodes, n_bins1, mesh, impl, dtype
     )
 
 
-@partial(jax.jit, static_argnames=("n_nodes", "n_bins1", "mesh", "impl"))
+@partial(
+    jax.jit, static_argnames=("n_nodes", "n_bins1", "mesh", "impl", "dtype")
+)
 def _build_histogram_jit(
-    bins, nodes, g, h, bins_fm, rw, n_nodes: int, n_bins1: int, mesh, impl: str
+    bins, nodes, g, h, bins_fm, rw, n_nodes: int, n_bins1: int, mesh,
+    impl: str, dtype: str = "auto",
 ):
     if mesh is None:
         return _one_shard_histogram(
-            bins, nodes, g, h, n_nodes, n_bins1, impl, bins_fm=bins_fm, rw=rw
+            bins, nodes, g, h, n_nodes, n_bins1, impl, bins_fm=bins_fm, rw=rw,
+            dtype=dtype,
         )
 
     # optional row-sharded / feature-major extras enter the shard_map only
@@ -235,7 +250,8 @@ def _build_histogram_jit(
     def fn(b, nd, gg, hh, *rest):
         kw = dict(zip([name for name, _, _ in extras], rest))
         part = _one_shard_histogram(
-            b, nd, gg, hh, n_nodes, n_bins1, impl, vma=(DATA_AXIS,), **kw
+            b, nd, gg, hh, n_nodes, n_bins1, impl, vma=(DATA_AXIS,),
+            dtype=dtype, **kw
         )
         return jax.lax.psum(part, DATA_AXIS)
 

@@ -83,6 +83,7 @@ def _nm_kernel(
     """
     r = node_ref.shape[0]
     rt = pl.program_id(1)
+    dtype = vals_ref.dtype
 
     # [Fb*B1, R] one-hot of bin codes, written per-feature into a VMEM
     # scratch: each 2-D compare pairs a lane-splat ([B1, 1] iota constant)
@@ -92,9 +93,12 @@ def _nm_kernel(
     binsb = bins_ref[...].astype(jnp.float32)  # [Fb, R] (tiny)
     jm = jmod_ref[...]  # [B1, 1] f32 iota constant
     for f in range(n_feat_b):
+        # compare in f32 (codes <= 256 exact); the 0/1 mask is stored at
+        # the histogram dtype — in bf16 mode this halves the dominant
+        # VMEM write traffic of the whole kernel, losslessly (0/1 exact)
         oh_ref[f * n_bins1 : (f + 1) * n_bins1, :] = (
             jm == binsb[f][None, :]
-        ).astype(jnp.float32)
+        ).astype(dtype)
     onehot = oh_ref[...]
 
     # [R, K*C] node-masked values in ~ONE VPU pass: lane j carries node
@@ -108,10 +112,10 @@ def _nm_kernel(
     iota_kc = jax.lax.broadcasted_iota(jnp.int32, (r, kc), 1)
     m_node = (iota_kc // _C) == node  # node<0 never matches
     tiled = jnp.concatenate([vals] * n_nodes, axis=1)  # [R, K*C]
-    vals_k = jnp.where(m_node, tiled, 0.0)
-
+    vals_k = jnp.where(m_node, tiled, jnp.zeros((), dtype))
 
     # [K*C, Fb*B1] = vals_kᵀ ⊗ onehotᵀ — contraction over rows on the MXU
+    # (bf16 operands run at 2x the f32 MXU rate; accumulation stays f32)
     slab = jax.lax.dot_general(
         vals_k, onehot, (((0,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -129,7 +133,7 @@ def _nm_kernel(
 def _build_histogram_nodematmul(
     bins, nodes, g, h, n_nodes: int, n_bins1: int,
     row_tile: int, feat_block: int, interpret: bool, vma: tuple,
-    bins_fm=None, rw=None,
+    bins_fm=None, rw=None, dtype=jnp.float32,
 ):
     n, n_feat = bins.shape
     r = row_tile
@@ -158,7 +162,7 @@ def _build_histogram_nodematmul(
     vals = jnp.stack(
         [g.astype(jnp.float32) * w, h.astype(jnp.float32) * w, cw, jnp.zeros_like(w)],
         axis=1,
-    )  # [N, C]
+    ).astype(dtype)  # [N, C]; bf16 mode rounds inputs, accumulates f32
 
     n_ftiles = n_feat_p // fb
     n_rtiles = n // r
@@ -177,7 +181,7 @@ def _build_histogram_nodematmul(
             pl.BlockSpec((r, 1), lambda f, t: (t, 0)),
             pl.BlockSpec((r, _C), lambda f, t: (t, 0)),
         ],
-        scratch_shapes=[pltpu.VMEM((fb * n_bins1, r), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((fb * n_bins1, r), dtype)],
         out_specs=pl.BlockSpec(
             (1, n_nodes * _C, fb * n_bins1), lambda f, t: (f, 0, 0)
         ),
@@ -210,12 +214,12 @@ def _hist_kernel(node_ref, first_ref, bins_ref, vals_ref, out_ref, *, n_feat, n_
     t = pl.program_id(0)
     r = bins_ref.shape[0]
     iota_b = jax.lax.broadcasted_iota(jnp.int32, (r, n_bins1), 1)
-    vals = vals_ref[:]  # [R, C]
+    vals = vals_ref[:]  # [R, C]; bf16 mode: both matmul operands bf16
 
     slabs = []
     for f in range(n_feat):
         b = bins_ref[:, f]
-        onehot = (iota_b == b[:, None]).astype(jnp.float32)  # [R, B1]
+        onehot = (iota_b == b[:, None]).astype(vals.dtype)  # [R, B1]
         # [C, B1] = valsᵀ[C, R] @ onehot[R, B1]  (contraction over rows)
         h_f = jax.lax.dot_general(
             vals, onehot, (((0,), (0,)), ((), ())),
@@ -235,7 +239,8 @@ def _hist_kernel(node_ref, first_ref, bins_ref, vals_ref, out_ref, *, n_feat, n_
         out_ref[...] = out_ref[...] + slab
 
 
-def _prep_padded(bins, nodes, g, h, n_nodes: int, row_tile: int, t_max: int, rw=None):
+def _prep_padded(bins, nodes, g, h, n_nodes: int, row_tile: int, t_max: int,
+                 rw=None, dtype=jnp.float32):
     """Sort rows by node, pad each node segment to a row_tile multiple.
 
     Returns (bins_p [T*R, F] int32, vals_p [T*R, C] f32,
@@ -270,8 +275,8 @@ def _prep_padded(bins, nodes, g, h, n_nodes: int, row_tile: int, t_max: int, rw=
     vals = jnp.stack(
         [g.astype(jnp.float32) * w, h.astype(jnp.float32) * w, cw,
          jnp.zeros_like(w)], axis=1
-    )
-    vals_p = jnp.zeros((total, _C), jnp.float32).at[dest].set(vals[order], mode="drop")
+    ).astype(dtype)
+    vals_p = jnp.zeros((total, _C), dtype).at[dest].set(vals[order], mode="drop")
 
     # tile t belongs to the node whose padded segment contains row t*r
     tile_starts = jnp.arange(t_max) * r
@@ -284,22 +289,59 @@ def _prep_padded(bins, nodes, g, h, n_nodes: int, row_tile: int, t_max: int, rw=
     return bins_p, vals_p, item_node, item_first
 
 
-@partial(
-    jax.jit,
-    static_argnames=("n_nodes", "n_bins1", "row_tile", "interpret", "vma", "kernel"),
-)
+def _resolve_hist_dtype(dtype: str):
+    """'auto' -> env H2O3_TPU_HIST_DTYPE, else bf16 on real TPU (2x MXU
+    rate, halved VMEM traffic; accumulation is always f32) and f32
+    elsewhere (the CPU interpreter path doubles as the exact-parity
+    oracle)."""
+    import os
+
+    if dtype == "auto":
+        dtype = os.environ.get("H2O3_TPU_HIST_DTYPE") or (
+            "bf16" if jax.default_backend() == "tpu" else "f32"
+        )
+    if dtype not in ("f32", "bf16"):
+        raise ValueError(f"hist dtype must be 'f32' or 'bf16', got {dtype!r}")
+    return jnp.bfloat16 if dtype == "bf16" else jnp.float32
+
+
 def build_histogram_pallas(
     bins, nodes, g, h, n_nodes: int, n_bins1: int,
     row_tile: int = None, interpret: bool = False, vma: tuple = (),
-    kernel: str = "auto", bins_fm=None, rw=None,
+    kernel: str = "auto", bins_fm=None, rw=None, dtype: str = "auto",
 ):
     """Drop-in Pallas replacement for ``histogram._shard_histogram``.
 
     bins: [N, F] int bin codes (NA bucket = n_bins1 - 1 handled upstream);
     nodes: [N] int32 (-1 = inactive row); g, h: [N] float; rw: optional [N]
     per-row count weight (weights_column -> the count channel reports Σw).
+    dtype: 'f32' | 'bf16' | 'auto' — matmul operand precision (the one-hot
+    mask is exact either way; bf16 rounds g/h/w inputs to 8 mantissa bits,
+    accumulation stays f32).
     Returns [n_nodes, F, n_bins1, 3] float32 of (Σg, Σh, Σw).
     """
+    # resolve the env-var default OUTSIDE the jit boundary: a cached trace
+    # must never pin a stale H2O3_TPU_HIST_DTYPE (when already inside a
+    # trace — called from _build_histogram_jit — dtype arrives pre-resolved)
+    if dtype == "auto":
+        dtype = "bf16" if _resolve_hist_dtype("auto") == jnp.bfloat16 else "f32"
+    return _build_histogram_pallas_jit(
+        bins, nodes, g, h, n_nodes, n_bins1, row_tile, interpret,
+        vma, kernel, bins_fm, rw, dtype,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "n_nodes", "n_bins1", "row_tile", "interpret", "vma", "kernel", "dtype"
+    ),
+)
+def _build_histogram_pallas_jit(
+    bins, nodes, g, h, n_nodes: int, n_bins1: int,
+    row_tile, interpret: bool, vma: tuple,
+    kernel: str, bins_fm, rw, dtype: str,
+):
     if kernel == "nodematmul" or (
         kernel == "auto" and n_nodes * _C <= _NODE_MATMUL_MAX_KC
     ):
@@ -307,13 +349,15 @@ def build_histogram_pallas(
             bins, nodes, g, h, n_nodes, n_bins1,
             row_tile=row_tile or _ROW_TILE, feat_block=_FEAT_BLOCK,
             interpret=interpret, vma=vma, bins_fm=bins_fm, rw=rw,
+            dtype=_resolve_hist_dtype(dtype),
         )
     n, n_feat = bins.shape
     r = row_tile or 512  # sorted kernel keeps its original tile height
     t_max = (n + r - 1) // r + n_nodes  # ≤ R-1 pad rows per node
 
     bins_p, vals_p, item_node, item_first = _prep_padded(
-        bins, nodes, g, h, n_nodes, r, t_max, rw=rw
+        bins, nodes, g, h, n_nodes, r, t_max, rw=rw,
+        dtype=_resolve_hist_dtype(dtype),
     )
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
