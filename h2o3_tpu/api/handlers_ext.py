@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import json
 import os
+import zipfile
 from typing import Any, Dict, List
 
 import numpy as np
@@ -286,3 +287,89 @@ def register(r: RequestServer, server: H2OServer) -> None:  # noqa: C901
                "fit a munging pipeline")
     r.register("GET", "/99/Assembly.java/{assembly_id}/{pojo_name}",
                assembly_java, "assembly as standalone java munger")
+
+    # ---- scoring pipeline (mojo-pipeline extension analogue) ---------------
+    def pipeline_build(params):
+        """Assemble a ScoringPipeline from a trained model and/or a fitted
+        Assembly (hex/mojopipeline — ours builds its own artifact instead
+        of loading DriverlessAI MOJO2)."""
+        from h2o3_tpu.models.assembly import Assembly
+        from h2o3_tpu.models.pipeline import build_pipeline
+
+        model = None
+        if params.get("model"):
+            model = _get_model(params["model"])
+        asm = None
+        if params.get("assembly"):
+            asm = DKV.get(params["assembly"])
+            if not isinstance(asm, Assembly):
+                raise RestError(404, f"no assembly {params['assembly']!r}")
+        try:
+            pipe = build_pipeline(model=model, assembly=asm)
+        except ValueError as e:
+            raise RestError(400, str(e))
+        return {"pipeline": {"name": pipe.key},
+                "in_names": pipe.in_names,
+                "has_model": pipe.mojo_bytes is not None}
+
+    def _get_pipeline(key: str):
+        from h2o3_tpu.models.pipeline import ScoringPipeline
+
+        pipe = DKV.get(key)
+        if not isinstance(pipe, ScoringPipeline):
+            raise RestError(404, f"no pipeline {key!r}")
+        return pipe
+
+    def pipeline_fetch(params, pipeline_id):
+        """Download the pipeline artifact zip."""
+        return _get_pipeline(pipeline_id).to_bytes()
+
+    def pipeline_import(params):
+        """Import an artifact from a server-side path or a base64 body."""
+        import base64
+
+        from h2o3_tpu.models.pipeline import ScoringPipeline
+
+        if params.get("path"):
+            try:
+                with open(params["path"], "rb") as f:
+                    data = f.read()
+            except OSError as e:
+                raise RestError(400, f"cannot read {params['path']!r}: {e}")
+        elif params.get("data"):
+            try:
+                data = base64.b64decode(params["data"])
+            except Exception:
+                raise RestError(400, "data is not valid base64")
+        else:
+            raise RestError(400, "path or data (base64 zip) required")
+        try:
+            pipe = ScoringPipeline.from_bytes(data)
+        except (ValueError, zipfile.BadZipFile, json.JSONDecodeError) as e:
+            raise RestError(400, f"bad pipeline artifact: {e}")
+        pipe.key = params.get("destination_key") or DKV.make_key("pipeline")
+        DKV.put(pipe.key, pipe)
+        return {"pipeline": {"name": pipe.key}, "in_names": pipe.in_names,
+                "has_model": pipe.mojo_bytes is not None}
+
+    def pipeline_transform(params):
+        """Run the pipeline on a frame (MojoPipeline.transform)."""
+        pipe = _get_pipeline(params.get("pipeline", ""))
+        fr = _get_frame(params.get("frame", params.get("frame_id", "")))
+        try:
+            out = pipe.transform(fr)
+        except ValueError as e:
+            raise RestError(400, str(e))
+        dest = params.get("destination_frame") or DKV.make_key("pipe_out")
+        out.key = dest
+        DKV.put(dest, out)
+        return {"result": {"name": dest}, "names": out.names}
+
+    r.register("POST", "/99/PipelineMojo", pipeline_build,
+               "build a scoring pipeline from model + assembly")
+    r.register("GET", "/99/PipelineMojo.fetch/{pipeline_id}", pipeline_fetch,
+               "download the pipeline artifact")
+    r.register("POST", "/99/PipelineMojo.import", pipeline_import,
+               "import a pipeline artifact")
+    r.register("POST", "/99/PipelineMojo.transform", pipeline_transform,
+               "transform a frame through a pipeline")

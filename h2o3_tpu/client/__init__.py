@@ -17,6 +17,7 @@ Usage::
 
 from __future__ import annotations
 
+import os
 from typing import Any, Dict, List, Optional
 
 from h2o3_tpu.client.connection import H2OConnection, H2OResponseError
@@ -340,3 +341,59 @@ class H2OAutoML:
     @property
     def leaderboard(self) -> List[Dict[str, Any]]:
         return self._leaderboard
+
+
+# -- scoring pipelines (mojo-pipeline extension analogue) --------------------
+
+
+def build_pipeline(model_or_id=None, assembly_id: Optional[str] = None) -> str:
+    """Build a server-side ScoringPipeline from a trained model and/or a
+    fitted assembly; returns the pipeline key (hex/mojopipeline analogue)."""
+    body: Dict[str, Any] = {}
+    if model_or_id is not None:
+        body["model"] = _key_of(model_or_id)
+    if assembly_id:
+        body["assembly"] = assembly_id
+    out = connection().request("POST /99/PipelineMojo", body)
+    return out["pipeline"]["name"]
+
+
+def download_pipeline(pipeline_id: str, path: str) -> str:
+    """Save a pipeline artifact zip locally."""
+    data = connection().request(
+        f"GET /99/PipelineMojo.fetch/{pipeline_id}", raw=True)
+    if os.path.isdir(path):
+        path = os.path.join(path, f"{pipeline_id}.zip")
+    with open(path, "wb") as f:
+        f.write(data if isinstance(data, bytes) else data.encode())
+    return path
+
+
+def import_pipeline(path: Optional[str] = None,
+                    data: Optional[bytes] = None,
+                    pipeline_id: Optional[str] = None) -> str:
+    """Import a pipeline artifact from a LOCAL file (uploaded as base64)
+    or raw bytes; returns the new pipeline key."""
+    import base64
+
+    if data is None:
+        if path is None:
+            raise ValueError("path or data required")
+        with open(path, "rb") as f:
+            data = f.read()
+    body = {"data": base64.b64encode(data).decode()}
+    if pipeline_id:
+        body["destination_key"] = pipeline_id
+    out = connection().request("POST /99/PipelineMojo.import", body)
+    return out["pipeline"]["name"]
+
+
+def pipeline_transform(pipeline_id: str, frame_or_id,
+                       destination_frame: Optional[str] = None) -> "H2OFrame":
+    """Run a frame through a pipeline; returns the result frame
+    (MojoPipeline.transform)."""
+    body = {"pipeline": pipeline_id, "frame": _key_of(frame_or_id)}
+    if destination_frame:
+        body["destination_frame"] = destination_frame
+    out = connection().request("POST /99/PipelineMojo.transform", body)
+    return get_frame(out["result"]["name"])

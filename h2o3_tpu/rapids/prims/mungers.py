@@ -559,3 +559,23 @@ def dropdup(env, args):
     bounds = np.append(starts, fr.nrows)
     picks = order[starts] if keep == "first" else order[bounds[1:] - 1]
     return Val.frame(fr.rows(np.sort(picks)))
+
+
+@prim("mojo.pipeline.transform")
+def mojo_pipeline_transform(env, args):
+    """(mojo.pipeline.transform pipeline frame allowTimestamps) — score a
+    frame through a ScoringPipeline (rapids/AstPipelineTransform.java; the
+    allowTimestamps flag is accepted for signature parity — this build's
+    pipelines carry time columns as numerics, so nothing is gated on it)."""
+    from h2o3_tpu.keyed import DKV
+    from h2o3_tpu.models.pipeline import ScoringPipeline
+
+    key = args[0].as_str()
+    pipe = DKV.get(key)
+    if not isinstance(pipe, ScoringPipeline):
+        raise RapidsError(f"no pipeline {key!r}")
+    fr = args[1].as_frame()
+    try:
+        return Val.frame(pipe.transform(fr))
+    except ValueError as e:
+        raise RapidsError(str(e))

@@ -1,0 +1,202 @@
+"""Scoring pipeline (mojo-pipeline extension analogue): build from
+assembly + model, portable zip artifact, offline reload, REST routes,
+rapids verb, client functions.
+
+Reference: ``h2o-extensions/mojo-pipeline/.../MojoPipeline.java``
+(transform + strict adaptFrame), ``rapids/AstPipelineTransform.java``
+(``mojo.pipeline.transform``)."""
+
+import base64
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.api import start_server
+
+pytestmark = pytest.mark.leaks_keys
+
+rng0 = np.random.default_rng(7)
+CSV = "x0,x1,y\n" + "\n".join(
+    f"{a:.4f},{b:.4f},{'yes' if a + b > 0 else 'no'}"
+    for a, b in rng0.normal(size=(400, 2))
+)
+
+
+@pytest.fixture(scope="module")
+def server():
+    s = start_server(port=0)
+    yield s
+    s.stop()
+
+
+def _req(server, method, path, data=None, raw=False):
+    body = json.dumps(data).encode() if data is not None else None
+    req = urllib.request.Request(
+        server.url + path, data=body,
+        headers={"Content-Type": "application/json"} if body else {},
+        method=method)
+    try:
+        with urllib.request.urlopen(req) as resp:
+            payload = resp.read()
+            return resp.status, payload if raw else json.loads(payload)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+@pytest.fixture(scope="module")
+def trained(server):
+    """Parsed frame + fitted assembly (log-feature) + GBM on the munged
+    frame; returns (frame_id, assembly_key, model_id, munged_id)."""
+    st, up = _req(server, "POST", "/3/PostFile", {"data": CSV})
+    assert st == 200
+    st, out = _req(server, "POST", "/3/Parse",
+                   {"source_frames": [up["destination_frame"]],
+                    "destination_frame": "pipe_train"})
+    assert st == 200, out
+    steps = [
+        {"op": "BinaryOp", "fun": "*", "left": "x0", "right": "x1",
+         "new_col_name": "x0x1"},
+    ]
+    st, out = _req(server, "POST", "/99/Assembly",
+                   {"frame": "pipe_train", "steps": steps,
+                    "destination_frame": "pipe_munged"})
+    assert st == 200, out
+    asm_key = out["assembly"]["name"]
+    st, out = _req(server, "POST", "/3/ModelBuilders/gbm",
+                   {"training_frame": "pipe_munged", "response_column": "y",
+                    "ntrees": 8, "max_depth": 3, "seed": 1, "min_rows": 3,
+                    "model_id": "pipe_gbm"})
+    assert st == 200, out
+    return "pipe_train", asm_key, "pipe_gbm", "pipe_munged"
+
+
+def test_build_transform_parity(server, trained):
+    frame_id, asm_key, model_id, munged_id = trained
+    st, out = _req(server, "POST", "/99/PipelineMojo",
+                   {"model": model_id, "assembly": asm_key})
+    assert st == 200, out
+    pipe_key = out["pipeline"]["name"]
+    assert out["has_model"] and "x0" in out["in_names"]
+
+    # pipeline(raw frame) == predict(munged frame)
+    st, out = _req(server, "POST", "/99/PipelineMojo.transform",
+                   {"pipeline": pipe_key, "frame": frame_id,
+                    "destination_frame": "pipe_pred"})
+    assert st == 200, out
+    assert out["names"][0] == "predict"
+    st, pf = _req(server, "GET",
+                  "/3/Frames/pipe_pred/columns/pyes/summary")
+    assert st == 200
+    st, direct = _req(server, "POST",
+                      f"/3/Predictions/models/{model_id}/frames/{munged_id}",
+                      {"predictions_frame": "direct_pred"})
+    assert st == 200, direct
+    st, df = _req(server, "GET",
+                  "/3/Frames/direct_pred/columns/pyes/summary")
+    assert st == 200
+    a = pf["frames"][0]["columns"][0]
+    b = df["frames"][0]["columns"][0]
+    assert a["mean"] == pytest.approx(b["mean"], rel=1e-5)
+
+
+def test_artifact_roundtrip_offline(server, trained, tmp_path):
+    """Download the zip, load it OUTSIDE the server (ScoringPipeline.load),
+    and score rows without any cluster objects."""
+    frame_id, asm_key, model_id, _ = trained
+    st, out = _req(server, "POST", "/99/PipelineMojo",
+                   {"model": model_id, "assembly": asm_key})
+    assert st == 200
+    pipe_key = out["pipeline"]["name"]
+    st, blob = _req(server, "GET", f"/99/PipelineMojo.fetch/{pipe_key}",
+                    raw=True)
+    assert st == 200 and isinstance(blob, bytes) and blob[:2] == b"PK"
+    path = os.path.join(tmp_path, "pipe.zip")
+    with open(path, "wb") as f:
+        f.write(blob)
+
+    from h2o3_tpu.frame.frame import ColType, Column, Frame
+    from h2o3_tpu.models.pipeline import ScoringPipeline
+
+    pipe = ScoringPipeline.load(path)
+    assert pipe.steps and pipe.mojo_bytes
+    x0 = rng0.normal(size=50)
+    x1 = rng0.normal(size=50)
+    fr = Frame([Column("x0", x0, ColType.NUM),
+                Column("x1", x1, ColType.NUM)])
+    out_fr = pipe.transform(fr)
+    assert out_fr.names[0] == "predict"
+    probs = out_fr.col("pyes").numeric_view()
+    assert probs.shape == (50,) and np.all((probs >= 0) & (probs <= 1))
+
+    # strict adaptFrame: missing input column must raise
+    with pytest.raises(ValueError, match="missing a column: x1"):
+        pipe.transform(Frame([Column("x0", x0, ColType.NUM)]))
+
+
+def test_import_and_rapids_verb(server, trained, tmp_path):
+    frame_id, asm_key, model_id, _ = trained
+    st, out = _req(server, "POST", "/99/PipelineMojo",
+                   {"model": model_id, "assembly": asm_key})
+    assert st == 200
+    st, blob = _req(server, "GET",
+                    f"/99/PipelineMojo.fetch/{out['pipeline']['name']}",
+                    raw=True)
+    assert st == 200
+
+    # import the artifact back under a fresh key (base64 body)
+    st, imp = _req(server, "POST", "/99/PipelineMojo.import",
+                   {"data": base64.b64encode(blob).decode(),
+                    "destination_key": "pipe_imported"})
+    assert st == 200, imp
+    assert imp["pipeline"]["name"] == "pipe_imported"
+
+    # the rapids verb (AstPipelineTransform signature)
+    st, out = _req(server, "POST", "/99/Rapids",
+                   {"ast": f'(tmp= rapids_out (mojo.pipeline.transform '
+                           f'"pipe_imported" {frame_id} 0))'})
+    assert st == 200, out
+    st, sf = _req(server, "GET",
+                  "/3/Frames/rapids_out/columns/predict/summary")
+    assert st == 200, sf
+
+    # bad artifact -> 400, not a crash
+    st, bad = _req(server, "POST", "/99/PipelineMojo.import",
+                   {"data": base64.b64encode(b"not a zip").decode()})
+    assert st == 400
+
+
+def test_transform_only_pipeline(server, trained):
+    """An assembly-only pipeline returns the munged frame (no model)."""
+    frame_id, asm_key, _, _ = trained
+    st, out = _req(server, "POST", "/99/PipelineMojo",
+                   {"assembly": asm_key})
+    assert st == 200, out
+    assert out["has_model"] is False
+    st, tr = _req(server, "POST", "/99/PipelineMojo.transform",
+                  {"pipeline": out["pipeline"]["name"], "frame": frame_id,
+                   "destination_frame": "munge_only"})
+    assert st == 200, tr
+    assert "x0x1" in tr["names"] and "y" in tr["names"]
+
+    # neither model nor assembly -> 400
+    st, err = _req(server, "POST", "/99/PipelineMojo", {})
+    assert st == 400
+
+
+def test_client_pipeline_functions(server, trained, tmp_path):
+    """h2o.build_pipeline / download_pipeline / import_pipeline /
+    pipeline_transform over real HTTP."""
+    frame_id, asm_key, model_id, _ = trained
+    import h2o3_tpu.client as h2o
+
+    h2o.connect(server.url)
+    key = h2o.build_pipeline(model_id, assembly_id=asm_key)
+    path = h2o.download_pipeline(key, str(tmp_path))
+    assert os.path.exists(path)
+    key2 = h2o.import_pipeline(path, pipeline_id="client_pipe")
+    assert key2 == "client_pipe"
+    pred = h2o.pipeline_transform(key2, frame_id)
+    assert "predict" in pred.names
