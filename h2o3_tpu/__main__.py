@@ -27,6 +27,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="cloud name (-name)")
     p.add_argument("--port", type=int, default=54321,
                    help="REST port (-port); 0 picks a free port")
+    p.add_argument("--ip", default="127.0.0.1",
+                   help="bind address (-ip); use 0.0.0.0 in pods/containers")
     p.add_argument("--ice-root", default=None,
                    help="spill/log directory (-ice_root)")
     p.add_argument("--max-mem", default=None,
@@ -42,6 +44,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "(-hash_login)")
     p.add_argument("--log-dir", default=None,
                    help="write logs here in addition to the in-memory ring")
+    # multi-host pod launch (the h2odriver / h2o-k8s analogue: instead of
+    # flatfile/multicast cloud formation, hosts rendezvous at a JAX
+    # coordinator and XLA owns the collective fabric)
+    p.add_argument("--coordinator", default=None, metavar="HOST:PORT",
+                   help="JAX distributed coordinator address; process 0 "
+                        "binds it, others connect (multi-host pods; "
+                        "replaces -flatfile cloud formation)")
+    p.add_argument("--num-processes", type=int, default=None,
+                   help="total processes in the pod")
+    p.add_argument("--process-id", type=int, default=None,
+                   help="this process's index (0-based); on k8s, derive "
+                        "from the StatefulSet ordinal (see deploy/)")
     return p
 
 
@@ -65,6 +79,24 @@ def main(argv=None) -> int:
     L.init(dir=args.log_dir or args.ice_root)
     logger = L.get_logger("launcher")
 
+    if args.coordinator:
+        # multi-host rendezvous BEFORE any backend use: after this, every
+        # process sees the pod's full device set and default_mesh() spans
+        # hosts (water/H2O.java cloud formation -> jax.distributed)
+        from h2o3_tpu.parallel.mesh import distributed_initialize
+
+        if args.num_processes is None or args.process_id is None:
+            print("--coordinator requires --num-processes and --process-id",
+                  file=sys.stderr)
+            return 2
+        distributed_initialize(
+            coordinator_address=args.coordinator,
+            num_processes=args.num_processes,
+            process_id=args.process_id,
+        )
+        logger.info("joined pod: process %d/%d via %s",
+                    args.process_id, args.num_processes, args.coordinator)
+
     if args.max_mem:
         from h2o3_tpu.keyed import DKV
 
@@ -80,6 +112,7 @@ def main(argv=None) -> int:
         ssl_cert=args.ssl_cert,
         ssl_key=args.ssl_key,
         auth_file=args.hash_login_file,
+        ip=args.ip,
     )
     logger.info("%s listening on %s", args.name, server.url)
     print(f"h2o3-tpu node '{args.name}' up at {server.url}", flush=True)

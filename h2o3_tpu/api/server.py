@@ -96,8 +96,12 @@ class H2OServer:
         ssl_cert: Optional[str] = None,
         ssl_key: Optional[str] = None,
         auth_file: Optional[str] = None,
+        ip: str = "127.0.0.1",
     ) -> None:
         self.name = name
+        #: bind address (-ip / web_ip OptArg); 0.0.0.0 for pod/container
+        #: serving where probes and clients arrive on the pod IP
+        self.ip = ip
         self.start_time = time.time()
         self.registry = RequestServer()
         from h2o3_tpu.api import handlers
@@ -251,7 +255,7 @@ class H2OServer:
             def do_DELETE(self):
                 self._respond("DELETE")
 
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
+        self._httpd = ThreadingHTTPServer((self.ip, self.port), Handler)
         if self.ssl_cert:
             import ssl
 
@@ -281,7 +285,9 @@ class H2OServer:
     @property
     def url(self) -> str:
         scheme = "https" if self.ssl_cert else "http"
-        return f"{scheme}://127.0.0.1:{self.port}"
+        # a wildcard bind is reachable via loopback for local clients
+        host = "127.0.0.1" if self.ip in ("0.0.0.0", "::") else self.ip
+        return f"{scheme}://{host}:{self.port}"
 
 
 def start_server(port: int = 0, name: str = "h2o3-tpu", **kw) -> H2OServer:

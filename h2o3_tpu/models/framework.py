@@ -97,6 +97,34 @@ class Job:
         return (end - self.start_time) if self.start_time else 0.0
 
 
+def prediction_frame(raw: np.ndarray, domain, threshold: float = 0.5) -> Frame:
+    """Raw scores -> the canonical predictions frame (Model.score layout).
+
+    domain None => not a classifier: 1-D raw becomes a 'predict' numeric
+    column; 2-D raw (PCA projections, autoencoder reconstructions) becomes
+    one numeric column per output. With a domain, binomial labels threshold
+    ``p[:, 1]`` at ``threshold`` (training max-F1 by default), multinomial
+    labels argmax; per-class columns are named p<level>.
+    """
+    if domain is None:
+        if raw.ndim == 1:
+            return Frame(
+                [Column("predict", raw.astype(np.float64), ColType.NUM)])
+        return Frame([
+            Column(f"C{k + 1}", raw[:, k].astype(np.float64), ColType.NUM)
+            for k in range(raw.shape[1])
+        ])
+    if raw.shape[1] == 2:
+        labels = (raw[:, 1] >= threshold).astype(np.int32)
+    else:
+        labels = raw.argmax(axis=1).astype(np.int32)
+    cols = [Column("predict", labels, ColType.CAT, list(domain))]
+    for k, lv in enumerate(domain):
+        cols.append(
+            Column(f"p{lv}", raw[:, k].astype(np.float64), ColType.NUM))
+    return Frame(cols)
+
+
 class Model:
     """Trained model: predict + metrics (hex/Model.java).
 
@@ -151,18 +179,9 @@ class Model:
         frame = self._apply_preprocessors(frame)
         raw = self._predict_raw(frame)
         if not self.is_classifier:
-            return Frame([Column("predict", raw.astype(np.float64), ColType.NUM)])
-        dom = self.data_info.response_domain
-        assert dom is not None
-        if self.nclasses == 2:
-            thr = getattr(self.training_metrics, "max_f1_threshold", 0.5) or 0.5
-            labels = (raw[:, 1] >= thr).astype(np.int32)
-        else:
-            labels = raw.argmax(axis=1).astype(np.int32)
-        cols = [Column("predict", labels, ColType.CAT, dom)]
-        for k, lv in enumerate(dom):
-            cols.append(Column(f"p{lv}", raw[:, k].astype(np.float64), ColType.NUM))
-        return Frame(cols)
+            return prediction_frame(raw, None)
+        thr = getattr(self.training_metrics, "max_f1_threshold", 0.5) or 0.5
+        return prediction_frame(raw, self.data_info.response_domain, thr)
 
     def model_performance(self, frame: Frame) -> Any:
         """Score a frame and build the right ModelMetrics (Model.score + MM builders)."""

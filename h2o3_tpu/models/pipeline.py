@@ -31,7 +31,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from h2o3_tpu.frame.frame import ColType, Column, Frame
+from h2o3_tpu.frame.frame import ColType, Frame
 from h2o3_tpu.keyed import DKV
 
 #: artifact member names
@@ -188,9 +188,7 @@ class ScoringPipeline:
         if self.steps:
             from h2o3_tpu.models.assembly import Assembly
 
-            asm = Assembly(steps=self.steps)
-            for step in self.steps:
-                fr = asm._apply(fr, step)
+            fr = Assembly(steps=self.steps).fit(fr)
         if self.mojo_bytes is None:
             return fr
         mojo = self._genmodel()
@@ -205,20 +203,16 @@ class ScoringPipeline:
             else:
                 data[col.name] = col.numeric_view()
         raw = mojo.score(data)
-        if raw.ndim == 1:
-            return Frame(
-                [Column("predict", raw.astype(np.float64), ColType.NUM)])
-        dom = mojo.domain_values or [str(k) for k in range(raw.shape[1])]
-        if raw.shape[1] == 2:
-            thr = float(mojo.meta.get("default_threshold", 0.5))
-            labels = (raw[:, 1] >= thr).astype(np.int32)
-        else:
-            labels = raw.argmax(axis=1).astype(np.int32)
-        cols = [Column("predict", labels, ColType.CAT, list(dom))]
-        for k, lv in enumerate(dom):
-            cols.append(
-                Column(f"p{lv}", raw[:, k].astype(np.float64), ColType.NUM))
-        return Frame(cols)
+        from h2o3_tpu.models.framework import prediction_frame
+
+        # dispatch on the MOJO's declared response domain, NOT the score
+        # shape: an unsupervised model's [N, k] output (PCA projections)
+        # must come back as k numeric columns, not argmax "labels"
+        if not mojo.is_classifier:
+            return prediction_frame(raw, None)
+        return prediction_frame(
+            raw, mojo.domain_values,
+            float(mojo.meta.get("default_threshold", 0.5)))
 
 
 def build_pipeline(model=None, assembly=None) -> ScoringPipeline:

@@ -174,3 +174,47 @@ class TestCustomMetricUDF:
         finally:
             s.stop()
             DKV.remove("udf_fr")
+
+
+class TestPodLaunch:
+    """--coordinator multi-host flags (the h2odriver / h2o-k8s analogue)."""
+
+    def test_coordinator_requires_pod_shape(self, capsys):
+        from h2o3_tpu.__main__ import main
+
+        rc = main(["--coordinator", "localhost:9999", "--port", "0"])
+        assert rc == 2
+
+    def test_single_process_pod_forms_and_serves(self, tmp_path):
+        """A 1-process pod rendezvous at its own coordinator and serves —
+        the same code path every pod member runs (k8s ordinal 0)."""
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            coord = f"127.0.0.1:{s.getsockname()[1]}"
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "h2o3_tpu", "--port", "0",
+             "--name", "pod-test", "--coordinator", coord,
+             "--num-processes", "1", "--process-id", "0"],
+            cwd=REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        try:
+            line = ""
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                line = proc.stdout.readline()
+                if "up at http" in line:
+                    break
+            assert "up at http" in line, line
+            url = line.strip().rsplit(" ", 1)[-1]
+            with urllib.request.urlopen(url + "/3/Cloud") as resp:
+                cloud = json.loads(resp.read())
+            assert cloud["cloud_name"] == "pod-test"
+            proc.send_signal(signal.SIGTERM)
+            assert proc.wait(timeout=30) == 0
+        finally:
+            if proc.poll() is None:
+                proc.kill()
