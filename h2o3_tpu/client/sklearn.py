@@ -1,0 +1,221 @@
+"""sklearn-compliant wrappers over the client estimators.
+
+Reference: ``h2o-py/h2o/sklearn/`` (wrapper.py + the generated
+``___Classifier`` / ``___Regressor`` / ``___Estimator`` families): fit /
+predict / predict_proba / transform / score over numpy or pandas inputs,
+full get_params/set_params so the wrappers clone inside sklearn pipelines
+and searches, and automatic backend connection handling.
+
+TPU-native build keeps the same surface but generates the wrappers from
+this framework's own estimator registry; data travels as CSV through the
+same REST the plain client uses.
+
+Usage::
+
+    from h2o3_tpu.client.sklearn import H2OGradientBoostingClassifier
+    clf = H2OGradientBoostingClassifier(ntrees=50)
+    clf.fit(X, y).predict(X)            # numpy in -> numpy out
+    cross_val_score(clf, X, y, cv=3)    # clones via get_params
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from sklearn.base import (
+    BaseEstimator,
+    ClassifierMixin,
+    ClusterMixin,
+    RegressorMixin,
+    TransformerMixin,
+)
+
+
+def _connection():
+    """Reuse the module-level client connection, starting an in-process
+    server on first use (H2OConnectionMonitorMixin's auto-connect role)."""
+    import h2o3_tpu.client as h2o
+
+    try:
+        return h2o.connection()
+    except Exception:
+        return h2o.init()
+
+
+def _to_2d(X) -> np.ndarray:
+    arr = np.asarray(
+        X.values if hasattr(X, "values") else X, dtype=np.float64)
+    if arr.ndim == 1:
+        arr = arr[:, None]
+    return arr
+
+
+def _upload(X, y=None, y_categorical: bool = False):
+    """numpy/pandas/list -> uploaded H2OFrame (CSV over REST).
+
+    Classification responses upload as level strings (``c<label>``) so the
+    server parses the column categorical — sklearn's numeric class labels
+    would otherwise train a regressor.
+    """
+    import h2o3_tpu.client as h2o
+
+    _connection()
+    arr = _to_2d(X)
+    names = [f"x{i}" for i in range(arr.shape[1])]
+    cols = [arr[:, i].astype(str) for i in range(arr.shape[1])]
+    if y is not None:
+        yv = np.asarray(y.values if hasattr(y, "values") else y).ravel()
+        names.append("y")
+        cols.append(
+            np.char.add("c", yv.astype(str)) if y_categorical
+            else yv.astype(np.float64).astype(str)
+        )
+    import csv
+
+    buf = io.StringIO()
+    w = csv.writer(buf)  # proper quoting: labels may contain , or newlines
+    w.writerow(names)
+    w.writerows(zip(*cols))
+    return h2o.upload_csv(buf.getvalue())
+
+
+class _H2OSklearnBase(BaseEstimator):
+    """get_params/set_params over the open **params dict (the reference
+    generates explicit signatures; a dict keeps clone()/pipelines working
+    without codegen)."""
+
+    _algo: str = "?"
+
+    def __init__(self, **params: Any) -> None:
+        self._params: Dict[str, Any] = dict(params)
+        self._model = None
+
+    def get_params(self, deep: bool = True) -> Dict[str, Any]:
+        return dict(self._params)
+
+    def set_params(self, **params: Any) -> "_H2OSklearnBase":
+        self._params.update(params)
+        return self
+
+    # -- shared plumbing -----------------------------------------------------
+
+    def _estimator(self):
+        from h2o3_tpu.client import estimators as E
+
+        for name in dir(E):
+            cls = getattr(E, name)
+            if isinstance(cls, type) and getattr(cls, "algo", None) == self._algo:
+                return cls(**self._params)
+        raise ValueError(f"no client estimator for algo {self._algo!r}")
+
+    def _fit(self, X, y=None, categorical: bool = False):
+        fr = _upload(X, y, y_categorical=categorical)
+        est = self._estimator()
+        est.train(y="y" if y is not None else None, training_frame=fr)
+        self._model = est.model
+        self._train_frame = fr  # reusable for in-sample label extraction
+        self.n_features_in_ = _to_2d(X).shape[1]
+        return self
+
+    def _predictions(self, X):
+        if self._model is None:
+            raise ValueError("fit first")
+        fr = _upload(X)
+        pred = self._model.predict(fr)
+        return pred.get_frame_data()
+
+
+class _H2OClassifier(_H2OSklearnBase, ClassifierMixin):
+    def fit(self, X, y):
+        yv = np.asarray(y.values if hasattr(y, "values") else y).ravel()
+        self.classes_ = np.unique(yv)
+        return self._fit(X, y, categorical=True)
+
+    def predict(self, X):
+        data = self._predictions(X)
+        # map label strings back through classes_ — a dtype cast would
+        # corrupt e.g. bool targets (np.asarray(['False'], bool) is True)
+        by_name = {f"c{c}": c for c in self.classes_}
+        return np.asarray([by_name[s] for s in data["predict"]],
+                          dtype=self.classes_.dtype)
+
+    def predict_proba(self, X):
+        data = self._predictions(X)
+        cols = []
+        for c in self.classes_:
+            col = data.get(f"pc{c}")
+            if col is None:
+                raise ValueError(f"no probability column for class {c!r}")
+            cols.append(np.asarray(col, dtype=np.float64))
+        return np.stack(cols, axis=1)
+
+    def predict_log_proba(self, X):
+        return np.log(self.predict_proba(X))
+
+
+class _H2ORegressor(_H2OSklearnBase, RegressorMixin):
+    def fit(self, X, y):
+        return self._fit(X, y, categorical=False)
+
+    def predict(self, X):
+        data = self._predictions(X)
+        return np.asarray(data["predict"], dtype=np.float64)
+
+
+class _H2OClusterer(_H2OSklearnBase, ClusterMixin):
+    def fit(self, X, y=None):
+        self._fit(X)
+        # score the already-uploaded training frame — no second upload
+        data = self._model.predict(self._train_frame).get_frame_data()
+        self.labels_ = np.asarray(data["predict"], dtype=np.int64)
+        return self
+
+    def predict(self, X):
+        data = self._predictions(X)
+        return np.asarray(data["predict"], dtype=np.int64)
+
+
+class _H2OTransformer(_H2OSklearnBase, TransformerMixin):
+    def fit(self, X, y=None):
+        return self._fit(X)
+
+    def transform(self, X):
+        data = self._predictions(X)
+        cols = sorted(data, key=lambda n: (len(n), n))
+        return np.stack(
+            [np.asarray(data[c], dtype=np.float64) for c in cols], axis=1)
+
+
+def _gen(name: str, algo: str, base: type) -> type:
+    cls = type(name, (base,), {"_algo": algo})
+    cls.__doc__ = (
+        f"sklearn-compliant wrapper over the {algo!r} estimator "
+        f"(h2o-py h2o.sklearn.{name} analogue)."
+    )
+    return cls
+
+
+H2OGradientBoostingClassifier = _gen(
+    "H2OGradientBoostingClassifier", "gbm", _H2OClassifier)
+H2OGradientBoostingRegressor = _gen(
+    "H2OGradientBoostingRegressor", "gbm", _H2ORegressor)
+H2ORandomForestClassifier = _gen(
+    "H2ORandomForestClassifier", "drf", _H2OClassifier)
+H2ORandomForestRegressor = _gen(
+    "H2ORandomForestRegressor", "drf", _H2ORegressor)
+H2OXGBoostClassifier = _gen("H2OXGBoostClassifier", "xgboost", _H2OClassifier)
+H2OXGBoostRegressor = _gen("H2OXGBoostRegressor", "xgboost", _H2ORegressor)
+H2OGeneralizedLinearClassifier = _gen(
+    "H2OGeneralizedLinearClassifier", "glm", _H2OClassifier)
+H2OGeneralizedLinearRegressor = _gen(
+    "H2OGeneralizedLinearRegressor", "glm", _H2ORegressor)
+H2ODeepLearningClassifier = _gen(
+    "H2ODeepLearningClassifier", "deeplearning", _H2OClassifier)
+H2ODeepLearningRegressor = _gen(
+    "H2ODeepLearningRegressor", "deeplearning", _H2ORegressor)
+H2OKMeansEstimator = _gen("H2OKMeansEstimator", "kmeans", _H2OClusterer)
+H2OPrincipalComponentAnalysisEstimator = _gen(
+    "H2OPrincipalComponentAnalysisEstimator", "pca", _H2OTransformer)

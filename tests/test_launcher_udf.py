@@ -202,13 +202,16 @@ class TestPodLaunch:
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         )
         try:
-            line = ""
+            line, seen = "", []
             deadline = time.time() + 120
             while time.time() < deadline:
                 line = proc.stdout.readline()
+                seen.append(line)
                 if "up at http" in line:
                     break
-            assert "up at http" in line, line
+                if line == "" and proc.poll() is not None:
+                    break  # child died: surface its output, don't spin
+            assert "up at http" in line, "".join(seen)
             url = line.strip().rsplit(" ", 1)[-1]
             with urllib.request.urlopen(url + "/3/Cloud") as resp:
                 cloud = json.loads(resp.read())

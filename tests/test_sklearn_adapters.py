@@ -1,0 +1,102 @@
+"""sklearn adapter surface (h2o-py h2o.sklearn analogue): fit/predict/
+predict_proba/score over numpy, clone/get_params in sklearn tooling."""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.leaks_keys
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(300, 4))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.int64)
+    return X, y
+
+
+def test_classifier_fit_predict_proba_score(data):
+    from h2o3_tpu.client.sklearn import H2OGradientBoostingClassifier
+
+    X, y = data
+    clf = H2OGradientBoostingClassifier(ntrees=20, max_depth=3, seed=1)
+    assert clf.fit(X, y) is clf
+    pred = clf.predict(X)
+    assert pred.shape == (300,) and set(np.unique(pred)) <= {0, 1}
+    proba = clf.predict_proba(X)
+    assert proba.shape == (300, 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-6)
+    acc = clf.score(X, y)  # ClassifierMixin accuracy
+    assert acc > 0.85
+    assert np.all(np.isfinite(clf.predict_log_proba(X)))
+
+
+def test_regressor_r2(data):
+    from h2o3_tpu.client.sklearn import H2OGradientBoostingRegressor
+
+    X, _ = data
+    yr = X[:, 0] * 2.0 + X[:, 2] + 0.05 * np.random.default_rng(0).normal(
+        size=X.shape[0])
+    reg = H2OGradientBoostingRegressor(ntrees=30, max_depth=3, seed=1)
+    reg.fit(X, yr)
+    assert reg.score(X, yr) > 0.8  # RegressorMixin R^2
+
+
+def test_clone_and_cross_val(data):
+    from sklearn.base import clone
+    from sklearn.model_selection import cross_val_score
+
+    from h2o3_tpu.client.sklearn import H2OGeneralizedLinearClassifier
+
+    X, y = data
+    clf = H2OGeneralizedLinearClassifier(family="binomial", lambda_=0.0)
+    c2 = clone(clf)
+    assert c2.get_params() == clf.get_params() and c2 is not clf
+    scores = cross_val_score(clf, X, y, cv=2)
+    assert scores.shape == (2,) and scores.mean() > 0.8
+
+
+def test_kmeans_and_pca(data):
+    from h2o3_tpu.client.sklearn import (
+        H2OKMeansEstimator,
+        H2OPrincipalComponentAnalysisEstimator,
+    )
+
+    X, _ = data
+    km = H2OKMeansEstimator(k=3, seed=1)
+    km.fit(X)
+    assert km.labels_.shape == (300,) and len(np.unique(km.labels_)) == 3
+
+    pca = H2OPrincipalComponentAnalysisEstimator(k=2, seed=1)
+    z = pca.fit(X).transform(X)
+    assert z.shape == (300, 2) and np.all(np.isfinite(z))
+
+
+def test_pipeline_compose(data):
+    """The wrappers compose inside a sklearn Pipeline."""
+    from sklearn.pipeline import Pipeline
+    from sklearn.preprocessing import StandardScaler
+
+    from h2o3_tpu.client.sklearn import H2ORandomForestClassifier
+
+    X, y = data
+    pipe = Pipeline([
+        ("scale", StandardScaler()),
+        ("rf", H2ORandomForestClassifier(ntrees=10, seed=1)),
+    ])
+    pipe.fit(X, y)
+    assert pipe.score(X, y) > 0.8
+
+
+def test_bool_targets_roundtrip(data):
+    """Boolean y (a plain `X[:,0] > 0` mask) must predict back as bools —
+    a dtype cast of label strings would turn every 'False' into True."""
+    from h2o3_tpu.client.sklearn import H2OGradientBoostingClassifier
+
+    X, _ = data
+    yb = X[:, 0] > 0
+    clf = H2OGradientBoostingClassifier(ntrees=10, max_depth=3, seed=1)
+    pred = clf.fit(X, yb).predict(X)
+    assert pred.dtype == np.bool_
+    assert 0.1 < pred.mean() < 0.9          # both classes present
+    assert (pred == yb).mean() > 0.9
