@@ -73,19 +73,58 @@ ATTEMPTS = int(os.environ.get("BENCH_ATTEMPTS", 3))
 PARTIAL_PATH = os.path.join(_HERE, "BENCH_PARTIAL.json")
 
 
+def _current_round():
+    """Round number from the driver's PROGRESS.jsonl (None if unknown)."""
+    try:
+        with open(os.path.join(_HERE, "PROGRESS.jsonl")) as f:
+            lines = [ln for ln in f.read().splitlines() if ln.strip()]
+        return json.loads(lines[-1]).get("round")
+    except Exception:
+        return None
+
+
 def _fail(stage: str, detail: str) -> None:
     """Print a structured single-line diagnosis and exit 0.
 
     Exit 0 is deliberate: the driver records stdout either way, and a
     parseable diagnosis beats rc=124 with a truncated log (VERDICT r3
     item 1).
+
+    If a mirrored partial result from a successful run EARLIER IN THIS
+    ROUND exists (BENCH_PARTIAL.json — written only by a worker that
+    completed a real measurement, stamped with the round it ran in),
+    report that value with explicit provenance instead of 0.0: the round
+    then records the verified number plus the diagnosis, not just the
+    outage. A partial from a PREVIOUS round is never reported — that
+    would fabricate a number for a round in which nothing ran.
     """
+    err = f"{stage}: {detail}"
+    try:
+        if os.environ.get("BENCH_FORCE_CPU"):
+            partial = None  # CPU smoke runs must not report the TPU artifact
+        else:
+            with open(PARTIAL_PATH) as f:
+                partial = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        partial = None
+    rnd = _current_round()
+    if partial and (rnd is None or partial.get("round") != rnd):
+        partial = None  # stale cross-round artifact (or unknowable round)
+    if partial and partial.get("value"):
+        partial["error"] = err
+        partial["source"] = (
+            "BENCH_PARTIAL.json — mirrored from a successful measurement "
+            "earlier this round; the TPU backend was unreachable at bench "
+            "time (see error)"
+        )
+        print(json.dumps(partial))
+        sys.exit(0)
     print(json.dumps({
         "metric": "tpu_hist_train_rows_per_sec_per_chip",
         "value": 0.0,
         "unit": "rows/sec",
         "vs_baseline": 0.0,
-        "error": f"{stage}: {detail}",
+        "error": err,
     }))
     sys.exit(0)
 
@@ -249,12 +288,16 @@ def main() -> None:
         ok, result, note = _run_child(
             "--worker", ATTEMPT1_TIMEOUT if i == 0 else ATTEMPT_TIMEOUT)
         if ok and result and result.get("value"):
-            # mirror immediately so a later crash can't erase the number
-            try:
-                with open(PARTIAL_PATH, "w") as f:
-                    json.dump(result, f)
-            except OSError:
-                pass
+            # mirror immediately so a later crash can't erase the number —
+            # but never let the CPU test hook clobber a real TPU artifact
+            if not os.environ.get("BENCH_FORCE_CPU"):
+                try:
+                    mirrored = dict(result)
+                    mirrored["round"] = _current_round()
+                    with open(PARTIAL_PATH, "w") as f:
+                        json.dump(mirrored, f)
+                except OSError:
+                    pass
             print(json.dumps(result))
             return
         last_note = note or "worker returned no result"

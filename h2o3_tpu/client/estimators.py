@@ -185,10 +185,21 @@ class H2OEstimator:
         raise AttributeError(name)
 
 
+#: algo name -> generated estimator class (deterministic lookup for
+#: adapters; dir()-scanning would pick an arbitrary class on collisions)
+_BY_ALGO: dict = {}
+
+
 def _make(algo: str, cls_name: str):
     cls = type(cls_name, (H2OEstimator,), {"algo": algo})
     cls.__doc__ = f"h2o-py style estimator for the {algo!r} REST algo."
+    _BY_ALGO[algo] = cls
     return cls
+
+
+def for_algo(algo: str):
+    """The generated estimator class for a REST algo name (None if absent)."""
+    return _BY_ALGO.get(algo)
 
 
 # the h2o-py estimator surface (h2o-py/h2o/estimators/, SURVEY.md Appendix C)

@@ -45,6 +45,15 @@ def _connection():
         return h2o.init()
 
 
+def _remove_quietly(key: str) -> None:
+    import h2o3_tpu.client as h2o
+
+    try:
+        h2o.remove(key)
+    except Exception:
+        pass  # cleanup only — never turn a successful predict into an error
+
+
 def _to_2d(X) -> np.ndarray:
     arr = np.asarray(
         X.values if hasattr(X, "values") else X, dtype=np.float64)
@@ -105,48 +114,66 @@ class _H2OSklearnBase(BaseEstimator):
     def _estimator(self):
         from h2o3_tpu.client import estimators as E
 
-        for name in dir(E):
-            cls = getattr(E, name)
-            if isinstance(cls, type) and getattr(cls, "algo", None) == self._algo:
-                return cls(**self._params)
-        raise ValueError(f"no client estimator for algo {self._algo!r}")
+        cls = E.for_algo(self._algo)
+        if cls is None:
+            raise ValueError(f"no client estimator for algo {self._algo!r}")
+        return cls(**self._params)
 
-    def _fit(self, X, y=None, categorical: bool = False):
-        fr = _upload(X, y, y_categorical=categorical)
+    def _fit(self, X, y=None, categorical: bool = False,
+             keep_train_frame: bool = False):
+        arr = _to_2d(X)
+        fr = _upload(arr, y, y_categorical=categorical)
         est = self._estimator()
         est.train(y="y" if y is not None else None, training_frame=fr)
+        if self._model is not None:
+            # refit: drop the superseded server-side model (CV/search loops
+            # refit the same wrapper; models must not pile up in the DKV)
+            _remove_quietly(self._model.model_id)
         self._model = est.model
-        self._train_frame = fr  # reusable for in-sample label extraction
-        self.n_features_in_ = _to_2d(X).shape[1]
+        if keep_train_frame:
+            self._train_frame = fr  # clusterer reads in-sample labels_
+        else:
+            _remove_quietly(fr.frame_id)
+        self.n_features_in_ = arr.shape[1]
         return self
 
     def _predictions(self, X):
+        """Score X and return the columns; server-side temp frames are
+        deleted immediately — sklearn CV/search loops call predict many
+        times and must not accumulate frames in the server's DKV."""
         if self._model is None:
             raise ValueError("fit first")
         fr = _upload(X)
         pred = self._model.predict(fr)
-        return pred.get_frame_data()
+        data = pred.get_frame_data()
+        _remove_quietly(pred.frame_id)
+        _remove_quietly(fr.frame_id)
+        return data
 
 
-class _H2OClassifier(_H2OSklearnBase, ClassifierMixin):
+class _H2OClassifier(ClassifierMixin, _H2OSklearnBase):
     def fit(self, X, y):
         yv = np.asarray(y.values if hasattr(y, "values") else y).ravel()
-        self.classes_ = np.unique(yv)
-        return self._fit(X, y, categorical=True)
+        # upload CLASS INDICES as the level strings: np.unique and str()
+        # can disagree on which values are "the same" (int 1 vs float 1.0
+        # under object dtype), so uploading str(y) could mint more server
+        # classes than classes_ holds; indices share one label space
+        self.classes_, yidx = np.unique(yv, return_inverse=True)
+        return self._fit(X, yidx, categorical=True)
 
     def predict(self, X):
         data = self._predictions(X)
-        # map label strings back through classes_ — a dtype cast would
+        # map level strings back through classes_ — a dtype cast would
         # corrupt e.g. bool targets (np.asarray(['False'], bool) is True)
-        by_name = {f"c{c}": c for c in self.classes_}
+        by_name = {f"c{i}": c for i, c in enumerate(self.classes_)}
         return np.asarray([by_name[s] for s in data["predict"]],
                           dtype=self.classes_.dtype)
 
     def predict_proba(self, X):
         data = self._predictions(X)
         cols = []
-        for c in self.classes_:
-            col = data.get(f"pc{c}")
+        for i, c in enumerate(self.classes_):
+            col = data.get(f"pc{i}")
             if col is None:
                 raise ValueError(f"no probability column for class {c!r}")
             cols.append(np.asarray(col, dtype=np.float64))
@@ -156,7 +183,7 @@ class _H2OClassifier(_H2OSklearnBase, ClassifierMixin):
         return np.log(self.predict_proba(X))
 
 
-class _H2ORegressor(_H2OSklearnBase, RegressorMixin):
+class _H2ORegressor(RegressorMixin, _H2OSklearnBase):
     def fit(self, X, y):
         return self._fit(X, y, categorical=False)
 
@@ -165,11 +192,15 @@ class _H2ORegressor(_H2OSklearnBase, RegressorMixin):
         return np.asarray(data["predict"], dtype=np.float64)
 
 
-class _H2OClusterer(_H2OSklearnBase, ClusterMixin):
+class _H2OClusterer(ClusterMixin, _H2OSklearnBase):
     def fit(self, X, y=None):
-        self._fit(X)
+        self._fit(X, keep_train_frame=True)
         # score the already-uploaded training frame — no second upload
-        data = self._model.predict(self._train_frame).get_frame_data()
+        pred = self._model.predict(self._train_frame)
+        data = pred.get_frame_data()
+        _remove_quietly(pred.frame_id)
+        _remove_quietly(self._train_frame.frame_id)
+        del self._train_frame
         self.labels_ = np.asarray(data["predict"], dtype=np.int64)
         return self
 
@@ -178,15 +209,14 @@ class _H2OClusterer(_H2OSklearnBase, ClusterMixin):
         return np.asarray(data["predict"], dtype=np.int64)
 
 
-class _H2OTransformer(_H2OSklearnBase, TransformerMixin):
+class _H2OTransformer(TransformerMixin, _H2OSklearnBase):
     def fit(self, X, y=None):
         return self._fit(X)
 
     def transform(self, X):
-        data = self._predictions(X)
-        cols = sorted(data, key=lambda n: (len(n), n))
+        data = self._predictions(X)  # dict preserves server column order
         return np.stack(
-            [np.asarray(data[c], dtype=np.float64) for c in cols], axis=1)
+            [np.asarray(c, dtype=np.float64) for c in data.values()], axis=1)
 
 
 def _gen(name: str, algo: str, base: type) -> type:

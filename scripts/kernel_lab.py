@@ -1,0 +1,327 @@
+"""Kernel experiment lab: time tpu_hist variants and isolate per-level cost.
+
+Measures, per tree level K in (1, 2, 4, 8, 16, 32):
+  * the production node-matmul kernel (h2o3_tpu/ops/pallas_histogram.py);
+  * a full "level step" (hist + split search + routing) to expose the glue
+    residual between the kernel and the end-to-end tree time;
+  * candidate variants (row-tile 1024, factorized hi/lo one-hot) before
+    they are promoted into the production kernel.
+
+Timing uses the same methodology as scripts/bench_hist_kernel.py (scan-chain
+REPS applications, checksum readback, RTT subtracted — block_until_ready is
+a no-op over the axon tunnel; see that file's module doc).
+
+Usage:
+  python scripts/kernel_lab.py                # full lab on TPU
+  python scripts/kernel_lab.py --parity       # interpreter-mode parity (CPU)
+"""
+
+import json
+import os
+import sys
+import time
+from functools import partial
+
+import numpy as np
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/h2o3_tpu_jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PARITY = "--parity" in sys.argv
+if PARITY:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+if PARITY:
+    # env vars alone don't switch platforms here: the axon sitecustomize
+    # pins the remote backend; the config update before first backend use
+    # is authoritative (same as tests/conftest.py and bench.py)
+    jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp  # noqa: E402
+from jax.experimental import pallas as pl  # noqa: E402
+from jax.experimental.pallas import tpu as pltpu  # noqa: E402
+
+from h2o3_tpu.ops.pallas_histogram import (  # noqa: E402
+    _C,
+    build_histogram_pallas,
+    _build_histogram_nodematmul,
+    _resolve_hist_dtype,
+)
+
+N = 2_000_000 if not PARITY else 4096
+F, B1 = 28, 257
+REPS = 4
+LEVEL_KS = (1, 2, 4, 8, 16, 32)
+
+
+# ---------------------------------------------------------------------------
+# variant: factorized hi/lo one-hot (shallow levels)
+#
+# bin = hi*16 + lo. Instead of materializing the [B1, R] one-hot, the kernel
+# materializes Ihi [HI, R] and U [(c,lo), R] = Ilo[lo,r]*valsk[c,r], then one
+# dot_general contracting R gives [HI, KC*LO] = the full (bin, node, chan)
+# histogram for the feature. VPU write volume per feature drops from
+# B1*R (257R) to (HI + LO + KC*LO)*R = (17 + 16 + 16*KC)*R — a 2.6x cut at
+# K=1, parity around K=4.
+
+_LO = 16
+_HI = (B1 + _LO - 1) // _LO  # 17 for B1=257
+
+
+def _fact_kernel(bins_ref, node_ref, vals_ref, out_ref, *, n_feat_b, n_nodes):
+    rt = pl.program_id(1)
+    r = node_ref.shape[0]
+    dtype = vals_ref.dtype
+    kc = n_nodes * _C
+
+    node = node_ref[...]  # [R, 1]
+    vals = vals_ref[...]  # [R, C]
+    iota_kc = jax.lax.broadcasted_iota(jnp.int32, (r, kc), 1)
+    m_node = (iota_kc // _C) == node
+    tiled = jnp.concatenate([vals] * n_nodes, axis=1)  # [R, KC]
+    vals_k = jnp.where(m_node, tiled, jnp.zeros((), dtype)).T  # [KC, R]
+
+    iota_hi = jax.lax.broadcasted_iota(jnp.int32, (_HI, r), 0)
+    iota_lo = jax.lax.broadcasted_iota(jnp.int32, (_LO, r), 0)
+
+    slabs = []
+    for f in range(n_feat_b):
+        b = bins_ref[f][None, :]  # [1, R]
+        ihi = (iota_hi == (b // _LO)).astype(dtype)  # [HI, R]
+        ilo = (iota_lo == (b % _LO)).astype(dtype)  # [LO, R]
+        # U [(c, lo), R]: per channel c a [LO, R] block ilo * vals_k[c]
+        u = jnp.concatenate(
+            [ilo * vals_k[c][None, :] for c in range(kc)], axis=0
+        )  # [KC*LO, R]
+        # [HI, KC*LO] — contraction over rows on the MXU
+        slab = jax.lax.dot_general(
+            ihi, u, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        slabs.append(slab)
+    block = jnp.concatenate(slabs, axis=0)[None]  # [1, Fb*HI, KC*LO]
+
+    @pl.when(rt == 0)
+    def _():
+        out_ref[...] = block
+
+    @pl.when(rt != 0)
+    def _():
+        out_ref[...] = out_ref[...] + block
+
+
+def build_histogram_factorized_v2(
+    bins_fm, nodes, g, h, n_nodes: int, n_bins1: int,
+    row_tile: int = 512, feat_block: int = 8, interpret: bool = False,
+    dtype=jnp.float32, rw=None,
+):
+    n_feat_p, n = bins_fm.shape
+    r = row_tile
+    fb = feat_block
+    assert n % r == 0 and n_feat_p % fb == 0
+
+    w = (nodes >= 0).astype(jnp.float32)
+    cw = w if rw is None else w * rw.astype(jnp.float32)
+    vals = jnp.stack(
+        [g.astype(jnp.float32) * w, h.astype(jnp.float32) * w, cw,
+         jnp.zeros_like(w)], axis=1,
+    ).astype(dtype)
+
+    n_ftiles = n_feat_p // fb
+    n_rtiles = n // r
+    kc = n_nodes * _C
+
+    out = pl.pallas_call(
+        partial(_fact_kernel, n_feat_b=fb, n_nodes=n_nodes),
+        grid=(n_ftiles, n_rtiles),
+        in_specs=[
+            pl.BlockSpec((fb, r), lambda f, t: (f, t)),
+            pl.BlockSpec((r, 1), lambda f, t: (t, 0)),
+            pl.BlockSpec((r, _C), lambda f, t: (t, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, fb * _HI, kc * _LO), lambda f, t: (f, 0, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct(
+            (n_ftiles, fb * _HI, kc * _LO), jnp.float32
+        ),
+        interpret=interpret,
+    )(bins_fm, nodes[:, None], vals)
+
+    # [Ft, Fb*HI, KC*LO] with KC*LO laid out as (k, c, lo)
+    out = out.reshape(n_ftiles, fb, _HI, n_nodes, _C, _LO)
+    # -> [K, F, HI*LO, C]
+    out = jnp.transpose(out, (3, 0, 1, 2, 5, 4)).reshape(
+        n_nodes, n_feat_p, _HI * _LO, _C
+    )
+    return out[:, :, :n_bins1, :3]
+
+
+# ---------------------------------------------------------------------------
+# timing helpers (bench_hist_kernel methodology)
+
+
+def _measure_rtt() -> float:
+    tiny = jax.device_put(np.ones(8, np.float32))
+    float(tiny.sum())
+    t0 = time.perf_counter()
+    for _ in range(10):
+        float(tiny.sum())
+    return (time.perf_counter() - t0) / 10
+
+
+def _timed_chain(make_fn, gs_warm, gs_timed, rtt: float, tries: int = 3):
+    @jax.jit
+    def chained(gs):
+        def body(tot, g):
+            return tot + make_fn(g).sum(), None
+        tot, _ = jax.lax.scan(body, jnp.float32(0.0), gs)
+        return tot
+
+    last = None
+    for i in range(tries):
+        try:
+            gt = gs_timed * np.float32(1.0 + i * 2.0**-10)
+            float(gt.sum())
+            float(chained(gs_warm))
+            t0 = time.perf_counter()
+            float(chained(gt))
+            dt = (time.perf_counter() - t0 - rtt) / gs_timed.shape[0]
+            return max(dt, 1e-9)
+        except Exception as e:
+            last = e
+            time.sleep(3.0)
+    raise last
+
+
+# ---------------------------------------------------------------------------
+
+
+def parity_main():
+    rng = np.random.default_rng(0)
+    bins = rng.integers(0, B1, size=(N, F)).astype(np.int32)
+    nodes = rng.integers(-1, 8, size=N).astype(np.int32)
+    g = rng.normal(size=N).astype(np.float32)
+    h = rng.random(N).astype(np.float32)
+
+    from h2o3_tpu.ops.histogram import _shard_histogram
+
+    want = np.asarray(_shard_histogram(
+        jnp.asarray(bins), jnp.asarray(nodes), jnp.asarray(g),
+        jnp.asarray(h), 8, B1))
+
+    fb = 4
+    Fp = F + (-F) % fb
+    bfm = np.zeros((Fp, N), np.int32)
+    bfm[:F] = bins.T
+    got = np.asarray(build_histogram_factorized_v2(
+        jnp.asarray(bfm), jnp.asarray(nodes), jnp.asarray(g),
+        jnp.asarray(h), 8, B1, row_tile=512, feat_block=fb,
+        interpret=True))[:, :F]
+    err = np.max(np.abs(want - got))
+    print(f"factorized parity max_abs_err = {err:.3e}")
+    assert err < 1e-2, err
+    print("PARITY OK")
+
+
+def lab_main():
+    rng = np.random.default_rng(0)
+    bins = rng.integers(0, B1, size=(N, F)).astype(np.int32)
+    fb = 8
+    Fp = F + (-F) % fb
+    bfm_host = np.zeros((Fp, N), np.int32)
+    bfm_host[:F] = bins.T
+    bins_d = jax.device_put(bins)
+    bfm = jax.device_put(bfm_host)
+    gs_warm = jnp.stack([jax.device_put(rng.normal(size=N).astype(np.float32))
+                         for _ in range(REPS)])
+    gs = jnp.stack([jax.device_put(rng.normal(size=N).astype(np.float32))
+                    for _ in range(REPS)])
+    h = jax.device_put(rng.random(N).astype(np.float32))
+
+    rtt = _measure_rtt()
+    print(f"rtt {rtt*1e3:.1f} ms", flush=True)
+    rows = []
+
+    dt_bf16 = jnp.bfloat16 if _resolve_hist_dtype("auto") == jnp.bfloat16 \
+        else jnp.float32
+
+    for K in LEVEL_KS:
+        nodes = jax.device_put(rng.integers(0, K, size=N).astype(np.int32))
+        row = {"K": K}
+
+        # production kernel (row_tile 512)
+        row["prod_ms"] = round(_timed_chain(
+            lambda g: build_histogram_pallas(
+                bins_d, nodes, g, h, K, B1, bins_fm=bfm),
+            gs_warm, gs, rtt) * 1e3, 2)
+
+        # row-tile 1024 variant of the production kernel
+        try:
+            row["rt1024_ms"] = round(_timed_chain(
+                lambda g: _build_histogram_nodematmul(
+                    bins_d, nodes, g, h, K, B1, row_tile=1024, feat_block=fb,
+                    interpret=False, vma=(), bins_fm=None, dtype=dt_bf16),
+                gs_warm, gs, rtt) * 1e3, 2)
+        except Exception as e:
+            row["rt1024_ms"] = f"ERR {type(e).__name__}"
+
+        # factorized hi/lo variant
+        try:
+            row["fact_ms"] = round(_timed_chain(
+                lambda g: build_histogram_factorized_v2(
+                    bfm, nodes, g, h, K, B1, row_tile=512, feat_block=fb,
+                    dtype=dt_bf16),
+                gs_warm, gs, rtt) * 1e3, 2)
+        except Exception as e:
+            row["fact_ms"] = f"ERR {type(e).__name__}"
+
+        # factorized at row-tile 1024
+        try:
+            row["fact1024_ms"] = round(_timed_chain(
+                lambda g: build_histogram_factorized_v2(
+                    bfm, nodes, g, h, K, B1, row_tile=1024, feat_block=fb,
+                    dtype=dt_bf16),
+                gs_warm, gs, rtt) * 1e3, 2)
+        except Exception as e:
+            row["fact1024_ms"] = f"ERR {type(e).__name__}"
+
+        rows.append(row)
+        print(row, flush=True)
+
+    # glue residual: one full level step (hist + split search + route)
+    from h2o3_tpu.models.tree.booster import _split_search, _sel_tables, _sel_cols
+
+    K = 32
+    nodes_l = jax.device_put(rng.integers(0, K, size=N).astype(np.int32))
+
+    def level_step(g):
+        hist = build_histogram_pallas(bins_d, nodes_l, g, h, K, B1, bins_fm=bfm)
+        out = _split_search(
+            hist, jnp.float32(1.0), jnp.float32(0.0), jnp.float32(0.0),
+            jnp.float32(0.1), jnp.ones((F,), bool), min_rows=1.0, n_bins1=B1)
+        bf, bb, dl, gain, leaf = out
+        f, sb, dlk, cank = _sel_tables(
+            (bf, bb, dl, gain > 0), jnp.clip(nodes_l, 0, K - 1))
+        b = _sel_cols(bins_d, f)
+        go_left = jnp.where(b >= B1 - 1, dlk, b <= sb)
+        child = 2 * nodes_l + jnp.where(go_left, 1, 2)
+        return child.astype(jnp.float32).sum() + leaf.sum()
+
+    t = _timed_chain(level_step, gs_warm, gs, rtt)
+    print({"level_step_K32_ms": round(t * 1e3, 2)}, flush=True)
+    rows.append({"level_step_K32_ms": round(t * 1e3, 2)})
+
+    with open("KERNEL_LAB.json", "w") as f:
+        json.dump(rows, f, indent=1)
+    print("wrote KERNEL_LAB.json")
+
+
+if __name__ == "__main__":
+    if PARITY:
+        parity_main()
+    else:
+        lab_main()

@@ -206,11 +206,15 @@ class TestPodLaunch:
             deadline = time.time() + 120
             while time.time() < deadline:
                 line = proc.stdout.readline()
-                seen.append(line)
+                if line:
+                    seen.append(line)
                 if "up at http" in line:
                     break
-                if line == "" and proc.poll() is not None:
-                    break  # child died: surface its output, don't spin
+                if line == "":
+                    # EOF: either the child died, or it closed stdout while
+                    # still running — both mean the banner can never arrive;
+                    # spinning on instant-'' reads would burn the deadline
+                    break
             assert "up at http" in line, "".join(seen)
             url = line.strip().rsplit(" ", 1)[-1]
             with urllib.request.urlopen(url + "/3/Cloud") as resp:
