@@ -192,13 +192,20 @@ def grad_hess_device(objective: str, y, margin):
 
 def _split_search(
     hist, lam, alpha, gamma, lr, feat_mask, min_rows: float, n_bins1: int,
-    constraints=None, node_lo=None, node_hi=None,
+    constraints=None, node_lo=None, node_hi=None, child_stats: bool = False,
 ):
     """Per-node best split over (feature, bin, NA-direction).
 
     hist: [K, F, B+1, 3] (Σg, Σh, count). Returns per-node arrays:
     feat, bin, default_left, gain, leaf_value (lr-scaled) — plus, in
     monotone mode, the best split's unscaled (left, right) child values.
+
+    child_stats=True additionally returns (wl, wr, left_small): the chosen
+    split's unscaled child leaf values and whether the LEFT child holds no
+    more rows than the right — the inputs the histogram-subtraction level
+    flow needs (build the smaller sibling, derive the larger by
+    subtraction; terminal leaves come straight from wl/wr with no extra
+    totals pass).
 
     Monotone mode (constraints: [F] in {-1,0,+1}, node_lo/node_hi: [K]
     per-node leaf-value bounds): candidates whose child values violate the
@@ -232,6 +239,10 @@ def _split_search(
     parent = side_score(G, H)  # [K]
 
     def dir_gain(gl, hl, cl):
+        # constraints mode materializes per-candidate child values (the
+        # directional mask needs them); otherwise child stats for the ONE
+        # winning candidate are gathered later — full [K, F, B] wl/wr
+        # arrays would be pure waste on the default subtract path
         gr = G[:, None, None] - gl
         hr = H[:, None, None] - hl
         cr = CNT[:, None, None] - cl
@@ -243,12 +254,11 @@ def _split_search(
             wr = opt_w(gr, hr)
             c = constraints[None, :, None].astype(gl.dtype)
             gain = jnp.where((c != 0) & (c * (wr - wl) < 0), -jnp.inf, gain)
-            return gain, wl, wr
-        return gain, None, None
+        return gain
 
     # NA right (default_left=False): left stats = cum; NA left: left += NA bucket
-    gain_r, wl_r, wr_r = dir_gain(cum[..., 0], cum[..., 1], cum[..., 2])
-    gain_l, wl_l, wr_l = dir_gain(
+    gain_r = dir_gain(cum[..., 0], cum[..., 1], cum[..., 2])
+    gain_l = dir_gain(
         cum[..., 0] + na[..., 0][:, :, None],
         cum[..., 1] + na[..., 1][:, :, None],
         cum[..., 2] + na[..., 2][:, :, None],
@@ -273,11 +283,25 @@ def _split_search(
     raw_leaf = opt_w(G, H)
     if constraints is not None:
         raw_leaf = jnp.clip(raw_leaf, node_lo, node_hi)
-        wl_fb = jnp.where(go_left_better, wl_l, wl_r).reshape(flat.shape)
-        wr_fb = jnp.where(go_left_better, wr_l, wr_r).reshape(flat.shape)
-        best_wl = jnp.take_along_axis(wl_fb, best[:, None], axis=1)[:, 0]
-        best_wr = jnp.take_along_axis(wr_fb, best[:, None], axis=1)[:, 0]
-        return best_f, best_b, dl, best_gain, lr * raw_leaf, best_wl, best_wr
+    if constraints is not None or child_stats:
+        # gather the winning candidate's (Σg, Σh, Σw) left-side stats from
+        # cum/na — K-sized gathers, not full [K, F, B] re-materialization
+        K = hist.shape[0]
+        idx_f = jnp.broadcast_to(best_f[:, None, None, None], (K, 1, B, 3))
+        cum_f = jnp.take_along_axis(cum, idx_f, axis=1)[:, 0]  # [K, B, 3]
+        stats_l = jnp.take_along_axis(
+            cum_f, jnp.broadcast_to(best_b[:, None, None], (K, 1, 3)), axis=1
+        )[:, 0]  # [K, 3]
+        na_f = jnp.take_along_axis(
+            na, jnp.broadcast_to(best_f[:, None, None], (K, 1, 3)), axis=1
+        )[:, 0]  # [K, 3]
+        stats_l = stats_l + dl[:, None].astype(stats_l.dtype) * na_f
+        gl_b, hl_b, cl_b = stats_l[:, 0], stats_l[:, 1], stats_l[:, 2]
+        best_wl = opt_w(gl_b, hl_b)
+        best_wr = opt_w(G - gl_b, H - hl_b)
+        left_small = 2.0 * cl_b <= CNT
+        return (best_f, best_b, dl, best_gain, lr * raw_leaf,
+                best_wl, best_wr, left_small)
     return best_f, best_b, dl, best_gain, lr * raw_leaf
 
 
@@ -345,9 +369,30 @@ def _predict_stacked(bins, feat, split_bin, default_left, is_split, leaf, max_de
 # the device-resident training block
 
 
+def _tree_subtract_enabled() -> bool:
+    """Histogram-subtraction level flow: build only the SMALLER sibling of
+    each split and derive the larger by subtraction from the retained
+    parent histogram (the standard hist-GBDT trick — LightGBM, XGBoost
+    ``hist`` and the reference's ``grow_gpu_hist`` all do this); terminal
+    leaves come from the last split's child stats with no totals pass.
+
+    Env H2O3_TPU_TREE_SUBTRACT: '1' on, '0' off, unset/'auto' = on for the
+    Pallas TPU path, off for the XLA scatter path (keeps the CPU oracle
+    tier bit-stable). Read at trace time of the training block.
+    """
+    import os
+
+    from h2o3_tpu.ops.histogram import _hist_impl
+
+    v = os.environ.get("H2O3_TPU_TREE_SUBTRACT", "auto")
+    if v in ("0", "1"):
+        return v == "1"
+    return _hist_impl(None) == "pallas"
+
+
 def _build_one_tree(
     bins, g, h, sample, feat_mask, key, p: TreeParams, mesh, bins_fm=None,
-    constraints=None, rw=None,
+    constraints=None, rw=None, subtract: bool = False,
 ):
     """Grow one tree to max_depth, fully traced. Levels are unrolled with
     per-level static node capacity 2^d (the fixed-capacity redesign of the
@@ -375,6 +420,7 @@ def _build_one_tree(
         b_hi = jnp.full((1,), jnp.inf, jnp.float32)
 
     tf_l, tb_l, tdl_l, tsp_l, tlf_l = [], [], [], [], []
+    prev_hist = prev_can = prev_left_small = prev_wl = prev_wr = None
     for d in range(D + 1):
         K = 2**d
         lo = K - 1
@@ -382,17 +428,26 @@ def _build_one_tree(
         in_lvl = (local >= 0) & (local < K)
         hist_nodes = jnp.where(in_lvl & sample, local, -1).astype(jnp.int32)
         if d == D:
-            # terminal level: no split is possible, so the full
-            # [K, F, B+1, 3] histogram (the widest of the tree) is pure
-            # waste — per-node (Σg, Σh) totals give the leaf values
-            from h2o3_tpu.ops.histogram import node_totals_sharded
+            if subtract and prev_wl is not None:  # D=0 has no parent split
+                # terminal leaves straight from the parent split's child
+                # stats: child(2k+0) = wl[k], child(2k+1) = wr[k] — the
+                # level-(D-1) cumsum stats cover exactly the rows each
+                # child receives, so no totals pass is needed at all
+                raw_leaf = jnp.stack([prev_wl, prev_wr], axis=1).reshape(K)
+            else:
+                # terminal level: no split is possible, so the full
+                # [K, F, B+1, 3] histogram (the widest of the tree) is pure
+                # waste — per-node (Σg, Σh) totals give the leaf values
+                from h2o3_tpu.ops.histogram import node_totals_sharded
 
-            tot = node_totals_sharded(hist_nodes, g, h, K, mesh=mesh, rw=rw)
-            G, H = tot[:, 0], tot[:, 1]
-            t = jnp.sign(G) * jnp.maximum(
-                jnp.abs(G) - jnp.float32(p.reg_alpha), 0.0
-            )
-            raw_leaf = -t / jnp.maximum(H + jnp.float32(p.reg_lambda), 1e-12)
+                tot = node_totals_sharded(
+                    hist_nodes, g, h, K, mesh=mesh, rw=rw)
+                G, H = tot[:, 0], tot[:, 1]
+                t = jnp.sign(G) * jnp.maximum(
+                    jnp.abs(G) - jnp.float32(p.reg_alpha), 0.0
+                )
+                raw_leaf = -t / jnp.maximum(
+                    H + jnp.float32(p.reg_lambda), 1e-12)
             if mono:
                 raw_leaf = jnp.clip(raw_leaf, b_lo, b_hi)
             tf_l.append(jnp.zeros(K, jnp.int32))
@@ -401,10 +456,37 @@ def _build_one_tree(
             tsp_l.append(jnp.zeros(K, bool))
             tlf_l.append(jnp.float32(p.learn_rate) * raw_leaf)
             break
-        hist = build_histogram_sharded(
-            bins, hist_nodes, g, h, n_nodes=K, n_bins1=n_bins1, mesh=mesh,
-            bins_fm=bins_fm, rw=rw,
-        )
+        if subtract and d > 0:
+            # build ONLY each parent's smaller child (one kernel slot per
+            # parent, K/2 nodes); the larger sibling = parent − smaller.
+            # Children of non-split parents hold no rows: their small
+            # half is all-zero by the in_lvl mask and their big half is
+            # masked to zero by prev_can.
+            Kp = K // 2
+            par = jnp.clip(local // 2, 0, Kp - 1)
+            parity = local % 2
+            small_parity = jnp.where(prev_left_small, 0, 1)  # [Kp]
+            sp_row = _sel_table(small_parity.astype(jnp.int32), par)
+            half_nodes = jnp.where(
+                in_lvl & sample & (parity == sp_row), par, -1
+            ).astype(jnp.int32)
+            hist_small = build_histogram_sharded(
+                bins, half_nodes, g, h, n_nodes=Kp, n_bins1=n_bins1,
+                mesh=mesh, bins_fm=bins_fm, rw=rw,
+            )
+            can_m = prev_can[:, None, None, None]
+            hist_big = jnp.where(can_m, prev_hist - hist_small, 0.0)
+            ls_m = prev_left_small[:, None, None, None]
+            left = jnp.where(ls_m, hist_small, hist_big)
+            right = jnp.where(ls_m, hist_big, hist_small)
+            hist = jnp.stack([left, right], axis=1).reshape(
+                K, *hist_small.shape[1:]
+            )
+        else:
+            hist = build_histogram_sharded(
+                bins, hist_nodes, g, h, n_nodes=K, n_bins1=n_bins1,
+                mesh=mesh, bins_fm=bins_fm, rw=rw,
+            )
         if p.mtries > 0:
             key, sub = jax.random.split(key)
             r = jax.random.uniform(sub, (K, F))
@@ -424,9 +506,10 @@ def _build_one_tree(
             constraints=constraints if mono else None,
             node_lo=b_lo if mono else None,
             node_hi=b_hi if mono else None,
+            child_stats=subtract,
         )
-        if mono:
-            bf, bb, dl, gain, leaf, bwl, bwr = out
+        if mono or subtract:
+            bf, bb, dl, gain, leaf, bwl, bwr, left_small = out
         else:
             bf, bb, dl, gain, leaf = out
         can = (gain > max(p.min_split_improvement, 0.0)) & jnp.isfinite(gain) & (d < D)
@@ -435,6 +518,9 @@ def _build_one_tree(
         tdl_l.append(dl)
         tsp_l.append(can)
         tlf_l.append(leaf)
+        if subtract:
+            prev_hist, prev_can, prev_left_small = hist, can, left_small
+            prev_wl, prev_wr = bwl, bwr
         if d < D:
             k = jnp.clip(local, 0, K - 1)
             f, sb, dlk, cank = _sel_tables((bf, bb, dl, can), k)
@@ -474,6 +560,7 @@ def _make_block_fn(
     mesh,
     weighted: bool = False,
     monotone: bool = False,
+    subtract: bool = False,
 ):
     """Compile one training block: scan over `block` boosting rounds, the
     whole thing one XLA program. Returns f(bins, y, valid, margin, keys,
@@ -523,6 +610,7 @@ def _make_block_fn(
                     bins_fm=bins_fm,
                     constraints=mono if monotone else None,
                     rw=w if weighted else None,
+                    subtract=subtract,
                 )
                 # margin update from this tree (full data, not just the sample)
                 margin = margin.at[:, c].add(pred)
@@ -736,6 +824,7 @@ def train_boosted(
 
     built = 0
     default_block = tree_block_size()
+    subtract_on = _tree_subtract_enabled()
     while built < p.ntrees:
         block = (
             min(score_interval, p.ntrees - built)
@@ -745,6 +834,7 @@ def train_boosted(
         fn = _make_block_fn(
             objective, C, block, p_key, mesh,
             weighted=w_d is not None, monotone=mono_d is not None,
+            subtract=subtract_on,
         )
         # one key per ABSOLUTE tree index: blocking and checkpoints never
         # change the random stream a given tree sees

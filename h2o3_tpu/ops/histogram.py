@@ -182,7 +182,7 @@ def _hist_impl(impl: Optional[str]) -> str:
 
 def _one_shard_histogram(
     bins, nodes, g, h, n_nodes, n_bins1, impl, vma=(), bins_fm=None, rw=None,
-    dtype="auto",
+    dtype="auto", kernel="auto",
 ):
     if impl == "pallas":
         from h2o3_tpu.ops.pallas_histogram import build_histogram_pallas
@@ -190,7 +190,7 @@ def _one_shard_histogram(
         return build_histogram_pallas(
             bins, nodes, g, h, n_nodes, n_bins1,
             interpret=jax.default_backend() != "tpu", vma=vma, bins_fm=bins_fm,
-            rw=rw, dtype=dtype,
+            rw=rw, dtype=dtype, kernel=kernel,
         )
     return _shard_histogram(bins, nodes, g, h, n_nodes, n_bins1, rw=rw)
 
@@ -213,30 +213,39 @@ def build_histogram_sharded(
     # the scatter impl ignores dtype — pin it so flipping the dtype env var
     # neither recompiles nor (if invalid) breaks the path that never reads it
     impl = _hist_impl(impl)
+    kernel = "auto"
     if impl == "pallas":
-        from h2o3_tpu.ops.pallas_histogram import _resolve_hist_dtype
+        from h2o3_tpu.ops.pallas_histogram import (
+            _C,
+            _fact_max_kc,
+            _resolve_hist_dtype,
+        )
 
         dtype = (
             "bf16" if _resolve_hist_dtype("auto") == jnp.bfloat16 else "f32"
         )
+        if n_nodes * _C <= _fact_max_kc():
+            kernel = "factorized"
     else:
         dtype = "f32"
     return _build_histogram_jit(
-        bins, nodes, g, h, bins_fm, rw, n_nodes, n_bins1, mesh, impl, dtype
+        bins, nodes, g, h, bins_fm, rw, n_nodes, n_bins1, mesh, impl, dtype,
+        kernel,
     )
 
 
 @partial(
-    jax.jit, static_argnames=("n_nodes", "n_bins1", "mesh", "impl", "dtype")
+    jax.jit,
+    static_argnames=("n_nodes", "n_bins1", "mesh", "impl", "dtype", "kernel"),
 )
 def _build_histogram_jit(
     bins, nodes, g, h, bins_fm, rw, n_nodes: int, n_bins1: int, mesh,
-    impl: str, dtype: str = "auto",
+    impl: str, dtype: str = "auto", kernel: str = "auto",
 ):
     if mesh is None:
         return _one_shard_histogram(
             bins, nodes, g, h, n_nodes, n_bins1, impl, bins_fm=bins_fm, rw=rw,
-            dtype=dtype,
+            dtype=dtype, kernel=kernel,
         )
 
     # optional row-sharded / feature-major extras enter the shard_map only
@@ -251,7 +260,7 @@ def _build_histogram_jit(
         kw = dict(zip([name for name, _, _ in extras], rest))
         part = _one_shard_histogram(
             b, nd, gg, hh, n_nodes, n_bins1, impl, vma=(DATA_AXIS,),
-            dtype=dtype, **kw
+            dtype=dtype, kernel=kernel, **kw
         )
         return jax.lax.psum(part, DATA_AXIS)
 

@@ -20,8 +20,6 @@ import json
 import os
 import sys
 import time
-from functools import partial
-
 import numpy as np
 
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/h2o3_tpu_jax_cache")
@@ -41,11 +39,7 @@ if PARITY:
     # is authoritative (same as tests/conftest.py and bench.py)
     jax.config.update("jax_platforms", "cpu")
 import jax.numpy as jnp  # noqa: E402
-from jax.experimental import pallas as pl  # noqa: E402
-from jax.experimental.pallas import tpu as pltpu  # noqa: E402
-
 from h2o3_tpu.ops.pallas_histogram import (  # noqa: E402
-    _C,
     build_histogram_pallas,
     _build_histogram_nodematmul,
     _resolve_hist_dtype,
@@ -55,109 +49,6 @@ N = 2_000_000 if not PARITY else 4096
 F, B1 = 28, 257
 REPS = 4
 LEVEL_KS = (1, 2, 4, 8, 16, 32)
-
-
-# ---------------------------------------------------------------------------
-# variant: factorized hi/lo one-hot (shallow levels)
-#
-# bin = hi*16 + lo. Instead of materializing the [B1, R] one-hot, the kernel
-# materializes Ihi [HI, R] and U [(c,lo), R] = Ilo[lo,r]*valsk[c,r], then one
-# dot_general contracting R gives [HI, KC*LO] = the full (bin, node, chan)
-# histogram for the feature. VPU write volume per feature drops from
-# B1*R (257R) to (HI + LO + KC*LO)*R = (17 + 16 + 16*KC)*R — a 2.6x cut at
-# K=1, parity around K=4.
-
-_LO = 16
-_HI = (B1 + _LO - 1) // _LO  # 17 for B1=257
-
-
-def _fact_kernel(bins_ref, node_ref, vals_ref, out_ref, *, n_feat_b, n_nodes):
-    rt = pl.program_id(1)
-    r = node_ref.shape[0]
-    dtype = vals_ref.dtype
-    kc = n_nodes * _C
-
-    node = node_ref[...]  # [R, 1]
-    vals = vals_ref[...]  # [R, C]
-    iota_kc = jax.lax.broadcasted_iota(jnp.int32, (r, kc), 1)
-    m_node = (iota_kc // _C) == node
-    tiled = jnp.concatenate([vals] * n_nodes, axis=1)  # [R, KC]
-    vals_k = jnp.where(m_node, tiled, jnp.zeros((), dtype)).T  # [KC, R]
-
-    iota_hi = jax.lax.broadcasted_iota(jnp.int32, (_HI, r), 0)
-    iota_lo = jax.lax.broadcasted_iota(jnp.int32, (_LO, r), 0)
-
-    slabs = []
-    for f in range(n_feat_b):
-        b = bins_ref[f][None, :]  # [1, R]
-        ihi = (iota_hi == (b // _LO)).astype(dtype)  # [HI, R]
-        ilo = (iota_lo == (b % _LO)).astype(dtype)  # [LO, R]
-        # U [(c, lo), R]: per channel c a [LO, R] block ilo * vals_k[c]
-        u = jnp.concatenate(
-            [ilo * vals_k[c][None, :] for c in range(kc)], axis=0
-        )  # [KC*LO, R]
-        # [HI, KC*LO] — contraction over rows on the MXU
-        slab = jax.lax.dot_general(
-            ihi, u, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        slabs.append(slab)
-    block = jnp.concatenate(slabs, axis=0)[None]  # [1, Fb*HI, KC*LO]
-
-    @pl.when(rt == 0)
-    def _():
-        out_ref[...] = block
-
-    @pl.when(rt != 0)
-    def _():
-        out_ref[...] = out_ref[...] + block
-
-
-def build_histogram_factorized_v2(
-    bins_fm, nodes, g, h, n_nodes: int, n_bins1: int,
-    row_tile: int = 512, feat_block: int = 8, interpret: bool = False,
-    dtype=jnp.float32, rw=None,
-):
-    n_feat_p, n = bins_fm.shape
-    r = row_tile
-    fb = feat_block
-    assert n % r == 0 and n_feat_p % fb == 0
-
-    w = (nodes >= 0).astype(jnp.float32)
-    cw = w if rw is None else w * rw.astype(jnp.float32)
-    vals = jnp.stack(
-        [g.astype(jnp.float32) * w, h.astype(jnp.float32) * w, cw,
-         jnp.zeros_like(w)], axis=1,
-    ).astype(dtype)
-
-    n_ftiles = n_feat_p // fb
-    n_rtiles = n // r
-    kc = n_nodes * _C
-
-    out = pl.pallas_call(
-        partial(_fact_kernel, n_feat_b=fb, n_nodes=n_nodes),
-        grid=(n_ftiles, n_rtiles),
-        in_specs=[
-            pl.BlockSpec((fb, r), lambda f, t: (f, t)),
-            pl.BlockSpec((r, 1), lambda f, t: (t, 0)),
-            pl.BlockSpec((r, _C), lambda f, t: (t, 0)),
-        ],
-        out_specs=pl.BlockSpec(
-            (1, fb * _HI, kc * _LO), lambda f, t: (f, 0, 0)
-        ),
-        out_shape=jax.ShapeDtypeStruct(
-            (n_ftiles, fb * _HI, kc * _LO), jnp.float32
-        ),
-        interpret=interpret,
-    )(bins_fm, nodes[:, None], vals)
-
-    # [Ft, Fb*HI, KC*LO] with KC*LO laid out as (k, c, lo)
-    out = out.reshape(n_ftiles, fb, _HI, n_nodes, _C, _LO)
-    # -> [K, F, HI*LO, C]
-    out = jnp.transpose(out, (3, 0, 1, 2, 5, 4)).reshape(
-        n_nodes, n_feat_p, _HI * _LO, _C
-    )
-    return out[:, :, :n_bins1, :3]
 
 
 # ---------------------------------------------------------------------------
@@ -217,10 +108,10 @@ def parity_main():
     Fp = F + (-F) % fb
     bfm = np.zeros((Fp, N), np.int32)
     bfm[:F] = bins.T
-    got = np.asarray(build_histogram_factorized_v2(
-        jnp.asarray(bfm), jnp.asarray(nodes), jnp.asarray(g),
-        jnp.asarray(h), 8, B1, row_tile=512, feat_block=fb,
-        interpret=True))[:, :F]
+    got = np.asarray(build_histogram_pallas(
+        jnp.asarray(bins), jnp.asarray(nodes), jnp.asarray(g),
+        jnp.asarray(h), 8, B1, row_tile=512, interpret=True,
+        kernel="factorized"))
     err = np.max(np.abs(want - got))
     print(f"factorized parity max_abs_err = {err:.3e}")
     assert err < 1e-2, err
@@ -269,12 +160,12 @@ def lab_main():
         except Exception as e:
             row["rt1024_ms"] = f"ERR {type(e).__name__}"
 
-        # factorized hi/lo variant
+        # factorized hi/lo variant (production kernel)
         try:
             row["fact_ms"] = round(_timed_chain(
-                lambda g: build_histogram_factorized_v2(
-                    bfm, nodes, g, h, K, B1, row_tile=512, feat_block=fb,
-                    dtype=dt_bf16),
+                lambda g: build_histogram_pallas(
+                    bins_d, nodes, g, h, K, B1, bins_fm=bfm,
+                    kernel="factorized"),
                 gs_warm, gs, rtt) * 1e3, 2)
         except Exception as e:
             row["fact_ms"] = f"ERR {type(e).__name__}"
@@ -282,9 +173,9 @@ def lab_main():
         # factorized at row-tile 1024
         try:
             row["fact1024_ms"] = round(_timed_chain(
-                lambda g: build_histogram_factorized_v2(
-                    bfm, nodes, g, h, K, B1, row_tile=1024, feat_block=fb,
-                    dtype=dt_bf16),
+                lambda g: build_histogram_pallas(
+                    bins_d, nodes, g, h, K, B1, row_tile=1024,
+                    kernel="factorized"),
                 gs_warm, gs, rtt) * 1e3, 2)
         except Exception as e:
             row["fact1024_ms"] = f"ERR {type(e).__name__}"

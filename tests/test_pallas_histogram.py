@@ -22,8 +22,14 @@ from h2o3_tpu.ops.pallas_histogram import build_histogram_pallas
 INTERPRET = jax.default_backend() != "tpu"
 
 # (kernel, rtol, atol): node-matmul carries bf16 operand rounding (~2^-8
-# relative per element); sorted kernel is f32 end-to-end
-KERNELS = [("nodematmul", 2e-2, 5e-2), ("sorted", 1e-5, 1e-4)]
+# relative per element); sorted kernel is f32 end-to-end; factorized is the
+# hi/lo-decomposed one-hot variant (same bf16-on-TPU / f32-in-interpret
+# dtype policy as node-matmul)
+KERNELS = [
+    ("nodematmul", 2e-2, 5e-2),
+    ("sorted", 1e-5, 1e-4),
+    ("factorized", 2e-2, 5e-2),  # bf16 on real TPU, like nodematmul
+]
 
 
 def _mk(n, f, k, b1, seed, frac_inactive=0.0, empty_node=None):

@@ -171,3 +171,59 @@ def test_variable_importance(mesh, rng):
     m = GBM(response_column="y", ntrees=10, max_depth=3, seed=1).train(fr)
     vi = m.variable_importances()
     assert vi["x2"] == max(vi.values())
+
+
+# ---------------------------------------------------------------------------
+# histogram-subtraction level flow (H2O3_TPU_TREE_SUBTRACT)
+
+
+def _train_margins(X, y, objective, monkeypatch, subtract, **kw):
+    from h2o3_tpu.models.tree.booster import (
+        TreeParams, _make_block_fn, train_boosted)
+    from h2o3_tpu.models.tree.common import init_margin
+
+    monkeypatch.setenv("H2O3_TPU_TREE_SUBTRACT", "1" if subtract else "0")
+    _make_block_fn.cache_clear()
+    params = TreeParams(ntrees=8, max_depth=4, nbins=32, seed=3)
+    f0 = init_margin(objective, y, 1)
+    model = train_boosted(X, objective, y, 1, f0, params, **kw)
+    return model.predict_margin(X)
+
+
+class TestHistogramSubtraction:
+    """Subtract mode builds only the smaller sibling per split and derives
+    the larger by subtraction; terminal leaves come from the last split's
+    child stats. Same rows, same sums — predictions must match the direct
+    per-level build to f32 tolerance."""
+
+    def test_binomial_equivalence(self, mesh, rng, monkeypatch):
+        n = 2000
+        X = rng.normal(size=(n, 6)).astype(np.float32)
+        logit = X[:, 0] + X[:, 1] * X[:, 2]
+        y = (rng.random(n) < 1 / (1 + np.exp(-logit))).astype(np.float64)
+        a = _train_margins(X, y, "bernoulli", monkeypatch, subtract=False)
+        b = _train_margins(X, y, "bernoulli", monkeypatch, subtract=True)
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4)
+
+    def test_weighted_nas_equivalence(self, mesh, rng, monkeypatch):
+        n = 1500
+        X = rng.normal(size=(n, 5)).astype(np.float32)
+        X[rng.random((n, 5)) < 0.15] = np.nan  # exercise the NA bucket
+        y = np.where(np.isnan(X[:, 0]), 0.5, X[:, 0]) * 2 + rng.normal(size=n)
+        w = rng.integers(1, 4, size=n).astype(np.float64)
+        a = _train_margins(X, y, "gaussian", monkeypatch, subtract=False,
+                           weights=w)
+        b = _train_margins(X, y, "gaussian", monkeypatch, subtract=True,
+                           weights=w)
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4)
+
+    def test_monotone_equivalence(self, mesh, rng, monkeypatch):
+        n = 1500
+        X = rng.normal(size=(n, 4)).astype(np.float32)
+        y = 2 * X[:, 0] + 0.2 * rng.normal(size=n)
+        mono = np.array([1, 0, 0, 0], dtype=np.int32)
+        a = _train_margins(X, y, "gaussian", monkeypatch, subtract=False,
+                           monotone=mono)
+        b = _train_margins(X, y, "gaussian", monkeypatch, subtract=True,
+                           monotone=mono)
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4)
