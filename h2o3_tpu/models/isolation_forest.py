@@ -127,7 +127,21 @@ class IsolationForest(ModelBuilder):
             rows = rng.choice(n, sample, replace=False)
             self._grow(X[rows], 0, 0, rng, feats[t], threshs[t], splits[t], plens[t], p.max_depth)
         model.trees = (feats, threshs, splits, plens)
-        model.training_metrics = model.model_performance(frame)
+        # ONE full-data scoring pass serves both the training metrics and
+        # the summed-path-length extremes the reference stores on the
+        # output for MOJO scoring ((max - sum) / (max - min),
+        # IsolationForestMojoModel.unifyPreds)
+        mean_path = np.asarray(jax.device_get(_path_lengths(
+            jnp.asarray(X), jnp.asarray(feats), jnp.asarray(threshs),
+            jnp.asarray(splits), jnp.asarray(plens), p.max_depth)),
+            dtype=np.float64)
+        total = mean_path * p.ntrees
+        model.min_path_total = float(total.min())
+        model.max_path_total = float(total.max())
+        score = np.power(2.0, -mean_path / max(model._cn, 1e-9))
+        model.training_metrics = {
+            "mean_score": float(score.mean()), "max_score": float(score.max())
+        }
         return model
 
     def _grow(self, Xn, node, depth, rng, feat, thresh, is_split, path_len, max_depth) -> None:

@@ -50,19 +50,26 @@ _LINK_BY_DIST = {
 # tree encoder (DTree.size + DTree.compress)
 
 
-def _encode_subtree(trees, t: int, i: int, edges) -> bytes:
-    """Compress the heap subtree rooted at node i of tree t."""
+def _encode_subtree(trees, t: int, i: int, edges, raw_thresh=None) -> bytes:
+    """Compress the heap subtree rooted at node i of tree t.
+
+    raw_thresh: optional [M] float thresholds for trees that split on raw
+    values rather than bin codes (isolation forest) — bypasses the
+    edges[feature][bin] lookup."""
     is_split = trees.is_split[t]
     if not is_split[i]:
         return struct.pack("<f", float(trees.leaf[t][i]))
     f = int(trees.feat[t][i])
-    sb = int(trees.split_bin[t][i])
-    thr = (np.inf if sb >= edges.shape[1]
-           else float(edges[f][sb]))
+    if raw_thresh is not None:
+        thr = float(raw_thresh[i])
+    else:
+        sb = int(trees.split_bin[t][i])
+        thr = (np.inf if sb >= edges.shape[1]
+               else float(edges[f][sb]))
     # a split node's children always exist in the heap (splits stop one
     # level above the leaf frontier)
-    left = _encode_subtree(trees, t, 2 * i + 1, edges)
-    right = _encode_subtree(trees, t, 2 * i + 2, edges)
+    left = _encode_subtree(trees, t, 2 * i + 1, edges, raw_thresh)
+    right = _encode_subtree(trees, t, 2 * i + 2, edges, raw_thresh)
     left_leaf = not is_split[2 * i + 1]
     right_leaf = not is_split[2 * i + 2]
 
@@ -114,6 +121,24 @@ def _encode_tree(trees, t: int, leaf_shift: float = 0.0,
         return b"\x00\xff\xff" + struct.pack(
             "<f", float(trees.leaf[t][0]))
     return _encode_subtree(trees, t, 0, trees.edges)
+
+
+def _encode_raw_tree(is_split, feat, thresh, leaf) -> bytes:
+    """Encode one raw-threshold heap tree (isolation forest): NaN routes
+    left at every split, leaves carry float path lengths."""
+    import types
+
+    shim = types.SimpleNamespace(
+        is_split=[np.asarray(is_split)],
+        feat=[np.asarray(feat)],
+        leaf=[np.asarray(leaf)],
+        default_left=[np.ones(len(feat), bool)],
+        split_bin=[np.zeros(len(feat), np.int32)],
+        edges=np.zeros((0, 0)),
+    )
+    if not is_split[0]:
+        return b"\x00\xff\xff" + struct.pack("<f", float(leaf[0]))
+    return _encode_subtree(shim, 0, 0, shim.edges, raw_thresh=thresh)
 
 
 # ---------------------------------------------------------------------------
@@ -215,8 +240,120 @@ def _write_glm_mojo(model, path: str) -> str:
     return _zip_write(path, lines, dom_texts, {})
 
 
+def _write_kmeans_mojo(model, path: str) -> str:
+    """KMeans in the reference layout (KMeansMojoWriter.writeModelData /
+    KMeansMojoModel.score0): standardize means/mults/modes kv arrays plus
+    one ``center_<i>`` kv per centroid, distance in standardized space.
+
+    Numeric predictors only: the reference scorer keeps categorical
+    columns as single indicator-distance columns while this framework
+    one-hot expands them into the design matrix — the two center layouts
+    are not interconvertible, so categorical models raise."""
+    info = model.data_info
+    if info.cat_domains:
+        raise ValueError("reference-format KMeans MOJO covers numeric "
+                         "predictors only (the reference scorer's "
+                         "categorical distance is not one-hot)")
+    nums = list(info.predictor_names)
+    standardize = bool(getattr(info, "standardize", False))
+    centers = model.centers_std if standardize else model.centers
+    centers = np.asarray(centers, np.float64)
+
+    def jarr(vals):
+        return "[" + ", ".join(repr(float(v)) for v in vals) + "]"
+
+    kv = [
+        ("algorithm", "K-means"),
+        ("algo", "kmeans"),
+        ("category", "Clustering"),
+        ("uuid", str(_uuid.uuid4())),
+        ("supervised", "false"),
+        ("n_features", len(nums)),
+        ("n_classes", 1),
+        ("n_columns", len(nums)),
+        ("n_domains", 0),
+        ("balance_classes", "false"),
+        ("default_threshold", 0.5),
+        ("prior_class_distrib", "null"),
+        ("model_class_distrib", "null"),
+        ("mojo_version", "1.00"),
+        ("h2o_version", "h2o3-tpu"),
+        ("standardize", "true" if standardize else "false"),
+    ]
+    # means are written even when standardize is off: the in-framework
+    # scorer always mean-imputes NAs, and this extra kv lets the decoder
+    # match it (a reference reader only consults these when standardize
+    # is true — for NA rows on unstandardized models the reference
+    # runtime itself cannot impute)
+    kv.append(("standardize_means", jarr(info.num_means[n] for n in nums)))
+    if standardize:
+        kv += [
+            ("standardize_mults",
+             jarr(1.0 / max(info.num_sds[n], 1e-300) for n in nums)),
+            ("standardize_modes",
+             "[" + ", ".join(["-1"] * len(nums)) + "]"),
+        ]
+    kv.append(("center_num", centers.shape[0]))
+    for i, c in enumerate(centers):
+        kv.append((f"center_{i}", jarr(c)))
+    lines = ["[info]"]
+    lines += [f"{k} = {v}" for k, v in kv]
+    lines += ["", "[columns]"] + nums + ["", "[domains]"]
+    return _zip_write(path, lines, {}, {})
+
+
+def _write_isofor_mojo(model, path: str) -> str:
+    """Isolation forest in the reference layout
+    (IsolationForestMojoWriter / IsolationForestMojoModel.unifyPreds):
+    SharedTree-format trees whose leaves carry path lengths, plus
+    min/max_path_length for the (max - sum)/(max - min) score."""
+    feats, threshs, splits, plens = model.trees
+    ntrees = feats.shape[0]
+    names = list(model.data_info.predictor_names)
+    info = [
+        ("algorithm", "Isolation Forest"),
+        ("algo", "isolation_forest"),
+        ("category", "AnomalyDetection"),
+        ("uuid", str(_uuid.uuid4())),
+        ("supervised", "false"),
+        ("n_features", len(names)),
+        ("n_classes", 1),
+        ("n_columns", len(names)),
+        ("n_domains", 0),
+        ("balance_classes", "false"),
+        ("default_threshold", 0.5),
+        ("prior_class_distrib", "null"),
+        ("model_class_distrib", "null"),
+        ("mojo_version", "1.40"),
+        ("h2o_version", "h2o3-tpu"),
+        ("n_trees", ntrees),
+        ("n_trees_per_class", 1),
+        # int fields on the reference model (IsolationForestMojoReader):
+        # conservative rounding keeps every training score inside [0, 1]
+        ("max_path_length", int(np.ceil(model.max_path_total))),
+        ("min_path_length", int(np.floor(model.min_path_total))),
+        ("output_anomaly_flag", "false"),
+        ("_genmodel_encoding", "LabelEncoder"),
+    ]
+    lines = ["[info]"]
+    lines += [f"{k} = {v}" for k, v in info]
+    lines += ["", "[columns]"] + names + ["", "[domains]"]
+    # training routes left on v <= cut; the MOJO runtime routes left on
+    # v < thr (strict) — thr = nextafter(cut) makes the two identical for
+    # every float32 input
+    thr_adj = np.nextafter(
+        np.asarray(threshs, np.float32), np.float32(np.inf))
+    blobs = {
+        f"trees/t00_{t:03d}.bin": _encode_raw_tree(
+            splits[t], feats[t], thr_adj[t], plens[t])
+        for t in range(ntrees)
+    }
+    return _zip_write(path, lines, {}, blobs)
+
+
 def write_mojo(model, path: str) -> str:
-    """Serialize a GBM, DRF or GLM model into the reference MOJO layout."""
+    """Serialize a GBM, DRF, GLM, KMeans or IsolationForest model into the
+    reference MOJO layout."""
     from h2o3_tpu.models.tree.common import tree_feature_names
 
     algo = model.algo_name
@@ -226,11 +363,15 @@ def write_mojo(model, path: str) -> str:
                          "offset_column models")
     if algo == "glm":
         return _write_glm_mojo(model, path)
+    if algo == "kmeans":
+        return _write_kmeans_mojo(model, path)
+    if algo == "isolationforest":
+        return _write_isofor_mojo(model, path)
     if algo not in ("gbm", "drf"):
         raise ValueError(
-            "reference-format MOJO export currently covers GBM, DRF and "
-            "GLM; use the native .mojo (models/mojo_export.py) or POJO "
-            f"codegen for {algo}")
+            "reference-format MOJO export currently covers GBM, DRF, GLM, "
+            "KMeans and IsolationForest; use the native .mojo "
+            f"(models/mojo_export.py) or POJO codegen for {algo}")
     b = model.booster
     names = tree_feature_names(model.data_info, model.tree_encoding)
     dom = model.data_info.response_domain
@@ -453,11 +594,70 @@ class RefMojo:
             return np.array([1.0 - mu, mu])
         return np.array([mu])
 
+    def _kmeans_arrays(self):
+        """Parse the KMeans kv arrays ONCE and cache (score0 is per-row)."""
+        cached = getattr(self, "_kmeans_cache", None)
+        if cached is not None:
+            return cached
+
+        def arr(key):
+            body = self.info[key].strip()[1:-1].strip()
+            return np.asarray(
+                [float(x) for x in body.split(",")] if body else [],
+                np.float64)
+
+        cached = {
+            "centers": np.stack([
+                arr(f"center_{i}")
+                for i in range(int(self.info["center_num"]))
+            ]),
+            "means": (arr("standardize_means")
+                      if "standardize_means" in self.info else None),
+            "mults": (arr("standardize_mults")
+                      if "standardize_mults" in self.info else None),
+        }
+        self._kmeans_cache = cached
+        return cached
+
+    def _kmeans_score0(self, row: np.ndarray) -> np.ndarray:
+        """KMeansMojoModel.score0: Kmeans_preprocessData (NaN -> mean,
+        subtract-mean times mult) then KMeans_closest in standardized
+        space (numeric columns only in this exporter).
+
+        NaN imputation uses standardize_means whenever the writer
+        recorded them — this framework's writer emits them even for
+        standardize=False models so the artifact can reproduce
+        in-framework predictions on NA rows (the reference runtime only
+        imputes when standardize is on; a reference reader ignores the
+        extra key)."""
+        km = self._kmeans_arrays()
+        data = np.asarray(row, np.float64).copy()
+        if km["means"] is not None:
+            nan = np.isnan(data)
+            data[nan] = km["means"][nan]
+        if self.info.get("standardize") == "true":
+            data = (data - km["means"]) * km["mults"]
+        d2 = ((km["centers"] - data[None, :]) ** 2).sum(axis=1)
+        return np.array([float(np.argmin(d2))])
+
     def score0(self, row: np.ndarray) -> np.ndarray:
-        """Gbm/Drf/GlmMojoModel semantics over the decoded payload."""
+        """Gbm/Drf/Glm/KMeansMojoModel semantics over the decoded payload."""
         algo = self.info.get("algo", "gbm")
         if algo == "glm":  # no trees to walk
             return self._glm_score0(row)
+        if algo == "kmeans":
+            return self._kmeans_score0(row)
+        if algo == "isolation_forest":
+            # IsolationForestMojoModel.unifyPreds: sum of per-tree path
+            # lengths -> normalized score + mean path length
+            total = float(np.sum([
+                self.score_tree(t, row) for t in self.trees[0]
+            ], dtype=np.float64))
+            ntrees = int(self.info.get("n_trees", 1))
+            mx = float(self.info["max_path_length"])
+            mn = float(self.info["min_path_length"])
+            score = (mx - total) / (mx - mn) if mx > mn else 1.0
+            return np.array([score, total / max(ntrees, 1)])
         init_f = float(self.info.get("init_f", 0.0))
         dist = self.info.get("distribution", "gaussian")
         link = self.info.get("link_function", "identity")

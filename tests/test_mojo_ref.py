@@ -152,11 +152,11 @@ class TestContainerLayout:
         assert m.score_tree(blob, np.zeros(3)) == 2.5
 
     def test_unsupported_algo_refuses(self, rng):
-        from h2o3_tpu.models.kmeans import KMeans, KMeansParameters
+        from h2o3_tpu.models.naive_bayes import NaiveBayes
 
-        fr = _frame(rng).drop("y")
-        m = KMeans(KMeansParameters(k=3)).train(fr)
-        with pytest.raises(ValueError, match="GBM, DRF and"):
+        fr = _frame(rng)
+        m = NaiveBayes(response_column="y").train(fr)
+        with pytest.raises(ValueError, match="codegen"):
             write_mojo(m, "/tmp/nope.zip")
 
 
@@ -272,3 +272,99 @@ class TestClientDownloadMojo:
         finally:
             h2o.shutdown()  # reset the module connection for later tests
             s.stop()
+
+
+class TestKMeansReferenceMojo:
+    """KMeansMojoWriter/KMeansMojoModel layout: standardize kv arrays +
+    center_<i> rows, closest-center scoring in standardized space."""
+
+    def test_assignment_parity(self, rng, tmp_path):
+        from h2o3_tpu.frame.frame import Column, Frame
+        from h2o3_tpu.models.kmeans import KMeans
+
+        n = 600
+        X = np.concatenate([
+            rng.normal(size=(n // 2, 3)) + 4.0,
+            rng.normal(size=(n // 2, 3)) - 4.0,
+        ])
+        fr = Frame([Column(f"x{i}", X[:, i]) for i in range(3)])
+        m = KMeans(k=2, seed=7).train(fr)
+        path = str(tmp_path / "km.zip")
+        write_mojo(m, path)
+        mojo = read_mojo(path)
+        assert mojo.info["algo"] == "kmeans"
+        assert mojo.info["category"] == "Clustering"
+        assert int(mojo.info["center_num"]) == 2
+        got = _score_all(mojo, X.astype(np.float32))[:, 0].astype(int)
+        want = m.predict(fr).col("predict").numeric_view().astype(int)
+        np.testing.assert_array_equal(got, want)
+
+    @pytest.mark.parametrize("standardize", [True, False])
+    def test_nan_goes_to_mean(self, rng, tmp_path, standardize):
+        from h2o3_tpu.frame.frame import Column, Frame
+        from h2o3_tpu.models.kmeans import KMeans
+
+        X = rng.normal(size=(300, 2))
+        fr = Frame([Column("a", X[:, 0]), Column("b", X[:, 1])])
+        m = KMeans(k=3, seed=1, standardize=standardize).train(fr)
+        path = str(tmp_path / "km.zip")
+        write_mojo(m, path)
+        mojo = read_mojo(path)
+        # a NaN row imputes to the column means (standardized or not) and
+        # must match the in-framework assignment for that imputed row
+        out = mojo.score0(np.array([np.nan, np.nan]))
+        nan_fr = Frame([Column("a", np.array([np.nan])),
+                        Column("b", np.array([np.nan]))])
+        want = m.predict(nan_fr).col("predict").numeric_view()[0]
+        assert out[0] == want
+
+    def test_categorical_model_refuses(self, rng, tmp_path):
+        from h2o3_tpu.frame.frame import Column, Frame
+        from h2o3_tpu.models.kmeans import KMeans
+
+        cats = np.array(["a", "b", "c"])[rng.integers(0, 3, 200)]
+        fr = Frame([
+            Column("num", rng.normal(size=200)),
+            Column("cat", cats).as_factor(),
+        ])
+        m = KMeans(k=2, seed=1).train(fr)
+        with pytest.raises(ValueError, match="numeric"):
+            write_mojo(m, str(tmp_path / "km.zip"))
+
+
+class TestIsolationForestReferenceMojo:
+    """IsolationForestMojoWriter layout: SharedTree trees with path-length
+    leaves + min/max_path_length normalization (unifyPreds)."""
+
+    def test_mean_path_parity(self, rng, tmp_path):
+        from h2o3_tpu.frame.frame import Column, Frame
+        from h2o3_tpu.models.isolation_forest import (
+            IsolationForest, _path_lengths)
+        import jax.numpy as jnp
+
+        n = 400
+        X = rng.normal(size=(n, 4)).astype(np.float32)
+        X[:10] += 6.0  # anomalies
+        X[rng.random((n, 4)) < 0.05] = np.nan  # NA routing
+        fr = Frame([Column(f"x{i}", X[:, i].astype(np.float64))
+                    for i in range(4)])
+        m = IsolationForest(ntrees=12, max_depth=6, seed=5).train(fr)
+        path = str(tmp_path / "if.zip")
+        write_mojo(m, path)
+        mojo = read_mojo(path)
+        assert mojo.info["algo"] == "isolation_forest"
+        assert mojo.info["category"] == "AnomalyDetection"
+
+        from h2o3_tpu.models.tree.common import tree_matrix
+        Xm = tree_matrix(m.data_info, fr)
+        feats, threshs, splits, plens = m.trees
+        want_mean = np.asarray(_path_lengths(
+            jnp.asarray(Xm), jnp.asarray(feats), jnp.asarray(threshs),
+            jnp.asarray(splits), jnp.asarray(plens), m.max_depth))
+        got = _score_all(mojo, Xm)
+        np.testing.assert_allclose(got[:, 1], want_mean, rtol=1e-5,
+                                   atol=1e-5)
+        # normalized scores: anomalies (shorter paths) score higher, and
+        # training rows stay inside [0, 1] by the conservative rounding
+        assert got[:, 0].min() >= 0.0 and got[:, 0].max() <= 1.0
+        assert got[:10, 0].mean() > got[10:, 0].mean()
