@@ -36,13 +36,30 @@ from sklearn.base import (
 
 def _connection():
     """Reuse the module-level client connection, starting an in-process
-    server on first use (H2OConnectionMonitorMixin's auto-connect role)."""
+    server on first use (H2OConnectionMonitorMixin's auto-connect role).
+
+    The cached connection is health-checked: another component may have
+    stopped the server it points at (test suites do), and a dead cached
+    connection would otherwise fail every adapter call with URLError.
+    Only an UNREACHABLE server (connection-level failure) triggers
+    re-init — an alive server returning an HTTP error keeps the existing
+    connection, so transient 5xxs can't silently split fitted models and
+    new uploads across two servers."""
+    import urllib.error
+
     import h2o3_tpu.client as h2o
 
     try:
-        return h2o.connection()
-    except Exception:
+        conn = h2o.connection()
+    except RuntimeError:  # never connected
         return h2o.init()
+    try:
+        conn.cloud_info()  # liveness probe
+    except (urllib.error.URLError, ConnectionError, OSError) as e:
+        if isinstance(e, urllib.error.HTTPError):
+            return conn  # server alive; the request itself will surface it
+        return h2o.init()
+    return conn
 
 
 def _remove_quietly(key: str) -> None:
