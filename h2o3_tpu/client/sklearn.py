@@ -36,30 +36,13 @@ from sklearn.base import (
 
 def _connection():
     """Reuse the module-level client connection, starting an in-process
-    server on first use (H2OConnectionMonitorMixin's auto-connect role).
-
-    The cached connection is health-checked: another component may have
-    stopped the server it points at (test suites do), and a dead cached
-    connection would otherwise fail every adapter call with URLError.
-    Only an UNREACHABLE server (connection-level failure) triggers
-    re-init — an alive server returning an HTTP error keeps the existing
-    connection, so transient 5xxs can't silently split fitted models and
-    new uploads across two servers."""
-    import urllib.error
-
+    server on first use (H2OConnectionMonitorMixin's auto-connect role)."""
     import h2o3_tpu.client as h2o
 
     try:
-        conn = h2o.connection()
+        return h2o.connection()
     except RuntimeError:  # never connected
         return h2o.init()
-    try:
-        conn.cloud_info()  # liveness probe
-    except (urllib.error.URLError, ConnectionError, OSError) as e:
-        if isinstance(e, urllib.error.HTTPError):
-            return conn  # server alive; the request itself will surface it
-        return h2o.init()
-    return conn
 
 
 def _remove_quietly(key: str) -> None:
@@ -85,7 +68,27 @@ def _upload(X, y=None, y_categorical: bool = False):
     Classification responses upload as level strings (``c<label>``) so the
     server parses the column categorical — sklearn's numeric class labels
     would otherwise train a regressor.
+
+    If the cached connection's server has gone away (another component
+    stopped it — test suites do), the first request fails at the
+    connection level; one re-init + retry recovers instead of failing
+    every adapter call. HTTP-level errors pass through untouched
+    (H2OConnection converts them to H2OResponseError, which is not
+    caught here): an alive-but-erroring server must not be silently
+    swapped for a fresh empty one.
     """
+    import urllib.error
+
+    import h2o3_tpu.client as h2o
+
+    try:
+        return _upload_once(X, y, y_categorical)
+    except (urllib.error.URLError, ConnectionError, OSError):
+        h2o.init()  # server gone: start/connect fresh, then retry once
+        return _upload_once(X, y, y_categorical)
+
+
+def _upload_once(X, y=None, y_categorical: bool = False):
     import h2o3_tpu.client as h2o
 
     _connection()
