@@ -69,13 +69,14 @@ def _upload(X, y=None, y_categorical: bool = False):
     server parses the column categorical — sklearn's numeric class labels
     would otherwise train a regressor.
 
-    If the cached connection's server has gone away (another component
+    If the cached IN-PROCESS server has gone away (another component
     stopped it — test suites do), the first request fails at the
     connection level; one re-init + retry recovers instead of failing
     every adapter call. HTTP-level errors pass through untouched
     (H2OConnection converts them to H2OResponseError, which is not
-    caught here): an alive-but-erroring server must not be silently
-    swapped for a fresh empty one.
+    caught here), and a dead REMOTE connection also propagates: silently
+    swapping a user's remote cluster for a fresh local server would send
+    their data somewhere they never asked for.
     """
     import urllib.error
 
@@ -84,7 +85,10 @@ def _upload(X, y=None, y_categorical: bool = False):
     try:
         return _upload_once(X, y, y_categorical)
     except (urllib.error.URLError, ConnectionError, OSError):
-        h2o.init()  # server gone: start/connect fresh, then retry once
+        if getattr(h2o, "_server", None) is None and \
+                getattr(h2o, "_conn", None) is not None:
+            raise  # user-supplied remote connection: not ours to replace
+        h2o.init()  # in-process server gone: start fresh, then retry once
         return _upload_once(X, y, y_categorical)
 
 

@@ -351,9 +351,93 @@ def _write_isofor_mojo(model, path: str) -> str:
     return _zip_write(path, lines, {}, blobs)
 
 
+def _write_word2vec_mojo(model, path: str) -> str:
+    """Word2Vec in the reference layout (Word2VecMojoWriter): vec_size /
+    vocab_size kv, a ``vocabulary`` text file (one escaped word per
+    line), and a ``vectors`` blob of BIG-endian float32s — Java
+    ByteBuffer's default order, unlike the little-endian tree bytes."""
+    vecs = np.asarray(model.vectors, np.float32)
+    V, D = vecs.shape
+    kv = [
+        ("algorithm", "Word2Vec"),
+        ("algo", "word2vec"),
+        ("category", "WordEmbedding"),
+        ("uuid", str(_uuid.uuid4())),
+        ("supervised", "false"),
+        ("n_features", 0),
+        ("n_classes", 1),
+        ("n_columns", 0),
+        ("n_domains", 0),
+        ("balance_classes", "false"),
+        ("default_threshold", 0.5),
+        ("prior_class_distrib", "null"),
+        ("model_class_distrib", "null"),
+        ("mojo_version", "1.00"),
+        ("h2o_version", "h2o3-tpu"),
+        ("vec_size", D),
+        ("vocab_size", V),
+    ]
+    lines = ["[info]"]
+    lines += [f"{k} = {v}" for k, v in kv]
+    lines += ["", "[columns]", "", "[domains]"]
+    vocab_text = "\n".join(
+        _escape_vocab_word(w) for w in model.words
+    ) + "\n"
+    blobs = {"vectors": vecs.astype(">f4").tobytes()}
+    return _zip_write(path, lines, {"vocabulary": vocab_text}, blobs)
+
+
+def _escape_vocab_word(w: str) -> str:
+    """One word per line: every character splitlines() treats as a line
+    boundary must be escaped, or the vocab/vector zip misaligns."""
+    out = []
+    for ch in w:
+        if ch == "\\":
+            out.append("\\\\")
+        elif ch == "\n":
+            out.append("\\n")
+        elif ch == "\r":
+            out.append("\\r")
+        elif ch in "\v\f\x1c\x1d\x1e\x85\u2028\u2029":
+            out.append(f"\\u{ord(ch):04x}")
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
+def _unescape_vocab_word(s: str) -> str:
+    """Single left-to-right scan — sequential str.replace calls corrupt
+    words containing a literal backslash followed by 'n'."""
+    out = []
+    i = 0
+    while i < len(s):
+        ch = s[i]
+        if ch == "\\" and i + 1 < len(s):
+            nxt = s[i + 1]
+            if nxt == "\\":
+                out.append("\\")
+                i += 2
+                continue
+            if nxt == "n":
+                out.append("\n")
+                i += 2
+                continue
+            if nxt == "r":
+                out.append("\r")
+                i += 2
+                continue
+            if nxt == "u" and i + 6 <= len(s):
+                out.append(chr(int(s[i + 2:i + 6], 16)))
+                i += 6
+                continue
+        out.append(ch)
+        i += 1
+    return "".join(out)
+
+
 def write_mojo(model, path: str) -> str:
-    """Serialize a GBM, DRF, GLM, KMeans or IsolationForest model into the
-    reference MOJO layout."""
+    """Serialize a GBM, DRF, GLM, KMeans, IsolationForest or Word2Vec
+    model into the reference MOJO layout."""
     from h2o3_tpu.models.tree.common import tree_feature_names
 
     algo = model.algo_name
@@ -361,17 +445,20 @@ def write_mojo(model, path: str) -> str:
         # the format has no offset term; exporting would silently drop it
         raise ValueError("reference-format MOJO export does not support "
                          "offset_column models")
-    if algo == "glm":
-        return _write_glm_mojo(model, path)
-    if algo == "kmeans":
-        return _write_kmeans_mojo(model, path)
-    if algo == "isolationforest":
-        return _write_isofor_mojo(model, path)
+    writers = {
+        "glm": _write_glm_mojo,
+        "kmeans": _write_kmeans_mojo,
+        "isolationforest": _write_isofor_mojo,
+        "word2vec": _write_word2vec_mojo,
+    }
+    if algo in writers:
+        return writers[algo](model, path)
     if algo not in ("gbm", "drf"):
+        covered = ", ".join(sorted(["gbm", "drf", *writers]))
         raise ValueError(
-            "reference-format MOJO export currently covers GBM, DRF, GLM, "
-            "KMeans and IsolationForest; use the native .mojo "
-            f"(models/mojo_export.py) or POJO codegen for {algo}")
+            f"reference-format MOJO export currently covers {covered}; "
+            "use the native .mojo (models/mojo_export.py) or POJO "
+            f"codegen for {algo}")
     b = model.booster
     names = tree_feature_names(model.data_info, model.tree_encoding)
     dom = model.data_info.response_domain
@@ -722,4 +809,19 @@ def read_mojo(path: str) -> RefMojo:
                 z.read(f"trees/t{c:02d}_{t:03d}.bin")
                 for t in range(ntrees)
             ])
+        if m.info.get("algo") == "word2vec":
+            words = [
+                _unescape_vocab_word(w)
+                for w in z.read("vocabulary").decode().split("\n")
+                if w != ""
+            ]
+            vocab_size = int(m.info["vocab_size"])
+            if len(words) != vocab_size:
+                raise ValueError(
+                    f"corrupted vocabulary: {len(words)} words != "
+                    f"vocab_size {vocab_size}")
+            vecs = np.frombuffer(z.read("vectors"), dtype=">f4").reshape(
+                vocab_size, int(m.info["vec_size"])
+            )
+            m.word_vectors = dict(zip(words, np.asarray(vecs, np.float32)))
     return m
