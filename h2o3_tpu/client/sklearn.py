@@ -85,9 +85,14 @@ def _upload(X, y=None, y_categorical: bool = False):
     try:
         return _upload_once(X, y, y_categorical)
     except (urllib.error.URLError, ConnectionError, OSError):
-        if getattr(h2o, "_server", None) is None and \
-                getattr(h2o, "_conn", None) is not None:
-            raise  # user-supplied remote connection: not ours to replace
+        conn = getattr(h2o, "_conn", None)
+        server = getattr(h2o, "_server", None)
+        if conn is not None and (
+                server is None or conn.base_url != server.url.rstrip("/")):
+            # the connection targets something OTHER than our in-process
+            # server (a stale local server may coexist with a later
+            # h2o.connect): a dead remote is not ours to replace
+            raise
         h2o.init()  # in-process server gone: start fresh, then retry once
         return _upload_once(X, y, y_categorical)
 

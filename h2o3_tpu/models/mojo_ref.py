@@ -160,6 +160,11 @@ def _zip_write(path: str, ini_lines: List[str],
     return path
 
 
+def _jarr(vals) -> str:
+    """Java Arrays.toString formatting for a double[] ini value."""
+    return "[" + ", ".join(repr(float(v)) for v in vals) + "]"
+
+
 def _write_glm_mojo(model, path: str) -> str:
     """GLM in the reference layout (GLMMojoWriter.writeModelData /
     GlmMojoModel.glmScore0): cats-first row layout, catOffsets into a
@@ -259,9 +264,6 @@ def _write_kmeans_mojo(model, path: str) -> str:
     centers = model.centers_std if standardize else model.centers
     centers = np.asarray(centers, np.float64)
 
-    def jarr(vals):
-        return "[" + ", ".join(repr(float(v)) for v in vals) + "]"
-
     kv = [
         ("algorithm", "K-means"),
         ("algo", "kmeans"),
@@ -285,17 +287,17 @@ def _write_kmeans_mojo(model, path: str) -> str:
     # match it (a reference reader only consults these when standardize
     # is true — for NA rows on unstandardized models the reference
     # runtime itself cannot impute)
-    kv.append(("standardize_means", jarr(info.num_means[n] for n in nums)))
+    kv.append(("standardize_means", _jarr(info.num_means[n] for n in nums)))
     if standardize:
         kv += [
             ("standardize_mults",
-             jarr(1.0 / max(info.num_sds[n], 1e-300) for n in nums)),
+             _jarr(1.0 / max(info.num_sds[n], 1e-300) for n in nums)),
             ("standardize_modes",
              "[" + ", ".join(["-1"] * len(nums)) + "]"),
         ]
     kv.append(("center_num", centers.shape[0]))
     for i, c in enumerate(centers):
-        kv.append((f"center_{i}", jarr(c)))
+        kv.append((f"center_{i}", _jarr(c)))
     lines = ["[info]"]
     lines += [f"{k} = {v}" for k, v in kv]
     lines += ["", "[columns]"] + nums + ["", "[domains]"]
@@ -435,9 +437,107 @@ def _unescape_vocab_word(s: str) -> str:
     return "".join(out)
 
 
+def _write_dl_mojo(model, path: str) -> str:
+    """DeepLearning in the reference layout (DeepLearningMojoWriter /
+    DeeplearningMojoModel.score0): neural_network_sizes + per-layer
+    weight/bias kv arrays, weights flattened ROW-major [out, in]
+    (gemv_row_optimized order; this framework stores [in, out]).
+
+    Numeric predictors only (the reference scorer's cats-first
+    setInput layout differs from this framework's interleaved design
+    matrix) and non-autoencoder. Hidden dropout ratios are written as 0:
+    training uses inverted dropout, so inference-time scaling is already
+    baked into the weights. The maxout family degrades to Rectifier in
+    this build, so it exports as Rectifier — the artifact reproduces
+    this model's predictions, not the reference's maxout."""
+    info = model.data_info
+    if info.cat_domains:
+        raise ValueError("reference-format DeepLearning MOJO covers "
+                         "numeric predictors only")
+    if model.params.autoencoder:
+        raise ValueError("reference-format DeepLearning MOJO does not "
+                         "cover autoencoder models")
+    nums = list(info.predictor_names)
+    F = len(nums)
+    net = [(np.asarray(W, np.float64), np.asarray(b, np.float64))
+           for W, b in model.net_params]
+    units = [F] + [w.shape[1] for w, _ in net]
+    nclasses = model.nclasses
+    is_clf = model.is_classifier
+    act = {"rectifier": "Rectifier", "relu": "Rectifier", "tanh": "Tanh",
+           "maxout": "Rectifier"}[model.params.activation]
+    if is_clf:
+        family = "bernoulli" if nclasses == 2 else "multinomial"
+        category = "Binomial" if nclasses == 2 else "Multinomial"
+    else:
+        family = "gaussian"
+        category = "Regression"
+
+    columns = nums + [model.params.response_column]
+    rdom = info.response_domain
+    kv = [
+        ("algorithm", "Deep Learning"),
+        ("algo", "deeplearning"),
+        ("category", category),
+        ("uuid", str(_uuid.uuid4())),
+        ("supervised", "true"),
+        ("n_features", F),
+        ("n_classes", nclasses if nclasses > 1 else 1),
+        ("n_columns", len(columns)),
+        ("n_domains", 1 if rdom else 0),
+        ("balance_classes", "false"),
+        ("default_threshold", 0.5),
+        ("prior_class_distrib", "null"),
+        ("model_class_distrib", "null"),
+        ("mojo_version", "1.10"),
+        ("h2o_version", "h2o3-tpu"),
+        ("mini_batch_size", 1),
+        ("nums", F),
+        ("cats", 0),
+        ("cat_offsets", "[0]"),
+        ("use_all_factor_levels",
+         "true" if info.use_all_factor_levels else "false"),
+        ("activation", act),
+        ("distribution", family),
+        ("mean_imputation", "true"),
+        ("norm_resp_mul", "null"),
+        ("norm_resp_sub", "null"),
+        ("neural_network_sizes", "[" + ", ".join(map(str, units)) + "]"),
+        ("hidden_dropout_ratios", _jarr([0.0] * len(net))),
+        ("_genmodel_encoding", "AUTO"),
+    ]
+    means = np.asarray([info.num_means[n] for n in nums], np.float64)
+    if getattr(info, "standardize", False):
+        kv.append(("norm_sub", _jarr(means)))
+        kv.append(("norm_mul",
+                   _jarr(1.0 / max(info.num_sds[n], 1e-300)
+                        for n in nums)))
+    else:
+        # the scorer's NaN handling is ZERO-after-normalization; this
+        # model mean-imputes. Writing norm_sub=means/norm_mul=1 makes the
+        # scorer's NaN -> 0 equal mean-imputation, and the mean shift on
+        # non-NaN values is folded into the first-layer bias exactly:
+        # (x - m)·W0 + (b0 + m·W0) == x·W0 + b0
+        kv.append(("norm_sub", _jarr(means)))
+        kv.append(("norm_mul", _jarr(np.ones(F))))
+        W0, b0 = net[0]
+        net[0] = (W0, b0 + means @ W0)
+    for i, (W, b) in enumerate(net):
+        kv.append((f"weight_layer{i}", _jarr(W.T.reshape(-1))))
+        kv.append((f"bias_layer{i}", _jarr(b)))
+    lines = ["[info]"]
+    lines += [f"{k} = {v}" for k, v in kv]
+    lines += ["", "[columns]"] + columns + ["", "[domains]"]
+    dom_texts: Dict[str, str] = {}
+    if rdom:
+        lines.append(f"{len(columns) - 1}: {len(rdom)} d000.txt")
+        dom_texts["domains/d000.txt"] = "\n".join(rdom) + "\n"
+    return _zip_write(path, lines, dom_texts, {})
+
+
 def write_mojo(model, path: str) -> str:
-    """Serialize a GBM, DRF, GLM, KMeans, IsolationForest or Word2Vec
-    model into the reference MOJO layout."""
+    """Serialize a GBM, DRF, GLM, KMeans, IsolationForest, Word2Vec or
+    DeepLearning model into the reference MOJO layout."""
     from h2o3_tpu.models.tree.common import tree_feature_names
 
     algo = model.algo_name
@@ -450,6 +550,7 @@ def write_mojo(model, path: str) -> str:
         "kmeans": _write_kmeans_mojo,
         "isolationforest": _write_isofor_mojo,
         "word2vec": _write_word2vec_mojo,
+        "deeplearning": _write_dl_mojo,
     }
     if algo in writers:
         return writers[algo](model, path)
@@ -727,11 +828,64 @@ class RefMojo:
         d2 = ((km["centers"] - data[None, :]) ** 2).sum(axis=1)
         return np.array([float(np.argmin(d2))])
 
+    def _dl_arrays(self):
+        cached = getattr(self, "_dl_cache", None)
+        if cached is not None:
+            return cached
+
+        def arr(key):
+            body = self.info[key].strip()[1:-1].strip()
+            return np.asarray(
+                [float(x) for x in body.split(",")] if body else [],
+                np.float64)
+
+        units = [int(u) for u in arr("neural_network_sizes")]
+        layers = []
+        for i in range(len(units) - 1):
+            W = arr(f"weight_layer{i}").reshape(units[i + 1], units[i])
+            b = arr(f"bias_layer{i}")
+            layers.append((W, b))
+        cached = {
+            "units": units,
+            "layers": layers,
+            "norm_sub": arr("norm_sub") if "norm_sub" in self.info else None,
+            "norm_mul": arr("norm_mul") if "norm_mul" in self.info else None,
+        }
+        self._dl_cache = cached
+        return cached
+
+    def _dl_score0(self, row: np.ndarray) -> np.ndarray:
+        """DeeplearningMojoModel.score0, numeric-only subset: setInput
+        ((d - norm_sub) * norm_mul, NaN -> 0 after normalization), then
+        fprop with the stored activation per hidden layer and
+        Softmax/Linear on the output layer."""
+        dl = self._dl_arrays()
+        x = np.asarray(row, np.float64).copy()
+        if dl["norm_sub"] is not None:
+            x = (x - dl["norm_sub"]) * dl["norm_mul"]
+        x[np.isnan(x)] = 0.0  # replaceMissingWithZero (post-normalization)
+        act = self.info.get("activation", "Rectifier")
+        n_layers = len(dl["layers"])
+        for i, (W, b) in enumerate(dl["layers"]):
+            x = W @ x + b
+            if i < n_layers - 1:
+                if act == "Tanh":
+                    x = np.tanh(x)
+                else:  # Rectifier
+                    x = np.maximum(x, 0.0)
+        if self.info.get("category") in ("Binomial", "Multinomial"):
+            z = x - x.max()
+            e = np.exp(z)
+            return e / e.sum()
+        return np.array([x[0]])
+
     def score0(self, row: np.ndarray) -> np.ndarray:
         """Gbm/Drf/Glm/KMeansMojoModel semantics over the decoded payload."""
         algo = self.info.get("algo", "gbm")
         if algo == "glm":  # no trees to walk
             return self._glm_score0(row)
+        if algo == "deeplearning":
+            return self._dl_score0(row)
         if algo == "kmeans":
             return self._kmeans_score0(row)
         if algo == "isolation_forest":

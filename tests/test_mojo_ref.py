@@ -401,3 +401,56 @@ class TestWord2VecReferenceMojo:
         le = np.frombuffer(raw, "<f4")
         be = np.frombuffer(raw, ">f4")
         assert not np.allclose(le, be)
+
+
+class TestDeepLearningReferenceMojo:
+    """DeepLearningMojoWriter layout: neural_network_sizes + row-major
+    weight_layer<i>/bias_layer<i> kv arrays, setInput normalization."""
+
+    def _num_frame(self, rng, n=400, classif=True):
+        X = rng.normal(size=(n, 5))
+        X[rng.random((n, 5)) < 0.05] = np.nan
+        logit = np.nan_to_num(X[:, 0]) - 0.7 * np.nan_to_num(X[:, 1])
+        cols = [Column(f"x{i}", X[:, i]) for i in range(5)]
+        if classif:
+            y = (logit > 0).astype(np.int32)
+            cols.append(Column("y", y, ColType.CAT, ["n", "p"]))
+        else:
+            cols.append(Column("y", logit + 0.1 * rng.normal(size=n)))
+        return Frame(cols)
+
+    @pytest.mark.parametrize("classif", [True, False])
+    @pytest.mark.parametrize("standardize", [True, False])
+    def test_forward_parity(self, rng, tmp_path, classif, standardize):
+        from h2o3_tpu.models.deeplearning import DeepLearning
+
+        fr = self._num_frame(rng, classif=classif)
+        m = DeepLearning(hidden=[8, 6], epochs=3, response_column="y",
+                         seed=2, activation="tanh",
+                         standardize=standardize).train(fr)
+        path = str(tmp_path / f"dl_{classif}_{standardize}.zip")
+        write_mojo(m, path)
+        mojo = read_mojo(path)
+        assert mojo.info["algo"] == "deeplearning"
+        assert mojo.info["activation"] == "Tanh"
+        from h2o3_tpu.models.data_info import expand_matrix
+        X, _ = expand_matrix(m.data_info, fr, dtype=np.float64)
+        # un-standardize back to raw inputs: the MOJO consumes raw rows
+        raw = np.stack([fr.col(f"x{i}").numeric_view() for i in range(5)],
+                       axis=1)
+        got = _score_all(mojo, raw)
+        want = m._predict_raw(fr)
+        if classif:
+            np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+        else:
+            np.testing.assert_allclose(got[:, 0], want, rtol=1e-4,
+                                       atol=1e-5)
+
+    def test_autoencoder_refuses(self, rng, tmp_path):
+        from h2o3_tpu.models.deeplearning import DeepLearning
+
+        fr = self._num_frame(rng, n=120, classif=False).drop("y")
+        m = DeepLearning(hidden=[4], epochs=1, autoencoder=True,
+                         seed=1).train(fr)
+        with pytest.raises(ValueError, match="autoencoder"):
+            write_mojo(m, str(tmp_path / "ae.zip"))
