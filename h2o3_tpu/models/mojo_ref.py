@@ -165,29 +165,55 @@ def _jarr(vals) -> str:
     return "[" + ", ".join(repr(float(v)) for v in vals) + "]"
 
 
-def _write_glm_mojo(model, path: str) -> str:
-    """GLM in the reference layout (GLMMojoWriter.writeModelData /
-    GlmMojoModel.glmScore0): cats-first row layout, catOffsets into a
-    flat raw-scale beta, num block, intercept last."""
-    p = model.params
-    if p.family in ("multinomial", "ordinal"):
-        raise ValueError("reference-format GLM MOJO covers single-eta "
-                         "families only (not multinomial/ordinal)")
-    info_d = model.data_info
-    cats = [n for n in info_d.predictor_names if n in info_d.cat_domains]
-    nums = [n for n in info_d.predictor_names
-            if n not in info_d.cat_domains]
+def _parse_jarr(s: str, cast=float):
+    """Inverse of _jarr: parse a bracketed comma-joined kv array."""
+    body = s.strip()[1:-1].strip()
+    return [cast(x) for x in body.split(",")] if body else []
+
+
+def _glm_class_beta(info_d, cats, nums, coef: Dict[str, float]):
+    """One class's flat beta in the reference layout: cats-first
+    (catOffsets, skipping level 0 unless use_all_factor_levels), nums,
+    intercept last. Returns (beta, cat_offsets)."""
     skip = 0 if info_d.use_all_factor_levels else 1
     cat_offsets = [0]
     beta: List[float] = []
     for c in cats:
         dom = info_d.cat_domains[c]
         for lv in dom[skip:]:
-            beta.append(float(model.coefficients.get(f"{c}.{lv}", 0.0)))
+            beta.append(float(coef.get(f"{c}.{lv}", 0.0)))
         cat_offsets.append(len(beta))
     for n in nums:
-        beta.append(float(model.coefficients.get(n, 0.0)))
-    beta.append(float(model.coefficients.get("Intercept", 0.0)))
+        beta.append(float(coef.get(n, 0.0)))
+    beta.append(float(coef.get("Intercept", 0.0)))
+    return beta, cat_offsets
+
+
+def _write_glm_mojo(model, path: str) -> str:
+    """GLM in the reference layout (GLMMojoWriter.writeModelData /
+    GlmMojoModel.glmScore0, GlmMultinomialMojoModel for multinomial):
+    cats-first row layout, catOffsets into a flat raw-scale beta, num
+    block, intercept last; multinomial concatenates the per-class betas
+    class-major (beta[i + c*P])."""
+    p = model.params
+    if p.family == "ordinal":
+        raise ValueError("reference-format GLM MOJO does not cover the "
+                         "ordinal family (thresholded cumulative etas "
+                         "have no GlmMojoModel analogue)")
+    info_d = model.data_info
+    cats = [n for n in info_d.predictor_names if n in info_d.cat_domains]
+    nums = [n for n in info_d.predictor_names
+            if n not in info_d.cat_domains]
+    if p.family == "multinomial":
+        beta = []
+        cat_offsets = None
+        for lv in info_d.response_domain:
+            cb, cat_offsets = _glm_class_beta(
+                info_d, cats, nums, model.coefficients_multinomial[lv])
+            beta.extend(cb)
+    else:
+        beta, cat_offsets = _glm_class_beta(
+            info_d, cats, nums, model.coefficients)
 
     columns = cats + nums + [p.response_column]
     dom_texts: Dict[str, str] = {}
@@ -204,7 +230,10 @@ def _write_glm_mojo(model, path: str) -> str:
         dom_texts[f"domains/d{di:03d}.txt"] = "\n".join(rdom) + "\n"
 
     nclasses = model.nclasses
-    category = ("Binomial" if nclasses == 2 else "Regression")
+    if p.family == "multinomial":
+        category = "Multinomial"
+    else:
+        category = "Binomial" if nclasses == 2 else "Regression"
     kv = [
         ("algorithm", "Generalized Linear Model"),
         ("algo", "glm"),
@@ -716,10 +745,7 @@ class RefMojo:
             return cached
 
         def arr(key, cast=float):
-            s = self.info[key].strip()
-            body = s[1:-1].strip()
-            return ([] if not body
-                    else [cast(x) for x in body.split(",")])
+            return _parse_jarr(self.info[key], cast)
 
         cached = {
             "cats": int(self.info["cats"]),
@@ -748,22 +774,38 @@ class RefMojo:
             for i in range(nums):
                 if np.isnan(data[cats + i]):
                     data[cats + i] = g["num_means"][i]
-        eta = 0.0
         use_all = self.info.get("use_all_factor_levels") == "true"
-        for i in range(cats):
-            # Java's (int) NaN is 0 — an unimputed NaN categorical maps
-            # to level 0 exactly like the reference runtime
-            iv = data[i]
-            ival = (0 if np.isnan(iv) else int(iv)) - (0 if use_all else 1)
-            if ival < 0:
-                continue
-            ival += cat_offsets[i]
-            if ival < cat_offsets[i + 1]:
-                eta += beta[ival]
-        noff = cat_offsets[cats] - cats
-        for i in range(cats, len(beta) - 1 - noff):
-            eta += beta[noff + i] * data[i]
-        eta += beta[-1]
+
+        def class_eta(cbeta):
+            eta = 0.0
+            for i in range(cats):
+                # Java's (int) NaN is 0 — an unimputed NaN categorical
+                # maps to level 0 exactly like the reference runtime
+                iv = data[i]
+                ival = (0 if np.isnan(iv) else int(iv)) - (
+                    0 if use_all else 1)
+                if ival < 0:
+                    continue
+                ival += cat_offsets[i]
+                if ival < cat_offsets[i + 1]:
+                    eta += cbeta[ival]
+            noff = cat_offsets[cats] - cats
+            for i in range(cats, len(cbeta) - 1 - noff):
+                eta += cbeta[noff + i] * data[i]
+            return eta + cbeta[-1]
+
+        if self.info.get("family") == "multinomial":
+            # GlmMultinomialMojoModel.glmScore0 — including its quirk of
+            # seeding the max with 0, not -inf
+            C = self.nclasses
+            P = len(beta) // C
+            etas = np.array([class_eta(beta[c * P:(c + 1) * P])
+                             for c in range(C)])
+            max_row = max(0.0, float(etas.max()))
+            e = np.exp(etas - max_row)
+            return e / e.sum()
+
+        eta = class_eta(beta)
         link = self.info.get("link", "identity")
         if link == "logit":
             mu = 1.0 / (1.0 + np.exp(-eta))
@@ -789,10 +831,7 @@ class RefMojo:
             return cached
 
         def arr(key):
-            body = self.info[key].strip()[1:-1].strip()
-            return np.asarray(
-                [float(x) for x in body.split(",")] if body else [],
-                np.float64)
+            return np.asarray(_parse_jarr(self.info[key]), np.float64)
 
         cached = {
             "centers": np.stack([
@@ -834,10 +873,7 @@ class RefMojo:
             return cached
 
         def arr(key):
-            body = self.info[key].strip()[1:-1].strip()
-            return np.asarray(
-                [float(x) for x in body.split(",")] if body else [],
-                np.float64)
+            return np.asarray(_parse_jarr(self.info[key]), np.float64)
 
         units = [int(u) for u in arr("neural_network_sizes")]
         layers = []

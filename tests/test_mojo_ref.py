@@ -238,13 +238,42 @@ class TestGlmReferenceMojo:
             got = mojo.score0(np.array([g[i], x0[i], x1[i]]))
             np.testing.assert_allclose(got[0], want[i], rtol=1e-8)
 
-    def test_multinomial_glm_refuses(self, rng, tmp_path):
+    def test_multinomial_with_categoricals(self, rng, tmp_path):
+        from h2o3_tpu.models.glm import GLM, GLMParameters
+
+        n = 500
+        X = rng.normal(size=(n, 2))
+        g = rng.integers(0, 3, size=n).astype(np.int32)
+        score = X[:, 0] + 0.5 * (g == 1) - 0.7 * X[:, 1]
+        y = np.clip(np.digitize(score, [-0.7, 0.7]), 0, 2).astype(np.int32)
+        fr = Frame([
+            Column("g", g, ColType.CAT, ["u", "v", "w"]),
+            Column("x0", X[:, 0]),
+            Column("x1", X[:, 1]),
+            Column("y", y, ColType.CAT, ["a", "b", "c"]),
+        ])
+        m = GLM(GLMParameters(response_column="y",
+                              family="multinomial")).train(fr)
+        path = str(tmp_path / "glm_mn.zip")
+        write_mojo(m, path)
+        mojo = read_mojo(path)
+        assert mojo.info["category"] == "Multinomial"
+        assert int(mojo.info["n_classes"]) == 3
+        want = m._predict_raw(fr)
+        gd = fr.col("g").data.astype(np.float64)
+        x0 = fr.col("x0").data
+        x1 = fr.col("x1").data
+        for i in range(0, n, 19):
+            got = mojo.score0(np.array([gd[i], x0[i], x1[i]]))
+            np.testing.assert_allclose(got, want[i], rtol=1e-6, atol=1e-8)
+
+    def test_ordinal_glm_refuses(self, rng, tmp_path):
         from h2o3_tpu.models.glm import GLM, GLMParameters
 
         fr = _frame(rng, nclass=3)
         m = GLM(GLMParameters(response_column="y",
-                              family="multinomial")).train(fr)
-        with pytest.raises(ValueError, match="single-eta"):
+                              family="ordinal")).train(fr)
+        with pytest.raises(ValueError, match="ordinal"):
             write_mojo(m, str(tmp_path / "x.zip"))
 
 
@@ -433,9 +462,7 @@ class TestDeepLearningReferenceMojo:
         mojo = read_mojo(path)
         assert mojo.info["algo"] == "deeplearning"
         assert mojo.info["activation"] == "Tanh"
-        from h2o3_tpu.models.data_info import expand_matrix
-        X, _ = expand_matrix(m.data_info, fr, dtype=np.float64)
-        # un-standardize back to raw inputs: the MOJO consumes raw rows
+        # the MOJO consumes raw rows (it normalizes internally)
         raw = np.stack([fr.col(f"x{i}").numeric_view() for i in range(5)],
                        axis=1)
         got = _score_all(mojo, raw)
