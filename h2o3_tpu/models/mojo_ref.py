@@ -564,9 +564,90 @@ def _write_dl_mojo(model, path: str) -> str:
     return _zip_write(path, lines, dom_texts, {})
 
 
+def _write_te_mojo(model, path: str) -> str:
+    """TargetEncoder in the reference layout (TargetEncoderMojoWriter):
+    an ``encoding_map.ini`` of ``[column]`` sections with
+    ``code = numerator denominator`` lines, NA-presence and column
+    mapping files under ``feature_engineering/target_encoding/``, and
+    blending kv. This framework's NA handling maps unseen/missing
+    levels to the prior, which is the reference scorer's path when the
+    column's NA-presence flag is 0 — so every flag is written 0."""
+    p = model.params
+    cols = list(model.encodings)
+    columns = cols + [p.response_column]
+    dom_texts: Dict[str, str] = {}
+    dom_lines = []
+    for ci, c in enumerate(cols):
+        dom = model.encodings[c][0]
+        dom_lines.append(f"{ci}: {len(dom)} d{ci:03d}.txt")
+        dom_texts[f"domains/d{ci:03d}.txt"] = "\n".join(dom) + "\n"
+    rdom = model.data_info.response_domain
+    if rdom:
+        dom_lines.append(
+            f"{len(columns) - 1}: {len(rdom)} d{len(cols):03d}.txt")
+        dom_texts[f"domains/d{len(cols):03d}.txt"] = "\n".join(rdom) + "\n"
+
+    kv = [
+        ("algorithm", "TargetEncoder"),
+        ("algo", "targetencoder"),
+        ("category", "TargetEncoder"),
+        ("uuid", str(_uuid.uuid4())),
+        ("supervised", "true"),
+        ("n_features", len(cols)),
+        ("n_classes", 2 if rdom else 1),
+        ("n_columns", len(columns)),
+        ("n_domains", len(dom_lines)),
+        ("balance_classes", "false"),
+        ("default_threshold", 0.5),
+        ("prior_class_distrib", "null"),
+        ("model_class_distrib", "null"),
+        ("mojo_version", "1.00"),
+        ("h2o_version", "h2o3-tpu"),
+        ("keep_original_categorical_columns",
+         "true" if p.keep_original_categorical_columns else "false"),
+        ("with_blending", "true" if p.blending else "false"),
+    ]
+    if p.blending:
+        kv.append(("inflection_point", p.inflection_point))
+        kv.append(("smoothing", p.smoothing))
+    kv.append(("non_predictors", p.response_column))
+
+    base = "feature_engineering/target_encoding"
+    enc_lines = []
+    for c in cols:
+        dom, num, den = model.encodings[c]
+        enc_lines.append(f"[{c}]")
+        for code in range(len(dom)):
+            enc_lines.append(
+                f"{code} = {float(num[code])!r} {float(den[code])!r}")
+        # the reference scorer derives each column's prior as
+        # Σnum/Σden over its map; rows whose code was NA are absent from
+        # the per-level sums, so without correction the map prior would
+        # drift from this model's global prior_mean. One synthetic
+        # category (an unused code — levels only go to len(dom)-1, and
+        # the NA-presence flag is 0 so it is never looked up) restores
+        # Σnum/Σden == prior_mean exactly.
+        resid_den = 1.0
+        resid_num = model.prior_mean * (float(den.sum()) + resid_den) \
+            - float(num.sum())
+        enc_lines.append(f"{len(dom)} = {resid_num!r} {resid_den!r}")
+    dom_texts[f"{base}/encoding_map.ini"] = "\n".join(enc_lines) + "\n"
+    dom_texts[f"{base}/te_column_name_to_missing_values_presence.ini"] = (
+        "\n".join(f"{c} = 0" for c in cols) + "\n")
+    dom_texts[f"{base}/input_encoding_columns_map.ini"] = "\n".join(
+        f"[from]\n{c}\n[to]\n{c}" for c in cols) + "\n"
+    dom_texts[f"{base}/input_output_columns_map.ini"] = "\n".join(
+        f"[from]\n{c}\n[to]\n{c}_te" for c in cols) + "\n"
+
+    lines = ["[info]"]
+    lines += [f"{k} = {v}" for k, v in kv]
+    lines += ["", "[columns]"] + columns + ["", "[domains]"] + dom_lines
+    return _zip_write(path, lines, dom_texts, {})
+
+
 def write_mojo(model, path: str) -> str:
-    """Serialize a GBM, DRF, GLM, KMeans, IsolationForest, Word2Vec or
-    DeepLearning model into the reference MOJO layout."""
+    """Serialize a GBM, DRF, GLM, KMeans, IsolationForest, Word2Vec,
+    DeepLearning or TargetEncoder model into the reference MOJO layout."""
     from h2o3_tpu.models.tree.common import tree_feature_names
 
     algo = model.algo_name
@@ -580,6 +661,7 @@ def write_mojo(model, path: str) -> str:
         "isolationforest": _write_isofor_mojo,
         "word2vec": _write_word2vec_mojo,
         "deeplearning": _write_dl_mojo,
+        "targetencoder": _write_te_mojo,
     }
     if algo in writers:
         return writers[algo](model, path)
@@ -915,9 +997,46 @@ class RefMojo:
             return e / e.sum()
         return np.array([x[0]])
 
+    def te_transform(self, levels: Dict[str, float]) -> Dict[str, float]:
+        """TargetEncoderMojoModel.score0 semantics: per encoded column,
+        numerator/denominator lookup by level code with optional blending
+        against the column map's prior (Σnum/Σden); NaN/unseen levels
+        take the prior (every NA-presence flag is written 0)."""
+        blending = self.info.get("with_blending") == "true"
+        k = float(self.info.get("inflection_point", 10.0))
+        f = float(self.info.get("smoothing", 20.0))
+        priors = getattr(self, "_te_priors", None)
+        if priors is None:  # per-column Σnum/Σden, computed once
+            priors = {
+                col: (sum(v[0] for v in emap.values())
+                      / max(sum(v[1] for v in emap.values()), 1e-300))
+                for col, emap in self.te_encodings.items()
+            }
+            self._te_priors = priors
+        out: Dict[str, float] = {}
+        for col in self.te_columns:
+            emap = self.te_encodings[col]
+            prior = priors[col]
+            cat = levels.get(col, float("nan"))
+            if cat is None or (isinstance(cat, float) and np.isnan(cat)) \
+                    or int(cat) not in emap:
+                out[f"{col}_te"] = prior
+                continue
+            num, den = emap[int(cat)]
+            post = num / den if den else prior
+            if blending:
+                lam = 1.0 / (1.0 + np.exp((k - den) / max(f, 1e-12)))
+                post = lam * post + (1.0 - lam) * prior
+            out[f"{col}_te"] = post
+        return out
+
     def score0(self, row: np.ndarray) -> np.ndarray:
         """Gbm/Drf/Glm/KMeansMojoModel semantics over the decoded payload."""
         algo = self.info.get("algo", "gbm")
+        if algo == "targetencoder":
+            raise ValueError(
+                "TargetEncoder MOJOs transform rows rather than score "
+                "them — use te_transform({column: level_code, ...})")
         if algo == "glm":  # no trees to walk
             return self._glm_score0(row)
         if algo == "deeplearning":
@@ -999,6 +1118,33 @@ def read_mojo(path: str) -> RefMojo:
                 z.read(f"trees/t{c:02d}_{t:03d}.bin")
                 for t in range(ntrees)
             ])
+        if m.info.get("algo") == "targetencoder":
+            base = "feature_engineering/target_encoding"
+            enc: Dict[str, Dict[int, tuple]] = {}
+            cur = None
+            for line in z.read(f"{base}/encoding_map.ini").decode() \
+                    .splitlines():
+                line = line.strip()
+                if line.startswith("[") and line.endswith("]"):
+                    cur = line[1:-1]
+                    enc[cur] = {}
+                elif line and cur is not None:
+                    k, _, v = line.partition("=")
+                    parts = v.split()
+                    enc[cur][int(k)] = (float(parts[0]), float(parts[1]))
+            m.te_encodings = enc
+            order = []
+            in_from = False
+            for line in z.read(f"{base}/input_encoding_columns_map.ini") \
+                    .decode().splitlines():
+                line = line.strip()
+                if line == "[from]":
+                    in_from = True
+                elif line.startswith("["):
+                    in_from = False
+                elif line and in_from:
+                    order.append(line)
+            m.te_columns = order or list(enc)
         if m.info.get("algo") == "word2vec":
             words = [
                 _unescape_vocab_word(w)

@@ -481,3 +481,47 @@ class TestDeepLearningReferenceMojo:
                          seed=1).train(fr)
         with pytest.raises(ValueError, match="autoencoder"):
             write_mojo(m, str(tmp_path / "ae.zip"))
+
+
+class TestTargetEncoderReferenceMojo:
+    """TargetEncoderMojoWriter layout: encoding_map.ini sections +
+    NA-presence and column-mapping files, blending kv."""
+
+    @pytest.mark.parametrize("blending", [False, True])
+    def test_transform_parity(self, rng, tmp_path, blending):
+        from h2o3_tpu.models.target_encoder import TargetEncoder
+
+        n = 600
+        g1 = np.array(["a", "b", "c", "d"])[rng.integers(0, 4, n)]
+        g2 = np.array(["x", "y"])[rng.integers(0, 2, n)]
+        y = ((g1 == "a") | (rng.random(n) < 0.3)).astype(np.int32)
+        g1c = Column("g1", g1).as_factor()
+        # inject NA codes: the map-derived prior must still equal the
+        # model's global prior (the writer's synthetic correction row)
+        g1c.data[rng.random(n) < 0.1] = -1
+        fr = Frame([
+            g1c,
+            Column("g2", g2).as_factor(),
+            Column("y", y, ColType.CAT, ["n", "p"]),
+        ])
+        m = TargetEncoder(response_column="y", blending=blending,
+                          noise=0.0).train(fr)
+        path = str(tmp_path / f"te_{blending}.zip")
+        write_mojo(m, path)
+        mojo = read_mojo(path)
+        assert mojo.info["algo"] == "targetencoder"
+        assert set(mojo.te_columns) == {"g1", "g2"}
+        want = m.transform(fr)
+        c1 = fr.col("g1").data
+        c2 = fr.col("g2").data
+        w1 = want.col("g1_te").numeric_view()
+        w2 = want.col("g2_te").numeric_view()
+        for i in range(0, n, 29):
+            got = mojo.te_transform(
+                {"g1": float(c1[i]), "g2": float(c2[i])})
+            np.testing.assert_allclose(got["g1_te"], w1[i], rtol=1e-10)
+            np.testing.assert_allclose(got["g2_te"], w2[i], rtol=1e-10)
+        # unseen level falls back to the prior
+        got = mojo.te_transform({"g1": float("nan"), "g2": 0.0})
+        prior = float(np.mean(y))
+        np.testing.assert_allclose(got["g1_te"], prior, rtol=1e-10)
