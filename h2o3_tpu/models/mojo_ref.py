@@ -564,6 +564,97 @@ def _write_dl_mojo(model, path: str) -> str:
     return _zip_write(path, lines, dom_texts, {})
 
 
+def _write_pca_mojo(model, path: str) -> str:
+    """PCA in the reference layout (PCAMojoWriter / PCAMojoModel.score0):
+    eigenvectors_raw blob of big-endian doubles [ncoefs, k] in CATS-FIRST
+    coefficient order, normSub/normMul over the num block, catOffsets,
+    and a permutation mapping the cats-first positions back to this
+    model's column order. NA semantics differ from in-framework predict
+    (the reference skips NA cats and propagates NaN nums; this framework
+    mean/mode-imputes), so parity holds on NA-free rows."""
+    info = model.data_info
+    cats = [n for n in info.predictor_names if n in info.cat_domains]
+    nums = [n for n in info.predictor_names if n not in info.cat_domains]
+    skip = 0 if info.use_all_factor_levels else 1
+    k = model.eigenvectors.shape[1]
+
+    # our expanded design matrix is interleaved in predictor order;
+    # reorder its rows into the cats-first layout the scorer expects
+    offsets = {}
+    off = 0
+    for name in info.predictor_names:
+        if name in info.cat_domains:
+            offsets[name] = off
+            off += len(info.cat_domains[name]) - skip
+        else:
+            offsets[name] = off
+            off += 1
+    order: List[int] = []
+    cat_offsets = [0]
+    for c in cats:
+        width = len(info.cat_domains[c]) - skip
+        order.extend(range(offsets[c], offsets[c] + width))
+        cat_offsets.append(cat_offsets[-1] + width)
+    for n in nums:
+        order.append(offsets[n])
+    ev = np.asarray(model.eigenvectors, np.float64)[order]  # [ncoefs, k]
+
+    # permutation: raw-row position (predictor order) of each cats-first
+    # column index
+    pos = {name: i for i, name in enumerate(info.predictor_names)}
+    permutation = [pos[c] for c in cats] + [pos[n] for n in nums]
+
+    standardize = bool(getattr(info, "standardize", False))
+    if standardize:
+        sub = [info.num_means[n] for n in nums]
+        mul = [1.0 / max(info.num_sds[n], 1e-300) for n in nums]
+    else:
+        sub = [0.0] * len(nums)
+        mul = [1.0] * len(nums)
+
+    columns = cats + nums
+    dom_texts: Dict[str, str] = {}
+    dom_lines = []
+    for ci, c in enumerate(cats):
+        dom = info.cat_domains[c]
+        dom_lines.append(f"{ci}: {len(dom)} d{ci:03d}.txt")
+        dom_texts[f"domains/d{ci:03d}.txt"] = "\n".join(dom) + "\n"
+    kv = [
+        ("algorithm", "Principal Components Analysis"),
+        ("algo", "pca"),
+        ("category", "DimReduction"),
+        ("uuid", str(_uuid.uuid4())),
+        ("supervised", "false"),
+        ("n_features", len(columns)),
+        ("n_classes", 1),
+        ("n_columns", len(columns)),
+        ("n_domains", len(dom_lines)),
+        ("balance_classes", "false"),
+        ("default_threshold", 0.5),
+        ("prior_class_distrib", "null"),
+        ("model_class_distrib", "null"),
+        ("mojo_version", "1.00"),
+        ("h2o_version", "h2o3-tpu"),
+        ("pcaMethod", "GramSVD"),
+        ("pca_impl", "MTJ_EVD_SYMMMATRIX"),
+        ("k", k),
+        ("use_all_factor_levels",
+         "true" if info.use_all_factor_levels else "false"),
+        ("permutation", "[" + ", ".join(map(str, permutation)) + "]"),
+        ("ncats", len(cats)),
+        ("nnums", len(nums)),
+        ("normSub", _jarr(sub)),
+        ("normMul", _jarr(mul)),
+        ("catOffsets", "[" + ", ".join(map(str, cat_offsets)) + "]"),
+        ("eigenvector_size", ev.shape[0]),
+    ]
+    lines = ["[info]"]
+    lines += [f"{k_} = {v}" for k_, v in kv]
+    lines += ["", "[columns]"] + columns + ["", "[domains]"] + dom_lines
+    blobs = {"eigenvectors_raw": ev.astype(">f8").tobytes()}
+    return _zip_write(path, lines, dom_texts, blobs)
+
+
 def _write_te_mojo(model, path: str) -> str:
     """TargetEncoder in the reference layout (TargetEncoderMojoWriter):
     an ``encoding_map.ini`` of ``[column]`` sections with
@@ -647,7 +738,8 @@ def _write_te_mojo(model, path: str) -> str:
 
 def write_mojo(model, path: str) -> str:
     """Serialize a GBM, DRF, GLM, KMeans, IsolationForest, Word2Vec,
-    DeepLearning or TargetEncoder model into the reference MOJO layout."""
+    DeepLearning, TargetEncoder or PCA model into the reference MOJO
+    layout."""
     from h2o3_tpu.models.tree.common import tree_feature_names
 
     algo = model.algo_name
@@ -662,6 +754,7 @@ def write_mojo(model, path: str) -> str:
         "word2vec": _write_word2vec_mojo,
         "deeplearning": _write_dl_mojo,
         "targetencoder": _write_te_mojo,
+        "pca": _write_pca_mojo,
     }
     if algo in writers:
         return writers[algo](model, path)
@@ -997,6 +1090,49 @@ class RefMojo:
             return e / e.sum()
         return np.array([x[0]])
 
+    def _pca_arrays(self):
+        """Parse the PCA kv arrays ONCE and cache (score0 is per-row)."""
+        cached = getattr(self, "_pca_cache", None)
+        if cached is not None:
+            return cached
+        cached = {
+            "ncats": int(self.info["ncats"]),
+            "nnums": int(self.info["nnums"]),
+            "k": int(self.info["k"]),
+            "perm": _parse_jarr(self.info["permutation"], int),
+            "cat_offsets": _parse_jarr(self.info["catOffsets"], int),
+            "sub": np.asarray(_parse_jarr(self.info["normSub"])),
+            "mul": np.asarray(_parse_jarr(self.info["normMul"])),
+        }
+        self._pca_cache = cached
+        return cached
+
+    def _pca_score0(self, row: np.ndarray) -> np.ndarray:
+        """PCAMojoModel.score0: per component, sum the one-hot cat
+        eigenvector entries (NA cats skipped) plus normalized nums times
+        the num-block entries."""
+        p = self._pca_arrays()
+        ncats, nnums, kcomp = p["ncats"], p["nnums"], p["k"]
+        perm, cat_offsets = p["perm"], p["cat_offsets"]
+        sub, mul = p["sub"], p["mul"]
+        use_all = self.info.get("use_all_factor_levels") == "true"
+        ev = self.eigenvectors
+        num_start = cat_offsets[ncats]
+        out = np.zeros(kcomp)
+        for j in range(ncats):
+            v = row[perm[j]]
+            if np.isnan(v):
+                continue  # missing categoricals are skipped
+            last = cat_offsets[j + 1] - cat_offsets[j] - 1
+            level = int(v) - (0 if use_all else 1)
+            if level < 0 or level > last:
+                continue  # unseen test level
+            out += ev[cat_offsets[j] + level]
+        for j in range(nnums):
+            out += (row[perm[ncats + j]] - sub[j]) * mul[j] * \
+                ev[num_start + j]
+        return out
+
     def te_transform(self, levels: Dict[str, float]) -> Dict[str, float]:
         """TargetEncoderMojoModel.score0 semantics: per encoded column,
         numerator/denominator lookup by level code with optional blending
@@ -1018,8 +1154,12 @@ class RefMojo:
             emap = self.te_encodings[col]
             prior = priors[col]
             cat = levels.get(col, float("nan"))
+            # the map's LAST code is the writer's synthetic
+            # prior-correction entry, not a real level: out-of-domain
+            # codes must take the prior fallback, never that residual
+            n_levels = len(emap) - 1
             if cat is None or (isinstance(cat, float) and np.isnan(cat)) \
-                    or int(cat) not in emap:
+                    or not (0 <= int(cat) < n_levels):
                 out[f"{col}_te"] = prior
                 continue
             num, den = emap[int(cat)]
@@ -1041,6 +1181,8 @@ class RefMojo:
             return self._glm_score0(row)
         if algo == "deeplearning":
             return self._dl_score0(row)
+        if algo == "pca":
+            return self._pca_score0(row)
         if algo == "kmeans":
             return self._kmeans_score0(row)
         if algo == "isolation_forest":
@@ -1118,6 +1260,12 @@ def read_mojo(path: str) -> RefMojo:
                 z.read(f"trees/t{c:02d}_{t:03d}.bin")
                 for t in range(ntrees)
             ])
+        if m.info.get("algo") == "pca":
+            ncoefs = int(m.info["eigenvector_size"])
+            kcomp = int(m.info["k"])
+            m.eigenvectors = np.frombuffer(
+                z.read("eigenvectors_raw"), ">f8"
+            ).reshape(ncoefs, kcomp)
         if m.info.get("algo") == "targetencoder":
             base = "feature_engineering/target_encoding"
             enc: Dict[str, Dict[int, tuple]] = {}

@@ -525,3 +525,34 @@ class TestTargetEncoderReferenceMojo:
         got = mojo.te_transform({"g1": float("nan"), "g2": 0.0})
         prior = float(np.mean(y))
         np.testing.assert_allclose(got["g1_te"], prior, rtol=1e-10)
+
+
+class TestPCAReferenceMojo:
+    """PCAMojoWriter layout: big-endian eigenvectors_raw blob in
+    cats-first order + permutation/catOffsets/norm arrays."""
+
+    def test_projection_parity_with_categoricals(self, rng, tmp_path):
+        from h2o3_tpu.models.pca import PCA
+
+        n = 400
+        X = rng.normal(size=(n, 3))
+        g = rng.integers(0, 3, size=n).astype(np.int32)
+        fr = Frame([
+            Column("x0", X[:, 0]),
+            Column("g", g, ColType.CAT, ["u", "v", "w"]),
+            Column("x1", X[:, 1]),
+            Column("x2", X[:, 2]),
+        ])
+        m = PCA(k=3, seed=1).train(fr)
+        path = str(tmp_path / "pca.zip")
+        write_mojo(m, path)
+        mojo = read_mojo(path)
+        assert mojo.info["algo"] == "pca"
+        assert int(mojo.info["k"]) == 3
+        want = m._predict_raw(fr)
+        # raw rows in predictor order: [x0, g, x1, x2]
+        gd = fr.col("g").data.astype(np.float64)
+        for i in range(0, n, 31):
+            row = np.array([X[i, 0], gd[i], X[i, 1], X[i, 2]])
+            got = mojo.score0(row)
+            np.testing.assert_allclose(got, want[i], rtol=1e-4, atol=1e-5)
