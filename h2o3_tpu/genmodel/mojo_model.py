@@ -537,6 +537,14 @@ class PcaMojoModel(MojoModel):
 
     def _score_rows(self, rows):
         X = self.layout.expand(rows)
+        # training-time demean/descale statistics (absent for
+        # standardize/none, which the layout expansion already applies)
+        sub = self._arrays.get("transform_sub")
+        mul = self._arrays.get("transform_mul")
+        if sub is not None:
+            X = X - sub
+        if mul is not None:
+            X = X * mul
         return X @ self._arrays["eigenvectors"]
 
 

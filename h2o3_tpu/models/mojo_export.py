@@ -144,9 +144,19 @@ def _payload(model: Model) -> Payload:
         )
 
     if isinstance(model, PCAModel):
-        return {"algo": "pca"}, {
+        arrays = {
             "eigenvectors": np.asarray(model.eigenvectors, dtype=np.float64)
         }
+        # demean/descale statistics live OUTSIDE the design-matrix layout;
+        # without them the offline scorer would project un-transformed rows
+        # onto transformed-space eigenvectors
+        if model.transform_sub is not None:
+            arrays["transform_sub"] = np.asarray(
+                model.transform_sub, dtype=np.float64)
+        if model.transform_mul is not None:
+            arrays["transform_mul"] = np.asarray(
+                model.transform_mul, dtype=np.float64)
+        return {"algo": "pca"}, arrays
 
     raise ValueError(f"MOJO export not supported for {type(model).__name__}")
 

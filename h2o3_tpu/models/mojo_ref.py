@@ -604,8 +604,20 @@ def _write_pca_mojo(model, path: str) -> str:
     pos = {name: i for i, name in enumerate(info.predictor_names)}
     permutation = [pos[c] for c in cats] + [pos[n] for n in nums]
 
-    standardize = bool(getattr(info, "standardize", False))
-    if standardize:
+    # normSub/normMul carry the training-time transform. This model's
+    # demean/descale statistics cover the EXPANDED matrix (one-hot cat
+    # columns included), but the reference scorer only normalizes the
+    # num block — those modes are not representable in the format
+    transform = getattr(model.params, "transform",
+                        "standardize" if getattr(info, "standardize", False)
+                        else "none")
+    if transform in ("demean", "descale"):
+        raise ValueError(
+            "reference-format PCA MOJO covers transform='standardize' or "
+            "'none'; demean/descale statistics span the expanded one-hot "
+            "columns, which PCAMojoModel's num-only normalization cannot "
+            "express")
+    if transform == "standardize":
         sub = [info.num_means[n] for n in nums]
         mul = [1.0 / max(info.num_sds[n], 1e-300) for n in nums]
     else:
@@ -1149,17 +1161,31 @@ class RefMojo:
                 for col, emap in self.te_encodings.items()
             }
             self._te_priors = priors
+        bounds = getattr(self, "_te_bounds", None)
+        if bounds is None:
+            # valid level codes come from the column's DOMAIN, not the
+            # map length: this writer appends one synthetic
+            # prior-correction entry past the domain (never a real
+            # level), while a foreign reference writer emits exactly the
+            # domain — either way the domain bound is right
+            bounds = {}
+            for col in self.te_columns:
+                try:
+                    ci = self.columns.index(col)
+                    bounds[col] = len(self.domains[ci])
+                except (ValueError, KeyError):
+                    bounds[col] = len(self.te_encodings[col]) - 1
+            self._te_bounds = bounds
         out: Dict[str, float] = {}
         for col in self.te_columns:
             emap = self.te_encodings[col]
             prior = priors[col]
             cat = levels.get(col, float("nan"))
-            # the map's LAST code is the writer's synthetic
-            # prior-correction entry, not a real level: out-of-domain
-            # codes must take the prior fallback, never that residual
-            n_levels = len(emap) - 1
+            # a level inside the domain can still be absent from a
+            # foreign writer's map (unseen in training): prior fallback
             if cat is None or (isinstance(cat, float) and np.isnan(cat)) \
-                    or not (0 <= int(cat) < n_levels):
+                    or not (0 <= int(cat) < bounds[col]) \
+                    or int(cat) not in emap:
                 out[f"{col}_te"] = prior
                 continue
             num, den = emap[int(cat)]

@@ -45,6 +45,10 @@ class PCAModel(Model):
     def __init__(self, params, data_info):
         super().__init__(params, data_info)
         self.eigenvectors: Optional[np.ndarray] = None  # [D, k]
+        #: expanded-space demean/descale statistics from training (None
+        #: for standardize/none, which expand_matrix handles itself)
+        self.transform_sub: Optional[np.ndarray] = None
+        self.transform_mul: Optional[np.ndarray] = None
         self.std_deviation: Optional[np.ndarray] = None  # [k]
         self.pve: Optional[np.ndarray] = None  # proportion of variance explained
         self.cum_pve: Optional[np.ndarray] = None
@@ -55,6 +59,13 @@ class PCAModel(Model):
 
     def _predict_raw(self, frame: Frame) -> np.ndarray:
         X, _ = expand_matrix(self.data_info, frame, dtype=np.float32)
+        # demean/descale are applied OUTSIDE expand_matrix at fit time;
+        # scoring must re-apply the TRAINING statistics or the projection
+        # is computed in a different space than the eigenvectors
+        if self.transform_sub is not None:
+            X = X - self.transform_sub
+        if self.transform_mul is not None:
+            X = X * self.transform_mul
         return X @ self.eigenvectors
 
     def predict(self, frame: Frame) -> Frame:
@@ -84,14 +95,19 @@ class PCA(ModelBuilder):
         X, _ = expand_matrix(info, frame, dtype=np.float32)
         # transform semantics (hex/DataInfo TransformType): STANDARDIZE is done
         # inside expand_matrix; DEMEAN centers only; DESCALE scales only
+        tsub = tmul = None
         if p.transform == "demean":
-            X = X - X.mean(axis=0, keepdims=True)
+            tsub = X.mean(axis=0, keepdims=True)
+            X = X - tsub
         elif p.transform == "descale":
             sd = X.std(axis=0, ddof=1, keepdims=True)
-            X = X / np.where(sd > 0, sd, 1.0)
+            tmul = 1.0 / np.where(sd > 0, sd, 1.0)
+            X = X * tmul
         n, D = X.shape
         k = min(p.k, D)
         model = PCAModel(p, info)
+        model.transform_sub = tsub
+        model.transform_mul = tmul
 
         mesh = default_mesh()
         Xd, _ = shard_rows(X, mesh)
@@ -153,6 +169,8 @@ class SVD(ModelBuilder):
         model.v = pca_model.eigenvectors
         model.d = pca_model.std_deviation * np.sqrt(max(n - 1, 1))
         model.eigenvectors = pca_model.eigenvectors
+        model.transform_sub = pca_model.transform_sub
+        model.transform_mul = pca_model.transform_mul
         model.std_deviation = pca_model.std_deviation
         model.pve = pca_model.pve
         model.cum_pve = pca_model.cum_pve

@@ -193,3 +193,31 @@ def test_autoencoder_predict_reconstruction_frame(mesh, rng):
     rec = m.predict(fr)
     assert rec.ncols == 4 and rec.nrows == 300
     assert all(n.startswith("reconstr_") for n in rec.names)
+
+
+def test_pca_demean_predict_consistency(mesh, rng):
+    """demean/descale statistics from training must be re-applied at
+    scoring: projecting the TRAINING frame must equal projecting the
+    transformed design matrix the eigenvectors were fit on."""
+    from h2o3_tpu.frame.frame import Column, Frame
+    from h2o3_tpu.models.data_info import expand_matrix
+    from h2o3_tpu.models.pca import PCA
+
+    X = rng.normal(size=(300, 4)) + 5.0  # offset so demean matters
+    X[:, 0] *= 10.0  # sd far from 1 so descale matters too
+    fr = Frame([Column(f"x{i}", X[:, i]) for i in range(4)])
+    for transform in ("demean", "descale"):
+        m = PCA(k=2, transform=transform, seed=1).train(fr)
+        Xe, _ = expand_matrix(m.data_info, fr, dtype=np.float32)
+        if m.transform_sub is not None:
+            Xe = Xe - m.transform_sub
+        if m.transform_mul is not None:
+            Xe = Xe * m.transform_mul
+        want = Xe @ m.eigenvectors
+        got = m._predict_raw(fr)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+        # regression guard for the actual bug: raw projection (no
+        # transform) must NOT match when the transform shifts the data
+        raw = (expand_matrix(m.data_info, fr, dtype=np.float32)[0]
+               @ m.eigenvectors)
+        assert not np.allclose(got, raw, atol=1e-3)
