@@ -573,30 +573,10 @@ def _write_pca_mojo(model, path: str) -> str:
     (the reference skips NA cats and propagates NaN nums; this framework
     mean/mode-imputes), so parity holds on NA-free rows."""
     info = model.data_info
-    cats = [n for n in info.predictor_names if n in info.cat_domains]
-    nums = [n for n in info.predictor_names if n not in info.cat_domains]
-    skip = 0 if info.use_all_factor_levels else 1
     k = model.eigenvectors.shape[1]
-
     # our expanded design matrix is interleaved in predictor order;
     # reorder its rows into the cats-first layout the scorer expects
-    offsets = {}
-    off = 0
-    for name in info.predictor_names:
-        if name in info.cat_domains:
-            offsets[name] = off
-            off += len(info.cat_domains[name]) - skip
-        else:
-            offsets[name] = off
-            off += 1
-    order: List[int] = []
-    cat_offsets = [0]
-    for c in cats:
-        width = len(info.cat_domains[c]) - skip
-        order.extend(range(offsets[c], offsets[c] + width))
-        cat_offsets.append(cat_offsets[-1] + width)
-    for n in nums:
-        order.append(offsets[n])
+    order, cat_offsets, cats, nums = _coefs_cats_first(info)
     ev = np.asarray(model.eigenvectors, np.float64)[order]  # [ncoefs, k]
 
     # permutation: raw-row position (predictor order) of each cats-first
@@ -664,6 +644,86 @@ def _write_pca_mojo(model, path: str) -> str:
     lines += [f"{k_} = {v}" for k_, v in kv]
     lines += ["", "[columns]"] + columns + ["", "[domains]"] + dom_lines
     blobs = {"eigenvectors_raw": ev.astype(">f8").tobytes()}
+    return _zip_write(path, lines, dom_texts, blobs)
+
+
+def _coefs_cats_first(info):
+    """(order, cat_offsets, cats, nums): indices reordering this
+    framework's interleaved expanded coefficient space into the
+    reference's cats-first layout."""
+    cats = [n for n in info.predictor_names if n in info.cat_domains]
+    nums = [n for n in info.predictor_names if n not in info.cat_domains]
+    skip = 0 if info.use_all_factor_levels else 1
+    offsets = {}
+    off = 0
+    for name in info.predictor_names:
+        offsets[name] = off
+        off += (len(info.cat_domains[name]) - skip
+                if name in info.cat_domains else 1)
+    order: List[int] = []
+    cat_offsets = [0]
+    for c in cats:
+        width = len(info.cat_domains[c]) - skip
+        order.extend(range(offsets[c], offsets[c] + width))
+        cat_offsets.append(cat_offsets[-1] + width)
+    for n in nums:
+        order.append(offsets[n])
+    return order, cat_offsets, cats, nums
+
+
+def _write_coxph_mojo(model, path: str) -> str:
+    """CoxPH in the reference layout (CoxPHMojoWriter /
+    CoxPHMojoModel.score0): cats-first coef kv, x_mean_cat/x_mean_num
+    rectangular blobs (big-endian doubles + _size1/_size2 kv) whose
+    coef-weighted sum forms lpBase, so the scored linear predictor is
+    coef·(x − x̄) exactly like this framework's predict. No strata
+    (strata_count = 0; the reference scorer then always uses row 0)."""
+    info = model.data_info
+    order, cat_offsets, cats, nums = _coefs_cats_first(info)
+    beta = np.asarray(model.beta, np.float64)[order]
+    means = np.asarray(model.feature_means, np.float64).reshape(-1)[order]
+    ncatc = cat_offsets[-1]
+    columns = cats + nums
+    dom_texts: Dict[str, str] = {}
+    dom_lines = []
+    for ci, c in enumerate(cats):
+        dom = info.cat_domains[c]
+        dom_lines.append(f"{ci}: {len(dom)} d{ci:03d}.txt")
+        dom_texts[f"domains/d{ci:03d}.txt"] = "\n".join(dom) + "\n"
+    kv = [
+        ("algorithm", "CoxPH"),
+        ("algo", "coxph"),
+        ("category", "CoxPH"),
+        ("uuid", str(_uuid.uuid4())),
+        ("supervised", "true"),
+        ("n_features", len(columns)),
+        ("n_classes", 1),
+        ("n_columns", len(columns)),
+        ("n_domains", len(dom_lines)),
+        ("balance_classes", "false"),
+        ("default_threshold", 0.5),
+        ("prior_class_distrib", "null"),
+        ("model_class_distrib", "null"),
+        ("mojo_version", "1.00"),
+        ("h2o_version", "h2o3-tpu"),
+        ("coef", _jarr(beta)),
+        ("cats", len(cats)),
+        ("cat_offsets", "[" + ", ".join(map(str, cat_offsets)) + "]"),
+        ("use_all_factor_levels",
+         "true" if info.use_all_factor_levels else "false"),
+        ("x_mean_cat_size1", 1),
+        ("x_mean_cat_size2", ncatc),
+        ("x_mean_num_size1", 1),
+        ("x_mean_num_size2", len(nums)),
+        ("strata_count", 0),
+    ]
+    lines = ["[info]"]
+    lines += [f"{k} = {v}" for k, v in kv]
+    lines += ["", "[columns]"] + columns + ["", "[domains]"] + dom_lines
+    blobs = {
+        "x_mean_cat": means[:ncatc].astype(">f8").tobytes(),
+        "x_mean_num": means[ncatc:].astype(">f8").tobytes(),
+    }
     return _zip_write(path, lines, dom_texts, blobs)
 
 
@@ -767,6 +827,7 @@ def write_mojo(model, path: str) -> str:
         "deeplearning": _write_dl_mojo,
         "targetencoder": _write_te_mojo,
         "pca": _write_pca_mojo,
+        "coxph": _write_coxph_mojo,
     }
     if algo in writers:
         return writers[algo](model, path)
@@ -1145,6 +1206,42 @@ class RefMojo:
                 ev[num_start + j]
         return out
 
+    def _coxph_score0(self, row: np.ndarray) -> np.ndarray:
+        """CoxPHMojoModel.score0 (no strata): lp = forCategories +
+        forOtherColumns − lpBase, with lpBase = x̄·coef from the
+        x_mean_cat/x_mean_num blobs — i.e. coef·(x − x̄)."""
+        cached = getattr(self, "_coxph_cache", None)
+        if cached is None:
+            coef = np.asarray(_parse_jarr(self.info["coef"]))
+            cat_offsets = _parse_jarr(self.info["cat_offsets"], int)
+            ncatc = cat_offsets[-1]
+            means = np.concatenate([self.x_mean_cat, self.x_mean_num])
+            cached = {
+                "coef": coef,
+                "cat_offsets": cat_offsets,
+                "cats": int(self.info["cats"]),
+                "lp_base": float(means @ coef),
+                "use_all": self.info.get(
+                    "use_all_factor_levels") == "true",
+                "ncatc": ncatc,
+            }
+            self._coxph_cache = cached
+        coef = cached["coef"]
+        cat_offsets = cached["cat_offsets"]
+        cats = cached["cats"]
+        lp = 0.0
+        for j in range(cats):
+            v = row[j]
+            if np.isnan(v):
+                continue
+            level = int(v) - (0 if cached["use_all"] else 1)
+            if level < 0 or level >= cat_offsets[j + 1] - cat_offsets[j]:
+                continue
+            lp += coef[cat_offsets[j] + level]
+        for j in range(len(coef) - cached["ncatc"]):
+            lp += coef[cached["ncatc"] + j] * row[cats + j]
+        return np.array([lp - cached["lp_base"]])
+
     def te_transform(self, levels: Dict[str, float]) -> Dict[str, float]:
         """TargetEncoderMojoModel.score0 semantics: per encoded column,
         numerator/denominator lookup by level code with optional blending
@@ -1209,6 +1306,8 @@ class RefMojo:
             return self._dl_score0(row)
         if algo == "pca":
             return self._pca_score0(row)
+        if algo == "coxph":
+            return self._coxph_score0(row)
         if algo == "kmeans":
             return self._kmeans_score0(row)
         if algo == "isolation_forest":
@@ -1286,6 +1385,9 @@ def read_mojo(path: str) -> RefMojo:
                 z.read(f"trees/t{c:02d}_{t:03d}.bin")
                 for t in range(ntrees)
             ])
+        if m.info.get("algo") == "coxph":
+            m.x_mean_cat = np.frombuffer(z.read("x_mean_cat"), ">f8")
+            m.x_mean_num = np.frombuffer(z.read("x_mean_num"), ">f8")
         if m.info.get("algo") == "pca":
             ncoefs = int(m.info["eigenvector_size"])
             kcomp = int(m.info["k"])

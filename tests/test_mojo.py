@@ -296,3 +296,27 @@ class TestMojoGlmR3:
         )
         m = GLM(response_column="y", family="ordinal", lambda_=0.0).train(fr)
         _assert_parity(m, fr, str(tmp_path / "glm_ord.mojo"))
+
+
+def test_pca_demean_descale_mojo_roundtrip(rng, tmp_path):
+    """The native MOJO must carry demean/descale statistics — without
+    them the offline scorer projects un-transformed rows onto
+    transformed-space eigenvectors."""
+    import numpy as np
+
+    from h2o3_tpu.frame.frame import Column, Frame
+    from h2o3_tpu.genmodel.mojo_model import MojoModel
+    from h2o3_tpu.models.mojo_export import write_mojo as write_native
+    from h2o3_tpu.models.pca import PCA
+
+    X = rng.normal(size=(250, 4)) + 5.0
+    X[:, 0] *= 10.0
+    fr = Frame([Column(f"x{i}", X[:, i]) for i in range(4)])
+    for transform in ("demean", "descale"):
+        m = PCA(k=2, transform=transform, seed=1).train(fr)
+        path = str(tmp_path / f"pca_{transform}.mojo")
+        write_native(m, path)
+        mojo = MojoModel.load(path)
+        got = mojo.score({f"x{i}": X[:, i] for i in range(4)})
+        want = m._predict_raw(fr)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)

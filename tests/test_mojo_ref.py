@@ -556,3 +556,39 @@ class TestPCAReferenceMojo:
             row = np.array([X[i, 0], gd[i], X[i, 1], X[i, 2]])
             got = mojo.score0(row)
             np.testing.assert_allclose(got, want[i], rtol=1e-4, atol=1e-5)
+
+
+class TestCoxPHReferenceMojo:
+    """CoxPHMojoWriter layout: cats-first coef kv + x_mean blobs whose
+    coef-weighted sum forms lpBase (score = coef·(x − x̄))."""
+
+    def test_linear_predictor_parity(self, rng, tmp_path):
+        from h2o3_tpu.models.coxph import CoxPH
+
+        n = 400
+        X = rng.normal(size=(n, 2))
+        g = rng.integers(0, 3, size=n).astype(np.int32)
+        lam = np.exp(0.8 * X[:, 0] - 0.5 * X[:, 1] + 0.4 * (g == 2))
+        t_event = rng.exponential(1.0 / lam)
+        t_cens = rng.exponential(2.0, size=n)
+        t = np.minimum(t_event, t_cens)
+        d = (t_event <= t_cens).astype(np.float64)
+        fr = Frame([
+            Column("g", g, ColType.CAT, ["u", "v", "w"]),
+            Column("x0", X[:, 0]),
+            Column("x1", X[:, 1]),
+            Column("time", t),
+            Column("event", d),
+        ])
+        m = CoxPH(response_column="event", stop_column="time",
+                  ignored_columns=["time"]).train(fr)
+        path = str(tmp_path / "cox.zip")
+        write_mojo(m, path)
+        mojo = read_mojo(path)
+        assert mojo.info["algo"] == "coxph"
+        want = m._predict_raw(fr)
+        gd = g.astype(np.float64)
+        for i in range(0, n, 23):
+            got = mojo.score0(np.array([gd[i], X[i, 0], X[i, 1]]))
+            np.testing.assert_allclose(got[0], want[i], rtol=1e-6,
+                                       atol=1e-8)
