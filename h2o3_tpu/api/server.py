@@ -81,6 +81,15 @@ def _json_default(o):
     raise TypeError(f"not JSON serializable: {type(o)}")
 
 
+#: URLs of every server this process has ever run (see start())
+_SERVED_URLS: set = set()
+
+
+def served_from_this_process(url: str) -> bool:
+    """True if `url` is (or was) served by an H2OServer in this process."""
+    return url.rstrip("/") in _SERVED_URLS
+
+
 class H2OServer:
     """The server facade (h2o-webserver-iface HttpServerFacade analogue).
 
@@ -272,6 +281,11 @@ class H2OServer:
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
         self._thread.start()
+        # every URL ever served from THIS process: lets clients answer
+        # "is this dead endpoint one of ours to restart?" exactly,
+        # instead of guessing from the address (a port-forwarded remote
+        # can look like loopback)
+        _SERVED_URLS.add(self.url)
         return self
 
     def stop(self) -> None:

@@ -85,15 +85,18 @@ def _upload(X, y=None, y_categorical: bool = False):
     try:
         return _upload_once(X, y, y_categorical)
     except (urllib.error.URLError, ConnectionError, OSError):
+        from h2o3_tpu.api.server import served_from_this_process
+
         conn = getattr(h2o, "_conn", None)
-        server = getattr(h2o, "_server", None)
-        if conn is not None and (
-                server is None or conn.base_url != server.url.rstrip("/")):
-            # the connection targets something OTHER than our in-process
-            # server (a stale local server may coexist with a later
-            # h2o.connect): a dead remote is not ours to replace
+        if conn is not None and not served_from_this_process(conn.base_url):
+            # a dead EXTERNAL connection is not ours to replace — even a
+            # loopback address can be a port-forwarded remote cluster;
+            # the user's backend being down must surface, not silently
+            # reroute their data to a fresh local server
             raise
-        h2o.init()  # in-process server gone: start fresh, then retry once
+        # the dead server ran inside THIS process (ours, or a test
+        # harness's) and is gone for good: start fresh, retry once
+        h2o.init()
         return _upload_once(X, y, y_categorical)
 
 
