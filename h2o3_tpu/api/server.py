@@ -81,13 +81,18 @@ def _json_default(o):
     raise TypeError(f"not JSON serializable: {type(o)}")
 
 
-#: URLs of every server this process has ever run (see start())
-_SERVED_URLS: set = set()
+#: URLs of servers CURRENTLY running in this process (start() adds,
+#: stop() removes — a dead server's port may be reused by anything)
+_LIVE_URLS: set = set()
 
 
 def served_from_this_process(url: str) -> bool:
-    """True if `url` is (or was) served by an H2OServer in this process."""
-    return url.rstrip("/") in _SERVED_URLS
+    """True if `url` is served by a live H2OServer in this process RIGHT
+    NOW. Callers that need "was this endpoint ours?" later (e.g. after
+    the server stops) must evaluate this at connection time and remember
+    the answer — a stopped server's port can be reused by an unrelated
+    external service."""
+    return url.rstrip("/") in _LIVE_URLS
 
 
 class H2OServer:
@@ -281,11 +286,11 @@ class H2OServer:
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
         self._thread.start()
-        # every URL ever served from THIS process: lets clients answer
-        # "is this dead endpoint one of ours to restart?" exactly,
-        # instead of guessing from the address (a port-forwarded remote
-        # can look like loopback)
-        _SERVED_URLS.add(self.url)
+        # registry of live in-process servers: lets clients answer "is
+        # this endpoint one of ours?" exactly at connect time, instead
+        # of guessing from the address (a port-forwarded remote can
+        # look like loopback)
+        _LIVE_URLS.add(self.url)
         return self
 
     def stop(self) -> None:
@@ -293,6 +298,7 @@ class H2OServer:
         # that may race the owner's own stop() call
         httpd, self._httpd = self._httpd, None
         if httpd:
+            _LIVE_URLS.discard(self.url)
             httpd.shutdown()
             httpd.server_close()
 

@@ -70,6 +70,13 @@ def init(url: Optional[str] = None) -> H2OConnection:
         _server = start_server(port=0)
         url = _server.url
     _conn = H2OConnection(url)
+    # evaluated NOW, while any in-process server at this URL is alive:
+    # later consumers (adapter dead-server recovery) must know whether
+    # this connection targeted one of our own servers — a stopped
+    # server's port can be reused by an unrelated external service
+    from h2o3_tpu.api.server import served_from_this_process
+
+    _conn.in_process = served_from_this_process(url)
     _conn.cloud_info()  # fail fast if unreachable
     return _conn
 

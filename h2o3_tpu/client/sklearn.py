@@ -85,14 +85,14 @@ def _upload(X, y=None, y_categorical: bool = False):
     try:
         return _upload_once(X, y, y_categorical)
     except (urllib.error.URLError, ConnectionError, OSError):
-        from h2o3_tpu.api.server import served_from_this_process
-
         conn = getattr(h2o, "_conn", None)
-        if conn is not None and not served_from_this_process(conn.base_url):
+        if conn is not None and not getattr(conn, "in_process", False):
             # a dead EXTERNAL connection is not ours to replace — even a
-            # loopback address can be a port-forwarded remote cluster;
-            # the user's backend being down must surface, not silently
-            # reroute their data to a fresh local server
+            # loopback address can be a port-forwarded remote cluster
+            # (or a reused port of a long-gone local server); the user's
+            # backend being down must surface, not silently reroute
+            # their data to a fresh local server. `in_process` was
+            # stamped at connect time, while the target was alive.
             raise
         # the dead server ran inside THIS process (ours, or a test
         # harness's) and is gone for good: start fresh, retry once

@@ -808,10 +808,80 @@ def _write_te_mojo(model, path: str) -> str:
     return _zip_write(path, lines, dom_texts, {})
 
 
+def _write_ensemble_mojo(model, path: str) -> str:
+    """StackedEnsemble in the reference layout (StackedEnsembleMojoWriter
+    / MultiModelMojoWriter): the metalearner and every base model are
+    full MOJOs embedded under ``models/<algo>/<key>/``, with parent kv
+    naming the metalearner and ``base_model<i>`` keys. Every sub-model
+    must itself be reference-exportable."""
+    import tempfile
+
+    sub_entries: Dict[str, bytes] = {}
+
+    def embed(sub) -> str:
+        key = str(sub.key)
+        with tempfile.NamedTemporaryFile(suffix=".zip") as tf:
+            write_mojo(sub, tf.name)
+            with zipfile.ZipFile(tf.name) as sz:
+                for nm in sz.namelist():
+                    sub_entries[f"models/{sub.algo_name}/{key}/{nm}"] = \
+                        sz.read(nm)
+        return key
+
+    meta_key = embed(model.metalearner)
+    base_keys = [embed(bm) for bm in model.base_models]
+
+    info = model.data_info
+    cats = [n for n in info.predictor_names if n in info.cat_domains]
+    nums = [n for n in info.predictor_names if n not in info.cat_domains]
+    columns = cats + nums + [info.response_name]
+    dom_texts: Dict[str, str] = {}
+    dom_lines = []
+    for ci, c in enumerate(cats):
+        dom = info.cat_domains[c]
+        dom_lines.append(f"{ci}: {len(dom)} d{ci:03d}.txt")
+        dom_texts[f"domains/d{ci:03d}.txt"] = "\n".join(dom) + "\n"
+    rdom = info.response_domain
+    if rdom:
+        dom_lines.append(
+            f"{len(columns) - 1}: {len(rdom)} d{len(cats):03d}.txt")
+        dom_texts[f"domains/d{len(cats):03d}.txt"] = "\n".join(rdom) + "\n"
+    nclasses = model.nclasses
+    category = ("Binomial" if nclasses == 2
+                else "Multinomial" if nclasses > 2 else "Regression")
+    kv = [
+        ("algorithm", "StackedEnsemble"),
+        ("algo", "stackedensemble"),
+        ("category", category),
+        ("uuid", str(_uuid.uuid4())),
+        ("supervised", "true"),
+        ("n_features", len(cats) + len(nums)),
+        ("n_classes", nclasses if nclasses > 1 else 1),
+        ("n_columns", len(columns)),
+        ("n_domains", len(dom_lines)),
+        ("balance_classes", "false"),
+        ("default_threshold", 0.5),
+        ("prior_class_distrib", "null"),
+        ("model_class_distrib", "null"),
+        ("mojo_version", "1.01"),
+        ("h2o_version", "h2o3-tpu"),
+        ("submodel_count", 1 + len(base_keys)),
+        ("base_models_num", len(base_keys)),
+        ("metalearner", meta_key),
+        ("metalearner_transform", "NONE"),
+    ]
+    for i, key in enumerate(base_keys):
+        kv.append((f"base_model{i}", key))
+    lines = ["[info]"]
+    lines += [f"{k} = {v}" for k, v in kv]
+    lines += ["", "[columns]"] + columns + ["", "[domains]"] + dom_lines
+    return _zip_write(path, lines, dom_texts, sub_entries)
+
+
 def write_mojo(model, path: str) -> str:
     """Serialize a GBM, DRF, GLM, KMeans, IsolationForest, Word2Vec,
-    DeepLearning, TargetEncoder or PCA model into the reference MOJO
-    layout."""
+    DeepLearning, TargetEncoder, PCA or StackedEnsemble model into the
+    reference MOJO layout."""
     from h2o3_tpu.models.tree.common import tree_feature_names
 
     algo = model.algo_name
@@ -828,6 +898,7 @@ def write_mojo(model, path: str) -> str:
         "targetencoder": _write_te_mojo,
         "pca": _write_pca_mojo,
         "coxph": _write_coxph_mojo,
+        "stackedensemble": _write_ensemble_mojo,
     }
     if algo in writers:
         return writers[algo](model, path)
@@ -1206,6 +1277,44 @@ class RefMojo:
                 ev[num_start + j]
         return out
 
+    def _ensemble_score0(self, row: np.ndarray) -> np.ndarray:
+        """StackedEnsembleMojoModel.score0: score every base model on the
+        (re-mapped) row, stack the level-one vector in base order
+        (binomial p1 / regression pred / multinomial all classes), then
+        score the metalearner on it."""
+        nclasses = self.nclasses
+        # parent row layout = parent columns minus the response; each
+        # sub-model expects ITS column order — remap by name, computed
+        # once (score0 is per-row)
+        remaps = getattr(self, "_ensemble_remaps", None)
+        if remaps is None:
+            pos = {c: i for i, c in enumerate(self.columns[:-1])}
+            remaps = [
+                None if bm is None
+                else np.asarray([pos[c] for c in bm.columns[:-1]], np.intp)
+                for bm in self.base_models
+            ]
+            self._ensemble_remaps = remaps
+        base_preds: List[float] = []
+        for bm, idx in zip(self.base_models, remaps):
+            if bm is None:
+                continue
+            sub_row = row[idx]
+            out = bm.score0(sub_row)
+            if nclasses > 2:
+                base_preds.extend(out)
+            elif nclasses == 2:
+                base_preds.append(out[-1])  # p1 (preds[2] in the runtime)
+            else:
+                base_preds.append(out[0])
+        if self.info.get("metalearner_transform") == "Logit":
+            base_preds = [
+                float(np.log(max(min(p, 1 - 1e-9), 1e-9)
+                             / (1 - max(min(p, 1 - 1e-9), 1e-9))))
+                for p in base_preds
+            ]
+        return self.metalearner.score0(np.asarray(base_preds, np.float64))
+
     def _coxph_score0(self, row: np.ndarray) -> np.ndarray:
         """CoxPHMojoModel.score0 (no strata): lp = forCategories +
         forOtherColumns − lpBase, with lpBase = x̄·coef from the
@@ -1308,6 +1417,8 @@ class RefMojo:
             return self._pca_score0(row)
         if algo == "coxph":
             return self._coxph_score0(row)
+        if algo == "stackedensemble":
+            return self._ensemble_score0(row)
         if algo == "kmeans":
             return self._kmeans_score0(row)
         if algo == "isolation_forest":
@@ -1349,91 +1460,115 @@ class RefMojo:
 
 
 def read_mojo(path: str) -> RefMojo:
-    m = RefMojo()
     with zipfile.ZipFile(path) as z:
-        section = 0
-        columns: List[str] = []
-        domain_files: Dict[int, str] = {}
-        for raw in z.read("model.ini").decode().splitlines():
-            line = raw.strip()
-            if not line or line.startswith("#"):
-                continue
-            if line == "[info]":
-                section = 1
-            elif line == "[columns]":
-                section = 2
-            elif line == "[domains]":
-                section = 3
-            elif section == 1:
+        return _read_entry(z, "")
+
+
+def _read_entry(z: "zipfile.ZipFile", prefix: str) -> RefMojo:
+    """Parse one model rooted at `prefix` inside the archive ("" for the
+    top level; "models/<algo>/<key>/" for MultiModelMojoWriter
+    sub-models)."""
+    m = RefMojo()
+    section = 0
+    columns: List[str] = []
+    domain_files: Dict[int, str] = {}
+    for raw in z.read(prefix + "model.ini").decode().splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line == "[info]":
+            section = 1
+        elif line == "[columns]":
+            section = 2
+        elif line == "[domains]":
+            section = 3
+        elif section == 1:
+            k, _, v = line.partition("=")
+            m.info[k.strip()] = v.strip()
+        elif section == 2:
+            columns.append(line)
+        elif section == 3:
+            ci, _, rest = line.partition(":")
+            # '<col>: <n_elements> <file>' (count optional for
+            # tolerance with older writers)
+            toks = rest.split()
+            domain_files[int(ci)] = toks[-1]
+    m.columns = columns
+    for ci, fname in domain_files.items():
+        m.domains[ci] = z.read(
+            f"{prefix}domains/{fname}").decode().splitlines()
+    K = int(m.info.get("n_trees_per_class", 1))
+    ntrees = int(m.info.get("n_trees", 0))
+    for c in range(K):
+        m.trees.append([
+            z.read(f"{prefix}trees/t{c:02d}_{t:03d}.bin")
+            for t in range(ntrees)
+        ])
+    if m.info.get("algo") == "coxph":
+        m.x_mean_cat = np.frombuffer(z.read(prefix + "x_mean_cat"), ">f8")
+        m.x_mean_num = np.frombuffer(z.read(prefix + "x_mean_num"), ">f8")
+    if m.info.get("algo") == "pca":
+        ncoefs = int(m.info["eigenvector_size"])
+        kcomp = int(m.info["k"])
+        m.eigenvectors = np.frombuffer(
+            z.read(prefix + "eigenvectors_raw"), ">f8"
+        ).reshape(ncoefs, kcomp)
+    if m.info.get("algo") == "targetencoder":
+        base = prefix + "feature_engineering/target_encoding"
+        enc: Dict[str, Dict[int, tuple]] = {}
+        cur = None
+        for line in z.read(f"{base}/encoding_map.ini").decode() \
+                .splitlines():
+            line = line.strip()
+            if line.startswith("[") and line.endswith("]"):
+                cur = line[1:-1]
+                enc[cur] = {}
+            elif line and cur is not None:
                 k, _, v = line.partition("=")
-                m.info[k.strip()] = v.strip()
-            elif section == 2:
-                columns.append(line)
-            elif section == 3:
-                ci, _, rest = line.partition(":")
-                # '<col>: <n_elements> <file>' (count optional for
-                # tolerance with older writers)
-                toks = rest.split()
-                domain_files[int(ci)] = toks[-1]
-        m.columns = columns
-        for ci, fname in domain_files.items():
-            m.domains[ci] = z.read(f"domains/{fname}").decode().splitlines()
-        K = int(m.info.get("n_trees_per_class", 1))
-        ntrees = int(m.info.get("n_trees", 0))
-        for c in range(K):
-            m.trees.append([
-                z.read(f"trees/t{c:02d}_{t:03d}.bin")
-                for t in range(ntrees)
-            ])
-        if m.info.get("algo") == "coxph":
-            m.x_mean_cat = np.frombuffer(z.read("x_mean_cat"), ">f8")
-            m.x_mean_num = np.frombuffer(z.read("x_mean_num"), ">f8")
-        if m.info.get("algo") == "pca":
-            ncoefs = int(m.info["eigenvector_size"])
-            kcomp = int(m.info["k"])
-            m.eigenvectors = np.frombuffer(
-                z.read("eigenvectors_raw"), ">f8"
-            ).reshape(ncoefs, kcomp)
-        if m.info.get("algo") == "targetencoder":
-            base = "feature_engineering/target_encoding"
-            enc: Dict[str, Dict[int, tuple]] = {}
-            cur = None
-            for line in z.read(f"{base}/encoding_map.ini").decode() \
-                    .splitlines():
-                line = line.strip()
-                if line.startswith("[") and line.endswith("]"):
-                    cur = line[1:-1]
-                    enc[cur] = {}
-                elif line and cur is not None:
-                    k, _, v = line.partition("=")
-                    parts = v.split()
-                    enc[cur][int(k)] = (float(parts[0]), float(parts[1]))
-            m.te_encodings = enc
-            order = []
-            in_from = False
-            for line in z.read(f"{base}/input_encoding_columns_map.ini") \
-                    .decode().splitlines():
-                line = line.strip()
-                if line == "[from]":
-                    in_from = True
-                elif line.startswith("["):
-                    in_from = False
-                elif line and in_from:
-                    order.append(line)
-            m.te_columns = order or list(enc)
-        if m.info.get("algo") == "word2vec":
-            words = [
-                _unescape_vocab_word(w)
-                for w in z.read("vocabulary").decode().split("\n")
-                if w != ""
-            ]
-            vocab_size = int(m.info["vocab_size"])
-            if len(words) != vocab_size:
-                raise ValueError(
-                    f"corrupted vocabulary: {len(words)} words != "
-                    f"vocab_size {vocab_size}")
-            vecs = np.frombuffer(z.read("vectors"), dtype=">f4").reshape(
-                vocab_size, int(m.info["vec_size"])
-            )
-            m.word_vectors = dict(zip(words, np.asarray(vecs, np.float32)))
+                parts = v.split()
+                enc[cur][int(k)] = (float(parts[0]), float(parts[1]))
+        m.te_encodings = enc
+        order = []
+        in_from = False
+        for line in z.read(f"{base}/input_encoding_columns_map.ini") \
+                .decode().splitlines():
+            line = line.strip()
+            if line == "[from]":
+                in_from = True
+            elif line.startswith("["):
+                in_from = False
+            elif line and in_from:
+                order.append(line)
+        m.te_columns = order or list(enc)
+    if m.info.get("algo") == "word2vec":
+        words = [
+            _unescape_vocab_word(w)
+            for w in z.read(prefix + "vocabulary").decode().split("\n")
+            if w != ""
+        ]
+        vocab_size = int(m.info["vocab_size"])
+        if len(words) != vocab_size:
+            raise ValueError(
+                f"corrupted vocabulary: {len(words)} words != "
+                f"vocab_size {vocab_size}")
+        vecs = np.frombuffer(
+            z.read(prefix + "vectors"), dtype=">f4").reshape(
+            vocab_size, int(m.info["vec_size"])
+        )
+        m.word_vectors = dict(zip(words, np.asarray(vecs, np.float32)))
+    if m.info.get("algo") == "stackedensemble":
+        # sub-models live under models/<algo>/<key>/ (MultiModelMojoWriter)
+        def find_prefix(key: str) -> str:
+            suffix = f"/{key}/model.ini"
+            for nm in z.namelist():
+                if nm.startswith(prefix + "models/") and nm.endswith(suffix):
+                    return nm[: -len("model.ini")]
+            raise ValueError(f"sub-model {key!r} missing from archive")
+
+        m.metalearner = _read_entry(z, find_prefix(m.info["metalearner"]))
+        m.base_models = []
+        for i in range(int(m.info["base_models_num"])):
+            key = m.info.get(f"base_model{i}")
+            m.base_models.append(
+                _read_entry(z, find_prefix(key)) if key else None)
     return m

@@ -592,3 +592,40 @@ class TestCoxPHReferenceMojo:
             got = mojo.score0(np.array([gd[i], X[i, 0], X[i, 1]]))
             np.testing.assert_allclose(got[0], want[i], rtol=1e-6,
                                        atol=1e-8)
+
+
+class TestStackedEnsembleReferenceMojo:
+    """MultiModelMojoWriter layout: metalearner + base models embedded
+    as full MOJOs under models/<algo>/<key>/, parent kv naming them."""
+
+    def test_binomial_parity(self, rng, tmp_path):
+        from h2o3_tpu.models.glm import GLM
+        from h2o3_tpu.models.stacked_ensemble import StackedEnsemble
+        from h2o3_tpu.models.tree.gbm import GBM
+
+        n = 600
+        X = rng.normal(size=(n, 4))
+        logit = X[:, 0] - 0.8 * X[:, 1] + 0.5 * X[:, 2] * X[:, 3]
+        y = (rng.random(n) < 1 / (1 + np.exp(-logit))).astype(np.int32)
+        cols = [Column(f"x{j}", X[:, j]) for j in range(4)]
+        cols.append(Column("y", y, ColType.CAT, ["0", "1"]))
+        fr = Frame(cols)
+
+        common = dict(response_column="y", nfolds=3,
+                      keep_cross_validation_predictions=True, seed=11)
+        glm = GLM(family="binomial", **common).train(fr)
+        gbm = GBM(ntrees=8, max_depth=3, min_rows=2, **common).train(fr)
+        se = StackedEnsemble(base_models=[glm, gbm], response_column="y",
+                             seed=11).train(fr)
+        path = str(tmp_path / "se.zip")
+        write_mojo(se, path)
+        mojo = read_mojo(path)
+        assert mojo.info["algo"] == "stackedensemble"
+        assert int(mojo.info["base_models_num"]) == 2
+        assert mojo.metalearner.info["algo"] == "glm"
+        assert {b.info["algo"] for b in mojo.base_models} == {"glm", "gbm"}
+
+        want = se._predict_raw(fr)  # [n, 2] probabilities
+        for i in range(0, n, 37):
+            got = mojo.score0(X[i].astype(np.float64))
+            np.testing.assert_allclose(got, want[i], rtol=1e-5, atol=1e-6)
