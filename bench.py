@@ -192,6 +192,11 @@ def _worker() -> None:
     train_boosted(X, "bernoulli", y, 1, f0, params, timings=timings)
     dt = timings["train_s"]
 
+    # record which level flow produced this number (the orchestrator's
+    # final attempt pins subtraction off; the artifact must say so)
+    from h2o3_tpu.models.tree.booster import _tree_subtract_enabled
+    _subtract_on = _tree_subtract_enabled()
+
     rows_per_sec = n_rows * ntrees / dt  # row-scans per second per chip
 
     vs = 1.0
@@ -215,11 +220,12 @@ def _worker() -> None:
         "vs_baseline": round(vs, 3),
         "detail": {"n_rows": n_rows, "ntrees": ntrees,
                    "max_depth": max_depth, "train_s": round(dt, 3),
-                   "warmup_s": round(warmup_s, 1)},
+                   "warmup_s": round(warmup_s, 1),
+                   "subtract": _subtract_on},
     }))
 
 
-def _run_child(arg: str, timeout: int):
+def _run_child(arg: str, timeout: int, extra_env=None):
     """Run this file with `arg` in a subprocess under a hard timeout.
 
     Returns (ok, last_json_line_or_None, note).  The child is killed on
@@ -227,9 +233,14 @@ def _run_child(arg: str, timeout: int):
     backend-init hang (in-process signals never fire; see module doc).
     """
     cmd = [sys.executable, os.path.abspath(__file__), arg]
+    env = None
+    if extra_env:
+        env = dict(os.environ)
+        env.update(extra_env)
     try:
         proc = subprocess.run(
-            cmd, timeout=timeout, capture_output=True, text=True, cwd=_HERE)
+            cmd, timeout=timeout, capture_output=True, text=True, cwd=_HERE,
+            env=env)
     except subprocess.TimeoutExpired as e:
         def _text(b):
             return b.decode(errors="replace") if isinstance(b, bytes) \
@@ -285,8 +296,16 @@ def main() -> None:
 
     last_note = ""
     for i in range(ATTEMPTS):
+        # the final attempt pins the training program to the direct
+        # (non-subtraction) level flow — the configuration every prior
+        # official number was measured with — so a regression in a newer
+        # default can never turn the whole bench into a zero
+        extra = ({"H2O3_TPU_TREE_SUBTRACT": "0"}
+                 if i == ATTEMPTS - 1 and
+                 "H2O3_TPU_TREE_SUBTRACT" not in os.environ else None)
         ok, result, note = _run_child(
-            "--worker", ATTEMPT1_TIMEOUT if i == 0 else ATTEMPT_TIMEOUT)
+            "--worker", ATTEMPT1_TIMEOUT if i == 0 else ATTEMPT_TIMEOUT,
+            extra_env=extra)
         if ok and result and result.get("value"):
             # mirror immediately so a later crash can't erase the number —
             # but never let the CPU test hook clobber a real TPU artifact

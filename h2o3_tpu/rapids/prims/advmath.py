@@ -507,3 +507,45 @@ def setproperty(env, args):
 def comma(env, args):
     """(, expr expr ...) — sequence; value of the last (AstComma)."""
     return args[-1] if args else Val.num(0)
+
+
+@prim("distance")
+def distance(env, args):
+    """(distance references queries measure) — pairwise measure between
+    all rows: [R rows x Q cols] (AstDistance). Measures: 'l1', 'l2',
+    'cosine' (similarity, dot/(|r||q|)), 'cosine_sq' (dot²/(|r|²|q|²))."""
+    refs = _matrix(args[0].as_frame())
+    qs = _matrix(args[1].as_frame())
+    measure = args[2].as_str().lower()
+    if measure not in ("cosine", "cosine_sq", "l1", "l2"):
+        raise ValueError(
+            f"Invalid distance measure provided: {measure}. Must be one "
+            "of ['cosine', 'cosine_sq', 'l1', 'l2']")
+    if refs.shape[1] != qs.shape[1]:
+        raise ValueError(
+            f"Frames must have the same number of cols, found "
+            f"{refs.shape[1]} and {qs.shape[1]}")
+    if np.isnan(refs).any() or np.isnan(qs).any():
+        raise ValueError("distance frames must not contain missing values")
+    if measure in ("cosine", "cosine_sq"):
+        dot = refs @ qs.T  # [R, Q] — the MXU-shaped path
+        dr = (refs * refs).sum(axis=1)[:, None]
+        dq = (qs * qs).sum(axis=1)[None, :]
+        if measure == "cosine_sq":
+            out = (dot * dot) / (dr * dq)
+        else:
+            out = dot / np.sqrt(dr * dq)
+    elif measure == "l2":
+        d2 = ((refs * refs).sum(axis=1)[:, None]
+              + (qs * qs).sum(axis=1)[None, :]
+              - 2.0 * (refs @ qs.T))
+        out = np.sqrt(np.maximum(d2, 0.0))
+    else:  # l1 — accumulate per feature: a [R, Q, p] broadcast temp
+        # would be p times the (already R*Q) output size
+        out = np.zeros((refs.shape[0], qs.shape[0]))
+        for j in range(refs.shape[1]):
+            out += np.abs(refs[:, j][:, None] - qs[:, j][None, :])
+    return Val.frame(Frame([
+        Column(f"C{j + 1}", out[:, j].astype(np.float64), ColType.NUM)
+        for j in range(out.shape[1])
+    ]))

@@ -247,8 +247,10 @@ def _eval_exec(node: AstExec, env: Env) -> Val:
     # resolve the operator
     if isinstance(node.op, AstId):
         op_name = node.op.name
-        if op_name in ("tmp=", "="):
-            return _eval_assign(op_name, node.args, env)
+        if op_name in ("tmp=", "=", "assign"):
+            # AstAssign registers as "assign"; "=" is the legacy spelling
+            return _eval_assign("=" if op_name == "assign" else op_name,
+                                node.args, env)
         prim = PRIMS.get(op_name)
         if prim is not None:
             args = [eval_ast(a, env) for a in node.args]

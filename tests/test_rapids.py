@@ -364,3 +364,30 @@ def test_dropdup(sess):
     s.assign("df", fr)
     out = ex(s, "(dropdup df [0 1] 'first')").as_frame()
     assert out.nrows == 2
+
+
+def test_distance_measures(sess):
+    """(distance refs queries measure) — AstDistance parity on small
+    oracles for all four measures."""
+    import numpy as np
+
+    from h2o3_tpu.frame.frame import Column, Frame
+
+    rng = np.random.default_rng(0)
+    R, Q, p = 7, 5, 3
+    A = rng.normal(size=(R, p))
+    B = rng.normal(size=(Q, p))
+    s = Session()
+    s.assign("dist_a", Frame([Column(f"x{i}", A[:, i]) for i in range(p)]))
+    s.assign("dist_b", Frame([Column(f"x{i}", B[:, i]) for i in range(p)]))
+    for measure, want in {
+        "l2": np.sqrt(((A[:, None] - B[None]) ** 2).sum(2)),
+        "l1": np.abs(A[:, None] - B[None]).sum(2),
+        "cosine": (A @ B.T) / np.sqrt(
+            (A * A).sum(1)[:, None] * (B * B).sum(1)[None, :]),
+        "cosine_sq": (A @ B.T) ** 2 / (
+            (A * A).sum(1)[:, None] * (B * B).sum(1)[None, :]),
+    }.items():
+        out = ex(s, f'(distance dist_a dist_b "{measure}")').as_frame()
+        got = np.stack([c.numeric_view() for c in out.columns], axis=1)
+        np.testing.assert_allclose(got, want, rtol=1e-12, err_msg=measure)
