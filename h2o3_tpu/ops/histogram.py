@@ -264,10 +264,27 @@ def _build_histogram_jit(
         )
         return jax.lax.psum(part, DATA_AXIS)
 
+    sm_kw = {}
+    if impl == "pallas" and jax.default_backend() != "tpu":
+        # interpreter-mode pallas lowers VMEM scratch to plain arrays
+        # whose varying-axis metadata can't match the shard-varying
+        # values written into them; the check only exists to validate
+        # collective placement, which the real-TPU path still enforces.
+        # (the kwarg is check_vma on jax.shard_map but check_rep on the
+        # jax.experimental fallback — key off the actual signature)
+        import inspect
+
+        params = inspect.signature(_shard_map).parameters
+        if "check_vma" in params:
+            sm_kw["check_vma"] = False
+        elif "check_rep" in params:
+            sm_kw["check_rep"] = False
+
     return _shard_map(
         fn,
         mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS))
         + tuple(spec for _, _, spec in extras),
         out_specs=P(),
+        **sm_kw,
     )(bins, nodes, g, h, *[a for _, a, _ in extras])
