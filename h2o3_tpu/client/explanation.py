@@ -1,0 +1,95 @@
+"""Explanation plots — ``h2o-py/h2o/explanation/_explain.py`` analogue.
+
+Matplotlib renderings over the same REST surfaces the plain client uses:
+variable importance (``GET /3/Models/{id}/varimp``) and partial
+dependence (``POST /3/PartialDependence``-style makePDP handler). Each
+function returns the matplotlib Figure so callers can save or show it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+
+def _model_id(model) -> str:
+    return getattr(model, "model_id", model)
+
+
+def varimp_plot(model, num_of_features: int = 10):
+    """Horizontal bar chart of scaled variable importances
+    (h2o-py varimp_plot)."""
+    import matplotlib.pyplot as plt  # auto-selects Agg when headless
+
+    import h2o3_tpu.client as h2o
+
+    out = h2o.connection().request(
+        f"GET /3/Models/{_model_id(model)}/varimp")
+    rows = out.get("varimp", out.get("variable_importances", []))
+    if isinstance(rows, dict):
+        rows = [
+            {"variable": v, "scaled_importance": s}
+            for v, s in zip(rows.get("variable", []),
+                            rows.get("scaled_importance", []))
+        ]
+    rows = rows[:num_of_features]
+    names = [r["variable"] for r in rows][::-1]
+    vals = [float(r.get("scaled_importance", r.get("relative_importance", 0)))
+            for r in rows][::-1]
+    fig, ax = plt.subplots(figsize=(8, max(2, 0.4 * len(names))))
+    ax.barh(names, vals)
+    ax.set_xlabel("scaled importance")
+    ax.set_title(f"Variable importance: {_model_id(model)}")
+    fig.tight_layout()
+    return fig
+
+
+def pd_plot(model, frame, column: str, nbins: int = 20):
+    """Partial-dependence curve for one column (h2o-py pd_plot)."""
+    import matplotlib.pyplot as plt
+
+    import h2o3_tpu.client as h2o
+
+    out = h2o.connection().request(
+        "POST /3/PartialDependence", {
+            "model_id": _model_id(model),
+            "frame_id": frame.frame_id,
+            "cols": column,
+            "nbins": nbins,
+        })
+    pd = out["partial_dependence_data"][0]
+    xs = pd["values"]
+    ys = [float(v) for v in pd["mean_response"]]
+    fig, ax = plt.subplots(figsize=(8, 5))
+    try:  # the server formats numeric sweep points as strings
+        xnum = [float(x) for x in xs]
+    except (TypeError, ValueError):
+        xnum = None
+    if xnum is not None:
+        ax.plot(xnum, ys, marker="o")
+    else:
+        ax.bar([str(x) for x in xs], ys)
+        ax.tick_params(axis="x", rotation=45)
+    ax.set_xlabel(column)
+    ax.set_ylabel("mean response")
+    ax.set_title(f"Partial dependence: {column} ({_model_id(model)})")
+    fig.tight_layout()
+    return fig
+
+
+def explain(model, frame, columns: Optional[List[str]] = None) -> List[Any]:
+    """h2o.explain-style convenience: varimp plot + a PD plot per (top)
+    column. Returns the list of Figures."""
+    figs = [varimp_plot(model)]
+    if columns is None:
+        import h2o3_tpu.client as h2o
+
+        out = h2o.connection().request(
+            f"GET /3/Models/{_model_id(model)}/varimp")
+        rows = out.get("varimp", [])
+        if isinstance(rows, list):
+            columns = [r["variable"] for r in rows[:3]]
+        else:
+            columns = list(rows.get("variable", []))[:3]
+    for c in columns or []:
+        figs.append(pd_plot(model, frame, c))
+    return figs

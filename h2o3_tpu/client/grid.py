@@ -1,0 +1,99 @@
+"""Grid-search client — ``h2o-py/h2o/grid/grid_search.py`` analogue.
+
+H2OGridSearch wraps ``POST /99/Grid/{algo}``: base params + a hyper-param
+dict; the server walks the space (cartesian or random with stopping
+criteria) and returns the grid id + per-combo model ids. ``get_grid``
+re-sorts server-side like ``GET /99/Grids/{grid_id}?sort_by=``.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+
+class H2OGridSearch:
+    def __init__(self, model: Any, hyper_params: Dict[str, List[Any]],
+                 grid_id: Optional[str] = None,
+                 search_criteria: Optional[Dict[str, Any]] = None,
+                 **base_params: Any) -> None:
+        # `model` accepts an estimator CLASS, an estimator INSTANCE
+        # (its params become base params), or a bare algo name string
+        if isinstance(model, str):
+            algo = model
+        else:
+            algo = getattr(model, "algo", None)
+            if algo in (None, "?"):
+                raise ValueError(f"cannot derive algo from {model!r}")
+            inst_params = getattr(model, "_params", None)
+            if isinstance(inst_params, dict):
+                base_params = {**inst_params, **base_params}
+        self.algo = algo
+        self.hyper_params = dict(hyper_params)
+        self.search_criteria = dict(search_criteria or {})
+        self.base_params = dict(base_params)
+        self.grid_id = grid_id
+        self._summary: Optional[Dict[str, Any]] = None
+
+    def train(self, y: Optional[str] = None, training_frame=None,
+              **extra: Any) -> "H2OGridSearch":
+        import h2o3_tpu.client as h2o
+
+        conn = h2o.connection()
+        payload: Dict[str, Any] = dict(self.base_params)
+        payload.update(extra)
+        if y is not None:
+            payload["response_column"] = y
+        payload["training_frame"] = training_frame.frame_id
+        payload["hyper_parameters"] = json.dumps(self.hyper_params)
+        if self.search_criteria:
+            payload["search_criteria"] = json.dumps(self.search_criteria)
+        if self.grid_id:
+            payload["grid_id"] = self.grid_id
+        out = conn.request(f"POST /99/Grid/{self.algo}", payload)
+        self.grid_id = out["grid_id"]["name"]
+        self._summary = None
+        return self
+
+    # -- results -------------------------------------------------------------
+
+    def _fetch(self, sort_by: str = "auto") -> Dict[str, Any]:
+        import h2o3_tpu.client as h2o
+
+        if self.grid_id is None:
+            raise ValueError("train first")
+        return h2o.connection().request(
+            f"GET /99/Grids/{self.grid_id}", {"sort_by": sort_by})
+
+    @property
+    def model_ids(self) -> List[str]:
+        if self._summary is None:
+            self._summary = self._fetch()
+        return [m["name"] for m in self._summary["model_ids"]]
+
+    @property
+    def models(self):
+        from h2o3_tpu.client.estimators import H2OModel
+
+        import h2o3_tpu.client as h2o
+
+        conn = h2o.connection()
+        return [H2OModel(conn, mid) for mid in self.model_ids]
+
+    def get_grid(self, sort_by: str = "auto") -> "H2OGridSearch":
+        """Re-sort server-side (grid_get sort_by); model_ids / models
+        then reflect the new order."""
+        self._summary = self._fetch(sort_by)
+        return self
+
+    @property
+    def hyper_params_used(self) -> List[Dict[str, Any]]:
+        if self._summary is None:
+            self._summary = self._fetch()
+        return self._summary.get("hyper_params", [])
+
+    @property
+    def failure_details(self) -> List[str]:
+        if self._summary is None:
+            self._summary = self._fetch()
+        return self._summary.get("failure_details", [])

@@ -1,0 +1,56 @@
+"""Tree inspection client — ``h2o-py/h2o/tree/tree.py`` analogue.
+
+H2OTree fetches ``GET /3/Trees/{model_id}/{tree_number}`` (TreeV3-style
+node arrays in heap layout: children of node i are 2i+1 / 2i+2) and
+exposes the per-node arrays plus simple navigation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+
+class H2OTree:
+    def __init__(self, model, tree_number: int, tree_class: int = 0) -> None:
+        import h2o3_tpu.client as h2o
+
+        model_id = getattr(model, "model_id", model)
+        out = h2o.connection().request(
+            f"GET /3/Trees/{model_id}/{tree_number}",
+            {"tree_class": tree_class})
+        self.model_id: str = out["model_id"]["name"]
+        self.tree_number: int = out["tree_number"]
+        self.tree_class: int = out["tree_class"]
+        self.features: List[Optional[str]] = out["features"]
+        self.thresholds: List[Optional[float]] = out["thresholds"]
+        self.is_split: List[bool] = out["is_split"]
+        self.default_left: List[bool] = out["default_left"]
+        self.predictions: List[float] = out["predictions"]
+
+    def __len__(self) -> int:
+        return len(self.features)
+
+    @property
+    def nodes(self) -> int:
+        return len(self.features)
+
+    def left_child(self, i: int) -> Optional[int]:
+        c = 2 * i + 1
+        return c if self.is_split[i] and c < len(self.features) else None
+
+    def right_child(self, i: int) -> Optional[int]:
+        c = 2 * i + 2
+        return c if self.is_split[i] and c < len(self.features) else None
+
+    def describe_node(self, i: int) -> str:
+        if self.is_split[i]:
+            na = "left" if self.default_left[i] else "right"
+            return (f"node {i}: split on {self.features[i]} at "
+                    f"{self.thresholds[i]} (NA -> {na})")
+        return f"node {i}: leaf = {self.predictions[i]:.6g}"
+
+    def __repr__(self) -> str:  # pragma: no cover - cosmetic
+        n_leaves = sum(1 for s in self.is_split if not s)
+        return (f"<H2OTree {self.model_id} tree={self.tree_number} "
+                f"class={self.tree_class} nodes={self.nodes} "
+                f"leaves~{n_leaves}>")

@@ -204,3 +204,78 @@ class TestClientPersistence:
         loaded = h2o.load_frame(path, frame_id="iris_reloaded")
         assert loaded.dim == iris.dim
         assert loaded.names == iris.names
+
+
+class TestClientGridTreeExplain:
+    """Round-4 client surface: H2OGridSearch, H2OTree, explanation plots
+    (h2o-py grid/tree/explanation analogues) over live REST."""
+
+    def test_grid_search_client(self, conn):
+        import numpy as np
+
+        import h2o3_tpu.client as h2o
+        from h2o3_tpu.client.grid import H2OGridSearch
+
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(300, 3))
+        y = (X[:, 0] > 0).astype(int)
+        csv = "a,b,c,y\n" + "\n".join(
+            f"{r[0]},{r[1]},{r[2]},c{int(t)}" for r, t in zip(X, y))
+        fr = h2o.upload_csv(csv)
+        gs = H2OGridSearch("gbm", {"max_depth": [2, 3]}, ntrees=4,
+                           min_rows=2, seed=1)
+        gs.train(y="y", training_frame=fr)
+        assert len(gs.model_ids) == 2
+        aucs = [m.auc() for m in gs.models]
+        assert all(a is not None and a > 0.5 for a in aucs)
+        gs.get_grid(sort_by="auc")
+        assert len(gs.model_ids) == 2
+
+    def test_tree_inspection(self, conn):
+        import numpy as np
+
+        import h2o3_tpu.client as h2o
+        from h2o3_tpu.client.estimators import H2OGradientBoostingEstimator
+        from h2o3_tpu.client.tree import H2OTree
+
+        rng = np.random.default_rng(4)
+        X = rng.normal(size=(300, 3))
+        y = (X[:, 0] > 0).astype(int)
+        csv = "a,b,c,y\n" + "\n".join(
+            f"{r[0]},{r[1]},{r[2]},c{int(t)}" for r, t in zip(X, y))
+        fr = h2o.upload_csv(csv)
+        est = H2OGradientBoostingEstimator(ntrees=3, max_depth=3,
+                                           min_rows=2, seed=1)
+        est.train(y="y", training_frame=fr)
+        t = H2OTree(est.model, 0)
+        assert t.nodes >= 3 and any(t.is_split)
+        root = 0
+        assert t.is_split[root]
+        assert t.left_child(root) == 1 and t.right_child(root) == 2
+        assert "split on" in t.describe_node(root)
+        leaf = next(i for i, s in enumerate(t.is_split) if not s)
+        assert "leaf" in t.describe_node(leaf)
+
+    def test_explanation_plots(self, conn):
+        import numpy as np
+
+        import h2o3_tpu.client as h2o
+        from h2o3_tpu.client.estimators import H2OGradientBoostingEstimator
+        from h2o3_tpu.client import explanation
+
+        rng = np.random.default_rng(5)
+        X = rng.normal(size=(200, 3))
+        y = (X[:, 0] > 0).astype(int)
+        csv = "a,b,c,y\n" + "\n".join(
+            f"{r[0]},{r[1]},{r[2]},c{int(t)}" for r, t in zip(X, y))
+        fr = h2o.upload_csv(csv)
+        est = H2OGradientBoostingEstimator(ntrees=3, max_depth=3,
+                                           min_rows=2, seed=1)
+        est.train(y="y", training_frame=fr)
+        fig = explanation.varimp_plot(est.model)
+        assert fig.axes and len(fig.axes[0].patches) >= 1
+        fig2 = explanation.pd_plot(est.model, fr, "a")
+        assert fig2.axes and (fig2.axes[0].lines or fig2.axes[0].patches)
+        import matplotlib.pyplot as plt
+
+        plt.close("all")

@@ -177,14 +177,17 @@ def test_variable_importance(mesh, rng):
 # histogram-subtraction level flow (H2O3_TPU_TREE_SUBTRACT)
 
 
-def _train_margins(X, y, objective, monkeypatch, subtract, **kw):
+def _train_margins(X, y, objective, monkeypatch, subtract, impl=None,
+                   params=None, **kw):
     from h2o3_tpu.models.tree.booster import (
         TreeParams, _make_block_fn, train_boosted)
     from h2o3_tpu.models.tree.common import init_margin
 
     monkeypatch.setenv("H2O3_TPU_TREE_SUBTRACT", "1" if subtract else "0")
+    if impl is not None:
+        monkeypatch.setenv("H2O3_TPU_HIST_IMPL", impl)
     _make_block_fn.cache_clear()
-    params = TreeParams(ntrees=8, max_depth=4, nbins=32, seed=3)
+    params = params or TreeParams(ntrees=8, max_depth=4, nbins=32, seed=3)
     f0 = init_margin(objective, y, 1)
     model = train_boosted(X, objective, y, 1, f0, params, **kw)
     return model.predict_margin(X)
@@ -234,25 +237,16 @@ def test_pallas_subtract_tree_matches_scatter(mesh, rng, monkeypatch):
     subtraction) must grow the same trees as the scatter oracle. Run in
     Pallas interpreter mode on a small config — this is the program the
     real-TPU bench compiles."""
-    from h2o3_tpu.models.tree.booster import (
-        TreeParams, _make_block_fn, train_boosted)
-    from h2o3_tpu.models.tree.common import init_margin
+    from h2o3_tpu.models.tree.booster import TreeParams
 
     n = 2048
     X = rng.normal(size=(n, 5)).astype(np.float32)
     y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] +
          0.2 * rng.normal(size=n) > 0).astype(np.float64)
     params = TreeParams(ntrees=2, max_depth=3, nbins=16, seed=5)
-    f0 = init_margin("bernoulli", y, 1)
 
-    margins = {}
-    for impl, subtract in (("scatter", "0"), ("pallas", "1")):
-        monkeypatch.setenv("H2O3_TPU_HIST_IMPL", impl)
-        monkeypatch.setenv("H2O3_TPU_TREE_SUBTRACT", subtract)
-        _make_block_fn.cache_clear()
-        m = train_boosted(X, "bernoulli", y, 1, f0, params)
-        margins[impl] = m.predict_margin(X)
-    monkeypatch.delenv("H2O3_TPU_HIST_IMPL")
-    _make_block_fn.cache_clear()
-    np.testing.assert_allclose(margins["pallas"], margins["scatter"],
-                               rtol=1e-4, atol=1e-4)
+    a = _train_margins(X, y, "bernoulli", monkeypatch, subtract=False,
+                       impl="scatter", params=params)
+    b = _train_margins(X, y, "bernoulli", monkeypatch, subtract=True,
+                       impl="pallas", params=params)
+    np.testing.assert_allclose(b, a, rtol=1e-4, atol=1e-4)
