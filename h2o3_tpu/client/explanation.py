@@ -12,14 +12,13 @@ from typing import Any, List, Optional
 
 
 def _model_id(model) -> str:
-    return getattr(model, "model_id", model)
+    import urllib.parse
+
+    return urllib.parse.quote(getattr(model, "model_id", model), safe="")
 
 
-def varimp_plot(model, num_of_features: int = 10):
-    """Horizontal bar chart of scaled variable importances
-    (h2o-py varimp_plot)."""
-    import matplotlib.pyplot as plt  # auto-selects Agg when headless
-
+def _varimp_rows(model) -> list:
+    """One GET of /3/Models/{id}/varimp, normalized to row dicts."""
     import h2o3_tpu.client as h2o
 
     out = h2o.connection().request(
@@ -31,7 +30,15 @@ def varimp_plot(model, num_of_features: int = 10):
             for v, s in zip(rows.get("variable", []),
                             rows.get("scaled_importance", []))
         ]
-    rows = rows[:num_of_features]
+    return rows
+
+
+def varimp_plot(model, num_of_features: int = 10):
+    """Horizontal bar chart of scaled variable importances
+    (h2o-py varimp_plot)."""
+    import matplotlib.pyplot as plt  # auto-selects Agg when headless
+
+    rows = _varimp_rows(model)[:num_of_features]
     names = [r["variable"] for r in rows][::-1]
     vals = [float(r.get("scaled_importance", r.get("relative_importance", 0)))
             for r in rows][::-1]
@@ -79,17 +86,9 @@ def pd_plot(model, frame, column: str, nbins: int = 20):
 def explain(model, frame, columns: Optional[List[str]] = None) -> List[Any]:
     """h2o.explain-style convenience: varimp plot + a PD plot per (top)
     column. Returns the list of Figures."""
-    figs = [varimp_plot(model)]
     if columns is None:
-        import h2o3_tpu.client as h2o
-
-        out = h2o.connection().request(
-            f"GET /3/Models/{_model_id(model)}/varimp")
-        rows = out.get("varimp", [])
-        if isinstance(rows, list):
-            columns = [r["variable"] for r in rows[:3]]
-        else:
-            columns = list(rows.get("variable", []))[:3]
-    for c in columns or []:
+        columns = [r["variable"] for r in _varimp_rows(model)[:3]]
+    figs = [varimp_plot(model)]
+    for c in columns:
         figs.append(pd_plot(model, frame, c))
     return figs

@@ -238,11 +238,11 @@ class H2OFrame:
 
     def group_by_sum(self, by: str, col: str) -> "H2OFrame":
         """Minimal groupby surface: (GB fr [by] "sum" col "all")."""
-        bi = self.names.index(by)
-        ci = self.names.index(col)
-        return H2OFrame(
-            self._conn, ExprNode("GB", self, [bi], "sum", ci, "all")
-        )
+        return self.group_by(by).sum(col).get_frame()
+
+    def group_by(self, by) -> "H2OGroupBy":
+        """Fluent multi-aggregation group-by (h2o-py H2OFrame.group_by)."""
+        return H2OGroupBy(self, by)
 
     # -- materialization -----------------------------------------------------
     def get_frame_data(self) -> Dict[str, list]:
@@ -276,3 +276,73 @@ class H2OFrame:
         if self._key:
             return f"<H2OFrame {self._key} {self._nrows}x{self._ncols}>"
         return f"<H2OFrame lazy {self._ex.to_rapids()[:60]}>"
+
+
+class H2OGroupBy:
+    """Fluent group-by builder — ``h2o-py/h2o/group_by.py`` analogue.
+
+    Chain aggregations, then read ``.frame``/``get_frame()``: one
+    ``(GB fr [by] agg col na ...)`` rapids op with all requested
+    aggregations (AstGroup's multi-agg form).
+    """
+
+    def __init__(self, fr: "H2OFrame", by) -> None:
+        self._fr = fr
+        self._by = [by] if isinstance(by, str) else list(by)
+        self._aggs: list = []
+
+    #: server-accepted aggregate names (rapids/groupby.py AGGS)
+    _AGGS = ("nrow", "sum", "mean", "min", "max", "sd", "var", "median",
+             "mode")
+
+    def _add(self, agg: str, col, na: str) -> "H2OGroupBy":
+        if agg not in self._AGGS:
+            raise ValueError(f"unknown aggregate {agg!r}; one of {self._AGGS}")
+        cols = ([col] if isinstance(col, str)
+                else list(col) if col is not None
+                else [n for n in self._fr.names if n not in self._by])
+        for c in cols:
+            self._aggs.append((agg, self._fr.names.index(c), na))
+        return self
+
+    def count(self, na: str = "all") -> "H2OGroupBy":
+        # nrow counts per group regardless of a value column; anchor on
+        # the first by-column like the reference client does
+        self._aggs.append(("nrow", self._fr.names.index(self._by[0]), na))
+        return self
+
+    def sum(self, col=None, na: str = "all") -> "H2OGroupBy":
+        return self._add("sum", col, na)
+
+    def mean(self, col=None, na: str = "all") -> "H2OGroupBy":
+        return self._add("mean", col, na)
+
+    def min(self, col=None, na: str = "all") -> "H2OGroupBy":
+        return self._add("min", col, na)
+
+    def max(self, col=None, na: str = "all") -> "H2OGroupBy":
+        return self._add("max", col, na)
+
+    def sd(self, col=None, na: str = "all") -> "H2OGroupBy":
+        return self._add("sd", col, na)
+
+    def var(self, col=None, na: str = "all") -> "H2OGroupBy":
+        return self._add("var", col, na)
+
+    def median(self, col=None, na: str = "all") -> "H2OGroupBy":
+        return self._add("median", col, na)
+
+    def mode(self, col=None, na: str = "all") -> "H2OGroupBy":
+        return self._add("mode", col, na)
+
+    def get_frame(self) -> "H2OFrame":
+        if not self._aggs:
+            raise ValueError("add at least one aggregation first")
+        args: list = [self._fr, [self._fr.names.index(b) for b in self._by]]
+        for agg, ci, na in self._aggs:
+            args += [agg, ci, na]
+        return H2OFrame(self._fr._conn, ExprNode("GB", *args))
+
+    @property
+    def frame(self) -> "H2OFrame":
+        return self.get_frame()

@@ -35,7 +35,8 @@ class H2OGridSearch:
         self.grid_id = grid_id
         self._summary: Optional[Dict[str, Any]] = None
 
-    def train(self, y: Optional[str] = None, training_frame=None,
+    def train(self, x: Optional[List[str]] = None,
+              y: Optional[str] = None, training_frame=None,
               **extra: Any) -> "H2OGridSearch":
         import h2o3_tpu.client as h2o
 
@@ -44,6 +45,13 @@ class H2OGridSearch:
         payload.update(extra)
         if y is not None:
             payload["response_column"] = y
+        if x is not None:
+            # h2o-py semantics: x lists the predictors; everything else
+            # (except the response) is ignored — same translation as
+            # H2OEstimator.train
+            payload["ignored_columns"] = [
+                c for c in training_frame.names if c not in x and c != y
+            ]
         payload["training_frame"] = training_frame.frame_id
         payload["hyper_parameters"] = json.dumps(self.hyper_params)
         if self.search_criteria:

@@ -12,11 +12,14 @@ from typing import Any, List, Optional
 
 class H2OTree:
     def __init__(self, model, tree_number: int, tree_class: int = 0) -> None:
+        import urllib.parse
+
         import h2o3_tpu.client as h2o
 
         model_id = getattr(model, "model_id", model)
+        quoted = urllib.parse.quote(model_id, safe="")
         out = h2o.connection().request(
-            f"GET /3/Trees/{model_id}/{tree_number}",
+            f"GET /3/Trees/{quoted}/{tree_number}",
             {"tree_class": tree_class})
         self.model_id: str = out["model_id"]["name"]
         self.tree_number: int = out["tree_number"]

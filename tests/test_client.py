@@ -279,3 +279,22 @@ class TestClientGridTreeExplain:
         import matplotlib.pyplot as plt
 
         plt.close("all")
+
+
+def test_group_by_fluent(conn):
+    csv = ("g,v,w\n" + "\n".join(
+        f"{'ab'[i % 2]},{i},{i * 2}" for i in range(10)))
+    fr = h2o.upload_csv(csv)
+    out = fr.group_by("g").count().sum("v").mean("w").get_frame()
+    data = out.get_frame_data()
+    cols = list(data)
+    assert len(data[cols[0]]) == 2  # two groups
+    # group 'a' holds even i (0,2,4,6,8): count 5, sum v 20, mean w 8
+    gcol = data[cols[0]]
+    ai = gcol.index("a")
+    nrow_col = next(c for c in cols if "nrow" in c)
+    sum_col = next(c for c in cols if c.startswith("sum"))
+    mean_col = next(c for c in cols if c.startswith("mean"))
+    assert float(data[nrow_col][ai]) == 5
+    assert float(data[sum_col][ai]) == 20
+    assert float(data[mean_col][ai]) == 8

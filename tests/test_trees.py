@@ -20,6 +20,17 @@ import jax.numpy as jnp
 pytestmark = pytest.mark.leaks_keys
 
 
+@pytest.fixture(autouse=True)
+def _clear_block_cache():
+    """The block-fn lru cache key excludes the hist-impl env var; tests
+    here flip it via monkeypatch, so the cache must be flushed after the
+    env is restored or later same-key trains reuse the wrong impl."""
+    yield
+    from h2o3_tpu.models.tree.booster import _make_block_fn
+
+    _make_block_fn.cache_clear()
+
+
 def _classif_frame(rng, n=4000, informative=True):
     X = rng.normal(size=(n, 6)).astype(np.float64)
     logit = 1.5 * X[:, 0] - 2.0 * X[:, 1] + X[:, 2] * X[:, 3]
