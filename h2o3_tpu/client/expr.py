@@ -66,6 +66,12 @@ class ExprNode:
         return ExprNode("__key__", frame_key)
 
     @staticmethod
+    def raw(text: str) -> "ExprNode":
+        """A pre-rendered rapids fragment (e.g. a ``{ x . ... }`` lambda)
+        spliced into the wire string verbatim."""
+        return ExprNode("__key__", text)
+
+    @staticmethod
     def tmp_key() -> str:
         return f"py_tmp_{next(_tmp_counter)}"
 

@@ -244,6 +244,26 @@ class H2OFrame:
         """Fluent multi-aggregation group-by (h2o-py H2OFrame.group_by)."""
         return H2OGroupBy(self, by)
 
+    def apply(self, fun, axis: int = 0) -> "H2OFrame":
+        """h2o-py H2OFrame.apply: run an expression-shaped lambda per
+        column (axis=0) or per row (axis=1). The lambda is traced with a
+        symbolic proxy into a rapids ``{ x . expr }`` function (the
+        reference compiles bytecode via astfun.py; tracing covers the
+        same expression lambdas)."""
+        if axis not in (0, 1):
+            raise ValueError(f"axis must be 0 (columns) or 1 (rows), "
+                             f"got {axis!r}")
+        proxy = _LambdaProxy("x")
+        out = fun(proxy)
+        if not isinstance(out, _LambdaProxy):
+            raise ValueError("lambda must return an expression built "
+                             "from its argument")
+        margin = 2 if axis == 0 else 1
+        lam = "{ x . " + out._ast + " }"
+        return H2OFrame(
+            self._conn, ExprNode("apply", self, margin, ExprNode.raw(lam))
+        )
+
     # -- materialization -----------------------------------------------------
     def get_frame_data(self) -> Dict[str, list]:
         """Full data download via /3/DownloadDataset (frame.py
@@ -346,3 +366,101 @@ class H2OGroupBy:
     @property
     def frame(self) -> "H2OFrame":
         return self.get_frame()
+
+
+class _LambdaProxy:
+    """Symbolic stand-in passed to a user lambda: records arithmetic and
+    method calls and prints as a rapids expression. Covers the
+    expression-shaped lambdas H2OFrame.apply takes (the reference's
+    astfun.py decompiles bytecode; tracing needs no bytecode and covers
+    the same straight-line expressions, but not Python control flow)."""
+
+    def __init__(self, ast: str) -> None:
+        self._ast = ast
+
+    # arithmetic ------------------------------------------------------------
+    def _bin(self, op: str, other, flip: bool = False) -> "_LambdaProxy":
+        if isinstance(other, _LambdaProxy):
+            o = other._ast
+        else:
+            o = _to_ast(other)  # shared literal rendering; raises clearly
+        a, b = (o, self._ast) if flip else (self._ast, o)
+        return _LambdaProxy(f"({op} {a} {b})")
+
+    def __add__(self, o):
+        return self._bin("+", o)
+
+    def __radd__(self, o):
+        return self._bin("+", o, flip=True)
+
+    def __sub__(self, o):
+        return self._bin("-", o)
+
+    def __rsub__(self, o):
+        return self._bin("-", o, flip=True)
+
+    def __mul__(self, o):
+        return self._bin("*", o)
+
+    def __rmul__(self, o):
+        return self._bin("*", o, flip=True)
+
+    def __truediv__(self, o):
+        return self._bin("/", o)
+
+    def __rtruediv__(self, o):
+        return self._bin("/", o, flip=True)
+
+    def __pow__(self, o):
+        return self._bin("^", o)
+
+    def __neg__(self):
+        return _LambdaProxy(f"(- 0 {self._ast})")
+
+    def __gt__(self, o):
+        return self._bin(">", o)
+
+    def __ge__(self, o):
+        return self._bin(">=", o)
+
+    def __lt__(self, o):
+        return self._bin("<", o)
+
+    def __le__(self, o):
+        return self._bin("<=", o)
+
+    def __eq__(self, o):  # element-wise, like the H2OFrame surface
+        return self._bin("==", o)
+
+    def __ne__(self, o):
+        return self._bin("!=", o)
+
+    __hash__ = None  # symbolic: never hash/deduplicate by identity
+
+    # reducers / math methods ----------------------------------------------
+    #: op -> extra rendered args; reducers carry na_rm=True so a lambda's
+    #: x.sum() agrees with the direct H2OFrame sum() (whose client also
+    #: sends na_rm) instead of NA-poisoning
+    _METHODS = {
+        "sum": ("sum", " 1"), "mean": ("mean", " 1 0"),
+        "min": ("min", " 1"), "max": ("max", " 1"),
+        "sd": ("sd", " 1"), "var": ("var", " 1"),
+        "median": ("median", " 1"), "abs": ("abs", ""),
+        "log": ("log", ""), "exp": ("exp", ""), "sqrt": ("sqrt", ""),
+        "floor": ("floor", ""), "ceil": ("ceiling", ""),
+        "nacnt": ("naCnt", ""),
+    }
+
+    def __getattr__(self, name: str):
+        entry = self._METHODS.get(name)
+        if entry is None:
+            raise AttributeError(
+                f"H2OFrame.apply lambda supports "
+                f"{sorted(self._METHODS)} and arithmetic; got .{name}")
+        op, extra = entry
+        ast = self._ast
+
+        def call() -> "_LambdaProxy":
+            return _LambdaProxy(f"({op} {ast}{extra})")
+
+        return call

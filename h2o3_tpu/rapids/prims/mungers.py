@@ -533,14 +533,20 @@ def apply_(env, args):
             rc = rf.col(0)
             out_cols.append(Column(c.name, rc.data, rc.type, rc.domain))
         return Val.frame(Frame(out_cols))
-    # margin 1: per-row apply — vectorize by calling fun on a transposed frame
+    # margin 1: per-row apply. The row binds as ONE COLUMN of its values
+    # (the reference's AstApply row binding): reducers then collapse
+    # across the row to a scalar, and elementwise arithmetic yields the
+    # transformed row values
     mat = np.stack([numeric_data(c) for c in fr.columns], axis=1)
     out_rows = []
     for i in range(fr.nrows):
-        row_fr = Frame([Column(f"C{j+1}", np.array([mat[i, j]]), ColType.NUM) for j in range(fr.ncols)])
+        row_fr = Frame([Column("C1", mat[i].astype(np.float64), ColType.NUM)])
         res = apply_fun(fun, [Val.frame(row_fr)], env)
         if res.is_frame():
-            out_rows.append([float(c.numeric_view()[0]) for c in res.value.columns])
+            rf = res.as_frame()
+            out_rows.append([float(v) for v in rf.col(0).numeric_view()])
+        elif res.kind == Val.NUMS:
+            out_rows.append([float(v) for v in res.as_nums()])
         else:
             out_rows.append([res.as_num()])
     arr = np.asarray(out_rows)

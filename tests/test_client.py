@@ -298,3 +298,29 @@ def test_group_by_fluent(conn):
     assert float(data[nrow_col][ai]) == 5
     assert float(data[sum_col][ai]) == 20
     assert float(data[mean_col][ai]) == 8
+
+
+def test_frame_apply_lambda(conn):
+    csv = "a,b\n1,10\n2,20\n3,30\n"
+    fr = h2o.upload_csv(csv)
+    # per-column standardize-ish expression lambda
+    out = fr.apply(lambda x: (x - x.mean()) / x.sd())
+    data = out.get_frame_data()
+    import numpy as np
+
+    a = np.array([float(v) for v in data["a"]])
+    np.testing.assert_allclose(a, (np.array([1, 2, 3]) - 2) / 1.0)
+    # per-column reducer
+    sums = fr.apply(lambda x: x.sum()).get_frame_data()
+    assert [float(v[0]) for v in sums.values()] == [6.0, 60.0]
+    # row-wise reducer (axis=1): mean across each row's values
+    rows = fr.apply(lambda x: x.mean(), axis=1).get_frame_data()
+    vals = [float(v) for v in next(iter(rows.values()))]
+    assert vals == [5.5, 11.0, 16.5]
+    # comparisons trace element-wise (not Python identity)
+    flags = fr.apply(lambda x: (x == 2).sum()).get_frame_data()
+    assert [float(v[0]) for v in flags.values()] == [1.0, 0.0]
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="axis"):
+        fr.apply(lambda x: x.sum(), axis=7)
