@@ -437,6 +437,12 @@ class _LambdaProxy:
 
     __hash__ = None  # symbolic: never hash/deduplicate by identity
 
+    def __bool__(self):
+        raise TypeError(
+            "a traced lambda expression has no truth value: chained "
+            "comparisons (0 < x < 5) and and/or would silently drop "
+            "terms — write (x > 0) * (x < 5) instead")
+
     # reducers / math methods ----------------------------------------------
     #: op -> extra rendered args; reducers carry na_rm=True so a lambda's
     #: x.sum() agrees with the direct H2OFrame sum() (whose client also

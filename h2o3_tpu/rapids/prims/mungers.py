@@ -585,3 +585,66 @@ def mojo_pipeline_transform(env, args):
         return Val.frame(pipe.transform(fr))
     except ValueError as e:
         raise RapidsError(str(e))
+
+
+@prim("grouped_permute")
+def grouped_permute(env, args):
+    """(grouped_permute fr permCol groupBy permuteBy keepCol)
+    (AstGroupedPermute): within each group (first groupBy column), rows
+    split by whether the permuteBy categorical's level is "D"; the two
+    sides' (permCol id -> summed keepCol amount) maps are crossed into
+    [group, In, Out, InAmnt, OutAmnt] rows — all D-side x other-side
+    combinations, first-seen id order, duplicate ids merging amounts."""
+    fr = args[0].as_frame()
+    perm_col = int(args[1].as_num())
+    by = [int(i) for i in args[2].as_nums()]
+    permute_by = int(args[3].as_num())
+    keep_col = int(args[4].as_num())
+
+    gb_col = fr.col(by[0])
+    gid = numeric_data(gb_col)
+    pb = fr.col(permute_by)
+    if pb.domain is None:
+        raise RapidsError("grouped_permute: permuteBy must be categorical")
+    is_d = np.array([
+        pb.domain[int(c)] == "D" if c >= 0 else False for c in pb.data
+    ])
+    rid = numeric_data(fr.col(perm_col))
+    amt = numeric_data(fr.col(keep_col))
+
+    # per group, per side: insertion-ordered rid -> summed amount.
+    # NaN keys canonicalize to one sentinel: the reference's
+    # HashMap<Double> treats NaN as equal to itself, so NA groups merge
+    def canon(v: float):
+        return "__nan__" if np.isnan(v) else float(v)
+
+    groups: dict = {}
+    for i in range(fr.nrows):
+        sides = groups.setdefault(canon(gid[i]), ({}, {}))
+        side = sides[0] if is_d[i] else sides[1]
+        side[canon(rid[i])] = side.get(canon(rid[i]), 0.0) + amt[i]
+
+    rows = []
+    for key, (d_side, c_side) in groups.items():
+        k = np.nan if key == "__nan__" else key
+        for r0, a0 in d_side.items():
+            for r1, a1 in c_side.items():
+                rows.append((k,
+                             np.nan if r0 == "__nan__" else r0,
+                             np.nan if r1 == "__nan__" else r1,
+                             a0, a1))
+    out = np.array(rows, dtype=np.float64).reshape(-1, 5)
+
+    def col(name, vals, src):
+        if src.domain is not None:
+            codes = np.where(np.isnan(vals), -1, vals).astype(np.int32)
+            return Column(name, codes, ColType.CAT, list(src.domain))
+        return Column(name, vals, ColType.NUM)
+
+    return Val.frame(Frame([
+        col(fr.names[by[0]], out[:, 0], gb_col),
+        col("In", out[:, 1], fr.col(perm_col)),
+        col("Out", out[:, 2], fr.col(perm_col)),
+        Column("InAmnt", out[:, 3], ColType.NUM),
+        Column("OutAmnt", out[:, 4], ColType.NUM),
+    ]))

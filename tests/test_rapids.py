@@ -391,3 +391,42 @@ def test_distance_measures(sess):
         out = ex(s, f'(distance dist_a dist_b "{measure}")').as_frame()
         got = np.stack([c.numeric_view() for c in out.columns], axis=1)
         np.testing.assert_allclose(got, want, rtol=1e-12, err_msg=measure)
+
+
+def test_grouped_permute(sess):
+    """(grouped_permute ...) — AstGroupedPermute: D-side x other-side
+    id/amount crossings within each group."""
+    import numpy as np
+
+    from h2o3_tpu.frame.frame import ColType, Column, Frame
+
+    s = Session()
+    fr = Frame([
+        Column("acct", np.array([1.0, 1, 1, 1, 2, 2])),
+        Column("txn", np.array([10.0, 11, 12, 10, 20, 21])),
+        Column("dc", np.array([0, 0, 1, 0, 0, 1], np.int32),
+               ColType.CAT, ["D", "C"]),
+        Column("amt", np.array([5.0, 7, 9, 3, 4, 6])),
+    ])
+    s.assign("gp", fr)
+    out = ex(s, "(grouped_permute gp 1 [0] 2 3)").as_frame()
+    # acct 1: D side {10: 5+3=8, 11: 7}, C side {12: 9} -> 2 rows
+    # acct 2: D side {20: 4}, C side {21: 6} -> 1 row
+    assert out.nrows == 3
+    ins = out.col("In").numeric_view()
+    amnts = out.col("InAmnt").numeric_view()
+    i10 = int(np.where(ins == 10)[0][0])
+    assert amnts[i10] == 8.0  # duplicate D ids merge amounts
+    assert float(out.col("OutAmnt").numeric_view()[i10]) == 9.0
+
+    # NA group keys merge into ONE group (reference HashMap<Double>
+    # semantics), not one singleton per NaN
+    fr2 = Frame([
+        Column("acct", np.array([np.nan, np.nan])),
+        Column("txn", np.array([1.0, 2.0])),
+        Column("dc", np.array([0, 1], np.int32), ColType.CAT, ["D", "C"]),
+        Column("amt", np.array([5.0, 9.0])),
+    ])
+    s.assign("gp2", fr2)
+    out2 = ex(s, "(grouped_permute gp2 1 [0] 2 3)").as_frame()
+    assert out2.nrows == 1  # the D and C rows cross within the NA group
