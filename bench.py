@@ -112,6 +112,9 @@ def _fail(stage: str, detail: str) -> None:
         partial = None  # stale cross-round artifact (or unknowable round)
     if partial and partial.get("value"):
         partial["error"] = err
+        # explicit machine-readable flag so a consumer parsing only a few
+        # fields cannot mistake a mirrored value for a live measurement
+        partial["value_is_mirrored"] = True
         partial["source"] = (
             "BENCH_PARTIAL.json — mirrored from a successful measurement "
             "earlier this round; the TPU backend was unreachable at bench "

@@ -125,6 +125,9 @@ def _job_schema(job: Job) -> Dict[str, Any]:
 def _metrics_schema(mm: Any) -> Optional[Dict[str, Any]]:
     if mm is None:
         return None
+    if isinstance(mm, dict):  # e.g. isolation forest's {mean_score, max_score}
+        return {k: (None if isinstance(v, float) and np.isnan(v) else v)
+                for k, v in mm.items() if np.isscalar(v)}
     out = {}
     for k in (
         "mse rmse mae rmsle r2 mean_residual_deviance auc pr_auc gini logloss "

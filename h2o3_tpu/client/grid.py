@@ -41,6 +41,8 @@ class H2OGridSearch:
         import h2o3_tpu.client as h2o
 
         conn = h2o.connection()
+        if training_frame is None:
+            raise ValueError("training_frame is required")
         payload: Dict[str, Any] = dict(self.base_params)
         payload.update(extra)
         if y is not None:

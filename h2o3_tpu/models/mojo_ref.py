@@ -160,13 +160,29 @@ def _zip_write(path: str, ini_lines: List[str],
     return path
 
 
+def _jdouble(v: float) -> str:
+    """One double in Java Double.toString spelling: non-finite values are
+    'Infinity'/'-Infinity'/'NaN' (Python repr's 'inf'/'nan' would misparse
+    in a genuine h2o-genmodel reader's parseDouble)."""
+    v = float(v)
+    if v != v:
+        return "NaN"
+    if v == float("inf"):
+        return "Infinity"
+    if v == float("-inf"):
+        return "-Infinity"
+    return repr(v)
+
+
 def _jarr(vals) -> str:
     """Java Arrays.toString formatting for a double[] ini value."""
-    return "[" + ", ".join(repr(float(v)) for v in vals) + "]"
+    return "[" + ", ".join(_jdouble(v) for v in vals) + "]"
 
 
 def _parse_jarr(s: str, cast=float):
-    """Inverse of _jarr: parse a bracketed comma-joined kv array."""
+    """Inverse of _jarr: parse a bracketed comma-joined kv array.
+    float() natively accepts both the Java ('Infinity'/'NaN') and the
+    Python ('inf'/'nan') spellings, so no special casing is needed."""
     body = s.strip()[1:-1].strip()
     return [cast(x) for x in body.split(",")] if body else []
 
@@ -259,11 +275,11 @@ def _write_glm_mojo(model, path: str) -> str:
         ("cat_offsets", "[" + ", ".join(map(str, cat_offsets)) + "]"),
         ("nums", len(nums)),
         ("num_means", "[" + ", ".join(
-            repr(info_d.num_means[n]) for n in nums) + "]"),
+            _jdouble(info_d.num_means[n]) for n in nums) + "]"),
         ("mean_imputation",
          "true" if info_d.missing_values_handling == "mean_imputation"
          else "false"),
-        ("beta", "[" + ", ".join(repr(b) for b in beta) + "]"),
+        ("beta", "[" + ", ".join(_jdouble(b) for b in beta) + "]"),
         ("family", p.family),
         ("link", p.actual_link()),
         ("tweedie_link_power", p.tweedie_link_power),
@@ -957,7 +973,7 @@ def write_mojo(model, path: str) -> str:
         ("n_trees_per_class", K),
         ("distribution", dist),
         ("link_function", _LINK_BY_DIST.get(dist, "identity")),
-        ("init_f", repr(init_f)),
+        ("init_f", _jdouble(init_f)),
     ]
     if algo == "drf":
         info.append(("binomial_double_trees", "false"))

@@ -5,9 +5,9 @@ The axon remote backend serializes sessions and a killed process wedges
 it for ~25+ minutes (see .claude/skills/verify/SKILL.md), so when a
 window opens the safest plan is ONE process that produces everything:
 
-  1. KERNEL_PROBE_r04.json    — per-K kernel evidence (VERDICT r3 1d)
+  1. KERNEL_PROBE_r05.json    — per-K kernel evidence (VERDICT r3 1d)
   2. KERNEL_LAB.json          — production vs rt1024 vs factorized per K
-  3. SUBTRACT_AB_r04.json     — end-to-end A/B of the subtraction flow
+  3. SUBTRACT_AB_r05.json     — end-to-end A/B of the subtraction flow
   4. BENCH_PARTIAL.json       — refreshed flagship number via the fastest
                                measured configuration
 
@@ -81,12 +81,12 @@ def main() -> None:
     os.chdir(_HERE)  # CWD-relative outputs (KERNEL_LAB.json) land in-repo
     print("devices:", jax.devices(), flush=True)
 
-    # 1. kernel probe (writes KERNEL_PROBE_r04.json itself)
+    # 1. kernel probe (writes KERNEL_PROBE_r05.json itself)
     def probe():
         import runpy
 
         sys.argv = ["bench_hist_kernel",
-                    os.path.join(_HERE, "KERNEL_PROBE_r04.json")]
+                    os.path.join(_HERE, "KERNEL_PROBE_r05.json")]
         runpy.run_path(
             os.path.join(_HERE, "scripts", "bench_hist_kernel.py"),
             run_name="__main__")
@@ -118,7 +118,7 @@ def main() -> None:
                 _ROWS * _TREES / dt, 1)
             print(results, flush=True)
         if not _SMOKE:  # a CPU smoke run must not write TPU artifacts
-            with open(os.path.join(_HERE, "SUBTRACT_AB_r04.json"), "w") as f:
+            with open(os.path.join(_HERE, "SUBTRACT_AB_r05.json"), "w") as f:
                 json.dump(results, f, indent=1)
         return results
 
@@ -169,6 +169,51 @@ def main() -> None:
 
     if ab_res and not _SMOKE:  # never let a smoke run touch the artifact
         _stage("refresh_partial", refresh)
+
+    # 5. device-munging crossover sweep (VERDICT r4 item 7): host vs
+    #    device sort and groupby at 64k..4M rows, so DIST_SORT_MIN is
+    #    set from data instead of a guess.
+    def crossover():
+        from h2o3_tpu.rapids import dist
+
+        sizes = ((65_536, 262_144, 1_048_576, 4_194_304)
+                 if not _SMOKE else (8_192, 16_384))
+        out = {"sizes": []}
+        for n in sizes:
+            rng = np.random.default_rng(n)
+            vals = rng.normal(size=n)
+            keys = dist.encode_f64(vals)
+            codes = rng.integers(0, 1024, size=n).astype(np.int64)
+            entry = {"n": n}
+            dist.device_argsort_u64(keys)  # compile warmup
+            t0 = time.time()
+            dist.device_argsort_u64(keys)
+            entry["device_sort_s"] = round(time.time() - t0, 4)
+            t0 = time.time()
+            np.argsort(keys, kind="stable")
+            entry["host_sort_s"] = round(time.time() - t0, 4)
+            dist.device_group_aggregate(codes, vals, 1024)  # warmup
+            t0 = time.time()
+            dist.device_group_aggregate(codes, vals, 1024)
+            entry["device_groupby_s"] = round(time.time() - t0, 4)
+            t0 = time.time()
+            np.bincount(codes, minlength=1024)
+            np.bincount(codes, weights=vals, minlength=1024)
+            np.bincount(codes, weights=vals * vals, minlength=1024)
+            entry["host_groupby_s"] = round(time.time() - t0, 4)
+            out["sizes"].append(entry)
+            print(entry, flush=True)
+        # first size where the device sort beats host = measured crossover
+        xs = [e["n"] for e in out["sizes"]
+              if e["device_sort_s"] < e["host_sort_s"]]
+        out["sort_crossover_rows"] = min(xs) if xs else None
+        if not _SMOKE:
+            with open(os.path.join(_HERE, "MUNGE_CROSSOVER_r05.json"),
+                      "w") as f:
+                json.dump(out, f, indent=1)
+        return out
+
+    _stage("munge_crossover", crossover)
 
     print("### session complete", flush=True)
 

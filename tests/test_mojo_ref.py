@@ -629,3 +629,28 @@ class TestStackedEnsembleReferenceMojo:
         for i in range(0, n, 37):
             got = mojo.score0(X[i].astype(np.float64))
             np.testing.assert_allclose(got, want[i], rtol=1e-5, atol=1e-6)
+
+
+class TestJavaDoubleSpelling:
+    """ADVICE r4: non-finite doubles must render as Java parseDouble
+    spellings ('Infinity'/'NaN'), and the parser must accept both."""
+
+    def test_jarr_roundtrip_nonfinite(self):
+        from h2o3_tpu.models.mojo_ref import _jarr, _parse_jarr
+        import math
+
+        vals = [1.5, float("inf"), float("-inf"), float("nan"), -0.0]
+        s = _jarr(vals)
+        assert "Infinity" in s and "NaN" in s
+        assert "inf" not in s.replace("Infinity", "")  # no Python spelling
+        back = _parse_jarr(s)
+        assert back[0] == 1.5 and back[1] == math.inf and back[2] == -math.inf
+        assert math.isnan(back[3])
+
+    def test_parse_accepts_python_spelling(self):
+        from h2o3_tpu.models.mojo_ref import _parse_jarr
+        import math
+
+        back = _parse_jarr("[inf, -inf, nan, 2.0]")
+        assert back[0] == math.inf and back[1] == -math.inf
+        assert math.isnan(back[2]) and back[3] == 2.0

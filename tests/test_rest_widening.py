@@ -245,3 +245,13 @@ class TestEstimatorSurface:
 
         with pytest.raises(TypeError, match="ntreees"):
             H2OGradientBoostingEstimator(ntreees=5)
+
+
+def test_metrics_schema_accepts_dict():
+    """ADVICE r4: isolation forest stores training_metrics as a plain dict;
+    the model schema must surface its entries instead of {}."""
+    from h2o3_tpu.api.handlers import _metrics_schema
+
+    out = _metrics_schema({"mean_score": 0.42, "max_score": 0.9})
+    assert out == {"mean_score": 0.42, "max_score": 0.9}
+    assert _metrics_schema(None) is None
