@@ -202,29 +202,38 @@ def _worker() -> None:
 
     rows_per_sec = n_rows * ntrees / dt  # row-scans per second per chip
 
-    vs = 1.0
-    import glob
-    for path in sorted(glob.glob(os.path.join(_HERE, "BENCH_r*.json")),
-                       reverse=True):
-        try:
-            with open(path) as f:
-                prev = json.load(f)
-            parsed = prev.get("parsed") or prev  # driver wraps under "parsed"
-            if parsed.get("value"):  # skip rounds that recorded a crash
-                vs = rows_per_sec / float(parsed["value"])
-                break
-        except Exception:
-            continue
+    # MFU accounting (VERDICT r4 item 2): the histogram build is the FLOP
+    # budget — per level the node-matmul kernel contracts
+    # one_hot(bins)[R, F*B1] against node-masked vals [R, K*C] (C=4
+    # channels), so FLOPs = 2*R*F*B1*K*C summed over levels (K = 2**d
+    # nodes; subtraction builds only the smaller child, ~halving K past
+    # the root).  Achieved TFLOP/s over bf16 peak gives MFU on one v5e
+    # core (197 TFLOP/s; override BENCH_PEAK_TFLOPS for other parts).
+    n_bins1, chans, n_feat = 257, 4, X.shape[1]
+    level_nodes = sum(
+        max(1, 2 ** d // (2 if _subtract_on and d > 0 else 1))
+        for d in range(max_depth)
+    )
+    flops = 2.0 * n_rows * n_feat * n_bins1 * chans * level_nodes * ntrees
+    peak = float(os.environ.get("BENCH_PEAK_TFLOPS", 197.0))
+    tflops = flops / dt / 1e12
+    # re-based denominators (VERDICT r4 weak 7): the 8M round target and
+    # the 25M north star, not round 1's broken floor
+    target = 8_000_000.0
 
     print(json.dumps({
         "metric": "tpu_hist_train_rows_per_sec_per_chip",
         "value": round(rows_per_sec, 1),
         "unit": "rows/sec (n_rows*ntrees/train_time, Higgs-shaped 28f)",
-        "vs_baseline": round(vs, 3),
+        "vs_baseline": round(rows_per_sec / target, 3),
         "detail": {"n_rows": n_rows, "ntrees": ntrees,
                    "max_depth": max_depth, "train_s": round(dt, 3),
                    "warmup_s": round(warmup_s, 1),
-                   "subtract": _subtract_on},
+                   "subtract": _subtract_on,
+                   "vs_baseline_is": "value / 8M rows/sec round target",
+                   "vs_north_star_25M": round(rows_per_sec / 25e6, 3),
+                   "achieved_tflops": round(tflops, 2),
+                   "mfu_vs_bf16_peak": round(tflops / peak, 4)},
     }))
 
 

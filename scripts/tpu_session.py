@@ -135,28 +135,14 @@ def main() -> None:
                 rnd = json.loads(f.read().splitlines()[-1]).get("round")
         except Exception:
             rnd = None
-        # vs_baseline means "vs the newest official BENCH_r*.json value",
-        # exactly like bench.py's worker — the two writers of
-        # BENCH_PARTIAL.json must agree on the metric's meaning
-        import glob
-
-        vs = 1.0
-        for p in sorted(glob.glob(os.path.join(_HERE, "BENCH_r*.json")),
-                        reverse=True):
-            try:
-                with open(p) as f:
-                    prev = json.load(f)
-                parsed = prev.get("parsed") or prev
-                if parsed.get("value"):
-                    vs = value / float(parsed["value"])
-                    break
-            except Exception:
-                continue
+        # vs_baseline means "vs the 8M rows/sec round target", exactly
+        # like bench.py's worker — the two writers of BENCH_PARTIAL.json
+        # must agree on the metric's meaning
         partial = {
             "metric": "tpu_hist_train_rows_per_sec_per_chip",
             "value": value,
             "unit": "rows/sec (n_rows*ntrees/train_time, Higgs-shaped 28f)",
-            "vs_baseline": round(vs, 3),
+            "vs_baseline": round(value / 8e6, 3),
             "detail": {"n_rows": _ROWS, "ntrees": _TREES, "max_depth": 6,
                        "train_s": dt,
                        "subtract": best_mode == "1"},
