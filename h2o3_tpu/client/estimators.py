@@ -79,6 +79,33 @@ class H2OModel:
         )
         return out["model_metrics"][0]
 
+    def permutation_importance(self, frame: H2OFrame, metric: str = "AUTO",
+                               n_samples: int = 10_000, n_repeats: int = 1,
+                               features=None, seed: int = -1) -> H2OFrame:
+        """Permutation variable importance over ``frame``
+        (h2o-py ModelBase.permutation_importance — emits the
+        ``PermutationVarImp`` rapids op, AstPermutationVarImp)."""
+        from h2o3_tpu.client.expr import ExprNode
+
+        frame.refresh()  # materialize once; nrows below reuses the key
+        if n_samples == -1 or n_samples > frame.nrows:
+            n_samples = -1
+        ex = ExprNode("PermutationVarImp", ExprNode.raw(self.model_id),
+                      frame, metric, n_samples, n_repeats,
+                      features, seed)
+        return H2OFrame(self._conn, ex)
+
+    def reset_threshold(self, threshold: float) -> float:
+        """Set the classification threshold used by predict; returns the
+        old one (h2o-py reset_model_threshold —
+        the ``model.reset.threshold`` rapids op)."""
+        from h2o3_tpu.client.expr import ExprNode
+
+        ex = ExprNode("model.reset.threshold",
+                      ExprNode.raw(self.model_id), threshold)
+        fr = H2OFrame(self._conn, ex)
+        return float(fr._scalar(ExprNode("flatten", fr)))
+
     def download_mojo(self, path: str, format: str = "native") -> str:
         """format='reference' emits the actual H2O-3 MOJO zip layout."""
         import os

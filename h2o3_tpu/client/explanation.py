@@ -50,6 +50,29 @@ def varimp_plot(model, num_of_features: int = 10):
     return fig
 
 
+def permutation_importance_plot(model, frame, metric: str = "AUTO",
+                                n_samples: int = 10_000, n_repeats: int = 1,
+                                features=None, seed: int = -1,
+                                num_of_features: int = 10):
+    """Bar chart of permutation variable importance
+    (h2o-py permutation_importance_plot; AstPermutationVarImp)."""
+    import matplotlib.pyplot as plt
+
+    pvi = model.permutation_importance(
+        frame, metric=metric, n_samples=n_samples, n_repeats=n_repeats,
+        features=features, seed=seed)
+    data = pvi.get_frame_data()
+    names = list(data["Variable"])[:num_of_features][::-1]
+    col = "Scaled Importance" if "Scaled Importance" in data else "Run 1"
+    vals = [float(v) for v in data[col][:num_of_features]][::-1]
+    fig, ax = plt.subplots(figsize=(8, max(2, 0.4 * len(names))))
+    ax.barh(names, vals)
+    ax.set_xlabel(f"permutation importance ({col.lower()})")
+    ax.set_title(f"Permutation variable importance: {_model_id(model)}")
+    fig.tight_layout()
+    return fig
+
+
 def pd_plot(model, frame, column: str, nbins: int = 20):
     """Partial-dependence curve for one column (h2o-py pd_plot)."""
     import matplotlib.pyplot as plt
@@ -89,6 +112,10 @@ def explain(model, frame, columns: Optional[List[str]] = None) -> List[Any]:
     if columns is None:
         columns = [r["variable"] for r in _varimp_rows(model)[:3]]
     figs = [varimp_plot(model)]
+    try:
+        figs.append(permutation_importance_plot(model, frame))
+    except Exception:
+        pass  # e.g. unsupervised model with no scoreable metric
     for c in columns:
         figs.append(pd_plot(model, frame, c))
     return figs

@@ -174,14 +174,30 @@ class Model:
             frame = pre.transform(frame)
         return frame
 
+    def default_threshold(self) -> float:
+        """Binomial label threshold: an explicit reset wins, else the
+        training max-F1 (Model._output.defaultThreshold())."""
+        override = getattr(self, "_threshold_override", None)
+        if override is not None:
+            return override
+        return getattr(self.training_metrics, "max_f1_threshold", 0.5) or 0.5
+
+    def reset_threshold(self, threshold: float) -> float:
+        """Set the classification threshold used by predict; returns the
+        previous effective threshold (Model.resetThreshold,
+        rapids ``model.reset.threshold``)."""
+        old = self.default_threshold()
+        self._threshold_override = float(threshold)
+        return old
+
     def predict(self, frame: Frame) -> Frame:
         """Predictions frame: 'predict' (+ per-class probability columns)."""
         frame = self._apply_preprocessors(frame)
         raw = self._predict_raw(frame)
         if not self.is_classifier:
             return prediction_frame(raw, None)
-        thr = getattr(self.training_metrics, "max_f1_threshold", 0.5) or 0.5
-        return prediction_frame(raw, self.data_info.response_domain, thr)
+        return prediction_frame(raw, self.data_info.response_domain,
+                                self.default_threshold())
 
     def model_performance(self, frame: Frame) -> Any:
         """Score a frame and build the right ModelMetrics (Model.score + MM builders)."""

@@ -166,8 +166,11 @@ def write_mojo(model: Model, path: str) -> str:
     meta, arrays = _payload(model)
     info = _info_dict(model)
     # binomial label threshold: offline labels must match in-cluster
-    # Model.predict, which thresholds at the training max-F1 point
-    thr = getattr(model.training_metrics, "max_f1_threshold", None)
+    # Model.predict — an explicit reset_threshold wins over the
+    # training max-F1 point
+    thr = getattr(model, "_threshold_override", None)
+    if thr is None:
+        thr = getattr(model.training_metrics, "max_f1_threshold", None)
     if thr is not None and np.isfinite(thr):
         meta["default_threshold"] = float(thr)
     buf = io.BytesIO()

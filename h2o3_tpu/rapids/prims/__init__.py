@@ -34,6 +34,7 @@ from h2o3_tpu.rapids.prims import (  # noqa: E402,F401
     assign,
     mathops,
     matrix,
+    models,
     mungers,
     operators,
     reducers,

@@ -34,7 +34,7 @@ from h2o3_tpu.rapids.parser import (
 class Val:
     """Tagged runtime value (water/rapids/Val.java)."""
 
-    NUM, NUMS, STR, STRS, FRAME, ROW, FUN = range(7)
+    NUM, NUMS, STR, STRS, FRAME, ROW, FUN, MODEL, KEYED = range(9)
 
     __slots__ = ("kind", "value")
 
@@ -70,6 +70,14 @@ class Val:
     @staticmethod
     def fun(f) -> "Val":
         return Val(Val.FUN, f)
+
+    @staticmethod
+    def model(m) -> "Val":
+        return Val(Val.MODEL, m)
+
+    @staticmethod
+    def keyed(obj) -> "Val":
+        return Val(Val.KEYED, obj)
 
     # -- coercions (Val.getNum/getFrame/... semantics) -----------------------
     def as_num(self) -> float:
@@ -126,8 +134,22 @@ class Val:
     def is_fun(self) -> bool:
         return self.kind == Val.FUN
 
+    def as_model(self):
+        """Val.getModel — a MODEL val, or a str/id naming a model in the
+        DKV (h2o-py serializes ModelBase args as bare model ids)."""
+        if self.kind == Val.MODEL:
+            return self.value
+        if self.kind in (Val.STR, Val.KEYED):
+            from h2o3_tpu.models.framework import Model
+
+            obj = self.value if self.kind == Val.KEYED else DKV.get(self.value)
+            if isinstance(obj, Model):
+                return obj
+        raise TypeError(f"expected a model, got {self!r}")
+
     def __repr__(self) -> str:
-        names = {0: "num", 1: "nums", 2: "str", 3: "strs", 4: "frame", 5: "row", 6: "fun"}
+        names = {0: "num", 1: "nums", 2: "str", 3: "strs", 4: "frame",
+                 5: "row", 6: "fun", 7: "model", 8: "keyed"}
         return f"<Val:{names[self.kind]} {self.value!r}>"
 
 
@@ -234,6 +256,11 @@ def _eval_id(name: str, env: Env) -> Val:
     fr = env.session.lookup(name)
     if fr is not None:
         return Val.frame(fr)
+    obj = DKV.get(name)
+    if obj is not None:  # DKV ids beyond frames: models, segment models
+        from h2o3_tpu.models.framework import Model
+
+        return Val.model(obj) if isinstance(obj, Model) else Val.keyed(obj)
     from h2o3_tpu.rapids.prims import PRIMS
 
     if name in PRIMS:

@@ -324,3 +324,45 @@ def test_frame_apply_lambda(conn):
 
     with _pytest.raises(ValueError, match="axis"):
         fr.apply(lambda x: x.sum(), axis=7)
+
+
+class TestClientModelPrims:
+    """Round-5 client surface: permutation importance + reset threshold
+    (h2o-py ModelBase.permutation_importance / reset_model_threshold,
+    emitting the AstPermutationVarImp / AstModelResetThreshold rapids)."""
+
+    def _train(self, seed=5):
+        import h2o3_tpu.client as h2o
+
+        rng = np.random.default_rng(seed)
+        X = rng.normal(size=(300, 3))
+        y = (X[:, 0] + 0.2 * X[:, 1] > 0).astype(int)
+        csv = "a,b,c,y\n" + "\n".join(
+            f"{r[0]},{r[1]},{r[2]},c{int(t)}" for r, t in zip(X, y))
+        fr = h2o.upload_csv(csv)
+        est = h2o.H2OGradientBoostingEstimator(ntrees=5, max_depth=3, seed=1)
+        est.train(y="y", training_frame=fr)
+        return est.model, fr
+
+    def test_permutation_importance(self, conn):
+        model, fr = self._train()
+        pvi = model.permutation_importance(fr, metric="auc", seed=42)
+        data = pvi.get_frame_data()
+        assert list(data)[0] == "Variable"
+        assert "Scaled Importance" in data
+        # strongest feature first, response not present
+        assert data["Variable"][0] == "a"
+        assert "y" not in data["Variable"]
+
+    def test_permutation_importance_repeats(self, conn):
+        model, fr = self._train()
+        pvi = model.permutation_importance(fr, n_repeats=2, seed=42)
+        data = pvi.get_frame_data()
+        assert "Run 1" in data and "Run 2" in data
+
+    def test_reset_threshold(self, conn):
+        model, fr = self._train()
+        old = model.reset_threshold(0.8)
+        assert 0.0 < old < 1.0
+        # a second reset returns the value just set
+        assert model.reset_threshold(0.3) == pytest.approx(0.8)

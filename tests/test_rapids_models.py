@@ -1,0 +1,150 @@
+"""Model-valued rapids prims (water/rapids/ast/prims/models/):
+perfectAUC, model.reset.threshold, PermutationVarImp,
+segment_models_as_frame.  Oracles: sklearn's exact AUC, direct metric
+deltas, and the segment builder's own frame."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.frame.frame import Column, ColType, Frame
+from h2o3_tpu.rapids import Session, exec_rapids
+
+pytestmark = pytest.mark.leaks_keys
+
+
+def _train_glm(n=400, seed=1):
+    from h2o3_tpu.models.glm import GLM
+
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 4))
+    w = np.array([2.0, -1.0, 0.5, 0.0])
+    yv = (rng.random(n) < 1 / (1 + np.exp(-(X @ w)))).astype(np.int32)
+    fr = Frame(
+        [Column(f"x{j}", X[:, j], ColType.NUM) for j in range(4)]
+        + [Column("y", yv, ColType.CAT, ["0", "1"])]
+    )
+    model = GLM(family="binomial", response_column="y").train(fr)
+    return model, fr, X, yv
+
+
+class TestPerfectAUC:
+    def test_matches_sklearn_exact_auc(self):
+        from sklearn.metrics import roc_auc_score
+
+        rng = np.random.default_rng(0)
+        probs = np.round(rng.random(500), 2)  # coarse grid forces ties
+        acts = (rng.random(500) < probs).astype(np.float64)
+        s = Session()
+        s.assign("p", Frame([Column("p", probs, ColType.NUM)]))
+        s.assign("a", Frame([Column("a", acts, ColType.NUM)]))
+        out = exec_rapids("(perfectAUC p a)", s).as_frame()
+        got = float(out.col(0).numeric_view()[0])
+        want = roc_auc_score(acts, probs)
+        assert got == pytest.approx(want, abs=1e-12)
+
+    def test_validations(self):
+        s = Session()
+        s.assign("p", Frame([Column("p", np.array([0.1, 1.5]), ColType.NUM)]))
+        s.assign("a", Frame([Column("a", np.array([0.0, 1.0]), ColType.NUM)]))
+        with pytest.raises(ValueError, match="between 0 and 1"):
+            exec_rapids("(perfectAUC p a)", s)
+        s.assign("p2", Frame([Column("p", np.array([0.1, 0.5]), ColType.NUM)]))
+        s.assign("a2", Frame([Column("a", np.array([0.0, 2.0]), ColType.NUM)]))
+        with pytest.raises(ValueError, match="0 or 1"):
+            exec_rapids("(perfectAUC p2 a2)", s)
+
+
+class TestResetThreshold:
+    def test_roundtrip_and_predict_effect(self):
+        model, fr, X, yv = _train_glm()
+        s = Session()
+        old = model.default_threshold()
+        out = exec_rapids(
+            f"(model.reset.threshold {model.key} 0.75)", s).as_frame()
+        assert float(out.col(0).numeric_view()[0]) == pytest.approx(old)
+        assert model.default_threshold() == 0.75
+        # labels actually move with the threshold
+        pred = model.predict(fr)
+        p1 = pred.col("p1").numeric_view()
+        labels = pred.col("predict").data
+        np.testing.assert_array_equal(labels, (p1 >= 0.75).astype(np.int32))
+        # second reset returns the first override
+        out2 = exec_rapids(
+            f"(model.reset.threshold {model.key} 0.25)", s).as_frame()
+        assert float(out2.col(0).numeric_view()[0]) == pytest.approx(0.75)
+
+
+class TestPermutationVarImp:
+    def test_informative_features_rank_top(self):
+        model, fr, X, yv = _train_glm()
+        s = Session()
+        s.assign("fr", fr)
+        out = exec_rapids(
+            f'(PermutationVarImp {model.key} fr "auc" -1 1 [] 42)',
+            s).as_frame()
+        assert out.names == ["Variable", "Relative Importance",
+                             "Scaled Importance", "Percentage"]
+        vars_ = list(out.col("Variable").data)
+        # response is excluded; strongest coefficient shuffles worst
+        assert "y" not in vars_
+        assert set(vars_) == {"x0", "x1", "x2", "x3"}
+        assert vars_[0] == "x0"  # |w|=2 dominates
+        rel = out.col("Relative Importance").numeric_view()
+        scaled = out.col("Scaled Importance").numeric_view()
+        pct = out.col("Percentage").numeric_view()
+        assert np.all(np.diff(rel) <= 0)  # sorted descending
+        assert scaled[0] == pytest.approx(1.0)
+        assert pct.sum() == pytest.approx(1.0)
+
+    def test_repeats_and_features_subset(self):
+        model, fr, X, yv = _train_glm()
+        s = Session()
+        s.assign("fr", fr)
+        out = exec_rapids(
+            f'(PermutationVarImp {model.key} fr "auto" -1 3 ["x0" "x1"] 7)',
+            s).as_frame()
+        assert out.names == ["Variable", "Run 1", "Run 2", "Run 3"]
+        assert set(out.col("Variable").data) == {"x0", "x1"}
+        assert out.nrows == 2
+
+    def test_validations(self):
+        model, fr, X, yv = _train_glm()
+        s = Session()
+        s.assign("fr", fr)
+        with pytest.raises(ValueError, match="n_samples"):
+            exec_rapids(
+                f'(PermutationVarImp {model.key} fr "auc" 1 1 [] 42)', s)
+        with pytest.raises(ValueError, match="not present"):
+            exec_rapids(
+                f'(PermutationVarImp {model.key} fr "auc" -1 1 ["zz"] 42)',
+                s)
+
+
+class TestSegmentModelsAsFrame:
+    def test_frame_matches_builder(self):
+        from h2o3_tpu.models.glm import GLM, GLMParameters
+        from h2o3_tpu.models.segments import SegmentModelsBuilder
+
+        rng = np.random.default_rng(3)
+        n = 120
+        g = np.array(["a", "b", "c"])[rng.integers(0, 3, n)]
+        x = rng.normal(size=n)
+        yv = 2.0 * x + rng.normal(scale=0.1, size=n)
+        dom = ["a", "b", "c"]
+        fr = Frame([
+            Column("g", np.array([dom.index(v) for v in g], np.int32),
+                   ColType.CAT, dom),
+            Column("x", x, ColType.NUM),
+            Column("y", yv, ColType.NUM),
+        ])
+        sm = SegmentModelsBuilder(
+            GLM,
+            GLMParameters(response_column="y", family="gaussian", lambda_=0.0),
+            segment_columns=["g"]).train(fr)
+        s = Session()
+        out = exec_rapids(f"(segment_models_as_frame {sm.key})", s).as_frame()
+        want = sm.as_frame()
+        assert out.names == want.names
+        assert out.nrows == 3
+        st = out.col("status")
+        assert all(st.domain[c] == "succeeded" for c in st.data)
