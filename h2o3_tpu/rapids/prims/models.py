@@ -34,9 +34,10 @@ def perfect_auc_values(probs: np.ndarray, acts: np.ndarray) -> float:
     with midranks in vectorized numpy."""
     acts = np.asarray(acts, np.float64)
     probs = np.asarray(probs, np.float64)
-    if np.nanmin(acts) < 0 or np.nanmax(acts) > 1 or np.any(acts != np.floor(acts)):
+    if np.any(np.isnan(acts)) or np.any(acts < 0) or np.any(acts > 1) \
+            or np.any(acts != np.floor(acts)):
         raise ValueError("Actuals are either 0 or 1")
-    if np.nanmin(probs) < 0 or np.nanmax(probs) > 1:
+    if np.any(np.isnan(probs)) or np.any(probs < 0) or np.any(probs > 1):
         raise ValueError("Probabilities are between 0 and 1")
     pos = acts == 1.0
     n_pos = int(pos.sum())
@@ -166,6 +167,7 @@ def permutation_var_imp(
         todo = (set(model.data_info.predictor_names) & set(names)) - non_pred
 
     runs: List[Dict[str, float]] = []
+    full_base: Optional[float] = None
     for rep in range(n_repeats):
         rep_seed = None if seed == -1 else seed + rep
         rng = np.random.default_rng(rep_seed)
@@ -174,9 +176,13 @@ def permutation_var_imp(
             # row would double-weight its metric contribution
             idx = rng.choice(fr.nrows, size=n_samples, replace=False)
             sub = fr.rows(idx)
+            base = _metric_of(model.model_performance(sub), metric)
         else:
             sub = fr
-        base = _metric_of(model.model_performance(sub), metric)
+            if full_base is None:  # same frame every repeat: score once
+                full_base = _metric_of(
+                    model.model_performance(sub), metric)
+            base = full_base
         result: Dict[str, float] = {}
         cols = list(sub.columns)
         for j, name in enumerate(sub.names):

@@ -45,22 +45,27 @@ h2o.importFile <- function(path, destination_frame = NULL) {
 }
 
 as.data.frame.H2OFrame <- function(x, ...) {
+  x <- .h2o.eval(x)  # lazy rapids frames materialize first
   csv <- .h2o.GETraw(paste0("/3/DownloadDataset?frame_id=",
                             utils::URLencode(x$key, reserved = TRUE)))
   utils::read.csv(text = csv, stringsAsFactors = FALSE)
 }
 
 print.H2OFrame <- function(x, ...) {
+  if (is.null(x$key)) {
+    cat("H2OFrame (lazy):", x$ast, "\n")
+    return(invisible(x))
+  }
   cat("H2OFrame", x$key, ":", x$nrows, "rows x", x$ncols, "cols\n")
   cat("columns:", paste(x$names, collapse = ", "), "\n")
   invisible(x)
 }
 
-dim.H2OFrame <- function(x) c(x$nrows, x$ncols)
+dim.H2OFrame <- function(x) { x <- .h2o.eval(x); c(x$nrows, x$ncols) }
 
-h2o.nrow <- function(fr) fr$nrows
-h2o.ncol <- function(fr) fr$ncols
-h2o.colnames <- function(fr) fr$names
+h2o.nrow <- function(fr) .h2o.eval(fr)$nrows
+h2o.ncol <- function(fr) .h2o.eval(fr)$ncols
+h2o.colnames <- function(fr) .h2o.names.of(fr)
 
 h2o.ls <- function() {
   frames <- .h2o.GET("/3/Frames")$frames
@@ -79,6 +84,7 @@ h2o.removeAll <- function() invisible(.h2o.DELETE("/3/DKV"))
 
 h2o.splitFrame <- function(fr, ratios = 0.75, destination_frames = NULL,
                            seed = -1) {
+  fr <- .h2o.eval(fr)
   params <- list(dataset = fr$key, ratios = as.list(ratios), seed = seed)
   if (!is.null(destination_frames))
     params$destination_frames <- as.list(destination_frames)
@@ -89,11 +95,9 @@ h2o.splitFrame <- function(fr, ratios = 0.75, destination_frames = NULL,
 h2o.rapids <- function(ast) .h2o.POST("/99/Rapids", list(ast = ast))
 
 h2o.describe <- function(fr) {
+  fr <- .h2o.eval(fr)
   .h2o.GET(paste0("/3/Frames/", utils::URLencode(fr$key, reserved = TRUE),
                   "/summary"))$frames[[1]]$columns
 }
 
-h2o.group_by <- function(fr, by, ...) {
-  # munging rides Rapids, exactly like the python client's lazy Expr
-  stop("compose a Rapids AST with h2o.rapids(); see /99/Rapids/help")
-}
+# h2o.group_by and the rest of the munging surface live in rapids.R

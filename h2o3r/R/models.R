@@ -10,7 +10,7 @@
   # frames travel as keys
   for (k in c("training_frame", "validation_frame")) {
     if (!is.null(params[[k]]) && inherits(params[[k]], "H2OFrame"))
-      params[[k]] <- params[[k]]$key
+      params[[k]] <- .h2o.eval(params[[k]])$key
   }
   out <- .h2o.POST(paste0("/3/ModelBuilders/", algo), params)
   key <- out$models[[1]]$model_id$name
@@ -40,7 +40,8 @@ h2o.predict <- function(object, newdata, predictions_frame = NULL) {
   if (!is.null(predictions_frame)) params$predictions_frame <- predictions_frame
   out <- .h2o.POST(paste0(
     "/3/Predictions/models/", utils::URLencode(object$key, reserved = TRUE),
-    "/frames/", utils::URLencode(newdata$key, reserved = TRUE)), params)
+    "/frames/", utils::URLencode(.h2o.eval(newdata)$key,
+                                 reserved = TRUE)), params)
   .h2o.frameHandle(out$model_metrics[[1]]$predictions_frame$name)
 }
 
@@ -120,7 +121,7 @@ h2o.grid <- function(algo, hyper_params, grid_id = NULL, ...) {
   params <- list(...)
   for (k in c("training_frame", "validation_frame")) {
     if (!is.null(params[[k]]) && inherits(params[[k]], "H2OFrame"))
-      params[[k]] <- params[[k]]$key
+      params[[k]] <- .h2o.eval(params[[k]])$key
   }
   params$hyper_parameters <- hyper_params
   if (!is.null(grid_id)) params$grid_id <- grid_id

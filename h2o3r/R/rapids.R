@@ -1,0 +1,302 @@
+# Lazy munging surface: R expressions compose Rapids ASTs.
+#
+# Reference: h2o-r/h2o-package/R/frame.R (.newExpr and the `[`/`$`/Ops
+# methods that build the lazy AST client-side). The emission here is
+# pinned to the PYTHON client's wire text: for every op below, the
+# rendered rapids string must equal what h2o3_tpu/client/expr.py emits
+# for the same operation. tests/golden/r_python_rapids_parity.json holds
+# the golden transcripts; tests/test_r_client.py checks the python side
+# against them (no Rscript needed) and h2o3r/tests/test_munging.R checks
+# this side when an R runtime exists.
+
+# -- value rendering (mirror of client/expr.py _to_ast) ----------------------
+
+.h2o.rapids.quote <- function(s) {
+  s <- gsub("\\\\", "\\\\\\\\", s)
+  s <- gsub("\"", "\\\\\"", s)
+  paste0("\"", s, "\"")
+}
+
+.h2o.rapids.num <- function(x) {
+  # integers render bare ("3"), fractions as decimals ("0.75") — the
+  # same strings python's repr() produces for int/float args
+  format(x, scientific = FALSE, trim = TRUE, digits = 15)
+}
+
+.h2o.rapids.val <- function(x) {
+  if (inherits(x, "H2OFrame")) return(.h2o.ast.of(x))
+  if (is.null(x)) return("\"\"")
+  if (is.logical(x) && length(x) == 1) return(if (x) "1" else "0")
+  if (is.numeric(x)) {
+    if (length(x) == 1) return(.h2o.rapids.num(x))
+    return(paste0("[", paste(vapply(x, .h2o.rapids.num, character(1)),
+                             collapse = " "), "]"))
+  }
+  if (is.character(x)) {
+    if (length(x) == 1) return(.h2o.rapids.quote(x))
+    return(paste0("[", paste(vapply(x, .h2o.rapids.quote, character(1)),
+                             collapse = " "), "]"))
+  }
+  stop("cannot render a ", class(x)[1], " into a rapids ast")
+}
+
+.h2o.rapids.strlist <- function(xs) {
+  # a character vector ALWAYS renders as a list (python list-of-str),
+  # even when length 1
+  paste0("[", paste(vapply(xs, .h2o.rapids.quote, character(1)),
+                    collapse = " "), "]")
+}
+
+.h2o.rapids.numlist <- function(xs) {
+  paste0("[", paste(vapply(xs, .h2o.rapids.num, character(1)),
+                    collapse = " "), "]")
+}
+
+.h2o.ast.of <- function(fr) {
+  if (!is.null(fr$ast)) fr$ast else fr$key
+}
+
+.h2o.op <- function(op, ...) {
+  args <- list(...)
+  rendered <- vapply(args, .h2o.rapids.val, character(1))
+  paste0("(", op, paste0(" ", rendered, collapse = ""), ")")
+}
+
+# a pre-rendered fragment that .h2o.rapids.val must splice verbatim
+.h2o.raw <- function(text) structure(list(ast = text), class = "H2OFrame")
+
+# -- lazy frames -------------------------------------------------------------
+
+.h2o.expr <- function(ast) {
+  structure(list(key = NULL, ast = ast, nrows = NA_integer_,
+                 ncols = NA_integer_, names = NULL),
+            class = "H2OFrame")
+}
+
+.h2o.session <- function() {
+  if (is.null(.h2o.env$session_id))
+    .h2o.env$session_id <- .h2o.POST("/4/sessions")$session_key
+  .h2o.env$session_id
+}
+
+.h2o.tmp.counter <- function() {
+  n <- if (is.null(.h2o.env$tmp_n)) 0L else .h2o.env$tmp_n
+  .h2o.env$tmp_n <- n + 1L
+  n
+}
+
+.h2o.eval <- function(fr) {
+  # materialize a lazy frame under a session temp key (the python
+  # client's refresh(): (tmp= {sid}_tmp_{n} <ast>)).  R lists copy by
+  # value, so the evaluated handle can't be cached on fr itself; a
+  # per-session cache keyed by the AST text keeps repeated metadata
+  # calls from re-executing the expression and leaking temp keys.
+  if (!is.null(fr$key)) return(fr)
+  if (is.null(.h2o.env$eval_cache))
+    .h2o.env$eval_cache <- new.env(parent = emptyenv())
+  hit <- .h2o.env$eval_cache[[fr$ast]]
+  if (!is.null(hit)) return(hit)
+  sid <- .h2o.session()
+  tmp <- paste0(sid, "_r_tmp_", .h2o.tmp.counter())
+  out <- .h2o.POST("/99/Rapids",
+                   list(ast = paste0("(tmp= ", tmp, " ", fr$ast, ")"),
+                        session_id = sid))
+  ev <- structure(list(key = out$key$name, ast = NULL, nrows = out$num_rows,
+                       ncols = out$num_cols,
+                       names = unlist(lapply(out$col_names, identity))),
+                  class = "H2OFrame")
+  .h2o.env$eval_cache[[fr$ast]] <- ev
+  ev
+}
+
+.h2o.scalar <- function(ast) {
+  sid <- .h2o.session()
+  out <- .h2o.POST("/99/Rapids", list(ast = ast, session_id = sid))
+  if (!is.null(out$scalar)) return(out$scalar)
+  if (!is.null(out$string)) return(out$string)
+  .h2o.frameHandle(out$key$name)
+}
+
+.h2o.names.of <- function(fr) {
+  if (!is.null(fr$names)) return(fr$names)
+  .h2o.eval(fr)$names
+}
+
+.h2o.colidx <- function(fr, cols) {
+  nm <- .h2o.names.of(fr)
+  idx <- match(cols, nm)
+  if (anyNA(idx)) stop("unknown column(s): ",
+                       paste(cols[is.na(idx)], collapse = ", "))
+  idx - 1L
+}
+
+# -- slicing / selection -----------------------------------------------------
+
+"$.H2OFrame" <- function(x, name) {
+  # handle fields win over columns; warn if that shadows a real column
+  if (name %in% c("key", "ast", "nrows", "ncols", "names")) {
+    nm <- .subset2(x, "names")
+    if (!is.null(nm) && name %in% nm)
+      warning("frame has a column named '", name, "' shadowed by the ",
+              "handle field; use fr[, \"", name, "\"] to select it")
+    return(.subset2(x, name))
+  }
+  .h2o.expr(.h2o.op("cols_py", x, name))
+}
+
+"[.H2OFrame" <- function(x, i, j, ...) {
+  base <- x
+  if (!missing(j)) {
+    if (is.logical(j)) j <- which(j)
+    if (is.numeric(j) && any(j < 0))
+      stop("negative (exclusion) column indices are not supported; ",
+           "select the columns to keep")
+    if (is.character(j)) {
+      sel <- if (length(j) == 1) .h2o.rapids.quote(j) else
+        .h2o.rapids.strlist(j)
+    } else {
+      sel <- if (length(j) == 1) .h2o.rapids.num(j - 1) else
+        .h2o.rapids.numlist(j - 1)
+    }
+    base <- .h2o.expr(paste0("(cols_py ", .h2o.ast.of(x), " ", sel, ")"))
+  }
+  if (missing(i)) return(base)
+  if (inherits(i, "H2OFrame"))  # boolean mask frame
+    return(.h2o.expr(.h2o.op("rows", base, i)))
+  if (is.logical(i)) i <- which(i)
+  if (any(i < 0))
+    stop("negative (exclusion) row indices are not supported; ",
+         "select the rows to keep")
+  i <- as.integer(i)
+  lo <- min(i) - 1L
+  n <- length(i)
+  if (identical(as.integer(i), seq.int(min(i), max(i))))  # contiguous 1-based
+    return(.h2o.expr(paste0("(rows ", .h2o.ast.of(base),
+                            " [", lo, ":", n, "])")))
+  .h2o.expr(.h2o.op("rows", base, i - 1))
+}
+
+# -- operators (Ops group generic: + - * / ^ %% == != < <= > >= & |) ---------
+
+Ops.H2OFrame <- function(e1, e2) {
+  op <- switch(.Generic, "%%" = "%", .Generic)
+  if (missing(e2)) {  # unary ! / -
+    if (.Generic == "!") return(.h2o.expr(.h2o.op("not", e1)))
+    if (.Generic == "-") return(.h2o.expr(.h2o.op("-", 0, e1)))
+    stop("unsupported unary op ", .Generic)
+  }
+  .h2o.expr(.h2o.op(op, e1, e2))
+}
+
+"!.H2OFrame" <- function(x) .h2o.expr(.h2o.op("not", x))
+
+Math.H2OFrame <- function(x, ...) {
+  # log/exp/sqrt/abs/floor/ceiling/trunc/cos/sin/tan/...: rapids uses the
+  # same names (prims/mathops.py)
+  .h2o.expr(.h2o.op(.Generic, x))
+}
+
+# -- reducers (eager scalars, python's H2OFrame.mean/... emission) -----------
+
+mean.H2OFrame <- function(x, na.rm = TRUE, ...)
+  .h2o.scalar(.h2o.op("mean", x, na.rm, 0))
+
+sum.H2OFrame <- function(..., na.rm = TRUE) {
+  if (length(list(...)) != 1) stop("sum over one H2OFrame at a time")
+  .h2o.scalar(.h2o.op("sum", ..1, na.rm))
+}
+
+min.H2OFrame <- function(..., na.rm = TRUE) {
+  if (length(list(...)) != 1) stop("min over one H2OFrame at a time")
+  .h2o.scalar(.h2o.op("min", ..1, na.rm))
+}
+
+max.H2OFrame <- function(..., na.rm = TRUE) {
+  if (length(list(...)) != 1) stop("max over one H2OFrame at a time")
+  .h2o.scalar(.h2o.op("max", ..1, na.rm))
+}
+
+h2o.sd <- function(fr) .h2o.scalar(.h2o.op("sd", fr, TRUE))
+h2o.var <- function(fr) .h2o.scalar(.h2o.op("var", fr, TRUE, "everything"))
+h2o.median <- function(fr, na.rm = TRUE)
+  .h2o.scalar(.h2o.op("median", fr, na.rm))
+h2o.nacnt <- function(fr) .h2o.scalar(.h2o.op("naCnt", fr))
+
+# -- munging -----------------------------------------------------------------
+
+h2o.unique <- function(fr) .h2o.expr(.h2o.op("unique", fr))
+h2o.table <- function(fr) .h2o.expr(.h2o.op("table", fr, FALSE))
+h2o.asfactor <- function(fr) .h2o.expr(.h2o.op("as.factor", fr))
+h2o.asnumeric <- function(fr) .h2o.expr(.h2o.op("as.numeric", fr))
+h2o.ascharacter <- function(fr) .h2o.expr(.h2o.op("as.character", fr))
+h2o.cbind <- function(a, b) .h2o.expr(.h2o.op("cbind", a, b))
+h2o.rbind <- function(a, b) .h2o.expr(.h2o.op("rbind", a, b))
+h2o.ifelse <- function(test, yes, no)
+  .h2o.expr(.h2o.op("ifelse", test, yes, no))
+
+h2o.setNames <- function(fr, names) {
+  .h2o.expr(paste0("(colnames= ", .h2o.ast.of(fr), " ",
+                   .h2o.rapids.numlist(seq_along(names) - 1), " ",
+                   .h2o.rapids.strlist(names), ")"))
+}
+
+h2o.arrange <- function(fr, ..., ascending = TRUE) {
+  cols <- c(...)
+  idxs <- .h2o.colidx(fr, cols)
+  flags <- rep(if (ascending) 1 else 0, length(idxs))
+  .h2o.expr(paste0("(sort ", .h2o.ast.of(fr), " ",
+                   .h2o.rapids.numlist(idxs), " ",
+                   .h2o.rapids.numlist(flags), ")"))
+}
+
+h2o.merge <- function(x, y, all.x = FALSE, all.y = FALSE) {
+  .h2o.expr(paste0("(merge ", .h2o.ast.of(x), " ", .h2o.ast.of(y), " ",
+                   if (all.x) "1" else "0", " ", if (all.y) "1" else "0",
+                   " [] [] \"auto\")"))
+}
+
+h2o.group_by <- function(fr, by, nrow = NULL, sum = NULL, mean = NULL,
+                         min = NULL, max = NULL, sd = NULL, var = NULL,
+                         median = NULL, mode = NULL, na = "all") {
+  # (GB fr [by-idxs] agg colidx na ...) — AstGroup's multi-agg form,
+  # the exact emission of the python client's fluent H2OGroupBy
+  aggs <- character(0)
+  if (!is.null(nrow))
+    aggs <- c(aggs, paste0("\"nrow\" ", .h2o.colidx(fr, by[1]),
+                           " ", .h2o.rapids.quote(na)))
+  for (agg in c("sum", "mean", "min", "max", "sd", "var", "median",
+                "mode")) {
+    cols <- get(agg)
+    if (is.null(cols)) next
+    for (ci in .h2o.colidx(fr, cols))
+      aggs <- c(aggs, paste0(.h2o.rapids.quote(agg), " ", ci, " ",
+                             .h2o.rapids.quote(na)))
+  }
+  if (length(aggs) == 0) stop("add at least one aggregation")
+  .h2o.expr(paste0("(GB ", .h2o.ast.of(fr), " ",
+                   .h2o.rapids.numlist(.h2o.colidx(fr, by)), " ",
+                   paste(aggs, collapse = " "), ")"))
+}
+
+h2o.perfAUC <- function(probs, acts)
+  .h2o.scalar(.h2o.op("flatten", .h2o.raw(.h2o.op("perfectAUC", probs,
+                                                  acts))))
+
+h2o.reset_threshold <- function(model, threshold) {
+  key <- if (inherits(model, "H2OModel")) model$key else model
+  .h2o.scalar(.h2o.op("flatten",
+                      .h2o.raw(paste0("(model.reset.threshold ", key, " ",
+                                      .h2o.rapids.num(threshold), ")"))))
+}
+
+h2o.permutation_importance <- function(model, fr, metric = "AUTO",
+                                       n_samples = 10000, n_repeats = 1,
+                                       features = NULL, seed = -1) {
+  key <- if (inherits(model, "H2OModel")) model$key else model
+  feats <- if (is.null(features)) "\"\"" else .h2o.rapids.strlist(features)
+  .h2o.eval(.h2o.expr(paste0(
+    "(PermutationVarImp ", key, " ", .h2o.ast.of(fr), " ",
+    .h2o.rapids.quote(metric), " ", .h2o.rapids.num(n_samples), " ",
+    .h2o.rapids.num(n_repeats), " ", feats, " ",
+    .h2o.rapids.num(seed), ")")))
+}

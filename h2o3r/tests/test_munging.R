@@ -1,0 +1,79 @@
+# Golden-transcript parity: the R munging surface must emit EXACTLY the
+# rapids text the python client emits for the same operations
+# (tests/golden/r_python_rapids_parity.json, authored from the python
+# client's ExprNode emission and pinned on the python side by
+# tests/test_r_client.py::TestRapidsParity).
+#
+# Emission is pure string composition — no server, no connection needed.
+# Run: Rscript h2o3r/tests/test_munging.R   (exit 0 = all parity holds)
+
+args <- commandArgs(trailingOnly = FALSE)
+this <- sub("--file=", "", args[grepl("^--file=", args)])
+root <- normalizePath(file.path(dirname(this), "..", ".."))
+
+for (f in c("json.R", "connection.R", "rapids.R", "frame.R", "models.R"))
+  source(file.path(root, "h2o3r", "R", f))
+
+golden <- .h2o.fromJSON(paste(readLines(
+  file.path(root, "tests", "golden", "r_python_rapids_parity.json"),
+  warn = FALSE), collapse = "\n"))
+
+mk <- function(key, names) {
+  structure(list(key = key, ast = NULL, nrows = 100L,
+                 ncols = length(names), names = names),
+            class = "H2OFrame")
+}
+frA <- mk("frA", c("a", "b", "g"))
+frB <- mk("frB", c("a", "c"))
+
+ast <- function(x) if (inherits(x, "H2OFrame")) .h2o.ast.of(x) else x
+
+got <- list(
+  col_by_name = ast(frA$a),
+  cols_by_list = ast(frA[, c("a", "b")]),
+  row_slice = ast(frA[1:5, ]),
+  mask_rows = ast(frA[frA$a > 6L, ]),
+  arith = ast(frA$a * 2 + 1),
+  rmul = ast(2 * frA$a),
+  compare_and = ast((frA$a > 1) & (frA$b < 2)),
+  "not" = ast(!frA$a),
+  mean = .h2o.op("mean", frA$a, TRUE, 0),
+  sum = .h2o.op("sum", frA$a, TRUE),
+  unique = ast(h2o.unique(frA$g)),
+  table = ast(h2o.table(frA$g)),
+  asfactor = ast(h2o.asfactor(frA$g)),
+  cbind = ast(h2o.cbind(frA, frB)),
+  rbind = ast(h2o.rbind(frA, frA)),
+  colnames_assign = ast(h2o.setNames(frA, c("x", "y", "z"))),
+  sort = ast(h2o.arrange(frA, "a")),
+  sort_desc_multi = ast(h2o.arrange(frA, "a", "b", ascending = FALSE)),
+  merge = ast(h2o.merge(frA, frB)),
+  merge_all_x = ast(h2o.merge(frA, frB, all.x = TRUE)),
+  groupby = ast(h2o.group_by(frA, "g", sum = "a", mean = "b")),
+  groupby_count = ast(h2o.group_by(frA, "g", nrow = TRUE)),
+  ifelse = ast(h2o.ifelse(frA$a > 0L, 1, 0)),
+  log = ast(log(frA$a)),
+  perfect_auc = .h2o.op("perfectAUC", frA$a, frA$b)
+)
+
+fails <- 0L
+for (name in names(golden)) {
+  want <- golden[[name]]
+  have <- got[[name]]
+  if (is.null(have)) {
+    cat("MISSING scenario:", name, "\n")
+    fails <- fails + 1L
+  } else if (!identical(have, want)) {
+    cat("MISMATCH", name, "\n  R:      ", have, "\n  python: ", want, "\n")
+    fails <- fails + 1L
+  }
+}
+# every R scenario must also exist in the golden file (no dead entries)
+extra <- setdiff(names(got), names(golden))
+if (length(extra) > 0) {
+  cat("scenarios absent from golden file:", paste(extra, collapse = ", "),
+      "\n")
+  fails <- fails + 1L
+}
+cat(length(golden) - fails, "of", length(golden), "parity scenarios OK\n")
+quit(status = if (fails > 0) 1L else 0L)
