@@ -40,8 +40,24 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ssl-cert", default=None, help="TLS certificate (PEM)")
     p.add_argument("--ssl-key", default=None, help="TLS private key (PEM)")
     p.add_argument("--hash-login-file", default=None,
-                   help="user:sha256(password) lines enabling Basic auth "
-                        "(-hash_login)")
+                   help="hash-file Basic auth (-hash_login): lines of "
+                        "user:sha256hex or the salted "
+                        "user:pbkdf2:iters:salt:hash form emitted by "
+                        "--hash-password")
+    p.add_argument("--login-type", default=None,
+                   choices=["hash", "ldap"],
+                   help="auth SPI backend (LoginType); hash is implied "
+                        "by --hash-login-file")
+    p.add_argument("--ldap-url", default=None,
+                   help="LDAP server URL for --login-type ldap "
+                        "(-ldap_login)")
+    p.add_argument("--ldap-bind-template", default=None,
+                   help="bind-DN template with {} for the username, e.g. "
+                        "'uid={},ou=people,dc=example,dc=org'")
+    p.add_argument("--hash-password", nargs=2, metavar=("USER", "PASS"),
+                   default=None,
+                   help="print a salted PBKDF2 hash-file line for "
+                        "USER/PASS and exit")
     p.add_argument("--log-dir", default=None,
                    help="write logs here in addition to the in-memory ring")
     # multi-host pod launch (the h2odriver / h2o-k8s analogue: instead of
@@ -73,6 +89,12 @@ def _parse_mem(s: str) -> int:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+
+    if args.hash_password:
+        from h2o3_tpu.api.auth import hash_entry
+
+        print(hash_entry(*args.hash_password))
+        return 0
 
     from h2o3_tpu.util import log as L
 
@@ -106,12 +128,21 @@ def main(argv=None) -> int:
 
     from h2o3_tpu.api import start_server
 
+    auth_backend = None
+    if args.login_type == "ldap":
+        from h2o3_tpu.api.auth import make_backend
+
+        auth_backend = make_backend(
+            "ldap", ldap_url=args.ldap_url,
+            ldap_bind_template=args.ldap_bind_template)
+
     server = start_server(
         port=args.port,
         name=args.name,
         ssl_cert=args.ssl_cert,
         ssl_key=args.ssl_key,
         auth_file=args.hash_login_file,
+        auth_backend=auth_backend,
         ip=args.ip,
     )
     logger.info("%s listening on %s", args.name, server.url)

@@ -1,0 +1,144 @@
+"""Pluggable login backends (the auth SPI).
+
+Reference: ``h2o-webserver-iface/.../LoginType.java`` — NONE / HASH /
+LDAP / KERBEROS / SPNEGO / PAM, each a JAAS realm behind jetty's Basic
+auth. Here the SPI is a ``LoginBackend`` with ``authenticate(user,
+password)``; the server's Basic-auth gate delegates to whichever backend
+is configured:
+
+* ``HashFileBackend`` — LoginType.HASH's realm.properties analogue.
+  Accepts BOTH entry formats:
+    - legacy: ``user:<sha256-hex>``  (single-round, kept for existing
+      files)
+    - salted: ``user:pbkdf2:<iterations>:<salt-hex>:<hash-hex>``
+      (PBKDF2-HMAC-SHA256; generate with ``hash_entry()``)
+  All comparisons are constant-time (``hmac.compare_digest``).
+* ``LdapBackend`` — LoginType.LDAP, via ``ldap3`` when importable: a
+  simple-bind against the configured server with a DN template. The
+  image has no ldap3 (and no LDAP server), so construction raises a
+  clear error unless the module is present; the SPI seam is what tests
+  pin (a stub ldap3 exercises the flow).
+
+KERBEROS / SPNEGO / PAM remain honest refusals (``make_backend`` says
+so) — they need system daemons this runtime does not ship.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+from typing import Dict, Optional
+
+
+class LoginBackend:
+    """SPI: one method, constant-time semantics required of impls."""
+
+    name = "none"
+
+    def authenticate(self, user: str, password: str) -> bool:
+        raise NotImplementedError
+
+
+def hash_entry(user: str, password: str, iterations: int = 120_000,
+               salt: Optional[bytes] = None) -> str:
+    """One salted hash-file line: ``user:pbkdf2:<iters>:<salt>:<hash>``."""
+    salt = salt if salt is not None else os.urandom(16)
+    dk = hashlib.pbkdf2_hmac("sha256", password.encode(), salt, iterations)
+    return f"{user}:pbkdf2:{iterations}:{salt.hex()}:{dk.hex()}"
+
+
+class HashFileBackend(LoginBackend):
+    name = "hash_file"
+
+    def __init__(self, path: str) -> None:
+        self._entries: Dict[str, str] = {}
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line and ":" in line and not line.startswith("#"):
+                    user, spec = line.split(":", 1)
+                    self._entries[user] = spec
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def authenticate(self, user: str, password: str) -> bool:
+        spec = self._entries.get(user)
+        if spec is None:
+            return False
+        if spec.startswith("pbkdf2:"):
+            try:
+                _tag, iters_s, salt_hex, want_hex = spec.split(":", 3)
+                dk = hashlib.pbkdf2_hmac(
+                    "sha256", password.encode(), bytes.fromhex(salt_hex),
+                    int(iters_s))
+            except (ValueError, TypeError):
+                return False
+            return hmac.compare_digest(dk.hex(), want_hex.lower())
+        # legacy single-round sha256 hex
+        return hmac.compare_digest(
+            hashlib.sha256(password.encode()).hexdigest(), spec.lower())
+
+
+class LdapBackend(LoginBackend):
+    """Simple-bind LDAP auth (LoginType.LDAP / ldaploginmodule).
+
+    ``bind_template`` receives the username, e.g.
+    ``uid={},ou=people,dc=example,dc=org``. A successful bind IS the
+    authentication, exactly like the JAAS ldaploginmodule's
+    authIdentity."""
+
+    name = "ldap"
+
+    def __init__(self, url: str, bind_template: str,
+                 _ldap3_module=None) -> None:
+        if _ldap3_module is None:
+            try:
+                import ldap3 as _ldap3_module  # noqa: F811
+            except ImportError as e:
+                raise RuntimeError(
+                    "LDAP login needs the 'ldap3' package, which this "
+                    "image does not ship; install it or use "
+                    "--hash-login-file") from e
+        self._ldap3 = _ldap3_module
+        self._url = url
+        self._template = bind_template
+
+    def authenticate(self, user: str, password: str) -> bool:
+        if not password or any(c in user for c in ",=\0"):
+            return False  # no anonymous binds, no DN injection
+        dn = self._template.format(user)
+        try:
+            server = self._ldap3.Server(self._url)
+            conn = self._ldap3.Connection(server, user=dn,
+                                          password=password)
+            ok = bool(conn.bind())
+            conn.unbind()
+            return ok
+        except Exception:
+            return False
+
+
+def make_backend(login_type: str, *, auth_file: Optional[str] = None,
+                 ldap_url: Optional[str] = None,
+                 ldap_bind_template: Optional[str] = None) -> LoginBackend:
+    """Factory keyed on LoginType names (lowercased)."""
+    lt = (login_type or "none").lower()
+    if lt in ("none", ""):
+        raise ValueError("no backend for login_type=none")
+    if lt in ("hash", "hash_file"):
+        if not auth_file:
+            raise ValueError("hash login needs an auth file")
+        return HashFileBackend(auth_file)
+    if lt == "ldap":
+        if not (ldap_url and ldap_bind_template):
+            raise ValueError("ldap login needs --ldap-url and "
+                             "--ldap-bind-template")
+        return LdapBackend(ldap_url, ldap_bind_template)
+    if lt in ("kerberos", "spnego", "pam"):
+        raise ValueError(
+            f"login_type={lt} needs system daemons (JAAS "
+            f"{lt}loginmodule) this runtime does not ship; supported: "
+            "hash_file, ldap")
+    raise ValueError(f"unknown login_type {login_type!r}")

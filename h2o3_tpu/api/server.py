@@ -110,6 +110,7 @@ class H2OServer:
         ssl_cert: Optional[str] = None,
         ssl_key: Optional[str] = None,
         auth_file: Optional[str] = None,
+        auth_backend=None,
         ip: str = "127.0.0.1",
     ) -> None:
         self.name = name
@@ -126,15 +127,13 @@ class H2OServer:
         self.port = port
         self.ssl_cert = ssl_cert
         self.ssl_key = ssl_key
-        self._auth: Optional[Dict[str, str]] = None
-        if auth_file:
-            self._auth = {}
-            with open(auth_file) as f:
-                for line in f:
-                    line = line.strip()
-                    if line and ":" in line:
-                        user, hashed = line.split(":", 1)
-                        self._auth[user] = hashed.lower()
+        #: the auth SPI (api/auth.py LoginBackend); auth_file builds the
+        #: hash-file backend for back-compat, auth_backend wins when given
+        self._auth = auth_backend
+        if self._auth is None and auth_file:
+            from h2o3_tpu.api.auth import HashFileBackend
+
+            self._auth = HashFileBackend(auth_file)
 
     def _check_auth(self, header: Optional[str]) -> bool:
         if self._auth is None:
@@ -142,8 +141,6 @@ class H2OServer:
         if not header or not header.startswith("Basic "):
             return False
         import base64
-        import hashlib
-        import hmac
 
         try:
             user, _, password = (
@@ -151,12 +148,7 @@ class H2OServer:
             )
         except Exception:
             return False
-        want = self._auth.get(user)
-        # constant-time digest compare: the hash-file scheme mirrors the
-        # reference's, but == on hex digests leaks timing for free
-        return want is not None and hmac.compare_digest(
-            hashlib.sha256(password.encode()).hexdigest(), want
-        )
+        return self._auth.authenticate(user, password)
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "H2OServer":

@@ -537,3 +537,122 @@ class TestRecoveryWalkerAccounting:
                 search_criteria=SearchCriteria(strategy="RandomDiscrete"),
                 recovery_dir="/tmp/nope",
             )
+
+
+class TestAuthSPI:
+    """Pluggable login backends (LoginType.java; api/auth.py)."""
+
+    def test_salted_pbkdf2_entries_over_http(self, tmp_path):
+        import base64
+        import urllib.request
+
+        from h2o3_tpu.api import start_server
+        from h2o3_tpu.api.auth import hash_entry
+
+        auth = tmp_path / "realm.properties"
+        auth.write_text(hash_entry("bob", "hunter2", iterations=2_000) + "\n")
+        s = start_server(port=0, auth_file=str(auth))
+        try:
+            req = urllib.request.Request(s.url + "/3/Ping")
+            req.add_header(
+                "Authorization",
+                "Basic " + base64.b64encode(b"bob:hunter2").decode())
+            with urllib.request.urlopen(req) as resp:
+                assert resp.status == 200
+            bad = urllib.request.Request(s.url + "/3/Ping")
+            bad.add_header(
+                "Authorization",
+                "Basic " + base64.b64encode(b"bob:wrong").decode())
+            try:
+                urllib.request.urlopen(bad)
+                assert False, "expected 401"
+            except urllib.error.HTTPError as e:
+                assert e.code == 401
+        finally:
+            s.stop()
+
+    def test_mixed_legacy_and_salted_file(self, tmp_path):
+        import hashlib
+
+        from h2o3_tpu.api.auth import HashFileBackend, hash_entry
+
+        auth = tmp_path / "realm.properties"
+        auth.write_text(
+            "# comment line\n"
+            "alice:" + hashlib.sha256(b"secret").hexdigest() + "\n"
+            + hash_entry("bob", "hunter2", iterations=1_000) + "\n")
+        be = HashFileBackend(str(auth))
+        assert len(be) == 2
+        assert be.authenticate("alice", "secret")
+        assert be.authenticate("bob", "hunter2")
+        assert not be.authenticate("alice", "hunter2")
+        assert not be.authenticate("bob", "secret")
+        assert not be.authenticate("carol", "anything")
+
+    def test_hash_entry_deterministic_with_salt(self):
+        from h2o3_tpu.api.auth import hash_entry
+
+        a = hash_entry("u", "p", iterations=1_000, salt=b"\x01" * 16)
+        b = hash_entry("u", "p", iterations=1_000, salt=b"\x01" * 16)
+        assert a == b
+        assert hash_entry("u", "p", iterations=1_000) != a  # random salt
+
+    def test_ldap_backend_via_stub(self):
+        from h2o3_tpu.api.auth import LdapBackend
+
+        binds = []
+
+        class _Conn:
+            def __init__(self, server, user=None, password=None):
+                self.user, self.password = user, password
+
+            def bind(self):
+                binds.append((self.user, self.password))
+                return self.password == "right"
+
+            def unbind(self):
+                pass
+
+        class _Stub:
+            Server = staticmethod(lambda url: url)
+            Connection = _Conn
+
+        be = LdapBackend("ldap://ldap.example:389",
+                         "uid={},ou=people,dc=example,dc=org",
+                         _ldap3_module=_Stub)
+        assert be.authenticate("alice", "right")
+        assert not be.authenticate("alice", "wrong")
+        assert binds[0][0] == "uid=alice,ou=people,dc=example,dc=org"
+        # hardening: empty password (anonymous bind) and DN injection
+        assert not be.authenticate("alice", "")
+        assert not be.authenticate("evil,dc=x", "right")
+
+    def test_make_backend_refusals(self, tmp_path):
+        import pytest
+
+        from h2o3_tpu.api.auth import make_backend
+
+        with pytest.raises(ValueError, match="kerberos"):
+            make_backend("kerberos")
+        with pytest.raises(ValueError, match="auth file"):
+            make_backend("hash_file")
+        with pytest.raises(ValueError, match="ldap-url"):
+            make_backend("ldap")
+
+    def test_launcher_hash_password_flag(self, capsys):
+        from h2o3_tpu.__main__ import main
+
+        assert main(["--hash-password", "dave", "pw"]) == 0
+        line = capsys.readouterr().out.strip()
+        assert line.startswith("dave:pbkdf2:120000:")
+        from h2o3_tpu.api.auth import HashFileBackend
+        import tempfile, os
+        with tempfile.NamedTemporaryFile("w", suffix=".properties",
+                                         delete=False) as f:
+            f.write(line + "\n")
+        try:
+            be = HashFileBackend(f.name)
+            assert be.authenticate("dave", "pw")
+            assert not be.authenticate("dave", "pW")
+        finally:
+            os.unlink(f.name)
