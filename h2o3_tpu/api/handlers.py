@@ -596,6 +596,39 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
     r.register("DELETE", "/4/sessions/{session_id}", end_session, "end session")
     r.register("POST", "/99/Rapids", rapids_exec_ep, "execute a rapids ast")
 
+    def flow_replay(params, name):
+        """Load a notebook document saved under NPS category "notebook"
+        (the reference Flow's own save location, NodePersistentStorage)
+        and execute its cells in order server-side — the h2o-web flow
+        replay, minus the browser."""
+        import json as _json
+
+        from h2o3_tpu.util import nps
+
+        try:
+            raw = nps.get("notebook", name)
+        except FileNotFoundError:
+            raise RestError(404, f"no saved flow {name!r}")
+        try:
+            doc = _json.loads(raw.decode())
+        except Exception:
+            raise RestError(400, f"flow {name!r} is not a JSON document")
+        out = []
+        for cell in doc.get("cells", []):
+            ast = cell.get("input") if isinstance(cell, dict) else None
+            if not ast:
+                continue
+            try:
+                res = rapids_exec_ep(
+                    {"ast": ast, "session_id": params.get("session_id")})
+                out.append({"input": ast, "ok": True, "result": res})
+            except RestError as e:
+                out.append({"input": ast, "ok": False, "error": str(e)})
+        return {"name": name, "cells": out}
+
+    r.register("POST", "/99/Flow/{name}/run", flow_replay,
+               "replay a saved flow document")
+
     # ---- model builders ---------------------------------------------------
     def builders_list(params):
         return {
@@ -1453,12 +1486,18 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
 <body>
 <h1>h2o3-tpu <span class=muted>Flow-lite</span></h1>
 <div id=cloud class=muted>loading&hellip;</div>
-<h2>Cell <span class=muted>(Rapids — see /99/Rapids/help)</span></h2>
+<h2>Notebook <span class=muted>(Rapids cells — see /99/Rapids/help)</span></h2>
+<div id=history></div>
 <div><textarea id=cell rows=3 cols=80
  placeholder="(sort frame_id [0] [1])"></textarea><br>
 <button id=run>Run</button>
-<span class=muted>runs the expression server-side; assignments
- ((= name expr)) appear under Frames</span></div>
+<input id=fname size=18 placeholder="flow name">
+<button id=fsave>Save flow</button>
+<select id=flist></select>
+<button id=fload>Load</button>
+<button id=freplay>Load + replay</button>
+<span class=muted>flows persist server-side under
+ /3/NodePersistentStorage/notebook</span></div>
 <pre id=cellout class=muted></pre>
 <h2>Import <span class=muted>(path/glob/URI on the server)</span></h2>
 <div><input id=ipath size=60 placeholder="/data/train.csv">
@@ -1482,11 +1521,51 @@ async function post(p,body){const r=await fetch(p,{method:'POST',
  return r.json()}
 function show(id,v){document.getElementById(id).textContent=
  typeof v==='string'?v:JSON.stringify(v,null,1)}
+let cells=[];
+function renderHistory(){
+ const h=document.getElementById('history');h.innerHTML='';
+ cells.forEach((c,i)=>{
+  const d=document.createElement('div');
+  const inp=document.createElement('pre');
+  inp.textContent='['+(i+1)+'] '+c.input;d.appendChild(inp);
+  const out=document.createElement('pre');out.className='muted';
+  out.textContent=typeof c.output==='string'?c.output:
+   JSON.stringify(c.output,null,1);d.appendChild(out);
+  h.appendChild(d)})}
+async function runCell(ast){
+ const out=await post('/99/Rapids',{ast});
+ cells.push({input:ast,output:out});renderHistory();return out}
+async function refreshFlows(){
+ const sel=document.getElementById('flist');sel.innerHTML='';
+ const ls=await j('/3/NodePersistentStorage/notebook');
+ for(const e of (ls.entries||[])){
+  const o=document.createElement('option');o.value=e.name;
+  o.textContent=e.name;sel.appendChild(o)}}
+async function loadFlow(replay){
+ const name=document.getElementById('flist').value;if(!name)return;
+ const r=await fetch('/3/NodePersistentStorage/notebook/'+
+  encodeURIComponent(name));
+ const doc=JSON.parse(await r.text());
+ if(replay){cells=[];renderHistory();
+  for(const c of (doc.cells||[]))await runCell(c.input)}
+ else{cells=doc.cells||[];renderHistory()}
+ document.getElementById('fname').value=name;refresh()}
 document.addEventListener('DOMContentLoaded',()=>{
  document.getElementById('run').onclick=async()=>{
   const ast=document.getElementById('cell').value.trim();
   if(!ast)return;
-  show('cellout',await post('/99/Rapids',{ast}));refresh()};
+  show('cellout',await runCell(ast));
+  document.getElementById('cell').value='';refresh()};
+ document.getElementById('fsave').onclick=async()=>{
+  const name=document.getElementById('fname').value.trim();
+  if(!name){show('cellout','name the flow first');return}
+  await post('/3/NodePersistentStorage/notebook/'+
+   encodeURIComponent(name),
+   {value:JSON.stringify({version:1,cells})});
+  show('cellout','saved flow '+name);refreshFlows()};
+ document.getElementById('fload').onclick=()=>loadFlow(false);
+ document.getElementById('freplay').onclick=()=>loadFlow(true);
+ refreshFlows();
  document.getElementById('imp').onclick=async()=>{
   const path=document.getElementById('ipath').value.trim();
   if(!path)return;
