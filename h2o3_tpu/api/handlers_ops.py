@@ -763,7 +763,8 @@ def register(r: RequestServer, server: H2OServer) -> None:  # noqa: C901
         return {"capabilities": [
             {"name": n} for n in
             ("frames", "rapids", "models", "grid", "automl", "persist",
-             "recovery", "timeline", "mesh-sharding", "pallas-kernels")
+             "recovery", "timeline", "mesh-sharding", "pallas-kernels",
+             "parse-xls-biff")
         ]}
 
     def capabilities_api(params):

@@ -608,10 +608,11 @@ def parse_xlsx(data: bytes) -> Frame:
 
 
 def parse_xls_legacy(data: bytes) -> Frame:
-    raise ValueError(
-        "legacy .xls (BIFF) ingest is not supported in this build; save "
-        "as .xlsx or csv (reference: water/parser/XlsParser.java)"
-    )
+    """Legacy BIFF .xls via the OLE2+BIFF walker (frame/xls.py;
+    water/parser/XlsParser.java)."""
+    from h2o3_tpu.frame.xls import parse_xls
+
+    return parse_xls(data)
 
 
 # ---------------------------------------------------------------------------

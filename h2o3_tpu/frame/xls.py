@@ -1,0 +1,337 @@
+"""Legacy ``.xls`` (BIFF) reader: OLE2 compound document + BIFF2-8
+records, numeric and string cells.
+
+Reference: ``water/parser/XlsParser.java`` (a from-scratch BIFF record
+walker over the compound-document "Workbook" stream, same scope: cell
+values only — no formulas being evaluated, no formatting). The layout
+facts come from the public MS-CFB / MS-XLS specifications.
+
+Structure handled here:
+
+* **OLE2/CFB container**: 512-byte header, FAT sector chains, directory
+  entries, and the root's mini-stream with its own miniFAT for streams
+  under the 4096-byte cutoff (small workbooks written by some tools).
+* **BIFF stream** (directory entry ``Workbook`` or ``Book``): a linear
+  record walk collecting the BIFF8 shared-string table (including
+  CONTINUE splits, where a string resumes with a fresh flags byte) and
+  the cell records of the FIRST worksheet substream: NUMBER, RK, MULRK,
+  LABELSST, LABEL (BIFF2-5 inline), INTEGER/old NUMBER/old LABEL
+  (BIFF2), and cached numeric FORMULA results.
+
+Row 1 is the header when every populated cell in it is a string
+(matching the CSV sniffing convention); otherwise columns are named
+C1..Cn.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Optional, Tuple
+
+_ENDOFCHAIN = 0xFFFFFFFE
+_FREESECT = 0xFFFFFFFF
+_FATSECT = 0xFFFFFFFD
+_DIFSECT = 0xFFFFFFFC
+
+
+def _u16(b: bytes, o: int) -> int:
+    return struct.unpack_from("<H", b, o)[0]
+
+
+def _u32(b: bytes, o: int) -> int:
+    return struct.unpack_from("<I", b, o)[0]
+
+
+# ---------------------------------------------------------------------------
+# OLE2 / CFB container
+
+
+def _cfb_stream(data: bytes, want_names: Tuple[str, ...]) -> bytes:
+    """Extract the named stream from an OLE2 compound file."""
+    if data[:8] != b"\xd0\xcf\x11\xe0\xa1\xb1\x1a\xe1":
+        raise ValueError("not an OLE2 compound document")
+    sec_shift = _u16(data, 30)
+    sec_size = 1 << sec_shift
+    mini_shift = _u16(data, 32)
+    mini_size = 1 << mini_shift
+    n_fat = _u32(data, 44)
+    dir_start = _u32(data, 48)
+    mini_cutoff = _u32(data, 56)
+    minifat_start = _u32(data, 60)
+    difat_start = _u32(data, 68)
+    n_difat = _u32(data, 72)
+
+    def sector(n: int) -> bytes:
+        off = 512 + n * sec_size
+        return data[off:off + sec_size]
+
+    # FAT sector list: 109 header DIFAT entries + DIFAT sector chain
+    fat_sectors: List[int] = []
+    for i in range(109):
+        s = _u32(data, 76 + 4 * i)
+        if s not in (_FREESECT, _ENDOFCHAIN):
+            fat_sectors.append(s)
+    ds = difat_start
+    for _ in range(n_difat):
+        if ds in (_ENDOFCHAIN, _FREESECT):
+            break
+        blk = sector(ds)
+        per = sec_size // 4 - 1
+        for i in range(per):
+            s = _u32(blk, 4 * i)
+            if s not in (_FREESECT, _ENDOFCHAIN):
+                fat_sectors.append(s)
+        ds = _u32(blk, sec_size - 4)
+    fat_sectors = fat_sectors[:max(n_fat, len(fat_sectors))]
+
+    fat: List[int] = []
+    for s in fat_sectors:
+        blk = sector(s)
+        fat.extend(struct.unpack(f"<{sec_size // 4}I", blk))
+
+    def chain(start: int, cap: int = 1 << 22) -> bytes:
+        out, s, seen = [], start, 0
+        while s not in (_ENDOFCHAIN, _FREESECT) and seen < cap:
+            out.append(sector(s))
+            if s >= len(fat):
+                break
+            s = fat[s]
+            seen += 1
+        return b"".join(out)
+
+    # directory entries (128 bytes each)
+    dirdata = chain(dir_start)
+    root_start = root_size = None
+    target: Optional[Tuple[int, int]] = None
+    for off in range(0, len(dirdata) - 127, 128):
+        name_len = _u16(dirdata, off + 64)
+        if name_len < 2:
+            continue
+        name = dirdata[off:off + name_len - 2].decode("utf-16-le",
+                                                      "replace")
+        etype = dirdata[off + 66]
+        start = _u32(dirdata, off + 116)
+        size = _u32(dirdata, off + 120)
+        if etype == 5:  # root: its stream is the mini stream
+            root_start, root_size = start, size
+        elif etype == 2 and name in want_names and target is None:
+            target = (start, size)
+    if target is None:
+        raise ValueError(f"no {'/'.join(want_names)} stream in workbook")
+    start, size = target
+
+    if size >= mini_cutoff:
+        return chain(start)[:size]
+
+    # small stream: bytes live in the root's mini stream, chained by the
+    # miniFAT in mini-sector units
+    if root_start is None:
+        raise ValueError("xls: mini stream without a root entry")
+    mini_stream = chain(root_start)[:root_size]
+    minifat_data = chain(minifat_start) if minifat_start not in (
+        _ENDOFCHAIN, _FREESECT) else b""
+    minifat = list(struct.unpack(f"<{len(minifat_data) // 4}I",
+                                 minifat_data[:len(minifat_data) & ~3]))
+    out, s, seen = [], start, 0
+    while s not in (_ENDOFCHAIN, _FREESECT) and seen < (1 << 20):
+        out.append(mini_stream[s * mini_size:(s + 1) * mini_size])
+        if s >= len(minifat):
+            break
+        s = minifat[s]
+        seen += 1
+    return b"".join(out)[:size]
+
+
+# ---------------------------------------------------------------------------
+# BIFF records
+
+
+def _rk_value(rk: int) -> float:
+    """RK-encoded number: bit0 = /100, bit1 = int30 vs high-30-of-double."""
+    cents = rk & 1
+    if rk & 2:
+        v = float(rk >> 2 if rk >> 2 < (1 << 29) else (rk >> 2) - (1 << 30))
+    else:
+        v = struct.unpack("<d", struct.pack("<Q",
+                                            (rk & 0xFFFFFFFC) << 32))[0]
+    return v / 100.0 if cents else v
+
+
+class _SSTReader:
+    """BIFF8 shared strings across SST + CONTINUE records: a string that
+    spans a record boundary resumes with a fresh option-flags byte."""
+
+    def __init__(self, parts: List[bytes]) -> None:
+        self.parts = parts
+        self.pi = 0
+        self.off = 0
+
+    def _remaining(self) -> int:
+        return len(self.parts[self.pi]) - self.off
+
+    def _advance(self) -> None:
+        while self.pi < len(self.parts) and self._remaining() == 0:
+            self.pi += 1
+            self.off = 0
+
+    def take(self, n: int) -> bytes:
+        out = b""
+        while n > 0:
+            self._advance()
+            if self.pi >= len(self.parts):
+                raise ValueError("xls: truncated SST")
+            chunk = self.parts[self.pi][self.off:self.off + n]
+            self.off += len(chunk)
+            n -= len(chunk)
+            out += chunk
+        return out
+
+    def read_string(self) -> str:
+        cch = _u16(self.take(2), 0)
+        flags = self.take(1)[0]
+        wide = flags & 0x01
+        ext = flags & 0x04
+        rich = flags & 0x08
+        c_run = _u16(self.take(2), 0) if rich else 0
+        cb_ext = _u32(self.take(4), 0) if ext else 0
+        chars: List[str] = []
+        left = cch
+        while left > 0:
+            self._advance()
+            avail = self._remaining()
+            if avail == 0:
+                raise ValueError("xls: truncated SST string")
+            n = min(left, avail // 2 if wide else avail)
+            if wide:
+                if n == 0:  # a lone byte at a boundary cannot happen mid-
+                    raise ValueError("xls: split utf-16 unit in SST")
+                chars.append(self.take(2 * n).decode("utf-16-le",
+                                                     "replace"))
+            else:
+                chars.append(self.take(n).decode("latin-1"))
+            left -= n
+            if left > 0:  # string continues in the next CONTINUE record
+                flags = self.take(1)[0]
+                wide = flags & 0x01
+        self.take(4 * c_run)
+        self.take(cb_ext)
+        return "".join(chars)
+
+
+def _short_string(payload: bytes, off: int, biff8: bool) -> str:
+    """Inline LABEL string: BIFF8 unicode (cch16+flags) or BIFF2-5 bytes."""
+    if biff8:
+        cch = _u16(payload, off)
+        flags = payload[off + 2]
+        if flags & 0x01:
+            return payload[off + 3:off + 3 + 2 * cch].decode(
+                "utf-16-le", "replace")
+        return payload[off + 3:off + 3 + cch].decode("latin-1")
+    cch = _u16(payload, off)
+    return payload[off + 2:off + 2 + cch].decode("latin-1")
+
+
+def parse_xls(data: bytes):
+    """.xls bytes -> Frame (numeric + string cells of the first sheet)."""
+    from h2o3_tpu.frame.frame import Frame
+    from h2o3_tpu.frame.parse import column_from_strings
+
+    stream = _cfb_stream(data, ("Workbook", "Book"))
+
+    cells: Dict[Tuple[int, int], object] = {}
+    sst: List[str] = []
+    sst_parts: List[bytes] = []
+    sst_total = 0
+    in_sst = False
+    biff8 = True
+    sheets_seen = 0
+    pos = 0
+    n = len(stream)
+    while pos + 4 <= n:
+        rid = _u16(stream, pos)
+        rlen = _u16(stream, pos + 2)
+        payload = stream[pos + 4:pos + 4 + rlen]
+        pos += 4 + rlen
+        if rid == 0x0809 or rid in (0x0009, 0x0209, 0x0409):  # BOF
+            if rid == 0x0809:
+                vers = _u16(payload, 0)
+                biff8 = vers >= 0x0600
+            else:
+                biff8 = False
+            stype = _u16(payload, 2) if len(payload) >= 4 else 0x0010
+            if stype == 0x0010:  # worksheet substream
+                sheets_seen += 1
+                if sheets_seen > 1:
+                    break  # first sheet only, like the xlsx parser
+            in_sst = False
+            continue
+        if rid == 0x00FC:  # SST (BIFF8)
+            sst_total = _u32(payload, 4)
+            sst_parts = [payload[8:]]
+            in_sst = True
+            continue
+        if rid == 0x003C and in_sst:  # CONTINUE of the SST
+            sst_parts.append(payload)
+            continue
+        in_sst = False
+        if sheets_seen == 0 and rid not in (0x00FC, 0x003C):
+            continue  # globals substream: only the SST matters
+        if rid == 0x0203 and len(payload) >= 14:  # NUMBER (BIFF5/8)
+            r, c = _u16(payload, 0), _u16(payload, 2)
+            cells[(r, c)] = struct.unpack_from("<d", payload, 6)[0]
+        elif rid == 0x0003 and len(payload) >= 15:  # NUMBER (BIFF2)
+            r, c = _u16(payload, 0), _u16(payload, 2)
+            cells[(r, c)] = struct.unpack_from("<d", payload, 7)[0]
+        elif rid == 0x0002 and len(payload) >= 9:  # INTEGER (BIFF2)
+            r, c = _u16(payload, 0), _u16(payload, 2)
+            cells[(r, c)] = float(_u16(payload, 7))
+        elif rid == 0x027E and len(payload) >= 10:  # RK
+            r, c = _u16(payload, 0), _u16(payload, 2)
+            cells[(r, c)] = _rk_value(_u32(payload, 6))
+        elif rid == 0x00BD and len(payload) >= 12:  # MULRK
+            r, c0 = _u16(payload, 0), _u16(payload, 2)
+            n_rk = (len(payload) - 6) // 6
+            for i in range(n_rk):
+                cells[(r, c0 + i)] = _rk_value(_u32(payload, 6 + 6 * i + 2))
+        elif rid == 0x00FD and len(payload) >= 10:  # LABELSST
+            r, c = _u16(payload, 0), _u16(payload, 2)
+            if not sst and sst_parts:
+                reader = _SSTReader(sst_parts)
+                for _ in range(sst_total):
+                    sst.append(reader.read_string())
+            idx = _u32(payload, 6)
+            cells[(r, c)] = sst[idx] if idx < len(sst) else ""
+        elif rid == 0x0204 and len(payload) >= 8:  # LABEL (BIFF5/8 inline)
+            r, c = _u16(payload, 0), _u16(payload, 2)
+            cells[(r, c)] = _short_string(payload, 6, biff8)
+        elif rid == 0x0004 and len(payload) >= 8:  # LABEL (BIFF2)
+            r, c = _u16(payload, 0), _u16(payload, 2)
+            cch = payload[7]
+            cells[(r, c)] = payload[8:8 + cch].decode("latin-1")
+        elif rid == 0x0006 and len(payload) >= 14:  # FORMULA: cached num
+            r, c = _u16(payload, 0), _u16(payload, 2)
+            res = payload[6:14]
+            if res[6:8] != b"\xff\xff":  # else string/bool/err result
+                cells[(r, c)] = struct.unpack("<d", res)[0]
+        elif rid == 0x000A:  # EOF
+            if sheets_seen >= 1:
+                break
+
+    if not cells:
+        raise ValueError("xls: no numeric or string cells found")
+
+    n_rows = max(r for r, _ in cells) + 1
+    n_cols = max(c for _, c in cells) + 1
+    first = [cells.get((0, j)) for j in range(n_cols)]
+    has_header = all(isinstance(v, str) for v in first if v is not None) \
+        and any(v is not None for v in first)
+    header = ([str(v) if v is not None else f"C{j + 1}"
+               for j, v in enumerate(first)] if has_header
+              else [f"C{j + 1}" for j in range(n_cols)])
+    body_rows = range(1, n_rows) if has_header else range(n_rows)
+    cols = []
+    for j in range(n_cols):
+        vals = [None if (v := cells.get((i, j))) is None else str(v)
+                for i in body_rows]
+        cols.append(column_from_strings(header[j], vals))
+    return Frame(cols)
