@@ -30,7 +30,7 @@ import io
 import struct
 import uuid as _uuid
 import zipfile
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -894,6 +894,119 @@ def _write_ensemble_mojo(model, path: str) -> str:
     return _zip_write(path, lines, dom_texts, sub_entries)
 
 
+def _model_feature_schema(model) -> List[Tuple[str, Optional[List[str]]]]:
+    """(name, domain) of a model's feature columns in MOJO order
+    (cats first, then nums — the DataInfo permutation every writer
+    here uses)."""
+    info = model.data_info
+    cats = [n for n in info.predictor_names if n in info.cat_domains]
+    nums = [n for n in info.predictor_names if n not in info.cat_domains]
+    return ([(c, list(info.cat_domains[c])) for c in cats]
+            + [(n, None) for n in nums])
+
+
+def write_pipeline_mojo(models: Dict[str, Any],
+                        input_mapping: Dict[str, str],
+                        main_alias: str, path: str) -> str:
+    """Compose reference-exportable models into ONE pipeline MOJO in the
+    reference layout (``hex/genmodel/MojoPipelineWriter.java``): every
+    model embeds as a full MOJO under ``models/<alias>/`` with
+    ``submodel_key_i``/``submodel_dir_i`` kvs; ``input_mapping`` maps a
+    generated column name consumed by the main model to
+    ``"<alias>:<prediction index>"`` of the sub-model producing it; the
+    pipeline's input schema is derived exactly like
+    ``deriveInputSchema`` (union of sub-model features + the main
+    model's non-generated columns, response included)."""
+    import tempfile
+
+    if main_alias not in models:
+        raise ValueError(f"Main model is missing. There is no model with "
+                         f"alias '{main_alias}'.")
+    main = models[main_alias]
+
+    sub_entries: Dict[str, bytes] = {}
+    for alias, m in models.items():
+        with tempfile.NamedTemporaryFile(suffix=".zip") as tf:
+            write_mojo(m, tf.name)
+            with zipfile.ZipFile(tf.name) as sz:
+                for nm in sz.namelist():
+                    sub_entries[f"models/{alias}/{nm}"] = sz.read(nm)
+
+    # deriveInputSchema: sub-model features first (domain conflicts are
+    # an error), then the main model's columns not generated by a sub
+    schema: List[Tuple[str, Optional[List[str]]]] = []
+    seen: Dict[str, Optional[List[str]]] = {}
+    for alias, m in models.items():
+        if alias == main_alias:
+            continue
+        for name, dom in _model_feature_schema(m):
+            if name in seen:
+                if seen[name] != dom:
+                    raise ValueError(
+                        f"Domains of column '{name}' differ.")
+                continue
+            seen[name] = dom
+            schema.append((name, dom))
+    minfo = main.data_info
+    main_cols = (_model_feature_schema(main)
+                 + [(minfo.response_name,
+                     list(minfo.response_domain)
+                     if minfo.response_domain else None)])
+    for name, dom in main_cols:
+        if name in input_mapping or name in seen:
+            continue
+        seen[name] = dom
+        schema.append((name, dom))
+
+    columns = [n for n, _ in schema]
+    dom_texts: Dict[str, str] = {}
+    dom_lines = []
+    di = 0
+    for ci, (_n, dom) in enumerate(schema):
+        if dom is None:
+            continue
+        dom_lines.append(f"{ci}: {len(dom)} d{di:03d}.txt")
+        dom_texts[f"domains/d{di:03d}.txt"] = "\n".join(dom) + "\n"
+        di += 1
+
+    nclasses = main.nclasses
+    category = ("Binomial" if nclasses == 2
+                else "Multinomial" if nclasses > 2 else "Regression")
+    kv: List[Tuple[str, Any]] = [
+        ("algorithm", "MOJO Pipeline"),
+        ("algo", "pipeline"),
+        ("category", category),
+        ("uuid", str(_uuid.uuid4())),
+        ("supervised", "true"),
+        ("n_features", len(columns) - 1),
+        ("n_classes", nclasses if nclasses > 1 else 1),
+        ("n_columns", len(columns)),
+        ("n_domains", len(dom_lines)),
+        ("balance_classes", "false"),
+        ("default_threshold", 0.5),
+        ("prior_class_distrib", "null"),
+        ("model_class_distrib", "null"),
+        ("mojo_version", "1.00"),
+        ("h2o_version", "h2o3-tpu"),
+        ("submodel_count", len(models)),
+    ]
+    for i, alias in enumerate(models):
+        kv.append((f"submodel_key_{i}", alias))
+        kv.append((f"submodel_dir_{i}", f"models/{alias}/"))
+    kv.append(("generated_column_count", len(input_mapping)))
+    for i, (gname, spec) in enumerate(input_mapping.items()):
+        alias, _, idx = spec.partition(":")
+        kv.append((f"generated_column_name_{i}", gname))
+        kv.append((f"generated_column_model_{i}", alias))
+        kv.append((f"generated_column_index_{i}", int(idx)))
+    kv.append(("main_model", main_alias))
+
+    lines = ["[info]"]
+    lines += [f"{k} = {v}" for k, v in kv]
+    lines += ["", "[columns]"] + columns + ["", "[domains]"] + dom_lines
+    return _zip_write(path, lines, dom_texts, sub_entries)
+
+
 def write_mojo(model, path: str) -> str:
     """Serialize a GBM, DRF, GLM, KMeans, IsolationForest, Word2Vec,
     DeepLearning, TargetEncoder, PCA or StackedEnsemble model into the
@@ -1418,6 +1531,34 @@ class RefMojo:
             out[f"{col}_te"] = post
         return out
 
+    @property
+    def nfeatures(self) -> int:
+        return int(self.info.get("n_features", len(self.columns)))
+
+    def _pipeline_score0(self, row: np.ndarray) -> np.ndarray:
+        """MojoPipeline.score0: copy passthrough inputs into the main
+        model's row layout, score every sub-model to fill the generated
+        columns, then score the main model."""
+        main = self.pipeline_models[self.pipeline_main]
+        gen_names = {g[0] for g in self.pipeline_gen}
+        main_feats = main.columns[:main.nfeatures]
+        main_row = np.full(main.nfeatures, np.nan)
+        for ti, name in enumerate(main_feats):
+            if name not in gen_names:
+                main_row[ti] = row[self.columns.index(name)]
+        for alias, sub in self.pipeline_models.items():
+            if alias == self.pipeline_main:
+                continue
+            sub_row = np.array([
+                row[self.columns.index(nm)]
+                for nm in sub.columns[:sub.nfeatures]
+            ])
+            preds = sub.score0(sub_row)
+            for gname, galias, gidx in self.pipeline_gen:
+                if galias == alias:
+                    main_row[main_feats.index(gname)] = preds[gidx]
+        return main.score0(main_row)
+
     def score0(self, row: np.ndarray) -> np.ndarray:
         """Gbm/Drf/Glm/KMeansMojoModel semantics over the decoded payload."""
         algo = self.info.get("algo", "gbm")
@@ -1435,6 +1576,8 @@ class RefMojo:
             return self._coxph_score0(row)
         if algo == "stackedensemble":
             return self._ensemble_score0(row)
+        if algo == "pipeline":
+            return self._pipeline_score0(row)
         if algo == "kmeans":
             return self._kmeans_score0(row)
         if algo == "isolation_forest":
@@ -1572,6 +1715,22 @@ def _read_entry(z: "zipfile.ZipFile", prefix: str) -> RefMojo:
             vocab_size, int(m.info["vec_size"])
         )
         m.word_vectors = dict(zip(words, np.asarray(vecs, np.float32)))
+    if m.info.get("algo") == "pipeline":
+        # MojoPipelineReader: sub-models by submodel_dir_i, generated
+        # columns bound to (model alias, prediction index)
+        m.pipeline_models = {}
+        for i in range(int(m.info["submodel_count"])):
+            key = m.info[f"submodel_key_{i}"]
+            subdir = m.info[f"submodel_dir_{i}"]
+            m.pipeline_models[key] = _read_entry(z, prefix + subdir)
+        m.pipeline_gen = []
+        for i in range(int(m.info.get("generated_column_count", 0))):
+            m.pipeline_gen.append((
+                m.info[f"generated_column_name_{i}"],
+                m.info[f"generated_column_model_{i}"],
+                int(m.info[f"generated_column_index_{i}"]),
+            ))
+        m.pipeline_main = m.info["main_model"]
     if m.info.get("algo") == "stackedensemble":
         # sub-models live under models/<algo>/<key>/ (MultiModelMojoWriter)
         def find_prefix(key: str) -> str:

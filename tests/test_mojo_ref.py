@@ -654,3 +654,71 @@ class TestJavaDoubleSpelling:
         back = _parse_jarr("[inf, -inf, nan, 2.0]")
         assert back[0] == math.inf and back[1] == -math.inf
         assert math.isnan(back[2]) and back[3] == 2.0
+
+
+class TestPipelineReferenceMojo:
+    """Reference-format pipeline MOJO (hex/genmodel/MojoPipelineWriter +
+    algos/pipeline/MojoPipeline): sub-model predictions feed generated
+    columns of the main model inside ONE interoperable zip."""
+
+    def _parts(self, rng, tmp_path):
+        from h2o3_tpu.models.glm import GLM, GLMParameters
+        from h2o3_tpu.models.tree.gbm import GBM
+
+        n = 400
+        X = rng.normal(size=(n, 3))
+        y_lin = 2.0 * X[:, 0] - X[:, 1] + rng.normal(size=n) * 0.1
+        glm_fr = Frame([
+            Column("a", X[:, 0]), Column("b", X[:, 1]),
+            Column("ylin", y_lin),
+        ])
+        glm = GLM(GLMParameters(response_column="ylin", family="gaussian",
+                                lambda_=0.0)).train(glm_fr)
+        glm_pred = glm.predict(glm_fr).col(0).numeric_view()
+        yb = (y_lin + 0.5 * X[:, 2] > 0).astype(np.int32)
+        main_fr = Frame([
+            Column("c", X[:, 2]), Column("glm_pred", glm_pred),
+            Column("y", yb, ColType.CAT, ["n", "p"]),
+        ])
+        gbm = GBM(ntrees=5, max_depth=3, response_column="y", seed=3,
+                  min_rows=2).train(main_fr)
+        return glm, gbm, X, glm_pred, main_fr
+
+    def test_write_decode_score_parity(self, rng, tmp_path):
+        from h2o3_tpu.models.mojo_ref import write_pipeline_mojo
+
+        glm, gbm, X, glm_pred, main_fr = self._parts(rng, tmp_path)
+        path = str(tmp_path / "pipe.zip")
+        write_pipeline_mojo({"glm_stage": glm, "main": gbm},
+                            {"glm_pred": "glm_stage:0"}, "main", path)
+
+        # reference layout facts an external MultiModelMojoReader needs
+        with zipfile.ZipFile(path) as z:
+            names = z.namelist()
+            assert "models/glm_stage/model.ini" in names
+            assert "models/main/model.ini" in names
+            ini = z.read("model.ini").decode()
+            assert "algorithm = MOJO Pipeline" in ini
+            assert "main_model = main" in ini
+            assert "generated_column_name_0 = glm_pred" in ini
+
+        mojo = read_mojo(path)
+        assert mojo.info["algo"] == "pipeline"
+        # pipeline schema: glm features first, then main's non-generated
+        assert mojo.columns[:2] == ["a", "b"]
+        assert "glm_pred" not in mojo.columns
+        ia, ib, ic = (mojo.columns.index(k) for k in ("a", "b", "c"))
+        want = gbm._predict_raw(main_fr)
+        for i in range(0, 400, 23):
+            row = np.full(len(mojo.columns), np.nan)
+            row[ia], row[ib], row[ic] = X[i, 0], X[i, 1], X[i, 2]
+            got = mojo.score0(row)
+            np.testing.assert_allclose(got, want[i], rtol=1e-4, atol=1e-5)
+
+    def test_missing_main_alias_refused(self, rng, tmp_path):
+        from h2o3_tpu.models.mojo_ref import write_pipeline_mojo
+
+        glm, gbm, *_ = self._parts(rng, tmp_path)
+        with pytest.raises(ValueError, match="alias"):
+            write_pipeline_mojo({"glm_stage": glm}, {}, "nope",
+                                str(tmp_path / "x.zip"))
