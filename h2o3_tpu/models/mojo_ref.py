@@ -290,6 +290,177 @@ def _write_glm_mojo(model, path: str) -> str:
     return _zip_write(path, lines, dom_texts, {})
 
 
+def _write_gam_mojo(model, path: str) -> str:
+    """GAM (cubic-regression smoothers) in the reference layout
+    (``hex/gam/GAMMojoWriter.java`` / ``GamMojoReader.java``): the
+    artifact carries knots, ``_binvD`` (= B⁻¹D) and ``zTranspose`` per
+    smoother as big-endian double blobs, the gam column-name text files,
+    and both the centered and de-centered GLM betas; the scorer
+    re-gamifies each row with ``GamUtilsCubicRegression`` and evaluates
+    ``beta_center``. The training-side basis construction
+    (``models/gam.py cr_basis``) is the same a/c-function algebra, so
+    in-range rows score identically; outside the boundary knots the
+    reference extrapolates the boundary-bin cubic while training used
+    linear extrapolation — only such rows can differ.
+
+    Covered: every-smoother-CR (bs=0), non-multinomial families,
+    standardize=False. Thin-plate needs the polynomial-basis machinery
+    (``GamUtilsThinPlateRegression``) and I-/M-splines have no genmodel
+    scorer at all — all three refuse rather than export an artifact
+    that scores differently."""
+    p = model.params
+    if any(s.kind != 0 for s in model.specs):
+        raise ValueError(
+            "reference-format GAM MOJO covers cubic-regression smoothers "
+            "(bs=0) only; thin-plate needs GamUtilsThinPlateRegression's "
+            "polynomial machinery and I-/M-splines have no genmodel "
+            "scorer")
+    if p.family in ("multinomial", "ordinal"):
+        raise ValueError("reference-format GAM MOJO covers non-"
+                         "multinomial families only")
+    if p.standardize:
+        raise ValueError("reference-format GAM MOJO export requires "
+                         "standardize=False (the reference stores raw-"
+                         "scale betas)")
+    info_d = model.data_info
+    cats = [n for n in info_d.predictor_names if n in info_d.cat_domains]
+    nums = [n for n in info_d.predictor_names
+            if n not in info_d.cat_domains]
+    # linear betas permuted cats-first (same layout as the GLM writer)
+    lin_beta, cat_offsets = _glm_class_beta(
+        info_d, cats, nums, model.coefficients)
+    lin_beta = lin_beta[:-1]  # intercept re-appended after the gam block
+    intercept = float(model.coefficients["Intercept"])
+
+    specs = model.specs
+    n_gam = len(specs)
+    n_lin = info_d.n_coefs
+    # centered gam coefficients straight from the solved beta blocks
+    gam_center: List[np.ndarray] = []
+    off = n_lin
+    for s in specs:
+        kz = len(s.knots) - 1
+        gam_center.append(np.asarray(model.beta[off:off + kz], np.float64))
+        off += kz
+    gam_no_center = [s.Z @ g for s, g in zip(specs, gam_center)]
+
+    beta_center = lin_beta + [float(v) for g in gam_center for v in g] \
+        + [intercept]
+    beta_no_center = lin_beta + [float(v) for g in gam_no_center
+                                 for v in g] + [intercept]
+
+    gam_col_names = [[f"{s.column}_cr_{i}" for i in range(len(s.knots))]
+                     for s in specs]
+    gam_col_names_center = [
+        [f"{s.column}_cr_{i}" for i in range(len(s.knots) - 1)]
+        for s in specs]
+    names_no_centering = (cats + nums
+                          + [n for blk in gam_col_names for n in blk])
+    columns = (cats + nums
+               + [n for blk in gam_col_names_center for n in blk]
+               + [p.response_column])
+
+    dom_texts: Dict[str, str] = {}
+    dom_lines = []
+    di = 0
+    for ci, c in enumerate(cats):
+        dom = info_d.cat_domains[c]
+        dom_lines.append(f"{ci}: {len(dom)} d{di:03d}.txt")
+        dom_texts[f"domains/d{di:03d}.txt"] = "\n".join(dom) + "\n"
+        di += 1
+    rdom = info_d.response_domain
+    if rdom:
+        dom_lines.append(f"{len(columns) - 1}: {len(rdom)} d{di:03d}.txt")
+        dom_texts[f"domains/d{di:03d}.txt"] = "\n".join(rdom) + "\n"
+
+    # blobs: knots / zTranspose / _binvD, big-endian f64 (ByteBuffer)
+    from h2o3_tpu.models.gam import cr_matrices
+
+    knots_blob = b"".join(
+        np.asarray(s.knots, ">f8").tobytes() for s in specs)
+    zt_blob = b"".join(
+        np.ascontiguousarray(s.Z.T, ">f8").tobytes() for s in specs)
+    binvd_blob = b""
+    for s in specs:
+        D, B = cr_matrices(np.asarray(s.knots))
+        binvd_blob += np.ascontiguousarray(
+            np.linalg.solve(B, D), ">f8").tobytes()
+
+    n_expanded = sum(len(s.knots) for s in specs)
+    n_expanded_center = sum(len(s.knots) - 1 for s in specs)
+    nclasses = model.nclasses
+    category = "Binomial" if nclasses == 2 else "Regression"
+    kv: List[Tuple[str, Any]] = [
+        ("algorithm", "Generalized Additive Model"),
+        ("algo", "gam"),
+        ("category", category),
+        ("uuid", str(_uuid.uuid4())),
+        ("supervised", "true"),
+        ("n_features", len(columns) - 1),
+        ("n_classes", nclasses if nclasses > 1 else 1),
+        ("n_columns", len(columns)),
+        ("n_domains", len(dom_lines)),
+        ("balance_classes", "false"),
+        ("default_threshold",
+         _jdouble(model.default_threshold()) if nclasses == 2 else 0.5),
+        ("prior_class_distrib", "null"),
+        ("model_class_distrib", "null"),
+        ("mojo_version", "1.00"),
+        ("h2o_version", "h2o3-tpu"),
+        ("use_all_factor_levels",
+         "true" if info_d.use_all_factor_levels else "false"),
+        ("family", p.family),
+        ("link", p.actual_link()),
+        ("tweedie_link_power", p.tweedie_link_power),
+        ("cats", len(cats)),
+        ("cat_offsets", "[" + ", ".join(map(str, cat_offsets)) + "]"),
+        ("catNAFills", "[" + ", ".join(
+            str(info_d.cat_mode[c]) for c in cats) + "]"),
+        ("num", len(nums) + n_expanded),
+        ("numsCenter", len(nums) + n_expanded_center),
+        ("numNAFillsCenter", _jarr(
+            [info_d.num_means[n] for n in nums]
+            + [0.0] * n_expanded_center)),
+        ("mean_imputation",
+         "true" if info_d.missing_values_handling == "mean_imputation"
+         else "false"),
+        ("beta length per class", len(beta_no_center)),
+        ("beta center length per class", len(beta_center)),
+        ("beta", _jarr(beta_no_center)),
+        ("beta_center", _jarr(beta_center)),
+        ("num_expanded_gam_columns", n_expanded),
+        ("num_expanded_gam_columns_center", n_expanded_center),
+        ("num_knots", "[" + ", ".join(
+            str(len(s.knots)) for s in specs) + "]"),
+        ("num_knots_sorted", "[" + ", ".join(
+            str(len(s.knots)) for s in specs) + "]"),
+        ("gam_column_dim", "[" + ", ".join(["1"] * n_gam) + "]"),
+        ("gam_column_dim_sorted", "[" + ", ".join(["1"] * n_gam) + "]"),
+        ("num_TP_col", 0),
+        ("total feature size", len(names_no_centering)),
+        ("bs", "[" + ", ".join(["0"] * n_gam) + "]"),
+        ("bs_sorted", "[" + ", ".join(["0"] * n_gam) + "]"),
+        ("gamColName_dim", "[" + ", ".join(
+            str(len(s.knots)) for s in specs) + "]"),
+        ("_d", "[" + ", ".join(["1"] * n_gam) + "]"),
+    ]
+    dom_texts["gam_columns"] = "\n".join(s.column for s in specs) + "\n"
+    dom_texts["gam_columns_sorted"] = dom_texts["gam_columns"]
+    dom_texts["_names_no_centering"] = "\n".join(names_no_centering) + "\n"
+    dom_texts["gamColNames"] = "\n".join(
+        n for blk in gam_col_names for n in blk) + "\n"
+    dom_texts["gamColNamesCenter"] = "\n".join(
+        n for blk in gam_col_names_center for n in blk) + "\n"
+    lines = ["[info]"]
+    lines += [f"{k} = {v}" for k, v in kv]
+    lines += ["", "[columns]"] + columns + ["", "[domains]"] + dom_lines
+    return _zip_write(path, lines, dom_texts, {
+        "knots": knots_blob,
+        "zTranspose": zt_blob,
+        "_binvD": binvd_blob,
+    })
+
+
 def _write_kmeans_mojo(model, path: str) -> str:
     """KMeans in the reference layout (KMeansMojoWriter.writeModelData /
     KMeansMojoModel.score0): standardize means/mults/modes kv arrays plus
@@ -1008,9 +1179,9 @@ def write_pipeline_mojo(models: Dict[str, Any],
 
 
 def write_mojo(model, path: str) -> str:
-    """Serialize a GBM, DRF, GLM, KMeans, IsolationForest, Word2Vec,
-    DeepLearning, TargetEncoder, PCA or StackedEnsemble model into the
-    reference MOJO layout."""
+    """Serialize a GBM, DRF, GLM, GAM (CR smoothers), KMeans,
+    IsolationForest, Word2Vec, DeepLearning, TargetEncoder, PCA, CoxPH,
+    StackedEnsemble or pipeline model into the reference MOJO layout."""
     from h2o3_tpu.models.tree.common import tree_feature_names
 
     algo = model.algo_name
@@ -1020,6 +1191,7 @@ def write_mojo(model, path: str) -> str:
                          "offset_column models")
     writers = {
         "glm": _write_glm_mojo,
+        "gam": _write_gam_mojo,
         "kmeans": _write_kmeans_mojo,
         "isolationforest": _write_isofor_mojo,
         "word2vec": _write_word2vec_mojo,
@@ -1535,6 +1707,83 @@ class RefMojo:
     def nfeatures(self) -> int:
         return int(self.info.get("n_features", len(self.columns)))
 
+    # -- GAM (GamMojoModel + GamUtilsCubicRegression, ported) --------------
+    @staticmethod
+    def _gam_locate_bin(x: float, knots: np.ndarray) -> int:
+        """GamUtilsCubicRegression.locateBin — boundary values clamp to
+        the first/last bin (the cubic then EXTRAPOLATES with raw x)."""
+        if x <= knots[0]:
+            return 0
+        if x >= knots[-1]:
+            return len(knots) - 2
+        return int(np.searchsorted(knots, x, side="right") - 1)
+
+    def _gam_expand_one(self, x: float, ci: int) -> np.ndarray:
+        """expandOneGamCol: the K basis values of smoother ci at x."""
+        knots = self.gam_knots[ci]
+        binvd = self.gam_binvd[ci]
+        K = len(knots)
+        vals = np.zeros(K)
+        if np.isnan(x):
+            return np.full(K, np.nan)
+        j = self._gam_locate_bin(x, knots)
+        hj = knots[j + 1] - knots[j]
+        tm, tp = knots[j + 1] - x, x - knots[j]
+        cmj = (tm ** 3 / hj - tm * hj) / 6.0
+        cpj = (tp ** 3 / hj - tp * hj) / 6.0
+        if j == 0:
+            vals[:] = binvd[0] * cpj
+        elif j >= binvd.shape[0]:
+            vals[:] = binvd[j - 1] * cmj
+        else:
+            vals[:] = binvd[j - 1] * cmj + binvd[j] * cpj
+        vals[j] += tm / hj
+        vals[j + 1] += tp / hj
+        return vals
+
+    def gam_score0(self, row: Dict[str, float]) -> np.ndarray:
+        """GamMojoModel.gamScore0 over a {column: value} row (cats as
+        level codes, gam predictors as raw values): gamify each smoother
+        column, center through zTranspose, evaluate beta_center."""
+        cats = int(self.info.get("cats", 0))
+        cat_offsets = _parse_jarr(self.info.get("cat_offsets", "[0]"), int)
+        use_all = self.info.get("use_all_factor_levels") == "true"
+        beta = np.asarray(_parse_jarr(self.info["beta_center"]))
+        feats = self.columns[:-1]
+        eta = 0.0
+        for i in range(cats):
+            ival = int(row[feats[i]])
+            if not use_all:
+                ival -= 1
+            if ival >= 0:
+                ival += cat_offsets[i]
+                if ival < cat_offsets[i + 1]:
+                    eta += beta[ival]
+        noff = cat_offsets[cats] - cats
+        # plain numeric features come before the gamified block
+        n_center = sum(len(k) - 1 for k in self.gam_knots)
+        for i in range(cats, len(feats) - n_center):
+            eta += beta[noff + i] * row[feats[i]]
+        pos = noff + len(feats) - n_center
+        for ci, col in enumerate(self.gam_columns):
+            basis = self._gam_expand_one(float(row[col]), ci)
+            centered = self.gam_zt[ci] @ basis
+            for v in centered:
+                eta += beta[pos] * v
+                pos += 1
+        eta += beta[-1]
+        fam = self.info.get("family", "gaussian")
+        link = self.info.get("link", "identity")
+        if link == "logit":
+            mu = 1.0 / (1.0 + np.exp(-eta))
+        elif link == "log":
+            mu = np.exp(eta)
+        else:
+            mu = eta
+        if fam in ("binomial", "quasibinomial", "fractionalbinomial"):
+            return np.array([1.0 - mu, mu])
+        return np.array([mu])
+
     def _pipeline_score0(self, row: np.ndarray) -> np.ndarray:
         """MojoPipeline.score0: copy passthrough inputs into the main
         model's row layout, score every sub-model to fill the generated
@@ -1715,6 +1964,29 @@ def _read_entry(z: "zipfile.ZipFile", prefix: str) -> RefMojo:
             vocab_size, int(m.info["vec_size"])
         )
         m.word_vectors = dict(zip(words, np.asarray(vecs, np.float32)))
+    if m.info.get("algo") == "gam":
+        # GamMojoReader: per-smoother knots / zTranspose / _binvD blobs
+        # (big-endian f64) + the gam column-name text files
+        nks = _parse_jarr(m.info["num_knots_sorted"], int)
+        m.gam_columns = z.read(
+            prefix + "gam_columns_sorted").decode().split()
+        kb = z.read(prefix + "knots")
+        zb = z.read(prefix + "zTranspose")
+        bb = z.read(prefix + "_binvD")
+        m.gam_knots, m.gam_zt, m.gam_binvd = [], [], []
+        ko = zo = bo = 0
+        for k in nks:
+            m.gam_knots.append(np.frombuffer(
+                kb, ">f8", count=k, offset=ko).copy())
+            ko += 8 * k
+            m.gam_zt.append(np.frombuffer(
+                zb, ">f8", count=(k - 1) * k, offset=zo
+            ).reshape(k - 1, k).copy())
+            zo += 8 * (k - 1) * k
+            m.gam_binvd.append(np.frombuffer(
+                bb, ">f8", count=(k - 2) * k, offset=bo
+            ).reshape(k - 2, k).copy())
+            bo += 8 * (k - 2) * k
     if m.info.get("algo") == "pipeline":
         # MojoPipelineReader: sub-models by submodel_dir_i, generated
         # columns bound to (model alias, prediction index)

@@ -722,3 +722,91 @@ class TestPipelineReferenceMojo:
         with pytest.raises(ValueError, match="alias"):
             write_pipeline_mojo({"glm_stage": glm}, {}, "nope",
                                 str(tmp_path / "x.zip"))
+
+
+class TestGamReferenceMojo:
+    """GAM reference MOJO (GAMMojoWriter / GamMojoReader /
+    GamUtilsCubicRegression): knots + binvD + zTranspose blobs, centered
+    betas, independent re-gamification at score time."""
+
+    def _train(self, rng, family="gaussian"):
+        from h2o3_tpu.models.gam import GAM
+
+        n = 400
+        x1 = rng.normal(size=n)
+        x2 = rng.uniform(-2, 2, size=n)
+        z = rng.normal(size=n)
+        g = rng.integers(0, 3, size=n)
+        f = np.sin(1.3 * x1) + 0.4 * x2 ** 2 + 0.3 * z + 0.2 * g
+        if family == "binomial":
+            y = (f + rng.normal(size=n) * 0.3 > 0.5).astype(np.int32)
+            ycol = Column("y", y, ColType.CAT, ["n", "p"])
+        else:
+            ycol = Column("y", f + rng.normal(size=n) * 0.1)
+        fr = Frame([
+            Column("z", z),
+            Column("g", g.astype(np.int32), ColType.CAT, ["a", "b", "c"]),
+            Column("x1", x1), Column("x2", x2), ycol,
+        ])
+        m = GAM(response_column="y", gam_columns=["x1", "x2"],
+                num_knots=8, family=family, lambda_=0.0,
+                standardize=False).train(fr)
+        return m, fr
+
+    @pytest.mark.parametrize("family", ["gaussian", "binomial"])
+    def test_write_decode_score_parity(self, rng, tmp_path, family):
+        from h2o3_tpu.models.mojo_ref import write_mojo
+
+        m, fr = self._train(rng, family)
+        path = str(tmp_path / f"gam_{family}.zip")
+        write_mojo(m, path)
+        mojo = read_mojo(path)
+        assert mojo.info["algo"] == "gam"
+        assert mojo.gam_columns == ["x1", "x2"]
+        want = m._predict_raw(fr)
+        g = fr.col("g").data
+        for i in range(0, 400, 31):
+            row = {"g": float(g[i]),
+                   "z": float(fr.col("z").data[i]),
+                   "x1": float(fr.col("x1").data[i]),
+                   "x2": float(fr.col("x2").data[i])}
+            got = mojo.gam_score0(row)
+            np.testing.assert_allclose(
+                got, np.atleast_1d(want[i]), rtol=1e-6, atol=1e-8)
+
+    def test_layout_facts(self, rng, tmp_path):
+        from h2o3_tpu.models.mojo_ref import write_mojo
+
+        m, fr = self._train(rng)
+        path = str(tmp_path / "gam.zip")
+        write_mojo(m, path)
+        with zipfile.ZipFile(path) as z:
+            names = z.namelist()
+            for entry in ("knots", "zTranspose", "_binvD",
+                          "gam_columns_sorted", "gamColNamesCenter",
+                          "_names_no_centering"):
+                assert entry in names, entry
+            ini = z.read("model.ini").decode()
+            assert "algorithm = Generalized Additive Model" in ini
+            assert "num_TP_col = 0" in ini
+            # blob sizes: K=8 knots -> zT (7x8), binvD (6x8), two cols
+            assert len(z.read("knots")) == 2 * 8 * 8
+            assert len(z.read("zTranspose")) == 2 * 7 * 8 * 8
+            assert len(z.read("_binvD")) == 2 * 6 * 8 * 8
+
+    def test_refusals(self, rng, tmp_path):
+        from h2o3_tpu.models.gam import GAM
+        from h2o3_tpu.models.mojo_ref import write_mojo
+
+        n = 300
+        x = rng.normal(size=n)
+        fr = Frame([Column("x", x),
+                    Column("y", np.sin(x) + rng.normal(size=n) * 0.1)])
+        tp = GAM(response_column="y", gam_columns=["x"], num_knots=8,
+                 bs=1, lambda_=0.0, standardize=False).train(fr)
+        with pytest.raises(ValueError, match="thin-plate|bs=0"):
+            write_mojo(tp, str(tmp_path / "tp.zip"))
+        std = GAM(response_column="y", gam_columns=["x"], num_knots=8,
+                  lambda_=0.0, standardize=True).train(fr)
+        with pytest.raises(ValueError, match="standardize"):
+            write_mojo(std, str(tmp_path / "std.zip"))
