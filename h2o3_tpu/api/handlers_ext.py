@@ -233,10 +233,33 @@ def register(r: RequestServer, server: H2OServer) -> None:  # noqa: C901
             "available in this build; decrypt before import (reference: "
             "water/parser/DecryptionTool.java)")
 
+    def import_hive_table_ep(params):
+        """ImportHiveTableHandler: reads over a HiveServer2 DB-API
+        connection (pyhive) instead of the reference's metastore-direct
+        loads; errors are actionable when the driver is absent."""
+        from h2o3_tpu.frame.ingest import import_hive_table
+
+        parts = params.get("partitions")
+        if isinstance(parts, str) and parts:
+            parts = json.loads(parts)
+        try:
+            fr = import_hive_table(
+                database=params.get("database") or "default",
+                table=params.get("table") or "",
+                partitions=parts or None,
+                connection_url=params.get("connection_url"))
+        except ValueError as e:
+            raise RestError(400, str(e))
+        key = params.get("destination_frame") or DKV.make_key("hive")
+        fr.key = key
+        DKV.put(key, fr)
+        return {"key": {"name": key}, "destination_frame": {"name": key},
+                "num_rows": fr.nrows, "num_cols": fr.ncols}
+
     def hive_unavailable(params):
         raise RestError(
             400,
-            "Hive import/export needs the Hive metastore client, which is "
+            "Hive export needs the Hive metastore client, which is "
             "not available in this build (reference: h2o-ext-hive / "
             "water/hive/HiveTableImporter.java); export the table to "
             "parquet/orc/csv and import that")
@@ -249,8 +272,8 @@ def register(r: RequestServer, server: H2OServer) -> None:  # noqa: C901
                "parse svmlight sources")
     r.register("POST", "/3/DecryptionSetup", decryption_setup,
                "encrypted ingest (unavailable, actionable error)")
-    r.register("POST", "/3/ImportHiveTable", hive_unavailable,
-               "hive import (unavailable, actionable error)")
+    r.register("POST", "/3/ImportHiveTable", import_hive_table_ep,
+               "hive table import over HiveServer2 (pyhive)")
     r.register("POST", "/3/SaveToHiveTable", hive_unavailable,
                "hive export (unavailable, actionable error)")
 

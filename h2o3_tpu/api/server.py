@@ -253,6 +253,18 @@ class H2OServer:
                 self.wfile.write(payload)
 
             def do_GET(self):
+                if (urllib.parse.urlparse(self.path).path == "/3/Steam.web"
+                        and "websocket" in
+                        (self.headers.get("Upgrade") or "").lower()):
+                    if not srv._check_auth(
+                            self.headers.get("Authorization")):
+                        self.send_response(401)
+                        self.end_headers()
+                        return
+                    from h2o3_tpu.api import steam
+
+                    steam.serve_websocket(self)
+                    return
                 self._respond("GET")
 
             def do_POST(self):

@@ -1,0 +1,141 @@
+"""Steam integration: the websocket message exchange.
+
+Reference: ``h2o-extensions/steam`` — ``SteamWebsocketServlet`` accepts
+ONE websocket connection from the Steam orchestrator at ``/3/Steam.web``
+and fans every parsed JSON message out to registered ``SteamMessenger``s;
+``SteamHelloMessenger`` answers ``{"_type": "hello"}`` with version and
+cloud facts. The transport here is a from-scratch RFC 6455 server-side
+endpoint (stdlib only): handshake (Sec-WebSocket-Accept), client-masked
+frame decode, text/ping/close handling.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import struct
+from typing import Callable, Dict, List, Optional
+
+_WS_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+#: messenger registry (SteamMessenger SPI): fn(message) -> response|None
+MESSENGERS: List[Callable[[Dict[str, str]], Optional[Dict[str, str]]]] = []
+
+
+def messenger(fn):
+    MESSENGERS.append(fn)
+    return fn
+
+
+@messenger
+def hello_messenger(message: Dict[str, str]) -> Optional[Dict[str, str]]:
+    """SteamHelloMessenger: hello -> hello_response with build facts."""
+    if message.get("_type") != "hello":
+        return None
+    from h2o3_tpu import __version__ as _ver
+    from h2o3_tpu.parallel.mesh import default_mesh
+
+    try:
+        cloud = default_mesh().devices.size
+    except Exception:
+        cloud = 1
+    return {
+        "_type": "hello_response",
+        "_id": str(message.get("_id", "")) + "_response",
+        "version": _ver,
+        "branch": "main",
+        "hash": "0" * 7,
+        "cloud_size": str(cloud),
+    }
+
+
+def dispatch(message: Dict[str, str]) -> List[Dict[str, str]]:
+    """All messengers see every message (SteamMessageExchange
+    .distributeMessage); non-None returns are sent back."""
+    out = []
+    for fn in MESSENGERS:
+        resp = fn(message)
+        if resp is not None:
+            out.append(resp)
+    return out
+
+
+def accept_key(client_key: str) -> str:
+    """Sec-WebSocket-Accept for a client's Sec-WebSocket-Key (RFC 6455
+    §4.2.2)."""
+    digest = hashlib.sha1((client_key + _WS_GUID).encode()).digest()
+    return base64.b64encode(digest).decode()
+
+
+def encode_frame(payload: bytes, opcode: int = 0x1) -> bytes:
+    """One server->client frame (FIN set, unmasked)."""
+    head = bytes([0x80 | opcode])
+    n = len(payload)
+    if n < 126:
+        head += bytes([n])
+    elif n < (1 << 16):
+        head += bytes([126]) + struct.pack(">H", n)
+    else:
+        head += bytes([127]) + struct.pack(">Q", n)
+    return head + payload
+
+
+def read_frame(rfile) -> Optional[tuple]:
+    """One client frame -> (opcode, payload bytes); None on EOF.
+    Client frames MUST be masked (§5.1)."""
+    head = rfile.read(2)
+    if len(head) < 2:
+        return None
+    opcode = head[0] & 0x0F
+    masked = head[1] & 0x80
+    n = head[1] & 0x7F
+    if n == 126:
+        n = struct.unpack(">H", rfile.read(2))[0]
+    elif n == 127:
+        n = struct.unpack(">Q", rfile.read(8))[0]
+    if n > (1 << 22):
+        return None  # oversized control-plane frame: drop the connection
+    mask = rfile.read(4) if masked else b""
+    payload = rfile.read(n)
+    if masked:
+        payload = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+    return opcode, payload
+
+
+def serve_websocket(handler) -> None:
+    """Upgrade an http.server request to a websocket and run the Steam
+    message loop until close (SteamWebsocketServlet.onWebSocketText)."""
+    key = handler.headers.get("Sec-WebSocket-Key", "")
+    if not key:
+        handler.send_response(400)
+        handler.end_headers()
+        return
+    handler.send_response_only(101, "Switching Protocols")
+    handler.send_header("Upgrade", "websocket")
+    handler.send_header("Connection", "Upgrade")
+    handler.send_header("Sec-WebSocket-Accept", accept_key(key))
+    handler.end_headers()
+    handler.wfile.flush()
+    while True:
+        frame = read_frame(handler.rfile)
+        if frame is None:
+            break
+        opcode, payload = frame
+        if opcode == 0x8:  # close: echo and stop
+            handler.wfile.write(encode_frame(payload, 0x8))
+            break
+        if opcode == 0x9:  # ping -> pong
+            handler.wfile.write(encode_frame(payload, 0xA))
+            continue
+        if opcode != 0x1:
+            continue  # binary/continuation: the exchange is text-only
+        try:
+            message = json.loads(payload.decode())
+        except (ValueError, UnicodeDecodeError):
+            continue
+        for resp in dispatch(message):
+            handler.wfile.write(
+                encode_frame(json.dumps(resp).encode()))
+        handler.wfile.flush()
+    handler.close_connection = True

@@ -749,11 +749,26 @@ def _db_connect(connection_url: str):
             raise ValueError(
                 "mysql import needs pymysql, which is not available in "
                 "this build (reference: water/jdbc/SQLManager.java)")
+    if low.startswith(("hive2://", "jdbc:hive2:")):
+        try:
+            from pyhive import hive  # type: ignore
+        except ImportError:
+            raise ValueError(
+                "hive import needs the 'pyhive' package, which is not "
+                "available in this build (reference: h2o-ext-hive / "
+                "water/hive/HiveTableImporter.java); export the table "
+                "to parquet/orc/csv and import that instead")
+        from urllib.parse import urlparse
+
+        p = urlparse(url.replace("jdbc:hive2:", "hive2:"))
+        return hive.connect(
+            host=p.hostname or "localhost", port=p.port or 10000,
+            username=p.username, database=p.path.lstrip("/") or "default")
     raise ValueError(
         f"unsupported SQL connection url {connection_url!r}; supported: "
         f"sqlite:/path (stdlib), postgresql:// (psycopg2), mysql:// "
-        f"(pymysql) — the reference loads arbitrary JDBC drivers "
-        f"(water/jdbc/SQLManager.java)")
+        f"(pymysql), hive2:// (pyhive) — the reference loads arbitrary "
+        f"JDBC drivers (water/jdbc/SQLManager.java)")
 
 
 def _rows_to_frame(names: Sequence[str], rows: List[tuple]) -> Frame:
@@ -882,3 +897,37 @@ def import_sql_table(
 # module-object import (unlike a from-import of a name) is safe in both
 # import orders of this circular pair.
 from h2o3_tpu.frame import cloud as _cloud  # noqa: E402, F401
+
+
+def import_hive_table(
+    database: str = "default",
+    table: str = "",
+    partitions: Optional[List[List[str]]] = None,
+    connection_url: Optional[str] = None,
+) -> Frame:
+    """Import a Hive table (ImportHiveTableHandler.HiveTableImporter):
+    reads over a HiveServer2 DB-API connection (pyhive when importable)
+    instead of the reference's metastore-direct file loads; `partitions`
+    (list of [col=value, ...] specs) become a WHERE disjunction — the
+    importer's partition filter."""
+    if not table:
+        raise ValueError("table is required")
+    if not re.fullmatch(_SQL_IDENT, table):
+        raise ValueError(f"invalid table name {table!r}")
+    if database and not re.fullmatch(_SQL_IDENT, database):
+        raise ValueError(f"invalid database name {database!r}")
+    url = connection_url or f"hive2://localhost:10000/{database}"
+    query = f"SELECT * FROM {database}.{table}" if database else \
+        f"SELECT * FROM {table}"
+    if partitions:
+        clauses = []
+        for spec in partitions:
+            parts = []
+            for kv in spec:
+                k, _, v = str(kv).partition("=")
+                if not re.fullmatch(_SQL_IDENT, k):
+                    raise ValueError(f"invalid partition column {k!r}")
+                parts.append(f"{k} = '" + v.replace("'", "''") + "'")
+            clauses.append("(" + " AND ".join(parts) + ")")
+        query += " WHERE " + " OR ".join(clauses)
+    return import_sql_table(url, select_query=query)

@@ -632,3 +632,109 @@ class TestLegacyXlsMiniStream(TestLegacyXls):
     # the CONTINUE fixture whose stream the parent builds directly
     def test_sst_continue_split(self):
         pass
+
+
+class TestHiveImport:
+    """Hive table import (ImportHiveTableHandler / h2o-ext-hive): rides a
+    HiveServer2 DB-API connection. The image has no pyhive, so a stub
+    module backed by sqlite pins the flow; without the stub the error is
+    actionable."""
+
+    def _stub_pyhive(self, monkeypatch, tmp_path):
+        import sqlite3
+        import sys
+        import types
+
+        db = tmp_path / "warehouse.db"
+        conn0 = sqlite3.connect(db)
+        conn0.execute("ATTACH DATABASE ? AS dflt", (str(db),))
+        conn0.executescript(
+            "CREATE TABLE IF NOT EXISTS events"
+            "(id INTEGER, v REAL, dt TEXT);"
+            "INSERT INTO events VALUES (1, 1.5, '2026-01-01'),"
+            "(2, 2.5, '2026-01-01'), (3, -0.5, '2026-01-02');")
+        conn0.commit()
+        conn0.close()
+        seen = {}
+
+        class _Cursor:
+            def __init__(self, cur, database):
+                self._cur, self._db = cur, database
+
+            def execute(self, q, *a):
+                # hive queries say db.table; sqlite sees the bare table
+                return self._cur.execute(q.replace(f"{self._db}.", ""), *a)
+
+            def __getattr__(self, name):
+                return getattr(self._cur, name)
+
+        class _Conn:
+            def __init__(self, path, database):
+                self._c = sqlite3.connect(path)
+                self._db = database
+
+            def cursor(self):
+                return _Cursor(self._c.cursor(), self._db)
+
+            def close(self):
+                self._c.close()
+
+        class _Hive(types.ModuleType):
+            @staticmethod
+            def connect(host, port, username=None, database="default"):
+                seen.update(host=host, port=port, database=database)
+                return _Conn(db, database)
+
+        pyhive = types.ModuleType("pyhive")
+        hive = _Hive("pyhive.hive")
+        pyhive.hive = hive
+        monkeypatch.setitem(sys.modules, "pyhive", pyhive)
+        monkeypatch.setitem(sys.modules, "pyhive.hive", hive)
+        return seen
+
+    def test_import_with_partition_filter(self, monkeypatch, tmp_path):
+        from h2o3_tpu.frame.ingest import import_hive_table
+
+        seen = self._stub_pyhive(monkeypatch, tmp_path)
+        fr = import_hive_table(database="default", table="events")
+        assert fr.nrows == 3 and fr.names == ["id", "v", "dt"]
+        assert seen["database"] == "default" and seen["port"] == 10000
+        part = import_hive_table(
+            database="default", table="events",
+            partitions=[["dt=2026-01-01"]])
+        assert part.nrows == 2
+        np.testing.assert_allclose(part.col("v").numeric_view(), [1.5, 2.5])
+
+    def test_validation_and_missing_driver(self):
+        import pytest as _pytest
+
+        from h2o3_tpu.frame.ingest import import_hive_table
+
+        with _pytest.raises(ValueError, match="table is required"):
+            import_hive_table(database="default")
+        with _pytest.raises(ValueError, match="invalid table name"):
+            import_hive_table(table="x; DROP TABLE y")
+        with _pytest.raises(ValueError, match="pyhive"):
+            import_hive_table(table="events")
+
+    def test_rest_route(self, monkeypatch, tmp_path):
+        import json as _json
+        import urllib.request
+
+        from h2o3_tpu.api import start_server
+        from h2o3_tpu.keyed import DKV
+
+        self._stub_pyhive(monkeypatch, tmp_path)
+        s = start_server(port=0)
+        try:
+            req = urllib.request.Request(
+                s.url + "/3/ImportHiveTable",
+                data=_json.dumps({"database": "default",
+                                  "table": "events"}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req) as resp:
+                out = _json.loads(resp.read())
+            assert out["num_rows"] == 3
+            DKV.remove(out["key"]["name"])
+        finally:
+            s.stop()

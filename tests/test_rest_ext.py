@@ -249,3 +249,72 @@ class TestClientModuleFunctions:
         assert "void score(const float *x" in src
         java = h2o.download_pojo(gbm)
         assert "score0" in java
+
+
+class TestSteamWebsocket:
+    """Steam message exchange over a real RFC 6455 websocket
+    (h2o-extensions/steam SteamWebsocketServlet + SteamHelloMessenger)."""
+
+    @staticmethod
+    def _handshake(sock, host):
+        import base64 as b64
+
+        key = b64.b64encode(b"0123456789abcdef").decode()
+        req = ("GET /3/Steam.web HTTP/1.1\r\n"
+               f"Host: {host}\r\n"
+               "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+               f"Sec-WebSocket-Key: {key}\r\n"
+               "Sec-WebSocket-Version: 13\r\n\r\n")
+        sock.sendall(req.encode())
+        head = b""
+        while b"\r\n\r\n" not in head:
+            head += sock.recv(1024)
+        return key, head.decode()
+
+    @staticmethod
+    def _mask_frame(payload: bytes) -> bytes:
+        import os as _os
+
+        mask = _os.urandom(4)
+        body = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+        assert len(payload) < 126
+        return bytes([0x81, 0x80 | len(payload)]) + mask + body
+
+    @staticmethod
+    def _read_frame(sock):
+        head = sock.recv(2)
+        n = head[1] & 0x7F
+        assert not head[1] & 0x80  # server frames are unmasked
+        payload = b""
+        while len(payload) < n:
+            payload += sock.recv(n - len(payload))
+        return head[0] & 0x0F, payload
+
+    def test_hello_roundtrip(self, server):
+        import json as _json
+        import socket
+
+        from h2o3_tpu.api.steam import accept_key
+
+        host = server.url.split("//")[1]
+        ip, port = host.split(":")
+        with socket.create_connection((ip, int(port)), timeout=10) as sock:
+            key, resp = self._handshake(sock, host)
+            assert "101" in resp.splitlines()[0]
+            assert f"Sec-WebSocket-Accept: {accept_key(key)}" in resp
+            sock.sendall(self._mask_frame(_json.dumps(
+                {"_type": "hello", "_id": "42"}).encode()))
+            opcode, payload = self._read_frame(sock)
+            assert opcode == 0x1
+            msg = _json.loads(payload)
+            assert msg["_type"] == "hello_response"
+            assert msg["_id"] == "42_response"
+            assert int(msg["cloud_size"]) >= 1
+            # ping -> pong keeps the exchange alive
+            sock.sendall(bytes([0x89, 0x80]) + b"\x00\x00\x00\x00")
+            opcode, _ = self._read_frame(sock)
+            assert opcode == 0xA
+            # close is echoed
+            sock.sendall(bytes([0x88, 0x80]) + b"\x00\x00\x00\x00")
+            opcode, _ = self._read_frame(sock)
+            assert opcode == 0x8
