@@ -201,6 +201,84 @@ class H2OFrame:
     def table(self) -> "H2OFrame":
         return self._unary("table", False)
 
+    def quantile(self, prob=None,
+                 combine_method: str = "interpolate") -> "H2OFrame":
+        """Per-column quantiles (h2o-py H2OFrame.quantile; AstQtile)."""
+        probs = list(prob) if prob is not None else \
+            [0.001, 0.01, 0.1, 0.25, 0.333, 0.5, 0.667, 0.75, 0.9,
+             0.99, 0.999]
+        return H2OFrame(self._conn,
+                        ExprNode("quantile", self, probs, combine_method))
+
+    def impute(self, column: int = -1, method: str = "mean",
+               combine_method: str = "interpolate",
+               by=None) -> "H2OFrame":
+        """NA imputation in place server-side (h2o-py H2OFrame.impute;
+        AstImpute). column -1 = every numeric column."""
+        return H2OFrame(self._conn, ExprNode(
+            "h2o.impute", self, column, method, combine_method,
+            list(by) if by else []))
+
+    def cor(self, other: Optional["H2OFrame"] = None,
+            use: str = "everything",
+            method: str = "Pearson") -> "H2OFrame":
+        """Correlation matrix (h2o-py H2OFrame.cor; AstCorrelation)."""
+        return H2OFrame(self._conn, ExprNode(
+            "cor", self, other if other is not None else self, use,
+            method))
+
+    def scale(self, center=True, scale=True) -> "H2OFrame":
+        """Center/scale numeric columns (h2o-py H2OFrame.scale;
+        AstScale)."""
+        return H2OFrame(self._conn,
+                        ExprNode("scale", self, center, scale))
+
+    def cumsum(self, axis: int = 0) -> "H2OFrame":
+        return self._unary("cumsum", axis)
+
+    def cumprod(self, axis: int = 0) -> "H2OFrame":
+        return self._unary("cumprod", axis)
+
+    def tolower(self) -> "H2OFrame":
+        return self._unary("tolower")
+
+    def toupper(self) -> "H2OFrame":
+        return self._unary("toupper")
+
+    def trim(self) -> "H2OFrame":
+        return self._unary("trim")
+
+    def gsub(self, pattern: str, replacement: str,
+             ignore_case: bool = False) -> "H2OFrame":
+        """Replace all regex matches (h2o-py H2OFrame.gsub ->
+        replaceall)."""
+        return H2OFrame(self._conn, ExprNode(
+            "replaceall", self, pattern, replacement, ignore_case))
+
+    def strsplit(self, pattern: str) -> "H2OFrame":
+        return self._unary("strsplit", pattern)
+
+    def substring(self, start_index: int,
+                  end_index: Optional[int] = None) -> "H2OFrame":
+        return H2OFrame(self._conn, ExprNode(
+            "substring", self, start_index,
+            end_index if end_index is not None else -1))
+
+    def nchar(self) -> "H2OFrame":
+        return self._unary("length")
+
+    def year(self) -> "H2OFrame":
+        return self._unary("year")
+
+    def month(self) -> "H2OFrame":
+        return self._unary("month")
+
+    def day(self) -> "H2OFrame":
+        return self._unary("day")
+
+    def hour(self) -> "H2OFrame":
+        return self._unary("hour")
+
     # -- munging -------------------------------------------------------------
     def asfactor(self) -> "H2OFrame":
         return self._unary("as.factor")

@@ -169,7 +169,7 @@ def hist(env, args):
     )
 
 
-@prim("impute")
+@prim("impute", "h2o.impute")
 def impute(env, args):
     """(impute fr col method combine_method [by] [groupByFrame] [values])
     (AstImpute): method mean|median|mode; col -1 = all."""

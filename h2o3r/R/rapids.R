@@ -300,3 +300,45 @@ h2o.permutation_importance <- function(model, fr, metric = "AUTO",
     .h2o.rapids.num(n_repeats), " ", feats, " ",
     .h2o.rapids.num(seed), ")")))
 }
+
+# -- round-5 widening: quantiles, imputation, correlation, strings, time ----
+
+h2o.quantile <- function(fr, probs = c(0.001, 0.01, 0.1, 0.25, 0.333, 0.5,
+                                       0.667, 0.75, 0.9, 0.99, 0.999),
+                         combine_method = "interpolate") {
+  .h2o.expr(paste0("(quantile ", .h2o.ast.of(fr), " ",
+                   .h2o.rapids.numlist(probs), " ",
+                   .h2o.rapids.quote(combine_method), ")"))
+}
+
+h2o.impute <- function(fr, column = -1, method = "mean",
+                       combine_method = "interpolate", by = NULL) {
+  byl <- if (is.null(by)) "[]" else .h2o.rapids.numlist(by)
+  .h2o.expr(paste0("(h2o.impute ", .h2o.ast.of(fr), " ",
+                   .h2o.rapids.num(column), " ",
+                   .h2o.rapids.quote(method), " ",
+                   .h2o.rapids.quote(combine_method), " ", byl, ")"))
+}
+
+h2o.cor <- function(x, y = NULL, use = "everything", method = "Pearson") {
+  .h2o.expr(.h2o.op("cor", x, if (is.null(y)) x else y, use, method))
+}
+
+h2o.scale <- function(fr, center = TRUE, scale = TRUE)
+  .h2o.expr(.h2o.op("scale", fr, center, scale))
+
+h2o.cumsum <- function(fr, axis = 0) .h2o.expr(.h2o.op("cumsum", fr, axis))
+h2o.cumprod <- function(fr, axis = 0) .h2o.expr(.h2o.op("cumprod", fr, axis))
+h2o.tolower <- function(fr) .h2o.expr(.h2o.op("tolower", fr))
+h2o.toupper <- function(fr) .h2o.expr(.h2o.op("toupper", fr))
+h2o.trim <- function(fr) .h2o.expr(.h2o.op("trim", fr))
+h2o.gsub <- function(pattern, replacement, fr, ignore.case = FALSE)
+  .h2o.expr(.h2o.op("replaceall", fr, pattern, replacement, ignore.case))
+h2o.strsplit <- function(fr, split) .h2o.expr(.h2o.op("strsplit", fr, split))
+h2o.substring <- function(fr, start, end = -1)
+  .h2o.expr(.h2o.op("substring", fr, start, end))
+h2o.nchar <- function(fr) .h2o.expr(.h2o.op("length", fr))
+h2o.year <- function(fr) .h2o.expr(.h2o.op("year", fr))
+h2o.month <- function(fr) .h2o.expr(.h2o.op("month", fr))
+h2o.day <- function(fr) .h2o.expr(.h2o.op("day", fr))
+h2o.hour <- function(fr) .h2o.expr(.h2o.op("hour", fr))

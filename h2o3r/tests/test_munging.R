@@ -53,7 +53,18 @@ got <- list(
   groupby_count = ast(h2o.group_by(frA, "g", nrow = TRUE)),
   ifelse = ast(h2o.ifelse(frA$a > 0L, 1, 0)),
   log = ast(log(frA$a)),
-  perfect_auc = .h2o.op("perfectAUC", frA$a, frA$b)
+  perfect_auc = .h2o.op("perfectAUC", frA$a, frA$b),
+  quantile = ast(h2o.quantile(frA$a, c(0.25, 0.5, 0.75))),
+  impute = ast(h2o.impute(frA, 0, "median")),
+  cor = ast(h2o.cor(frA[, c("a", "b")])),
+  scale = ast(h2o.scale(frA[, c("a", "b")])),
+  cumsum = ast(h2o.cumsum(frA$a)),
+  tolower = ast(h2o.tolower(frA$g)),
+  gsub = ast(h2o.gsub("x", "y", frA$g)),
+  strsplit = ast(h2o.strsplit(frA$g, "-")),
+  substring = ast(h2o.substring(frA$g, 1, 3)),
+  nchar = ast(h2o.nchar(frA$g)),
+  year = ast(h2o.year(frA$b))
 )
 
 fails <- 0L

@@ -366,3 +366,23 @@ class TestClientModelPrims:
         assert 0.0 < old < 1.0
         # a second reset returns the value just set
         assert model.reset_threshold(0.3) == pytest.approx(0.8)
+
+
+def test_round5_munging_surface(conn):
+    """The round-5 client widening executes server-side end to end."""
+    import h2o3_tpu.client as h2o
+
+    fr = h2o.upload_csv("a,b,s\n1,10,Cat\n2,20,dog\nNA,30,Cat\n4,40,bird\n")
+    q = fr["a"].quantile([0.5]).get_frame_data()
+    assert float(q["aQuantiles"][0]) == 2.0
+    filled = fr.impute(0, "mean")
+    vals = [float(v) for v in filled.get_frame_data()["a"]]
+    assert vals[2] == pytest.approx((1 + 2 + 4) / 3)
+    c = fr[["a", "b"]].cor(use="complete.obs").get_frame_data()
+    assert float(c[list(c)[0]][0]) == pytest.approx(1.0)
+    lo = fr["s"].tolower().get_frame_data()
+    assert lo[list(lo)[0]][0] == "cat"
+    n = fr["s"].nchar().get_frame_data()
+    assert float(n[list(n)[0]][0]) == 3.0
+    cs = fr["b"].cumsum().get_frame_data()
+    assert [float(v) for v in cs[list(cs)[0]]] == [10.0, 30.0, 60.0, 100.0]

@@ -219,6 +219,17 @@ class TestRapidsParity:
             "ifelse": ExprNode("ifelse", frA["a"] > 0, 1, 0),
             "log": ExprNode("log", frA["a"]),
             "perfect_auc": ExprNode("perfectAUC", frA["a"], frA["b"]),
+            "quantile": frA["a"].quantile([0.25, 0.5, 0.75]),
+            "impute": frA.impute(0, "median"),
+            "cor": frA[["a", "b"]].cor(),
+            "scale": frA[["a", "b"]].scale(),
+            "cumsum": frA["a"].cumsum(),
+            "tolower": frA["g"].tolower(),
+            "gsub": frA["g"].gsub("x", "y"),
+            "strsplit": frA["g"].strsplit("-"),
+            "substring": frA["g"].substring(1, 3),
+            "nchar": frA["g"].nchar(),
+            "year": frA["b"].year(),
         }
         golden = self._golden()
         assert set(S) == set(golden), "scenario sets diverged"
