@@ -166,9 +166,15 @@ class TestIngestForms:
     def test_gated_routes_actionable(self, server):
         st, out = _req(server, "POST", "/3/DecryptionSetup", {})
         assert st == 400 and "Decryption" in out["msg"]
-        for p in ("/3/ImportHiveTable", "/3/SaveToHiveTable"):
-            st, out = _req(server, "POST", p, {})
-            assert st == 400 and "Hive" in out["msg"]
+        # hive import is now a real (pyhive-gated) path: without a table
+        # it validates, and without the driver the error names pyhive
+        st, out = _req(server, "POST", "/3/ImportHiveTable", {})
+        assert st == 400 and "table is required" in out["msg"]
+        st, out = _req(server, "POST", "/3/ImportHiveTable",
+                       {"table": "t"})
+        assert st == 400 and "pyhive" in out["msg"]
+        st, out = _req(server, "POST", "/3/SaveToHiveTable", {})
+        assert st == 400 and "Hive" in out["msg"]
 
 
 class TestAssembly:
