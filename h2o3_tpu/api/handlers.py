@@ -753,6 +753,39 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
         finally:
             os.unlink(path)
 
+    def mojo_pipeline(params):
+        """Compose trained models into ONE reference-layout pipeline MOJO
+        (hex/genmodel/MojoPipelineWriter — h2o.make_mojo_pipeline's
+        role): body {models: {alias: model_id}, input_mapping:
+        {generated_col: "alias:pred_idx"}, main_model: alias}; returns
+        the zip bytes."""
+        from h2o3_tpu.models.mojo_ref import write_pipeline_mojo
+
+        models_spec = params.get("models")
+        if isinstance(models_spec, str):
+            models_spec = json.loads(models_spec)
+        mapping = params.get("input_mapping") or {}
+        if isinstance(mapping, str):
+            mapping = json.loads(mapping)
+        main = params.get("main_model")
+        if not models_spec or not main:
+            raise RestError(400, "models (alias->model_id) and main_model "
+                                 "are required")
+        models = {alias: _get_model(mid)
+                  for alias, mid in models_spec.items()}
+        with tempfile.NamedTemporaryFile(suffix=".zip",
+                                         delete=False) as f:
+            path = f.name
+        try:
+            try:
+                write_pipeline_mojo(models, mapping, main, path)
+            except ValueError as e:
+                raise RestError(400, str(e))
+            with open(path, "rb") as f:
+                return f.read()
+        finally:
+            os.unlink(path)
+
     def predict(params, model_id, frame_id):
         m = _get_model(model_id)
         fr = _get_frame(frame_id)
@@ -879,6 +912,8 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
     r.register("POST", "/3/Frames/{frame_id}/save", frame_save,
                "save frame server-side")
     r.register("POST", "/3/Frames/load", frame_load, "load a saved frame")
+    r.register("POST", "/99/MojoPipeline", mojo_pipeline,
+               "compose models into a reference pipeline MOJO")
     r.register("POST", "/99/Models.mojo", mojo_import,
                "import a MOJO as a Generic model")
     r.register(

@@ -324,3 +324,63 @@ class TestSteamWebsocket:
             sock.sendall(bytes([0x88, 0x80]) + b"\x00\x00\x00\x00")
             opcode, _ = self._read_frame(sock)
             assert opcode == 0x8
+
+
+class TestMojoPipelineRoute:
+    def test_compose_and_decode(self, server, gbm, tmp_path):
+        """POST /99/MojoPipeline returns a reference pipeline zip whose
+        main model is the trained GBM (degenerate single-model pipeline:
+        no generated columns)."""
+        import zipfile as _zip
+
+        st, raw = _req(server, "POST", "/99/MojoPipeline",
+                       {"models": {"main": gbm}, "input_mapping": {},
+                        "main_model": "main"}, raw=True)
+        assert st == 200
+        p = tmp_path / "pipe.zip"
+        p.write_bytes(raw)
+        with _zip.ZipFile(p) as z:
+            ini = z.read("model.ini").decode()
+            assert "algorithm = MOJO Pipeline" in ini
+            assert "models/main/model.ini" in z.namelist()
+        from h2o3_tpu.models.mojo_ref import read_mojo
+
+        mojo = read_mojo(str(p))
+        assert mojo.pipeline_main == "main"
+
+    def test_validation(self, server):
+        st, out = _req(server, "POST", "/99/MojoPipeline", {})
+        assert st == 400 and "main_model" in out["msg"]
+
+
+class TestGamReferenceDownload:
+    def test_gam_reference_mojo_over_rest(self, server, tmp_path):
+        import numpy as np
+
+        rng = np.random.default_rng(9)
+        x = rng.normal(size=200)
+        csv = "x,z,y\n" + "\n".join(
+            f"{a:.5f},{b:.5f},{np.sin(a) + 0.2 * b:.5f}"
+            for a, b in zip(x, rng.normal(size=200)))
+        st, up = _req(server, "POST", "/3/PostFile", {"data": csv})
+        st, out = _req(server, "POST", "/3/Parse",
+                       {"source_frames": [up["destination_frame"]],
+                        "destination_frame": "gam_train"})
+        assert st == 200, out
+        st, out = _req(server, "POST", "/3/ModelBuilders/gam",
+                       {"training_frame": "gam_train",
+                        "response_column": "y", "gam_columns": ["x"],
+                        "num_knots": 8, "lambda_": 0.0,
+                        "standardize": False, "model_id": "ext_gam"})
+        assert st == 200, out
+        st, raw = _req(server, "GET",
+                       "/3/Models/ext_gam/mojo?format=reference",
+                       raw=True)
+        assert st == 200
+        p = tmp_path / "gam.zip"
+        p.write_bytes(raw)
+        from h2o3_tpu.models.mojo_ref import read_mojo
+
+        mojo = read_mojo(str(p))
+        assert mojo.info["algo"] == "gam"
+        assert mojo.gam_columns == ["x"]
