@@ -216,6 +216,28 @@ def rapids(ast: str) -> Dict[str, Any]:
     )
 
 
+def make_mojo_pipeline(models: Dict[str, Any], input_mapping: Dict[str, str],
+                       main_model: str, path: str) -> str:
+    """Compose trained models into ONE reference-layout pipeline MOJO on
+    the server and save the zip locally (h2o.make_mojo_pipeline's role;
+    hex/genmodel/MojoPipelineWriter). ``models`` maps alias -> model (or
+    model id); ``input_mapping`` maps a generated column consumed by the
+    main model to ``"alias:prediction_index"``."""
+    import os
+
+    spec = {alias: _key_of(m) if not isinstance(m, str) else m
+            for alias, m in models.items()}
+    raw = connection().request(
+        "POST /99/MojoPipeline",
+        {"models": spec, "input_mapping": input_mapping,
+         "main_model": main_model}, raw=True)
+    if os.path.isdir(path):
+        path = os.path.join(path, "pipeline.mojo")
+    with open(path, "wb") as f:
+        f.write(raw)
+    return path
+
+
 def cluster_status() -> Dict[str, Any]:
     return connection().cloud_info()
 

@@ -386,3 +386,25 @@ def test_round5_munging_surface(conn):
     assert float(n[list(n)[0]][0]) == 3.0
     cs = fr["b"].cumsum().get_frame_data()
     assert [float(v) for v in cs[list(cs)[0]]] == [10.0, 30.0, 60.0, 100.0]
+
+
+def test_make_mojo_pipeline(conn, tmp_path):
+    """h2o.make_mojo_pipeline composes server-side models into one
+    reference pipeline zip."""
+    import zipfile
+
+    import h2o3_tpu.client as h2o
+
+    rng = np.random.default_rng(13)
+    X = rng.normal(size=(200, 2))
+    y = (X[:, 0] > 0).astype(int)
+    csv = "a,b,y\n" + "\n".join(
+        f"{r[0]},{r[1]},c{int(t)}" for r, t in zip(X, y))
+    fr = h2o.upload_csv(csv)
+    est = h2o.H2OGradientBoostingEstimator(ntrees=3, max_depth=2, seed=1)
+    est.train(y="y", training_frame=fr)
+    out = h2o.make_mojo_pipeline(
+        {"main": est.model}, {}, "main", str(tmp_path))
+    with zipfile.ZipFile(out) as z:
+        assert "models/main/model.ini" in z.namelist()
+        assert "algorithm = MOJO Pipeline" in z.read("model.ini").decode()
