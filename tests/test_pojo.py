@@ -286,3 +286,56 @@ class TestJavaEmitterAndRoutes:
         proc = subprocess.run(["javac", f"{cls}.java"], cwd=tmp_path,
                               capture_output=True, text=True)
         assert proc.returncode == 0, proc.stderr
+
+
+class TestGamPojo:
+    """GAM C scorer: emitted source recomputes the CR basis and must
+    match in-framework predict bit-for-bit on in-range rows."""
+
+    @pytest.mark.parametrize("family", ["gaussian", "binomial"])
+    def test_compiled_parity(self, tmp_path, family):
+        from h2o3_tpu.models.data_info import expand_matrix
+        from h2o3_tpu.models.gam import GAM
+        from h2o3_tpu.models.pojo import pojo_source
+
+        rng = np.random.default_rng(17)
+        n = 300
+        x1 = rng.normal(size=n)
+        z = rng.normal(size=n)
+        f = np.sin(1.4 * x1) + 0.5 * z
+        if family == "binomial":
+            y = (f + rng.normal(size=n) * 0.3 > 0).astype(np.int32)
+            ycol = Column("y", y, ColType.CAT, ["n", "p"])
+        else:
+            ycol = Column("y", f + rng.normal(size=n) * 0.1)
+        fr = Frame([Column("z", z), Column("x1", x1), ycol])
+        m = GAM(response_column="y", gam_columns=["x1"], num_knots=8,
+                family=family, lambda_=0.0, standardize=False).train(fr)
+        src = pojo_source(m, "c")
+        lib = _compile(src, tmp_path, f"gam_{family}")
+        lib.score.argtypes = [ctypes.POINTER(ctypes.c_double),
+                              ctypes.POINTER(ctypes.c_double)]
+        Xl, _ = expand_matrix(m.data_info, fr, dtype=np.float64)
+        want = m._predict_raw(fr)
+        out = np.zeros(3)
+        for i in range(0, n, 17):
+            row = np.concatenate([Xl[i], [x1[i]]])
+            lib.score(row.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                      out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+            if family == "binomial":
+                np.testing.assert_allclose(out[1:], want[i], rtol=1e-10)
+            else:
+                np.testing.assert_allclose(out[0], want[i], rtol=1e-10)
+
+    def test_refusal_for_non_cr(self, tmp_path):
+        from h2o3_tpu.models.gam import GAM
+        from h2o3_tpu.models.pojo import pojo_source
+
+        rng = np.random.default_rng(3)
+        x = rng.normal(size=200)
+        fr = Frame([Column("x", x),
+                    Column("y", np.sin(x) + rng.normal(size=200) * 0.1)])
+        m = GAM(response_column="y", gam_columns=["x"], num_knots=8,
+                bs=1, lambda_=0.0, standardize=False).train(fr)
+        with pytest.raises(ValueError, match="cubic-regression"):
+            pojo_source(m, "c")
