@@ -137,6 +137,65 @@ def tp_penalty(knots: np.ndarray) -> np.ndarray:
     return S
 
 
+def tp_m(d: int) -> int:
+    """(m-1) = max polynomial degree of the TP null space:
+    m = floor((d+1)/2)+1 (ThinPlateRegressionUtils.calculatem)."""
+    return int(np.floor((d + 1) * 0.5)) + 1
+
+
+def tp_poly_exponents(d: int, m: int) -> List[Tuple[int, ...]]:
+    """All monomial exponent tuples with total degree < m, the all-zeros
+    (constant) term first — M = C(d+m-1, d) of them
+    (ThinPlateRegressionUtils.findPolyBasis)."""
+    from itertools import product
+
+    combos = [t for t in product(range(m), repeat=d) if sum(t) < m]
+    combos.sort(key=lambda t: (sum(t), t))
+    return combos
+
+
+def tp_const(m: int, d: int) -> float:
+    """Radial-basis scale (GamUtilsThinPlateRegression.calTPConstantTerm)."""
+    from math import factorial, pi
+
+    if d % 2 == 0:
+        return ((-1.0) ** (m + 1 + d / 2.0)
+                / (2.0 ** (2 * m - 1) * pi ** (d / 2.0)
+                   * factorial(m - 1) * factorial(m - d // 2)))
+    return ((-1.0) ** m * m
+            / (factorial(2 * m) * pi ** ((d - 1) / 2.0)))
+
+
+def tp_distance(X: np.ndarray, knots: np.ndarray, m: int) -> np.ndarray:
+    """[N, K] radial terms φ(|x−kᵢ|) exactly as the reference scores them
+    (GamUtilsThinPlateRegression.calculateDistance): const·r^(2m−d),
+    and for even d an extra ·log(r^(2m−d)) where the power is nonzero."""
+    d = knots.shape[1]
+    # Gram identity keeps temporaries at [N, K] (an [N, K, d] broadcast
+    # diff would dominate peak memory when scoring large frames)
+    r2 = ((X * X).sum(axis=1)[:, None] + (knots * knots).sum(axis=1)[None]
+          - 2.0 * X @ knots.T)
+    r = np.sqrt(np.maximum(r2, 0.0))
+    dist = r ** (2 * m - d)
+    out = tp_const(m, d) * dist
+    if d % 2 == 0:
+        with np.errstate(divide="ignore"):
+            lg = np.where(dist != 0, np.log(np.maximum(dist, 1e-300)), 0.0)
+        out = out * lg
+    return out
+
+
+def tp_polynomials(X: np.ndarray,
+                   expo: List[Tuple[int, ...]]) -> np.ndarray:
+    """[N, M] monomial basis (calculatePolynomialBasis)."""
+    out = np.ones((X.shape[0], len(expo)))
+    for j, t in enumerate(expo):
+        for p, e in enumerate(t):
+            if e:
+                out[:, j] *= X[:, p] ** e
+    return out
+
+
 def _bspline_knots(knots: np.ndarray, degree: int) -> np.ndarray:
     return np.concatenate([
         np.repeat(knots[0], degree), knots, np.repeat(knots[-1], degree)
@@ -171,6 +230,107 @@ def i_basis(x: np.ndarray, knots: np.ndarray, degree: int = 3) -> np.ndarray:
     dm = BSpline.design_matrix(xc, t, degree + 1, extrapolate=False).toarray()
     # I_j(x) = sum of higher-order B-splines from j+1 on (de Boor)
     return np.cumsum(dm[:, ::-1], axis=1)[:, ::-1][:, 1:]
+
+
+@dataclass
+class TpSpec:
+    """Multi-predictor thin-plate smoother (ThinPlateDistanceWithKnots +
+    ThinPlatePolynomialWithKnots): d-dim radial distances to K knot
+    points, projected through zCS (the null space of the knot-polynomial
+    matrix, the T'δ=0 constraint), concatenated with the M monomials of
+    total degree < m, then centered through Z like every other smoother.
+    Scoring math matches GamUtilsThinPlateRegression exactly."""
+
+    columns: List[str]
+    knots: np.ndarray          # [K, d] knot points (data rows)
+    zcs: np.ndarray            # [K, K-M]
+    Z: np.ndarray              # [K, K-1] centering transform
+    penalty: np.ndarray        # [K-1, K-1] (bending energy through Z)
+    na_fill: np.ndarray        # [d] per-predictor training medians
+    m: int
+    kind: int = 1
+    nonneg: bool = False
+
+    @property
+    def column(self) -> str:  # display/coefficient-name anchor
+        return "_".join(self.columns)
+
+    @property
+    def expo(self) -> List[Tuple[int, ...]]:
+        return tp_poly_exponents(self.knots.shape[1], self.m)
+
+    def raw_basis(self, X: np.ndarray) -> np.ndarray:
+        dist = tp_distance(X, self.knots, self.m) @ self.zcs
+        poly = tp_polynomials(X, self.expo)
+        return np.concatenate([dist, poly], axis=1)
+
+    def stack(self, frame: Frame) -> np.ndarray:
+        """[N, d] raw predictor matrix — the ONE extraction both
+        training and scoring use (train/predict skew guard)."""
+        return _tp_stack(frame, self.columns)
+
+    def expand(self, X: np.ndarray) -> np.ndarray:
+        X = np.where(np.isnan(X), self.na_fill[None, :], X)
+        return self.raw_basis(X) @ self.Z
+
+
+def _tp_stack(frame: Frame, columns) -> np.ndarray:
+    return np.column_stack([
+        frame.col(c).numeric_view().astype(np.float64) for c in columns])
+
+
+def _make_tp_spec(columns: List[str], X: np.ndarray,
+                  num_knots: int) -> TpSpec:
+    """Joint thin-plate smoother over ≥2 predictors. Knots are actual
+    data rows, evenly spaced along the first predictor's sort order (the
+    reference also takes knot points from the data)."""
+    d = X.shape[1]
+    ok = ~np.isnan(X).any(axis=1)
+    Xs = X[ok]
+    m = tp_m(d)
+    expo = tp_poly_exponents(d, m)
+    M = len(expo)
+    if num_knots <= M + 1:
+        raise ValueError(
+            f"thin-plate smoother over {d} predictors needs num_knots > "
+            f"{M + 1} (polynomial null space has {M} terms)")
+    if len(Xs) < num_knots:
+        raise ValueError("not enough complete rows for the requested "
+                         "number of thin-plate knots")
+    order = np.argsort(Xs[:, 0], kind="stable")
+    pick = order[np.linspace(0, len(order) - 1, num_knots).astype(int)]
+    knots = np.unique(Xs[pick], axis=0)
+    K = len(knots)
+    if K <= M + 1:
+        raise ValueError("duplicate rows collapsed the thin-plate knots; "
+                         "reduce num_knots or dedupe the predictors")
+    # zCS: null space of T' where T[i,j] = poly_j(knot_i)
+    T = tp_polynomials(knots, expo)
+    Q, _ = np.linalg.qr(T, mode="complete")
+    zcs = Q[:, M:]
+    # bending energy on the constrained distance coefficients
+    E = tp_distance(knots, knots, m)
+    S_dist = zcs.T @ E @ zcs
+    S_dist = (S_dist + S_dist.T) / 2.0
+    # PSD guard: the projected radial form can have tiny negative
+    # eigenvalues from float error
+    w = np.linalg.eigvalsh(S_dist)
+    if w.min() < 0:
+        S_dist = S_dist - (w.min() - 1e-10) * np.eye(len(S_dist))
+    S_raw = np.zeros((K, K))
+    S_raw[:K - M, :K - M] = S_dist
+    na_fill = np.median(Xs, axis=0)
+    # centering against the intercept, same construction as _make_spec
+    spec = TpSpec(columns=list(columns), knots=knots, zcs=zcs,
+                  Z=np.empty(0), penalty=np.empty(0), na_fill=na_fill,
+                  m=m)
+    basis = spec.raw_basis(Xs)
+    mean = basis.mean(axis=0)
+    _, _, Vt = np.linalg.svd(mean[None, :], full_matrices=True)
+    Z = Vt[1:].T
+    spec.Z = Z
+    spec.penalty = Z.T @ S_raw @ Z
+    return spec
 
 
 @dataclass
@@ -285,7 +445,11 @@ class GAMModel(Model):
         Xl, _ = expand_matrix(self.data_info, frame, dtype=np.float64)
         blocks = [Xl]
         for s in self.specs:
-            blocks.append(s.expand(frame.col(s.column).numeric_view().astype(np.float64)))
+            if isinstance(s, TpSpec):
+                blocks.append(s.expand(s.stack(frame)))
+            else:
+                blocks.append(s.expand(
+                    frame.col(s.column).numeric_view().astype(np.float64)))
         return np.concatenate(blocks, axis=1)
 
     def _predict_raw(self, frame: Frame) -> np.ndarray:
@@ -320,10 +484,19 @@ class GAM(ModelBuilder):
                 frame = frame.add_column(ycol.as_factor())
         # gam columns are modeled through their basis only (GAM.java removes
         # them from the linear predictors)
+        # gam_columns entries may be a column name or a LIST of names (a
+        # joint multi-predictor thin-plate smoother, GAM.java's
+        # gam_columns[][] shape)
+        flat_gam_cols: List[str] = []
+        for entry in p.gam_columns:
+            if isinstance(entry, (list, tuple)):
+                flat_gam_cols.extend(entry)
+            else:
+                flat_gam_cols.append(entry)
         info = build_data_info(
             frame,
             y=p.response_column,
-            ignored=list(p.ignored_columns) + list(p.gam_columns),
+            ignored=list(p.ignored_columns) + flat_gam_cols,
             standardize=p.standardize,
             missing_values_handling=p.missing_values_handling,
         )
@@ -336,14 +509,31 @@ class GAM(ModelBuilder):
                       else [None] * ncols)
         if len(knots_list) != ncols:
             raise ValueError("knots list must align with gam_columns")
-        model.specs = [
-            _make_spec(
-                c, frame.col(c).numeric_view().astype(np.float64),
-                int(nk_list[i]), bs=int(bs_list[i]),
-                user_knots=knots_list[i], nonneg=p.splines_non_negative,
-            )
-            for i, c in enumerate(p.gam_columns)
-        ]
+        specs = []
+        for i, c in enumerate(p.gam_columns):
+            if isinstance(c, (list, tuple)) and len(c) > 1:
+                if int(bs_list[i]) != 1:
+                    # GAM.java: multi-column smoothers are thin-plate
+                    # ONLY — a silently coerced bs=0 would hand the user
+                    # a different basis than the documented code
+                    raise ValueError(
+                        "multi-predictor gam_columns entries are "
+                        "thin-plate smoothers: pass bs=1 for "
+                        f"{list(c)}")
+                if knots_list[i] is not None:
+                    raise ValueError("explicit knots are not supported "
+                                     "for multi-predictor smoothers")
+                specs.append(_make_tp_spec(
+                    list(c), _tp_stack(frame, c), int(nk_list[i])))
+            else:
+                cc = c[0] if isinstance(c, (list, tuple)) else c
+                specs.append(_make_spec(
+                    cc, frame.col(cc).numeric_view().astype(np.float64),
+                    int(nk_list[i]), bs=int(bs_list[i]),
+                    user_knots=knots_list[i],
+                    nonneg=p.splines_non_negative,
+                ))
+        model.specs = specs
 
         X = model._design(frame)
         y = response_vector(info, frame)
