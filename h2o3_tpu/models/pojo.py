@@ -430,6 +430,48 @@ def glm_pojo_c(model) -> str:
     return "".join(chunks)
 
 
+def glm_multinomial_pojo_c(model) -> str:
+    """Multinomial GLM scorer: K etas over the standardized design
+    vector (class-major beta_multi layout, intercept row last) +
+    numerically-stable softmax — matching ``_predict_raw``'s
+    ``_softmax(X @ B[:-1] + B[-1])`` exactly."""
+    names = list(model.data_info.coef_names)
+    B = np.asarray(model.beta_multi, dtype=np.float64)  # [P+1, K]
+    P, K = B.shape[0] - 1, B.shape[1]
+    chunks = [f"""/* GENERATED standalone multinomial GLM scorer — do not edit.
+ * Model: {model.key} (K={K} classes)
+ * x: double[{P}] standardized design vector (expand_matrix order):
+ * {", ".join(names)}
+ * out: [label, p_0..p_{K - 1}]
+ */
+#include <math.h>
+
+"""]
+    chunks.append(_c_arr("beta", B[:-1].ravel(), "double", _c_float))
+    chunks.append(_c_arr("icpt", B[-1], "double", _c_float))
+    chunks.append(f"""
+void score(const double *x, double *out) {{
+  double eta[{K}];
+  double mx = -1e308;
+  for (int k = 0; k < {K}; k++) {{
+    double e = icpt[k];
+    for (int i = 0; i < {P}; i++) e += beta[i * {K} + k] * x[i];
+    eta[k] = e;
+    if (e > mx) mx = e;
+  }}
+  double tot = 0.0;
+  for (int k = 0; k < {K}; k++) {{ eta[k] = exp(eta[k] - mx); tot += eta[k]; }}
+  int best = 0;
+  for (int k = 0; k < {K}; k++) {{
+    out[k + 1] = eta[k] / tot;
+    if (out[k + 1] > out[best + 1]) best = k;
+  }}
+  out[0] = (double) best;
+}}
+""")
+    return "".join(chunks)
+
+
 def gam_pojo_c(model) -> str:
     """Standalone GAM scorer: the emitted source re-computes each
     cubic-regression smoother's basis (cr_basis algebra: locateBin +
@@ -563,11 +605,15 @@ def pojo_source(model, lang: str = "c") -> str:
             getattr(model, "coefficients", None), dict):
         if lang != "c":
             raise ValueError("GLM POJO is emitted as C only")
-        if getattr(model.params, "family", "") in ("multinomial", "ordinal") \
+        if getattr(model.params, "family", "") == "multinomial":
+            if getattr(model, "beta_multi", None) is None:
+                raise ValueError("multinomial GLM has no trained betas")
+            return glm_multinomial_pojo_c(model)
+        if getattr(model.params, "family", "") == "ordinal" \
                 or getattr(model, "beta_std", None) is None:
             raise ValueError(
-                "GLM POJO export supports single-eta families only "
-                "(not multinomial/ordinal)")
+                "GLM POJO export does not cover the ordinal family "
+                "(thresholded cumulative etas)")
         return glm_pojo_c(model)
     raise ValueError(
         f"POJO export supports tree models and GLM, not {model.algo_name}")

@@ -188,12 +188,14 @@ class TestGLMPojoC:
             np.testing.assert_allclose(out[1:], want[i], rtol=1e-10)
 
     def test_unsupported_glm_families_raise(self, rng):
+        # multinomial now exports (TestMultinomialGlmPojo); ordinal is
+        # the remaining refusal
         from h2o3_tpu.models.glm import GLM, GLMParameters
 
         fr = _frame(rng, nclass=3)
         m = GLM(GLMParameters(response_column="y",
-                              family="multinomial")).train(fr)
-        with pytest.raises(ValueError, match="single-eta"):
+                              family="ordinal")).train(fr)
+        with pytest.raises(ValueError, match="ordinal"):
             m.pojo("c")
 
     def test_offset_models_refuse(self, rng):
@@ -339,3 +341,34 @@ class TestGamPojo:
                 bs=1, lambda_=0.0, standardize=False).train(fr)
         with pytest.raises(ValueError, match="cubic-regression"):
             pojo_source(m, "c")
+
+
+class TestMultinomialGlmPojo:
+    def test_compiled_parity(self, tmp_path):
+        from h2o3_tpu.models.data_info import expand_matrix
+        from h2o3_tpu.models.glm import GLM, GLMParameters
+        from h2o3_tpu.models.pojo import pojo_source
+
+        rng = np.random.default_rng(23)
+        n = 400
+        X = rng.normal(size=(n, 3))
+        logits = np.stack([X[:, 0], -X[:, 0] + X[:, 1], 0.5 * X[:, 2]],
+                          axis=1)
+        y = logits.argmax(axis=1).astype(np.int32)
+        fr = Frame([Column(f"x{i}", X[:, i]) for i in range(3)]
+                   + [Column("y", y, ColType.CAT, ["a", "b", "c"])])
+        m = GLM(GLMParameters(response_column="y", family="multinomial",
+                              lambda_=0.0)).train(fr)
+        src = pojo_source(m, "c")
+        lib = _compile(src, tmp_path, "glm_multi")
+        lib.score.argtypes = [ctypes.POINTER(ctypes.c_double),
+                              ctypes.POINTER(ctypes.c_double)]
+        Xd, _ = expand_matrix(m.data_info, fr, dtype=np.float64)
+        want = m._predict_raw(fr)
+        out = np.zeros(4)
+        for i in range(0, n, 29):
+            row = np.ascontiguousarray(Xd[i])
+            lib.score(row.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                      out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+            np.testing.assert_allclose(out[1:], want[i], rtol=1e-10)
+            assert int(out[0]) == int(np.argmax(want[i]))
