@@ -168,6 +168,11 @@ def _worker() -> None:
 
     from h2o3_tpu.models.tree.booster import TreeParams, train_boosted
     from h2o3_tpu.models.tree.common import init_margin
+    from h2o3_tpu.util import telemetry
+
+    # count XLA compiles from the first warmup program on, so the artifact
+    # records how much of this run was compilation vs steady-state training
+    telemetry.install_jax_compile_listener()
 
     X, y = synth_higgs(n_rows)
     params = TreeParams(
@@ -221,6 +226,15 @@ def _worker() -> None:
     # the 25M north star, not round 1's broken floor
     target = 8_000_000.0
 
+    # telemetry ride-along: jit-miss / dispatch / shard-byte totals travel
+    # inside every BENCH_*.json so regressions in compile count or dispatch
+    # volume are visible in the same trend line as the throughput number
+    try:
+        tel = {k: (round(v, 3) if isinstance(v, float) else v)
+               for k, v in telemetry.REGISTRY.summary().items() if v}
+    except Exception:  # the measurement must never die on its meters
+        tel = {}
+
     print(json.dumps({
         "metric": "tpu_hist_train_rows_per_sec_per_chip",
         "value": round(rows_per_sec, 1),
@@ -234,6 +248,7 @@ def _worker() -> None:
                    "vs_north_star_25M": round(rows_per_sec / 25e6, 3),
                    "achieved_tflops": round(tflops, 2),
                    "mfu_vs_bf16_peak": round(tflops / peak, 4)},
+        "telemetry": tel,
     }))
 
 

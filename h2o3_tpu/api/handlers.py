@@ -219,6 +219,8 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
         """CloudV3 (api/schemas3/CloudV3.java)."""
         import jax
 
+        from h2o3_tpu.util import telemetry
+
         try:
             devices = [str(d) for d in jax.devices()]
         except Exception:
@@ -231,6 +233,8 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
             "cloud_uptime_millis": int((time.time() - server.start_time) * 1000),
             "consensus": True,
             "locked": True,
+            # compact process-wide totals; the full registry is /3/Metrics
+            "telemetry": telemetry.REGISTRY.summary(),
             "nodes": [
                 {
                     "h2o": f"127.0.0.1:{server.port}",
@@ -1121,7 +1125,9 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
         (water/TimeLine.java:22,75 snapshot semantics)."""
         from h2o3_tpu.util import timeline
 
-        n = int(params.get("count", 1000))
+        # `count` is the documented name; `n` is the short alias thin
+        # clients use (both untested before the telemetry PR)
+        n = int(params.get("count", params.get("n", 1000)))
         return {
             "events": timeline.snapshot(n),
             "total_events": timeline.total_events(),
@@ -1169,6 +1175,28 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
             "user", "nice", "system", "idle", "iowait", "irq", "softirq"
         ], "available": True}
 
+    def metrics_ep(params):
+        """Full registry snapshot as JSON (the quantitative face of
+        /3/Timeline — counts where the timeline has events)."""
+        from h2o3_tpu.util import telemetry
+
+        return {
+            "metrics": telemetry.REGISTRY.snapshot(),
+            "now": int(time.time() * 1000),
+        }
+
+    def metrics_prometheus(params):
+        """Prometheus text exposition v0.0.4 — point a scraper at it."""
+        from h2o3_tpu.util import telemetry
+
+        return (
+            telemetry.REGISTRY.prometheus().encode(),
+            "text/plain; version=0.0.4; charset=utf-8",
+        )
+
+    r.register("GET", "/3/Metrics", metrics_ep, "telemetry registry (JSON)")
+    r.register("GET", "/3/Metrics/prometheus", metrics_prometheus,
+               "telemetry registry (Prometheus text exposition)")
     r.register("GET", "/3/Timeline", timeline_ep, "event timeline")
     r.register("GET", "/3/JStack", jstack, "thread dump")
     r.register("GET", "/3/Logs", logs_ep, "recent log lines")

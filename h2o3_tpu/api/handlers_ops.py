@@ -180,7 +180,10 @@ def register(r: RequestServer, server: H2OServer) -> None:  # noqa: C901
             except Exception as e:  # noqa: BLE001
                 job.fail(e)
 
-        threading.Thread(target=run, daemon=True).start()
+        # named so a scoring thread reads as work in /3/Profiler and
+        # /3/JStack, not as an anonymous Thread-N
+        threading.Thread(
+            target=run, daemon=True, name=f"job-{job.key}").start()
         return {"job": {"key": {"name": job.key}},
                 "predictions_frame": {"name": dest}}
 
@@ -825,11 +828,19 @@ def register(r: RequestServer, server: H2OServer) -> None:  # noqa: C901
     def profiler_ep(params):
         from h2o3_tpu.util import profiler
 
+        # default filter drops ONLY the server's own threads — the accept
+        # loop ("http-accept") and request workers ("http-worker", named by
+        # the handler); application threads, even unnamed ones, stay
+        # visible. exclude="" disables, any other value is a name regex;
+        # the applied filter is echoed so nothing is hidden silently
+        exclude = params.get("exclude", r"^http[-_]")
         return {"nodes": [{
             "node_name": "localhost",
+            "exclude": exclude,
             "profile": profiler.collect(
                 duration_s=float(params.get("duration", 0.25)),
-                depth=int(params.get("depth", 10))),
+                depth=int(params.get("depth", 10)),
+                exclude=exclude or None),
         }]}
 
     def profiler_trace(params):

@@ -27,8 +27,21 @@ import numpy as np
 
 from h2o3_tpu import __version__
 from h2o3_tpu.keyed import DKV
+from h2o3_tpu.util import telemetry
 
 Route = Tuple[str, "re.Pattern[str]", List[str], Callable, str]
+
+#: REST traffic meters. The route label is the registered *pattern*
+#: (/3/Models/{model_id}), never the raw path — raw paths would explode the
+#: label cardinality with every model key ever scored.
+_REST_REQUESTS = telemetry.counter(
+    "rest_requests_total", "REST requests served",
+    labels=("method", "route", "status"),
+)
+_REST_SECONDS = telemetry.histogram(
+    "rest_request_seconds", "REST request wall seconds",
+    labels=("method", "route"),
+)
 
 
 class RestError(Exception):
@@ -42,6 +55,9 @@ class RequestServer:
 
     def __init__(self) -> None:
         self.routes: List[Route] = []
+        #: compiled pattern text -> the original {name} path template; the
+        #: request meters and the docs lint both label routes with this
+        self._templates: Dict[str, str] = {}
 
     def register(self, method: str, path: str, handler: Callable, summary: str = "") -> None:
         """path uses {name} placeholders, e.g. /3/Models/{model_id}."""
@@ -50,16 +66,43 @@ class RequestServer:
             "^" + re.sub(r"\{(\w+)\}", r"(?P<\1>[^/]+)", path) + "$"
         )
         self.routes.append((method.upper(), pattern, names, handler, summary))
+        self._templates[pattern.pattern] = path
 
-    def dispatch(self, method: str, path: str, params: Dict[str, Any]) -> Any:
+    def templates(self) -> List[Tuple[str, str]]:
+        """(method, {name}-template) of every registered route."""
+        return [
+            (m, self._templates.get(p.pattern, p.pattern[1:-1]))
+            for m, p, _names, _handler, _summary in self.routes
+        ]
+
+    def match(
+        self, method: str, path: str
+    ) -> Optional[Tuple[Callable, Dict[str, str], str]]:
+        """(handler, path_kwargs, route_pattern) of the first matching route;
+        the pattern string is the stable low-cardinality label the request
+        meters use."""
         for m, pattern, _names, handler, _ in self.routes:
             if m != method:
                 continue
-            match = pattern.match(path)
-            if match:
-                kw = {k: urllib.parse.unquote(v) for k, v in match.groupdict().items()}
-                return handler(params, **kw)
-        raise RestError(404, f"no route for {method} {path}")
+            mt = pattern.match(path)
+            if mt:
+                kw = {
+                    k: urllib.parse.unquote(v)
+                    for k, v in mt.groupdict().items()
+                }
+                # label with the {name} template the route was registered
+                # under, not the compiled (?P<name>...) regex
+                route = self._templates.get(
+                    pattern.pattern, pattern.pattern[1:-1])
+                return handler, kw, route
+        return None
+
+    def dispatch(self, method: str, path: str, params: Dict[str, Any]) -> Any:
+        found = self.match(method, path)
+        if found is None:
+            raise RestError(404, f"no route for {method} {path}")
+        handler, kw, _route = found
+        return handler(params, **kw)
 
     def endpoints(self) -> List[Dict[str, str]]:
         return [
@@ -152,6 +195,12 @@ class H2OServer:
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "H2OServer":
+        # the /3/Logs ring must be live from the first request, whether or
+        # not any module logged before the server came up (satellite of the
+        # telemetry PR: init() is idempotent, dir comes from H2O3_TPU_LOG_DIR)
+        from h2o3_tpu.util import log as _log
+
+        _log.init()
         registry = self.registry
         srv = self
 
@@ -193,61 +242,89 @@ class H2OServer:
                 return params
 
             def _respond(self, method: str) -> None:
-                from h2o3_tpu.util import timeline
                 from h2o3_tpu.util.log import get_logger
 
+                # claim the default "Thread-N" name for this worker so the
+                # profiler's housekeeping filter ("^http[-_]") can target
+                # server threads precisely without hiding unnamed
+                # application threads that happen to share the default name
+                cur = threading.current_thread()
+                if cur.name.startswith("Thread-"):
+                    cur.name = "http-worker"
                 parsed = urllib.parse.urlparse(self.path)
                 get_logger("rest").info("%s %s", method, parsed.path)
+                # the request meters label by registered route pattern; an
+                # unmatched path collapses into one "(unmatched)" series so
+                # scanners can't mint unbounded label values
+                found = registry.match(method, parsed.path)
+                route = found[2] if found else "(unmatched)"
+                status = 200
+                ctype = "application/json"
+                extra_headers: List[Tuple[str, str]] = []
+                t0 = time.perf_counter()
                 if not srv._check_auth(self.headers.get("Authorization")):
-                    body = json.dumps(
+                    status = 401
+                    payload = json.dumps(
                         {"http_status": 401, "msg": "authentication required"}
                     ).encode()
-                    self.send_response(401)
-                    self.send_header("WWW-Authenticate", 'Basic realm="h2o3-tpu"')
-                    self.send_header("Content-Type", "application/json")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
-                    return
-                try:
-                    with timeline.timed("rest", method=method, path=parsed.path):
-                        out = registry.dispatch(method, parsed.path, self._params())
-                    ctype = "application/octet-stream"
-                    if (
-                        isinstance(out, tuple) and len(out) == 2
-                        and isinstance(out[0], (bytes, bytearray))
-                    ):
-                        out, ctype = out
-                    if isinstance(out, (bytes, bytearray)):
-                        self.send_response(200)
-                        self.send_header("Content-Type", ctype)
-                        self.send_header("Content-Length", str(len(out)))
-                        self.end_headers()
-                        self.wfile.write(out)
-                        return
-                    payload = json.dumps(out, default=_json_default).encode()
-                    self.send_response(200)
-                except RestError as e:
-                    payload = json.dumps(
-                        {  # water/api/schemas3/H2OErrorV3 shape
-                            "http_status": e.status,
-                            "msg": str(e),
-                            "dev_msg": str(e),
-                            "exception_type": "RestError",
-                        }
-                    ).encode()
-                    self.send_response(e.status)
-                except Exception as e:  # noqa: BLE001
-                    payload = json.dumps(
-                        {
-                            "http_status": 500,
-                            "msg": f"{type(e).__name__}: {e}",
-                            "dev_msg": traceback.format_exc(),
-                            "exception_type": type(e).__name__,
-                        }
-                    ).encode()
-                    self.send_response(500)
-                self.send_header("Content-Type", "application/json")
+                    extra_headers.append(
+                        ("WWW-Authenticate", 'Basic realm="h2o3-tpu"'))
+                else:
+                    try:
+                        with telemetry.Span(
+                            "rest", method=method, route=route,
+                            path=parsed.path,
+                        ):
+                            if found is None:
+                                raise RestError(
+                                    404,
+                                    f"no route for {method} {parsed.path}",
+                                )
+                            handler, path_kw, _ = found
+                            out = handler(self._params(), **path_kw)
+                        if (
+                            isinstance(out, tuple) and len(out) == 2
+                            and isinstance(out[0], (bytes, bytearray))
+                        ):
+                            payload, ctype = out
+                        elif isinstance(out, (bytes, bytearray)):
+                            payload, ctype = out, "application/octet-stream"
+                        else:
+                            payload = json.dumps(
+                                out, default=_json_default).encode()
+                    except RestError as e:
+                        status = e.status
+                        payload = json.dumps(
+                            {  # water/api/schemas3/H2OErrorV3 shape
+                                "http_status": e.status,
+                                "msg": str(e),
+                                "dev_msg": str(e),
+                                "exception_type": "RestError",
+                            }
+                        ).encode()
+                        ctype = "application/json"
+                    except Exception as e:  # noqa: BLE001
+                        status = 500
+                        payload = json.dumps(
+                            {
+                                "http_status": 500,
+                                "msg": f"{type(e).__name__}: {e}",
+                                "dev_msg": traceback.format_exc(),
+                                "exception_type": type(e).__name__,
+                            }
+                        ).encode()
+                        ctype = "application/json"
+                # account BEFORE the response flushes: a client that has
+                # read its response can immediately see the request in
+                # /3/Metrics (read-your-writes for the meters)
+                _REST_REQUESTS.inc(
+                    method=method, route=route, status=str(status))
+                _REST_SECONDS.observe(
+                    time.perf_counter() - t0, method=method, route=route)
+                self.send_response(status)
+                for k, v in extra_headers:
+                    self.send_header(k, v)
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(payload)))
                 self.end_headers()
                 self.wfile.write(payload)
@@ -288,7 +365,10 @@ class H2OServer:
                 do_handshake_on_connect=False,
             )
         self.port = self._httpd.server_address[1]
-        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="http-accept",  # matches /3/Profiler's "^http" filter
+        )
         self._thread.start()
         # registry of live in-process servers: lets clients answer "is
         # this endpoint one of ours?" exactly at connect time, instead

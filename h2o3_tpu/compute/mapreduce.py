@@ -19,6 +19,7 @@ Two entry points:
 
 from __future__ import annotations
 
+import time
 from typing import Callable, Dict, Optional, Sequence
 
 import numpy as np
@@ -34,6 +35,46 @@ except ImportError:  # pragma: no cover
 
 from h2o3_tpu.frame.frame import ColType, Frame
 from h2o3_tpu.parallel.mesh import DATA_AXIS, default_mesh, row_mask, shard_rows
+from h2o3_tpu.util import telemetry
+
+#: per-primitive accounting (DrJAX's point for MapReduce-in-JAX: you cannot
+#: place sharded work without counting it) — op is map_reduce | map_batches
+_DISPATCHES = telemetry.counter(
+    "mapreduce_dispatch_total", "MRTask-analogue dispatches", labels=("op",)
+)
+_SHARDS = telemetry.gauge(
+    "mapreduce_shards", "shard count of the most recent dispatch",
+    labels=("op",),
+)
+_WALL = telemetry.histogram(
+    "mapreduce_wall_seconds",
+    "dispatch wall time (trace + compile + execute + device sync)",
+    labels=("op",),
+)
+_JIT_CACHE = telemetry.counter(
+    "mapreduce_jit_cache_total",
+    "XLA compile-cache outcome per dispatch (compile-count delta)",
+    labels=("op", "result"),
+)
+
+
+def _dispatch(op: str, table: "FrameTable", call):
+    """Shared accounting envelope: count + span + jit hit/miss attribution."""
+    telemetry.install_jax_compile_listener()
+    n_shards = int(table.mesh.devices.size)
+    _DISPATCHES.inc(op=op)
+    _SHARDS.set(n_shards, op=op)
+    # thread-local delta: compiles run on the dispatching thread, so this
+    # stays correct when several builds dispatch concurrently
+    compiles_before = telemetry.thread_compile_count()
+    t0 = time.perf_counter()
+    with telemetry.Span("mapreduce", op=op, shards=n_shards,
+                        rows=table.n_valid):
+        out = call()
+    _WALL.observe(time.perf_counter() - t0, op=op)
+    missed = telemetry.thread_compile_count() > compiles_before
+    _JIT_CACHE.inc(op=op, result="miss" if missed else "hit")
+    return out
 
 
 class FrameTable:
@@ -114,7 +155,11 @@ def map_reduce(
         in_specs=(P(DATA_AXIS), P(DATA_AXIS)) + tuple(P() for _ in extra_args),
         out_specs=P(),
     )
-    return jax.jit(mapped)(table.arrays, table.mask, *extra_args)
+    return _dispatch(
+        "map_reduce",
+        table,
+        lambda: jax.jit(mapped)(table.arrays, table.mask, *extra_args),
+    )
 
 
 def map_batches(fn: Callable, table: FrameTable, *extra_args):
@@ -129,7 +174,11 @@ def map_batches(fn: Callable, table: FrameTable, *extra_args):
         in_specs=(P(DATA_AXIS), P(DATA_AXIS)) + tuple(P() for _ in extra_args),
         out_specs=P(DATA_AXIS),
     )
-    return jax.jit(mapped)(table.arrays, table.mask, *extra_args)
+    return _dispatch(
+        "map_batches",
+        table,
+        lambda: jax.jit(mapped)(table.arrays, table.mask, *extra_args),
+    )
 
 
 def gather_rows(x: jax.Array, n_valid: int) -> np.ndarray:

@@ -38,6 +38,19 @@ from h2o3_tpu.frame.parse import (
     _build_column,
     parse_csv,
 )
+from h2o3_tpu.util import telemetry
+
+#: ingest accounting by wire format. Bytes are counted per decompressed
+#: part (parse_bytes runs after decompress_parts), i.e. what the parsers
+#: actually chewed through — NOT the compressed on-the-wire size
+_INGEST_BYTES = telemetry.counter(
+    "ingest_bytes_total", "decompressed bytes parsed per source part",
+    labels=("format",),
+)
+_INGEST_ROWS = telemetry.counter(
+    "ingest_rows_total", "rows materialized per source part",
+    labels=("format",),
+)
 
 
 # ---------------------------------------------------------------------------
@@ -656,25 +669,26 @@ def parse_bytes(
     for part_name, part in decompress_parts(name, data):
         f = fmt or sniff_format(part_name, part)
         if f == "csv":
-            frames.append(
-                parse_csv(part.decode("utf-8", errors="replace"), **csv_kw)
-            )
+            fr = parse_csv(part.decode("utf-8", errors="replace"), **csv_kw)
         elif f == "svmlight":
-            frames.append(parse_svmlight(part.decode("utf-8", errors="replace")))
+            fr = parse_svmlight(part.decode("utf-8", errors="replace"))
         elif f == "arff":
-            frames.append(parse_arff(part.decode("utf-8", errors="replace")))
+            fr = parse_arff(part.decode("utf-8", errors="replace"))
         elif f == "parquet":
-            frames.append(parse_parquet(part))
+            fr = parse_parquet(part)
         elif f == "orc":
-            frames.append(parse_orc(part))
+            fr = parse_orc(part)
         elif f == "avro":
-            frames.append(parse_avro(part))
+            fr = parse_avro(part)
         elif f == "xlsx":
-            frames.append(parse_xlsx(part))
+            fr = parse_xlsx(part)
         elif f == "xls":
-            frames.append(parse_xls_legacy(part))
+            fr = parse_xls_legacy(part)
         else:
             raise ValueError(f"unknown format {f!r}")
+        _INGEST_BYTES.inc(len(part), format=f)
+        _INGEST_ROWS.inc(fr.nrows, format=f)
+        frames.append(fr)
     return rbind_all(frames)
 
 

@@ -26,6 +26,17 @@ from typing import Dict, List, Optional, Sequence, Union
 import numpy as np
 
 from h2o3_tpu.frame.frame import ColType, Column, Frame, NA_CAT
+from h2o3_tpu.util import telemetry
+
+#: parse accounting — every CSV parse (library call, REST /3/Parse, multi-part
+#: archives via ingest.parse_bytes) lands here; labels split the native fast
+#: path from the pure-python tokenizer so the hot path's share is measurable
+_PARSE_ROWS = telemetry.counter(
+    "parse_rows_total", "rows parsed into frames", labels=("parser",)
+)
+_PARSE_SECONDS = telemetry.histogram(
+    "parse_seconds", "wall seconds per CSV parse", labels=("parser",)
+)
 
 #: Default NA tokens (reference: water/parser/ParseSetup + CsvParser NA handling)
 DEFAULT_NA_STRINGS = ("", "NA", "N/A", "na", "n/a", "NaN", "nan", "null", "NULL", "?")
@@ -104,6 +115,9 @@ def parse_csv(
     setup: Optional[ParseSetup] = None,
 ) -> Frame:
     """Parse a CSV file or literal CSV text into a Frame (POST /3/Parse)."""
+    import time as _time
+
+    t0 = _time.perf_counter()
     text = _read_all(src)  # single read; setup guessing reuses it
     if setup is None:
         setup = parse_setup(
@@ -115,6 +129,8 @@ def parse_csv(
         )
     fast = _native_numeric_fast(text, setup)
     if fast is not None:
+        _PARSE_ROWS.inc(fast.nrows, parser="csv_native")
+        _PARSE_SECONDS.observe(_time.perf_counter() - t0, parser="csv_native")
         return fast
     records = _split_records(text)
     if setup.skip_blank_lines:
@@ -132,7 +148,10 @@ def parse_csv(
         _build_column(setup.column_names[j], setup.column_types[j], cells[j], na)
         for j in range(width)
     ]
-    return Frame(cols)
+    fr = Frame(cols)
+    _PARSE_ROWS.inc(fr.nrows, parser="csv")
+    _PARSE_SECONDS.observe(_time.perf_counter() - t0, parser="csv")
+    return fr
 
 
 def column_from_strings(

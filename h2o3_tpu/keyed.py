@@ -16,6 +16,20 @@ import threading
 import uuid
 from typing import Any, Dict, Iterator, List, Optional
 
+from h2o3_tpu.util import telemetry
+
+#: store churn meters — the DKV analogue of the reference's WaterMeter
+#: gauges: size, put/get traffic, and Cleaner spill activity
+_DKV_KEYS = telemetry.gauge("dkv_keys", "objects resident in the keyed store")
+_DKV_PUTS = telemetry.counter("dkv_puts_total", "KeyedStore.put calls")
+_DKV_GETS = telemetry.counter("dkv_gets_total", "KeyedStore.get calls")
+_DKV_REMOVES = telemetry.counter(
+    "dkv_removes_total", "keys dropped from the store (remove/scope sweep)"
+)
+_DKV_SPILLS = telemetry.counter(
+    "dkv_spills_total", "frames spilled to the ice dir by the memory budget"
+)
+
 
 class _SpilledFrame:
     """Disk-resident stand-in for a spilled Frame (the reference Cleaner's
@@ -193,6 +207,7 @@ class KeyedStore:
                             path, nbytes, fr.nrows, fr.ncols, list(fr.names),
                             cls=type(fr),
                         )
+                        _DKV_SPILLS.inc()
                         get_logger("cleaner").info(
                             "spilled frame %s (%.1f MB) to %s",
                             victim, nbytes / 1e6, path,
@@ -255,11 +270,14 @@ class KeyedStore:
             if spillable:
                 self._tick += 1
                 self._access[key] = self._tick
+            _DKV_PUTS.inc()
+            _DKV_KEYS.set(len(self._store))
         if spillable:
             self._maybe_spill()
         return key
 
     def get(self, key: str, default: Any = None) -> Any:
+        _DKV_GETS.inc()
         with self._lock:
             v = self._store.get(key, default)
             if not isinstance(v, _SpilledFrame):
@@ -284,6 +302,9 @@ class KeyedStore:
             self._check_unlocked(key)
             v = self._store.pop(key, None)
             self._drop_value(key, v)
+            if v is not None:
+                _DKV_REMOVES.inc()
+            _DKV_KEYS.set(len(self._store))
 
     def rekey(self, obj: Any, new_key: str) -> str:
         """Re-register ``obj`` (which carries a ``.key`` attribute) under
@@ -299,6 +320,7 @@ class KeyedStore:
             self._store[new_key] = obj
             if self._scopes:
                 self._scopes[-1].append(new_key)
+            _DKV_KEYS.set(len(self._store))
         return new_key
 
     def keys(self) -> List[str]:
@@ -321,7 +343,9 @@ class KeyedStore:
             self._read_locks.clear()
             for k, v in list(self._store.items()):
                 self._drop_value(k, v)
+            _DKV_REMOVES.inc(len(self._store))
             self._store.clear()
+            _DKV_KEYS.set(0)
 
     @staticmethod
     def make_key(prefix: str = "obj") -> str:
@@ -345,6 +369,9 @@ class KeyedStore:
                     continue  # in use by a running job: defer, never yank
                 v = self._store.pop(k, None)
                 self._drop_value(k, v)
+                if v is not None:
+                    _DKV_REMOVES.inc()
+            _DKV_KEYS.set(len(self._store))
 
     def scope(self) -> "_ScopeCtx":
         return _ScopeCtx(self)

@@ -24,6 +24,17 @@ from h2o3_tpu.frame.frame import ColType, Column, Frame
 from h2o3_tpu.keyed import DKV
 from h2o3_tpu.models import metrics as M
 from h2o3_tpu.models.data_info import DataInfo
+from h2o3_tpu.util import telemetry
+
+#: fit accounting: wall seconds per algo (histogram — AutoML fans dozens of
+#: fits through here) + outcome counter; the fit Span makes every timeline
+#: event of a build (train blocks, mapreduce dispatches) share one trace_id
+_FIT_SECONDS = telemetry.histogram(
+    "model_fit_seconds", "model build wall seconds", labels=("algo",)
+)
+_FITS = telemetry.counter(
+    "model_fit_total", "model builds", labels=("algo", "outcome")
+)
 
 
 @dataclass
@@ -302,7 +313,6 @@ class ModelBuilder:
         raise NotImplementedError
 
     def train(self, frame: Frame, valid: Optional[Frame] = None) -> Model:
-        from h2o3_tpu.util import timeline
         from h2o3_tpu.util.log import get_logger
 
         log = get_logger("train")
@@ -327,11 +337,19 @@ class ModelBuilder:
         DKV.scope_enter()
         keep = [self.job.key]
         try:
-            with timeline.timed("train", algo=self.algo_name, rows=frame.nrows):
+            with telemetry.Span(
+                "train", algo=self.algo_name, rows=frame.nrows
+            ) as span:
                 model = self._fit(frame, valid)
                 if self.params.nfolds >= 2 or self.params.fold_column:
                     self._cross_validate(model, frame)
-            model.run_time = time.time() - t0
+                model.run_time = time.time() - t0
+                span.set(train_s=round(model.run_time, 3))
+                iters = getattr(model, "iterations", None)
+                if isinstance(iters, (int, float)):
+                    span.set(iterations=int(iters))
+            _FIT_SECONDS.observe(model.run_time, algo=self.algo_name)
+            _FITS.inc(algo=self.algo_name, outcome="ok")
             self.job.done()
             keep = None  # success: everything the build registered lives
             log.info(
@@ -340,6 +358,7 @@ class ModelBuilder:
             )
             return model
         except BaseException as e:
+            _FITS.inc(algo=self.algo_name, outcome="error")
             self.job.fail(e)
             log.error("%s train failed: %s: %s", self.algo_name, type(e).__name__, e)
             raise

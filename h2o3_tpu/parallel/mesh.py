@@ -26,12 +26,30 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from h2o3_tpu.util import telemetry
+
 #: Name of the batch/data axis — every algo shards rows over this axis (pure DP;
 #: the reference has no TP/PP/SP workloads, SURVEY.md §2.4: its models are
 #: trees/linear/small MLPs and the long axis is *rows*).
 DATA_AXIS = "data"
 
 _default_mesh: Optional[Mesh] = None
+
+#: placement accounting (the WaterMeter analogue for the device tier): how
+#: many devices "the cloud" has, and how much padding the SPMD static-shape
+#: contract costs on every host->mesh transfer
+_MESH_DEVICES = telemetry.gauge(
+    "mesh_devices", "devices in the default data mesh"
+)
+_SHARD_BYTES = telemetry.counter(
+    "shard_bytes_total", "bytes placed row-sharded on the mesh (incl. pad)"
+)
+_SHARD_PAD_ROWS = telemetry.counter(
+    "shard_pad_rows_total", "pad rows added to satisfy static SPMD shapes"
+)
+_SHARD_PAD_BYTES = telemetry.gauge(
+    "shard_last_pad_bytes", "pad bytes of the most recent shard_rows call"
+)
 
 
 def distributed_initialize(**kwargs) -> None:
@@ -68,6 +86,7 @@ def default_mesh(n_devices: Optional[int] = None) -> Mesh:
         return Mesh(np.array(devs), (DATA_AXIS,))
     if _default_mesh is None or len(_default_mesh.devices.flat) != len(devs):
         _default_mesh = Mesh(np.array(devs), (DATA_AXIS,))
+    _MESH_DEVICES.set(len(devs))
     return _default_mesh
 
 
@@ -107,6 +126,12 @@ def shard_rows(
     mesh = mesh or default_mesh()
     nshards = mesh.devices.size
     padded, n = pad_rows(np.asarray(x), nshards, fill)
+    pad_count = padded.shape[0] - n
+    _SHARD_BYTES.inc(padded.nbytes)
+    _SHARD_PAD_ROWS.inc(pad_count)
+    _SHARD_PAD_BYTES.set(
+        pad_count * (padded.nbytes / padded.shape[0]) if padded.shape[0] else 0
+    )
     arr = jax.device_put(padded, row_sharding(mesh, padded.ndim))
     return arr, n
 

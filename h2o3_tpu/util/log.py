@@ -32,7 +32,12 @@ _initialized = False
 class _RingHandler(logging.Handler):
     def emit(self, record: logging.LogRecord) -> None:
         try:
-            _ring.append(self.format(record))
+            line = self.format(record)  # format outside the lock
+            with _lock:
+                # ring access is consistently lock-protected: recent()
+                # copies under _lock, so appends must happen under it too
+                # (list(deque) during a concurrent append is a RuntimeError)
+                _ring.append(line)
         except Exception:  # pragma: no cover - never raise from logging
             pass
 

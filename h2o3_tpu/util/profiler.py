@@ -11,39 +11,60 @@ TPU-native half; SURVEY.md §5 maps ProfileCollector to jax.profiler).
 from __future__ import annotations
 
 import os
+import re
 import sys
 import threading
 import time
 import traceback
 from collections import Counter
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 
 def collect(duration_s: float = 0.25, interval_s: float = 0.005,
-            depth: int = 10) -> List[Dict[str, Any]]:
+            depth: int = 10,
+            exclude: Optional[str] = None) -> List[Dict[str, Any]]:
     """Sample every live thread's stack for ``duration_s``; return
     collapsed stacks sorted by sample count (ProfileCollectorTask's
-    per-node result shape)."""
-    counts: Counter = Counter()
+    per-node result shape).
+
+    ``exclude`` is an optional regex matched against thread *names*:
+    daemon housekeeping threads (the HTTP server accept loop, heartbeat
+    timers) otherwise dominate the collapsed stacks of an idle server.
+    ``pct`` is the share of sampling sweeps in which a stack was seen, so
+    a thread pinned on one line reads 100% regardless of how many other
+    threads were live."""
+    counts: Counter = Counter()  # thread-samples (two threads on one line
+    sweeps: Counter = Counter()  # count twice); sweeps counts presence once
     me = threading.get_ident()
-    end = time.monotonic() + max(duration_s, interval_s)
+    pat = re.compile(exclude) if exclude else None
+    deadline = time.monotonic() + max(duration_s, interval_s)
     n_samples = 0
-    while time.monotonic() < end:
+    while True:
+        names = {t.ident: t.name for t in threading.enumerate()} if pat else {}
+        seen = set()
         for tid, frame in sys._current_frames().items():
             if tid == me:
                 continue  # the profiler thread itself is noise
+            if pat is not None and pat.search(names.get(tid, "")):
+                continue
             stack = traceback.extract_stack(frame)[-depth:]
             sig = ";".join(
                 f"{os.path.basename(f.filename)}:{f.lineno}:{f.name}"
                 for f in stack
             )
             counts[sig] += 1
+            seen.add(sig)
+        for sig in seen:
+            sweeps[sig] += 1
         n_samples += 1
-        time.sleep(interval_s)
-    total = sum(counts.values())
+        # never overshoot duration_s: sleep only the remaining budget
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            break
+        time.sleep(min(interval_s, remaining))
     return [
         {"stacktrace": sig.split(";"), "count": c,
-         "pct": round(100.0 * c / total, 1) if total else 0.0}
+         "pct": round(100.0 * sweeps[sig] / n_samples, 1) if n_samples else 0.0}
         for sig, c in counts.most_common(50)
     ]
 

@@ -1,0 +1,573 @@
+"""Process-wide telemetry: metrics registry + span-correlated tracing.
+
+Reference: H2O-3 ships first-class self-observability — ``/3/Timeline``,
+``/3/Profiler``, ``/3/Logs`` and the WaterMeter CPU/IO gauges (``water/api/
+WaterMeterCpuTicksHandler.java``) — but no *quantitative* layer: nothing in
+the seed counted REST requests, jit compile-cache misses, map_reduce
+dispatches, bytes ingested or store churn.  This module is that layer:
+
+* a lock-protected process-wide :class:`Registry` of :class:`Counter` /
+  :class:`Gauge` / :class:`Histogram` families with labels, snapshot-able as
+  JSON (``GET /3/Metrics``) and as Prometheus text exposition format v0.0.4
+  (``GET /3/Metrics/prometheus``);
+* a :class:`Span` context that threads a ``trace_id``/``parent_id`` through
+  nested work (REST request -> model fit -> map_reduce dispatch) and records
+  enriched events into the existing :mod:`h2o3_tpu.util.timeline` ring, so
+  ``/3/Timeline`` becomes correlatable — every plain ``timeline.record``
+  under an open span inherits the span's trace ids via the trace provider
+  hook installed below;
+* a ``jax.monitoring`` listener that counts XLA backend compiles process-wide
+  (``jit_compiles_total`` / ``jit_compile_seconds_total``), the substrate for
+  per-dispatch jit cache hit/miss accounting in ``compute/mapreduce.py``.
+
+The TPU-native story (SURVEY.md §5): ``jax.profiler`` owns the device-side
+trace; this registry owns the host-side control-plane numbers that DrJAX-style
+per-primitive accounting needs before any hot path can be called "measurably
+faster".
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import threading
+import time
+import uuid
+from typing import (
+    Any,
+    Dict,
+    List,
+    Mapping,
+    Optional,
+    Sequence,
+    Tuple,
+)
+
+from h2o3_tpu.util import timeline
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "REGISTRY",
+    "Span",
+    "counter",
+    "gauge",
+    "histogram",
+    "current_span",
+    "current_trace_id",
+    "install_jax_compile_listener",
+    "jit_compile_count",
+]
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+#: default histogram buckets (seconds-flavored; jit compiles and model fits
+#: span sub-ms REST pings to multi-minute training blocks)
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
+)
+
+
+def _escape_label(v: Any) -> str:
+    """Prometheus label-value escaping: backslash, double-quote, newline."""
+    return (
+        str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def _escape_help(h: str) -> str:
+    return h.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _fmt_value(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    f = float(v)
+    if math.isnan(f):
+        return "NaN"
+    if f.is_integer() and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+class Metric:
+    """One metric family: a name + help + fixed label names, holding one
+    series per distinct label-value tuple. All mutation is lock-protected
+    (REST handler threads, training threads and the compile listener all
+    write concurrently)."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = "", labels: Sequence[str] = ()) -> None:
+        if not _NAME_RE.match(name):
+            raise ValueError(f"bad metric name {name!r}")
+        for ln in labels:
+            if not _LABEL_RE.match(ln):
+                raise ValueError(f"bad label name {ln!r} for metric {name!r}")
+        self.name = name
+        self.help = help
+        self.labelnames: Tuple[str, ...] = tuple(labels)
+        self._lock = threading.Lock()
+        self._series: Dict[Tuple[str, ...], Any] = {}
+
+    def _key(self, labels: Mapping[str, Any]) -> Tuple[str, ...]:
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"metric {self.name!r} takes labels {self.labelnames}, "
+                f"got {tuple(labels)}"
+            )
+        return tuple(str(labels[k]) for k in self.labelnames)
+
+    def _label_str(self, key: Tuple[str, ...]) -> str:
+        if not self.labelnames:
+            return ""
+        pairs = ",".join(
+            f'{n}="{_escape_label(v)}"' for n, v in zip(self.labelnames, key)
+        )
+        return "{" + pairs + "}"
+
+    # -- shared exposition scaffolding --------------------------------------
+    def _header(self) -> List[str]:
+        out = []
+        if self.help:
+            out.append(f"# HELP {self.name} {_escape_help(self.help)}")
+        out.append(f"# TYPE {self.name} {self.kind}")
+        return out
+
+    def expose(self) -> List[str]:
+        raise NotImplementedError
+
+    def snapshot(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+
+class Counter(Metric):
+    """Monotonically increasing count (rest_requests_total, ...)."""
+
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0, **labels: Any) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up")
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + amount
+
+    def value(self, **labels: Any) -> float:
+        key = self._key(labels)
+        with self._lock:
+            return float(self._series.get(key, 0.0))
+
+    def total(self) -> float:
+        """Sum over every label combination (the /3/Cloud summary number)."""
+        with self._lock:
+            return float(sum(self._series.values()))
+
+    def expose(self) -> List[str]:
+        out = self._header()
+        with self._lock:
+            items = sorted(self._series.items())
+        for key, v in items:
+            out.append(f"{self.name}{self._label_str(key)} {_fmt_value(v)}")
+        return out
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            items = sorted(self._series.items())
+        return {
+            "type": self.kind,
+            "help": self.help,
+            "series": [
+                {"labels": dict(zip(self.labelnames, key)), "value": v}
+                for key, v in items
+            ],
+        }
+
+
+class Gauge(Metric):
+    """A value that goes both ways (dkv_keys, mesh_devices, ...)."""
+
+    kind = "gauge"
+
+    def set(self, value: float, **labels: Any) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels: Any) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + amount
+
+    def dec(self, amount: float = 1.0, **labels: Any) -> None:
+        self.inc(-amount, **labels)
+
+    def value(self, **labels: Any) -> float:
+        key = self._key(labels)
+        with self._lock:
+            return float(self._series.get(key, 0.0))
+
+    expose = Counter.expose
+    snapshot = Counter.snapshot
+
+
+class Histogram(Metric):
+    """Cumulative-bucket histogram (model_fit_seconds, rest_request_seconds).
+
+    Exposition follows the Prometheus contract: ``_bucket{le=...}`` lines are
+    cumulative, the ``+Inf`` bucket equals ``_count``, plus ``_sum``."""
+
+    kind = "histogram"
+
+    def __init__(
+        self,
+        name: str,
+        help: str = "",
+        labels: Sequence[str] = (),
+        buckets: Optional[Sequence[float]] = None,
+    ) -> None:
+        super().__init__(name, help, labels)
+        # the +Inf bucket is implicit (it IS _count); an explicit inf here
+        # would double the le="+Inf" exposition line and put a non-JSON
+        # Infinity token into the /3/Metrics payload
+        bs = tuple(sorted(
+            b for b in (buckets if buckets is not None else DEFAULT_BUCKETS)
+            if not math.isinf(b)
+        ))
+        if not bs:
+            raise ValueError("histogram needs at least one finite bucket")
+        self.buckets: Tuple[float, ...] = bs
+
+    def observe(self, value: float, **labels: Any) -> None:
+        key = self._key(labels)
+        v = float(value)
+        with self._lock:
+            st = self._series.get(key)
+            if st is None:
+                st = self._series[key] = {
+                    "buckets": [0] * len(self.buckets), "sum": 0.0, "count": 0,
+                }
+            for i, ub in enumerate(self.buckets):
+                if v <= ub:
+                    st["buckets"][i] += 1
+                    break
+            st["sum"] += v
+            st["count"] += 1
+
+    def count(self, **labels: Any) -> int:
+        key = self._key(labels)
+        with self._lock:
+            st = self._series.get(key)
+            return int(st["count"]) if st else 0
+
+    def total_count(self) -> int:
+        with self._lock:
+            return int(sum(st["count"] for st in self._series.values()))
+
+    def expose(self) -> List[str]:
+        out = self._header()
+        with self._lock:
+            items = sorted(
+                (k, list(st["buckets"]), st["sum"], st["count"])
+                for k, st in self._series.items()
+            )
+        for key, counts, total, n in items:
+            cum = 0
+            for ub, c in zip(self.buckets, counts):
+                cum += c
+                le = dict(zip(self.labelnames, key))
+                pairs = [f'{k}="{_escape_label(v)}"' for k, v in le.items()]
+                pairs.append(f'le="{_fmt_value(ub)}"')
+                out.append(
+                    f"{self.name}_bucket{{{','.join(pairs)}}} {cum}"
+                )
+            pairs = [
+                f'{k}="{_escape_label(v)}"'
+                for k, v in zip(self.labelnames, key)
+            ]
+            pairs_inf = pairs + ['le="+Inf"']
+            out.append(f"{self.name}_bucket{{{','.join(pairs_inf)}}} {n}")
+            suffix = "{" + ",".join(pairs) + "}" if pairs else ""
+            out.append(f"{self.name}_sum{suffix} {_fmt_value(total)}")
+            out.append(f"{self.name}_count{suffix} {n}")
+        return out
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            items = sorted(
+                (k, list(st["buckets"]), st["sum"], st["count"])
+                for k, st in self._series.items()
+            )
+        return {
+            "type": self.kind,
+            "help": self.help,
+            "buckets": list(self.buckets),
+            "series": [
+                {
+                    "labels": dict(zip(self.labelnames, key)),
+                    "bucket_counts": counts,
+                    "sum": total,
+                    "count": n,
+                }
+                for key, counts, total, n in items
+            ],
+        }
+
+
+class Registry:
+    """Process-wide metric catalog. ``counter/gauge/histogram`` are
+    get-or-create: re-registration with matching type+labels returns the
+    existing family (instrumented modules declare their metrics at import
+    time, in any order), a mismatch raises."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, Metric] = {}
+
+    def _get_or_create(self, cls, name: str, help: str,
+                       labels: Sequence[str], **kw) -> Metric:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if type(m) is not cls or m.labelnames != tuple(labels):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{type(m).__name__}{m.labelnames}"
+                    )
+                want = kw.get("buckets")
+                if want is not None and tuple(sorted(
+                    b for b in want if not math.isinf(b)
+                )) != m.buckets:
+                    # silently handing back different buckets would skew
+                    # the second caller's quantiles with no error
+                    raise ValueError(
+                        f"histogram {name!r} already registered with "
+                        f"buckets {m.buckets}"
+                    )
+                return m
+            m = cls(name, help, labels, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help: str = "",
+                labels: Sequence[str] = ()) -> Counter:
+        return self._get_or_create(Counter, name, help, labels)
+
+    def gauge(self, name: str, help: str = "",
+              labels: Sequence[str] = ()) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labels)
+
+    def histogram(self, name: str, help: str = "", labels: Sequence[str] = (),
+                  buckets: Optional[Sequence[float]] = None) -> Histogram:
+        return self._get_or_create(
+            Histogram, name, help, labels, buckets=buckets
+        )
+
+    def get(self, name: str) -> Optional[Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._metrics)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-able view of every family (the /3/Metrics payload)."""
+        with self._lock:
+            metrics = sorted(self._metrics.items())
+        return {name: m.snapshot() for name, m in metrics}
+
+    def prometheus(self) -> str:
+        """Text exposition format v0.0.4 (one family block per metric)."""
+        with self._lock:
+            metrics = sorted(self._metrics.items())
+        lines: List[str] = []
+        for _, m in metrics:
+            lines.extend(m.expose())
+        return "\n".join(lines) + "\n" if lines else ""
+
+    def summary(self) -> Dict[str, float]:
+        """Compact totals for /3/Cloud and the bench artifact: every counter
+        and histogram collapsed over labels, gauges as-is when unlabeled."""
+        with self._lock:
+            metrics = sorted(self._metrics.items())
+        out: Dict[str, float] = {}
+        for name, m in metrics:
+            if isinstance(m, Histogram):
+                out[name + "_count"] = m.total_count()
+            elif isinstance(m, Counter):
+                out[name] = m.total()
+            elif isinstance(m, Gauge) and not m.labelnames:
+                out[name] = m.value()
+        return out
+
+#: The process-wide registry — the analogue of the one WaterMeter per node.
+#: Deliberately no reset(): instrumented modules hold direct references to
+#: their families, so clearing the catalog would split-brain the process
+#: (stale objects still incremented, fresh ones exposed). Tests wanting
+#: isolation construct their own Registry.
+REGISTRY = Registry()
+
+
+def counter(name: str, help: str = "", labels: Sequence[str] = ()) -> Counter:
+    return REGISTRY.counter(name, help, labels)
+
+
+def gauge(name: str, help: str = "", labels: Sequence[str] = ()) -> Gauge:
+    return REGISTRY.gauge(name, help, labels)
+
+
+def histogram(name: str, help: str = "", labels: Sequence[str] = (),
+              buckets: Optional[Sequence[float]] = None) -> Histogram:
+    return REGISTRY.histogram(name, help, labels, buckets)
+
+
+# ---------------------------------------------------------------------------
+# Span-correlated tracing
+
+
+_tls = threading.local()
+
+
+def _span_stack() -> List["Span"]:
+    stack = getattr(_tls, "spans", None)
+    if stack is None:
+        stack = _tls.spans = []
+    return stack
+
+
+def current_span() -> Optional["Span"]:
+    stack = _span_stack()
+    return stack[-1] if stack else None
+
+
+def current_trace_id() -> Optional[str]:
+    sp = current_span()
+    return sp.trace_id if sp else None
+
+
+def _trace_fields() -> Optional[Dict[str, Any]]:
+    """Trace context injected into plain ``timeline.record`` calls made under
+    an open span (the provider hook; the recording code stays span-unaware)."""
+    sp = current_span()
+    if sp is None:
+        return None
+    return {"trace_id": sp.trace_id, "span_id": sp.span_id}
+
+
+timeline.set_trace_provider(_trace_fields)
+
+
+class Span:
+    """Context manager: a unit of traced work.
+
+    The outermost span mints a fresh ``trace_id``; nested spans inherit it and
+    point at their parent via ``parent_id``. On exit one enriched event lands
+    in the timeline ring (kind + duration_ms + ok + ids + fields) — the same
+    shape ``timeline.timed`` wrote, now correlatable across layers. Spans are
+    thread-local: a REST handler thread's trace does not leak into a
+    concurrently training thread."""
+
+    def __init__(self, kind: str, **fields: Any) -> None:
+        self.kind = kind
+        self.fields = dict(fields)
+        self.span_id = uuid.uuid4().hex[:16]
+        self.trace_id: Optional[str] = None
+        self.parent_id: Optional[str] = None
+        self.t0 = 0.0
+
+    def set(self, **fields: Any) -> "Span":
+        """Attach fields discovered mid-span (iterations, rows, ...)."""
+        self.fields.update(fields)
+        return self
+
+    def __enter__(self) -> "Span":
+        parent = current_span()
+        if parent is not None:
+            self.trace_id = parent.trace_id
+            self.parent_id = parent.span_id
+        else:
+            self.trace_id = uuid.uuid4().hex[:16]
+        _span_stack().append(self)
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        duration_ms = round((time.perf_counter() - self.t0) * 1e3, 3)
+        stack = _span_stack()
+        if stack and stack[-1] is self:
+            stack.pop()
+        elif self in stack:  # tolerate exotic unwinding, never corrupt peers
+            stack.remove(self)
+        timeline.record(
+            self.kind,
+            duration_ms=duration_ms,
+            ok=exc_type is None,
+            trace_id=self.trace_id,
+            span_id=self.span_id,
+            parent_id=self.parent_id,
+            **self.fields,
+        )
+
+
+# ---------------------------------------------------------------------------
+# XLA compile accounting (jax.monitoring)
+
+_JIT_COMPILES = counter(
+    "jit_compiles_total",
+    "XLA backend compiles observed process-wide (jax.monitoring)",
+)
+_JIT_COMPILE_SECS = counter(
+    "jit_compile_seconds_total",
+    "total wall seconds spent in XLA backend compiles",
+)
+
+_jit_listener_lock = threading.Lock()
+_jit_listener_installed = False
+#: per-thread compile count: XLA compiles run synchronously on the thread
+#: that triggered them, so a thread-local delta attributes cache misses to
+#: the right dispatch even when builds run concurrently (a global delta
+#: would blame thread A for thread B's compile)
+_tls_compiles = threading.local()
+
+
+def install_jax_compile_listener() -> bool:
+    """Register the process-wide compile listener once; idempotent.
+
+    Returns False when jax (or jax.monitoring) is unavailable — telemetry
+    must never be the reason a host-only code path imports the backend."""
+    global _jit_listener_installed
+    with _jit_listener_lock:
+        if _jit_listener_installed:
+            return True
+        try:
+            from jax import monitoring
+        except Exception:  # pragma: no cover - jax is baked into the image
+            return False
+
+        def _on_duration(name: str, secs: float, **kw: Any) -> None:
+            if name.endswith("backend_compile_duration"):
+                _JIT_COMPILES.inc()
+                _JIT_COMPILE_SECS.inc(secs)
+                _tls_compiles.count = getattr(_tls_compiles, "count", 0) + 1
+
+        monitoring.register_event_duration_secs_listener(_on_duration)
+        _jit_listener_installed = True
+        return True
+
+
+def jit_compile_count() -> float:
+    """Total compiles observed process-wide (the bench/summary number)."""
+    return _JIT_COMPILES.total()
+
+
+def thread_compile_count() -> int:
+    """Compiles observed on the CALLING thread — per-dispatch deltas give
+    correct cache hit/miss attribution under concurrent builds."""
+    return getattr(_tls_compiles, "count", 0)

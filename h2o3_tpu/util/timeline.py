@@ -24,10 +24,30 @@ _lock = threading.Lock()
 _ring: Deque[Dict[str, Any]] = collections.deque(maxlen=CAPACITY)
 _counter = 0
 
+#: Optional trace-context provider (installed by util/telemetry.py): returns
+#: {"trace_id": ..., "span_id": ...} for the calling thread's open span, or
+#: None. Kept as a hook so this module stays import-light and dependency-free.
+_trace_provider = None
+
+
+def set_trace_provider(fn) -> None:
+    """Install a callable returning trace-context fields to merge into every
+    recorded event (telemetry Spans use this to make /3/Timeline
+    correlatable); pass None to uninstall."""
+    global _trace_provider
+    _trace_provider = fn
+
 
 def record(kind: str, **fields: Any) -> None:
     """Append one event; cheap enough for per-block/per-request use."""
     global _counter
+    if _trace_provider is not None and "trace_id" not in fields:
+        try:
+            ctx = _trace_provider()
+        except Exception:  # tracing must never break recording
+            ctx = None
+        if ctx:
+            fields = {**ctx, **fields}
     evt = {"ns": time.time_ns(), "kind": kind, **fields}
     with _lock:
         _counter += 1
@@ -56,6 +76,8 @@ class timed:
 
 
 def snapshot(n: int = 1000) -> List[Dict[str, Any]]:
+    if n <= 0:
+        return []  # [-0:] would be the WHOLE ring, not zero events
     with _lock:
         return list(_ring)[-n:]
 

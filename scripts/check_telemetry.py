@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Lint: observability docs must match the live REST route registry.
+
+Two checks, both cheap enough for tier-1 (CPU-only, no server socket):
+
+1. Every *observability* route registered on the server (anything under the
+   prefixes below) must appear in README.md's "## Observability" route
+   table. A new metrics/logging/profiling route that nobody documented
+   fails the build.
+2. Every algo in ``h2o3_tpu/api/registry.py``'s ``algo_map`` must be
+   servable through the registered ``/3/ModelBuilders/{algo}`` train route
+   — the registry and the route table cannot drift apart.
+
+Exit 0 = in sync; exit 1 prints what is missing.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_HERE)
+sys.path.insert(0, _ROOT)
+
+#: route prefixes that constitute the observability surface
+OBS_PREFIXES = (
+    "/3/Logs",
+    "/3/Timeline",
+    "/3/Metrics",
+    "/3/Profiler",
+    "/3/JStack",
+    "/3/WaterMeterCpuTicks",
+    "/3/Ping",
+)
+
+
+def readme_documented_routes(readme_path: str) -> set:
+    """Route strings out of the Observability section's markdown table."""
+    with open(readme_path) as f:
+        text = f.read()
+    m = re.search(r"^## Observability$(.*?)(?=^## |\Z)", text,
+                  re.MULTILINE | re.DOTALL)
+    if not m:
+        return set()
+    routes = set()
+    for line in m.group(1).splitlines():
+        if not line.startswith("|"):
+            continue
+        cell = line.split("|")[1].strip().strip("`")
+        parts = cell.split()
+        if len(parts) == 2 and parts[0] in ("GET", "POST", "DELETE"):
+            # table escapes | inside parameter hints; the route is parts[1]
+            routes.add((parts[0], parts[1]))
+    return routes
+
+
+def live_routes():
+    """(method, template) pairs off a constructed (not started) server."""
+    from h2o3_tpu.api.server import H2OServer
+
+    return H2OServer(port=0).registry.templates()
+
+
+def main() -> int:
+    failures = []
+
+    routes = live_routes()
+    documented = readme_documented_routes(os.path.join(_ROOT, "README.md"))
+    if not documented:
+        failures.append(
+            "README.md has no '## Observability' route table at all")
+    obs = [
+        (m, t) for m, t in routes
+        if any(t.startswith(p) for p in OBS_PREFIXES)
+    ]
+    for m, t in sorted(obs):
+        if (m, t) not in documented:
+            failures.append(
+                f"observability route {m} {t} is registered but missing "
+                f"from README.md's Observability table"
+            )
+    stale = {
+        (m, t) for m, t in documented
+        if any(t.startswith(p) for p in OBS_PREFIXES)
+        and (m, t) not in set(routes)
+    }
+    for m, t in sorted(stale):
+        failures.append(
+            f"README.md documents {m} {t} but no such route is registered"
+        )
+
+    from h2o3_tpu.api.registry import algo_map
+
+    train_routes = {t for m, t in routes if m == "POST"}
+    if "/3/ModelBuilders/{algo}" not in train_routes:
+        failures.append("train route /3/ModelBuilders/{algo} not registered")
+    else:
+        # every registry algo name must be a clean single path segment,
+        # so the train route's {algo} placeholder can actually match it
+        for algo in algo_map():
+            if not re.match(r"^[a-z0-9_]+$", algo):
+                failures.append(
+                    f"algo {algo!r} in api/registry.py cannot be a "
+                    f"URL path segment of /3/ModelBuilders/{{algo}}"
+                )
+
+    if failures:
+        for f in failures:
+            print(f"check_telemetry: {f}", file=sys.stderr)
+        return 1
+    print(
+        f"check_telemetry: OK — {len(obs)} observability routes documented, "
+        f"{len(algo_map())} algos registered"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,7 +19,12 @@ if "--xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # jax < 0.5.3 has no jax_num_cpu_devices; the XLA_FLAGS
+    # --xla_force_host_platform_device_count set above covers it
+    pass
 
 # NOTE: the persistent compilation cache is deliberately NOT enabled for
 # the CPU test tier: XLA:CPU AOT executables serialized here carry machine

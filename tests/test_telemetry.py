@@ -1,0 +1,534 @@
+"""Telemetry: metrics registry, Prometheus exposition, span-correlated
+tracing, the observability rings, and the /3/Metrics REST surface.
+
+The registry under test in the unit half is a private ``Registry()``
+instance; the REST half reads the process-global ``REGISTRY`` through
+deltas only (the suite's other tests are feeding it concurrently)."""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import threading
+import time
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.util import log as L
+from h2o3_tpu.util import telemetry as T
+from h2o3_tpu.util import timeline
+from h2o3_tpu.util.profiler import collect
+from h2o3_tpu.util.telemetry import Registry
+
+# REST-half tests share server/frame/model keys module-wide; the
+# module-level sweeper reclaims them at module end
+pytestmark = pytest.mark.leaks_keys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# registry unit tests (private Registry instances)
+
+
+class TestCounters:
+    def test_inc_and_value(self):
+        r = Registry()
+        c = r.counter("requests_total", "reqs", labels=("route",))
+        c.inc(route="/a")
+        c.inc(2, route="/a")
+        c.inc(route="/b")
+        assert c.value(route="/a") == 3
+        assert c.value(route="/b") == 1
+        assert c.total() == 4
+
+    def test_label_mismatch_raises(self):
+        r = Registry()
+        c = r.counter("x_total", labels=("a",))
+        with pytest.raises(ValueError):
+            c.inc(b=1)
+        with pytest.raises(ValueError):
+            c.inc()  # missing label
+
+    def test_counters_only_go_up(self):
+        r = Registry()
+        c = r.counter("y_total")
+        with pytest.raises(ValueError):
+            c.inc(-1)
+
+    def test_get_or_create_and_type_clash(self):
+        r = Registry()
+        c1 = r.counter("same", "h", labels=("l",))
+        c2 = r.counter("same", "h", labels=("l",))
+        assert c1 is c2
+        with pytest.raises(ValueError):
+            r.gauge("same")  # type clash
+        with pytest.raises(ValueError):
+            r.counter("same", labels=("other",))  # label clash
+
+    def test_histogram_bucket_clash(self):
+        r = Registry()
+        h = r.histogram("h_seconds", buckets=(0.1, 1.0))
+        assert r.histogram("h_seconds") is h  # default buckets = inherit
+        assert r.histogram("h_seconds", buckets=(0.1, 1.0)) is h
+        with pytest.raises(ValueError):
+            r.histogram("h_seconds", buckets=(10.0, 60.0))
+
+    def test_bad_names_rejected(self):
+        r = Registry()
+        with pytest.raises(ValueError):
+            r.counter("bad-name")
+        with pytest.raises(ValueError):
+            r.counter("ok_total", labels=("bad-label",))
+
+
+class TestGaugesAndHistograms:
+    def test_gauge_set_inc_dec(self):
+        r = Registry()
+        g = r.gauge("keys")
+        g.set(10)
+        g.inc(5)
+        g.dec(3)
+        assert g.value() == 12
+
+    def test_histogram_buckets_cumulative(self):
+        r = Registry()
+        h = r.histogram("lat_seconds", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+            h.observe(v)
+        snap = h.snapshot()["series"][0]
+        assert snap["count"] == 5
+        assert snap["bucket_counts"] == [1, 2, 1]  # per-bucket, 50.0 overflows
+        assert snap["sum"] == pytest.approx(56.05)
+
+    def test_histogram_count_by_label(self):
+        r = Registry()
+        h = r.histogram("fit_seconds", labels=("algo",), buckets=(1.0,))
+        h.observe(0.5, algo="gbm")
+        h.observe(2.5, algo="gbm")
+        assert h.count(algo="gbm") == 2
+        assert h.count(algo="glm") == 0
+        assert h.total_count() == 2
+
+
+#: one exposition line: name{labels} value  (labels optional)
+_SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'            # metric name
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'  # first label
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*\})?'  # more labels
+    r' (-?[0-9.e+-]+|\+Inf|-Inf|NaN)$'
+)
+
+
+def assert_valid_exposition(text: str) -> None:
+    """Line-check Prometheus text exposition v0.0.4."""
+    assert text.endswith("\n")
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            parts = line.split(" ", 3)
+            assert re.match(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$", parts[2]), line
+            if line.startswith("# TYPE "):
+                assert parts[3] in ("counter", "gauge", "histogram"), line
+            continue
+        assert _SAMPLE_RE.match(line), f"bad exposition line: {line!r}"
+
+
+class TestPrometheusExposition:
+    def test_help_type_and_samples(self):
+        r = Registry()
+        r.counter("reqs_total", "requests served", labels=("route",)).inc(
+            route="/3/Cloud")
+        r.gauge("keys", "store size").set(7)
+        r.histogram("lat_seconds", "latency", buckets=(0.1, 1.0)).observe(0.5)
+        text = r.prometheus()
+        assert_valid_exposition(text)
+        assert "# HELP reqs_total requests served" in text
+        assert "# TYPE reqs_total counter" in text
+        assert "# TYPE keys gauge" in text
+        assert "# TYPE lat_seconds histogram" in text
+        assert 'reqs_total{route="/3/Cloud"} 1' in text
+        assert "keys 7" in text
+
+    def test_label_escaping(self):
+        r = Registry()
+        c = r.counter("odd_total", labels=("p",))
+        c.inc(p='we"ird\\path\nline')
+        text = r.prometheus()
+        assert_valid_exposition(text)
+        assert r'odd_total{p="we\"ird\\path\nline"} 1' in text
+
+    def test_histogram_contract(self):
+        r = Registry()
+        h = r.histogram("h_seconds", buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 99.0):
+            h.observe(v)
+        text = r.prometheus()
+        assert_valid_exposition(text)
+        # cumulative buckets; +Inf bucket equals _count
+        assert 'h_seconds_bucket{le="0.1"} 1' in text
+        assert 'h_seconds_bucket{le="1"} 2' in text
+        assert 'h_seconds_bucket{le="+Inf"} 3' in text
+        assert "h_seconds_count 3" in text
+        assert "h_seconds_sum 99.55" in text
+
+    def test_empty_registry_is_empty_text(self):
+        assert Registry().prometheus() == ""
+
+    def test_json_snapshot_is_json_able(self):
+        r = Registry()
+        r.counter("a_total", labels=("x",)).inc(x="1")
+        r.histogram("b_seconds", buckets=(1.0,)).observe(0.5)
+        json.dumps(r.snapshot())  # must not raise
+
+    def test_summary_collapses_labels(self):
+        r = Registry()
+        c = r.counter("c_total", labels=("x",))
+        c.inc(3, x="a")
+        c.inc(4, x="b")
+        r.histogram("d_seconds", buckets=(1.0,)).observe(0.1)
+        s = r.summary()
+        assert s["c_total"] == 7
+        assert s["d_seconds_count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# spans + timeline correlation
+
+
+class TestSpans:
+    def test_nesting_threads_trace_and_parent(self):
+        with T.Span("outer") as outer:
+            assert T.current_trace_id() == outer.trace_id
+            with T.Span("inner") as inner:
+                assert inner.trace_id == outer.trace_id
+                assert inner.parent_id == outer.span_id
+        assert T.current_span() is None
+
+    def test_span_records_enriched_timeline_event(self):
+        before = timeline.total_events()
+        with T.Span("unit_span", tag="x") as sp:
+            pass
+        evts = [e for e in timeline.snapshot(50)
+                if e.get("kind") == "unit_span" and e.get("seq", 0) > before]
+        assert len(evts) == 1
+        e = evts[0]
+        assert e["trace_id"] == sp.trace_id
+        assert e["span_id"] == sp.span_id
+        assert e["parent_id"] is None
+        assert e["ok"] is True
+        assert e["tag"] == "x"
+        assert e["duration_ms"] >= 0
+
+    def test_plain_record_under_span_inherits_trace(self):
+        with T.Span("enclosing") as sp:
+            timeline.record("plain_evt", foo=1)
+        evts = [e for e in timeline.snapshot(50)
+                if e.get("kind") == "plain_evt"]
+        assert evts and evts[-1]["trace_id"] == sp.trace_id
+
+    def test_exception_marks_not_ok(self):
+        with pytest.raises(RuntimeError):
+            with T.Span("boom_span"):
+                raise RuntimeError("x")
+        evts = [e for e in timeline.snapshot(50)
+                if e.get("kind") == "boom_span"]
+        assert evts and evts[-1]["ok"] is False
+
+    def test_spans_are_thread_local(self):
+        seen = {}
+
+        def other():
+            seen["trace"] = T.current_trace_id()
+
+        with T.Span("main_span"):
+            t = threading.Thread(target=other)
+            t.start()
+            t.join()
+        assert seen["trace"] is None
+
+
+# ---------------------------------------------------------------------------
+# the rings (satellite: previously-untested timeline/log paths)
+
+
+class TestTimelineRing:
+    def test_clear_total_and_rollover(self):
+        timeline.clear()
+        assert timeline.total_events() == 0
+        for i in range(timeline.CAPACITY + 10):
+            timeline.record("spin", i=i)
+        # the counter keeps counting past capacity; the ring holds CAPACITY
+        assert timeline.total_events() == timeline.CAPACITY + 10
+        snap = timeline.snapshot(timeline.CAPACITY * 2)
+        assert len(snap) == timeline.CAPACITY
+        # oldest events rolled off; the newest survived, in order
+        assert snap[0]["i"] == 10
+        assert snap[-1]["i"] == timeline.CAPACITY + 9
+        seqs = [e["seq"] for e in snap]
+        assert seqs == sorted(seqs)
+        timeline.clear()
+        assert timeline.total_events() == 0
+        assert timeline.snapshot() == []
+
+    def test_snapshot_n_limits(self):
+        timeline.clear()
+        for i in range(20):
+            timeline.record("evt", i=i)
+        assert len(timeline.snapshot(5)) == 5
+        assert [e["i"] for e in timeline.snapshot(3)] == [17, 18, 19]
+        # 0/negative must mean "no events", not "[-0:] is everything"
+        assert timeline.snapshot(0) == []
+        assert timeline.snapshot(-5) == []
+        timeline.clear()
+
+
+class TestLogRing:
+    def test_recent_ordering_and_limit(self):
+        logger = L.get_logger("telemetry_test")
+        marks = [f"ring-order-{i}" for i in range(5)]
+        for m in marks:
+            logger.info(m)
+        lines = L.recent(1000)
+        idx = [next(i for i, ln in enumerate(lines) if m in ln) for m in marks]
+        assert idx == sorted(idx), "ring must preserve emit order"
+        assert any(marks[-1] in ln for ln in L.recent(1))
+
+    def test_concurrent_emit_and_recent(self):
+        # the satellite fix: recent() copies under the same lock emit
+        # appends under — hammer both concurrently and expect no error
+        logger = L.get_logger("telemetry_race")
+        errs = []
+
+        def writer():
+            try:
+                for i in range(300):
+                    logger.info("race %d", i)
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        def reader():
+            try:
+                for _ in range(300):
+                    L.recent(50)
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=f)
+                   for f in (writer, writer, reader, reader)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+
+
+class TestProfiler:
+    def test_duration_not_overshot(self):
+        t0 = time.monotonic()
+        collect(duration_s=0.2, interval_s=0.05)
+        # pre-fix the tail sleep overshot by a full interval every time
+        assert time.monotonic() - t0 < 0.2 + 0.1
+
+    def test_exclude_thread_name_filter(self):
+        stop = threading.Event()
+
+        def busy():
+            while not stop.is_set():
+                sum(range(1000))
+
+        t = threading.Thread(target=busy, name="noisy-housekeeper",
+                             daemon=True)
+        t.start()
+        try:
+            with_noise = collect(duration_s=0.15, interval_s=0.01)
+            filtered = collect(duration_s=0.15, interval_s=0.01,
+                               exclude=r"^noisy-")
+        finally:
+            stop.set()
+            t.join()
+        flat = lambda prof: ";".join(
+            ";".join(s["stacktrace"]) for s in prof)  # noqa: E731
+        assert "busy" in flat(with_noise)
+        assert "busy" not in flat(filtered)
+
+    def test_pct_uses_sample_count(self):
+        stop = threading.Event()
+
+        def busy():
+            while not stop.is_set():
+                time.sleep(0.001)
+
+        t = threading.Thread(target=busy, name="pct-probe", daemon=True)
+        t.start()
+        try:
+            prof = collect(duration_s=0.15, interval_s=0.01)
+        finally:
+            stop.set()
+            t.join()
+        assert prof, "at least one stack must be sampled"
+        # pct is per-sweep share: no single stack can exceed 100
+        assert all(0 <= s["pct"] <= 100.0 for s in prof)
+
+
+# ---------------------------------------------------------------------------
+# REST surface + end-to-end acceptance
+
+
+@pytest.fixture(scope="module")
+def server():
+    from h2o3_tpu.api import start_server
+
+    s = start_server(port=0)
+    yield s
+    s.stop()
+
+
+def _req(server, method, path, data=None, raw=False):
+    body = json.dumps(data).encode() if data is not None else None
+    headers = {"Content-Type": "application/json"} if data else {}
+    req = urllib.request.Request(
+        server.url + path, data=body, headers=headers, method=method)
+    try:
+        with urllib.request.urlopen(req) as resp:
+            payload = resp.read()
+            return resp.status, payload if raw else json.loads(payload)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+CSV = "x,y\n" + "\n".join(f"{i % 7},{(i * 3) % 5}" for i in range(64)) + "\n"
+
+
+class TestMetricsOverRest:
+    def test_acceptance_end_to_end(self, server):
+        """ISSUE acceptance: one REST request + one map_reduce + one fit ->
+        nonzero rest_requests_total / mapreduce_dispatch_total / a
+        model_fit_seconds observation, and the fit's timeline events share
+        one trace_id."""
+        import jax.numpy as jnp
+
+        from h2o3_tpu.compute.mapreduce import FrameTable, map_reduce
+        from h2o3_tpu.keyed import DKV
+
+        st, up = _req(server, "POST", "/3/PostFile", {"data": CSV})
+        assert st == 200
+        st, _ = _req(server, "POST", "/3/Parse", {
+            "source_frames": [up["destination_frame"]],
+            "destination_frame": "tele.hex"})
+        assert st == 200
+        st, out = _req(server, "POST", "/3/ModelBuilders/glm",
+                       {"training_frame": "tele.hex", "response_column": "y"})
+        assert st == 200, out
+
+        tbl = FrameTable.from_frame(DKV.get("tele.hex"))
+        map_reduce(
+            lambda cols, mask: jnp.sum(jnp.where(mask, cols["x"], 0.0)), tbl)
+
+        st, m = _req(server, "GET", "/3/Metrics")
+        assert st == 200
+        metrics = m["metrics"]
+        rest_total = sum(
+            s["value"] for s in metrics["rest_requests_total"]["series"])
+        assert rest_total > 0
+        mr_total = sum(
+            s["value"] for s in metrics["mapreduce_dispatch_total"]["series"])
+        assert mr_total > 0
+        fit_series = metrics["model_fit_seconds"]["series"]
+        assert any(s["labels"]["algo"] == "glm" and s["count"] > 0
+                   for s in fit_series)
+        # the jit cache meter attributed every dispatch one way or the other
+        jit_series = metrics["mapreduce_jit_cache_total"]["series"]
+        assert sum(s["value"] for s in jit_series) >= mr_total
+
+        # trace correlation: the glm train event and its enclosing REST
+        # request event carry the same trace_id
+        st, tl = _req(server, "GET", "/3/Timeline?count=5000")
+        assert st == 200
+        trains = [e for e in tl["events"]
+                  if e.get("kind") == "train" and e.get("algo") == "glm"]
+        assert trains, "fit must land a train event in the timeline"
+        evt = trains[-1]
+        assert evt.get("trace_id")
+        shared = [e["kind"] for e in tl["events"]
+                  if e.get("trace_id") == evt["trace_id"]]
+        assert "rest" in shared and "train" in shared
+
+    def test_prometheus_exposition_is_valid(self, server):
+        st, body = _req(server, "GET", "/3/Metrics/prometheus", raw=True)
+        assert st == 200
+        text = body.decode()
+        assert_valid_exposition(text)
+        assert "# TYPE rest_requests_total counter" in text
+        assert "# TYPE model_fit_seconds histogram" in text
+        # a scrape is accounted before its response flushes, so the SECOND
+        # scrape must carry the first one's route label
+        st, body2 = _req(server, "GET", "/3/Metrics/prometheus", raw=True)
+        assert re.search(
+            r'rest_requests_total\{[^}]*route="/3/Metrics/prometheus"[^}]*\} '
+            r"[1-9]", body2.decode())
+        # histograms expose the full contract
+        assert re.search(r'model_fit_seconds_bucket\{[^}]*le="\+Inf"\} \d+',
+                         text)
+        assert re.search(r"model_fit_seconds_count(\{[^}]*\})? \d+", text)
+
+    def test_metrics_route_labels_are_templates(self, server):
+        # hit a parameterized route, then confirm the label is the {name}
+        # template, not the raw path (cardinality control)
+        _req(server, "GET", "/3/Frames/no_such_frame_xyz")
+        st, m = _req(server, "GET", "/3/Metrics")
+        routes = {s["labels"]["route"]
+                  for s in m["metrics"]["rest_requests_total"]["series"]}
+        assert "/3/Frames/{frame_id}" in routes
+        assert all("no_such_frame_xyz" not in r for r in routes)
+
+    def test_unmatched_path_collapses(self, server):
+        _req(server, "GET", "/3/TotallyNot/a/route")
+        st, m = _req(server, "GET", "/3/Metrics")
+        routes = {s["labels"]["route"]
+                  for s in m["metrics"]["rest_requests_total"]["series"]}
+        assert "(unmatched)" in routes
+        assert all("TotallyNot" not in r for r in routes)
+
+    def test_cloud_carries_telemetry_summary(self, server):
+        st, out = _req(server, "GET", "/3/Cloud")
+        assert st == 200
+        tel = out["telemetry"]
+        assert tel["rest_requests_total"] > 0
+        assert "dkv_keys" in tel and "jit_compiles_total" in tel
+
+    def test_timeline_count_and_n_params(self, server):
+        for i in range(12):
+            timeline.record("param_probe", i=i)
+        st, out = _req(server, "GET", "/3/Timeline?count=5")
+        assert st == 200 and len(out["events"]) == 5
+        st, out = _req(server, "GET", "/3/Timeline?n=3")
+        assert st == 200 and len(out["events"]) == 3
+        # count wins when both are passed (count is the documented name)
+        st, out = _req(server, "GET", "/3/Timeline?count=4&n=9")
+        assert st == 200 and len(out["events"]) == 4
+        assert out["total_events"] >= 12
+
+    def test_logs_ring_live_from_startup(self, server):
+        # server.start() ran log.init(): REST traffic logs must be in the
+        # ring without any client having touched the log module first
+        st, out = _req(server, "GET", "/3/Logs?count=10000")
+        assert st == 200
+        assert any("GET /3/" in ln for ln in out["lines"])
+
+
+class TestCheckTelemetryScript:
+    def test_lint_passes(self):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_ROOT, "scripts",
+                                          "check_telemetry.py")],
+            capture_output=True, text=True, timeout=240,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "OK" in proc.stdout
