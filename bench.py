@@ -54,7 +54,8 @@ _CACHE_ENV = {
 # SIGSEGV at load (tests/conftest.py documents the hazard).  Removal (not
 # just skipping the setdefault) so an externally exported cache dir can't
 # reach CPU children either.
-if os.environ.get("BENCH_FORCE_CPU"):
+if os.environ.get("BENCH_FORCE_CPU") or "--cache-bench" in sys.argv:
+    # --cache-bench is CPU-only by construction: same hazard
     for _k in _CACHE_ENV:
         os.environ.pop(_k, None)
 else:
@@ -252,6 +253,101 @@ def _worker() -> None:
     }))
 
 
+def _cache_bench() -> None:
+    """CPU-runnable warm-vs-cold devcache microbench (PR 3 acceptance).
+
+    N repeat fits of GLM+GBM on ONE frame plus repeat map_reduce
+    dispatches; reports upload bytes and dispatch/fit wall cold vs warm,
+    and the ``mapreduce_jit_cache_total`` hit ratio. Prints ONE JSON line.
+    Runs entirely on the host CPU backend — the caching win is provable
+    without TPU access (`python bench.py --cache-bench`).
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from h2o3_tpu.frame.frame import Frame
+    from h2o3_tpu.compute.mapreduce import FrameTable, map_reduce
+    from h2o3_tpu.models.glm import GLM
+    from h2o3_tpu.models.tree.gbm import GBM
+    from h2o3_tpu.util import telemetry
+
+    telemetry.install_jax_compile_listener()
+    n_rows = int(os.environ.get("BENCH_CACHE_ROWS", 100_000))
+    n_fits = int(os.environ.get("BENCH_CACHE_FITS", 3))
+
+    import numpy as np
+    X, y = synth_higgs(n_rows, n_feat=8)
+    fr = Frame.from_dict(
+        {f"f{i}": X[:, i].astype(np.float64) for i in range(X.shape[1])}
+        | {"y": y}
+    )
+    shard_bytes = telemetry.REGISTRY.get("shard_bytes_total")
+    jit_cache = telemetry.REGISTRY.get("mapreduce_jit_cache_total")
+
+    def fit_once():
+        t0 = time.time()
+        GLM(response_column="y", family="binomial", lambda_=0.0).train(fr)
+        GBM(response_column="y", ntrees=3, max_depth=3, seed=0).train(fr)
+        return time.time() - t0
+
+    def dispatch_once():
+        tbl = FrameTable.from_frame(fr, columns=["f0", "f1"])
+        t0 = time.time()
+        map_reduce(
+            _cache_bench_stat, tbl)
+        return time.time() - t0
+
+    b0 = shard_bytes.total()
+    cold_fit = fit_once()
+    cold_dispatch = dispatch_once()
+    cold_bytes = shard_bytes.total() - b0
+
+    warm_fit, warm_dispatch, warm_bytes = [], [], []
+    for _ in range(max(1, n_fits - 1)):
+        b1 = shard_bytes.total()
+        warm_fit.append(fit_once())
+        warm_dispatch.append(dispatch_once())
+        warm_bytes.append(shard_bytes.total() - b1)
+
+    hits = sum(
+        s["value"] for s in jit_cache.snapshot()["series"]
+        if s["labels"]["result"] == "hit"
+    )
+    total = sum(s["value"] for s in jit_cache.snapshot()["series"])
+    summary = {
+        k: v for k, v in telemetry.REGISTRY.summary().items()
+        if k.startswith(("devcache", "mapreduce", "shard"))
+    }
+    print(json.dumps({
+        "metric": "devcache_warm_vs_cold",
+        "unit": "seconds / bytes (lower warm is the win)",
+        "n_rows": n_rows,
+        "fits_per_phase": "1 GLM + 1 GBM",
+        "cold": {"fit_s": round(cold_fit, 3),
+                 "dispatch_s": round(cold_dispatch, 4),
+                 "upload_bytes": cold_bytes},
+        "warm": {"fit_s": round(min(warm_fit), 3),
+                 "dispatch_s": round(min(warm_dispatch), 4),
+                 "upload_bytes": max(warm_bytes)},
+        "warm_beats_cold": bool(
+            min(warm_fit) < cold_fit
+            and min(warm_dispatch) < cold_dispatch
+            and max(warm_bytes) < cold_bytes
+        ),
+        "jit_cache_hit_ratio": round(hits / total, 3) if total else None,
+        "telemetry": summary,
+    }))
+
+
+def _cache_bench_stat(cols, mask):
+    """Module-level map fn so repeat dispatches share one plan-cache key."""
+    import jax.numpy as jnp
+
+    return jnp.sum(jnp.where(mask, cols["f0"] * cols["f1"], 0.0))
+
+
 def _run_child(arg: str, timeout: int, extra_env=None):
     """Run this file with `arg` in a subprocess under a hard timeout.
 
@@ -359,5 +455,7 @@ if __name__ == "__main__":
         _probe()
     elif "--worker" in sys.argv:
         _worker()
+    elif "--cache-bench" in sys.argv:
+        _cache_bench()
     else:
         main()

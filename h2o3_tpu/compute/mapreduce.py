@@ -15,12 +15,26 @@ Two entry points:
   * ``map_reduce(fn, table)``   — fn: (cols, mask) -> pytree of partials; psum'd.
   * ``map_batches(fn, table)``  — fn: (cols, mask) -> per-row outputs; stays sharded
     (the analogue of an MRTask producing NewChunks / outputFrame).
+
+Caching (the DrJAX accounting gap, PAPERS.md): repeat dispatches must not
+pay trace+compile again, and repeat placements must not pay host->mesh
+transfer again. Two levels close it:
+  * a *dispatch plan cache* memoizes the jitted ``shard_map`` program keyed
+    on (fn identity, reduce op, mesh, argument shapes/dtypes/treedef) —
+    re-dispatching the same fn over same-shaped data reuses the compiled
+    executable instead of rebuilding ``jax.jit(mapped)`` per call;
+  * ``FrameTable.from_frame`` memoizes the whole device placement in the
+    process-wide :data:`h2o3_tpu.frame.devcache.DEVCACHE`, keyed on column
+    version stamps, and ``matrix()`` caches its stacked design matrix.
 """
 
 from __future__ import annotations
 
+import os
+import threading
 import time
-from typing import Callable, Dict, Optional, Sequence
+from collections import OrderedDict
+from typing import Callable, Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -33,6 +47,12 @@ try:  # JAX >= 0.6 top-level API, older fallback
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
+from h2o3_tpu.frame.devcache import (
+    DEVCACHE,
+    REQUESTS as _DEVCACHE_REQUESTS,
+    frame_token,
+    mesh_fingerprint,
+)
 from h2o3_tpu.frame.frame import ColType, Frame
 from h2o3_tpu.parallel.mesh import DATA_AXIS, default_mesh, row_mask, shard_rows
 from h2o3_tpu.util import telemetry
@@ -56,6 +76,93 @@ _JIT_CACHE = telemetry.counter(
     "XLA compile-cache outcome per dispatch (compile-count delta)",
     labels=("op", "result"),
 )
+_PLAN_CACHE = telemetry.counter(
+    "mapreduce_plan_cache_total",
+    "compiled shard_map plan reuse per dispatch",
+    labels=("op", "result"),
+)
+_PLAN_EVICTIONS = telemetry.counter(
+    "mapreduce_plan_evictions_total",
+    "dispatch plans dropped from the LRU plan cache",
+)
+
+
+# ---------------------------------------------------------------------------
+# dispatch plan cache: (fn, reduce, mesh, arg signature) -> jitted program
+
+
+def _plan_cache_size() -> int:
+    try:
+        return max(1, int(os.environ.get("H2O3_TPU_PLAN_CACHE_SIZE", 128)))
+    except ValueError:
+        return 128
+
+
+_plans: "OrderedDict[Tuple, Callable]" = OrderedDict()
+_plans_lock = threading.Lock()
+
+
+def _leaf_sig(x) -> Tuple:
+    """Hashable trace signature of one argument leaf: arrays by
+    shape+dtype (jit programs depend on avals, not values), python
+    scalars by type (weak-typed scalars trace identically per type)."""
+    if hasattr(x, "shape") and hasattr(x, "dtype"):
+        return ("arr", tuple(x.shape), str(x.dtype))
+    return ("py", type(x).__name__)
+
+
+def _plan_key(op: str, fn: Callable, reduce: str, table: "FrameTable",
+              extra_args: tuple) -> Optional[Tuple]:
+    """Cache key for the jitted shard_map program, or None when the
+    dispatch is uncacheable (unhashable fn). The entry holds ``fn``
+    strongly, so a key can never alias a dead function's identity.
+
+    Deliberately NOT weakref-keyed: the cached plan closes over ``fn``
+    (shard_fn wraps it for retracing), so a weak key could never fire —
+    the entry itself is what keeps fn alive. The cost is that up to
+    H2O3_TPU_PLAN_CACHE_SIZE callables (+ captured closures) stay pinned
+    until LRU-evicted; callers dispatching per-call closures over large
+    captured arrays should prefer passing those arrays as extra_args."""
+    leaves, treedef = jax.tree.flatten(tuple(extra_args))
+    key = (
+        op, fn, reduce, table.mesh,
+        tuple((k, tuple(v.shape), str(v.dtype))
+              for k, v in sorted(table.arrays.items())),
+        _leaf_sig(table.mask),
+        treedef,
+        tuple(_leaf_sig(leaf) for leaf in leaves),
+    )
+    try:
+        hash(key)
+    except TypeError:
+        return None
+    return key
+
+
+def _get_plan(op: str, fn: Callable, reduce: str, table: "FrameTable",
+              extra_args: tuple, build: Callable[[], Callable]) -> Callable:
+    key = _plan_key(op, fn, reduce, table, extra_args)
+    if key is None:
+        _PLAN_CACHE.inc(op=op, result="uncacheable")
+        return build()
+    with _plans_lock:
+        plan = _plans.get(key)
+        if plan is not None:
+            _plans.move_to_end(key)
+            _PLAN_CACHE.inc(op=op, result="hit")
+            return plan
+    _PLAN_CACHE.inc(op=op, result="miss")
+    plan = build()
+    with _plans_lock:
+        existing = _plans.get(key)
+        if existing is not None:
+            return existing  # lost a build race: converge on one program
+        _plans[key] = plan
+        limit = _plan_cache_size()
+        while len(_plans) > limit:
+            _plans.popitem(last=False)
+            _PLAN_EVICTIONS.inc()
+    return plan
 
 
 def _dispatch(op: str, table: "FrameTable", call):
@@ -96,6 +203,13 @@ class FrameTable:
         self.mask = mask
         self.n_valid = n_valid
         self.mesh = mesh
+        # cached tables are process-shared: concurrent first matrix() calls
+        # must not double-build (and double byte-account) the stack
+        self._matrix_lock = threading.Lock()
+        self._matrix_cache: Dict[Tuple[str, ...], jax.Array] = {}
+        #: devcache key when this table is cache-resident — stacked
+        #: matrices built on it are byte-attributed to that entry
+        self._devcache_key: Optional[Tuple] = None
 
     @staticmethod
     def from_frame(
@@ -103,32 +217,71 @@ class FrameTable:
         columns: Optional[Sequence[str]] = None,
         mesh: Optional[Mesh] = None,
         dtype=jnp.float32,
+        cache: bool = True,
     ) -> "FrameTable":
+        """Device-resident view of ``frame``, memoized process-wide.
+
+        Placement is cached in :data:`~h2o3_tpu.frame.devcache.DEVCACHE`
+        keyed on (column versions, dtype, mesh), so repeat calls on an
+        unmutated frame return the SAME resident table — no re-upload, no
+        new ``shard_bytes_total``. ``cache=False`` forces a fresh upload."""
         mesh = mesh or default_mesh()
+        np_dtype = np.dtype(dtype)  # normalize jnp/np scalar types once
         names = list(columns) if columns is not None else [
             c.name for c in frame.columns if c.type not in (ColType.STR, ColType.UUID)
         ]
         if not names:
             raise ValueError("no device-shardable (numeric/categorical/time) columns")
-        arrays: Dict[str, jax.Array] = {}
-        n = frame.nrows
-        for name in names:
-            col = frame.col(name)
-            host = col.numeric_view().astype(np.dtype(dtype.dtype if hasattr(dtype, "dtype") else dtype))
-            arr, n = shard_rows(host, mesh, fill=np.nan)
-            arrays[name] = arr
-        some = next(iter(arrays.values()))
-        mask = row_mask(n, some.shape[0], mesh)
-        return FrameTable(arrays, mask, n, mesh)
+
+        def build() -> "FrameTable":
+            arrays: Dict[str, jax.Array] = {}
+            n = frame.nrows
+            for name in names:
+                host = frame.col(name).numeric_view().astype(np_dtype)
+                arr, n = shard_rows(host, mesh, fill=np.nan)
+                arrays[name] = arr
+            some = next(iter(arrays.values()))
+            mask = row_mask(n, some.shape[0], mesh)
+            return FrameTable(arrays, mask, n, mesh)
+
+        token = frame_token(frame, names) if cache else None
+        if token is None:
+            return build()
+        key = ("frame_table", token, str(np_dtype), mesh_fingerprint(mesh))
+        table = DEVCACHE.get_or_put(
+            key, build, frame_key=getattr(frame, "key", None),
+            kind="frame_table",
+        )
+        table._devcache_key = key
+        return table
 
     @property
     def n_padded(self) -> int:
         return next(iter(self.arrays.values())).shape[0]
 
     def matrix(self, columns: Optional[Sequence[str]] = None) -> jax.Array:
-        """[N_pad, F] feature matrix (column-stacked, row-sharded)."""
-        names = list(columns) if columns is not None else list(self.arrays)
-        return jnp.stack([self.arrays[n] for n in names], axis=1)
+        """[N_pad, F] feature matrix (column-stacked, row-sharded).
+
+        The stacked matrix is cached per column tuple: with the table
+        itself cached, repeat fits stack (and re-place) nothing."""
+        names = tuple(columns) if columns is not None else tuple(self.arrays)
+        with self._matrix_lock:
+            cached = self._matrix_cache.get(names)
+            if cached is not None:
+                _DEVCACHE_REQUESTS.inc(kind="table_matrix", result="hit")
+                return cached
+            _DEVCACHE_REQUESTS.inc(kind="table_matrix", result="miss")
+            m = jnp.stack([self.arrays[n] for n in names], axis=1)
+            self._matrix_cache[names] = m
+            if self._devcache_key is not None:
+                # a stacked matrix on a cache-resident table is resident
+                # device memory: fold it into the entry so the budget sees it
+                DEVCACHE.grow_entry(self._devcache_key, int(m.nbytes))
+        return m
+
+
+#: valid ``map_reduce(reduce=...)`` choices -> the collective combiner
+_REDUCERS = {"sum": jax.lax.psum, "max": jax.lax.pmax, "min": jax.lax.pmin}
 
 
 def map_reduce(
@@ -142,23 +295,34 @@ def map_reduce(
     ``fn`` must be jax-traceable and return a pytree of arrays whose shapes do
     not depend on the shard content (static shapes — the SPMD contract).
     The returned pytree is fully reduced and replicated on every device.
+    Repeat dispatches of the same ``fn`` over same-shaped arguments reuse
+    the compiled program via the plan cache (zero re-trace/re-compile).
     """
-    red = {"sum": jax.lax.psum, "max": jax.lax.pmax, "min": jax.lax.pmin}[reduce]
+    if reduce not in _REDUCERS:
+        raise ValueError(
+            f"unknown reduce {reduce!r}; valid choices: {sorted(_REDUCERS)}"
+        )
 
-    def shard_fn(arrays, mask, *extras):
-        part = fn(arrays, mask, *extras)
-        return jax.tree.map(lambda x: red(x, DATA_AXIS), part)
+    def build() -> Callable:
+        red = _REDUCERS[reduce]
 
-    mapped = _shard_map(
-        shard_fn,
-        mesh=table.mesh,
-        in_specs=(P(DATA_AXIS), P(DATA_AXIS)) + tuple(P() for _ in extra_args),
-        out_specs=P(),
-    )
+        def shard_fn(arrays, mask, *extras):
+            part = fn(arrays, mask, *extras)
+            return jax.tree.map(lambda x: red(x, DATA_AXIS), part)
+
+        mapped = _shard_map(
+            shard_fn,
+            mesh=table.mesh,
+            in_specs=(P(DATA_AXIS), P(DATA_AXIS)) + tuple(P() for _ in extra_args),
+            out_specs=P(),
+        )
+        return jax.jit(mapped)
+
+    jitted = _get_plan("map_reduce", fn, reduce, table, extra_args, build)
     return _dispatch(
         "map_reduce",
         table,
-        lambda: jax.jit(mapped)(table.arrays, table.mask, *extra_args),
+        lambda: jitted(table.arrays, table.mask, *extra_args),
     )
 
 
@@ -168,16 +332,20 @@ def map_batches(fn: Callable, table: FrameTable, *extra_args):
     The analogue of an MRTask writing NewChunks into an output Frame
     (``water/MRTask.java:558-559`` outputFrame)."""
 
-    mapped = _shard_map(
-        fn,
-        mesh=table.mesh,
-        in_specs=(P(DATA_AXIS), P(DATA_AXIS)) + tuple(P() for _ in extra_args),
-        out_specs=P(DATA_AXIS),
-    )
+    def build() -> Callable:
+        mapped = _shard_map(
+            fn,
+            mesh=table.mesh,
+            in_specs=(P(DATA_AXIS), P(DATA_AXIS)) + tuple(P() for _ in extra_args),
+            out_specs=P(DATA_AXIS),
+        )
+        return jax.jit(mapped)
+
+    jitted = _get_plan("map_batches", fn, "shard", table, extra_args, build)
     return _dispatch(
         "map_batches",
         table,
-        lambda: jax.jit(mapped)(table.arrays, table.mask, *extra_args),
+        lambda: jitted(table.arrays, table.mask, *extra_args),
     )
 
 

@@ -1,0 +1,300 @@
+"""Device frame cache — memoized host->mesh placement (the DKV invariant).
+
+The reference platform's performance story rests on data living *in memory,
+in place* across jobs: a Frame is parsed once into the DKV and every MRTask
+after that touches resident chunks (SURVEY.md §1). The TPU port's analogue
+is this cache: the host->mesh transfer of a frame's columns (row-sharded
+column dicts, stacked design matrices, tree-booster bin codes, validity
+masks) happens ONCE per (data state, layout, mesh), and every later fit or
+dispatch on the same unmutated frame reuses the resident device arrays.
+
+Keying: every :class:`~h2o3_tpu.frame.frame.Column` carries a process-wide
+monotonic ``version`` stamp, bumped through the same paths that call
+``invalidate_rollups`` — so a cache key built from ``(name, version)``
+pairs (:func:`frame_token`) identifies column *data*, and any mutation
+makes the old key unreachable. Explicit lifecycle eviction rides on the
+keyed store: ``KeyedStore.remove/rekey/clear`` (and Cleaner spills) call
+:meth:`DeviceFrameCache.invalidate_frame` for the affected frame key.
+
+Memory: entries are LRU in a byte-accounted budget
+(``H2O3_TPU_DEVCACHE_BYTES``, default 1 GiB) so device/host pressure
+reclaims the least recently used placements first. Hit/miss/evict and
+bytes-saved counters flow through the PR 1 telemetry registry.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+from h2o3_tpu.util import telemetry
+
+__all__ = [
+    "DEVCACHE",
+    "DeviceFrameCache",
+    "cached",
+    "device_nbytes",
+    "frame_token",
+    "mesh_fingerprint",
+]
+
+#: cache traffic by placement kind (frame_table, glm_design, tree_bins, ...)
+REQUESTS = telemetry.counter(
+    "devcache_requests_total",
+    "device frame cache lookups by placement kind",
+    labels=("kind", "result"),
+)
+_EVICTIONS = telemetry.counter(
+    "devcache_evictions_total",
+    "device frame cache entries dropped",
+    labels=("reason",),
+)
+_BYTES_SAVED = telemetry.counter(
+    "devcache_bytes_saved_total",
+    "host->device upload bytes avoided by cache hits",
+)
+_BYTES = telemetry.gauge(
+    "devcache_bytes", "device bytes resident in the frame cache"
+)
+_ENTRIES = telemetry.gauge(
+    "devcache_entries", "entries resident in the frame cache"
+)
+
+_DEFAULT_BUDGET = 1 << 30  # 1 GiB of device-resident placements
+
+
+def _env_budget() -> int:
+    raw = os.environ.get("H2O3_TPU_DEVCACHE_BYTES")
+    if not raw:
+        return _DEFAULT_BUDGET
+    try:
+        return int(raw)
+    except ValueError:
+        return _DEFAULT_BUDGET
+
+
+def mesh_fingerprint(mesh) -> Tuple:
+    """Hashable identity of a mesh placement: axis layout + device set.
+
+    A placement sharded for one mesh must never be served for another
+    (different device count, ids, or platform → different shardings)."""
+    devs = tuple(int(d.id) for d in mesh.devices.flat)
+    platform = mesh.devices.flat[0].platform if devs else "none"
+    return (tuple(mesh.axis_names), mesh.devices.shape, devs, platform)
+
+
+def frame_token(frame, columns: Optional[Sequence[str]] = None) -> Optional[Tuple]:
+    """Data-identity token of (a column subset of) a frame.
+
+    Built from per-column ``(name, version)`` stamps plus the row count;
+    versions are globally unique per column state, so equal tokens imply
+    byte-identical host data. Returns None for objects without version
+    stamps (foreign/duck-typed frames) — callers then skip the cache."""
+    if frame is None:
+        return None
+    try:
+        cols = (
+            [frame.col(c) for c in columns]
+            if columns is not None
+            else list(frame.columns)
+        )
+        token = tuple((c.name, c.version) for c in cols)
+        nrows = frame.nrows
+    except (AttributeError, KeyError, TypeError):
+        return None
+    return ("frame", nrows, token)
+
+
+def device_nbytes(value: Any) -> int:
+    """Bytes of every array reachable from ``value`` (dict/list/tuple
+    nesting and FrameTable-shaped objects with ``arrays`` + ``mask``)."""
+    total = 0
+    stack = [value]
+    while stack:
+        v = stack.pop()
+        if v is None:
+            continue
+        if hasattr(v, "nbytes") and hasattr(v, "dtype") and hasattr(v, "shape"):
+            total += int(v.nbytes)
+        elif isinstance(v, dict):
+            stack.extend(v.values())
+        elif isinstance(v, (list, tuple)):
+            stack.extend(v)
+        elif isinstance(getattr(v, "arrays", None), dict):  # FrameTable shape
+            stack.extend(v.arrays.values())
+            stack.append(getattr(v, "mask", None))
+    return total
+
+
+class _Entry:
+    __slots__ = ("value", "nbytes", "kind", "frame_keys")
+
+    def __init__(self, value: Any, nbytes: int, kind: str) -> None:
+        self.value = value
+        self.nbytes = nbytes
+        self.kind = kind
+        self.frame_keys: set = set()
+
+
+class DeviceFrameCache:
+    """Process-wide LRU cache of device placements, byte-budgeted.
+
+    ``get_or_put(key, build)`` is the single entry point: the builder runs
+    only on a miss, OUTSIDE the lock (a multi-GB device_put must not block
+    concurrent lookups); a lost insert race keeps the first entry. Passing
+    ``frame_key`` links the entry to a keyed-store frame so DKV
+    remove/rekey/clear (and Cleaner spills) can evict it explicitly."""
+
+    def __init__(self, max_bytes: Optional[int] = None) -> None:
+        self._lock = threading.RLock()
+        self._entries: "OrderedDict[Tuple, _Entry]" = OrderedDict()
+        self._by_frame_key: Dict[str, set] = {}
+        self._bytes = 0
+        self._max_bytes = _env_budget() if max_bytes is None else int(max_bytes)
+
+    # -- sizing --------------------------------------------------------------
+    def set_max_bytes(self, max_bytes: int) -> None:
+        with self._lock:
+            self._max_bytes = int(max_bytes)
+            self._shrink()
+            self._publish()
+
+    @property
+    def max_bytes(self) -> int:
+        with self._lock:
+            return self._max_bytes
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "bytes": self._bytes,
+                "max_bytes": self._max_bytes,
+            }
+
+    # -- the cache protocol --------------------------------------------------
+    def get_or_put(
+        self,
+        key: Tuple,
+        build: Callable[[], Any],
+        frame_key: Optional[str] = None,
+        kind: str = "table",
+    ) -> Any:
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+                self._link(entry, key, frame_key)
+                REQUESTS.inc(kind=kind, result="hit")
+                _BYTES_SAVED.inc(entry.nbytes)
+                return entry.value
+        REQUESTS.inc(kind=kind, result="miss")
+        value = build()  # host->device transfer happens without the lock
+        nbytes = device_nbytes(value)
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:  # lost a concurrent build race: keep first
+                self._entries.move_to_end(key)
+                self._link(entry, key, frame_key)  # our lifecycle link still applies
+                return entry.value
+            entry = _Entry(value, nbytes, kind)
+            self._entries[key] = entry
+            self._bytes += nbytes
+            self._link(entry, key, frame_key)
+            self._shrink()
+            self._publish()
+        return value
+
+    def _link(self, entry: _Entry, key: Tuple, frame_key: Optional[str]) -> None:
+        if frame_key:
+            entry.frame_keys.add(frame_key)
+            self._by_frame_key.setdefault(frame_key, set()).add(key)
+
+    def grow_entry(self, key: Tuple, nbytes: int) -> None:
+        """Attribute extra device bytes to a resident entry — e.g. a stacked
+        matrix lazily cached ON a resident FrameTable — so the byte budget
+        and gauges see the entry's true footprint. No-op once evicted."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                return
+            entry.nbytes += int(nbytes)
+            self._bytes += int(nbytes)
+            self._shrink()
+            self._publish()
+
+    # -- eviction ------------------------------------------------------------
+    def _drop(self, key: Tuple, reason: str) -> None:
+        # caller holds the lock
+        entry = self._entries.pop(key, None)
+        if entry is None:
+            return
+        self._bytes -= entry.nbytes
+        for fk in entry.frame_keys:
+            keys = self._by_frame_key.get(fk)
+            if keys is not None:
+                keys.discard(key)
+                if not keys:
+                    del self._by_frame_key[fk]
+        _EVICTIONS.inc(reason=reason)
+
+    def _shrink(self) -> None:
+        # caller holds the lock; never evict the most recent entry — a
+        # single over-budget placement must still be usable while resident
+        while self._bytes > self._max_bytes and len(self._entries) > 1:
+            oldest = next(iter(self._entries))
+            self._drop(oldest, reason="lru")
+
+    def invalidate_frame(self, frame_key: str) -> int:
+        """Drop every placement linked to a keyed-store frame (DKV
+        remove/rekey/clear, Cleaner spill). Returns entries dropped."""
+        with self._lock:
+            keys = list(self._by_frame_key.get(frame_key, ()))
+            for k in keys:
+                self._drop(k, reason="invalidate")
+            if keys:
+                self._publish()
+            return len(keys)
+
+    def clear(self) -> None:
+        with self._lock:
+            for k in list(self._entries):
+                self._drop(k, reason="clear")
+            self._by_frame_key.clear()
+            self._publish()
+
+    def _publish(self) -> None:
+        _BYTES.set(self._bytes)
+        _ENTRIES.set(len(self._entries))
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+#: The process-wide device frame cache (one per control plane, like the DKV).
+DEVCACHE = DeviceFrameCache()
+
+
+def cached(
+    kind: str,
+    token: Optional[Tuple],
+    extra_key,
+    mesh,
+    build: Callable[[], Any],
+    frame_key: Optional[str] = None,
+) -> Any:
+    """The one-call memoized-placement pattern every upload site uses:
+    bypass (plain build, no counters) when the frame yielded no token,
+    else serve from / insert into :data:`DEVCACHE` under
+    ``(kind, token, extra_key, mesh fingerprint)``."""
+    if token is None:
+        return build()
+    return DEVCACHE.get_or_put(
+        (kind, token, extra_key, mesh_fingerprint(mesh)),
+        build,
+        frame_key=frame_key,
+        kind=kind,
+    )

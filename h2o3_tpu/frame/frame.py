@@ -26,9 +26,17 @@ TPU-native redesign:
 from __future__ import annotations
 
 import enum
+import itertools
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
+
+#: process-wide monotonic column-version source. Every Column state —
+#: fresh construction or in-place mutation (the invalidate_rollups paths) —
+#: draws a new number, so a (name, version) pair identifies column DATA
+#: uniquely across the process lifetime. The device frame cache
+#: (h2o3_tpu/frame/devcache.py) keys host->mesh placements on these stamps.
+_COLUMN_VERSIONS = itertools.count(1)
 
 
 class ColType(enum.Enum):
@@ -59,7 +67,7 @@ class Column:
       BAD  -> float64 all-NaN
     """
 
-    __slots__ = ("name", "type", "data", "domain", "_rollups")
+    __slots__ = ("name", "type", "data", "domain", "_rollups", "version")
 
     def __init__(
         self,
@@ -76,6 +84,7 @@ class Column:
         self.data = data
         self.domain = list(domain) if domain is not None else None
         self._rollups = None
+        self.version = next(_COLUMN_VERSIONS)
         if self.type is ColType.CAT and self.domain is None:
             raise ValueError(f"CAT column {name!r} requires a domain")
 
@@ -128,7 +137,11 @@ class Column:
         return self._rollups
 
     def invalidate_rollups(self) -> None:
+        """Mutation notification: drops cached rollups AND bumps the version
+        stamp, so device placements keyed on the old state can never be
+        served for the mutated data (devcache invariant)."""
         self._rollups = None
+        self.version = next(_COLUMN_VERSIONS)
 
     def min(self) -> float:
         return self.rollups.min
@@ -291,6 +304,13 @@ class Frame:
     @property
     def shape(self) -> Tuple[int, int]:
         return (self.nrows, self.ncols)
+
+    @property
+    def version(self) -> Tuple[int, ...]:
+        """Per-column version stamps. Two frames with equal (names, version)
+        tuples hold identical data; any mutating path produces a fresh
+        Column (new stamp) or bumps in place via invalidate_rollups."""
+        return tuple(c.version for c in self._cols)
 
     def __len__(self) -> int:
         return self.nrows

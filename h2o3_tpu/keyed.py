@@ -12,11 +12,31 @@ lookups, and `Scope`-style temp tracking (``water/Scope.java``).
 
 from __future__ import annotations
 
+import sys
 import threading
 import uuid
 from typing import Any, Dict, Iterator, List, Optional
 
 from h2o3_tpu.util import telemetry
+
+
+def _devcache_invalidate(key: Optional[str]) -> None:
+    """Drop device placements linked to a dropped/renamed frame key.
+
+    Looked up via sys.modules so the store never forces the devcache (and
+    transitively the compute stack) to import: if the module was never
+    loaded, nothing was ever cached."""
+    if not key:
+        return
+    mod = sys.modules.get("h2o3_tpu.frame.devcache")
+    if mod is not None:
+        mod.DEVCACHE.invalidate_frame(key)
+
+
+def _devcache_clear() -> None:
+    mod = sys.modules.get("h2o3_tpu.frame.devcache")
+    if mod is not None:
+        mod.DEVCACHE.clear()
 
 #: store churn meters — the DKV analogue of the reference's WaterMeter
 #: gauges: size, put/get traffic, and Cleaner spill activity
@@ -212,6 +232,10 @@ class KeyedStore:
                             "spilled frame %s (%.1f MB) to %s",
                             victim, nbytes / 1e6, path,
                         )
+                        # memory pressure reclaims the device tier too: a
+                        # frame cold enough to leave host RAM has no claim
+                        # on resident device placements
+                        _devcache_invalidate(victim)
                     else:
                         try:
                             os.unlink(path)
@@ -305,6 +329,8 @@ class KeyedStore:
             if v is not None:
                 _DKV_REMOVES.inc()
             _DKV_KEYS.set(len(self._store))
+        if v is not None:
+            _devcache_invalidate(key)
 
     def rekey(self, obj: Any, new_key: str) -> str:
         """Re-register ``obj`` (which carries a ``.key`` attribute) under
@@ -321,6 +347,10 @@ class KeyedStore:
             if self._scopes:
                 self._scopes[-1].append(new_key)
             _DKV_KEYS.set(len(self._store))
+        if old and old != new_key:
+            # placements registered under the old key re-upload on next
+            # use; renaming must never leave stale device state reachable
+            _devcache_invalidate(old)
         return new_key
 
     def keys(self) -> List[str]:
@@ -346,6 +376,7 @@ class KeyedStore:
             _DKV_REMOVES.inc(len(self._store))
             self._store.clear()
             _DKV_KEYS.set(0)
+        _devcache_clear()
 
     @staticmethod
     def make_key(prefix: str = "obj") -> str:
@@ -359,6 +390,7 @@ class KeyedStore:
 
     def scope_exit(self, keep: Optional[List[str]] = None) -> None:
         keep_set = set(keep or [])
+        dropped: List[str] = []
         with self._lock:
             if not self._scopes:
                 return
@@ -371,7 +403,10 @@ class KeyedStore:
                 self._drop_value(k, v)
                 if v is not None:
                     _DKV_REMOVES.inc()
+                    dropped.append(k)
             _DKV_KEYS.set(len(self._store))
+        for k in dropped:
+            _devcache_invalidate(k)
 
     def scope(self) -> "_ScopeCtx":
         return _ScopeCtx(self)

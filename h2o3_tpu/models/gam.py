@@ -478,6 +478,28 @@ class GAM(ModelBuilder):
     def _fit(self, frame: Frame, valid: Optional[Frame] = None) -> GAMModel:
         p: GAMParameters = self.params
         link = p.actual_link()
+        # design-cache identity, captured BEFORE the response conversion
+        # rebinds `frame`. The key holds exactly the params that shape the
+        # design matrix (basis spec + layout), NOT solver knobs like
+        # lambda/alpha/scale — so refits that only retune smoothing or
+        # regularization reuse the resident device design.
+        from h2o3_tpu.frame import devcache as _devcache
+
+        def _hashable(v):
+            if isinstance(v, (list, tuple)):
+                return tuple(_hashable(x) for x in v)
+            if isinstance(v, np.ndarray):
+                return (v.shape, v.tobytes())
+            return v
+
+        self._design_token = _devcache.frame_token(frame)
+        self._design_sig = (
+            p.standardize, p.missing_values_handling,
+            tuple(p.ignored_columns), p.response_column,
+            _hashable(p.gam_columns), _hashable(p.num_knots),
+            _hashable(p.bs), _hashable(p.knots), p.splines_non_negative,
+        )
+        self._train_frame_key = getattr(frame, "key", None)
         if p.family in ("binomial", "quasibinomial"):
             ycol = frame.col(p.response_column)
             if not ycol.is_categorical():
@@ -560,8 +582,15 @@ class GAM(ModelBuilder):
             off += kz
 
         mesh = default_mesh()
-        Xi = np.concatenate([X, np.ones((n, 1))], axis=1).astype(np.float32)
-        Xd, _ = shard_rows(Xi, mesh)
+
+        def _build_design():
+            Xi = np.concatenate([X, np.ones((n, 1))], axis=1).astype(np.float32)
+            return shard_rows(Xi, mesh)[0]
+
+        Xd = _devcache.cached(
+            "gam_design", self._design_token, self._design_sig, mesh,
+            _build_design, frame_key=self._train_frame_key,
+        )
         pad = lambda a: pad_rows(a, mesh.devices.size)[0]
 
         wsum = float(obs_w.sum())

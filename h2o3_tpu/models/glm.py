@@ -347,6 +347,20 @@ class GLM(ModelBuilder):
     def _fit(self, frame: Frame, valid: Optional[Frame] = None) -> GLMModel:
         p: GLMParameters = self.params
         link = p.actual_link()
+        # device-design cache identity, captured BEFORE any response
+        # conversion below rebinds `frame`: the expanded+filtered design is
+        # a pure function of the original column versions and these params,
+        # so lambda-path refits and AutoML retrains on the same unmutated
+        # frame reuse the resident device matrix (devcache tentpole)
+        from h2o3_tpu.frame import devcache as _devcache
+
+        self._design_token = _devcache.frame_token(frame)
+        self._design_sig = (
+            p.standardize, p.missing_values_handling,
+            tuple(p.ignored_columns), p.response_column, p.weights_column,
+            p.offset_column, p.intercept,
+        )
+        self._train_frame_key = getattr(frame, "key", None)
         if p.family in ("binomial", "quasibinomial", "multinomial", "ordinal"):
             # the reference requires a categorical response for these
             # families; a numeric column is auto-converted (as_factor)
@@ -481,18 +495,38 @@ class GLM(ModelBuilder):
         if p.compute_p_values and p.lambda_ == 0 and not p.lambda_search:
             self._p_values(model, X, y, mu, obs_w, offset, link, p, info)
 
+    def _cached_upload(self, kind: str, mesh, build):
+        """Memoize a device placement through the process-wide devcache,
+        keyed on (placement kind, frame token, design params, mesh). Falls
+        through to a plain upload when the frame has no version stamps."""
+        from h2o3_tpu.frame import devcache as _devcache
+
+        return _devcache.cached(
+            kind, getattr(self, "_design_token", None),
+            getattr(self, "_design_sig", None), mesh, build,
+            frame_key=getattr(self, "_train_frame_key", None),
+        )
+
     def _device_design(self, X: np.ndarray):
         """Row-sharded design matrix [N, P(+1 intercept col)] + row padder."""
         p: GLMParameters = self.params
         mesh = default_mesh()
         nshards = mesh.devices.size
-        Xi = (
-            np.concatenate([X, np.ones((len(X), 1), dtype=np.float32)], axis=1)
-            if p.intercept
-            else X
+
+        def build():
+            Xi = (
+                np.concatenate(
+                    [X, np.ones((len(X), 1), dtype=np.float32)], axis=1
+                )
+                if p.intercept
+                else X
+            )
+            Xd, _ = shard_rows(Xi, mesh)
+            return Xd
+
+        return self._cached_upload("glm_design", mesh, build), (
+            lambda a: pad_rows(a, nshards)[0]
         )
-        Xd, _ = shard_rows(Xi, mesh)
-        return Xd, lambda a: pad_rows(a, nshards)[0]
 
     def _run_lambda_path(
         self, model, lambdas, solve, dev_train, dev_valid, nonzeros, null_dev, state0
@@ -612,7 +646,10 @@ class GLM(ModelBuilder):
             )
         mesh = default_mesh()
         nshards = mesh.devices.size
-        Xf, _ = shard_rows(X64.astype(np.float32), mesh)
+        Xf = self._cached_upload(
+            "glm_lbfgs_x", mesh,
+            lambda: shard_rows(X64.astype(np.float32), mesh)[0],
+        )
         wd = jnp.asarray(pad_rows(obs_w, nshards)[0], dtype=jnp.float32)
         yd = jnp.asarray(pad_rows(y, nshards)[0], dtype=jnp.float32)
         od = jnp.asarray(pad_rows(offset, nshards)[0], dtype=jnp.float32)
@@ -790,7 +827,10 @@ class GLM(ModelBuilder):
         p: GLMParameters = self.params
         mesh = default_mesh()
         nshards = mesh.devices.size
-        Xf, _ = shard_rows(X64.astype(np.float32), mesh)
+        Xf = self._cached_upload(
+            "glm_multinomial_x", mesh,
+            lambda: shard_rows(X64.astype(np.float32), mesh)[0],
+        )
         wd = jnp.asarray(pad_rows(obs_w, nshards)[0], dtype=jnp.float32)
         Yd = jnp.asarray(pad_rows(Y, nshards)[0], dtype=jnp.float32)
         intercept = p.intercept
@@ -841,7 +881,9 @@ class GLM(ModelBuilder):
         l2 = p.lambda_ * (1 - p.alpha)
         mesh = default_mesh()
         nshards = mesh.devices.size
-        Xf, _ = shard_rows(X, mesh)
+        Xf = self._cached_upload(
+            "glm_ordinal_x", mesh, lambda: shard_rows(X, mesh)[0]
+        )
         wd = jnp.asarray(pad_rows(obs_w, nshards)[0], dtype=jnp.float32)
         yk = jnp.asarray(pad_rows(y.astype(np.int32), nshards)[0], dtype=jnp.int32)
         od = jnp.asarray(pad_rows(offset, nshards)[0], dtype=jnp.float32)

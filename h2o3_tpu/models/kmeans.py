@@ -112,7 +112,14 @@ class KMeans(ModelBuilder):
         rng = np.random.default_rng(p.actual_seed())
 
         mesh = default_mesh()
-        Xd, _ = shard_rows(X, mesh)
+        from h2o3_tpu.frame import devcache as _devcache
+
+        Xd = _devcache.cached(
+            "kmeans_x", _devcache.frame_token(frame),
+            (p.standardize, tuple(p.ignored_columns)), mesh,
+            lambda: shard_rows(X, mesh)[0],
+            frame_key=getattr(frame, "key", None),
+        )
         maskd = row_mask(n, Xd.shape[0], mesh)
 
         def run_lloyd(C0: np.ndarray):

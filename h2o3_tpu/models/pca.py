@@ -110,7 +110,15 @@ class PCA(ModelBuilder):
         model.transform_mul = tmul
 
         mesh = default_mesh()
-        Xd, _ = shard_rows(X, mesh)
+        from h2o3_tpu.frame import devcache as _devcache
+
+        Xd = _devcache.cached(
+            "pca_x", _devcache.frame_token(frame),
+            (p.transform, p.use_all_factor_levels, tuple(p.ignored_columns)),
+            mesh,
+            lambda: shard_rows(X, mesh)[0],
+            frame_key=getattr(frame, "key", None),
+        )
         maskd = row_mask(n, Xd.shape[0], mesh).astype(jnp.float32)
         G, cnt = jax.device_get(_gram_xx(Xd, maskd))
         G = np.asarray(G, dtype=np.float64) / max(n - 1, 1)

@@ -685,6 +685,8 @@ def train_boosted(
     weights: Optional[np.ndarray] = None,
     offset: Optional[np.ndarray] = None,
     monotone: Optional[np.ndarray] = None,
+    cache_token=None,
+    cache_frame_key: Optional[str] = None,
 ) -> BoostedTrees:
     """Device-resident booster loop.
 
@@ -705,6 +707,14 @@ def train_boosted(
     margin; single-margin objectives only. The caller owns adding the offset
     back at scoring time (Model.score semantics).
     monotone: [F] per-feature direction in {-1, 0, +1} (monotone_constraints).
+    cache_token: hashable identity of X's provenance (frame column versions
+    + encoding; see models/tree/common.tree_cache_token). When set, the
+    quantize-and-place block (apply_bins + bin-code/validity/feature-major
+    device_put) is memoized in the process-wide device frame cache, so
+    repeat GBM/DRF/XGBoost fits on the same unmutated frame — and every
+    tree of every fit — reuse the resident bin codes instead of re-binning
+    and re-uploading. cache_frame_key links the entry to a DKV frame for
+    lifecycle eviction. None bypasses the cache entirely.
     """
     import time as _time
 
@@ -728,7 +738,6 @@ def train_boosted(
             raise ValueError("checkpoint nbins mismatch")
     else:
         edges = make_bins(X, p.nbins, seed=p.seed)
-    bins_host = apply_bins(X, edges)
     n_bins1 = p.nbins + 1
     # pallas path: pad every shard to the kernel row tile so the prepared
     # feature-major copy needs no per-level realignment
@@ -739,26 +748,46 @@ def train_boosted(
         mult = nshards * _ROW_TILE
     else:
         mult = nshards
-    padn = (-n) % mult
-    if padn:
-        bins_host = np.concatenate(
-            [bins_host, np.zeros((padn, F), dtype=np.int32)], axis=0
-        )
-    bins_d = jax.device_put(bins_host, row_sharding(mesh, 2))
-    n_pad = bins_host.shape[0]
-    valid_d = jax.device_put(np.arange(n_pad) < n, row_sharding(mesh, 1))
 
-    bins_fm_d = None
-    if use_pallas:
-        from h2o3_tpu.ops.pallas_histogram import _FEAT_BLOCK
+    def _place_bins():
+        bins_host = apply_bins(X, edges)
+        padn = (-n) % mult
+        if padn:
+            bh = np.concatenate(
+                [bins_host, np.zeros((padn, F), dtype=np.int32)], axis=0
+            )
+        else:
+            bh = bins_host
+        bins_d = jax.device_put(bh, row_sharding(mesh, 2))
+        n_pad = bh.shape[0]
+        valid_d = jax.device_put(np.arange(n_pad) < n, row_sharding(mesh, 1))
+        bins_fm_d = None
+        if use_pallas:
+            from h2o3_tpu.ops.pallas_histogram import _FEAT_BLOCK
 
-        fb = min(_FEAT_BLOCK, F)
-        Fp = F + (-F) % fb
-        bfm_host = np.zeros((Fp, n_pad), dtype=np.int32)
-        bfm_host[:F] = bins_host.T
-        bins_fm_d = jax.device_put(
-            bfm_host, NamedSharding(mesh, P(None, DATA_AXIS))
-        )
+            fb = min(_FEAT_BLOCK, F)
+            Fp = F + (-F) % fb
+            bfm_host = np.zeros((Fp, n_pad), dtype=np.int32)
+            bfm_host[:F] = bh.T
+            bins_fm_d = jax.device_put(
+                bfm_host, NamedSharding(mesh, P(None, DATA_AXIS))
+            )
+        return bins_d, valid_d, bins_fm_d, n_pad
+
+    # bin codes are a pure function of (X provenance, edges, padding
+    # layout) — reusable across ntrees, checkpoint-continues, and
+    # GBM/DRF/XGBoost fits sharing a frame + binning spec
+    import hashlib
+
+    from h2o3_tpu.frame import devcache as _devcache
+
+    edges_digest = hashlib.sha1(
+        np.ascontiguousarray(edges).tobytes()
+    ).hexdigest()
+    bins_d, valid_d, bins_fm_d, n_pad = _devcache.cached(
+        "tree_bins", cache_token, (edges_digest, p.nbins, mult), mesh,
+        _place_bins, frame_key=cache_frame_key,
+    )
 
     C = n_class_trees
     if objective == "fixed":

@@ -322,6 +322,26 @@ def training_score(
     return wavg((margin[:, 0] - y) ** 2)
 
 
+def tree_cache_token(frame: Frame, p, encoding: str):
+    """Devcache identity of a booster's bin-code placement.
+
+    The binned matrix is a pure function of (frame column versions, the
+    categorical encoding, and the params that shape X / the keep mask:
+    ignored + response + weights + offset columns) — algo-independent, so
+    GBM/DRF/XGBoost fits on the same frame + binning spec share one entry.
+    Returns None (cache bypass) for frames without version stamps."""
+    from h2o3_tpu.frame import devcache
+
+    tok = devcache.frame_token(frame)
+    if tok is None:
+        return None
+    return (
+        tok, encoding, tuple(p.ignored_columns), p.response_column,
+        getattr(p, "weights_column", None),
+        getattr(p, "offset_column", None),
+    )
+
+
 def extract_weights(frame: Frame, p, keep: np.ndarray):
     """Load + validate weights_column, folding zero/NA-weight rows into the
     keep mask (dropping them is equivalent to the reference's zero

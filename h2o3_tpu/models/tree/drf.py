@@ -25,6 +25,7 @@ from h2o3_tpu.models.tree.common import (
     checkpoint_booster as _checkpoint_booster,
     extra_trees as _extra_trees,
     extract_weights,
+    tree_cache_token,
     tree_data_info,
     tree_matrix,
 )
@@ -129,6 +130,8 @@ class DRF(ModelBuilder):
                 n_features=F, encoding=model.tree_encoding,
             ),
             weights=weights,
+            cache_token=tree_cache_token(frame, p, model.tree_encoding),
+            cache_frame_key=getattr(frame, "key", None),
         )
         model.ntrees_built = model.booster.trees_per_class[0].ntrees
         model.training_metrics = model.model_performance(frame)

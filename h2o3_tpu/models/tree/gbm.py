@@ -20,6 +20,7 @@ from h2o3_tpu.models.tree.common import (
     checkpoint_booster as _checkpoint_booster,
     extra_trees as _extra_trees,
     make_tree_monitor,
+    tree_cache_token,
     tree_fit_setup,
 )
 
@@ -103,6 +104,8 @@ class GBM(ModelBuilder):
             weights=weights,
             offset=offset,
             monotone=mono,
+            cache_token=tree_cache_token(frame, p, model.tree_encoding),
+            cache_frame_key=getattr(frame, "key", None),
         )
         model.ntrees_built = model.booster.trees_per_class[0].ntrees
         model.training_metrics = model.model_performance(frame)

@@ -82,14 +82,60 @@ def make_bins(
     return edges
 
 
+def _apply_bins_batched(X: np.ndarray, edges: np.ndarray) -> np.ndarray:
+    """Vectorized per-row searchsorted (no Python loop over features).
+
+    One stable argsort of the per-feature ``[edges | values]`` concatenation
+    ranks every value against its own feature's edges in a single batched
+    pass: with edges FIRST and the sort stable, an equal edge sorts before
+    the value, so the running edge count at a value's sorted position is
+    exactly ``searchsorted(edges[f], x, side="right")`` — float64-exact
+    (ties, ±inf and NaN-last included). Row chunks bound the workspace.
+    """
+    n, F = X.shape
+    E = edges.shape[1]
+    out = np.empty((n, F), dtype=np.int32)
+    rows = np.arange(F)[:, None]
+    chunk = max(1, 4_000_000 // max(F, 1))
+    for s in range(0, n, chunk):
+        xb = X[s:s + chunk].T  # [F, m]
+        comb = np.concatenate([edges, xb], axis=1)  # [F, E+m]
+        order = np.argsort(comb, axis=1, kind="stable")
+        is_val = order >= E
+        edges_before = np.cumsum(~is_val, axis=1)  # edges at/before position
+        blk = np.empty(xb.shape, dtype=np.int32)
+        blk[np.broadcast_to(rows, order.shape)[is_val],
+            order[is_val] - E] = edges_before[is_val]
+        out[s:s + chunk] = blk.T
+    return out
+
+
 def apply_bins(X: np.ndarray, edges: np.ndarray) -> np.ndarray:
-    """Quantize raw features to bin codes [N, F] int8-range; NA -> nbins."""
+    """Quantize raw features to bin codes [N, F] int8-range; NA -> nbins.
+
+    Implementation is measurement-dispatched (single-core CPU numbers, see
+    PR notes): for tall matrices — the booster shape, e.g. 1M x 28 — the
+    per-feature ``np.searchsorted`` loop IS the fastest exact kernel
+    (binary search over L1-resident edges beats every batched formulation:
+    argsort ~0.7x, pooled-rank ~0.6x, broadcast-count ~0.3x, grid-bucketed
+    ~0.7x, jnp/f32 ~0.7x AND inexact), while for wide-short matrices the
+    per-call overhead of F tiny searchsorteds dominates and the batched
+    argsort path wins (n=8, F=5000: ~1.8x). Both paths are bit-exact
+    against the per-feature formulation; the hot repeat-fit case no longer
+    reaches either — the device frame cache serves the bin codes resident.
+    """
+    X = np.asarray(X)
     n, F = X.shape
     nbins = edges.shape[1] + 1
-    out = np.empty((n, F), dtype=np.int32)
-    for f in range(F):
-        out[:, f] = np.searchsorted(edges[f], X[:, f], side="right")
-        out[np.isnan(X[:, f]), f] = nbins  # NA bucket (DHistogram NA bin at end)
+    if n == 0 or F == 0:
+        return np.empty((n, F), dtype=np.int32)
+    if F > 32 * max(n, 1):  # wide-short: loop overhead dominates
+        out = _apply_bins_batched(X, edges)
+    else:
+        out = np.empty((n, F), dtype=np.int32)
+        for f in range(F):
+            out[:, f] = np.searchsorted(edges[f], X[:, f], side="right")
+    out[np.isnan(X)] = nbins  # NA bucket (DHistogram NA bin at end)
     return out
 
 

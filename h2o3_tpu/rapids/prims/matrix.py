@@ -23,21 +23,32 @@ _DEVICE_MIN_ELEMS = 1 << 20  # below this, host matmul wins on transfer cost
 @prim("x")
 def mmult(env, args):
     """(x fr1 fr2) — matrix multiply (AstMMult)."""
-    a = args[0].as_frame().to_numpy()
+    a_fr = args[0].as_frame()
     b = args[1].as_frame().to_numpy()
-    if a.shape[1] != b.shape[0]:
-        raise RapidsError(f"x: shape mismatch {a.shape} @ {b.shape}")
-    if a.size + b.size >= _DEVICE_MIN_ELEMS:
+    a_shape = (a_fr.nrows, a_fr.ncols)  # metadata only: no materialization
+    if a_shape[1] != b.shape[0]:
+        raise RapidsError(f"x: shape mismatch {a_shape} @ {b.shape}")
+    if a_shape[0] * a_shape[1] + b.size >= _DEVICE_MIN_ELEMS:
         import jax.numpy as jnp
 
+        from h2o3_tpu.frame import devcache
         from h2o3_tpu.parallel.mesh import default_mesh, shard_rows
 
         mesh = default_mesh()
-        a_dev, n = shard_rows(a.astype(np.float32), mesh, fill=0.0)
+        # the big left operand's placement is memoized on column versions;
+        # to_numpy stays inside the builder so a warm repeat of
+        # (x fr other) skips the O(N*P) host materialization too
+        a_dev, n = devcache.cached(
+            "mmult_lhs", devcache.frame_token(a_fr), None, mesh,
+            lambda: shard_rows(
+                a_fr.to_numpy().astype(np.float32), mesh, fill=0.0
+            ),
+            frame_key=getattr(a_fr, "key", None),
+        )
         out = np.asarray(jnp.matmul(a_dev, jnp.asarray(b.astype(np.float32))))[:n]
         out = out.astype(np.float64)
     else:
-        out = a @ b
+        out = a_fr.to_numpy() @ b
     return Val.frame(
         Frame([Column(f"C{j+1}", out[:, j], ColType.NUM) for j in range(out.shape[1])])
     )
