@@ -54,8 +54,9 @@ _CACHE_ENV = {
 # SIGSEGV at load (tests/conftest.py documents the hazard).  Removal (not
 # just skipping the setdefault) so an externally exported cache dir can't
 # reach CPU children either.
-if os.environ.get("BENCH_FORCE_CPU") or "--cache-bench" in sys.argv:
-    # --cache-bench is CPU-only by construction: same hazard
+if os.environ.get("BENCH_FORCE_CPU") or "--cache-bench" in sys.argv \
+        or "--parse-bench" in sys.argv:
+    # --cache-bench / --parse-bench are CPU-only by construction: same hazard
     for _k in _CACHE_ENV:
         os.environ.pop(_k, None)
 else:
@@ -341,6 +342,177 @@ def _cache_bench() -> None:
     }))
 
 
+def _parse_bench_csv(target_mb: float) -> str:
+    """Deterministic mixed NUM/CAT/TIME/STR/NUM CSV of ~target_mb MB —
+    the column mix routes every chunk through every native primitive
+    (float parse, dict encode, time parse, string gather)."""
+    cats = ("alpha", "beta", "gamma", "delta", "epsilon", "zeta", "eta")
+    row_bytes = 62  # measured mean of the format below
+    n = max(1000, int(target_mb * 1e6 / row_bytes))
+    rows = ["num,cat,time,str,count"]
+    for i in range(n):
+        num = "NA" if i % 97 == 0 else f"{i * 0.75 - 17.0:.4f}"
+        tim = (f"2021-{(i % 12) + 1:02d}-{(i % 27) + 1:02d}"
+               f" 10:{i % 60:02d}:{(i * 7) % 60:02d}")
+        rows.append(f"{num},{cats[i % 7]},{tim},free text {i % 5000},{i}")
+    rows.append("")
+    return "\n".join(rows)
+
+
+def _frames_identical(a, b) -> bool:
+    import numpy as np
+
+    if a.names != b.names or a.nrows != b.nrows:
+        return False
+    for n in a.names:
+        ca, cb = a.col(n), b.col(n)
+        if ca.type != cb.type or ca.domain != cb.domain:
+            return False
+        if ca.data.dtype == object:
+            if list(ca.data) != list(cb.data):
+                return False
+        elif not np.array_equal(ca.data, cb.data, equal_nan=True):
+            return False
+    return True
+
+
+def _parse_bench() -> None:
+    """CPU parse-pipeline bench (chunk-parallel two-phase ingest).
+
+    Rows/sec at 1/2/4/8 workers on a generated mixed NUM/CAT/TIME/STR
+    CSV (~BENCH_PARSE_MB, default 100), plain and gzipped, with scaling
+    efficiency and a serial-vs-parallel bit-identity check in the same
+    run.  Prints ONE JSON line and mirrors it to PARSE_BENCH.json.
+    Worker scaling is an OS-scheduling property: on a single-core host
+    (host_cpus=1) throughput is flat across worker counts by physics —
+    the pipeline-vs-serial-python speedup is the portable number there.
+    """
+    import gzip
+    import io
+
+    from h2o3_tpu.frame.parse import parse_csv, parse_csv_stream
+    from h2o3_tpu.frame.ingest import parse_bytes
+    from h2o3_tpu.util import telemetry
+
+    size_mb = float(os.environ.get("BENCH_PARSE_MB", 100))
+    repeats = int(os.environ.get("BENCH_PARSE_REPEATS", 2))
+    worker_counts = (1, 2, 4, 8)
+    # 2 MiB chunks: ~50 chunks at 100 MB, enough scheduling granularity
+    # for 8 workers without drowning in per-chunk overhead
+    os.environ.setdefault("H2O3_TPU_PARSE_CHUNK_BYTES", str(2 << 20))
+    chunk_bytes = int(os.environ["H2O3_TPU_PARSE_CHUNK_BYTES"])
+
+    t0 = time.time()
+    text = _parse_bench_csv(size_mb)
+    raw = text.encode("utf-8")
+    nbytes = len(raw)
+    gen_s = time.time() - t0
+    print(f"# generated {nbytes / 1e6:.1f} MB csv in {gen_s:.1f}s",
+          file=sys.stderr)
+
+    def timed_parse(workers):
+        best, fr = None, None
+        for _ in range(max(1, repeats)):
+            t = time.time()
+            f = parse_csv_stream(io.BytesIO(raw), workers=workers)
+            dt = time.time() - t
+            if best is None or dt < best:
+                best, fr = dt, f
+        return best, fr
+
+    # warmup outside the timers: builds/loads the native lib (a stale
+    # .so recompiles on first use) and faults the page cache
+    w_end = raw.find(b"\n", min(3 << 20, len(raw) // 2)) + 1
+    parse_csv_stream(io.BytesIO(raw[:w_end] if w_end > 0 else raw),
+                     workers=2)
+
+    plain = {}
+    frames = {}
+    for w in worker_counts:
+        dt, fr = timed_parse(w)
+        plain[w] = {"seconds": round(dt, 3),
+                    "rows_per_sec": round(fr.nrows / dt, 1),
+                    "mb_per_sec": round(nbytes / 1e6 / dt, 1)}
+        if w in (1, worker_counts[-1]):
+            frames[w] = fr
+        print(f"# workers={w}: {dt:.2f}s "
+              f"({fr.nrows / dt / 1e6:.2f}M rows/s)", file=sys.stderr)
+    nrows = frames[1].nrows
+
+    # gzipped source through the streamed-decompression ingest path
+    gz = gzip.compress(raw, compresslevel=1)
+    gz_res = {}
+    gz_identical = True
+    for w in (1, worker_counts[-1]):
+        best, fr = None, None
+        for _ in range(max(1, repeats)):
+            t = time.time()
+            f = parse_bytes("bench.csv.gz", gz, workers=w)
+            dt = time.time() - t
+            if best is None or dt < best:
+                best, fr = dt, f
+        gz_res[w] = {"seconds": round(best, 3),
+                     "rows_per_sec": round(fr.nrows / best, 1)}
+        gz_identical = gz_identical and _frames_identical(frames[1], fr)
+        print(f"# gz workers={w}: {best:.2f}s", file=sys.stderr)
+
+    # bit-identity, same run: parallel vs workers=1 on the full input,
+    # plus the serial whole-text oracle on a record-aligned prefix small
+    # enough to take the serial path (it is pure-python and ~25x slower)
+    wmax = worker_counts[-1]
+    identical = _frames_identical(frames[1], frames[wmax])
+    serial_mb = float(os.environ.get("BENCH_PARSE_SERIAL_MB", 8))
+    cut = raw.rfind(b"\n", 0, int(serial_mb * 1e6)) + 1
+    slice_text = raw[:cut].decode()
+    # chunk threshold above the slice size forces the true serial
+    # whole-text path (parse_csv routes anything larger to the pipeline)
+    os.environ["H2O3_TPU_PARSE_CHUNK_BYTES"] = str(1 << 28)
+    t = time.time()
+    serial_fr = parse_csv(slice_text)
+    serial_s = time.time() - t
+    os.environ["H2O3_TPU_PARSE_CHUNK_BYTES"] = str(256 << 10)
+    par_slice = parse_csv(slice_text, workers=wmax)
+    os.environ["H2O3_TPU_PARSE_CHUNK_BYTES"] = str(chunk_bytes)
+    serial_identical = _frames_identical(serial_fr, par_slice)
+    serial_rps = serial_fr.nrows / serial_s
+
+    rps1, rpsN = plain[1]["rows_per_sec"], plain[wmax]["rows_per_sec"]
+    tel = {
+        k: v for k, v in telemetry.REGISTRY.summary().items()
+        if k.startswith("parse")
+    }
+    result = {
+        "metric": "parse_rows_per_sec",
+        "value": rpsN,
+        "unit": f"rows/sec ({wmax} workers, mixed NUM/CAT/TIME/STR csv)",
+        "vs_baseline": round(rpsN / serial_rps, 2),
+        "detail": {
+            "csv_mb": round(nbytes / 1e6, 1),
+            "n_rows": nrows,
+            "chunk_bytes": chunk_bytes,
+            "host_cpus": os.cpu_count(),
+            "workers": plain,
+            "gz": gz_res,
+            "scaling_efficiency": {
+                w: round(plain[w]["rows_per_sec"] / (w * rps1), 3)
+                for w in worker_counts
+            },
+            "speedup_8w_vs_1w": round(rpsN / rps1, 2),
+            "serial_python_rows_per_sec": round(serial_rps, 1),
+            "speedup_pipeline_vs_serial_python": round(rpsN / serial_rps, 2),
+            "bit_identical_1w_vs_8w_full": identical,
+            "bit_identical_serial_vs_parallel_slice": serial_identical,
+            "bit_identical_gz_vs_plain": gz_identical,
+            "vs_baseline_is": "pipeline rows/sec / serial-python rows/sec",
+        },
+        "telemetry": {k: (round(v, 3) if isinstance(v, float) else v)
+                      for k, v in tel.items()},
+    }
+    with open(os.path.join(_HERE, "PARSE_BENCH.json"), "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))
+
+
 def _cache_bench_stat(cols, mask):
     """Module-level map fn so repeat dispatches share one plan-cache key."""
     import jax.numpy as jnp
@@ -457,5 +629,7 @@ if __name__ == "__main__":
         _worker()
     elif "--cache-bench" in sys.argv:
         _cache_bench()
+    elif "--parse-bench" in sys.argv:
+        _parse_bench()
     else:
         main()

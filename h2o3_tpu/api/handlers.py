@@ -381,6 +381,13 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
             kw["separator"] = chr(int(params["separator"]))
         if params.get("check_header"):
             kw["header"] = int(params["check_header"]) == 1
+        # chunk-parallel tokenization width (frame/parse.py two-phase
+        # pipeline); absent -> H2O3_TPU_PARSE_WORKERS / host cores
+        if params.get("parse_workers"):
+            try:
+                kw["workers"] = max(1, int(params["parse_workers"]))
+            except (TypeError, ValueError):
+                raise RestError(400, "parse_workers must be an integer")
         # forced types from ParseSetup must survive Parse (the reference's
         # two-phase parse honors the client-edited setup)
         names = params.get("column_names")

@@ -165,6 +165,30 @@ def list_sources(uri: str) -> List[str]:
 # transparent decompression (water/parser/ZipUtil)
 
 
+def _zip_is_opaque(data: bytes) -> bool:
+    """True when a PK-magic blob must reach a parser whole instead of
+    being exploded into entries: an .xlsx IS a zip (the XLSX parser needs
+    the archive), and an unreadable zip is passed through for the format
+    sniffer to reject with a real diagnosis."""
+    try:
+        with zipfile.ZipFile(io.BytesIO(data)) as z:
+            return "[Content_Types].xml" in z.namelist()
+    except zipfile.BadZipFile:
+        return True
+
+
+def _zip_entry_names(z: zipfile.ZipFile, name: str) -> List[str]:
+    """Parseable entries of an archive, sorted: directories and hidden
+    dotfiles (e.g. __MACOSX resource forks) are skipped."""
+    names = sorted(
+        n for n in z.namelist()
+        if not n.endswith("/") and not os.path.basename(n).startswith(".")
+    )
+    if not names:
+        raise ValueError(f"{name}: empty zip archive")
+    return names
+
+
 def decompress_parts(name: str, data: bytes) -> List[Tuple[str, bytes]]:
     """Unwrap gzip/zip by magic bytes. A multi-entry zip yields one part
     per entry (each recursively unwrapped) — entries are parsed separately
@@ -174,23 +198,11 @@ def decompress_parts(name: str, data: bytes) -> List[Tuple[str, bytes]]:
         inner = name[:-3] if name.lower().endswith(".gz") else name
         return decompress_parts(inner, gzip.decompress(data))
     if data[:4] == b"PK\x03\x04":  # zip
-        # an .xlsx IS a zip — it must reach the XLSX parser whole, not be
-        # exploded into its XML entries
-        try:
-            with zipfile.ZipFile(io.BytesIO(data)) as z:
-                if "[Content_Types].xml" in z.namelist():
-                    return [(name, data)]
-        except zipfile.BadZipFile:
+        if _zip_is_opaque(data):
             return [(name, data)]
         with zipfile.ZipFile(io.BytesIO(data)) as z:
-            names = sorted(
-                n for n in z.namelist()
-                if not n.endswith("/") and not os.path.basename(n).startswith(".")
-            )
-            if not names:
-                raise ValueError(f"{name}: empty zip archive")
             out: List[Tuple[str, bytes]] = []
-            for n in names:
+            for n in _zip_entry_names(z, name):
                 out.extend(decompress_parts(os.path.basename(n), z.read(n)))
             return out
     return [(name, data)]
@@ -199,6 +211,76 @@ def decompress_parts(name: str, data: bytes) -> List[Tuple[str, bytes]]:
 def _decompress(name: str, data: bytes) -> Tuple[str, bytes]:
     """First decompressed part — for format sniffing only."""
     return decompress_parts(name, data)[0]
+
+
+#: magic prefixes that mean "another archive layer" — nested archives are
+#: rare enough to materialize; everything else streams
+def _is_archive(head: bytes) -> bool:
+    return head[:2] == b"\x1f\x8b" or head[:4] == b"PK\x03\x04"
+
+
+class _PrefixedReader:
+    """File-like serving an already-read prefix, then the wrapped stream —
+    lets format sniffing peek without losing streamed decompression."""
+
+    def __init__(self, head: bytes, stream) -> None:
+        self._head = head
+        self._pos = 0
+        self._stream = stream
+        #: decompressed bytes handed to the consumer (ingest accounting)
+        self.count = 0
+
+    def read(self, n: int = -1) -> bytes:
+        out: List[bytes] = []
+        if self._pos < len(self._head):
+            if n is None or n < 0:
+                out.append(self._head[self._pos:])
+                self._pos = len(self._head)
+            else:
+                take = self._head[self._pos:self._pos + n]
+                self._pos += len(take)
+                out.append(take)
+                n -= len(take)
+        if n is None or n < 0:
+            out.append(self._stream.read())
+        elif n > 0:
+            out.append(self._stream.read(n))
+        b = b"".join(out)
+        self.count += len(b)
+        return b
+
+
+def iter_part_streams(name: str, data: bytes):
+    """Streamed counterpart of decompress_parts: yields (part_name,
+    file-like) with gzip/zip entries decoded INCREMENTALLY as the consumer
+    reads, so decompression overlaps the parallel parse's chunk
+    tokenization instead of materializing whole decompressed parts first.
+    Nested archives (gz-in-zip etc.) recurse, materializing only the
+    nested layer."""
+    if data[:2] == b"\x1f\x8b":  # gzip
+        inner = name[:-3] if name.lower().endswith(".gz") else name
+        gf = gzip.GzipFile(fileobj=io.BytesIO(data))
+        head = gf.read(4)
+        if _is_archive(head):
+            yield from iter_part_streams(inner, head + gf.read())
+        else:
+            yield inner, _PrefixedReader(head, gf)
+        return
+    if data[:4] == b"PK\x03\x04":  # zip
+        if _zip_is_opaque(data):  # xlsx / unreadable: hand over whole
+            yield name, io.BytesIO(data)
+            return
+        with zipfile.ZipFile(io.BytesIO(data)) as z:
+            for n in _zip_entry_names(z, name):
+                with z.open(n) as probe:
+                    head = probe.read(4)
+                if _is_archive(head):
+                    yield from iter_part_streams(os.path.basename(n), z.read(n))
+                else:
+                    with z.open(n) as f:
+                        yield os.path.basename(n), f
+        return
+    yield name, io.BytesIO(data)
 
 
 # ---------------------------------------------------------------------------
@@ -664,13 +746,34 @@ def parse_bytes(
 ) -> Frame:
     """One raw blob -> Frame: decompression, per-part format sniff, parse,
     bind. The single format dispatch shared by the library path
-    (parse_source/import_parse) and the REST /3/Parse handler."""
+    (parse_source/import_parse) and the REST /3/Parse handler.
+
+    CSV parts parse STREAMED (parse_csv_stream): archive decompression
+    stays incremental and overlaps the parallel parse's chunk
+    tokenization.  Other formats need their whole part materialized."""
+    from h2o3_tpu.frame.parse import parse_csv_stream
+
     frames: List[Frame] = []
-    for part_name, part in decompress_parts(name, data):
-        f = fmt or sniff_format(part_name, part)
+    for part_name, stream in iter_part_streams(name, data):
+        head_parts: List[bytes] = []
+        got = 0
+        while got < 8192:  # sniff prefix (loop: streams may read short)
+            b = stream.read(8192 - got)
+            if not b:
+                break
+            head_parts.append(b)
+            got += len(b)
+        head = b"".join(head_parts)
+        rdr = _PrefixedReader(head, stream)
+        f = fmt or sniff_format(part_name, head)
         if f == "csv":
-            fr = parse_csv(part.decode("utf-8", errors="replace"), **csv_kw)
-        elif f == "svmlight":
+            fr = parse_csv_stream(rdr, **csv_kw)
+            _INGEST_BYTES.inc(rdr.count, format=f)
+            _INGEST_ROWS.inc(fr.nrows, format=f)
+            frames.append(fr)
+            continue
+        part = rdr.read()
+        if f == "svmlight":
             fr = parse_svmlight(part.decode("utf-8", errors="replace"))
         elif f == "arff":
             fr = parse_arff(part.decode("utf-8", errors="replace"))

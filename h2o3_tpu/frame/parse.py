@@ -18,10 +18,15 @@ POST /3/Parse.
 
 from __future__ import annotations
 
+import io
+import math
 import os
 import re
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Union
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -29,14 +34,54 @@ from h2o3_tpu.frame.frame import ColType, Column, Frame, NA_CAT
 from h2o3_tpu.util import telemetry
 
 #: parse accounting — every CSV parse (library call, REST /3/Parse, multi-part
-#: archives via ingest.parse_bytes) lands here; labels split the native fast
-#: path from the pure-python tokenizer so the hot path's share is measurable
+#: archives via ingest.parse_bytes) lands here. ``parser`` labels:
+#:   csv             — serial pure-python tokenizer (small inputs)
+#:   csv_native      — serial whole-buffer all-numeric native fast path
+#:   native-parallel — chunk-parallel pipeline, every chunk tokenized by the
+#:                     native (csv.cpp) chunk primitives
+#:   python-parallel — chunk-parallel pipeline, every chunk on the python
+#:                     tokenizer (quotes/unicode/no native lib)
+#:   mixed-parallel  — chunk-parallel pipeline with both kinds of chunks
 _PARSE_ROWS = telemetry.counter(
     "parse_rows_total", "rows parsed into frames", labels=("parser",)
 )
 _PARSE_SECONDS = telemetry.histogram(
     "parse_seconds", "wall seconds per CSV parse", labels=("parser",)
 )
+_PARSE_CHUNKS = telemetry.counter(
+    "parse_chunks_total",
+    "byte chunks tokenized by the two-phase parallel CSV parse",
+    labels=("parser",),
+)
+_PARSE_WORKERS = telemetry.gauge(
+    "parse_workers",
+    "thread workers used by the most recent chunk-parallel CSV parse",
+)
+
+#: chunk-parallel pipeline knobs. Workers default to the host's cores (the
+#: reference's chunk-parallel MultiFileParseTask shape); chunk size trades
+#: scheduling granularity against per-chunk overhead.
+DEFAULT_CHUNK_BYTES = 8 << 20
+_SAMPLE_BYTES = 1 << 20
+
+
+def _env_workers() -> int:
+    try:
+        w = int(os.environ.get("H2O3_TPU_PARSE_WORKERS", "") or 0)
+    except ValueError:
+        w = 0
+    return max(1, w or (os.cpu_count() or 1))
+
+
+def _chunk_bytes() -> int:
+    try:
+        c = int(os.environ.get("H2O3_TPU_PARSE_CHUNK_BYTES", "") or 0)
+    except ValueError:
+        c = 0
+    c = c or DEFAULT_CHUNK_BYTES
+    # floor keeps tests free to force many chunks; ceiling keeps the native
+    # indexer's int32 cell offsets valid
+    return min(max(c, 64), 1 << 28)
 
 #: Default NA tokens (reference: water/parser/ParseSetup + CsvParser NA handling)
 DEFAULT_NA_STRINGS = ("", "NA", "N/A", "na", "n/a", "NaN", "nan", "null", "NULL", "?")
@@ -49,6 +94,52 @@ _TIME_PATTERNS = (
 _UUID_RE = re.compile(
     r"^[0-9a-fA-F]{8}-[0-9a-fA-F]{4}-[0-9a-fA-F]{4}-[0-9a-fA-F]{4}-[0-9a-fA-F]{12}$"
 )
+#: record terminators str.splitlines honors beyond \\n / \\r\\n: lone \\r plus
+#: \\v \\f \\x1c-\\x1e NEL(U+0085) LS(U+2028) PS(U+2029) — any of these makes
+#: a byte-level newline scan split records differently from the python path
+_SPLITLINES_DIVERGENT_RE = re.compile(
+    "[\v\f\x1c\x1d\x1e\x85\u2028\u2029]|\r(?!\n)"
+)
+def _has_divergent(buf: bytes, start: int, eof: bool) -> bool:
+    """Any record terminator in buf[start:] the \\n scan would miss (lone
+    \\r, \\v, \\f, \\x1c-\\x1e, NEL, LS, PS)?  A trailing \\r or an
+    incomplete E2 80 prefix on the final bytes is NOT flagged unless eof:
+    the caller holds the last byte back and rescans it with overlap once
+    the next block arrives.  Vectorized \u2014 this runs on the pipeline's
+    main thread over every block, so it must outrun the tokenizers."""
+    if len(buf) <= start:
+        return False
+    # memchr pre-filters: the common LF/ASCII block pays ~nothing, and
+    # only \r / \xe2 carriers reach the vectorized context checks.  NEL
+    # must match the full utf-8 sequence C2 85 — a bare 0x85 is the
+    # continuation byte of ordinary characters (Cyrillic, CJK) and
+    # decodes alone to U+FFFD, which splitlines does not split on.
+    for hard in (b"\x0b", b"\x0c", b"\x1c", b"\x1d", b"\x1e", b"\xc2\x85"):
+        if buf.find(hard, start) >= 0:
+            return True
+    has_cr = buf.find(b"\r", start) >= 0
+    has_e2 = buf.find(b"\xe2", start) >= 0
+    if not (has_cr or has_e2):
+        return False
+    arr = np.frombuffer(buf, dtype=np.uint8)[start:]
+    n = int(arr.size)
+    cr = np.flatnonzero(arr == 13) if has_cr else np.empty(0, np.int64)
+    if cr.size:
+        if cr[-1] == n - 1:
+            if eof:
+                return True  # trailing lone \r (conservative)
+            cr = cr[:-1]  # may yet be the CRLF half: held back
+        if cr.size and bool((arr[cr + 1] != 10).any()):
+            return True
+    if not has_e2:
+        return False
+    e2 = np.flatnonzero(arr == 0xE2)
+    e2 = e2[e2 <= n - 3]  # incomplete tail prefixes: held back / harmless
+    if e2.size:
+        nxt, nxt2 = arr[e2 + 1], arr[e2 + 2]
+        if bool(((nxt == 0x80) & ((nxt2 == 0xA8) | (nxt2 == 0xA9))).any()):
+            return True
+    return False
 _PATHLIKE_SUFFIXES = (".csv", ".txt", ".tsv", ".data", ".dat", ".gz", ".zip", ".svm", ".arff")
 
 
@@ -75,6 +166,16 @@ def parse_setup(
 ) -> ParseSetup:
     """Guess separator/header/types from a sample (ParseSetup.guessSetup)."""
     records = _sample_records(src, sample_rows + 1)
+    return _setup_from_records(records, separator, header, column_types, na_strings)
+
+
+def _setup_from_records(
+    records: List[str],
+    separator: Optional[str],
+    header: Optional[bool],
+    column_types: Optional[Dict[str, str]],
+    na_strings: Sequence[str],
+) -> ParseSetup:
     if not records:
         raise ValueError("empty input")
     sep = separator or _guess_separator(records)
@@ -113,12 +214,80 @@ def parse_csv(
     column_types: Optional[Dict[str, str]] = None,
     na_strings: Sequence[str] = DEFAULT_NA_STRINGS,
     setup: Optional[ParseSetup] = None,
+    workers: Optional[int] = None,
 ) -> Frame:
-    """Parse a CSV file or literal CSV text into a Frame (POST /3/Parse)."""
-    import time as _time
+    """Parse a CSV file or literal CSV text into a Frame (POST /3/Parse).
 
-    t0 = _time.perf_counter()
-    text = _read_all(src)  # single read; setup guessing reuses it
+    Inputs larger than one chunk (``H2O3_TPU_PARSE_CHUNK_BYTES``, default
+    8 MiB) take the chunk-parallel two-phase pipeline
+    (``ParseDataset.java:623``): newline/quote-safe byte chunks are
+    tokenized concurrently by ``workers`` threads
+    (``H2O3_TPU_PARSE_WORKERS``, default host cores), then per-chunk
+    categorical dictionaries merge into one sorted global domain — the
+    result is bit-identical to the serial path at any worker count."""
+    t0 = time.perf_counter()
+    s = os.fspath(src) if not isinstance(src, str) else src
+    if not s.strip():
+        raise ValueError("empty input")
+    threshold = _chunk_bytes()
+    if "\n" not in s:
+        if os.path.exists(s):
+            if os.path.getsize(s) > threshold:
+                with open(s, "rb") as f:
+                    return _parse_csv_stream_impl(
+                        f, t0, separator, header, column_types, na_strings,
+                        setup, workers,
+                    )
+            with open(s, "r", encoding="utf-8", errors="replace") as f:
+                text = f.read()
+            return _parse_csv_text(
+                text, t0, separator, header, column_types, na_strings, setup
+            )
+        if _looks_like_path(s):
+            raise FileNotFoundError(s)
+    if len(s) > threshold:  # large literal text: pipeline over its bytes
+        return _parse_csv_stream_impl(
+            io.BytesIO(s.encode("utf-8")), t0, separator, header,
+            column_types, na_strings, setup, workers,
+        )
+    return _parse_csv_text(
+        s, t0, separator, header, column_types, na_strings, setup
+    )
+
+
+def parse_csv_stream(
+    stream,
+    separator: Optional[str] = None,
+    header: Optional[bool] = None,
+    column_types: Optional[Dict[str, str]] = None,
+    na_strings: Sequence[str] = DEFAULT_NA_STRINGS,
+    setup: Optional[ParseSetup] = None,
+    workers: Optional[int] = None,
+) -> Frame:
+    """Parse a binary CSV stream (anything with ``.read(n)``) into a Frame.
+
+    This is the streamed-decompression entry (frame/ingest.py): gz/zip
+    decoding stays incremental — bytes are pulled block-by-block and
+    overlap with chunk tokenization already in flight, instead of
+    materializing the whole decompressed text first."""
+    t0 = time.perf_counter()
+    return _parse_csv_stream_impl(
+        stream, t0, separator, header, column_types, na_strings, setup,
+        workers,
+    )
+
+
+def _parse_csv_text(
+    text: str,
+    t0: float,
+    separator: Optional[str],
+    header: Optional[bool],
+    column_types: Optional[Dict[str, str]],
+    na_strings: Sequence[str],
+    setup: Optional[ParseSetup],
+) -> Frame:
+    """Serial whole-text parse — the small-input path and the oracle the
+    chunk pipeline is pinned bit-identical against (tests/test_parse_parallel)."""
     if setup is None:
         setup = parse_setup(
             text,
@@ -130,28 +299,531 @@ def parse_csv(
     fast = _native_numeric_fast(text, setup)
     if fast is not None:
         _PARSE_ROWS.inc(fast.nrows, parser="csv_native")
-        _PARSE_SECONDS.observe(_time.perf_counter() - t0, parser="csv_native")
+        _PARSE_SECONDS.observe(time.perf_counter() - t0, parser="csv_native")
         return fast
     records = _split_records(text)
     if setup.skip_blank_lines:
         records = [r for r in records if r.strip()]
     if setup.header:
         records = records[1:]
+    fr = Frame(_records_to_columns(records, setup, frozenset(setup.na_strings)))
+    _PARSE_ROWS.inc(fr.nrows, parser="csv")
+    _PARSE_SECONDS.observe(time.perf_counter() - t0, parser="csv")
+    return fr
+
+
+def _parse_csv_stream_impl(
+    stream,
+    t0: float,
+    separator: Optional[str],
+    header: Optional[bool],
+    column_types: Optional[Dict[str, str]],
+    na_strings: Sequence[str],
+    setup: Optional[ParseSetup],
+    workers: Optional[int],
+) -> Frame:
+    cb = _chunk_bytes()
+    target = max(cb + 1, _SAMPLE_BYTES)
+    first = _read_block(stream, target)
+    if len(first) <= cb:  # fits in one chunk: serial path
+        return _parse_csv_text(
+            first.decode("utf-8", errors="replace"), t0, separator, header,
+            column_types, na_strings, setup,
+        )
+    if setup is None:
+        # guessSetup on a sampled prefix; when the stream continues past
+        # the sample, the trailing record may be cut mid-stream and is
+        # dropped (same as _sample_records on files)
+        sample = first[:_SAMPLE_BYTES]
+        complete = len(first) < target and len(first) <= _SAMPLE_BYTES
+        recs = _split_records(sample.decode("utf-8", errors="replace"))
+        if not complete and recs:
+            recs = recs[:-1]
+        recs = [r for r in recs if r.strip()][:1001]
+        if not recs:
+            # no complete record inside the sample window (e.g. one giant
+            # quoted record): chunking gains nothing — drain the stream
+            # and take the serial whole-text path
+            rest = first + _read_block(stream, 1 << 62)
+            return _parse_csv_text(
+                rest.decode("utf-8", errors="replace"), t0, separator,
+                header, column_types, na_strings, None,
+            )
+        setup = _setup_from_records(
+            recs, separator, header, column_types, na_strings
+        )
+
+    def blocks() -> Iterator[bytes]:
+        yield first
+        while True:
+            b = stream.read(cb)
+            if not b:
+                return
+            yield b
+
+    return _parse_pipeline(blocks(), setup, t0, workers)
+
+
+def _read_block(stream, n: int) -> bytes:
+    """Read exactly n bytes unless EOF (some streams return short reads)."""
+    parts: List[bytes] = []
+    got = 0
+    while got < n:
+        b = stream.read(n - got)
+        if not b:
+            break
+        parts.append(b)
+        got += len(b)
+    return b"".join(parts)
+
+
+# ---------------------------------------------------------------------------
+# chunk-parallel two-phase pipeline
+#
+# Phase 1 (map): the byte stream is cut into newline-safe chunks (RFC-4180
+# quoted newlines respected via quote parity, so every chunk starts at a
+# record boundary), and a ThreadPoolExecutor tokenizes chunks concurrently.
+# Eligible chunks run entirely inside GIL-releasing native (csv.cpp) calls —
+# cell indexing, float/time parsing, dictionary encoding — so the workers
+# scale with host cores; chunks with quotes/unicode take the python
+# tokenizer.  Phase 2 (reduce): per-chunk categorical dictionaries merge
+# into one lexicographically sorted global domain (Categorical.java
+# semantics), per-chunk codes are remapped, columns concatenate.  The
+# output is bit-identical to the serial path for any worker count and any
+# chunk size.
+
+
+class _DivergentStream(Exception):
+    """Raised by the chunker when a block contains a record terminator the
+    byte-level \\n scan cannot honor (lone \\r, \\v, \\f, \\x1c-\\x1e,
+    NEL/LS/PS): cutting past it would split records differently from the
+    python oracle.  Chunks already cut are clean — a chunk free of these
+    bytes (and always starting/ending at record boundaries) tokenizes
+    identically under either global record-splitting discipline — so the
+    pipeline recovers by parsing the unconsumed remainder with the right
+    semantics instead of discarding work.  Carries the unconsumed buffer,
+    whether the header was already cut, and whether any consumed byte was
+    a quote (which picks the global discipline — _split_records)."""
+
+    def __init__(self, buf: bytes, header_done: bool, seen_quote: bool):
+        super().__init__("splitlines-divergent record terminator")
+        self.buf = buf
+        self.header_done = header_done
+        self.seen_quote = seen_quote
+
+
+def _iter_body_chunks(
+    blocks: Iterable[bytes],
+    chunk_bytes: int,
+    skip_header: bool,
+    skip_blanks: bool,
+) -> Iterator[bytes]:
+    """Cut a byte-block stream into record-aligned body chunks.
+
+    Leading blank records and the header record are consumed here, so
+    workers see pure body bytes.  Chunks always end just past a newline at
+    even quote parity; a record longer than chunk_bytes simply produces a
+    bigger chunk.  Raises _DivergentStream before cutting any region that
+    contains a record terminator the \\n scan would miss."""
+    it = iter(blocks)
+    buf = b""
+    eof = False
+    scanned = 0  # buf offset below which divergent bytes were ruled out
+    header_done = not skip_header
+    seen_quote = False
+
+    def fill(target: int) -> None:
+        nonlocal buf, eof, scanned, seen_quote
+        while not eof and len(buf) < target:
+            b = next(it, None)
+            if b is None:
+                eof = True
+            elif b:
+                seen_quote = seen_quote or b'"' in b
+                buf += b
+        # scan the newly arrived region (2-byte back-overlap covers the
+        # multi-byte LS/PS patterns and the held-back final byte)
+        if len(buf) > scanned:
+            if _has_divergent(buf, max(scanned - 2, 0), eof):
+                raise _DivergentStream(buf, header_done, seen_quote)
+            scanned = len(buf) if eof else len(buf) - 1
+
+    if skip_header:
+        while True:
+            fill(len(buf) + chunk_bytes + 1)
+            cut = _header_end(buf, skip_blanks, eof)
+            if cut is not None:
+                buf = buf[cut:]
+                scanned = max(scanned - cut, 0)
+                header_done = True
+                break
+            if eof:
+                return  # the whole input is header (+ blanks): empty body
+    target = chunk_bytes
+    while True:
+        fill(target)
+        if eof and len(buf) <= chunk_bytes:
+            if buf:
+                yield buf
+            return
+        cut = _quote_safe_cut(buf, chunk_bytes)
+        if cut is None:
+            if eof:
+                yield buf
+                return
+            target = len(buf) + chunk_bytes  # record spans the chunk: grow
+            continue
+        yield buf[:cut]
+        buf = buf[cut:]
+        scanned = max(scanned - cut, 0)
+        target = chunk_bytes
+
+
+def _quote_safe_cut(buf: bytes, target: int) -> Optional[int]:
+    """End offset (just past a record-terminating newline) of the largest
+    record-aligned prefix near ``target``; None when buf holds no complete
+    record.  Chunks start at record boundaries, so quote parity counts
+    from zero: a newline is a record boundary iff the quotes before it
+    are balanced (every '"' toggles — doubled quotes toggle twice, same
+    state machine as _split_records)."""
+    if buf.find(b'"') < 0:
+        p = buf.rfind(b"\n", 0, target)
+        if p < 0:
+            p = buf.find(b"\n", target)
+        return p + 1 if p >= 0 else None
+    arr = np.frombuffer(buf, dtype=np.uint8)
+    nl = np.flatnonzero(arr == 10)
+    if nl.size == 0:
+        return None
+    q = np.cumsum(arr == 34)
+    ok = nl[q[nl] % 2 == 0]
+    if ok.size == 0:
+        return None
+    cand = ok[ok < target]
+    return int(cand[-1] if cand.size else ok[0]) + 1
+
+
+def _header_end(buf: bytes, skip_blanks: bool, eof: bool) -> Optional[int]:
+    """Byte offset just past the header record (plus any leading blank
+    records); None when the buffer doesn't yet contain the whole header."""
+    pos = 0
+    while True:
+        rec_end = _record_end(buf, pos)
+        if rec_end is None:
+            # at EOF the unterminated remainder IS the header; body empty
+            return len(buf) if eof else None
+        if skip_blanks and not buf[pos:rec_end].strip(b" \t\r"):
+            pos = rec_end + 1
+            continue
+        return rec_end + 1
+
+
+def _record_end(buf: bytes, pos: int) -> Optional[int]:
+    """Index of the newline terminating the record starting at pos (which
+    is a record boundary, i.e. quote parity 0), or None."""
+    if buf.find(b'"', pos) < 0:
+        p = buf.find(b"\n", pos)
+        return p if p >= 0 else None
+    arr = np.frombuffer(buf, dtype=np.uint8)[pos:]
+    nl = np.flatnonzero(arr == 10)
+    if nl.size == 0:
+        return None
+    q = np.cumsum(arr == 34)
+    ok = nl[q[nl] % 2 == 0]
+    return pos + int(ok[0]) if ok.size else None
+
+
+def _na_breaks_numeric(na_strings: Sequence[str]) -> bool:
+    """True when an NA token parses to a non-NaN number: python maps it to
+    NA while a byte-level numeric parse would yield the value.  NaN-valued
+    tokens ('NaN', 'nan') are harmless — both paths produce NaN."""
+    for t in na_strings:
+        if not t:
+            continue
+        try:
+            v = float(t)
+        except ValueError:
+            continue
+        if not math.isnan(v):  # includes +-inf: float('inf') never raises
+            return True
+    return False
+
+
+#: bytes whose presence in a chunk routes it to the python tokenizer:
+#: quotes (RFC-4180 state machine), NUL (would corrupt the gather join),
+#: and str.splitlines' extra record terminators (\v \f \x1c-\x1e) that a
+#: byte-level \n scan would miss
+_PY_ONLY_BYTES = (b'"', b"\x00", b"\x0b", b"\x0c", b"\x1c", b"\x1d", b"\x1e")
+
+
+def _chunk_native_ok(chunk: bytes, setup: ParseSetup) -> bool:
+    """May this chunk take the native tokenizer and stay bit-identical to
+    the python path?  Quotes/unicode/lone-\\r and (for numeric columns)
+    tokens only python's float() accepts all force the python tokenizer."""
+    if not chunk or len(setup.separator) != 1:
+        return False
+    if any(b in chunk for b in _PY_ONLY_BYTES):
+        return False
+    arr = np.frombuffer(chunk, dtype=np.uint8)
+    if int(arr.max()) > 127:  # unicode: \x85/  terminators, NBSP strip
+        return False
+    cr = np.flatnonzero(arr == 13)
+    if cr.size and (
+        cr[-1] == arr.size - 1 or bool((arr[cr + 1] != 10).any())
+    ):
+        return False  # lone \r splits records in python, not in a \n scan
+    return True
+
+
+def _pack_na(na_strings: Sequence[str]) -> Tuple[bytes, np.ndarray, np.ndarray]:
+    """NA tokens packed as (blob, int32 starts, int32 ends) for the native
+    dictionary/gather primitives."""
+    toks = [t.encode("utf-8") for t in na_strings]
+    starts = np.empty(len(toks), dtype=np.int32)
+    ends = np.empty(len(toks), dtype=np.int32)
+    pos = 0
+    for i, t in enumerate(toks):
+        starts[i] = pos
+        pos += len(t)
+        ends[i] = pos
+    return b"".join(toks), starts, ends
+
+
+#: per-chunk result: (nrows, payloads, used_native) where payloads[j] is a
+#: float64 array (NUM/TIME/BAD), (int32 codes, local domain) for CAT, or an
+#: object array (STR/UUID)
+_ChunkResult = Tuple[int, list, bool]
+
+
+def _parse_chunk(chunk: bytes, setup: ParseSetup, na: frozenset, napack) -> _ChunkResult:
+    if napack is not None and _chunk_native_ok(chunk, setup):
+        try:
+            res = _parse_chunk_native(chunk, setup, na, napack)
+            if res is not None:
+                return res
+        except Exception:
+            pass  # any native surprise falls back to the python oracle
+    return _parse_chunk_python(chunk, setup, na)
+
+
+def _parse_chunk_native(
+    chunk: bytes, setup: ParseSetup, na: frozenset, napack
+) -> Optional[_ChunkResult]:
+    from h2o3_tpu import native
+
+    width = len(setup.column_names)
+    idx = native.csv_index_chunk(
+        chunk, setup.separator, width, setup.skip_blank_lines
+    )
+    if idx is None:
+        return None
+    starts, ends = idx
+    n = starts.shape[0]
+    na_blob, na_st, na_en = napack
+    payloads: list = []
+    for j, ctype in enumerate(setup.column_types):
+        s = np.ascontiguousarray(starts[:, j])
+        e = np.ascontiguousarray(ends[:, j])
+        if ctype is ColType.CAT:
+            r = native.dict_encode_cells(chunk, s, e, na_blob, na_st, na_en)
+            if r is None:
+                return None
+            codes, ust, uen = r
+            domain = [
+                chunk[ust[k]:uen[k]].decode("ascii") for k in range(len(ust))
+            ]
+            payloads.append((codes, domain))
+        elif ctype in (ColType.STR, ColType.UUID):
+            r = native.gather_cells(chunk, s, e, na_blob, na_st, na_en)
+            if r is None:
+                return None
+            joined, mask = r
+            arr = np.empty(n, dtype=object)
+            if n:
+                arr[:] = joined.decode("ascii").split("\n")
+                arr[mask.view(bool)] = None
+            payloads.append(arr)
+        elif ctype is ColType.TIME:
+            r = native.parse_cells_time(chunk, s, e)
+            if r is None:
+                return None
+            out, flags = r
+            bad = np.flatnonzero(flags)
+            if bad.size:  # NA tokens / nonstandard formats: python oracle
+                toks = [
+                    chunk[s[i]:e[i]].decode("ascii") for i in bad
+                ]
+                out[bad] = _parse_times(toks, na)
+            payloads.append(out)
+        else:  # NUM / BAD
+            out = native.parse_cells_f64(chunk, s, e)
+            if out is None:
+                return None
+            # python's float() accepts underscore separators (1_000) the
+            # native tokenizer rejects as junk; only NaN cells can hide
+            # one, so repair just those
+            if b"_" in chunk:
+                for i in np.flatnonzero(np.isnan(out)):
+                    cell = chunk[s[i]:e[i]]
+                    if b"_" in cell:
+                        t = cell.decode("ascii")
+                        if t not in na:
+                            try:
+                                out[i] = float(t)
+                            except ValueError:
+                                pass
+            payloads.append(out)
+    return n, payloads, True
+
+
+def _records_to_columns(
+    records: List[str], setup: ParseSetup, na: frozenset
+) -> List[Column]:
+    """Tokenize logical records into built Columns — the ONE record
+    loop both the serial path and the python chunk workers share (the
+    pipeline's bit-identity contract depends on them never diverging)."""
     width = len(setup.column_names)
     cells: List[List[str]] = [[] for _ in range(width)]
     for rec in records:
         toks = _tokenize(rec, setup.separator)
         for j in range(width):
             cells[j].append(toks[j] if j < len(toks) else "")
-    na = frozenset(setup.na_strings)
-    cols = [
+    return [
         _build_column(setup.column_names[j], setup.column_types[j], cells[j], na)
         for j in range(width)
     ]
-    fr = Frame(cols)
-    _PARSE_ROWS.inc(fr.nrows, parser="csv")
-    _PARSE_SECONDS.observe(_time.perf_counter() - t0, parser="csv")
+
+
+def _parse_chunk_python(
+    chunk: bytes, setup: ParseSetup, na: frozenset,
+    force_machine: Optional[bool] = None,
+) -> _ChunkResult:
+    text = chunk.decode("utf-8", errors="replace")
+    records = _split_records(text, force_machine)
+    if setup.skip_blank_lines:
+        records = [r for r in records if r.strip()]
+    payloads: list = []
+    for j, col in enumerate(_records_to_columns(records, setup, na)):
+        if setup.column_types[j] is ColType.CAT:
+            payloads.append((col.data, col.domain))
+        else:
+            payloads.append(col.data)
+    return len(records), payloads, False
+
+
+def _parse_pipeline(
+    blocks: Iterable[bytes],
+    setup: ParseSetup,
+    t0: float,
+    workers: Optional[int],
+) -> Frame:
+    na = frozenset(setup.na_strings)
+    w = max(1, int(workers)) if workers else _env_workers()
+    napack = None
+    try:
+        from h2o3_tpu import native
+
+        if native.available():
+            napack = _pack_na(setup.na_strings)
+    except Exception:
+        napack = None
+    if napack is not None and _na_breaks_numeric(setup.na_strings) and any(
+        t in (ColType.NUM, ColType.BAD) for t in setup.column_types
+    ):
+        napack = None  # a numeric NA token breaks native float parity
+
+    futures: list = []
+    tail_result: Optional[_ChunkResult] = None
+    with ThreadPoolExecutor(max_workers=w) as ex:
+        inflight: deque = deque()
+        try:
+            for chunk in _iter_body_chunks(
+                blocks, _chunk_bytes(), setup.header, setup.skip_blank_lines
+            ):
+                fut = ex.submit(_parse_chunk, chunk, setup, na, napack)
+                futures.append(fut)
+                inflight.append(fut)
+                # bound decompress-ahead so memory stays ~W chunks, while the
+                # decode of chunk k+1 still overlaps the tokenize of chunk k
+                while len(inflight) > w * 4:
+                    inflight.popleft().result()
+        except _DivergentStream as d:
+            # a record terminator the \n chunker cannot honor: drain the
+            # rest of the stream and recover (see _DivergentStream)
+            tail = b"".join([d.buf] + [b for b in blocks if b])
+            if not d.header_done:
+                # nothing was cut yet — the whole input takes the serial
+                # oracle (its splitlines/state-machine semantics ARE the
+                # contract the chunker could not honor here)
+                for f in futures:
+                    f.result()
+                return _parse_csv_text(
+                    tail.decode("utf-8", errors="replace"), t0,
+                    None, None, None, setup.na_strings, setup,
+                )
+            machine = d.seen_quote or b'"' in tail
+            tail_result = _parse_chunk_python(
+                tail, setup, na, force_machine=machine
+            )
+        results = [f.result() for f in futures]
+    if tail_result is not None:
+        results.append(tail_result)
+
+    n_native = sum(1 for r in results if r[2])
+    if n_native:
+        _PARSE_CHUNKS.inc(n_native, parser="native")
+    if len(results) - n_native:
+        _PARSE_CHUNKS.inc(len(results) - n_native, parser="python")
+    _PARSE_WORKERS.set(w)
+    label = (
+        "native-parallel"
+        if results and n_native == len(results)
+        else ("python-parallel" if n_native == 0 else "mixed-parallel")
+    )
+    fr = _reduce_chunks(results, setup)
+    _PARSE_ROWS.inc(fr.nrows, parser=label)
+    _PARSE_SECONDS.observe(time.perf_counter() - t0, parser=label)
     return fr
+
+
+def _reduce_chunks(results: List[_ChunkResult], setup: ParseSetup) -> Frame:
+    """Phase 2: unify per-chunk dictionaries into sorted global domains
+    (reference Categorical.java), remap codes, concatenate columns."""
+    cols: List[Column] = []
+    for j, name in enumerate(setup.column_names):
+        ctype = setup.column_types[j]
+        if ctype is ColType.CAT:
+            domains = [r[1][j][1] for r in results]
+            global_domain = sorted(set().union(*map(set, domains))) if domains else []
+            gd = np.array(global_domain) if global_domain else None
+            parts = []
+            for r in results:
+                codes, dom = r[1][j]
+                if dom:
+                    remap = np.searchsorted(gd, np.array(dom)).astype(np.int32)
+                    codes = np.where(
+                        codes >= 0, remap[np.clip(codes, 0, None)], NA_CAT
+                    ).astype(np.int32)
+                parts.append(codes)
+            data = (
+                np.concatenate(parts) if parts else np.empty(0, dtype=np.int32)
+            )
+            cols.append(Column(name, data, ColType.CAT, global_domain))
+        elif ctype in (ColType.STR, ColType.UUID):
+            parts = [r[1][j] for r in results]
+            data = (
+                np.concatenate(parts) if parts else np.empty(0, dtype=object)
+            )
+            cols.append(Column(name, data, ctype))
+        else:
+            parts = [r[1][j] for r in results]
+            data = (
+                np.concatenate(parts)
+                if parts
+                else np.empty(0, dtype=np.float64)
+            )
+            cols.append(Column(name, data, ctype))
+    return Frame(cols)
 
 
 def column_from_strings(
@@ -179,16 +851,33 @@ def _native_numeric_fast(text: str, setup: ParseSetup) -> Optional[Frame]:
         return None
     if len(setup.separator) != 1 or '"' in text:
         return None
+    # python float() accepts unicode digits a byte scan never will
+    if not text.isascii():
+        return None
+    # record terminators python honors that a byte-level \n scan does not:
+    # lone \r (old-Mac endings) and str.splitlines' extra terminators.
+    # CRLF is fine — the native tokenizer strips the \r itself.
+    if _SPLITLINES_DIVERGENT_RE.search(text):
+        return None
     # native parses every physical line; blank or whitespace-only lines would
     # become all-NaN rows where python (skip_blank_lines) drops them
     if re.search(r"(?m)^[ \t\r]*$", text[:-1] if text.endswith("\n") else text):
         return None
-    # numeric literals python accepts but the native tokenizer doesn't
-    # (underscore separators like 1_000) must take the python path
-    if "_" in text:
+    body_start = 0
+    if setup.header:
+        nl = text.find("\n")
+        if nl < 0:
+            return None
+        body_start = nl + 1
+    # numeric literals only python's float() accepts (underscore separators
+    # like 1_000) must take the python path — but scan only the BODY: a
+    # header named col_1 must not disable the fast path for the whole file
+    if text.find("_", body_start) >= 0:
         return None
-    # any NA token that parses as a number would be NA in python, numeric here
-    if any(t and _is_number(t) for t in setup.na_strings):
+    # an NA token that parses to a non-NaN number would be NA in python but
+    # numeric here; NaN-valued tokens ('NaN', 'nan' — in the DEFAULT list)
+    # produce NaN on both paths and must not disable the fast path
+    if _na_breaks_numeric(setup.na_strings):
         return None
     try:
         from h2o3_tpu import native
@@ -235,10 +924,16 @@ def _read_all(src: Union[str, os.PathLike]) -> str:
     return s  # literal CSV text
 
 
-def _split_records(text: str) -> List[str]:
+def _split_records(text: str, force_machine: Optional[bool] = None) -> List[str]:
     """Split text into logical records: newlines inside double quotes do NOT
-    terminate a record (RFC 4180)."""
-    if '"' not in text:
+    terminate a record (RFC 4180).  Quote-free text takes str.splitlines
+    (its richer terminator set is the long-standing serial behavior);
+    ``force_machine`` overrides that local choice with the *global* one —
+    the chunk pipeline's divergent-tail recovery must split a quote-free
+    tail with the quote state machine when the rest of the input had
+    quotes, exactly as the serial whole-text pass would."""
+    machine = ('"' in text) if force_machine is None else force_machine
+    if not machine:
         return text.splitlines()
     out, cur, inq = [], [], False
     for ch in text:

@@ -41,6 +41,19 @@ def _build() -> bool:
         return False
 
 
+def _stale() -> bool:
+    """True when a source file is newer than the built library (the .so
+    would lack symbols added since it was compiled)."""
+    try:
+        lib_m = os.path.getmtime(_LIB_PATH)
+        return any(
+            os.path.getmtime(os.path.join(_NATIVE_DIR, f)) > lib_m
+            for f in ("csv.cpp", "codecs.cpp")
+        )
+    except OSError:
+        return False
+
+
 def get_lib() -> Optional[ctypes.CDLL]:
     """The loaded library, building it on first use; None if unavailable."""
     global _lib, _tried
@@ -54,6 +67,8 @@ def get_lib() -> Optional[ctypes.CDLL]:
         _tried = True
         if not os.path.exists(_LIB_PATH) and not _build():
             return None
+        if _stale() and not _build():
+            return None
         try:
             lib = ctypes.CDLL(_LIB_PATH)
         except OSError:
@@ -65,6 +80,34 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_char,
             ctypes.c_int32, ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
             ctypes.c_int32,
+        ]
+        _i32p = ctypes.POINTER(ctypes.c_int32)
+        _u8p = ctypes.POINTER(ctypes.c_uint8)
+        _f64p = ctypes.POINTER(ctypes.c_double)
+        lib.h2o3_csv_index_chunk.restype = ctypes.c_int64
+        lib.h2o3_csv_index_chunk.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_char, ctypes.c_int32,
+            ctypes.c_int32, _i32p, _i32p, ctypes.c_int64,
+        ]
+        lib.h2o3_parse_cells_f64.restype = None
+        lib.h2o3_parse_cells_f64.argtypes = [
+            ctypes.c_char_p, _i32p, _i32p, ctypes.c_int64, _f64p,
+        ]
+        lib.h2o3_parse_cells_time.restype = ctypes.c_int64
+        lib.h2o3_parse_cells_time.argtypes = [
+            ctypes.c_char_p, _i32p, _i32p, ctypes.c_int64, _f64p, _u8p,
+        ]
+        lib.h2o3_dict_encode_cells.restype = ctypes.c_int64
+        lib.h2o3_dict_encode_cells.argtypes = [
+            ctypes.c_char_p, _i32p, _i32p, ctypes.c_int64,
+            ctypes.c_char_p, _i32p, _i32p, ctypes.c_int32,
+            _i32p, _i32p, _i32p,
+        ]
+        lib.h2o3_gather_cells.restype = ctypes.c_int64
+        lib.h2o3_gather_cells.argtypes = [
+            ctypes.c_char_p, _i32p, _i32p, ctypes.c_int64,
+            ctypes.c_char_p, _i32p, _i32p, ctypes.c_int32,
+            ctypes.c_char_p, _u8p,
         ]
         lib.h2o3_codec_bound.restype = ctypes.c_int64
         lib.h2o3_codec_bound.argtypes = [ctypes.c_int64]
@@ -113,6 +156,124 @@ def parse_numeric_csv(
     if got < 0 or got > nrows:
         return None
     return out[:got]
+
+
+# ---------------------------------------------------------------------------
+# chunk-parallel two-phase parse primitives (frame/parse.py workers)
+#
+# Every wrapper is one ctypes call over one body chunk; ctypes drops the
+# GIL for the call's duration, which is what lets the ThreadPoolExecutor
+# in frame/parse.py tokenize chunks genuinely concurrently.
+
+
+def _i32(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def _u8(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def _f64(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+
+
+def csv_index_chunk(
+    chunk: bytes, sep: str, ncols: int, skip_blanks: bool
+) -> Optional[tuple]:
+    """Tokenize one body chunk -> ([n, ncols] cell starts, ends) offset
+    grids (whitespace-stripped; blank records skipped). None if the lib is
+    unavailable or the preallocation was insufficient."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    cap = chunk.count(b"\n") + 1
+    starts = np.empty(cap * ncols, dtype=np.int32)
+    ends = np.empty(cap * ncols, dtype=np.int32)
+    n = lib.h2o3_csv_index_chunk(
+        chunk, len(chunk), sep.encode()[:1], ncols,
+        1 if skip_blanks else 0, _i32(starts), _i32(ends), cap,
+    )
+    if n < 0:
+        return None
+    return (
+        starts[: n * ncols].reshape(n, ncols),
+        ends[: n * ncols].reshape(n, ncols),
+    )
+
+
+def parse_cells_f64(
+    chunk: bytes, starts: np.ndarray, ends: np.ndarray
+) -> Optional[np.ndarray]:
+    """One column's cells -> float64 (NaN for NA/junk)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(starts)
+    out = np.empty(n, dtype=np.float64)
+    lib.h2o3_parse_cells_f64(chunk, _i32(starts), _i32(ends), n, _f64(out))
+    return out
+
+
+def parse_cells_time(
+    chunk: bytes, starts: np.ndarray, ends: np.ndarray
+) -> Optional[tuple]:
+    """One column's cells -> epoch-ms float64 for strictly canonical time
+    tokens, plus a uint8 flag array marking cells the caller must re-parse
+    in python (NA tokens / nonstandard formats)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(starts)
+    out = np.empty(n, dtype=np.float64)
+    flags = np.empty(n, dtype=np.uint8)
+    lib.h2o3_parse_cells_time(
+        chunk, _i32(starts), _i32(ends), n, _f64(out), _u8(flags)
+    )
+    return out, flags
+
+
+def dict_encode_cells(
+    chunk: bytes, starts: np.ndarray, ends: np.ndarray,
+    na_blob: bytes, na_starts: np.ndarray, na_ends: np.ndarray,
+) -> Optional[tuple]:
+    """One column's cells -> (int32 codes, uniq_starts, uniq_ends): the
+    local categorical dictionary in first-appearance order as offsets into
+    the chunk; NA cells get code -1."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(starts)
+    codes = np.empty(n, dtype=np.int32)
+    ust = np.empty(n, dtype=np.int32)
+    uen = np.empty(n, dtype=np.int32)
+    nu = lib.h2o3_dict_encode_cells(
+        chunk, _i32(starts), _i32(ends), n,
+        na_blob, _i32(na_starts), _i32(na_ends), len(na_starts),
+        _i32(codes), _i32(ust), _i32(uen),
+    )
+    return codes, ust[:nu], uen[:nu]
+
+
+def gather_cells(
+    chunk: bytes, starts: np.ndarray, ends: np.ndarray,
+    na_blob: bytes, na_starts: np.ndarray, na_ends: np.ndarray,
+) -> Optional[tuple]:
+    """One column's cells -> (newline-joined bytes, uint8 NA mask), for a
+    single bulk decode+split on the python side."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(starts)
+    total = int((ends.astype(np.int64) - starts).sum()) + n
+    out = ctypes.create_string_buffer(max(total, 1))
+    mask = np.empty(n, dtype=np.uint8)
+    got = lib.h2o3_gather_cells(
+        chunk, _i32(starts), _i32(ends), n,
+        na_blob, _i32(na_starts), _i32(na_ends), len(na_starts),
+        out, _u8(mask),
+    )
+    return out.raw[:got], mask
 
 
 # ---------------------------------------------------------------------------

@@ -11,7 +11,9 @@
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+#include <string_view>
 #include <thread>
+#include <unordered_map>
 #include <vector>
 
 extern "C" {
@@ -30,6 +32,13 @@ namespace {
 
 // strtod-free fast path for plain decimal numbers; falls back to strtod for
 // exponents/specials. Returns NaN for non-numeric tokens.
+//
+// Bit-exactness contract: for any token this function resolves without
+// strtod, the result must equal Python's float(token) exactly. At <= 15
+// total digits ip*scale+fp is an exact int64 below 2^53, so the single
+// division is the one correctly-rounded step — identical to CPython's
+// correctly-rounded decimal->binary conversion. (The old ip + fp/scale
+// form rounded twice and could drift 1 ulp at 16-18 digits.)
 static inline double parse_token(const char* s, const char* e) {
   while (s < e && (*s == ' ' || *s == '\t')) ++s;
   while (e > s && (e[-1] == ' ' || e[-1] == '\t' || e[-1] == '\r')) --e;
@@ -40,27 +49,31 @@ static inline double parse_token(const char* s, const char* e) {
   else if (*p == '+') ++p;
   int64_t ip = 0;
   int digits = 0;
-  while (p < e && *p >= '0' && *p <= '9' && digits < 18) {
+  while (p < e && *p >= '0' && *p <= '9' && digits < 15) {
     ip = ip * 10 + (*p - '0');
     ++p; ++digits;
   }
   if (p < e && *p == '.') {
     ++p;
     int64_t fp = 0, scale = 1;
-    while (p < e && *p >= '0' && *p <= '9' && digits < 18) {
+    while (p < e && *p >= '0' && *p <= '9' && digits < 15) {
       fp = fp * 10 + (*p - '0');
       scale *= 10;
       ++p; ++digits;
     }
     if (p == e && digits > 0) {
-      double v = (double)ip + (double)fp / (double)scale;
+      double v = (double)(ip * scale + fp) / (double)scale;
       return neg ? -v : v;
     }
   } else if (p == e && digits > 0) {
     double v = (double)ip;
     return neg ? -v : v;
   }
-  // exponent / >18 digits / inf / nan / junk: defer to strtod
+  // strtod accepts hex floats (0x1A) that python's float() rejects; any
+  // token containing x/X is junk (NaN) on the python path, so match that
+  for (const char* q = s; q < e; ++q)
+    if (*q == 'x' || *q == 'X') return NAN;
+  // exponent / >15 digits / inf / nan / junk: defer to strtod
   char tmp[64];
   size_t n = (size_t)(e - s);
   if (n >= sizeof(tmp)) return NAN;
@@ -156,6 +169,238 @@ int64_t h2o3_parse_numeric_csv(const char* buf, int64_t len, int64_t start,
     if (e > b && e[-1] != '\n') ++total;
   }
   return total;
+}
+
+// ---------------------------------------------------------------------------
+// Chunk-parallel two-phase parse primitives (ParseDataset.java:623 chunk
+// tokenization, driven from Python's ThreadPoolExecutor).  Each call is a
+// GIL-released ctypes invocation over one newline-aligned body chunk, so N
+// Python worker threads tokenize N chunks genuinely concurrently.  The
+// caller guarantees (frame/parse.py eligibility gate): no quote bytes, no
+// lone '\r', ASCII-only, single-byte separator.
+
+// Tokenize a body chunk into a [rows, ncols] cell grid of byte offsets
+// (start/end per cell, whitespace-stripped; missing trailing cells become
+// the empty range; extra cells beyond ncols are ignored, matching the
+// python tokenizer).  Whitespace-only records are skipped when skip_blanks.
+// Returns rows written, or -1 if cap_rows would overflow.
+int64_t h2o3_csv_index_chunk(const char* buf, int64_t len, char sep,
+                             int32_t ncols, int32_t skip_blanks,
+                             int32_t* starts, int32_t* ends,
+                             int64_t cap_rows) {
+  int64_t row = 0;
+  const char* p = buf;
+  const char* lim = buf + len;
+  while (p < lim) {
+    const char* nl = (const char*)memchr(p, '\n', (size_t)(lim - p));
+    const char* le = nl ? nl : lim;
+    const char* re = le;
+    if (re > p && re[-1] == '\r') --re;  // CRLF terminator
+    if (skip_blanks) {
+      const char* q = p;
+      while (q < re && (*q == ' ' || *q == '\t')) ++q;
+      if (q == re) { p = nl ? nl + 1 : lim; continue; }
+    }
+    if (row >= cap_rows) return -1;
+    int32_t* rs = starts + row * ncols;
+    int32_t* rr = ends + row * ncols;
+    int32_t col = 0;
+    const char* tok = p;
+    for (const char* q = p; q <= re && col < ncols; ++q) {
+      if (q == re || *q == sep) {
+        const char* a = tok;
+        const char* b = q;
+        while (a < b && (*a == ' ' || *a == '\t')) ++a;
+        while (b > a && (b[-1] == ' ' || b[-1] == '\t')) --b;
+        rs[col] = (int32_t)(a - buf);
+        rr[col] = (int32_t)(b - buf);
+        ++col;
+        tok = q + 1;
+      }
+    }
+    for (; col < ncols; ++col) { rs[col] = 0; rr[col] = 0; }
+    ++row;
+    p = nl ? nl + 1 : lim;
+  }
+  return row;
+}
+
+// Parse one column's cells (by index grid offsets) into float64; NA/junk
+// tokens become quiet NaN, same as the python builder's float() fallback.
+void h2o3_parse_cells_f64(const char* buf, const int32_t* starts,
+                          const int32_t* ends, int64_t n, double* out) {
+  for (int64_t i = 0; i < n; ++i)
+    out[i] = parse_token(buf + starts[i], buf + ends[i]);
+}
+
+namespace {
+
+// days since 1970-01-01 for a proleptic-Gregorian civil date
+// (Howard Hinnant's days_from_civil; exact over datetime's year range)
+static inline int64_t days_from_civil(int y, int m, int d) {
+  y -= m <= 2;
+  const int64_t era = (y >= 0 ? y : y - 399) / 400;
+  const unsigned yoe = (unsigned)(y - era * 400);
+  const unsigned doy = (153u * (unsigned)(m + (m > 2 ? -3 : 9)) + 2u) / 5u +
+                       (unsigned)d - 1u;
+  const unsigned doe = yoe * 365u + yoe / 4u - yoe / 100u + doy;
+  return era * 146097 + (int64_t)doe - 719468;
+}
+
+static inline bool rd_digits(const char* s, int k, int* v) {
+  int acc = 0;
+  for (int i = 0; i < k; ++i) {
+    if (s[i] < '0' || s[i] > '9') return false;
+    acc = acc * 10 + (s[i] - '0');
+  }
+  *v = acc;
+  return true;
+}
+
+static const int kMonthDays[13] = {0, 31, 28, 31, 30, 31, 30,
+                                   31, 31, 30, 31, 30, 31};
+
+static inline bool valid_ymd(int y, int m, int d) {
+  if (y < 1 || y > 9999 || m < 1 || m > 12 || d < 1) return false;
+  int md = kMonthDays[m];
+  if (m == 2 && (y % 4 == 0 && (y % 100 != 0 || y % 400 == 0))) md = 29;
+  return d <= md;
+}
+
+}  // namespace
+
+// Parse one column's cells as the canonical TIME formats
+// (yyyy-MM-dd[{ |T}HH:mm:ss[.f{1,6}]] and MM/dd/yyyy) into fractional
+// epoch milliseconds, computed exactly as CPython does:
+// (total_microseconds / 1e6) * 1000.0 — bit-identical to
+// (datetime.strptime(t) - epoch).total_seconds() * 1000.0.
+// Any cell not strictly matching (including NA tokens and out-of-range
+// fields) is flagged for the python fallback.  Returns the flagged count.
+int64_t h2o3_parse_cells_time(const char* buf, const int32_t* starts,
+                              const int32_t* ends, int64_t n, double* out,
+                              uint8_t* flags) {
+  int64_t nflag = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const char* s = buf + starts[i];
+    const int len = ends[i] - starts[i];
+    int y = 0, mo = 0, d = 0, h = 0, mi = 0, sec = 0;
+    int64_t us = 0;
+    bool ok = false;
+    if (len >= 10 && s[4] == '-' && s[7] == '-') {
+      ok = rd_digits(s, 4, &y) && rd_digits(s + 5, 2, &mo) &&
+           rd_digits(s + 8, 2, &d);
+      if (ok && len > 10) {
+        ok = len >= 19 && (s[10] == ' ' || s[10] == 'T') && s[13] == ':' &&
+             s[16] == ':' && rd_digits(s + 11, 2, &h) &&
+             rd_digits(s + 14, 2, &mi) && rd_digits(s + 17, 2, &sec);
+        if (ok && len > 19) {
+          const int nf = len - 20;  // fractional digits after '.'
+          ok = s[19] == '.' && nf >= 1 && nf <= 6;
+          if (ok) {
+            int frac = 0;
+            ok = rd_digits(s + 20, nf, &frac);
+            if (ok) {
+              int64_t f = frac;
+              for (int k = nf; k < 6; ++k) f *= 10;  // strptime %f pads
+              us = f;
+            }
+          }
+        }
+      }
+    } else if (len == 10 && s[2] == '/' && s[5] == '/') {
+      ok = rd_digits(s, 2, &mo) && rd_digits(s + 3, 2, &d) &&
+           rd_digits(s + 6, 4, &y);
+    }
+    if (ok)
+      ok = valid_ymd(y, mo, d) && h <= 23 && mi <= 59 && sec <= 59;
+    if (!ok) {
+      out[i] = NAN;
+      flags[i] = 1;
+      ++nflag;
+      continue;
+    }
+    flags[i] = 0;
+    const int64_t total_us =
+        (days_from_civil(y, mo, d) * 86400 + h * 3600 + mi * 60 + sec) *
+            1000000LL +
+        us;
+    out[i] = ((double)total_us / 1e6) * 1000.0;
+  }
+  return nflag;
+}
+
+// Dictionary-encode one column's cells: int32 codes in first-appearance
+// order plus the unique tokens as (start,end) offsets into buf (the local
+// categorical dictionary; Categorical.java's per-chunk map).  Cells equal
+// to an NA token (packed blob + offsets) get code -1.  uniq_starts /
+// uniq_ends must hold n entries.  Returns the dictionary size.
+int64_t h2o3_dict_encode_cells(const char* buf, const int32_t* starts,
+                               const int32_t* ends, int64_t n,
+                               const char* na_buf, const int32_t* na_starts,
+                               const int32_t* na_ends, int32_t n_na,
+                               int32_t* codes, int32_t* uniq_starts,
+                               int32_t* uniq_ends) {
+  std::unordered_map<std::string_view, int32_t> dict;
+  dict.reserve(256);
+  for (int64_t i = 0; i < n; ++i) {
+    const std::string_view sv(buf + starts[i],
+                              (size_t)(ends[i] - starts[i]));
+    bool is_na = false;
+    for (int32_t k = 0; k < n_na; ++k) {
+      const size_t l = (size_t)(na_ends[k] - na_starts[k]);
+      if (sv.size() == l &&
+          (l == 0 || memcmp(sv.data(), na_buf + na_starts[k], l) == 0)) {
+        is_na = true;
+        break;
+      }
+    }
+    if (is_na) {
+      codes[i] = -1;
+      continue;
+    }
+    auto it = dict.find(sv);
+    if (it == dict.end()) {
+      const int32_t c = (int32_t)dict.size();
+      dict.emplace(sv, c);
+      uniq_starts[c] = starts[i];
+      uniq_ends[c] = ends[i];
+      codes[i] = c;
+    } else {
+      codes[i] = it->second;
+    }
+  }
+  return (int64_t)dict.size();
+}
+
+// Gather one column's cells into a single '\n'-joined buffer (cells never
+// contain newlines — records were split on them) with an NA mask, so
+// Python can materialize a STR/UUID column with ONE decode + split instead
+// of n per-cell slices.  out must hold sum(ends-starts) + n bytes.
+// Returns bytes written.
+int64_t h2o3_gather_cells(const char* buf, const int32_t* starts,
+                          const int32_t* ends, int64_t n, const char* na_buf,
+                          const int32_t* na_starts, const int32_t* na_ends,
+                          int32_t n_na, char* out, uint8_t* na_mask) {
+  char* w = out;
+  for (int64_t i = 0; i < n; ++i) {
+    const char* s = buf + starts[i];
+    const size_t l = (size_t)(ends[i] - starts[i]);
+    bool is_na = false;
+    for (int32_t k = 0; k < n_na; ++k) {
+      const size_t nl = (size_t)(na_ends[k] - na_starts[k]);
+      if (l == nl && (l == 0 || memcmp(s, na_buf + na_starts[k], l) == 0)) {
+        is_na = true;
+        break;
+      }
+    }
+    na_mask[i] = is_na ? 1 : 0;
+    if (!is_na && l) {
+      memcpy(w, s, l);
+      w += l;
+    }
+    if (i + 1 < n) *w++ = '\n';
+  }
+  return (int64_t)(w - out);
 }
 
 }  // extern "C"

@@ -58,6 +58,42 @@ def readme_documented_routes(readme_path: str) -> set:
     return routes
 
 
+#: backticked tokens with one of these suffixes (optionally carrying a
+#: ``{label,...}`` hint) are treated as metric references the registry
+#: must actually contain
+_METRIC_SUFFIXES = ("_total", "_seconds", "_bytes", "_entries", "_workers")
+
+
+def readme_documented_metrics(readme_path: str) -> set:
+    """Metric names referenced in the Observability section's prose."""
+    with open(readme_path) as f:
+        text = f.read()
+    m = re.search(r"^## Observability$(.*?)(?=^## |\Z)", text,
+                  re.MULTILINE | re.DOTALL)
+    if not m:
+        return set()
+    names = set()
+    for tok in re.findall(r"`([a-z][a-z0-9_]*)(?:\{[a-z0-9_,]+\})?`",
+                          m.group(1)):
+        if tok.endswith(_METRIC_SUFFIXES):
+            names.add(tok)
+    return names
+
+
+def live_metrics() -> set:
+    """Registry names after importing every metric-declaring module the
+    server pulls in (parse/ingest/devcache/mapreduce come via the server
+    import below; list the frame layer explicitly so the lint cannot go
+    vacuous if a route stops importing it)."""
+    import h2o3_tpu.frame.ingest     # noqa: F401  parse_* / ingest_* meters
+    import h2o3_tpu.frame.devcache   # noqa: F401  devcache_* meters
+    import h2o3_tpu.compute.mapreduce  # noqa: F401  mapreduce_* meters
+    import h2o3_tpu.models.framework  # noqa: F401  model_fit_seconds
+    from h2o3_tpu.util import telemetry
+
+    return set(telemetry.REGISTRY.names())
+
+
 def live_routes():
     """(method, template) pairs off a constructed (not started) server."""
     from h2o3_tpu.api.server import H2OServer
@@ -93,6 +129,15 @@ def main() -> int:
             f"README.md documents {m} {t} but no such route is registered"
         )
 
+    registered = live_metrics()
+    ghost = readme_documented_metrics(os.path.join(_ROOT, "README.md")) \
+        - registered
+    for name in sorted(ghost):
+        failures.append(
+            f"README.md's Observability section documents metric {name!r} "
+            f"but the telemetry registry never declares it"
+        )
+
     from h2o3_tpu.api.registry import algo_map
 
     train_routes = {t for m, t in routes if m == "POST"}
@@ -112,8 +157,11 @@ def main() -> int:
         for f in failures:
             print(f"check_telemetry: {f}", file=sys.stderr)
         return 1
+    n_doc_metrics = len(
+        readme_documented_metrics(os.path.join(_ROOT, "README.md")))
     print(
         f"check_telemetry: OK — {len(obs)} observability routes documented, "
+        f"{n_doc_metrics} documented metrics registered, "
         f"{len(algo_map())} algos registered"
     )
     return 0

@@ -66,6 +66,90 @@ def test_native_csv_matches_python_parse():
         )
 
 
+def _python_path_parse(text):
+    """parse_csv with the native fast path stubbed out — the oracle."""
+    import h2o3_tpu.frame.parse as parse_mod
+
+    orig = parse_mod._native_numeric_fast
+    parse_mod._native_numeric_fast = lambda *a, **k: None
+    try:
+        return parse_csv(text)
+    finally:
+        parse_mod._native_numeric_fast = orig
+
+
+def _assert_same_frames(a, b):
+    assert a.names == b.names
+    assert a.nrows == b.nrows
+    for name in a.names:
+        np.testing.assert_array_equal(a.col(name).data, b.col(name).data)
+
+
+@needs_native
+def test_native_csv_crlf_matches_python():
+    """CRLF line endings: native nrows (newline count) and token \r
+    stripping must both agree with python's record splitting."""
+    rows = ["a,b,c"] + [
+        f"{i}.25,{-i},{'NA' if i % 7 == 0 else i * 2}" for i in range(300)
+    ]
+    text = "\r\n".join(rows) + "\r\n"
+    from h2o3_tpu.frame.parse import parse_setup
+
+    setup = parse_setup(text)
+    assert _native_numeric_fast(text, setup) is not None  # path engages
+    fr = parse_csv(text)
+    assert fr.nrows == 300
+    _assert_same_frames(fr, _python_path_parse(text))
+
+
+@needs_native
+def test_native_declines_lone_cr_line_endings():
+    """Old-Mac lone-\r terminators split records in python (splitlines)
+    but not in a byte-level \n scan: the fast path must decline."""
+    text = "a,b\r1,2\r3,4\r"
+    from h2o3_tpu.frame.parse import parse_setup
+
+    setup = parse_setup(text)
+    assert _native_numeric_fast(text, setup) is None
+    fr = parse_csv(text)
+    assert fr.nrows == 2  # python path splits on \r
+    np.testing.assert_array_equal(fr.col("a").data, [1.0, 3.0])
+
+
+@needs_native
+def test_native_fast_path_engages_with_default_na_strings():
+    """'NaN'/'nan' in the default NA list parse to NaN on BOTH paths, so
+    they must not disable the fast path (only non-NaN numeric NA tokens
+    like '999' genuinely diverge)."""
+    text = "a,b\n1.5,NA\n2.5,3.5\nNaN,4.5\n"
+    from h2o3_tpu.frame.parse import parse_setup
+
+    setup = parse_setup(text)
+    assert _native_numeric_fast(text, setup) is not None
+    _assert_same_frames(parse_csv(text), _python_path_parse(text))
+    # a numeric NA token still declines
+    setup999 = parse_setup(text, na_strings=("", "999"))
+    assert _native_numeric_fast(text, setup999) is None
+
+
+@needs_native
+def test_native_underscore_scan_is_body_only():
+    """A header named col_1 must not disable the fast path (the underscore
+    gate protects float('1_000') semantics, which only body bytes can
+    trigger) — while an underscore IN the body still declines."""
+    from h2o3_tpu.frame.parse import parse_setup
+
+    good = "col_1,col_2\n1,2\n3,4\n"
+    setup = parse_setup(good)
+    assert _native_numeric_fast(good, setup) is not None
+    _assert_same_frames(parse_csv(good), _python_path_parse(good))
+
+    bad = "col_1,col_2\n1_000,2\n3,4\n"
+    assert _native_numeric_fast(bad, parse_setup(bad)) is None
+    fr = parse_csv(bad)
+    assert fr.col("col_1").data[0] == 1000.0  # python float('1_000')
+
+
 @needs_native
 def test_native_fast_path_declines_non_numeric():
     setup = ParseSetup(

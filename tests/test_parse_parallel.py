@@ -1,0 +1,278 @@
+"""Chunk-parallel two-phase CSV parse: boundary correctness + reduce parity.
+
+Reference: ParseDataset.java:623 — chunk the byte stream, tokenize chunks in
+parallel, unify categorical dictionaries in a reduce (Categorical.java).
+The contract pinned here: the parallel Frame is BIT-IDENTICAL (data, domains,
+types, NA positions) to ``H2O3_TPU_PARSE_WORKERS=1`` and to the serial
+whole-text path, for any chunk size — including chunks that cut inside
+quoted newlines, chunks smaller than one record, and NA/TIME/UUID runs
+split across chunks.
+"""
+
+import gzip
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.frame.frame import ColType
+from h2o3_tpu.frame.parse import parse_csv
+from h2o3_tpu.util import telemetry
+
+
+def assert_frames_identical(a, b, tag=""):
+    assert a.names == b.names, tag
+    assert a.nrows == b.nrows, tag
+    for n in a.names:
+        ca, cb = a.col(n), b.col(n)
+        assert ca.type == cb.type, (tag, n, ca.type, cb.type)
+        assert ca.domain == cb.domain, (tag, n)
+        np.testing.assert_array_equal(ca.isna(), cb.isna(), err_msg=f"{tag}:{n}:na")
+        if ca.data.dtype == object:
+            assert list(ca.data) == list(cb.data), (tag, n)
+        else:
+            np.testing.assert_array_equal(ca.data, cb.data, err_msg=f"{tag}:{n}")
+
+
+def parallel(monkeypatch, text, chunk_bytes, workers, **kw):
+    monkeypatch.setenv("H2O3_TPU_PARSE_CHUNK_BYTES", str(chunk_bytes))
+    monkeypatch.setenv("H2O3_TPU_PARSE_WORKERS", str(workers))
+    try:
+        return parse_csv(text, **kw)
+    finally:
+        monkeypatch.delenv("H2O3_TPU_PARSE_CHUNK_BYTES")
+        monkeypatch.delenv("H2O3_TPU_PARSE_WORKERS")
+
+
+def _mixed_csv(n=300):
+    """Deterministic NUM/CAT/TIME/UUID/STR/NUM mix with NA runs."""
+    rows = ["num,cat,time,uuid,str,count"]
+    for i in range(n):
+        num = "NA" if i % 11 == 0 else f"{i * 0.75 - 17:.4f}"
+        cat = ["alpha", "beta", "gamma", "NA", "delta"][i % 5]
+        tim = (
+            "?"
+            if i % 13 == 0
+            else f"2021-{(i % 12) + 1:02d}-{(i % 27) + 1:02d} 10:{i % 60:02d}:{(i * 7) % 60:02d}.{i % 1000:03d}"
+        )
+        uid = (
+            ""
+            if i % 17 == 0
+            else f"{i:08x}-aaaa-bbbb-cccc-{i * 31:012x}"
+        )
+        s = "null" if i % 19 == 0 else f"free text {i}"
+        rows.append(f"{num},{cat},{tim},{uid},{s},{i}")
+    return "\n".join(rows) + "\n"
+
+
+class TestChunkBoundaries:
+    def test_identical_across_workers_and_chunk_sizes(self, monkeypatch):
+        text = _mixed_csv()
+        serial = parse_csv(text)
+        assert [c.type for c in serial.columns] == [
+            ColType.NUM, ColType.CAT, ColType.TIME, ColType.UUID,
+            ColType.STR, ColType.NUM,
+        ]
+        base = parallel(monkeypatch, text, 256, 1)
+        assert_frames_identical(serial, base, "serial-vs-w1")
+        for chunk in (64, 256, 4096):
+            for w in (2, 8):
+                par = parallel(monkeypatch, text, chunk, w)
+                assert_frames_identical(base, par, f"c{chunk}w{w}")
+
+    def test_quoted_newlines_span_chunk_splits(self, monkeypatch):
+        rows = ["label,value"]
+        for i in range(200):
+            rows.append(f'"line one\nline two, {i}\n""quoted"" end",{i}')
+        text = "\n".join(rows) + "\n"
+        serial = parse_csv(text)
+        assert serial.nrows == 200
+        lab = serial.col("label")  # 200 uniques -> CAT; check via domain
+        assert lab.domain[lab.data[5]] == 'line one\nline two, 5\n"quoted" end'
+        for chunk in (64, 173, 1024):
+            par = parallel(monkeypatch, text, chunk, 4)
+            assert_frames_identical(serial, par, f"quoted-c{chunk}")
+
+    def test_chunk_smaller_than_one_record(self, monkeypatch):
+        # single records far larger than the chunk size: the chunker must
+        # grow the chunk, never cut mid-record
+        wide = ",".join(f"{i}.5" for i in range(200))
+        long_q = '"' + "x" * 500 + '",' + ",".join("1" * 199)
+        text = "a" + ",".join(f"c{i}" for i in range(1, 200)) + "\n"
+        text += wide + "\n" + long_q + "\n" + wide + "\n"
+        serial = parse_csv(text)
+        par = parallel(monkeypatch, text, 64, 3)
+        assert_frames_identical(serial, par, "monster-record")
+
+    def test_na_and_time_and_uuid_split_across_chunks(self, monkeypatch):
+        # NA runs positioned to straddle every 64-byte cut
+        rows = ["t,u,x"]
+        for i in range(120):
+            t = "NA" if 40 <= i < 80 else f"2020-06-{(i % 28) + 1:02d}"
+            u = "N/A" if 30 <= i < 90 else f"{i:08x}-1111-2222-3333-aaaaaaaaaaaa"
+            rows.append(f"{t},{u},{i}")
+        text = "\n".join(rows) + "\n"
+        serial = parse_csv(text)
+        assert serial.col("t").type is ColType.TIME
+        assert serial.col("u").type is ColType.UUID
+        assert int(serial.col("t").isna().sum()) == 40
+        par = parallel(monkeypatch, text, 64, 8)
+        assert_frames_identical(serial, par, "na-time-uuid")
+
+    def test_categorical_dictionary_merge_is_global_sorted(self, monkeypatch):
+        # chunk-local dictionaries see disjoint level subsets in different
+        # first-appearance orders; the reduce must still produce one sorted
+        # global domain with stable codes
+        rows = ["g,x"]
+        levels = [f"lv{j:02d}" for j in range(20)]
+        for i in range(200):
+            rows.append(f"{levels[(i * 7) % 20]},{i}")
+        text = "\n".join(rows) + "\n"
+        serial = parse_csv(text)
+        assert serial.col("g").domain == sorted(levels)
+        par = parallel(monkeypatch, text, 64, 4)
+        assert_frames_identical(serial, par, "dict-merge")
+
+    def test_crlf_and_blank_lines(self, monkeypatch):
+        body = "".join(
+            (f"{i}.25,tok{i % 3}\r\n" if i % 9 else f"{i}.25,tok{i % 3}\r\n\r\n")
+            for i in range(150)
+        )
+        text = "a,b\r\n" + body
+        serial = parse_csv(text)
+        assert serial.nrows == 150  # blank CRLF lines dropped
+        par = parallel(monkeypatch, text, 128, 4)
+        assert_frames_identical(serial, par, "crlf")
+
+    def test_mixed_native_and_python_chunks(self, monkeypatch):
+        # unicode rows force individual chunks onto the python tokenizer
+        # while ascii chunks stay native — the reduce must not care
+        rows = ["w,x"]
+        for i in range(300):
+            rows.append((f"héllo-{i}" if i % 50 == 0 else f"word-{i}") + f",{i}")
+        text = "\n".join(rows) + "\n"
+        serial = parse_csv(text)
+        par = parallel(monkeypatch, text, 96, 4)
+        assert_frames_identical(serial, par, "mixed-chunks")
+
+    def test_lone_cr_terminators_fall_back_to_serial(self, monkeypatch):
+        # old-Mac lone-\r record terminators: the \n chunker cannot cut
+        # these, so the pipeline must divert to the serial oracle instead
+        # of swallowing the whole input as "the header"
+        text = "a,b\r" + "".join(f"{i}.5,{i * 2}\r" for i in range(100))
+        serial = parse_csv(text)
+        assert serial.nrows == 100
+        par = parallel(monkeypatch, text, 64, 4)
+        assert_frames_identical(serial, par, "lone-cr")
+
+    def test_formfeed_blank_line_before_header(self, monkeypatch):
+        # "\f" is blank to python's r.strip() but not to the chunker's
+        # header scan — divergent byte, must take the serial oracle
+        text = "\f\na,b\n" + "1,2\n" * 50
+        serial = parse_csv(text)
+        par = parallel(monkeypatch, text, 64, 2)
+        assert_frames_identical(serial, par, "formfeed")
+
+    def test_mid_stream_vertical_tab_with_quotes_elsewhere(self, monkeypatch):
+        # a \v appears far into the body while quotes exist in EARLIER
+        # chunks: the serial path's quote state machine keeps \v inline,
+        # so the recovered tail must be split with machine semantics even
+        # though the tail itself is quote-free
+        rows = ["a,b"] + [f'"q{i}",{i}' for i in range(40)]
+        rows += [f"plain\v{i},{i}" for i in range(40, 80)]
+        text = "\n".join(rows) + "\n"
+        serial = parse_csv(text)
+        assert serial.nrows == 80  # \v never terminates a record here
+        par = parallel(monkeypatch, text, 64, 4)
+        assert_frames_identical(serial, par, "vt-after-quotes")
+
+    def test_mid_stream_vertical_tab_no_quotes(self, monkeypatch):
+        # same divergent byte, quote-free input: serial splitlines DOES
+        # split on \v — the recovered tail must too
+        rows = ["a,b"] + [f"p{i},{i}" for i in range(40)]
+        rows += [f"x{i}\vy{i},{i}" for i in range(40, 60)]
+        text = "\n".join(rows) + "\n"
+        serial = parse_csv(text)
+        assert serial.nrows > 60  # the \v splits records
+        par = parallel(monkeypatch, text, 64, 4)
+        assert_frames_identical(serial, par, "vt-no-quotes")
+
+    def test_first_record_larger_than_sample_window(self, monkeypatch):
+        # a quoted first cell bigger than the 1 MiB setup-sampling window:
+        # no complete record fits the sample, so the stream impl must
+        # drain and take the serial path instead of raising 'empty input'
+        big = "line\n" * 250_000  # ~1.25 MB of quoted newlines
+        text = f'"{big}",1\n"tail",2\n'
+        par = parallel(monkeypatch, text, 256, 2)
+        assert par.nrows == 2
+        serial = parse_csv(text)
+        assert_frames_identical(serial, par, "giant-first-record")
+
+    def test_cyrillic_text_keeps_pipeline_engaged(self, monkeypatch):
+        # 0x85 appears as the utf-8 continuation byte of ordinary
+        # characters (Cyrillic 'х' = D1 85): that must NOT be mistaken
+        # for a NEL terminator and silently disable the pipeline
+        chunks = telemetry.REGISTRY.get("parse_chunks_total")
+        rows = ["word,x"] + [f"хлеб{i % 7},{i}" for i in range(300)]
+        text = "\n".join(rows) + "\n"
+        serial = parse_csv(text)
+        c0 = chunks.total()
+        par = parallel(monkeypatch, text, 128, 4)
+        assert chunks.total() > c0  # chunk pipeline actually ran
+        assert_frames_identical(serial, par, "cyrillic")
+
+    def test_real_nel_terminator_diverts(self, monkeypatch):
+        # an actual U+0085 NEL splits records in python's splitlines:
+        # the pipeline must divert and stay bit-identical
+        text = "a,b\n" + "1,2\x853,4\n" * 30
+        serial = parse_csv(text)
+        par = parallel(monkeypatch, text, 64, 2)
+        assert_frames_identical(serial, par, "nel")
+
+    def test_header_only_and_blank_prefix(self, monkeypatch):
+        text = "\n  \n a,b\n" + "1,2\n" * 40
+        serial = parse_csv(text)
+        par = parallel(monkeypatch, text, 64, 2)
+        assert_frames_identical(serial, par, "blank-prefix")
+        assert par.names == ["a", "b"]
+
+
+class TestStreamedDecompression:
+    def test_gz_stream_matches_plain(self, monkeypatch, tmp_path):
+        from h2o3_tpu.frame.ingest import parse_bytes
+
+        text = _mixed_csv(200)
+        plain = parse_csv(text)
+        monkeypatch.setenv("H2O3_TPU_PARSE_CHUNK_BYTES", "256")
+        fr = parse_bytes("m.csv.gz", gzip.compress(text.encode()))
+        assert_frames_identical(plain, fr, "gz")
+
+    def test_zip_entry_streams(self, monkeypatch, tmp_path):
+        import zipfile as _zf
+        import io as _io
+
+        from h2o3_tpu.frame.ingest import parse_bytes
+
+        text = _mixed_csv(150)
+        buf = _io.BytesIO()
+        with _zf.ZipFile(buf, "w", _zf.ZIP_DEFLATED) as z:
+            z.writestr("part.csv", text)
+        monkeypatch.setenv("H2O3_TPU_PARSE_CHUNK_BYTES", "256")
+        fr = parse_bytes("m.zip", buf.getvalue())
+        assert_frames_identical(parse_csv(text), fr, "zip")
+
+
+class TestTelemetry:
+    def test_chunk_and_worker_meters(self, monkeypatch):
+        chunks = telemetry.REGISTRY.get("parse_chunks_total")
+        rows = telemetry.REGISTRY.get("parse_rows_total")
+        c0 = chunks.total()
+        text = _mixed_csv(200)
+        parallel(monkeypatch, text, 256, 3)
+        assert chunks.total() > c0  # several chunks tokenized
+        assert telemetry.REGISTRY.get("parse_workers").value() == 3.0
+        parallel_rows = sum(
+            s["value"]
+            for s in rows.snapshot()["series"]
+            if s["labels"]["parser"].endswith("-parallel")
+        )
+        assert parallel_rows >= 200
