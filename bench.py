@@ -55,8 +55,9 @@ _CACHE_ENV = {
 # just skipping the setdefault) so an externally exported cache dir can't
 # reach CPU children either.
 if os.environ.get("BENCH_FORCE_CPU") or "--cache-bench" in sys.argv \
-        or "--parse-bench" in sys.argv:
-    # --cache-bench / --parse-bench are CPU-only by construction: same hazard
+        or "--parse-bench" in sys.argv or "--cluster-bench" in sys.argv:
+    # --cache-bench / --parse-bench / --cluster-bench are CPU-only by
+    # construction: same hazard
     for _k in _CACHE_ENV:
         os.environ.pop(_k, None)
 else:
@@ -575,6 +576,138 @@ def _run_child(arg: str, timeout: int, extra_env=None):
     return False, None, "no JSON line in child stdout"
 
 
+def _cluster_bench() -> None:
+    """2-node localhost cloud microbench (application-plane cluster).
+
+    Boots this process as node 0 and a ``h2o3_tpu.cluster.nodeproc``
+    subprocess as node 1 (port 0 + address-file rendezvous, exactly the
+    multi-process tests' harness), then measures the control plane: RPC
+    round-trip latency percentiles, RPC throughput by payload size, and
+    DKV put/get on keys homed locally vs on the remote node.  Prints ONE
+    JSON line and mirrors it to CLUSTER_BENCH.json.  No jax import — the
+    cluster layer is pure stdlib, so this runs anywhere in milliseconds.
+    """
+    import platform
+    import tempfile
+
+    from h2o3_tpu.cluster.membership import boot_node, set_local_cloud
+    from h2o3_tpu.keyed import KeyedStore
+    from h2o3_tpu.util import telemetry
+
+    rounds = int(os.environ.get("BENCH_CLUSTER_ROUNDS", 300))
+    store = KeyedStore()
+    cloud = boot_node("cluster-bench", "bench-n0",
+                      hb_interval=0.2, store=store)
+    router = store.router
+    tmp = tempfile.mkdtemp(prefix="cluster_bench_")
+    flat = os.path.join(tmp, "flatfile")
+    addr1 = os.path.join(tmp, "n1.addr")
+    with open(flat, "w") as f:
+        f.write(f"{cloud.info.host}:{cloud.info.port}\n")
+    child = subprocess.Popen(
+        [sys.executable, "-m", "h2o3_tpu.cluster.nodeproc",
+         "--cluster-name", "cluster-bench", "--node-name", "bench-n1",
+         "--flatfile", flat, "--address-file", addr1,
+         "--hb-interval", "0.2"],
+        stdin=subprocess.PIPE, stdout=subprocess.DEVNULL,
+        stderr=subprocess.STDOUT, cwd=_HERE,
+    )
+    try:
+        t0 = time.time()
+        while time.time() - t0 < 30:
+            if cloud.size() == 2 and cloud.consensus():
+                break
+            time.sleep(0.05)
+        else:
+            raise RuntimeError("2-node bench cloud never formed")
+        peer = next(m for m in cloud.members_sorted()
+                    if m.info.name == "bench-n1")
+
+        def _pct(samples, q):
+            s = sorted(samples)
+            return s[min(len(s) - 1, int(q * len(s)))]
+
+        # RPC round-trip latency (echo, tiny payload)
+        lat = []
+        for _ in range(rounds):
+            t = time.perf_counter()
+            cloud.client.call(peer.info.addr, "echo", b"x", timeout=5.0,
+                              target=peer.info.ident)
+            lat.append(time.perf_counter() - t)
+        rtt = {
+            "p50_us": round(_pct(lat, 0.50) * 1e6, 1),
+            "p90_us": round(_pct(lat, 0.90) * 1e6, 1),
+            "p99_us": round(_pct(lat, 0.99) * 1e6, 1),
+            "rounds": rounds,
+        }
+        # throughput by payload size (echo both ways: 2x bytes per RTT)
+        thru = {}
+        for sz in (64 << 10, 1 << 20, 4 << 20):
+            payload = b"\0" * sz
+            n = max(8, min(64, (64 << 20) // sz))
+            t = time.perf_counter()
+            for _ in range(n):
+                cloud.client.call(peer.info.addr, "echo", payload,
+                                  timeout=30.0, target=peer.info.ident)
+            dt = time.perf_counter() - t
+            thru[sz] = {"mb_per_sec": round(2 * sz * n / dt / 1e6, 1),
+                        "calls": n}
+        # DKV put/get: one key homed here, one homed on the peer
+        local_key = next(k for k in (f"bench_local_{i}" for i in range(4096))
+                         if router.home_name(k) == "bench-n0")
+        remote_key = next(k for k in (f"bench_remote_{i}" for i in range(4096))
+                          if router.home_name(k) == "bench-n1")
+        value = list(range(1000))
+        dkv = {}
+        for label, key in (("local", local_key), ("remote", remote_key)):
+            puts, gets = [], []
+            for _ in range(rounds):
+                t = time.perf_counter()
+                store.put(key, value)
+                puts.append(time.perf_counter() - t)
+                t = time.perf_counter()
+                got = store.get(key)
+                gets.append(time.perf_counter() - t)
+            assert got == value, f"{label} DKV roundtrip corrupted"
+            store.remove(key)
+            dkv[label] = {
+                "put_p50_us": round(_pct(puts, 0.5) * 1e6, 1),
+                "get_p50_us": round(_pct(gets, 0.5) * 1e6, 1),
+            }
+        tel = {k: v for k, v in telemetry.REGISTRY.summary().items()
+               if k.startswith(("rpc_", "cluster_"))}
+        result = {
+            "metric": "rpc_roundtrip_p50_us",
+            "value": rtt["p50_us"],
+            "unit": "microseconds (2-node localhost cloud, echo RPC)",
+            "vs_baseline": round(
+                dkv["remote"]["get_p50_us"]
+                / max(dkv["local"]["get_p50_us"], 1e-9), 2),
+            "detail": {
+                "host_cpus": os.cpu_count(),
+                "platform": platform.platform(),
+                "python": platform.python_version(),
+                "rpc_roundtrip": rtt,
+                "rpc_throughput_by_bytes": thru,
+                "dkv": dkv,
+                "vs_baseline_is": "remote get p50 / local get p50",
+            },
+            "telemetry": {k: (round(v, 3) if isinstance(v, float) else v)
+                          for k, v in tel.items()},
+        }
+        with open(os.path.join(_HERE, "CLUSTER_BENCH.json"), "w") as f:
+            json.dump(result, f, indent=1)
+        print(json.dumps(result))
+    finally:
+        try:
+            child.stdin.close()
+            child.wait(timeout=10)
+        except Exception:
+            child.kill()
+        cloud.stop()
+        set_local_cloud(None)
+
+
 def main() -> None:
     t_start = time.time()
     # two probe attempts: a single transient tunnel blip (one-off
@@ -631,5 +764,7 @@ if __name__ == "__main__":
         _cache_bench()
     elif "--parse-bench" in sys.argv:
         _parse_bench()
+    elif "--cluster-bench" in sys.argv:
+        _cluster_bench()
     else:
         main()

@@ -10,9 +10,13 @@ A from-scratch JAX/XLA/Pallas re-design of the capabilities of H2O-3
     (JAX owns device placement; no coherence protocol needed)
   * Rapids DSL + REST API (water/rapids/)          -> same logical op surface
 
-This is NOT a port: no Java cluster runtime, no custom UDP/TCP transport, no
-Paxos — XLA collectives over ICI/DCN and the JAX distributed runtime replace
-all of it (SURVEY.md §5 "Distributed communication backend").
+This is NOT a port: the *data* plane has no Java cluster runtime — XLA
+collectives over ICI/DCN and the JAX distributed runtime own sharded compute
+(SURVEY.md §5 "Distributed communication backend").  The *control* plane the
+runtime must own itself (membership, failure detection, key homes, remote
+task dispatch) lives in ``h2o3_tpu/cluster/``: heartbeat-gossip clouds with
+quorum hashing, stdlib-socket node RPC with the reference's retry ladder,
+consistent-hash DKV homes, and multi-node map_reduce/parse fan-out.
 """
 
 __version__ = "0.1.0"

@@ -72,6 +72,25 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--process-id", type=int, default=None,
                    help="this process's index (0-based); on k8s, derive "
                         "from the StatefulSet ordinal (see deploy/)")
+    # application-plane clustering (the -flatfile / -name cloud formation
+    # of the reference, h2o3_tpu/cluster/): heartbeat membership, node
+    # RPC, distributed DKV homes, multi-node task fan-out
+    p.add_argument("--flatfile", default=None, metavar="PATH",
+                   help="peer list (one host:port RPC address per line, "
+                        "# comments ok); presence of this flag boots the "
+                        "application-plane cluster node (-flatfile)")
+    p.add_argument("--cluster-name", default=None,
+                   help="application-plane cloud name; members of one "
+                        "cloud must agree on it (default: --name)")
+    p.add_argument("--node-name", default=None,
+                   help="this node's unique name in the cloud (default: "
+                        "<name>-<pid>); a duplicate is rejected at join "
+                        "with a clear 409")
+    p.add_argument("--cluster-port", type=int, default=0,
+                   help="node RPC bind port (0 = OS-assigned)")
+    p.add_argument("--cluster-address-file", default=None, metavar="PATH",
+                   help="write this node's resolved RPC host:port here "
+                        "after bind (harness rendezvous for --cluster-port 0)")
     return p
 
 
@@ -111,6 +130,14 @@ def main(argv=None) -> int:
             print("--coordinator requires --num-processes and --process-id",
                   file=sys.stderr)
             return 2
+        if args.num_processes < 1 or not (
+                0 <= args.process_id < args.num_processes):
+            # catch the misconfiguration HERE with a clear message — fed
+            # to the coordinator it becomes an opaque rendezvous stall
+            print(f"--process-id must be in [0, --num-processes): got "
+                  f"process-id={args.process_id} "
+                  f"num-processes={args.num_processes}", file=sys.stderr)
+            return 2
         distributed_initialize(
             coordinator_address=args.coordinator,
             num_processes=args.num_processes,
@@ -125,6 +152,37 @@ def main(argv=None) -> int:
         DKV.set_memory_budget(_parse_mem(args.max_mem), ice_dir=args.ice_root)
         logger.info("frame memory budget: %s (ice: %s)",
                     args.max_mem, args.ice_root or "<tmp>")
+
+    cloud = None
+    if args.flatfile is not None:
+        # application-plane cloud BEFORE the REST server: /3/Cloud must
+        # answer with real members from the first request; the server
+        # advertises its resolved REST port into the cloud at bind time
+        import os as _os
+
+        from h2o3_tpu.cluster.membership import CloudJoinError, boot_node
+
+        try:
+            # a wildcard --ip binds the RPC server on all interfaces;
+            # Cloud advertises a routable address in its place so
+            # cross-host peers can actually dial back
+            cloud = boot_node(
+                args.cluster_name or args.name,
+                args.node_name or f"{args.name}-{_os.getpid()}",
+                host=args.ip,
+                port=args.cluster_port,
+                flatfile=args.flatfile,
+                address_file=args.cluster_address_file,
+            )
+        except CloudJoinError as e:
+            # the clear 4xx surface: a duplicate --node-name (409) or
+            # wrong --cluster-name (400) fails fast and says so, instead
+            # of stalling forever on a membership hash that never agrees
+            print(f"cluster join rejected ({e.code}): {e}", file=sys.stderr)
+            return 2
+        logger.info("cluster node %s up in cloud '%s' (rpc %s:%d)",
+                    cloud.info.name, cloud.cloud_name,
+                    cloud.info.host, cloud.info.port)
 
     from h2o3_tpu.api import start_server
 
@@ -174,6 +232,11 @@ def main(argv=None) -> int:
             time.sleep(0.5)
     finally:
         server.stop()
+        if cloud is not None:
+            from h2o3_tpu.cluster.membership import set_local_cloud
+
+            cloud.stop()
+            set_local_cloud(None)
         logger.info("node stopped")
     return 0
 

@@ -216,16 +216,19 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
 
     # ---- cloud / ops ------------------------------------------------------
     def cloud(params):
-        """CloudV3 (api/schemas3/CloudV3.java)."""
+        """CloudV3 (api/schemas3/CloudV3.java) — real members with
+        heartbeat ages when an application-plane cloud is live
+        (h2o3_tpu/cluster/), the single-node shape otherwise."""
         import jax
 
+        from h2o3_tpu import cluster
         from h2o3_tpu.util import telemetry
 
         try:
             devices = [str(d) for d in jax.devices()]
         except Exception:
             devices = []
-        return {
+        out = {
             "version": __version__,
             "cloud_name": server.name,
             "cloud_size": 1,
@@ -244,6 +247,25 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
                 }
             ],
         }
+        c = cluster.local_cloud()
+        if c is not None:
+            nodes = c.member_schemas()
+            for nd in nodes:
+                if nd["name"] == c.info.name:  # only the local node can
+                    nd["devices"] = devices    # name its own devices
+                    nd["num_cpus"] = os.cpu_count()
+            out.update({
+                "cloud_name": c.cloud_name,
+                "node_name": c.info.name,
+                "cloud_size": sum(1 for nd in nodes if not nd["client"]),
+                "cloud_healthy": all(nd["healthy"] for nd in nodes),
+                "consensus": c.consensus(),
+                "cloud_hash": c.cloud_hash(),
+                "cloud_version": c.version,
+                "bad_nodes": sum(1 for nd in nodes if not nd["healthy"]),
+                "nodes": nodes,
+            })
+        return out
 
     r.register("GET", "/3/Cloud", cloud, "cloud status")
     r.register("GET", "/3/Cloud/status", cloud, "cloud status (minimal)")
@@ -1170,17 +1192,12 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
         return ("\n".join(L.recent(100000)) + "\n").encode()
 
     def watermeter(params):
-        """CPU tick counters (api/WaterMeterCpuTicksHandler.java:6)."""
-        try:
-            with open("/proc/stat") as f:
-                first = f.readline().split()
-        except OSError:  # non-Linux host: degrade gracefully, not a 500
-            return {"cpu_ticks": [], "columns": [], "available": False}
-        # user nice system idle iowait irq softirq
-        ticks = [int(x) for x in first[1:8]]
-        return {"cpu_ticks": [ticks], "columns": [
-            "user", "nice", "system", "idle", "iowait", "irq", "softirq"
-        ], "available": True}
+        """CPU tick counters (api/WaterMeterCpuTicksHandler.java:6); the
+        tick reader lives with the cluster heartbeat so the local route,
+        the HeartBeat payload and the cross-node proxy report one shape."""
+        from h2o3_tpu.cluster.membership import cpu_ticks_payload
+
+        return cpu_ticks_payload()
 
     def metrics_ep(params):
         """Full registry snapshot as JSON (the quantitative face of

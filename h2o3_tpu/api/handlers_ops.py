@@ -616,7 +616,11 @@ def register(r: RequestServer, server: H2OServer) -> None:  # noqa: C901
 
     # ---- cluster ops ------------------------------------------------------
     def dkv_delete(params, key):
-        if key not in DKV:
+        # existence through the ROUTED get (not local __contains__): on a
+        # multi-node cloud the key may live on its remote home — the same
+        # node that GET /3/DKV/{key} would happily answer from
+        sentinel = object()
+        if DKV.get(key, sentinel) is sentinel:
             raise RestError(404, f"no key {key!r}")
         DKV.remove(key)
         return {"key": {"name": key}}
@@ -629,6 +633,51 @@ def register(r: RequestServer, server: H2OServer) -> None:  # noqa: C901
             except ValueError:
                 skipped.append(k)
         return {"skipped_locked": skipped}
+
+    def _dkv_home(key):
+        """The key's home node name when a multi-node cloud is live."""
+        router = DKV.router
+        if router is not None and router.active():
+            return router.home_name(key)
+        return None
+
+    def dkv_get(params, key):
+        """Read one key THROUGH the distributed router: on a multi-node
+        cloud this answers identically from every member, wherever the
+        key is homed."""
+        sentinel = object()
+        v = DKV.get(key, sentinel)
+        if v is sentinel:
+            raise RestError(404, f"no key {key!r}")
+        if not isinstance(v, (str, int, float, bool, list, dict, type(None))):
+            v = repr(v)  # frames/models: identity, not payload
+        return {"key": {"name": key}, "value": v, "home": _dkv_home(key)}
+
+    def dkv_put(params, key):
+        """Store a JSON value under a key — routed to its home node when
+        a multi-node cloud is live (``replicas`` fans copies to the ring
+        successors)."""
+        if "value" not in params:
+            raise RestError(400, "missing 'value'")
+        try:
+            replicas = int(params.get("replicas", 1))
+        except (TypeError, ValueError):
+            raise RestError(400, "replicas must be an integer")
+        DKV.put(key, params["value"], replicas=replicas)
+        return {"key": {"name": key}, "home": _dkv_home(key)}
+
+    def dkv_home(params, key):
+        """Where a key lives (home + replica candidates) — the Key.home()
+        introspection the multi-node tests steer by."""
+        router = DKV.router
+        if router is None or not router.active():
+            return {"key": {"name": key}, "home": None, "replicas": [],
+                    "local": True}
+        homes = [m.info.name for m in router.home_members(key, 3)]
+        return {"key": {"name": key},
+                "home": homes[0] if homes else None,
+                "replicas": homes[1:],
+                "local": router.is_home(key)}
 
     def log_and_echo(params):
         from h2o3_tpu.util.log import get_logger
@@ -712,17 +761,73 @@ def register(r: RequestServer, server: H2OServer) -> None:  # noqa: C901
         except OSError:
             return {"persist_stats": [], "available": False}
 
+    def _cluster_node(nodeidx):
+        """(cloud, member) for a node-addressed route, or (None, None)
+        when no multi-node cloud is live (single-node: index 0 is us).
+        The index addresses the canonical sorted member list — the same
+        order /3/Cloud's ``nodes`` array reports."""
+        from h2o3_tpu import cluster
+
+        c = cluster.active_cloud()
+        try:
+            idx = int(nodeidx)
+        except (TypeError, ValueError):
+            # the route pattern matches any non-slash segment: a
+            # non-numeric index is a 404, not an int() 500
+            raise RestError(404, f"no node {nodeidx!r}")
+        if c is None:
+            if idx != 0 and idx != -1:
+                raise RestError(404, f"no node {idx} (cloud of 1)")
+            return None, None
+        members = c.members_sorted()
+        if not (-1 <= idx < len(members)):
+            raise RestError(
+                404, f"no node {idx} (cloud has {len(members)} members)")
+        member = members[idx] if idx >= 0 else c.local_member()
+        return c, member
+
+    def _node_rpc(c, member, method, payload=None):
+        """Proxy one built-in RPC to an addressed member, mapping
+        transport failures onto REST status codes (502: the member is
+        there but unreachable — exactly what the caller asked about)."""
+        from h2o3_tpu.cluster import RPCError, RemoteError
+
+        try:
+            # retries=1: an HTTP worker is waiting — bound the worst
+            # case near the timeout instead of 4x it
+            return c.client.call(
+                member.info.addr, method, payload,
+                timeout=10.0, target=member.info.ident, retries=1)
+        except RemoteError as e:
+            raise RestError(e.code if e.code >= 400 else 500, e.msg)
+        except RPCError as e:
+            raise RestError(
+                502, f"node {member.info.ident} unreachable: {e}")
+
     def watermeter_cpu_node(params, nodeidx):
-        return r.dispatch("GET", "/3/WaterMeterCpuTicks", params)
+        c, member = _cluster_node(nodeidx)
+        if c is None or member.info.name == c.info.name:
+            return r.dispatch("GET", "/3/WaterMeterCpuTicks", params)
+        return _node_rpc(c, member, "cpu_ticks")
 
     def logs_node_file(params, nodeidx, name):
-        from h2o3_tpu.util import log as L
+        c, member = _cluster_node(nodeidx)
+        if c is None or member.info.name == c.info.name:
+            from h2o3_tpu.util import log as L
 
-        L.init()
-        return ("\n".join(L.recent(10000)) + "\n").encode(), "text/plain"
+            L.init()
+            return ("\n".join(L.recent(10000)) + "\n").encode(), "text/plain"
+        got = _node_rpc(c, member, "logs", {"count": 10000})
+        return ("\n".join(got.get("lines", [])) + "\n").encode(), "text/plain"
 
     r.register("DELETE", "/3/DKV/{key}", dkv_delete, "remove one key")
     r.register("DELETE", "/3/DKV", dkv_delete_all, "remove all keys")
+    r.register("GET", "/3/DKV/{key}", dkv_get,
+               "read one key (routed to its home node)")
+    r.register("POST", "/3/DKV/{key}", dkv_put,
+               "store a JSON value (routed to its home node)")
+    r.register("GET", "/3/DKV/{key}/home", dkv_home,
+               "key home + replica placement")
     r.register("POST", "/3/LogAndEcho", log_and_echo, "log a message")
     r.register("GET", "/3/KillMinus3", kill_minus_3,
                "dump thread stacks to the log")

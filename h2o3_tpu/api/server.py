@@ -365,6 +365,14 @@ class H2OServer:
                 do_handshake_on_connect=False,
             )
         self.port = self._httpd.server_address[1]
+        # a live application-plane cloud learns where this node's REST
+        # surface landed (OS-assigned ports resolve only here); gossip
+        # then carries it to every member's /3/Cloud listing
+        from h2o3_tpu import cluster
+
+        _cloud = cluster.local_cloud()
+        if _cloud is not None:
+            _cloud.advertise_rest_port(self.port)
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True,
             name="http-accept",  # matches /3/Profiler's "^http" filter

@@ -1,0 +1,62 @@
+"""Application-plane clustering — H2O's L0–L3 stack on stdlib sockets.
+
+The reference cloud is four layers (SURVEY.md §5): L0 raw byte transport
+(``water/AutoBuffer.java``), L1 request/response RPC with a retry ladder
+(``water/RPC.java:101``), L2 heartbeat + Paxos-quorum membership
+(``water/HeartBeat.java``, ``water/Paxos.java:10-27``) and L3 the
+distributed K/V store with home-node key hashing (``water/Key.java:196``,
+``water/DKV.java``) plus remote task execution (``water/DTask.java``,
+``water/MRTask.java``).
+
+The data plane here is XLA's (``jax.distributed`` + collectives over the
+device mesh — ``parallel/mesh.py``); what the runtime must still own
+itself is the *control* plane: who is in the cloud, is a member alive,
+which node owns a key, and how does shard work reach another host.  That
+is this package:
+
+* :mod:`~h2o3_tpu.cluster.transport` — L0: length-prefixed TCP framing +
+  connection pool.
+* :mod:`~h2o3_tpu.cluster.rpc` — L1: named-method request/response RPC
+  with per-call timeout, bounded exponential-backoff retry, idempotency
+  tokens, and full telemetry (``rpc_calls_total{target,method,result}``).
+* :mod:`~h2o3_tpu.cluster.membership` — L2: periodic heartbeat gossip
+  carrying a ``HeartBeat``-style payload, quorum cloud formation on a
+  sorted member list + cloud hash, missed-heartbeat suspicion → removal,
+  cloud-version fencing of stale members.
+* :mod:`~h2o3_tpu.cluster.dkv` — L3a: consistent-hash key homes layered
+  onto :mod:`h2o3_tpu.keyed`; put/get on a non-home node forwards over
+  RPC (single-node clouds short-circuit to the local store).
+* :mod:`~h2o3_tpu.cluster.tasks` — L3b: remote DTask executor fanning
+  ``map_reduce`` / parse-chunk work out to members.
+
+A process has at most one live :class:`~h2o3_tpu.cluster.membership.Cloud`
+(:func:`local_cloud`); with none — or a cloud of one — every wired call
+path behaves exactly as before the cluster layer existed.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from h2o3_tpu.cluster.membership import (  # noqa: F401
+    Cloud,
+    NodeInfo,
+    local_cloud,
+    set_local_cloud,
+)
+from h2o3_tpu.cluster.rpc import (  # noqa: F401
+    RemoteError,
+    RPCConnectionError,
+    RPCError,
+    RPCTimeoutError,
+)
+
+
+def active_cloud() -> Optional["Cloud"]:
+    """The local cloud when it has MORE than one member, else None — the
+    single predicate every wired call path gates on (a cloud of one must
+    behave exactly like no cloud at all)."""
+    c = local_cloud()
+    if c is not None and c.size() > 1:
+        return c
+    return None

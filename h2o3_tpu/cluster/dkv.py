@@ -1,0 +1,326 @@
+"""L3a: distributed K/V homes — consistent-hash routing over the cloud.
+
+Reference: every ``water.Key`` hashes to a *home node* that owns the
+authoritative copy (``water/Key.java:196`` home arithmetic over the
+sorted member list, ``water/DKV.java:30-62`` put/get forwarding).  Here
+the same contract layers onto :class:`h2o3_tpu.keyed.KeyedStore` without
+changing its single-node behavior: a router installed on the store
+forwards put/get/remove for keys homed elsewhere over RPC, and
+short-circuits to the plain local path when the cloud has one member
+(or no cloud exists) — existing callers never see a difference.
+
+Key homes use a consistent-hash ring (virtual nodes per member) rather
+than the reference's plain ``hash % cloud_size``: when a member joins or
+leaves, only the keys homed on the affected arc move, instead of nearly
+every key re-homing — the right trade for clouds whose membership this
+layer itself can change (suspicion removal).
+
+``replicas=`` on put stores copies on the next distinct ring successors
+— the knob for small metadata keys that must survive their home node.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from h2o3_tpu.cluster import rpc as _rpc
+from h2o3_tpu.cluster.membership import Cloud, Member
+from h2o3_tpu.util import telemetry
+
+_FORWARDS = telemetry.counter(
+    "cluster_dkv_forwards_total",
+    "DKV operations forwarded to / served for another node",
+    labels=("op", "direction"),
+)
+
+#: virtual nodes per member on the hash ring — enough that key load
+#: splits within a few percent of even for small clouds
+_VNODES = 64
+
+#: deepest ring successor a replica can land on — and therefore the
+#: deepest get-fallback and remove fan-out need to reach.  Copies past
+#: this depth would be unreachable by the ring, so replicate clamps to
+#: it and remove bounds its RPC fan-out by it (a just-died member then
+#: only stalls removes of keys it actually homes, not every remove)
+MAX_REPLICAS = 3
+
+#: value types the ring routes to a home node — the plain DATA the
+#: /3/DKV surface and metadata puts store.  Framework lifecycle objects
+#: (Frame, Model, Job, Grid — anything not listed) stay NODE-LOCAL even
+#: on a multi-node cloud: the node that built them owns them, mutates
+#: them in place (Job.update / cancel), lists them (keys_of_type behind
+#: /3/Frames, /3/Models) and read-locks them — forwarding a pickled
+#: snapshot away would freeze that contract mid-air.  Gets of a
+#: local-only key still work everywhere they can: remote_get asks the
+#: ring home, then falls back to the local store.
+ROUTABLE_VALUE_TYPES = (
+    str, bytes, bytearray, int, float, bool, complex,
+    list, tuple, dict, set, frozenset, type(None),
+    np.ndarray, np.generic,
+)
+
+
+def _hash64(s: str) -> int:
+    return int.from_bytes(hashlib.md5(s.encode()).digest()[:8], "big")
+
+
+class HashRing:
+    """Consistent-hash ring over member idents."""
+
+    def __init__(self, idents: List[str]) -> None:
+        points: List[Tuple[int, str]] = []
+        for ident in idents:
+            for v in range(_VNODES):
+                points.append((_hash64(f"{ident}#{v}"), ident))
+        points.sort()
+        self._hashes = [h for h, _ in points]
+        self._owners = [o for _, o in points]
+        self.idents = sorted(idents)
+
+    def homes(self, key: str, n: int = 1) -> List[str]:
+        """The key's home ident plus the next ``n - 1`` DISTINCT ring
+        successors (replica placement)."""
+        if not self._hashes:
+            return []
+        out: List[str] = []
+        i = bisect.bisect_right(self._hashes, _hash64(key))
+        for step in range(len(self._hashes)):
+            owner = self._owners[(i + step) % len(self._hashes)]
+            if owner not in out:
+                out.append(owner)
+                if len(out) >= min(n, len(self.idents)):
+                    break
+        return out
+
+
+class DkvRouter:
+    """Installed on a :class:`~h2o3_tpu.keyed.KeyedStore` as ``.router``;
+    the store consults it on every put/get/remove.  All remote traffic
+    rides the cloud's pooled RPC client."""
+
+    #: per-op RPC timeout — DKV values can be whole frames
+    TIMEOUT = 60.0
+
+    def __init__(self, cloud: Cloud, store) -> None:
+        self.cloud = cloud
+        self.store = store
+        self._ring_lock = threading.Lock()
+        self._ring: Optional[HashRing] = None
+        self._ring_key: Optional[Tuple[str, ...]] = None
+        #: keys THIS node (as home) fanned replica copies out for — the
+        #: home performed the replication, so only it knows which keys
+        #: need a successor reap on remove (set ops are GIL-atomic)
+        self._replicated: set = set()
+        cloud.rpc_server.register("dkv_put", self._serve_put)
+        cloud.rpc_server.register("dkv_get", self._serve_get)
+        cloud.rpc_server.register("dkv_remove", self._serve_remove)
+
+    # -- ring ----------------------------------------------------------------
+    def _members(self) -> List[Member]:
+        """Key-owning members: healthy, non-client (clients hold no keys,
+        matching the reference's client-node exclusion from key homes)."""
+        return [m for m in self.cloud.members_sorted()
+                if m.healthy and not m.info.client]
+
+    def _current_ring(self) -> Tuple[HashRing, Dict[str, Member]]:
+        members = self._members()
+        by_ident = {m.info.ident: m for m in members}
+        key = tuple(sorted(by_ident))
+        with self._ring_lock:
+            if self._ring is None or self._ring_key != key:
+                self._ring = HashRing(list(key))
+                self._ring_key = key
+            return self._ring, by_ident
+
+    def active(self) -> bool:
+        """Multi-node clouds only — a cloud of one short-circuits every
+        caller straight to the local store."""
+        return self.cloud.size() > 1 and len(self._members()) > 1
+
+    def home_members(self, key: str, replicas: int = 1) -> List[Member]:
+        ring, by_ident = self._current_ring()
+        return [by_ident[i] for i in ring.homes(key, replicas)
+                if i in by_ident]
+
+    def home_name(self, key: str) -> Optional[str]:
+        homes = self.home_members(key, 1)
+        return homes[0].info.name if homes else None
+
+    def is_home(self, key: str) -> bool:
+        return self.home_name(key) in (None, self.cloud.info.name)
+
+    @staticmethod
+    def routes_value(value: Any) -> bool:
+        """True for plain-data values the ring owns; framework objects
+        (anything else) are node-local (see ROUTABLE_VALUE_TYPES)."""
+        return isinstance(value, ROUTABLE_VALUE_TYPES)
+
+    # -- client side (called from KeyedStore) --------------------------------
+    def remote_put(self, key: str, value: Any, replicas: int = 1) -> str:
+        home = self.home_members(key, 1)[0]
+        _FORWARDS.inc(op="put", direction="sent")
+        self.cloud.client.call(
+            home.info.addr, "dkv_put",
+            {"key": key, "value": value, "replicas": int(replicas)},
+            timeout=self.TIMEOUT, target=home.info.ident)
+        return key
+
+    def _local_fallback(self, key: str, default: Any) -> Any:
+        """Keys stored BEFORE the cloud grew (their ring home now lands
+        elsewhere) still live only in this node's store — a ring miss
+        must check it before declaring the key absent."""
+        sentinel = object()
+        v = self.store.get(key, sentinel, _local=True)
+        return default if v is sentinel else v
+
+    def remote_get(self, key: str, default: Any = None) -> Any:
+        """Ask the home; if it is unreachable, fall through the ring
+        successors (where replica copies live) before giving up."""
+        first_err: Optional[_rpc.RPCError] = None
+        for m in self.home_members(key, MAX_REPLICAS):
+            if m.info.name == self.cloud.info.name:
+                sentinel = object()
+                v = self.store.get(key, sentinel, _local=True)
+                if v is not sentinel:
+                    return v
+                continue
+            _FORWARDS.inc(op="get", direction="sent")
+            try:
+                # retries=1: the candidate walk below is the real retry
+                # — a full ladder per candidate could block a
+                # synchronous get for minutes against a black-holed home
+                resp = self.cloud.client.call(
+                    m.info.addr, "dkv_get", {"key": key},
+                    timeout=self.TIMEOUT, target=m.info.ident, retries=1)
+            except _rpc.RPCError as e:
+                if first_err is None:
+                    first_err = e
+                continue  # fall through to the next ring candidate
+            if resp.get("found"):
+                return resp.get("value")
+            # the home answered: absent is authoritative for the RING —
+            # but a pre-join local copy is still the caller's data
+            return self._local_fallback(key, default)
+        sentinel = object()
+        v = self.store.get(key, sentinel, _local=True)
+        if v is not sentinel:
+            return v  # every candidate unreachable, but we hold a copy
+        if first_err is not None:
+            raise first_err
+        return default
+
+    def remote_remove(self, key: str) -> None:
+        """Removal routes to the key's HOME only; the home — which
+        performed any replica fan-out and tracked it — reaps successor
+        copies just for keys that actually have them.  The common
+        unreplicated remove (model-build scope sweeps clear dozens of
+        temp keys) thus costs at most one RPC, zero when we are home."""
+        homes = self.home_members(key, 1)
+        if not homes or homes[0].info.name == self.cloud.info.name:
+            self._reap_replicas(key)
+            return
+        m = homes[0]
+        _FORWARDS.inc(op="remove", direction="sent")
+        try:
+            self.cloud.client.call(
+                m.info.addr, "dkv_remove", {"key": key},
+                timeout=self.TIMEOUT, target=m.info.ident)
+        except _rpc.RemoteError as e:
+            if e.code == 423:
+                # the remote copy is read/write-locked: surface the
+                # same ValueError the local _check_unlocked raises,
+                # not a silent "removed"
+                raise ValueError(e.msg) from e
+            # any other remote failure: best-effort
+        except _rpc.RPCError:
+            pass  # a dead home's copy dies with the member
+
+    def _reap_replicas(self, key: str) -> None:
+        """Home-side: remove successor copies IF this home fanned any.
+        A home that died between replicate and remove leaks its replica
+        copies until their holders churn — acceptable for best-effort
+        metadata replicas; the alternative (broadcast every remove) cost
+        every sweep a retry ladder against any dying member."""
+        if key not in self._replicated:
+            return
+        self._replicated.discard(key)
+        for m in self.home_members(key, MAX_REPLICAS)[1:]:
+            if m.info.name == self.cloud.info.name:
+                continue
+            _FORWARDS.inc(op="remove", direction="sent")
+            try:
+                self.cloud.client.call(
+                    m.info.addr, "dkv_remove",
+                    {"key": key, "replica_copy": True},
+                    timeout=self.TIMEOUT, target=m.info.ident)
+            except _rpc.RPCError:
+                pass  # a dead member's copy dies with the member
+
+    def replicate(self, key: str, value: Any, replicas: int) -> None:
+        """Push replica copies from the home to its ring successors."""
+        for m in self.home_members(key, min(replicas, MAX_REPLICAS))[1:]:
+            if m.info.name == self.cloud.info.name:
+                continue
+            self._replicated.add(key)  # a copy MAY land: reap on remove
+            _FORWARDS.inc(op="replicate", direction="sent")
+            try:
+                self.cloud.client.call(
+                    m.info.addr, "dkv_put",
+                    {"key": key, "value": value, "replica_copy": True},
+                    timeout=self.TIMEOUT, target=m.info.ident)
+            except _rpc.RPCError:
+                pass  # best-effort: the home copy is the authority
+
+    # -- server side (RPC handlers running on the home node) -----------------
+    def _serve_put(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        _FORWARDS.inc(op="put", direction="served")
+        key = payload["key"]
+        value = payload.get("value")
+        if payload.get("replica_copy"):
+            self.store.put(key, value, _local=True)
+        else:
+            # _local: this node answers AS the home — re-entering the
+            # routed put here would consult our own ring view, which can
+            # disagree with the sender's during suspicion churn and
+            # forward the put straight back (a ping-pong that holds an
+            # rpc-worker thread per hop). Store locally, replicate
+            # explicitly.
+            self.store.put(key, value, _local=True)
+            replicas = int(payload.get("replicas", 1))
+            if replicas > 1:
+                self.replicate(key, value, replicas)
+        return {"key": key, "home": self.cloud.info.name}
+
+    def _serve_get(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        _FORWARDS.inc(op="get", direction="served")
+        sentinel = object()
+        v = self.store.get(payload["key"], sentinel, _local=True)
+        if v is sentinel:
+            return {"found": False}
+        return {"found": True, "value": v}
+
+    def _serve_remove(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        _FORWARDS.inc(op="remove", direction="served")
+        key = payload["key"]
+        try:
+            self.store.remove(key, _local=True)
+        except ValueError as e:  # Lockable: surface the lock holders
+            raise _rpc.RpcFault(str(e), code=423)
+        if not payload.get("replica_copy"):
+            self._reap_replicas(key)  # serving AS home: reap successors
+        return {"removed": True}
+
+
+def install(cloud: Cloud, store=None) -> DkvRouter:
+    """Attach a router for ``cloud`` to ``store`` (default: the global
+    DKV singleton) and return it."""
+    if store is None:
+        from h2o3_tpu.keyed import DKV as store  # noqa: N811
+    router = DkvRouter(cloud, store)
+    store.router = router
+    return router

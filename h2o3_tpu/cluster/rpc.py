@@ -1,0 +1,327 @@
+"""L1 RPC: named-method request/response between nodes, with the
+reference's retry ladder.
+
+Reference: ``water/RPC.java:101`` — a DTask sent to an H2ONode retries on
+a bounded exponential backoff until acked, and the receiving node dedups
+re-sent tasks so a retried call never runs its side effects twice.  Both
+halves are reproduced here:
+
+* the client ladder: per-call timeout, ``retries`` attempts with
+  exponential backoff (base doubling, capped), connection pooling, and a
+  typed error surface (:class:`RPCTimeoutError` / :class:`RPCConnectionError`
+  / :class:`RemoteError`);
+* the server dedup: every logical call carries an idempotency token; the
+  server memoizes ``token -> response`` (and parks duplicate deliveries of
+  an in-flight token on the first execution), so a retry caused by a lost
+  response frame returns the original result instead of re-running the
+  method.
+
+Every call is metered: ``rpc_calls_total{target,method,result}``,
+``rpc_retries_total``, ``rpc_call_seconds{method}``.
+
+Wire format: one pickled dict per frame.  Pickle is the AutoBuffer
+analogue — nodes of one cloud run one codebase inside one trust boundary
+(the reference ships compiled DTask classes over the same wire); the REST
+surface, not this port, is the untrusted boundary.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import threading
+import time
+import uuid
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from h2o3_tpu.cluster import transport
+from h2o3_tpu.util import telemetry
+
+_RPC_CALLS = telemetry.counter(
+    "rpc_calls_total", "node RPC calls by outcome",
+    labels=("target", "method", "result"),
+)
+_RPC_RETRIES = telemetry.counter(
+    "rpc_retries_total", "RPC attempts re-sent by the backoff ladder"
+)
+_RPC_SECONDS = telemetry.histogram(
+    "rpc_call_seconds", "RPC round-trip wall seconds (incl. retries)",
+    labels=("method",),
+)
+_RPC_SERVED = telemetry.counter(
+    "rpc_served_total", "RPC requests served by the local node",
+    labels=("method", "result"),
+)
+
+
+class RPCError(Exception):
+    """Base of every typed RPC failure."""
+
+
+class RPCTimeoutError(RPCError):
+    """The call's per-attempt timeout expired on every attempt."""
+
+
+class RPCConnectionError(RPCError):
+    """No attempt could reach (or keep) a connection to the target."""
+
+
+class RemoteError(RPCError):
+    """The remote method raised; carries the remote type and an HTTP-ish
+    status code so control-plane callers (cloud join, REST proxies) can
+    answer 4xx instead of opaque 500s."""
+
+    def __init__(self, remote_type: str, msg: str, code: int = 500,
+                 detail: Optional[dict] = None) -> None:
+        super().__init__(f"{remote_type}: {msg}")
+        self.remote_type = remote_type
+        self.msg = msg
+        self.code = code
+        self.detail = detail or {}
+
+
+class RpcFault(Exception):
+    """Raise from a method handler to send a typed, coded error to the
+    caller (surfaces there as :class:`RemoteError` with the same code)."""
+
+    def __init__(self, msg: str, code: int = 400,
+                 detail: Optional[dict] = None) -> None:
+        super().__init__(msg)
+        self.code = code
+        self.detail = detail or {}
+
+
+def _encode(obj: Any) -> bytes:
+    return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+class RpcClient:
+    """Pooled caller with the bounded exponential-backoff retry ladder."""
+
+    def __init__(
+        self,
+        dialer: Callable[[transport.Address, float], transport.Connection] = transport.dial,
+        retries: int = 3,
+        backoff_base: float = 0.05,
+        backoff_max: float = 2.0,
+    ) -> None:
+        self.pool = transport.ConnectionPool(dialer)
+        self.retries = int(retries)
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+
+    def call(
+        self,
+        addr: transport.Address,
+        method: str,
+        payload: Any = None,
+        timeout: float = 5.0,
+        target: str = "",
+        retries: Optional[int] = None,
+    ) -> Any:
+        """One logical call: up to ``1 + retries`` attempts, every retry
+        re-sending the SAME idempotency token so the server side never
+        double-executes (water/RPC.java's resend discipline).
+
+        ``timeout`` is PER ATTEMPT: worst-case blocking against a
+        black-holed peer is ``(1 + retries) * timeout`` plus backoff.
+        Deadline-sensitive callers (heartbeat loops, REST proxies) pass
+        ``retries=`` to shrink or disable the ladder for that call.
+        """
+        token = uuid.uuid4().hex
+        request = _encode(
+            {"id": token, "method": method, "payload": payload}
+        )
+        target = target or f"{addr[0]}:{addr[1]}"
+        ladder = self.retries if retries is None else max(0, int(retries))
+        t0 = time.perf_counter()
+        last_exc: Optional[BaseException] = None
+        timed_out = False
+        try:
+            for attempt in range(ladder + 1):
+                if attempt:
+                    _RPC_RETRIES.inc()
+                    time.sleep(min(
+                        self.backoff_base * (2 ** (attempt - 1)),
+                        self.backoff_max,
+                    ))
+                try:
+                    raw = self._attempt(addr, request, timeout)
+                except socket.timeout as e:
+                    timed_out = True
+                    last_exc = e
+                    continue
+                except (ConnectionError, OSError) as e:
+                    last_exc = e
+                    continue
+                resp = pickle.loads(raw)
+                if resp.get("ok"):
+                    _RPC_CALLS.inc(target=target, method=method, result="ok")
+                    return resp.get("value")
+                err = resp.get("error") or {}
+                _RPC_CALLS.inc(
+                    target=target, method=method, result="remote_error")
+                raise RemoteError(
+                    err.get("type", "Exception"),
+                    err.get("msg", "remote call failed"),
+                    int(err.get("code", 500)),
+                    err.get("detail"),
+                )
+            result = "timeout" if timed_out else "connect_error"
+            _RPC_CALLS.inc(target=target, method=method, result=result)
+            if timed_out:
+                raise RPCTimeoutError(
+                    f"{method} to {target} timed out after "
+                    f"{ladder + 1} attempts of {timeout}s"
+                ) from last_exc
+            raise RPCConnectionError(
+                f"{method} to {target} unreachable after "
+                f"{ladder + 1} attempts: {last_exc}"
+            ) from last_exc
+        finally:
+            _RPC_SECONDS.observe(time.perf_counter() - t0, method=method)
+
+    def _attempt(self, addr: transport.Address, request: bytes,
+                 timeout: float) -> bytes:
+        """One ladder attempt.  Every idle pooled socket to a restarted
+        peer is stale at once (pool max_idle == ladder depth), so a
+        pooled connection that fails is closed and the next tried WITHIN
+        the attempt — only a fresh dial's failure, or any timeout,
+        charges the retry ladder."""
+        while True:
+            conn = self.pool.pop_idle(addr)
+            if conn is None:
+                break
+            try:
+                raw = conn.request(request, timeout)
+            except socket.timeout:
+                conn.close()  # live but slow: the ladder's problem
+                raise
+            except (ConnectionError, OSError):
+                conn.close()  # stale pooled socket: try the next
+                continue
+            self.pool.put(conn)
+            return raw
+        conn = self.pool.dial(addr, timeout)
+        try:
+            raw = conn.request(request, timeout)
+        except BaseException:
+            conn.close()  # response may still arrive: poisoned
+            raise
+        self.pool.put(conn)
+        return raw
+
+    def close(self) -> None:
+        self.pool.close_all()
+
+
+class RpcServer:
+    """Method registry + idempotent dispatch over a TransportServer."""
+
+    #: responses remembered per idempotency token — deep enough that a
+    #: retry ladder (seconds) can never outlive the memo (thousands of
+    #: calls) under any realistic call rate
+    DEDUP_CAPACITY = 4096
+    #: byte budget across memoized responses: big payloads (DKV frames,
+    #: echo benches) must not pin hundreds of MB of dead responses —
+    #: oldest entries evict first once the budget is exceeded
+    DEDUP_BYTE_BUDGET = 64 << 20
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        self._methods: Dict[str, Callable[[Any], Any]] = {}
+        self._lock = threading.Lock()
+        #: token -> (done_event, encoded_response|None): duplicates of an
+        #: in-flight token wait on the first execution instead of racing it
+        self._seen: "OrderedDict[str, Tuple[threading.Event, Optional[bytes]]]" = OrderedDict()
+        self._seen_bytes = 0
+        self._server = transport.TransportServer(
+            self._handle, host=host, port=port)
+        self.address = self._server.address
+
+    def register(self, method: str, fn: Callable[[Any], Any]) -> None:
+        self._methods[method] = fn
+
+    def _execute(self, method: str, payload: Any) -> bytes:
+        fn = self._methods.get(method)
+        try:
+            if fn is None:
+                raise RpcFault(f"unknown RPC method {method!r}", code=404)
+            value = fn(payload)
+            _RPC_SERVED.inc(method=method, result="ok")
+            return _encode({"ok": True, "value": value})
+        except RpcFault as e:
+            _RPC_SERVED.inc(method=method, result="fault")
+            return _encode({"ok": False, "error": {
+                "type": "RpcFault", "msg": str(e), "code": e.code,
+                "detail": e.detail,
+            }})
+        except Exception as e:  # noqa: BLE001 — ships to the caller typed
+            _RPC_SERVED.inc(method=method, result="error")
+            return _encode({"ok": False, "error": {
+                "type": type(e).__name__, "msg": str(e), "code": 500,
+            }})
+
+    def _evict_memo_locked(self) -> None:
+        """Oldest-first memo eviction that never drops an IN-FLIGHT
+        token: evicting one would re-execute a retried mutation (if its
+        first run later completed) or 409 a parked duplicate of a call
+        that actually succeeded.  In-flight entries hold no response
+        bytes, so the byte budget is enforceable without them; capacity
+        may transiently exceed by the number of concurrent calls."""
+        def _over() -> bool:
+            return len(self._seen) > self.DEDUP_CAPACITY or (
+                self._seen_bytes > self.DEDUP_BYTE_BUDGET
+                and len(self._seen) > 1)
+
+        if not _over():
+            return
+        for tok in list(self._seen):
+            if not _over():
+                return
+            _ev, resp = self._seen[tok]
+            if resp is None:
+                continue  # in-flight: protected
+            del self._seen[tok]
+            self._seen_bytes -= len(resp)
+
+    def _handle(self, raw: bytes) -> bytes:
+        try:
+            req = pickle.loads(raw)
+            token = req["id"]
+            method = req["method"]
+        except Exception as e:  # undecodable frame: typed error, no memo
+            return _encode({"ok": False, "error": {
+                "type": type(e).__name__, "msg": f"bad request frame: {e}",
+                "code": 400,
+            }})
+        with self._lock:
+            entry = self._seen.get(token)
+            if entry is None:
+                event = threading.Event()
+                self._seen[token] = (event, None)
+                self._evict_memo_locked()
+            else:
+                event = entry[0]
+        if entry is not None:
+            # duplicate delivery (retry after a lost response): wait for
+            # the original execution, return its memoized response
+            event.wait(timeout=300)
+            with self._lock:
+                memo = self._seen.get(token)
+            if memo is not None and memo[1] is not None:
+                return memo[1]
+            return _encode({"ok": False, "error": {
+                "type": "RpcFault", "code": 409,
+                "msg": "duplicate of a call that never completed",
+            }})
+        response = self._execute(method, req.get("payload"))
+        with self._lock:
+            if token in self._seen:
+                self._seen[token] = (event, response)
+                self._seen_bytes += len(response)
+        event.set()
+        return response
+
+    def stop(self) -> None:
+        self._server.stop()

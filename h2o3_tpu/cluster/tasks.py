@@ -1,0 +1,306 @@
+"""L3b: remote task execution — DTask fan-out over cloud members.
+
+Reference: ``water/DTask.java`` ships a serialized task to a node, runs
+it there, ships the result back; ``water/MRTask.java:96-127`` composes
+that into the node-tree fan-out/reduce every algorithm rides.  Here a
+task is a registered name + a pickled payload (functions cross the wire
+by module reference — one codebase per cloud, like the reference's
+shared classpath), executed on the receiving node's RPC thread.
+
+Two fan-outs mirror the two distributed workloads this repro has:
+
+* :func:`distributed_map_reduce` — slice a frame's host columns into one
+  contiguous row range per healthy member, run the member's range through
+  the local :func:`~h2o3_tpu.compute.mapreduce.map_reduce` (shard_map +
+  psum over that node's own device mesh), and combine the per-node
+  partials on the caller.  A cloud of one (or none) takes the plain local
+  path, bit-for-bit.
+* :func:`distributed_parse_chunks` — round-robin CSV chunk tokenization
+  (``frame/parse._parse_chunk``) over members, reducing with the parse
+  pipeline's own phase-2 merge, so multi-node parse shares the serial
+  path's bit-identity contract.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from h2o3_tpu.cluster import rpc as _rpc
+from h2o3_tpu.cluster.membership import Cloud, Member
+from h2o3_tpu.util import telemetry
+
+_TASKS_METER = telemetry.counter(
+    "cluster_tasks_total", "remote DTask executions",
+    labels=("task", "result"),
+)
+_FANOUT = telemetry.gauge(
+    "cluster_task_fanout", "members the most recent fan-out spanned")
+
+#: name -> handler; a task must be registered on every node of the cloud
+#: (one codebase per cloud), like DTask classes on the shared classpath
+_REGISTRY: Dict[str, Callable[[Any], Any]] = {}
+
+
+def register_task(name: str, fn: Optional[Callable[[Any], Any]] = None):
+    """Register (or decorate) a named task handler."""
+    def _reg(f: Callable[[Any], Any]) -> Callable[[Any], Any]:
+        _REGISTRY[name] = f
+        return f
+    return _reg(fn) if fn is not None else _reg
+
+
+def _run_task(payload: Dict[str, Any]) -> Any:
+    name = payload.get("task")
+    fn = _REGISTRY.get(name)
+    if fn is None:
+        _TASKS_METER.inc(task=str(name), result="unknown")
+        raise _rpc.RpcFault(f"unknown task {name!r}", code=404)
+    try:
+        out = fn(payload.get("payload"))
+    except Exception:
+        _TASKS_METER.inc(task=str(name), result="error")
+        raise
+    _TASKS_METER.inc(task=str(name), result="ok")
+    return out
+
+
+def install(cloud: Cloud) -> None:
+    """Register the DTask endpoint on a cloud's RPC server."""
+    cloud.rpc_server.register("dtask", _run_task)
+
+
+def submit(cloud: Cloud, member: Member, task: str, payload: Any = None,
+           timeout: float = 120.0) -> Any:
+    """Run one named task on one member and return its result."""
+    return cloud.client.call(
+        member.info.addr, "dtask", {"task": task, "payload": payload},
+        timeout=timeout, target=member.info.ident)
+
+
+# ---------------------------------------------------------------------------
+# built-in tasks
+
+
+@register_task("echo")
+def _task_echo(payload: Any) -> Any:
+    return payload
+
+
+def _table_from_columns(columns: Dict[str, np.ndarray]):
+    """Row-shard a dict of host columns onto THIS node's device mesh —
+    the per-node half of a distributed map_reduce."""
+    from h2o3_tpu.compute.mapreduce import FrameTable
+    from h2o3_tpu.parallel.mesh import default_mesh, row_mask, shard_rows
+
+    mesh = default_mesh()
+    arrays = {}
+    n = 0
+    for name, host in columns.items():
+        arr, n = shard_rows(
+            np.asarray(host, dtype=np.float32), mesh, fill=np.nan)
+        arrays[name] = arr
+    some = next(iter(arrays.values()))
+    return FrameTable(arrays, row_mask(n, some.shape[0], mesh), n, mesh)
+
+
+def _mr_shard_local(fn: Callable, columns: Dict[str, np.ndarray],
+                    reduce: str) -> Any:
+    """Run fn over one node's row range; partials come back as numpy so
+    they frame-serialize without device references."""
+    import jax
+
+    from h2o3_tpu.compute.mapreduce import map_reduce
+
+    out = map_reduce(fn, _table_from_columns(columns), reduce=reduce)
+    return jax.tree.map(np.asarray, out)
+
+
+@register_task("mr_shard")
+def _task_mr_shard(payload: Dict[str, Any]) -> Any:
+    return _mr_shard_local(
+        payload["fn"], payload["columns"], payload.get("reduce", "sum"))
+
+
+@register_task("parse_chunk")
+def _task_parse_chunk(payload: Dict[str, Any]) -> Any:
+    from h2o3_tpu.frame import parse as _parse
+
+    setup = payload["setup"]
+    na = frozenset(setup.na_strings)
+    napack = _parse._pipeline_napack(setup)
+    return _parse._parse_chunk(payload["chunk"], setup, na, napack)
+
+
+# ---------------------------------------------------------------------------
+# fan-outs
+
+
+_COMBINE = {"sum": np.add, "max": np.maximum, "min": np.minimum}
+
+
+def _healthy_workers(cloud: Cloud) -> List[Member]:
+    return [m for m in cloud.members_sorted()
+            if m.healthy and not m.info.client]
+
+
+def distributed_map_reduce(
+    fn: Callable,
+    columns: Dict[str, np.ndarray],
+    reduce: str = "sum",
+    cloud: Optional[Cloud] = None,
+    timeout: float = 300.0,
+) -> Any:
+    """MRTask over the cloud: contiguous row ranges fan out to members,
+    each runs the local shard_map+psum ``map_reduce`` over its range, and
+    the partials combine here in canonical member order.
+
+    ``fn`` must be importable on every member (module-level, one shared
+    codebase) — a closure raises immediately rather than failing remotely.
+    Falls back to plain local execution when no multi-node cloud is live,
+    and re-runs a failed member's range locally (the caller IS the reduce
+    point, so a lost member costs latency, not the answer).
+    """
+    if reduce not in _COMBINE:
+        raise ValueError(
+            f"unknown reduce {reduce!r}; valid choices: {sorted(_COMBINE)}")
+    if cloud is None:
+        from h2o3_tpu.cluster import active_cloud
+
+        cloud = active_cloud()
+    if cloud is None:
+        return _mr_shard_local(fn, columns, reduce)
+    workers = _healthy_workers(cloud)
+    if len(workers) < 2:
+        return _mr_shard_local(fn, columns, reduce)
+    if getattr(fn, "__name__", "<lambda>") == "<lambda>" or \
+            getattr(fn, "__closure__", None):
+        raise ValueError(
+            "distributed map_reduce needs a module-level fn (it crosses "
+            "the wire by module reference); got a lambda/closure")
+
+    n = len(next(iter(columns.values())))
+    k = len(workers)
+    bounds = [round(i * n / k) for i in range(k + 1)]
+    _FANOUT.set(k)
+    partials: List[Any] = [None] * k
+    errors: List[Optional[Exception]] = [None] * k
+
+    def _run(i: int, member: Member) -> None:
+        lo, hi = bounds[i], bounds[i + 1]
+        part = {name: np.ascontiguousarray(arr[lo:hi])
+                for name, arr in columns.items()}
+        if hi <= lo:
+            return  # empty range contributes the identity (skipped)
+        try:
+            if member.info.name == cloud.info.name:
+                partials[i] = _mr_shard_local(fn, part, reduce)
+            else:
+                partials[i] = submit(
+                    cloud, member, "mr_shard",
+                    {"fn": fn, "columns": part, "reduce": reduce},
+                    timeout=timeout)
+        except _rpc.RPCError as e:
+            errors[i] = e
+            partials[i] = _mr_shard_local(fn, part, reduce)  # recover
+
+    threads = [threading.Thread(target=_run, args=(i, m), daemon=True)
+               for i, m in enumerate(workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout)
+
+    # take ONE snapshot per range: a member that answered contributes its
+    # partial; a member that failed (error) already recovered inside _run;
+    # a member that never answered inside the deadline re-runs HERE — a
+    # silent missing range would be a silently wrong reduction
+    recovered = 0
+    parts = []
+    for i in range(k):
+        lo, hi = bounds[i], bounds[i + 1]
+        if hi <= lo:
+            continue
+        p = partials[i]
+        if p is None:
+            part = {name: np.ascontiguousarray(arr[lo:hi])
+                    for name, arr in columns.items()}
+            p = _mr_shard_local(fn, part, reduce)
+            recovered += 1
+        parts.append(p)
+    if recovered or any(e is not None for e in errors):
+        from h2o3_tpu.util.log import get_logger
+
+        get_logger("cluster").warning(
+            "map_reduce fan-out recovered %d member range(s) locally",
+            recovered + sum(1 for e in errors if e is not None))
+
+    if not parts:  # zero-row input: the local path defines the answer
+        return _mr_shard_local(fn, columns, reduce)
+
+    import jax
+
+    op = _COMBINE[reduce]
+    out = parts[0]
+    for p in parts[1:]:
+        out = jax.tree.map(op, out, p)
+    return out
+
+
+def distributed_parse_chunks(
+    chunks: Sequence[bytes],
+    setup,
+    cloud: Optional[Cloud] = None,
+    timeout: float = 300.0,
+):
+    """Phase-1 chunk tokenization round-robined over cloud members,
+    reduced with the pipeline's own phase-2 merge — multi-node parse with
+    the serial path's bit-identity contract.  Local-only when no
+    multi-node cloud is live."""
+    from h2o3_tpu.frame import parse as _parse
+
+    na = frozenset(setup.na_strings)
+    if cloud is None:
+        from h2o3_tpu.cluster import active_cloud
+
+        cloud = active_cloud()
+    workers = _healthy_workers(cloud) if cloud is not None else []
+    results: List[Any] = [None] * len(chunks)
+    if len(workers) < 2:
+        napack = _parse._pipeline_napack(setup)
+        for i, chunk in enumerate(chunks):
+            results[i] = _parse._parse_chunk(chunk, setup, na, napack)
+        return _parse._reduce_chunks(results, setup)
+    _FANOUT.set(len(workers))
+    napack = _parse._pipeline_napack(setup)
+
+    def _run(i: int, chunk: bytes, member: Member) -> None:
+        try:
+            if member.info.name == cloud.info.name:
+                results[i] = _parse._parse_chunk(chunk, setup, na, napack)
+            else:
+                results[i] = submit(
+                    cloud, member, "parse_chunk",
+                    {"chunk": chunk, "setup": setup}, timeout=timeout)
+        except _rpc.RPCError:
+            results[i] = _parse._parse_chunk(  # recover locally
+                chunk, setup, na, napack)
+
+    # bounded fan-out: a couple of chunks in flight per member pipelines
+    # the stream at constant memory — one thread (and one pickled copy
+    # of its chunk) per chunk at once would hold ~2x the input resident
+    from concurrent.futures import ThreadPoolExecutor
+    from concurrent.futures import wait as _futures_wait
+
+    ex = ThreadPoolExecutor(
+        max_workers=2 * len(workers), thread_name_prefix="parse-fanout")
+    futs = [ex.submit(_run, i, c, workers[i % len(workers)])
+            for i, c in enumerate(chunks)]
+    _futures_wait(futs, timeout=timeout)
+    ex.shutdown(wait=False, cancel_futures=True)
+    for i, r in enumerate(results):
+        if r is None:  # member never answered in time: tokenize here
+            results[i] = _parse._parse_chunk(chunks[i], setup, na, napack)
+    return _parse._reduce_chunks(results, setup)

@@ -1,0 +1,198 @@
+"""L0 transport: length-prefixed TCP frames + a per-target connection pool.
+
+Reference: ``water/AutoBuffer.java`` — H2O's one wire format is "a small
+header, then bytes", written onto persistent node-to-node TCP channels
+(``water/network/SocketChannelDriver``).  The analogue here is the
+simplest correct thing: every message is ``!I`` length prefix + payload,
+written to a pooled ``socket`` connection.  Everything above (request
+ids, retries, method names) belongs to :mod:`h2o3_tpu.cluster.rpc`.
+
+The ``dial`` entry point is deliberately a plain module function taken by
+:class:`ConnectionPool` as a constructor argument: the RPC fault-injection
+tests wrap it with a double that drops / delays / duplicates frames
+without touching a real socket option.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from typing import Callable, Dict, List, Tuple
+
+#: frame header: payload byte length, network order
+_HEADER = struct.Struct("!I")
+
+#: hard ceiling on one frame — a corrupt or hostile length prefix must
+#: never make recv allocate unbounded memory (1 GiB covers any shipped
+#: frame shard; bigger payloads should stream, not frame)
+MAX_FRAME_BYTES = 1 << 30
+
+Address = Tuple[str, int]
+
+
+class FrameTooLarge(ConnectionError):
+    """Peer announced a frame over MAX_FRAME_BYTES — protocol corruption."""
+
+
+def send_frame(sock: socket.socket, payload: bytes) -> None:
+    """One length-prefixed frame; a single sendall keeps it atomic enough
+    that concurrent writers on DISTINCT sockets never interleave."""
+    if len(payload) > MAX_FRAME_BYTES:
+        raise FrameTooLarge(f"frame of {len(payload)} bytes exceeds "
+                            f"{MAX_FRAME_BYTES}")
+    sock.sendall(_HEADER.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        buf += chunk
+    return bytes(buf)
+
+
+def recv_frame(sock: socket.socket) -> bytes:
+    (length,) = _HEADER.unpack(_recv_exact(sock, _HEADER.size))
+    if length > MAX_FRAME_BYTES:
+        raise FrameTooLarge(f"announced frame of {length} bytes exceeds "
+                            f"{MAX_FRAME_BYTES}")
+    return _recv_exact(sock, length)
+
+
+class Connection:
+    """One pooled TCP connection: send a request frame, read the response
+    frame. NOT thread-safe — the pool hands a connection to exactly one
+    caller at a time."""
+
+    def __init__(self, sock: socket.socket, addr: Address) -> None:
+        self.sock = sock
+        self.addr = addr
+
+    def request(self, payload: bytes, timeout: float) -> bytes:
+        self.sock.settimeout(timeout)
+        send_frame(self.sock, payload)
+        return recv_frame(self.sock)
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def dial(addr: Address, timeout: float = 5.0) -> Connection:
+    """Open one connection (the pool's default dialer)."""
+    sock = socket.create_connection(addr, timeout=timeout)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return Connection(sock, addr)
+
+
+class ConnectionPool:
+    """Per-target idle-connection pool (RPC.java reuses its node channel;
+    here a bounded stack of idle sockets per address).  A connection that
+    errored mid-call is closed, never returned — the next call dials
+    fresh rather than inheriting a poisoned stream."""
+
+    def __init__(self, dialer: Callable[[Address, float], Connection] = dial,
+                 max_idle: int = 4) -> None:
+        self._dial = dialer
+        self._max_idle = max_idle
+        self._idle: Dict[Address, List[Connection]] = {}
+        self._lock = threading.Lock()
+
+    def get(self, addr: Address, timeout: float) -> Connection:
+        conn = self.pop_idle(addr)
+        return conn if conn is not None else self._dial(addr, timeout)
+
+    def pop_idle(self, addr: Address) -> "Connection | None":
+        """An idle pooled connection, or None — callers that must know
+        whether a failure hit a possibly-stale pooled socket (the RPC
+        retry ladder) pop explicitly and dial via :meth:`dial`."""
+        with self._lock:
+            stack = self._idle.get(addr)
+            if stack:
+                return stack.pop()
+        return None
+
+    def dial(self, addr: Address, timeout: float) -> Connection:
+        return self._dial(addr, timeout)
+
+    def put(self, conn: Connection) -> None:
+        with self._lock:
+            stack = self._idle.setdefault(conn.addr, [])
+            if len(stack) < self._max_idle:
+                stack.append(conn)
+                return
+        conn.close()
+
+    def close_all(self) -> None:
+        with self._lock:
+            conns = [c for s in self._idle.values() for c in s]
+            self._idle.clear()
+        for c in conns:
+            c.close()
+
+
+class TransportServer:
+    """Frame server: accept loop + one thread per connection, each frame
+    handed to ``handler(payload) -> response`` and the response framed
+    back.  Binds port 0 by default — the resolved address is the node's
+    identity, published via flatfile/address-file rendezvous."""
+
+    def __init__(self, handler: Callable[[bytes], bytes],
+                 host: str = "127.0.0.1", port: int = 0) -> None:
+        self._handler = handler
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.address: Address = self._sock.getsockname()[:2]
+        self._stopping = threading.Event()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"rpc-accept-{self.address[1]}",
+        )
+        self._accept_thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._stopping.is_set():
+            try:
+                conn, _peer = self._sock.accept()
+            except OSError:
+                if self._stopping.is_set():
+                    return  # listener closed by stop()
+                # transient accept failure (EMFILE under thread fan-out,
+                # ECONNABORTED): a dead accept loop would leave the node
+                # heartbeating outbound — looking healthy — while every
+                # inbound RPC fails; breathe and keep serving
+                time.sleep(0.05)
+                continue
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True,
+                name="rpc-worker",
+            ).start()
+
+    def _serve_conn(self, sock: socket.socket) -> None:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            while not self._stopping.is_set():
+                payload = recv_frame(sock)
+                send_frame(sock, self._handler(payload))
+        except (ConnectionError, OSError):
+            pass  # client went away: its pooled socket died with it
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def stop(self) -> None:
+        self._stopping.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -352,3 +352,36 @@ def map_batches(fn: Callable, table: FrameTable, *extra_args):
 def gather_rows(x: jax.Array, n_valid: int) -> np.ndarray:
     """Pull a row-sharded device result back to host, dropping pad rows."""
     return np.asarray(jax.device_get(x))[:n_valid]
+
+
+def map_reduce_frame(
+    fn: Callable,
+    frame: Frame,
+    columns: Optional[Sequence[str]] = None,
+    reduce: str = "sum",
+):
+    """Cluster-aware MRTask entry: ``map_reduce`` over a Frame that fans
+    contiguous row ranges out to the members of a live multi-node
+    application-plane cloud (h2o3_tpu/cluster/tasks.py), each member
+    running the local shard_map+psum path over its range.  With no cloud
+    — or a cloud of one — this is exactly the local path.  Returns the
+    reduced pytree as HOST (numpy) arrays in both cases, so callers see
+    one contract regardless of where the shards ran."""
+    names = list(columns) if columns is not None else [
+        c.name for c in frame.columns
+        if c.type not in (ColType.STR, ColType.UUID)
+    ]
+    try:
+        from h2o3_tpu.cluster import active_cloud
+
+        cloud = active_cloud()
+    except Exception:
+        cloud = None
+    if cloud is None:
+        table = FrameTable.from_frame(frame, columns=names)
+        out = map_reduce(fn, table, reduce=reduce)
+        return jax.tree.map(np.asarray, out)
+    from h2o3_tpu.cluster.tasks import distributed_map_reduce
+
+    host = {n: frame.col(n).numeric_view() for n in names}
+    return distributed_map_reduce(fn, host, reduce=reduce, cloud=cloud)

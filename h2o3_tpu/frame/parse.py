@@ -711,14 +711,11 @@ def _parse_chunk_python(
     return len(records), payloads, False
 
 
-def _parse_pipeline(
-    blocks: Iterable[bytes],
-    setup: ParseSetup,
-    t0: float,
-    workers: Optional[int],
-) -> Frame:
-    na = frozenset(setup.na_strings)
-    w = max(1, int(workers)) if workers else _env_workers()
+def _pipeline_napack(setup: ParseSetup):
+    """The packed-NA blob chunk workers hand the native tokenizer, or
+    None when the native path is unavailable/ineligible.  Shared by the
+    in-process pipeline and the cluster's remote parse_chunk task
+    (h2o3_tpu/cluster/tasks.py) so both pick the same tokenizer."""
     napack = None
     try:
         from h2o3_tpu import native
@@ -731,6 +728,18 @@ def _parse_pipeline(
         t in (ColType.NUM, ColType.BAD) for t in setup.column_types
     ):
         napack = None  # a numeric NA token breaks native float parity
+    return napack
+
+
+def _parse_pipeline(
+    blocks: Iterable[bytes],
+    setup: ParseSetup,
+    t0: float,
+    workers: Optional[int],
+) -> Frame:
+    na = frozenset(setup.na_strings)
+    w = max(1, int(workers)) if workers else _env_workers()
+    napack = _pipeline_napack(setup)
 
     futures: list = []
     tail_result: Optional[_ChunkResult] = None

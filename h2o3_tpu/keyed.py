@@ -95,6 +95,12 @@ class KeyedStore:
     def __init__(self) -> None:
         self._store: Dict[str, Any] = {}
         self._lock = threading.RLock()
+        #: distributed key-home router (h2o3_tpu/cluster/dkv.py DkvRouter),
+        #: installed when a multi-node cloud forms: put/get/remove for keys
+        #: homed on another node forward over RPC. None (or a single-node
+        #: cloud) short-circuits every call to the plain local path below,
+        #: so nothing changes for existing callers.
+        self.router = None
         # Scope stacks are PER-THREAD (water/Scope.java is thread-local
         # too): concurrent builds (parallel grid, REST train threads)
         # must never see — or pop — each other's scopes
@@ -280,7 +286,22 @@ class KeyedStore:
                 pass
 
     # -- DKV.put/get/remove (water/DKV.java:30-62) ---------------------------
-    def put(self, key: str, value: Any) -> str:
+    def _route(self, key: str, _local: bool):
+        """The router when this op must forward: a live multi-node router,
+        a key homed elsewhere, and not an RPC-served local op."""
+        r = self.router
+        if r is None or _local or not r.active() or r.is_home(key):
+            return None
+        return r
+
+    def put(self, key: str, value: Any, *, replicas: int = 1,
+            _local: bool = False) -> str:
+        r = self._route(key, _local)
+        if r is not None and r.routes_value(value):
+            # plain data rides the ring to its home; framework objects
+            # (Frame/Model/Job...) fall through to the local store —
+            # this node owns their in-place mutation, listing and locks
+            return r.remote_put(key, value, replicas)
         spillable = _frame_nbytes(value) > 0
         with self._lock:
             # replacing a read-locked registration with a DIFFERENT object
@@ -298,9 +319,21 @@ class KeyedStore:
             _DKV_KEYS.set(len(self._store))
         if spillable:
             self._maybe_spill()
+        if replicas > 1 and not _local:
+            # home-side replica fan-out (the replicas= knob for metadata
+            # keys; plain data only — node-local framework objects never
+            # ship); reached both by local puts on the home node and by
+            # the RPC dkv_put handler forwarding a non-home caller's put
+            r = self.router
+            if r is not None and r.active() and r.routes_value(value):
+                r.replicate(key, value, replicas)
         return key
 
-    def get(self, key: str, default: Any = None) -> Any:
+    def get(self, key: str, default: Any = None, *,
+            _local: bool = False) -> Any:
+        r = self._route(key, _local)
+        if r is not None:
+            return r.remote_get(key, default)
         _DKV_GETS.inc()
         with self._lock:
             v = self._store.get(key, default)
@@ -321,7 +354,7 @@ class KeyedStore:
         with self._lock:
             return key in self._store
 
-    def remove(self, key: str) -> None:
+    def remove(self, key: str, *, _local: bool = False) -> None:
         with self._lock:
             self._check_unlocked(key)
             v = self._store.pop(key, None)
@@ -331,6 +364,13 @@ class KeyedStore:
             _DKV_KEYS.set(len(self._store))
         if v is not None:
             _devcache_invalidate(key)
+        if not _local:
+            # removal routes to the key's ring home, which reaps any
+            # replica copies it tracked — at most one RPC here, zero
+            # when this node is the home
+            r = self.router
+            if r is not None and r.active():
+                r.remote_remove(key)
 
     def rekey(self, obj: Any, new_key: str) -> str:
         """Re-register ``obj`` (which carries a ``.key`` attribute) under

@@ -62,15 +62,32 @@ def distributed_initialize(**kwargs) -> None:
     try:
         jax.distributed.initialize(**kwargs)
     except RuntimeError as e:
-        # tolerate double-initialization; real bootstrap failures must
-        # surface, or a multi-host job would silently train on one host
-        if "already" not in str(e).lower():
-            raise
+        # exactly ONE RuntimeError is benign: repeat initialization (the
+        # bootstrap is idempotent by contract). Every other RuntimeError
+        # is a real rendezvous failure — surface it WITH the attempted
+        # kwargs, or a multi-host job silently trains on one host and the
+        # operator has nothing to debug with.
+        if "already" in str(e).lower():
+            return
+        raise RuntimeError(
+            f"jax.distributed.initialize({_fmt_kwargs(kwargs)}) failed: {e}"
+        ) from e
     except ValueError as e:
-        # single-process runs (no coordinator configured) are a no-op;
-        # misconfigured multi-host args still raise
-        if "coordinator" not in str(e).lower():
-            raise
+        # exactly ONE ValueError is benign: a bare initialize() on a
+        # single-process run where no coordinator was configured AT ALL
+        # (jax raises "coordinator_address should be defined"). If the
+        # caller supplied any bootstrap kwargs, a ValueError means they
+        # are wrong (bad process id, missing num_processes, ...) — always
+        # re-raise those, with the kwargs in the message.
+        if not kwargs and "coordinator_address" in str(e):
+            return
+        raise ValueError(
+            f"jax.distributed.initialize({_fmt_kwargs(kwargs)}) failed: {e}"
+        ) from e
+
+
+def _fmt_kwargs(kwargs: dict) -> str:
+    return ", ".join(f"{k}={v!r}" for k, v in sorted(kwargs.items()))
 
 
 def device_count() -> int:

@@ -64,19 +64,25 @@ def readme_documented_routes(readme_path: str) -> set:
 _METRIC_SUFFIXES = ("_total", "_seconds", "_bytes", "_entries", "_workers")
 
 
+#: README sections whose backticked metric references the registry must
+#: actually contain (the Clustering section documents cluster_*/rpc_*)
+_METRIC_SECTIONS = ("Observability", "Clustering")
+
+
 def readme_documented_metrics(readme_path: str) -> set:
-    """Metric names referenced in the Observability section's prose."""
+    """Metric names referenced in the metric-documenting sections' prose."""
     with open(readme_path) as f:
         text = f.read()
-    m = re.search(r"^## Observability$(.*?)(?=^## |\Z)", text,
-                  re.MULTILINE | re.DOTALL)
-    if not m:
-        return set()
     names = set()
-    for tok in re.findall(r"`([a-z][a-z0-9_]*)(?:\{[a-z0-9_,]+\})?`",
-                          m.group(1)):
-        if tok.endswith(_METRIC_SUFFIXES):
-            names.add(tok)
+    for section in _METRIC_SECTIONS:
+        m = re.search(rf"^## {section}$(.*?)(?=^## |\Z)", text,
+                      re.MULTILINE | re.DOTALL)
+        if not m:
+            continue
+        for tok in re.findall(r"`([a-z][a-z0-9_]*)(?:\{[a-z0-9_,]+\})?`",
+                              m.group(1)):
+            if tok.endswith(_METRIC_SUFFIXES):
+                names.add(tok)
     return names
 
 
@@ -89,6 +95,10 @@ def live_metrics() -> set:
     import h2o3_tpu.frame.devcache   # noqa: F401  devcache_* meters
     import h2o3_tpu.compute.mapreduce  # noqa: F401  mapreduce_* meters
     import h2o3_tpu.models.framework  # noqa: F401  model_fit_seconds
+    import h2o3_tpu.cluster.rpc      # noqa: F401  rpc_* meters
+    import h2o3_tpu.cluster.membership  # noqa: F401  cluster_* meters
+    import h2o3_tpu.cluster.dkv      # noqa: F401  cluster_dkv_* meters
+    import h2o3_tpu.cluster.tasks    # noqa: F401  cluster_tasks_* meters
     from h2o3_tpu.util import telemetry
 
     return set(telemetry.REGISTRY.names())
@@ -134,8 +144,8 @@ def main() -> int:
         - registered
     for name in sorted(ghost):
         failures.append(
-            f"README.md's Observability section documents metric {name!r} "
-            f"but the telemetry registry never declares it"
+            f"README.md's {'/'.join(_METRIC_SECTIONS)} sections document "
+            f"metric {name!r} but the telemetry registry never declares it"
         )
 
     from h2o3_tpu.api.registry import algo_map

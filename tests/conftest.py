@@ -56,6 +56,11 @@ def pytest_configure(config):
         "leaks_keys: legacy test/module exempt from the strict DKV "
         "key-leak check (keys are still swept after the test)",
     )
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 run (-m 'not slow'): multi-node "
+        "formation tests and other long-wall-clock coverage",
+    )
 
 
 def _sweep_keys(keys):

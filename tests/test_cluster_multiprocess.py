@@ -1,0 +1,365 @@
+"""Multi-process cluster tier: real OS processes, real cloud formation.
+
+Reference analogue: the test suite's "N JVMs on localhost" cloud
+(water.runner.H2ORunner + @CloudSize(n)).  Every node here binds its RPC
+listener on port 0 and publishes the resolved address through an
+address file the harness folds into the next node's flatfile — no fixed
+ports, no collisions under parallel CI.  Every wait carries its own
+watchdog deadline so a wedged node fails the test with output instead of
+hanging the tier.
+
+Three tests:
+  * 2-node full-stack cloud over ``python -m h2o3_tpu`` — /3/Cloud
+    quorum on both nodes, cross-node DKV through the REST surface, node
+    RPC proxies, and the suspicion flip after a SIGKILL (tier-1);
+  * 2-node map_reduce fan-out bit-exactness with a real remote DTask
+    executor (tier-1);
+  * 3-node formation via the light nodeproc entry (marked slow).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: outer watchdog for any single wait; generous because a cold full-node
+#: boot initializes the XLA CPU backend
+WAIT = 120.0
+
+
+def _env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["H2O3_TPU_HB_INTERVAL"] = "0.2"  # suspicion window: 5 * 0.2s
+    return env
+
+
+class _Proc:
+    """Subprocess + stdout collector + watchdog-bounded helpers."""
+
+    def __init__(self, cmd, cwd, env):
+        self.proc = subprocess.Popen(
+            cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True, cwd=cwd, env=env)
+        self.lines = []
+        self._lock = threading.Lock()
+        self._reader = threading.Thread(target=self._pump, daemon=True)
+        self._reader.start()
+
+    def _pump(self):
+        for line in self.proc.stdout:
+            with self._lock:
+                self.lines.append(line)
+
+    def output(self):
+        with self._lock:
+            return "".join(self.lines)
+
+    def wait_for_line(self, needle, timeout=WAIT):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            out = self.output()
+            if needle in out:
+                return out
+            if self.proc.poll() is not None:
+                pytest.fail(
+                    f"process exited rc={self.proc.returncode} before "
+                    f"{needle!r}:\n{out[-4000:]}")
+            time.sleep(0.05)
+        self.kill()
+        pytest.fail(f"timed out waiting for {needle!r}:\n"
+                    f"{self.output()[-4000:]}")
+
+    def kill(self, sig=signal.SIGKILL):
+        try:
+            self.proc.send_signal(sig)
+        except OSError:
+            pass
+        try:
+            self.proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+
+
+def _wait_file(path, timeout=WAIT):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with open(path) as f:
+                content = f.read().strip()
+            if content:
+                return content
+        except OSError:
+            pass
+        time.sleep(0.05)
+    pytest.fail(f"address file {path} never appeared")
+
+
+def _get(url, timeout=10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _post_json(url, data, timeout=10.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(data).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _poll(fn, timeout, msg, every=0.2):
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        ok, last = fn()
+        if ok:
+            return last
+        time.sleep(every)
+    pytest.fail(f"timed out after {timeout}s waiting for {msg}; "
+                f"last state: {str(last)[:2000]}")
+
+
+def _full_node(tmp, name, flatfile, env):
+    addr_file = os.path.join(tmp, f"{name}.addr")
+    node = _Proc(
+        [sys.executable, "-m", "h2o3_tpu", "--port", "0",
+         "--name", "mpcloud", "--flatfile", flatfile,
+         "--cluster-name", "mpcloud", "--node-name", name,
+         "--cluster-address-file", addr_file],
+        cwd=tmp, env=env)
+    return node, addr_file
+
+
+class TestTwoNodeCloudREST:
+    """The acceptance path: formation quorum, cross-node DKV, proxies,
+    and the suspicion flip — all through the REST surface."""
+
+    def test_two_node_cloud(self, tmp_path):
+        tmp = str(tmp_path)
+        env = _env()
+        flat0 = os.path.join(tmp, "flat0")
+        open(flat0, "w").close()  # node 0 seeds nobody; node 1 seeds it
+        n0, addr0_file = _full_node(tmp, "n0", flat0, env)
+        n1 = None
+        try:
+            addr0 = _wait_file(addr0_file)
+            flat1 = os.path.join(tmp, "flat1")
+            with open(flat1, "w") as f:
+                f.write(addr0 + "\n")
+            n1, _ = _full_node(tmp, "n1", flat1, env)
+            url0 = n0.wait_for_line("up at ").split("up at ")[1].split()[0]
+            url1 = n1.wait_for_line("up at ").split("up at ")[1].split()[0]
+
+            # -- formation: same sorted member list + hash on BOTH nodes
+            def formed():
+                try:
+                    _, c0 = _get(url0 + "/3/Cloud")
+                    _, c1 = _get(url1 + "/3/Cloud")
+                except (urllib.error.URLError, OSError) as e:
+                    return False, str(e)
+                ok = (c0["cloud_size"] == 2 and c1["cloud_size"] == 2
+                      and c0["consensus"] and c1["consensus"])
+                return ok, (c0, c1)
+
+            c0, c1 = _poll(formed, WAIT, "2-node cloud quorum")
+            assert c0["cloud_hash"] == c1["cloud_hash"]
+            assert [n["name"] for n in c0["nodes"]] == ["n0", "n1"]
+            assert [n["name"] for n in c1["nodes"]] == ["n0", "n1"]
+            assert all(n["healthy"] for n in c0["nodes"])
+            ages = [n["last_heartbeat_age_ms"] for n in c0["nodes"]]
+            assert all(isinstance(a, int) and a < 60000 for a in ages)
+
+            # -- cross-node DKV: a key homed on n1, put via n0, read via
+            # both (the distributed router, through REST)
+            key = None
+            for i in range(256):
+                k = f"mpkey{i}"
+                _, home = _get(url0 + f"/3/DKV/{k}/home")
+                if home["home"] == "n1":
+                    key = k
+                    break
+            assert key is not None, "no probe key homed on n1?!"
+            st, put_out = _post_json(
+                url0 + f"/3/DKV/{key}", {"value": {"answer": [4, 2]}})
+            assert st == 200 and put_out["home"] == "n1"
+            st, got0 = _get(url0 + f"/3/DKV/{key}")
+            st1, got1 = _get(url1 + f"/3/DKV/{key}")
+            assert st == 200 and st1 == 200
+            assert got0["value"] == got1["value"] == {"answer": [4, 2]}
+
+            # -- node-addressed observability proxies over RPC
+            st, ticks1 = _get(url0 + "/3/WaterMeterCpuTicks/1")
+            assert st == 200 and "cpu_ticks" in ticks1
+            with urllib.request.urlopen(
+                    url0 + "/3/Logs/nodes/1/files/default",
+                    timeout=10.0) as resp:
+                assert resp.status == 200
+
+            # -- kill n1: /3/Cloud on n0 flips health inside the
+            # suspicion window (5 beats * 0.2s, plus scheduling slack)
+            n1.kill(signal.SIGKILL)
+            t0 = time.monotonic()
+
+            def flipped():
+                try:
+                    _, c = _get(url0 + "/3/Cloud")
+                except (urllib.error.URLError, OSError) as e:
+                    return False, str(e)
+                n1_rows = [n for n in c["nodes"] if n["name"] == "n1"]
+                # suspected (healthy: false, cloud_healthy flips) or
+                # already removed from the member list entirely
+                if n1_rows:
+                    return (not n1_rows[0]["healthy"]
+                            and not c["cloud_healthy"]), c
+                return True, c
+
+            _poll(flipped, 30.0, "suspicion flip after SIGKILL")
+            assert time.monotonic() - t0 < 30.0
+        finally:
+            if n1 is not None:
+                n1.kill()
+            n0.kill()
+
+
+def _write_mr_worker(tmp):
+    """worker0: forms a 2-node cloud with a nodeproc peer, then checks
+    distributed map_reduce bit-exactness against the local path."""
+    with open(os.path.join(tmp, "mrfns.py"), "w") as f:
+        f.write(
+            "import jax.numpy as jnp\n"
+            "def stat(cols, mask):\n"
+            "    return {'s': jnp.sum(jnp.where(mask, cols['x'], 0.0)),\n"
+            "            'n': jnp.sum(mask.astype(jnp.float32))}\n")
+    script = f"""
+import sys, time
+sys.path.insert(0, {REPO!r})
+sys.path.insert(0, {tmp!r})
+import numpy as np
+import mrfns
+from h2o3_tpu.cluster.membership import Cloud
+from h2o3_tpu.cluster import tasks as ctasks
+from h2o3_tpu.util import telemetry
+
+cloud = Cloud("mrcloud", "w0", hb_interval=0.2)
+ctasks.install(cloud)
+with open({tmp!r} + "/w0.addr.tmp", "w") as f:
+    f.write(f"{{cloud.info.host}}:{{cloud.info.port}}\\n")
+import os
+os.replace({tmp!r} + "/w0.addr.tmp", {tmp!r} + "/w0.addr")
+cloud.start([])
+deadline = time.monotonic() + 90
+while time.monotonic() < deadline:
+    if cloud.size() == 2 and cloud.consensus():
+        break
+    time.sleep(0.05)
+assert cloud.size() == 2, f"cloud never formed: {{cloud.size()}}"
+
+peer = next(m for m in cloud.members_sorted() if m.info.name == "w1")
+assert ctasks.submit(cloud, peer, "echo", 7) == 7
+
+cols = {{"x": np.arange(4001, dtype=np.float64)}}
+local = ctasks.distributed_map_reduce(mrfns.stat, cols, cloud=None)
+dist = ctasks.distributed_map_reduce(mrfns.stat, cols, cloud=cloud)
+for k in ("s", "n"):
+    a, b = np.asarray(local[k]), np.asarray(dist[k])
+    assert a.tobytes() == b.tobytes(), f"{{k}}: {{a}} != {{b}}"
+assert float(dist["s"]) == float(np.arange(4001).sum())
+assert telemetry.REGISTRY.get("cluster_task_fanout").value() == 2
+
+# the REMOTE node really ran its shard: its own meters say so
+peer_metrics = cloud.client.call(
+    peer.info.addr, "metrics", None, timeout=10.0)
+assert peer_metrics.get("cluster_tasks_total", 0) >= 1, peer_metrics
+cloud.stop()
+print("W0 OK", flush=True)
+"""
+    path = os.path.join(tmp, "worker0.py")
+    with open(path, "w") as f:
+        f.write(script)
+    return path
+
+
+class TestMapReduceFanout:
+    def test_two_node_map_reduce_bit_exact(self, tmp_path):
+        tmp = str(tmp_path)
+        env = _env()
+        w0 = _Proc([sys.executable, _write_mr_worker(tmp)],
+                   cwd=tmp, env=env)
+        w1 = None
+        try:
+            addr0 = _wait_file(os.path.join(tmp, "w0.addr"))
+            flat = os.path.join(tmp, "flat")
+            with open(flat, "w") as f:
+                f.write(addr0 + "\n")
+            w1 = _Proc(
+                [sys.executable, "-m", "h2o3_tpu.cluster.nodeproc",
+                 "--cluster-name", "mrcloud", "--node-name", "w1",
+                 "--flatfile", flat, "--hb-interval", "0.2"],
+                cwd=tmp, env=env)
+            w0.wait_for_line("W0 OK", timeout=240)
+            assert w0.proc.wait(timeout=30) == 0
+        finally:
+            if w1 is not None:
+                w1.kill()
+            w0.kill()
+
+
+@pytest.mark.slow
+class TestThreeNodeFormation:
+    """3-node formation via the light nodeproc entry; the harness polls
+    each node's ``members`` RPC until all three agree on one hash."""
+
+    def test_three_nodes_agree(self, tmp_path):
+        from h2o3_tpu.cluster.rpc import RpcClient, RPCError
+
+        tmp = str(tmp_path)
+        env = _env()
+        procs = []
+        addrs = []
+        try:
+            for i in range(3):
+                flat = os.path.join(tmp, f"flat{i}")
+                with open(flat, "w") as f:
+                    f.write("".join(a + "\n" for a in addrs))
+                addr_file = os.path.join(tmp, f"n{i}.addr")
+                procs.append(_Proc(
+                    [sys.executable, "-m", "h2o3_tpu.cluster.nodeproc",
+                     "--cluster-name", "tri", "--node-name", f"tri{i}",
+                     "--flatfile", flat, "--address-file", addr_file,
+                     "--hb-interval", "0.2"],
+                    cwd=tmp, env=env))
+                addrs.append(_wait_file(addr_file))
+            client = RpcClient()
+            targets = [(h, int(p)) for h, _, p in
+                       (a.rpartition(":") for a in addrs)]
+
+            def agree():
+                views = []
+                for t in targets:
+                    try:
+                        views.append(client.call(
+                            t, "members", None, timeout=5.0))
+                    except RPCError as e:
+                        return False, str(e)
+                ok = (all(v["size"] == 3 for v in views)
+                      and len({v["hash"] for v in views}) == 1
+                      and all(v["consensus"] for v in views)
+                      and len({tuple(v["members"]) for v in views}) == 1)
+                return ok, views
+
+            views = _poll(agree, WAIT, "3-node quorum")
+            assert len(views[0]["members"]) == 3
+            client.close()
+        finally:
+            for p in procs:
+                p.kill()
