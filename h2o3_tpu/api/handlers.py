@@ -1149,17 +1149,70 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
 
     # ---- diagnostics (TimeLine / logs / jstack analogues) -----------------
     # ---- observability (water/TimeLine.java, util/Log.java, JStack) -------
+    def _truthy(v) -> bool:
+        return str(v).lower() in ("1", "true", "yes")
+
+    def _active_cloud():
+        from h2o3_tpu import cluster
+
+        return cluster.active_cloud()
+
     def timeline_ep(params):
         """Real event ring: compiles, training blocks, REST requests
-        (water/TimeLine.java:22,75 snapshot semantics)."""
+        (water/TimeLine.java:22,75 snapshot semantics).  With
+        ``?cluster=true`` on a multi-node cloud: every member's ring is
+        collected over RPC, each remote event is tagged ``node=`` and its
+        wall clock shifted by the heartbeat-derived skew estimate, and the
+        merged stream comes back sorted — the reference's cluster-snapshot
+        TimeLine (init/TimelineSnapshot.java), minus the UDP packet log."""
         from h2o3_tpu.util import timeline
 
         # `count` is the documented name; `n` is the short alias thin
         # clients use (both untested before the telemetry PR)
         n = int(params.get("count", params.get("n", 1000)))
+        cloud = _active_cloud() if _truthy(params.get("cluster")) else None
+        if cloud is None:
+            return {
+                "events": timeline.snapshot(n),
+                "total_events": timeline.total_events(),
+                "now": int(time.time() * 1000),
+            }
+        results, errors = cloud.poll_members(
+            "timeline_snapshot", {"count": n})
+        members = {m.info.name: m for m in cloud.members_sorted()}
+        events = []
+        nodes_meta = []
+        for name in sorted(results):
+            snap = results[name] or {}
+            m = members.get(name)
+            is_self = name == cloud.info.name
+            skew_ms = 0.0
+            if not is_self and m is not None and m.clock_skew_ms is not None:
+                skew_ms = float(m.clock_skew_ms)
+            for ev in snap.get("events", []):
+                ev = dict(ev)
+                ev.setdefault("node", name)
+                # a remote clock ahead of ours by skew_ms reads skew_ms
+                # too late: shift its events back onto our clock
+                ev["ns"] = int(ev.get("ns", 0) - skew_ms * 1e6)
+                events.append(ev)
+            nodes_meta.append({
+                "name": name,
+                "skew_ms": round(skew_ms, 3),
+                "rtt_ms": (None if is_self or m is None or m.rtt_ms is None
+                           else round(m.rtt_ms, 3)),
+                "events": len(snap.get("events", [])),
+                "total_events": snap.get("total_events", 0),
+            })
+        for name in sorted(errors):
+            nodes_meta.append({"name": name, "error": errors[name]})
+        events.sort(key=lambda e: e.get("ns", 0))
         return {
-            "events": timeline.snapshot(n),
-            "total_events": timeline.total_events(),
+            "events": events,
+            "nodes": nodes_meta,
+            "partial": bool(errors),
+            "total_events": sum(nm.get("total_events", 0)
+                                for nm in nodes_meta),
             "now": int(time.time() * 1000),
         }
 
@@ -1199,24 +1252,71 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
 
         return cpu_ticks_payload()
 
-    def metrics_ep(params):
-        """Full registry snapshot as JSON (the quantitative face of
-        /3/Timeline — counts where the timeline has events)."""
+    def _federated_metrics():
+        """(merged_snapshot, nodes, errors) across the live cloud — or the
+        local registry labelled under this node's name when no multi-node
+        cloud is up, so ``?cluster=true`` has ONE response shape."""
+        from h2o3_tpu import cluster
         from h2o3_tpu.util import telemetry
 
+        cloud = _active_cloud()
+        if cloud is None:
+            local = cluster.local_cloud()
+            node = local.info.name if local is not None else (
+                telemetry.node_name() or "localhost")
+            merged = telemetry.merge_snapshots(
+                {node: telemetry.REGISTRY.snapshot()})
+            return merged, [node], {}
+        results, errors = cloud.poll_members("metrics_snapshot")
+        merged = telemetry.merge_snapshots({
+            name: (r or {}).get("metrics", {})
+            for name, r in results.items()
+        })
+        return merged, sorted(results), errors
+
+    def metrics_ep(params):
+        """Full registry snapshot as JSON (the quantitative face of
+        /3/Timeline — counts where the timeline has events).  With
+        ``?cluster=true``: every member's registry is scraped over RPC and
+        merged with a ``node=`` label (counters also sum into a
+        ``node="_cluster"`` aggregate, histogram buckets merge, gauges stay
+        per-node); an unreachable member degrades the answer to
+        ``partial: true`` — never a 5xx."""
+        from h2o3_tpu.util import telemetry
+
+        if not _truthy(params.get("cluster")):
+            return {
+                "metrics": telemetry.REGISTRY.snapshot(),
+                "now": int(time.time() * 1000),
+            }
+        merged, nodes, errors = _federated_metrics()
         return {
-            "metrics": telemetry.REGISTRY.snapshot(),
+            "metrics": merged,
+            "nodes": nodes,
+            "errors": errors,
+            "partial": bool(errors),
             "now": int(time.time() * 1000),
         }
 
     def metrics_prometheus(params):
-        """Prometheus text exposition v0.0.4 — point a scraper at it."""
+        """Prometheus text exposition v0.0.4 — point a scraper at it.
+        ``?cluster=true`` serves the federated merge (node= labels on every
+        series) with a comment header naming unreachable members."""
         from h2o3_tpu.util import telemetry
 
-        return (
-            telemetry.REGISTRY.prometheus().encode(),
-            "text/plain; version=0.0.4; charset=utf-8",
-        )
+        if not _truthy(params.get("cluster")):
+            return (
+                telemetry.REGISTRY.prometheus().encode(),
+                "text/plain; version=0.0.4; charset=utf-8",
+            )
+        merged, nodes, errors = _federated_metrics()
+        text = telemetry.snapshot_prometheus(merged)
+        if errors:
+            head = "".join(
+                f"# partial scrape: {name} unreachable ({msg})\n"
+                for name, msg in sorted(errors.items()))
+            text = head + text
+        return text.encode(), "text/plain; version=0.0.4; charset=utf-8"
 
     r.register("GET", "/3/Metrics", metrics_ep, "telemetry registry (JSON)")
     r.register("GET", "/3/Metrics/prometheus", metrics_prometheus,

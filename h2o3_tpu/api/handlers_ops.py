@@ -820,6 +820,24 @@ def register(r: RequestServer, server: H2OServer) -> None:  # noqa: C901
         got = _node_rpc(c, member, "logs", {"count": 10000})
         return ("\n".join(got.get("lines", [])) + "\n").encode(), "text/plain"
 
+    def timeline_node(params, nodeidx):
+        """One member's event ring, unmerged and on its own clock (the
+        per-node drill-down under the merged /3/Timeline?cluster=true) —
+        proxied over node RPC exactly like /3/Logs/nodes/{i}.  The self
+        index answers in-process with the SAME shape the RPC returns
+        (events/total_events/now_ns/node), so clients can compare clocks
+        across members without special-casing the serving node."""
+        from h2o3_tpu.util import telemetry, timeline
+
+        c, member = _cluster_node(nodeidx)
+        n = int(params.get("count", params.get("n", 1000)))
+        if c is None or member.info.name == c.info.name:
+            out = timeline.snapshot_payload(n)
+            out["node"] = (c.info.name if c is not None
+                           else telemetry.node_name() or "localhost")
+            return out
+        return _node_rpc(c, member, "timeline_snapshot", {"count": n})
+
     r.register("DELETE", "/3/DKV/{key}", dkv_delete, "remove one key")
     r.register("DELETE", "/3/DKV", dkv_delete_all, "remove all keys")
     r.register("GET", "/3/DKV/{key}", dkv_get,
@@ -842,6 +860,8 @@ def register(r: RequestServer, server: H2OServer) -> None:  # noqa: C901
                "cpu ticks (node)")
     r.register("GET", "/3/Logs/nodes/{nodeidx}/files/{name}", logs_node_file,
                "log file for a node")
+    r.register("GET", "/3/Timeline/nodes/{nodeidx}", timeline_node,
+               "event timeline of one addressed member (node RPC proxy)")
 
     # ---- typeahead / rapids help / capabilities / misc --------------------
     def typeahead_files(params):

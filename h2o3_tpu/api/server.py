@@ -111,6 +111,20 @@ class RequestServer:
         ]
 
 
+#: inbound trace-context headers must look like the ids we mint (hex, 8-32
+#: chars): the value is echoed back as a response header and recorded into
+#: every timeline event and log line of the request, so an unvalidated
+#: value would be a response-header-injection (CRLF) primitive and a
+#: timeline-pollution vector
+_TRACE_ID_RE = re.compile(r"^[0-9a-f]{8,32}$")
+
+
+def _trace_header(value: Optional[str]) -> Optional[str]:
+    if value and _TRACE_ID_RE.match(value):
+        return value
+    return None
+
+
 def _json_default(o):
     if isinstance(o, (np.integer,)):
         return int(o)
@@ -252,7 +266,6 @@ class H2OServer:
                 if cur.name.startswith("Thread-"):
                     cur.name = "http-worker"
                 parsed = urllib.parse.urlparse(self.path)
-                get_logger("rest").info("%s %s", method, parsed.path)
                 # the request meters label by registered route pattern; an
                 # unmatched path collapses into one "(unmatched)" series so
                 # scanners can't mint unbounded label values
@@ -261,8 +274,10 @@ class H2OServer:
                 status = 200
                 ctype = "application/json"
                 extra_headers: List[Tuple[str, str]] = []
+                span: Optional[telemetry.Span] = None
                 t0 = time.perf_counter()
                 if not srv._check_auth(self.headers.get("Authorization")):
+                    get_logger("rest").info("%s %s", method, parsed.path)
                     status = 401
                     payload = json.dumps(
                         {"http_status": 401, "msg": "authentication required"}
@@ -270,11 +285,24 @@ class H2OServer:
                     extra_headers.append(
                         ("WWW-Authenticate", 'Basic realm="h2o3-tpu"'))
                 else:
+                    # a proxied/forwarded request may carry its caller's
+                    # trace: honor the headers (id-shaped values only) so
+                    # one trace threads client -> this REST span -> any
+                    # node RPC it fans out
+                    span = telemetry.Span(
+                        "rest", method=method, route=route,
+                        path=parsed.path,
+                        trace_id=_trace_header(
+                            self.headers.get("X-H2O3-Trace-Id")),
+                        parent_id=_trace_header(
+                            self.headers.get("X-H2O3-Span-Id")),
+                    )
                     try:
-                        with telemetry.Span(
-                            "rest", method=method, route=route,
-                            path=parsed.path,
-                        ):
+                        with span:
+                            # logged INSIDE the span so the /3/Logs line
+                            # carries this request's trace/span ids
+                            get_logger("rest").info(
+                                "%s %s", method, parsed.path)
                             if found is None:
                                 raise RestError(
                                     404,
@@ -321,6 +349,9 @@ class H2OServer:
                     method=method, route=route, status=str(status))
                 _REST_SECONDS.observe(
                     time.perf_counter() - t0, method=method, route=route)
+                if span is not None and span.trace_id:
+                    # clients correlate their request with /3/Timeline
+                    extra_headers.append(("X-H2O3-Trace-Id", span.trace_id))
                 self.send_response(status)
                 for k, v in extra_headers:
                     self.send_header(k, v)

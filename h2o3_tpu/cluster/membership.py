@@ -51,6 +51,12 @@ _SUSPICIONS = telemetry.counter(
     "cluster_suspicions_total", "members marked suspect (missed beats)")
 _REMOVALS = telemetry.counter(
     "cluster_removals_total", "members removed from the cloud")
+_SCRAPE_ERRORS = telemetry.counter(
+    "metrics_scrape_errors_total",
+    "cluster-wide metric/timeline scrapes that could not reach a member "
+    "(the federation degrades to partial=true instead of 5xx-ing)",
+    labels=("node", "method"),
+)
 
 
 class CloudJoinError(Exception):
@@ -103,9 +109,30 @@ class Member:
         self.reported_hash: Optional[str] = None
         self.reported_version: int = 0
         self.healthy = True
+        #: EWMA clock-skew estimate (peer wall clock minus ours, ms) and
+        #: heartbeat RTT — sampled on every beat via the response timestamp
+        #: midpointed against the send/receive instants (Cristian's method);
+        #: the merged cluster timeline aligns remote events with it
+        self.clock_skew_ms: Optional[float] = None
+        self.rtt_ms: Optional[float] = None
 
     def heartbeat_age(self) -> float:
         return time.monotonic() - self.last_heard
+
+    def observe_clock(self, peer_now_ms: float, t_sent: float,
+                      t_received: float) -> None:
+        """Fold one (send wall-time, receive wall-time, peer wall-time)
+        triple into the skew/RTT estimates.  EWMA (alpha 0.3) smooths
+        scheduler jitter; accuracy is bounded by RTT asymmetry — good to a
+        few ms on a LAN, which is what aligning timeline events needs."""
+        rtt_ms = max(0.0, (t_received - t_sent) * 1000.0)
+        skew_ms = float(peer_now_ms) - (t_sent + t_received) / 2.0 * 1000.0
+        if self.rtt_ms is None or self.clock_skew_ms is None:
+            self.rtt_ms = rtt_ms
+            self.clock_skew_ms = skew_ms
+        else:
+            self.rtt_ms = 0.7 * self.rtt_ms + 0.3 * rtt_ms
+            self.clock_skew_ms = 0.7 * self.clock_skew_ms + 0.3 * skew_ms
 
 
 def cpu_ticks_payload() -> Dict[str, Any]:
@@ -173,8 +200,9 @@ class Cloud:
             os.environ.get("H2O3_TPU_HB_INTERVAL", 1.0))
         self.suspect_beats = suspect_beats if suspect_beats is not None else int(
             os.environ.get("H2O3_TPU_HB_SUSPECT", 5))
-        self.rpc_server = _rpc.RpcServer(host=host, port=port)
-        self.client = _rpc.RpcClient()
+        self.rpc_server = _rpc.RpcServer(host=host, port=port,
+                                         node_name=node_name)
+        self.client = _rpc.RpcClient(node_name=node_name)
         # bind host and advertised host are distinct: a wildcard bind
         # (0.0.0.0 in a pod) must still gossip an address peers can dial
         if advertise_host is None:
@@ -204,6 +232,8 @@ class Cloud:
         self.rpc_server.register("logs", self._on_logs)
         self.rpc_server.register("metrics", lambda p: (
             telemetry.REGISTRY.summary()))
+        self.rpc_server.register("metrics_snapshot", self._on_metrics_snapshot)
+        self.rpc_server.register("timeline_snapshot", self._on_timeline_snapshot)
         self.rpc_server.register("members", lambda p: {
             "members": [m.info.ident for m in self.members_sorted()],
             "hash": self.cloud_hash(),
@@ -277,6 +307,8 @@ class Cloud:
                 "dkv_keys": m.stats.get("dkv_keys", 0),
                 "num_cpus": m.stats.get("num_cpus", 0),
                 "sys_cpu_ticks": m.stats.get("cpu_ticks", []),
+                "clock_skew_ms": (0.0 if is_self else m.clock_skew_ms),
+                "rtt_ms": (0.0 if is_self else m.rtt_ms),
             })
         return out
 
@@ -448,6 +480,9 @@ class Cloud:
                 "hash": self.cloud_hash(),
                 "members": [m.info.to_dict()
                             for m in self._members.values()],
+                # wall clock at response build: the beating peer midpoints
+                # it against its send/receive instants to estimate skew
+                "now_ms": time.time() * 1000.0,
             }
         _HEARTBEATS.inc(direction="received", result="ok")
         self._publish_gauges()
@@ -459,9 +494,11 @@ class Cloud:
         ladder here would serialize ~4 timeouts against one dead peer
         per cycle — long enough to starve healthy peers past the
         suspicion window and flap the whole cloud's health."""
+        t_sent = time.time()
         resp = self.client.call(
             addr, "heartbeat", self._payload(),
             timeout=timeout, target=f"{addr[0]}:{addr[1]}", retries=0)
+        t_received = time.time()
         _HEARTBEATS.inc(direction="sent", result="ok")
         receiver = NodeInfo.from_dict(resp["receiver"])
         with self._lock:
@@ -476,6 +513,9 @@ class Cloud:
                 m.healthy = True
                 m.reported_hash = resp.get("hash")
                 m.reported_version = peer_version
+                peer_now_ms = resp.get("now_ms")
+                if peer_now_ms is not None:
+                    m.observe_clock(float(peer_now_ms), t_sent, t_received)
             if changed or peer_version > self.version:
                 self.version = max(self.version, peer_version) + (
                     1 if changed else 0)
@@ -574,6 +614,92 @@ class Cloud:
         count = int((payload or {}).get("count", 10000))
         return {"lines": L.recent(count), "log_file": L.log_file()}
 
+    def _on_metrics_snapshot(
+            self, payload: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+        """Full registry snapshot (not the compact ``metrics`` summary) —
+        the per-member half of ``GET /3/Metrics?cluster=true``."""
+        return {
+            "node": self.info.name,
+            "metrics": telemetry.REGISTRY.snapshot(),
+            "now_ms": time.time() * 1000.0,
+        }
+
+    def _on_timeline_snapshot(
+            self, payload: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+        """This node's event ring — the per-member half of the merged
+        cluster timeline (and the ``/3/Timeline/nodes/{i}`` proxy body)."""
+        from h2o3_tpu.util import timeline
+
+        out = timeline.snapshot_payload(
+            int((payload or {}).get("count", 1000)))
+        out["node"] = self.info.name
+        return out
+
+    # -- cluster-wide scrape fan-out ------------------------------------------
+    def poll_members(
+        self,
+        method: str,
+        payload: Any = None,
+        timeout: float = 5.0,
+    ) -> Tuple[Dict[str, Any], Dict[str, str]]:
+        """Fan one built-in RPC to every cloud member concurrently and
+        return ``(results, errors)`` keyed by member name.
+
+        The local node answers in-process (no loopback RPC, no dedup memo
+        churn).  A member that cannot be reached — or does not answer
+        inside the deadline — lands in ``errors`` and bumps
+        ``metrics_scrape_errors_total{node,method}``; it never raises, so
+        the REST federation degrades to ``partial: true`` instead of a
+        5xx.  One bounded retry per member (``retries=1``): an HTTP worker
+        is usually waiting on the merge."""
+        members = self.members_sorted()
+        # workers write ONLY their own slot (a single reference
+        # assignment); results/errors are built from a one-shot snapshot
+        # of the slots after the join deadline, so a straggler thread that
+        # answers late mutates nothing the caller is iterating — the
+        # federation endpoints keep their never-5xx contract even against
+        # a peer that dribbles bytes past every timeout
+        slots: List[Optional[Tuple[str, Any]]] = [None] * len(members)
+
+        def _one(i: int, m: Member) -> None:
+            if m.info.name == self.info.name:
+                fn = self.rpc_server._methods.get(method)
+                try:
+                    if fn is None:
+                        raise KeyError(f"unknown RPC method {method!r}")
+                    slots[i] = ("ok", fn(payload))
+                except Exception as e:  # noqa: BLE001 — degrade, don't 5xx
+                    slots[i] = ("err", f"{type(e).__name__}: {e}")
+                return
+            try:
+                slots[i] = ("ok", self.client.call(
+                    m.info.addr, method, payload,
+                    timeout=timeout, target=m.info.ident, retries=1))
+            except _rpc.RPCError as e:
+                slots[i] = ("err", str(e))
+
+        threads = [threading.Thread(target=_one, args=(i, m), daemon=True,
+                                    name=f"scrape-{m.info.name}")
+                   for i, m in enumerate(members)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 2 * timeout + 1.0
+        for t in threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+        results: Dict[str, Any] = {}
+        errors: Dict[str, str] = {}
+        for m, slot in zip(members, list(slots)):  # one-shot snapshot
+            name = m.info.name
+            if slot is None:
+                errors[name] = f"no answer within {timeout}s"
+            elif slot[0] == "ok":
+                results[name] = slot[1]
+            else:
+                errors[name] = slot[1]
+            if name in errors:
+                _SCRAPE_ERRORS.inc(node=name, method=method)
+        return results, errors
+
 
 # ---------------------------------------------------------------------------
 # process-global cloud (the H2O.CLOUD static)
@@ -616,6 +742,10 @@ def boot_node(
 
     cloud = Cloud(cloud_name, node_name, host=host, port=port,
                   client=client, hb_interval=hb_interval)
+    # declare the process's trace identity: every timeline event this node
+    # records from here on carries node=<name>, so merged cluster timelines
+    # and propagated traces attribute work to the member that did it
+    telemetry.set_node_name(node_name)
     _dkv.install(cloud, store)
     _tasks.install(cloud)
     set_local_cloud(cloud)

@@ -17,7 +17,22 @@ halves are reproduced here:
   method.
 
 Every call is metered: ``rpc_calls_total{target,method,result}``,
-``rpc_retries_total``, ``rpc_call_seconds{method}``.
+``rpc_retries_total``, ``rpc_call_seconds{method,side}`` (observed on BOTH
+sides — the client's round trip including retries, and the server's pure
+dispatch wall), with ``rpc_inflight{side}`` tracking calls currently in
+flight so a wedged member shows up in ``/3/Metrics`` before the heartbeat
+suspicion window fires.
+
+Tracing: when the caller holds an open :class:`~h2o3_tpu.util.telemetry.Span`,
+``call`` wraps the ladder in an ``rpc_client`` span and injects trace context
+into the request envelope; the server opens an ``rpc_server`` child span
+around method dispatch under the serving node's identity.  One ``trace_id``
+therefore threads caller → wire → remote execution.  When the ladder
+actually RETRIES, every attempt becomes a visible sibling ``rpc_attempt``
+span under the ``rpc_client`` (the failed first attempt is materialized
+retroactively at retry time) — the single-attempt common case pays for two
+spans, not three, keeping traced-call overhead within the documented bench
+budget.  Untraced calls (heartbeats) add no envelope bytes and no spans.
 
 Wire format: one pickled dict per frame.  Pickle is the AutoBuffer
 analogue — nodes of one cloud run one codebase inside one trust boundary
@@ -46,13 +61,39 @@ _RPC_RETRIES = telemetry.counter(
     "rpc_retries_total", "RPC attempts re-sent by the backoff ladder"
 )
 _RPC_SECONDS = telemetry.histogram(
-    "rpc_call_seconds", "RPC round-trip wall seconds (incl. retries)",
-    labels=("method",),
+    "rpc_call_seconds",
+    "RPC wall seconds: side=client is the round trip incl. retries, "
+    "side=server the pure method dispatch",
+    labels=("method", "side"),
 )
 _RPC_SERVED = telemetry.counter(
     "rpc_served_total", "RPC requests served by the local node",
     labels=("method", "result"),
 )
+_RPC_INFLIGHT = telemetry.gauge(
+    "rpc_inflight",
+    "RPC calls currently in flight (client: awaiting a response; server: "
+    "executing) — a wedged member pins this above zero before the "
+    "heartbeat suspicion window fires",
+    labels=("side",),
+)
+#: bound series handles: these tick on EVERY call/dispatch, so the label
+#: resolution happens once here, not per event
+_INFLIGHT_CLIENT = _RPC_INFLIGHT.bind(side="client")
+_INFLIGHT_SERVER = _RPC_INFLIGHT.bind(side="server")
+
+#: (method, side) -> bound histogram series; RPC method names are a small
+#: closed set per process, so the cache is tiny and the per-call observe
+#: drops to a dict hit + locked update
+_seconds_bound: Dict[Tuple[str, str], telemetry._Bound] = {}
+
+
+def _observe_seconds(method: str, side: str, v: float) -> None:
+    b = _seconds_bound.get((method, side))
+    if b is None:
+        b = _seconds_bound[(method, side)] = _RPC_SECONDS.bind(
+            method=method, side=side)
+    b.observe(v)
 
 
 class RPCError(Exception):
@@ -105,11 +146,15 @@ class RpcClient:
         retries: int = 3,
         backoff_base: float = 0.05,
         backoff_max: float = 2.0,
+        node_name: str = "",
     ) -> None:
         self.pool = transport.ConnectionPool(dialer)
         self.retries = int(retries)
         self.backoff_base = backoff_base
         self.backoff_max = backoff_max
+        #: this client's cluster identity — recorded as the trace origin in
+        #: every injected envelope so remote spans name their caller
+        self.node_name = node_name
 
     def call(
         self,
@@ -128,16 +173,129 @@ class RpcClient:
         black-holed peer is ``(1 + retries) * timeout`` plus backoff.
         Deadline-sensitive callers (heartbeat loops, REST proxies) pass
         ``retries=`` to shrink or disable the ladder for that call.
+
+        When the calling thread holds an open Span, the call joins its
+        trace: an ``rpc_client`` span covers the ladder, trace context rides
+        the request envelope to parent the remote ``rpc_server`` span, and
+        a retried call materializes each attempt as a sibling
+        ``rpc_attempt`` child.
         """
-        token = uuid.uuid4().hex
-        request = _encode(
-            {"id": token, "method": method, "payload": payload}
-        )
         target = target or f"{addr[0]}:{addr[1]}"
+        caller = telemetry.current_span()
+        if caller is None:
+            return self._call(addr, method, payload, timeout, target,
+                              retries, None, "")
+        # lightweight client span: a minted id + ONE recorded event, no
+        # thread-local stack traffic — nothing nests under it on this
+        # thread (the remote dispatch parents via the envelope ids), so
+        # the full Span machinery would buy nothing but overhead on the
+        # hot path the bench budget governs
+        from h2o3_tpu.util import timeline
+
+        span_id = telemetry._new_id()
+        node = self.node_name or telemetry.node_name() or ""
+        t0 = time.perf_counter()
+        ok = False
+        try:
+            out = self._call(addr, method, payload, timeout, target,
+                             retries, (caller.trace_id, span_id), node)
+            ok = True
+            return out
+        finally:
+            evt = {
+                "kind": "rpc_client",
+                "duration_ms": round((time.perf_counter() - t0) * 1e3, 3),
+                "ok": ok,
+                "trace_id": caller.trace_id,
+                "span_id": span_id,
+                "parent_id": caller.span_id,
+                "method": method,
+                "target": target,
+            }
+            if node:
+                evt["node"] = node
+            timeline.record_event(evt)
+
+    def _call(
+        self,
+        addr: transport.Address,
+        method: str,
+        payload: Any,
+        timeout: float,
+        target: str,
+        retries: Optional[int],
+        trace_ctx: Optional[Tuple[str, str]],
+        origin: str,
+    ) -> Any:
+        token = uuid.uuid4().hex
         ladder = self.retries if retries is None else max(0, int(retries))
+        request: Optional[bytes] = None
+        if trace_ctx is None:  # untraced envelope is attempt-invariant
+            request = _encode(
+                {"id": token, "method": method, "payload": payload}
+            )
+
+        def _record_attempt(span_id: str, t_a: float, ok: bool,
+                            attempt: int) -> None:
+            from h2o3_tpu.util import timeline
+
+            evt = {
+                "kind": "rpc_attempt",
+                "duration_ms": round((time.perf_counter() - t_a) * 1e3, 3),
+                "ok": ok,
+                "trace_id": trace_ctx[0],
+                "span_id": span_id,
+                "parent_id": trace_ctx[1],
+                "method": method, "target": target, "attempt": attempt,
+            }
+            if origin:
+                evt["node"] = origin
+            timeline.record_event(evt)
+
+        def _one_attempt(attempt: int) -> bytes:
+            if trace_ctx is None:
+                return self._attempt(addr, request, timeout)
+            if attempt == 0:
+                # common case: the envelope carries the rpc_client ids (no
+                # per-attempt span — one span per side keeps traced
+                # overhead inside the bench budget); if this attempt fails
+                # and a retry follows, it is materialized as a sibling
+                # rpc_attempt retroactively below
+                req = _encode({
+                    "id": token, "method": method, "payload": payload,
+                    "trace": {"trace_id": trace_ctx[0],
+                              "span_id": trace_ctx[1],
+                              "origin": origin, "attempt": 0},
+                })
+                t_a = time.perf_counter()
+                try:
+                    return self._attempt(addr, req, timeout)
+                except Exception:
+                    if ladder:  # a retry will follow: show attempt 0
+                        _record_attempt(telemetry._new_id(), t_a, False, 0)
+                    raise
+            # a real retry: every subsequent attempt is its own sibling
+            # and the envelope carries THAT attempt's ids, so a remote
+            # dispatch parents under the attempt that reached it
+            attempt_id = telemetry._new_id()
+            req = _encode({
+                "id": token, "method": method, "payload": payload,
+                "trace": {"trace_id": trace_ctx[0], "span_id": attempt_id,
+                          "origin": origin, "attempt": attempt},
+            })
+            t_a = time.perf_counter()
+            try:
+                raw = self._attempt(addr, req, timeout)
+            except Exception:
+                _record_attempt(attempt_id, t_a, False, attempt)
+                raise
+            _record_attempt(attempt_id, t_a, True, attempt)
+            return raw
+
         t0 = time.perf_counter()
         last_exc: Optional[BaseException] = None
         timed_out = False
+        _INFLIGHT_CLIENT.inc()
         try:
             for attempt in range(ladder + 1):
                 if attempt:
@@ -147,7 +305,7 @@ class RpcClient:
                         self.backoff_max,
                     ))
                 try:
-                    raw = self._attempt(addr, request, timeout)
+                    raw = _one_attempt(attempt)
                 except socket.timeout as e:
                     timed_out = True
                     last_exc = e
@@ -180,7 +338,8 @@ class RpcClient:
                 f"{ladder + 1} attempts: {last_exc}"
             ) from last_exc
         finally:
-            _RPC_SECONDS.observe(time.perf_counter() - t0, method=method)
+            _INFLIGHT_CLIENT.dec()
+            _observe_seconds(method, "client", time.perf_counter() - t0)
 
     def _attempt(self, addr: transport.Address, request: bytes,
                  timeout: float) -> bytes:
@@ -228,8 +387,13 @@ class RpcServer:
     #: oldest entries evict first once the budget is exceeded
     DEDUP_BYTE_BUDGET = 64 << 20
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 node_name: str = "") -> None:
         self._methods: Dict[str, Callable[[Any], Any]] = {}
+        #: serving identity: dispatches run under a thread-local node scope
+        #: so events recorded during remote execution name THIS node even
+        #: with several in-process Clouds (the test harness)
+        self.node_name = node_name
         self._lock = threading.Lock()
         #: token -> (done_event, encoded_response|None): duplicates of an
         #: in-flight token wait on the first execution instead of racing it
@@ -242,25 +406,60 @@ class RpcServer:
     def register(self, method: str, fn: Callable[[Any], Any]) -> None:
         self._methods[method] = fn
 
-    def _execute(self, method: str, payload: Any) -> bytes:
+    def _execute(self, method: str, payload: Any,
+                 trace: Optional[Dict[str, Any]] = None) -> bytes:
+        if trace and trace.get("trace_id"):
+            # the caller's envelope context parents this dispatch: one
+            # trace now threads caller -> wire -> remote execution, and
+            # anything fn records (nested spans, log lines) inherits it.
+            # The serving node's identity scopes the dispatch so those
+            # events attribute to THIS node even with several in-process
+            # Clouds (untraced calls skip both — heartbeats stay free).
+            sp = telemetry.Span(
+                "rpc_server",
+                trace_id=str(trace["trace_id"]),
+                parent_id=trace.get("span_id"),
+                method=method,
+                origin=trace.get("origin", ""),
+                attempt=int(trace.get("attempt", 0)),
+            )
+            if self.node_name:
+                with telemetry.node_scope(self.node_name), sp:
+                    return self._dispatch(method, payload, sp)
+            with sp:
+                return self._dispatch(method, payload, sp)
+        return self._dispatch(method, payload, None)
+
+    def _dispatch(self, method: str, payload: Any,
+                  sp: Optional["telemetry.Span"]) -> bytes:
         fn = self._methods.get(method)
+        t0 = time.perf_counter()
         try:
             if fn is None:
                 raise RpcFault(f"unknown RPC method {method!r}", code=404)
             value = fn(payload)
             _RPC_SERVED.inc(method=method, result="ok")
+            if sp is not None:
+                sp.set(result="ok")
             return _encode({"ok": True, "value": value})
         except RpcFault as e:
             _RPC_SERVED.inc(method=method, result="fault")
+            if sp is not None:
+                sp.set(result="fault")
             return _encode({"ok": False, "error": {
                 "type": "RpcFault", "msg": str(e), "code": e.code,
                 "detail": e.detail,
             }})
         except Exception as e:  # noqa: BLE001 — ships to the caller typed
             _RPC_SERVED.inc(method=method, result="error")
+            if sp is not None:
+                sp.set(result="error")
             return _encode({"ok": False, "error": {
                 "type": type(e).__name__, "msg": str(e), "code": 500,
             }})
+        finally:
+            _observe_seconds(method, "server",
+                             time.perf_counter() - t0)
 
     def _evict_memo_locked(self) -> None:
         """Oldest-first memo eviction that never drops an IN-FLIGHT
@@ -268,22 +467,24 @@ class RpcServer:
         first run later completed) or 409 a parked duplicate of a call
         that actually succeeded.  In-flight entries hold no response
         bytes, so the byte budget is enforceable without them; capacity
-        may transiently exceed by the number of concurrent calls."""
-        def _over() -> bool:
-            return len(self._seen) > self.DEDUP_CAPACITY or (
-                self._seen_bytes > self.DEDUP_BYTE_BUDGET
-                and len(self._seen) > 1)
+        may transiently exceed by the number of concurrent calls.
 
-        if not _over():
-            return
-        for tok in list(self._seen):
-            if not _over():
-                return
-            _ev, resp = self._seen[tok]
-            if resp is None:
-                continue  # in-flight: protected
-            del self._seen[tok]
-            self._seen_bytes -= len(resp)
+        The scan stops at the first evictable entry per round: once the
+        memo sits at capacity (steady state under sustained load), each
+        call evicts exactly one completed token from the front — O(1)
+        unless the oldest entries are all in flight, never an O(capacity)
+        list build per call."""
+        while (len(self._seen) > self.DEDUP_CAPACITY
+               or (self._seen_bytes > self.DEDUP_BYTE_BUDGET
+                   and len(self._seen) > 1)):
+            victim = None
+            for tok, (_ev, resp) in self._seen.items():  # oldest first
+                if resp is not None:
+                    victim = tok
+                    break
+            if victim is None:
+                return  # every old entry is in flight: protected
+            self._seen_bytes -= len(self._seen.pop(victim)[1])
 
     def _handle(self, raw: bytes) -> bytes:
         try:
@@ -295,33 +496,38 @@ class RpcServer:
                 "type": type(e).__name__, "msg": f"bad request frame: {e}",
                 "code": 400,
             }})
-        with self._lock:
-            entry = self._seen.get(token)
-            if entry is None:
-                event = threading.Event()
-                self._seen[token] = (event, None)
-                self._evict_memo_locked()
-            else:
-                event = entry[0]
-        if entry is not None:
-            # duplicate delivery (retry after a lost response): wait for
-            # the original execution, return its memoized response
-            event.wait(timeout=300)
+        _INFLIGHT_SERVER.inc()
+        try:
             with self._lock:
-                memo = self._seen.get(token)
-            if memo is not None and memo[1] is not None:
-                return memo[1]
-            return _encode({"ok": False, "error": {
-                "type": "RpcFault", "code": 409,
-                "msg": "duplicate of a call that never completed",
-            }})
-        response = self._execute(method, req.get("payload"))
-        with self._lock:
-            if token in self._seen:
-                self._seen[token] = (event, response)
-                self._seen_bytes += len(response)
-        event.set()
-        return response
+                entry = self._seen.get(token)
+                if entry is None:
+                    event = threading.Event()
+                    self._seen[token] = (event, None)
+                    self._evict_memo_locked()
+                else:
+                    event = entry[0]
+            if entry is not None:
+                # duplicate delivery (retry after a lost response): wait for
+                # the original execution, return its memoized response
+                event.wait(timeout=300)
+                with self._lock:
+                    memo = self._seen.get(token)
+                if memo is not None and memo[1] is not None:
+                    return memo[1]
+                return _encode({"ok": False, "error": {
+                    "type": "RpcFault", "code": 409,
+                    "msg": "duplicate of a call that never completed",
+                }})
+            response = self._execute(
+                method, req.get("payload"), req.get("trace"))
+            with self._lock:
+                if token in self._seen:
+                    self._seen[token] = (event, response)
+                    self._seen_bytes += len(response)
+            event.set()
+            return response
+        finally:
+            _INFLIGHT_SERVER.dec()
 
     def stop(self) -> None:
         self._server.stop()

@@ -188,65 +188,78 @@ def distributed_map_reduce(
     partials: List[Any] = [None] * k
     errors: List[Optional[Exception]] = [None] * k
 
-    def _run(i: int, member: Member) -> None:
-        lo, hi = bounds[i], bounds[i + 1]
-        part = {name: np.ascontiguousarray(arr[lo:hi])
-                for name, arr in columns.items()}
-        if hi <= lo:
-            return  # empty range contributes the identity (skipped)
-        try:
-            if member.info.name == cloud.info.name:
-                partials[i] = _mr_shard_local(fn, part, reduce)
-            else:
-                partials[i] = submit(
-                    cloud, member, "mr_shard",
-                    {"fn": fn, "columns": part, "reduce": reduce},
-                    timeout=timeout)
-        except _rpc.RPCError as e:
-            errors[i] = e
-            partials[i] = _mr_shard_local(fn, part, reduce)  # recover
+    # one span covers the whole fan-out; its context is captured and handed
+    # to every worker thread (spans are thread-local, so without the explicit
+    # hand-off each member's work would mint its own disconnected trace) —
+    # the RPC client then rides the per-member span across the wire, so one
+    # trace_id threads caller -> member span -> remote execution
+    with telemetry.Span("distributed_map_reduce", members=k, rows=int(n),
+                        reduce=reduce):
+        ctx = telemetry.current_trace_context()
 
-    threads = [threading.Thread(target=_run, args=(i, m), daemon=True)
-               for i, m in enumerate(workers)]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join(timeout=timeout)
-
-    # take ONE snapshot per range: a member that answered contributes its
-    # partial; a member that failed (error) already recovered inside _run;
-    # a member that never answered inside the deadline re-runs HERE — a
-    # silent missing range would be a silently wrong reduction
-    recovered = 0
-    parts = []
-    for i in range(k):
-        lo, hi = bounds[i], bounds[i + 1]
-        if hi <= lo:
-            continue
-        p = partials[i]
-        if p is None:
+        def _run(i: int, member: Member) -> None:
+            lo, hi = bounds[i], bounds[i + 1]
             part = {name: np.ascontiguousarray(arr[lo:hi])
                     for name, arr in columns.items()}
-            p = _mr_shard_local(fn, part, reduce)
-            recovered += 1
-        parts.append(p)
-    if recovered or any(e is not None for e in errors):
-        from h2o3_tpu.util.log import get_logger
+            if hi <= lo:
+                return  # empty range contributes the identity (skipped)
+            with telemetry.Span(
+                    "mr_member", trace_id=ctx["trace_id"],
+                    parent_id=ctx["span_id"], member=member.info.name,
+                    lo=lo, hi=hi):
+                try:
+                    if member.info.name == cloud.info.name:
+                        partials[i] = _mr_shard_local(fn, part, reduce)
+                    else:
+                        partials[i] = submit(
+                            cloud, member, "mr_shard",
+                            {"fn": fn, "columns": part, "reduce": reduce},
+                            timeout=timeout)
+                except _rpc.RPCError as e:
+                    errors[i] = e
+                    partials[i] = _mr_shard_local(fn, part, reduce)  # recover
 
-        get_logger("cluster").warning(
-            "map_reduce fan-out recovered %d member range(s) locally",
-            recovered + sum(1 for e in errors if e is not None))
+        threads = [threading.Thread(target=_run, args=(i, m), daemon=True)
+                   for i, m in enumerate(workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=timeout)
 
-    if not parts:  # zero-row input: the local path defines the answer
-        return _mr_shard_local(fn, columns, reduce)
+        # take ONE snapshot per range: a member that answered contributes its
+        # partial; a member that failed (error) already recovered inside _run;
+        # a member that never answered inside the deadline re-runs HERE — a
+        # silent missing range would be a silently wrong reduction
+        recovered = 0
+        parts = []
+        for i in range(k):
+            lo, hi = bounds[i], bounds[i + 1]
+            if hi <= lo:
+                continue
+            p = partials[i]
+            if p is None:
+                part = {name: np.ascontiguousarray(arr[lo:hi])
+                        for name, arr in columns.items()}
+                p = _mr_shard_local(fn, part, reduce)
+                recovered += 1
+            parts.append(p)
+        if recovered or any(e is not None for e in errors):
+            from h2o3_tpu.util.log import get_logger
 
-    import jax
+            get_logger("cluster").warning(
+                "map_reduce fan-out recovered %d member range(s) locally",
+                recovered + sum(1 for e in errors if e is not None))
 
-    op = _COMBINE[reduce]
-    out = parts[0]
-    for p in parts[1:]:
-        out = jax.tree.map(op, out, p)
-    return out
+        if not parts:  # zero-row input: the local path defines the answer
+            return _mr_shard_local(fn, columns, reduce)
+
+        import jax
+
+        op = _COMBINE[reduce]
+        out = parts[0]
+        for p in parts[1:]:
+            out = jax.tree.map(op, out, p)
+        return out
 
 
 def distributed_parse_chunks(
@@ -276,31 +289,43 @@ def distributed_parse_chunks(
     _FANOUT.set(len(workers))
     napack = _parse._pipeline_napack(setup)
 
-    def _run(i: int, chunk: bytes, member: Member) -> None:
-        try:
-            if member.info.name == cloud.info.name:
-                results[i] = _parse._parse_chunk(chunk, setup, na, napack)
-            else:
-                results[i] = submit(
-                    cloud, member, "parse_chunk",
-                    {"chunk": chunk, "setup": setup}, timeout=timeout)
-        except _rpc.RPCError:
-            results[i] = _parse._parse_chunk(  # recover locally
-                chunk, setup, na, napack)
+    with telemetry.Span("distributed_parse", chunks=len(chunks),
+                        members=len(workers)):
+        ctx = telemetry.current_trace_context()
 
-    # bounded fan-out: a couple of chunks in flight per member pipelines
-    # the stream at constant memory — one thread (and one pickled copy
-    # of its chunk) per chunk at once would hold ~2x the input resident
-    from concurrent.futures import ThreadPoolExecutor
-    from concurrent.futures import wait as _futures_wait
+        def _run(i: int, chunk: bytes, member: Member) -> None:
+            # executor threads are not the caller's thread: join its trace
+            # explicitly so remote chunk tokenization shows in one tree
+            with telemetry.Span(
+                    "parse_chunk_remote", trace_id=ctx["trace_id"],
+                    parent_id=ctx["span_id"], member=member.info.name,
+                    chunk=i):
+                try:
+                    if member.info.name == cloud.info.name:
+                        results[i] = _parse._parse_chunk(
+                            chunk, setup, na, napack)
+                    else:
+                        results[i] = submit(
+                            cloud, member, "parse_chunk",
+                            {"chunk": chunk, "setup": setup},
+                            timeout=timeout)
+                except _rpc.RPCError:
+                    results[i] = _parse._parse_chunk(  # recover locally
+                        chunk, setup, na, napack)
 
-    ex = ThreadPoolExecutor(
-        max_workers=2 * len(workers), thread_name_prefix="parse-fanout")
-    futs = [ex.submit(_run, i, c, workers[i % len(workers)])
-            for i, c in enumerate(chunks)]
-    _futures_wait(futs, timeout=timeout)
-    ex.shutdown(wait=False, cancel_futures=True)
-    for i, r in enumerate(results):
-        if r is None:  # member never answered in time: tokenize here
-            results[i] = _parse._parse_chunk(chunks[i], setup, na, napack)
-    return _parse._reduce_chunks(results, setup)
+        # bounded fan-out: a couple of chunks in flight per member pipelines
+        # the stream at constant memory — one thread (and one pickled copy
+        # of its chunk) per chunk at once would hold ~2x the input resident
+        from concurrent.futures import ThreadPoolExecutor
+        from concurrent.futures import wait as _futures_wait
+
+        ex = ThreadPoolExecutor(
+            max_workers=2 * len(workers), thread_name_prefix="parse-fanout")
+        futs = [ex.submit(_run, i, c, workers[i % len(workers)])
+                for i, c in enumerate(chunks)]
+        _futures_wait(futs, timeout=timeout)
+        ex.shutdown(wait=False, cancel_futures=True)
+        for i, r in enumerate(results):
+            if r is None:  # member never answered in time: tokenize here
+                results[i] = _parse._parse_chunk(chunks[i], setup, na, napack)
+        return _parse._reduce_chunks(results, setup)

@@ -377,11 +377,16 @@ def map_reduce_frame(
         cloud = active_cloud()
     except Exception:
         cloud = None
-    if cloud is None:
-        table = FrameTable.from_frame(frame, columns=names)
-        out = map_reduce(fn, table, reduce=reduce)
-        return jax.tree.map(np.asarray, out)
-    from h2o3_tpu.cluster.tasks import distributed_map_reduce
+    # span both paths under one kind: a trace reads identically whether the
+    # shards ran on this node's mesh or fanned out over the cloud, and the
+    # distributed path's member/RPC child spans hang underneath
+    with telemetry.Span("map_reduce_frame", rows=int(frame.nrows),
+                        columns=len(names), distributed=cloud is not None):
+        if cloud is None:
+            table = FrameTable.from_frame(frame, columns=names)
+            out = map_reduce(fn, table, reduce=reduce)
+            return jax.tree.map(np.asarray, out)
+        from h2o3_tpu.cluster.tasks import distributed_map_reduce
 
-    host = {n: frame.col(n).numeric_view() for n in names}
-    return distributed_map_reduce(fn, host, reduce=reduce, cloud=cloud)
+        host = {n: frame.col(n).numeric_view() for n in names}
+        return distributed_map_reduce(fn, host, reduce=reduce, cloud=cloud)

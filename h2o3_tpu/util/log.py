@@ -28,11 +28,34 @@ _ring: Deque[str] = collections.deque(maxlen=4096)
 _file_path: Optional[str] = None
 _initialized = False
 
+#: Optional trace-context provider (installed by util/telemetry.py):
+#: returns {"trace_id": ..., "span_id": ...} for the calling thread's open
+#: span, or None.  A hook — not an import — so this module stays
+#: dependency-free (mirrors util/timeline.py's provider).
+_trace_provider = None
+
+
+def set_trace_provider(fn) -> None:
+    """Install a callable returning the current trace context; log lines
+    emitted under an open span then carry ``[trace=... span=...]`` so
+    ``/3/Logs`` correlates with ``/3/Timeline`` (and the cross-node log
+    proxy ships the ids along for free — they are part of the line)."""
+    global _trace_provider
+    _trace_provider = fn
+
 
 class _RingHandler(logging.Handler):
     def emit(self, record: logging.LogRecord) -> None:
         try:
             line = self.format(record)  # format outside the lock
+            if _trace_provider is not None:
+                try:
+                    ctx = _trace_provider()
+                except Exception:  # tracing must never break logging
+                    ctx = None
+                if ctx and ctx.get("trace_id"):
+                    line += (f" [trace={ctx['trace_id']}"
+                             f" span={ctx['span_id']}]")
             with _lock:
                 # ring access is consistently lock-protected: recent()
                 # copies under _lock, so appends must happen under it too

@@ -29,10 +29,10 @@ faster".
 from __future__ import annotations
 
 import math
+import random
 import re
 import threading
 import time
-import uuid
 from typing import (
     Any,
     Dict,
@@ -57,8 +57,14 @@ __all__ = [
     "histogram",
     "current_span",
     "current_trace_id",
+    "current_trace_context",
     "install_jax_compile_listener",
     "jit_compile_count",
+    "merge_snapshots",
+    "node_name",
+    "node_scope",
+    "set_node_name",
+    "snapshot_prometheus",
 ]
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
@@ -96,6 +102,34 @@ def _fmt_value(v: float) -> str:
     return repr(f)
 
 
+class _Bound:
+    """A pre-validated handle on ONE series of a metric family: label
+    checking and key construction happen once at :meth:`Metric.bind` time,
+    so the per-event cost on a hot path (the RPC in-flight gauge ticks
+    twice per call) drops to a lock plus a dict op.  The update logic
+    stays on the metric class (``_inc_key``/``_set_key``/``_observe_key``),
+    so a handle keeps its metric's type discipline — ``observe`` on a
+    gauge-bound handle is an AttributeError, not silent corruption."""
+
+    __slots__ = ("_metric", "_key")
+
+    def __init__(self, metric: "Metric", key: Tuple[str, ...]) -> None:
+        self._metric = metric
+        self._key = key
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._metric._inc_key(self._key, amount)
+
+    def dec(self, amount: float = 1.0) -> None:
+        self._metric._inc_key(self._key, -amount)
+
+    def set(self, value: float) -> None:
+        self._metric._set_key(self._key, value)
+
+    def observe(self, value: float) -> None:
+        self._metric._observe_key(self._key, value)
+
+
 class Metric:
     """One metric family: a name + help + fixed label names, holding one
     series per distinct label-value tuple. All mutation is lock-protected
@@ -123,6 +157,11 @@ class Metric:
                 f"got {tuple(labels)}"
             )
         return tuple(str(labels[k]) for k in self.labelnames)
+
+    def bind(self, **labels: Any) -> _Bound:
+        """Pre-resolve a label set into a cheap single-series handle
+        (validates the labels now, never again)."""
+        return _Bound(self, self._key(labels))
 
     def _label_str(self, key: Tuple[str, ...]) -> str:
         if not self.labelnames:
@@ -152,12 +191,14 @@ class Counter(Metric):
 
     kind = "counter"
 
-    def inc(self, amount: float = 1.0, **labels: Any) -> None:
+    def _inc_key(self, key: Tuple[str, ...], amount: float) -> None:
         if amount < 0:
             raise ValueError("counters only go up")
-        key = self._key(labels)
         with self._lock:
             self._series[key] = self._series.get(key, 0.0) + amount
+
+    def inc(self, amount: float = 1.0, **labels: Any) -> None:
+        self._inc_key(self._key(labels), amount)
 
     def value(self, **labels: Any) -> float:
         key = self._key(labels)
@@ -195,18 +236,22 @@ class Gauge(Metric):
 
     kind = "gauge"
 
-    def set(self, value: float, **labels: Any) -> None:
-        key = self._key(labels)
+    def _set_key(self, key: Tuple[str, ...], value: float) -> None:
         with self._lock:
             self._series[key] = float(value)
 
-    def inc(self, amount: float = 1.0, **labels: Any) -> None:
-        key = self._key(labels)
+    def _inc_key(self, key: Tuple[str, ...], amount: float) -> None:
         with self._lock:
             self._series[key] = self._series.get(key, 0.0) + amount
 
+    def set(self, value: float, **labels: Any) -> None:
+        self._set_key(self._key(labels), value)
+
+    def inc(self, amount: float = 1.0, **labels: Any) -> None:
+        self._inc_key(self._key(labels), amount)
+
     def dec(self, amount: float = 1.0, **labels: Any) -> None:
-        self.inc(-amount, **labels)
+        self._inc_key(self._key(labels), -amount)
 
     def value(self, **labels: Any) -> float:
         key = self._key(labels)
@@ -244,8 +289,7 @@ class Histogram(Metric):
             raise ValueError("histogram needs at least one finite bucket")
         self.buckets: Tuple[float, ...] = bs
 
-    def observe(self, value: float, **labels: Any) -> None:
-        key = self._key(labels)
+    def _observe_key(self, key: Tuple[str, ...], value: float) -> None:
         v = float(value)
         with self._lock:
             st = self._series.get(key)
@@ -259,6 +303,9 @@ class Histogram(Metric):
                     break
             st["sum"] += v
             st["count"] += 1
+
+    def observe(self, value: float, **labels: Any) -> None:
+        self._observe_key(self._key(labels), value)
 
     def count(self, **labels: Any) -> int:
         key = self._key(labels)
@@ -407,6 +454,115 @@ class Registry:
                 out[name] = m.value()
         return out
 
+def merge_snapshots(
+    per_node: Mapping[str, Mapping[str, Any]]
+) -> Dict[str, Any]:
+    """Merge per-node :meth:`Registry.snapshot` payloads into one cluster
+    view (the ``GET /3/Metrics?cluster=true`` body).
+
+    Every series gains a ``node=`` label so per-member numbers stay
+    visible.  Counters and histograms additionally get a ``node="_cluster"``
+    aggregate per distinct label set — counters sum across nodes; histogram
+    bucket counts, sums and counts add (one codebase per cloud, so bucket
+    bounds match; a family whose bucket layout disagrees across nodes keeps
+    only the per-node series).  Gauges stay strictly per-node: summing one
+    member's free memory into another's means nothing.
+    """
+    merged: Dict[str, Dict[str, Any]] = {}
+    for node in sorted(per_node):
+        snap = per_node[node] or {}
+        for name, fam in snap.items():
+            slot = merged.setdefault(name, {
+                "type": fam.get("type", "untyped"),
+                "help": fam.get("help", ""),
+                "series": [],
+            })
+            if "buckets" in fam and "buckets" not in slot:
+                slot["buckets"] = list(fam["buckets"])
+            for s in fam.get("series", []):
+                entry = dict(s)
+                entry["labels"] = {**s.get("labels", {}), "node": node}
+                slot["series"].append(entry)
+    for name, fam in merged.items():
+        base_keys = [
+            tuple(sorted(
+                (k, v) for k, v in s["labels"].items() if k != "node"))
+            for s in fam["series"]
+        ]
+        if fam["type"] == "counter":
+            agg: Dict[Tuple, float] = {}
+            for key, s in zip(base_keys, fam["series"]):
+                agg[key] = agg.get(key, 0.0) + float(s.get("value", 0.0))
+            for key in sorted(agg):
+                fam["series"].append({
+                    "labels": {**dict(key), "node": "_cluster"},
+                    "value": agg[key],
+                })
+        elif fam["type"] == "histogram":
+            nb = len(fam.get("buckets", ()))
+            if any(len(s.get("bucket_counts", ())) != nb
+                   for s in fam["series"]):
+                continue  # bucket-layout skew: per-node series only
+            hagg: Dict[Tuple, Dict[str, Any]] = {}
+            for key, s in zip(base_keys, fam["series"]):
+                st = hagg.setdefault(key, {
+                    "bucket_counts": [0] * nb, "sum": 0.0, "count": 0})
+                st["bucket_counts"] = [
+                    a + b for a, b in
+                    zip(st["bucket_counts"], s["bucket_counts"])]
+                st["sum"] += float(s.get("sum", 0.0))
+                st["count"] += int(s.get("count", 0))
+            for key in sorted(hagg):
+                fam["series"].append({
+                    "labels": {**dict(key), "node": "_cluster"},
+                    **hagg[key],
+                })
+    return merged
+
+
+def snapshot_prometheus(snapshot: Mapping[str, Any]) -> str:
+    """Render a snapshot dict (one node's :meth:`Registry.snapshot` or a
+    :func:`merge_snapshots` result) as Prometheus text exposition v0.0.4 —
+    the federation path cannot use :meth:`Registry.prometheus` because the
+    merged series exist only as JSON, never as live Metric objects."""
+    lines: List[str] = []
+    for name in sorted(snapshot):
+        fam = snapshot[name]
+        kind = fam.get("type", "untyped")
+        if fam.get("help"):
+            lines.append(f"# HELP {name} {_escape_help(fam['help'])}")
+        lines.append(f"# TYPE {name} {kind}")
+        for s in fam.get("series", []):
+            pairs = [
+                '%s="%s"' % (k, _escape_label(v))
+                for k, v in s.get("labels", {}).items()
+            ]
+
+            def _suffixed(extra_pair: Optional[str] = None) -> str:
+                ps = pairs + ([extra_pair] if extra_pair else [])
+                return "{" + ",".join(ps) + "}" if ps else ""
+
+            if kind == "histogram":
+                cum = 0
+                for ub, c in zip(fam.get("buckets", ()),
+                                 s.get("bucket_counts", ())):
+                    cum += c
+                    le = 'le="%s"' % _fmt_value(ub)
+                    lines.append(f"{name}_bucket{_suffixed(le)} {cum}")
+                n = int(s.get("count", 0))
+                inf = 'le="+Inf"'
+                lines.append(f"{name}_bucket{_suffixed(inf)} {n}")
+                lines.append(
+                    f"{name}_sum{_suffixed()} "
+                    f"{_fmt_value(float(s.get('sum', 0.0)))}")
+                lines.append(f"{name}_count{_suffixed()} {n}")
+            else:
+                lines.append(
+                    f"{name}{_suffixed()} "
+                    f"{_fmt_value(float(s.get('value', 0.0)))}")
+    return "\n".join(lines) + "\n" if lines else ""
+
+
 #: The process-wide registry — the analogue of the one WaterMeter per node.
 #: Deliberately no reset(): instrumented modules hold direct references to
 #: their families, so clearing the catalog would split-brain the process
@@ -434,6 +590,55 @@ def histogram(name: str, help: str = "", labels: Sequence[str] = (),
 
 _tls = threading.local()
 
+#: span/trace id minting: a process-seeded PRNG formatted as 16 hex chars.
+#: uuid4 costs ~2.5us per id; at three spans per traced RPC that is real
+#: money against a ~100us loopback round trip — getrandbits is ~5x cheaper
+#: and 64 random bits is ample for correlating events inside one ring
+_ids = random.Random()
+
+
+def _new_id() -> str:
+    return "%016x" % _ids.getrandbits(64)
+
+
+#: process-global node identity (set by the cluster bootstrap); every
+#: timeline event and span records it so a merged cluster timeline can
+#: attribute events to the member that emitted them
+_node_name: Optional[str] = None
+
+
+def set_node_name(name: Optional[str]) -> None:
+    """Declare this process's cluster node name (``boot_node`` calls it);
+    every subsequently recorded timeline event carries ``node=<name>``."""
+    global _node_name
+    _node_name = name
+
+
+def node_name() -> Optional[str]:
+    """The effective node identity: a thread-local :class:`node_scope`
+    override (the RPC serving path) wins over the process-global name."""
+    override = getattr(_tls, "node", None)
+    return override if override is not None else _node_name
+
+
+class node_scope:
+    """Thread-local node-identity override: the RPC server dispatches a
+    remote call under the *serving* cloud's name so events recorded during
+    the call attribute correctly even with several in-process Clouds (the
+    single-process test harness)."""
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._prev: Optional[str] = None
+
+    def __enter__(self) -> "node_scope":
+        self._prev = getattr(_tls, "node", None)
+        _tls.node = self.name
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _tls.node = self._prev
+
 
 def _span_stack() -> List["Span"]:
     stack = getattr(_tls, "spans", None)
@@ -452,16 +657,39 @@ def current_trace_id() -> Optional[str]:
     return sp.trace_id if sp else None
 
 
-def _trace_fields() -> Optional[Dict[str, Any]]:
-    """Trace context injected into plain ``timeline.record`` calls made under
-    an open span (the provider hook; the recording code stays span-unaware)."""
+def current_trace_context() -> Optional[Dict[str, str]]:
+    """``{"trace_id", "span_id"}`` of the calling thread's open span, or
+    None — the envelope the RPC client injects so a remote child span can
+    join the caller's trace."""
     sp = current_span()
-    if sp is None:
+    if sp is None or sp.trace_id is None:
         return None
     return {"trace_id": sp.trace_id, "span_id": sp.span_id}
 
 
+def _trace_fields() -> Optional[Dict[str, Any]]:
+    """Trace context injected into plain ``timeline.record`` calls made under
+    an open span (the provider hook; the recording code stays span-unaware).
+    Also stamps the recording node's identity when one is declared, so every
+    event in a merged cluster timeline names its origin."""
+    out: Dict[str, Any] = {}
+    node = node_name()
+    if node:
+        out["node"] = node
+    sp = current_span()
+    if sp is not None:
+        out["trace_id"] = sp.trace_id
+        out["span_id"] = sp.span_id
+    return out or None
+
+
 timeline.set_trace_provider(_trace_fields)
+
+# the log ring gets the same correlation: lines emitted under an open span
+# carry its trace/span ids, so /3/Logs lines line up with /3/Timeline traces
+from h2o3_tpu.util import log as _log  # noqa: E402  (import-light, no cycle)
+
+_log.set_trace_provider(current_trace_context)
 
 
 class Span:
@@ -469,17 +697,28 @@ class Span:
 
     The outermost span mints a fresh ``trace_id``; nested spans inherit it and
     point at their parent via ``parent_id``. On exit one enriched event lands
-    in the timeline ring (kind + duration_ms + ok + ids + fields) — the same
-    shape ``timeline.timed`` wrote, now correlatable across layers. Spans are
-    thread-local: a REST handler thread's trace does not leak into a
-    concurrently training thread."""
+    in the timeline ring (kind + duration_ms + ok + ids + node + fields) — the
+    same shape ``timeline.timed`` wrote, now correlatable across layers. Spans
+    are thread-local: a REST handler thread's trace does not leak into a
+    concurrently training thread.
 
-    def __init__(self, kind: str, **fields: Any) -> None:
+    ``trace_id``/``parent_id`` may be passed explicitly to continue a trace
+    that started somewhere else — another thread (a fan-out worker joining
+    its caller's trace) or another *node* (the RPC server parenting its
+    dispatch span under the caller's envelope context). An explicit context
+    wins over the thread-local parent."""
+
+    __slots__ = ("kind", "fields", "span_id", "trace_id", "parent_id",
+                 "_explicit", "t0")
+
+    def __init__(self, kind: str, *, trace_id: Optional[str] = None,
+                 parent_id: Optional[str] = None, **fields: Any) -> None:
         self.kind = kind
-        self.fields = dict(fields)
-        self.span_id = uuid.uuid4().hex[:16]
-        self.trace_id: Optional[str] = None
-        self.parent_id: Optional[str] = None
+        self.fields = fields
+        self.span_id = _new_id()
+        self.trace_id: Optional[str] = trace_id
+        self.parent_id: Optional[str] = parent_id
+        self._explicit = trace_id is not None
         self.t0 = 0.0
 
     def set(self, **fields: Any) -> "Span":
@@ -488,12 +727,17 @@ class Span:
         return self
 
     def __enter__(self) -> "Span":
-        parent = current_span()
-        if parent is not None:
-            self.trace_id = parent.trace_id
-            self.parent_id = parent.span_id
-        else:
-            self.trace_id = uuid.uuid4().hex[:16]
+        if not self._explicit:
+            parent = current_span()
+            if parent is not None:
+                self.trace_id = parent.trace_id
+                self.parent_id = parent.span_id
+            else:
+                self.trace_id = _new_id()
+                # a parent_id passed WITHOUT a trace_id would dangle into
+                # no trace (e.g. a proxy dropped the trace header but kept
+                # the span header) — a fresh trace starts at a root
+                self.parent_id = None
         _span_stack().append(self)
         self.t0 = time.perf_counter()
         return self
@@ -505,15 +749,22 @@ class Span:
             stack.pop()
         elif self in stack:  # tolerate exotic unwinding, never corrupt peers
             stack.remove(self)
-        timeline.record(
-            self.kind,
-            duration_ms=duration_ms,
-            ok=exc_type is None,
-            trace_id=self.trace_id,
-            span_id=self.span_id,
-            parent_id=self.parent_id,
-            **self.fields,
-        )
+        evt = {
+            "kind": self.kind,
+            "duration_ms": duration_ms,
+            "ok": exc_type is None,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+        }
+        # the event carries an explicit trace_id, so the provider hook is
+        # bypassed — stamp the node identity here too
+        node = node_name()
+        if node and "node" not in self.fields:
+            evt["node"] = node
+        if self.fields:
+            evt.update(self.fields)
+        timeline.record_event(evt)
 
 
 # ---------------------------------------------------------------------------

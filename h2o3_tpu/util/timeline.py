@@ -55,6 +55,19 @@ def record(kind: str, **fields: Any) -> None:
         _ring.append(evt)
 
 
+def record_event(evt: Dict[str, Any]) -> None:
+    """Append a pre-built event dict — the hot-path variant of
+    :func:`record` for callers that already carry their trace fields
+    (telemetry Spans): no kwargs splat, no provider merge, one dict.
+    The caller hands over ownership of ``evt``."""
+    global _counter
+    evt["ns"] = time.time_ns()
+    with _lock:
+        _counter += 1
+        evt["seq"] = _counter
+        _ring.append(evt)
+
+
 class timed:
     """Context manager: records kind with duration_ms on exit."""
 
@@ -80,6 +93,18 @@ def snapshot(n: int = 1000) -> List[Dict[str, Any]]:
         return []  # [-0:] would be the WHOLE ring, not zero events
     with _lock:
         return list(_ring)[-n:]
+
+
+def snapshot_payload(n: int = 1000) -> Dict[str, Any]:
+    """Snapshot + ring totals + this node's wall clock at snapshot time —
+    the ``timeline_snapshot`` RPC body.  ``now_ns`` lets the merging node
+    sanity-check its heartbeat-derived clock-skew estimate against the
+    moment the events were actually collected."""
+    return {
+        "events": snapshot(n),
+        "total_events": total_events(),
+        "now_ns": time.time_ns(),
+    }
 
 
 def total_events() -> int:

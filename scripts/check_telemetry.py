@@ -61,7 +61,8 @@ def readme_documented_routes(readme_path: str) -> set:
 #: backticked tokens with one of these suffixes (optionally carrying a
 #: ``{label,...}`` hint) are treated as metric references the registry
 #: must actually contain
-_METRIC_SUFFIXES = ("_total", "_seconds", "_bytes", "_entries", "_workers")
+_METRIC_SUFFIXES = ("_total", "_seconds", "_bytes", "_entries", "_workers",
+                    "_inflight")
 
 
 #: README sections whose backticked metric references the registry must

@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Render span trees from a saved ``/3/Timeline`` JSON snapshot.
+
+Pure stdlib, no repo imports — point it at anything the timeline surface
+produced: ``GET /3/Timeline``, ``GET /3/Timeline?cluster=true`` (merged,
+node-tagged), or ``GET /3/Timeline/nodes/{i}`` (one member's ring).
+
+    curl -s localhost:54321/3/Timeline?cluster=true > snap.json
+    python scripts/trace_view.py snap.json
+    python scripts/trace_view.py snap.json --trace 1a2b3c4d5e6f7788
+    curl -s localhost:54321/3/Timeline | python scripts/trace_view.py -
+
+Output: one tree per trace, spans indented under their parents with
+durations and node ids, e.g. ::
+
+    trace 83f1d2... (4 spans, 1 event)
+    rest GET /3/DKV/k1 4.2ms ok [node-a]
+      rpc_client dkv_put 3.1ms ok [node-a]
+        rpc_server dkv_put 0.4ms ok [node-b]
+        rpc_attempt #1 0.9ms ok [node-a]   <- only when the ladder retried
+
+A timeline event is a *span end* when it carries a ``parent_id`` key (the
+Span contract: every span records parent_id, None for roots); other events
+under the same trace (plain ``timeline.record`` calls, ``timed`` blocks)
+attach beneath the span that was open when they were recorded.  Spans whose
+parent fell off the ring render as roots, flagged ``(orphan)``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+#: event fields that are structural, not descriptive — everything else a
+#: span carries is shown as key=value detail
+_STRUCTURAL = {
+    "ns", "seq", "kind", "trace_id", "span_id", "parent_id",
+    "duration_ms", "ok", "node",
+}
+
+
+def _events_of(payload: Any) -> List[Dict[str, Any]]:
+    """Accept a raw event list or any /3/Timeline response shape."""
+    if isinstance(payload, list):
+        return [e for e in payload if isinstance(e, dict)]
+    if isinstance(payload, dict) and isinstance(payload.get("events"), list):
+        return [e for e in payload["events"] if isinstance(e, dict)]
+    raise ValueError(
+        "unrecognized snapshot shape: want a /3/Timeline response "
+        "(an object with 'events') or a bare event list")
+
+
+def _is_span(ev: Dict[str, Any]) -> bool:
+    return "span_id" in ev and "parent_id" in ev
+
+
+def _start_ns(ev: Dict[str, Any]) -> float:
+    """Spans record at END; sort children by their start instant."""
+    return float(ev.get("ns", 0)) - float(ev.get("duration_ms", 0.0)) * 1e6
+
+
+def _label(ev: Dict[str, Any]) -> str:
+    parts = [str(ev.get("kind", "?"))]
+    for key in ("method", "route", "op", "task", "member", "target"):
+        if key in ev:
+            parts.append(str(ev[key]))
+            break
+    if "attempt" in ev:
+        parts.append(f"#{ev['attempt']}")
+    if "duration_ms" in ev:
+        parts.append(f"{float(ev['duration_ms']):.1f}ms")
+    if "ok" in ev:
+        parts.append("ok" if ev["ok"] else "FAILED")
+    node = ev.get("node")
+    if node:
+        parts.append(f"[{node}]")
+    detail = ",".join(
+        f"{k}={ev[k]}" for k in sorted(ev)
+        if k not in _STRUCTURAL
+        and k not in ("method", "route", "op", "task", "member", "target",
+                      "attempt")
+    )
+    if detail:
+        parts.append(f"({detail})")
+    return " ".join(parts)
+
+
+def render(events: List[Dict[str, Any]],
+           trace_id: Optional[str] = None) -> str:
+    """The trace trees of ``events`` as indented text, one per trace,
+    newest trace last.  ``trace_id`` narrows to one trace."""
+    traces: Dict[str, List[Dict[str, Any]]] = {}
+    order: List[str] = []
+    for ev in events:
+        tid = ev.get("trace_id")
+        if not tid or (trace_id and tid != trace_id):
+            continue
+        if tid not in traces:
+            traces[tid] = []
+            order.append(tid)
+        traces[tid].append(ev)
+
+    lines: List[str] = []
+    for tid in order:
+        evs = traces[tid]
+        spans = [e for e in evs if _is_span(e)]
+        plain = [e for e in evs if not _is_span(e)]
+        by_id = {e["span_id"]: e for e in spans}
+        children: Dict[Optional[str], List[Dict[str, Any]]] = {}
+        for e in spans:
+            parent = e.get("parent_id")
+            if parent is not None and parent not in by_id:
+                parent = None  # parent fell off the ring: orphan root
+                e = {**e, "_orphan": True}
+            children.setdefault(parent, []).append(e)
+        notes: Dict[str, List[Dict[str, Any]]] = {}
+        loose: List[Dict[str, Any]] = []
+        for e in plain:
+            sid = e.get("span_id")
+            (notes.setdefault(sid, []) if sid in by_id else loose).append(e)
+
+        lines.append(
+            f"trace {tid} ({len(spans)} span{'s' if len(spans) != 1 else ''}"
+            + (f", {len(plain)} event{'s' if len(plain) != 1 else ''}"
+               if plain else "") + ")")
+
+        def _walk(span: Dict[str, Any], depth: int) -> None:
+            flag = " (orphan)" if span.get("_orphan") else ""
+            lines.append("  " * depth + _label(span) + flag)
+            for note in sorted(notes.get(span["span_id"], []),
+                               key=lambda e: e.get("ns", 0)):
+                lines.append("  " * (depth + 1) + "- " + _label(note))
+            for child in sorted(children.get(span["span_id"], []),
+                                key=_start_ns):
+                _walk(child, depth + 1)
+
+        for root in sorted(children.get(None, []), key=_start_ns):
+            _walk(root, 0)
+        for note in sorted(loose, key=lambda e: e.get("ns", 0)):
+            lines.append("  - " + _label(note))
+        lines.append("")
+    if not lines:
+        lines = ["no traced events in snapshot", ""]
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render span trees from a /3/Timeline JSON snapshot")
+    ap.add_argument("snapshot",
+                    help="path to the saved JSON, or '-' for stdin")
+    ap.add_argument("--trace", default=None,
+                    help="show only this trace_id")
+    args = ap.parse_args(argv)
+    try:
+        if args.snapshot == "-":
+            payload = json.load(sys.stdin)
+        else:
+            with open(args.snapshot) as f:
+                payload = json.load(f)
+        events = _events_of(payload)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"trace_view: {e}", file=sys.stderr)
+        return 1
+    sys.stdout.write(render(events, trace_id=args.trace))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

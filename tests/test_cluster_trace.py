@@ -1,0 +1,514 @@
+"""Cluster-wide observability: cross-node trace propagation, federated
+metrics, and the merged cluster timeline.
+
+Reference analogues: ``water/TimeLine.java`` + ``init/TimelineSnapshot.java``
+(the cluster-snapshot timeline every member contributes to) and the
+per-node water meters.  Everything runs multiple Cloud instances inside
+one process over real loopback sockets — the envelope propagation, span
+parenting, scrape fan-out and merge logic are identical to the
+multi-process deployment; the only in-process artifact is that both
+"nodes" share one timeline ring and one metrics registry, which the
+assertions account for.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from h2o3_tpu.cluster import rpc as crpc
+from h2o3_tpu.cluster import transport
+from h2o3_tpu.cluster.membership import Cloud, set_local_cloud
+from h2o3_tpu.util import log as ulog
+from h2o3_tpu.util import telemetry as T
+from h2o3_tpu.util import timeline
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_HERE)
+
+
+def _mr_stat(cols, mask):
+    """Module-level map fn: crosses the RPC wire by module reference."""
+    import jax.numpy as jnp
+
+    return {
+        "s": jnp.sum(jnp.where(mask, cols["x"], 0.0)),
+        "n": jnp.sum(mask.astype(jnp.float32)),
+    }
+
+
+def _wait_for(cond, timeout=10.0, every=0.02, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(every)
+    pytest.fail(f"timed out after {timeout}s waiting for {msg}")
+
+
+def _trace_events(trace_id):
+    return [e for e in timeline.snapshot(timeline.CAPACITY)
+            if e.get("trace_id") == trace_id]
+
+
+@pytest.fixture()
+def two_clouds():
+    """A formed 2-node cloud (node-a, node-b) on loopback."""
+    a = Cloud("tracecloud", "node-a", hb_interval=0.05)
+    b = Cloud("tracecloud", "node-b", hb_interval=0.05)
+    try:
+        a.start([])
+        b.start([a.info.addr])
+        _wait_for(
+            lambda: a.size() == 2 and b.size() == 2
+            and a.consensus() and b.consensus(),
+            msg="2-node cloud formation")
+        yield a, b
+    finally:
+        a.stop()
+        b.stop()
+
+
+@pytest.fixture()
+def cloud_server(two_clouds):
+    from h2o3_tpu.api import start_server
+
+    a, b = two_clouds
+    set_local_cloud(a)
+    srv = start_server(port=0)
+    try:
+        yield a, b, srv
+    finally:
+        srv.stop()
+        set_local_cloud(None)
+
+
+def _get(srv, path):
+    try:
+        with urllib.request.urlopen(srv.url + path) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+# ---------------------------------------------------------------------------
+# trace propagation over RPC
+
+
+class TestTracePropagation:
+    def test_one_trace_spans_caller_client_attempt_and_server(
+            self, two_clouds):
+        a, b = two_clouds
+        with T.Span("caller_unit") as caller:
+            a.client.call(b.info.addr, "echo", b"x", timeout=5.0,
+                          target=b.info.ident)
+        evts = _trace_events(caller.trace_id)
+        by_kind = {e["kind"]: e for e in evts}
+        assert {"rpc_client", "rpc_server", "caller_unit"} <= set(by_kind)
+        # parent chain: caller -> rpc_client -> rpc_server; the clean
+        # single-attempt path opens NO per-attempt span (bench budget)
+        assert "rpc_attempt" not in by_kind
+        assert by_kind["rpc_client"]["parent_id"] == caller.span_id
+        assert (by_kind["rpc_server"]["parent_id"]
+                == by_kind["rpc_client"]["span_id"])
+        # the dispatch ran under the SERVING node's identity, and the
+        # envelope named its origin
+        assert by_kind["rpc_server"]["node"] == "node-b"
+        assert by_kind["rpc_server"]["origin"] == "node-a"
+        assert by_kind["rpc_server"]["method"] == "echo"
+
+    def test_untraced_calls_inject_nothing_and_open_no_spans(
+            self, two_clouds):
+        a, b = two_clouds
+        assert T.current_span() is None
+        before = timeline.total_events()
+        a.client.call(b.info.addr, "echo", b"y", timeout=5.0,
+                      target=b.info.ident)
+        evts = timeline.snapshot(timeline.CAPACITY)
+        new = [e for e in evts if e.get("seq", 0) > before
+               and e.get("kind", "").startswith("rpc_")]
+        assert new == []
+
+    def test_retried_attempts_are_sibling_spans(self):
+        """A dropped response forces a retry: the trace shows TWO
+        rpc_attempt spans under one rpc_client, and (dedup) only one
+        server-side execution span."""
+        srv = crpc.RpcServer(node_name="node-s")
+        srv.register("bump", lambda p: "ok")
+        drop = {"n": 1}
+
+        class _DropFirstReply(transport.Connection):
+            def __init__(self, inner):
+                self._inner = inner
+                self.sock = inner.sock
+                self.addr = inner.addr
+
+            def request(self, payload, timeout):
+                raw = self._inner.request(payload, timeout)
+                if drop["n"]:
+                    drop["n"] -= 1
+                    raise ConnectionResetError("reply dropped on the wire")
+                return raw
+
+        def dialer(addr, timeout):
+            return _DropFirstReply(transport.dial(addr, timeout))
+
+        client = crpc.RpcClient(dialer, backoff_base=0.01,
+                                node_name="node-c")
+        try:
+            with T.Span("retry_unit") as caller:
+                assert client.call(srv.address, "bump", None,
+                                   timeout=5.0, target="s") == "ok"
+            evts = _trace_events(caller.trace_id)
+            attempts = sorted((e for e in evts if e["kind"] == "rpc_attempt"),
+                              key=lambda e: e["attempt"])
+            clients = [e for e in evts if e["kind"] == "rpc_client"]
+            servers = [e for e in evts if e["kind"] == "rpc_server"]
+            assert len(clients) == 1
+            assert [e["attempt"] for e in attempts] == [0, 1]
+            # siblings: both attempts hang under the one rpc_client span
+            # (the failed first attempt materialized at retry time)
+            assert {e["parent_id"] for e in attempts} == {
+                clients[0]["span_id"]}
+            assert attempts[0]["ok"] is False and attempts[1]["ok"] is True
+            # the retry was deduped server-side: one execution span, one
+            # run — parented under the attempt-0 envelope (the rpc_client)
+            assert len(servers) == 1
+            assert servers[0]["node"] == "node-s"
+            assert servers[0]["parent_id"] == clients[0]["span_id"]
+        finally:
+            client.close()
+            srv.stop()
+
+    def test_distributed_map_reduce_single_trace_with_remote_spans(
+            self, two_clouds):
+        """Acceptance: a 2-node distributed_map_reduce yields ONE trace_id
+        whose span tree includes remote-node execution spans."""
+        import numpy as np
+
+        from h2o3_tpu.cluster import tasks as ctasks
+        from h2o3_tpu.cluster.tasks import distributed_map_reduce
+
+        ctasks.install(two_clouds[0])
+        ctasks.install(two_clouds[1])
+        x = np.arange(64, dtype=np.float64)
+        with T.Span("fit_unit") as caller:
+            out = distributed_map_reduce(
+                _mr_stat, {"x": x}, reduce="sum", cloud=two_clouds[0])
+        assert float(out["s"]) == float(x.sum())
+        evts = _trace_events(caller.trace_id)
+        kinds = {e["kind"] for e in evts}
+        assert {"distributed_map_reduce", "mr_member", "rpc_client",
+                "rpc_server", "mapreduce"} <= kinds
+        # the remote half executed under node-b's identity, in OUR trace
+        remote_exec = [e for e in evts if e["kind"] == "mapreduce"
+                       and e.get("node") == "node-b"]
+        assert remote_exec, [
+            (e["kind"], e.get("node")) for e in evts]
+        members = sorted(e["member"] for e in evts
+                         if e["kind"] == "mr_member")
+        assert members == ["node-a", "node-b"]
+
+    def test_rest_span_honors_inbound_trace_headers(self, cloud_server):
+        _a, _b, srv = cloud_server
+        req = urllib.request.Request(
+            srv.url + "/3/Ping",
+            headers={"X-H2O3-Trace-Id": "feedfacefeedface",
+                     "X-H2O3-Span-Id": "0123456789abcdef"})
+        with urllib.request.urlopen(req) as resp:
+            assert resp.status == 200
+            assert resp.headers["X-H2O3-Trace-Id"] == "feedfacefeedface"
+        rest = [e for e in _trace_events("feedfacefeedface")
+                if e["kind"] == "rest"]
+        assert rest and rest[-1]["parent_id"] == "0123456789abcdef"
+
+    def test_malformed_trace_header_is_ignored(self, cloud_server):
+        """A non-id-shaped inbound trace header must not be adopted (it
+        would be echoed back verbatim — a response-header-injection
+        primitive) nor recorded into the timeline."""
+        _a, _b, srv = cloud_server
+        req = urllib.request.Request(
+            srv.url + "/3/Ping",
+            headers={"X-H2O3-Trace-Id": "NOT-an-id!",
+                     "X-H2O3-Span-Id": "also bad"})
+        with urllib.request.urlopen(req) as resp:
+            assert resp.status == 200
+            echoed = resp.headers["X-H2O3-Trace-Id"]
+        # a fresh well-formed id was minted instead
+        assert echoed != "NOT-an-id!"
+        assert len(echoed) == 16 and int(echoed, 16) >= 0
+        assert _trace_events("NOT-an-id!") == []
+
+    def test_rest_dkv_put_traces_across_nodes(self, cloud_server):
+        """One trace threads REST handler -> routed DKV put -> remote home
+        node's RPC dispatch."""
+        from h2o3_tpu.cluster import dkv as cdkv
+        from h2o3_tpu.keyed import DKV, KeyedStore
+
+        a, b, srv = cloud_server
+        ra = cdkv.install(a, DKV)
+        cdkv.install(b, KeyedStore())
+        try:
+            key = next(k for k in (f"trace_k{i}" for i in range(4096))
+                       if ra.home_name(k) == "node-b")
+            body = json.dumps({"value": 7}).encode()
+            req = urllib.request.Request(
+                srv.url + f"/3/DKV/{key}", data=body,
+                headers={"Content-Type": "application/json"}, method="POST")
+            with urllib.request.urlopen(req) as resp:
+                assert resp.status == 200
+                tid = resp.headers["X-H2O3-Trace-Id"]
+            assert tid
+            evts = _trace_events(tid)
+            kinds = {e["kind"] for e in evts}
+            assert {"rest", "rpc_client", "rpc_server"} <= kinds
+            served_on = {e.get("node") for e in evts
+                         if e["kind"] == "rpc_server"}
+            assert "node-b" in served_on
+            DKV.remove(key)
+        finally:
+            DKV.router = None
+
+    def test_log_lines_carry_trace_ids(self):
+        with T.Span("log_unit") as sp:
+            ulog.get_logger("tracetest").info("correlate me")
+        hits = [ln for ln in ulog.recent(100)
+                if "correlate me" in ln]
+        assert hits and f"trace={sp.trace_id}" in hits[-1]
+        assert f"span={sp.span_id}" in hits[-1]
+        # outside a span: no trace suffix
+        ulog.get_logger("tracetest").info("uncorrelated line")
+        hits = [ln for ln in ulog.recent(100) if "uncorrelated line" in ln]
+        assert hits and "trace=" not in hits[-1]
+
+
+# ---------------------------------------------------------------------------
+# rpc serving-side meters
+
+
+class TestRpcMeters:
+    def test_served_side_seconds_labelled_by_method(self, two_clouds):
+        a, b = two_clouds
+        h = T.REGISTRY.get("rpc_call_seconds")
+        before = h.count(method="echo", side="server")
+        a.client.call(b.info.addr, "echo", b"z", timeout=5.0,
+                      target=b.info.ident)
+        assert h.count(method="echo", side="server") == before + 1
+        assert h.count(method="echo", side="client") >= 1
+
+    def test_inflight_gauge_pins_while_a_call_is_wedged(self):
+        import threading
+
+        release = threading.Event()
+        srv = crpc.RpcServer()
+        srv.register("wedge", lambda p: release.wait(10))
+        client = crpc.RpcClient(retries=0)
+        g = T.REGISTRY.get("rpc_inflight")
+        base_srv = g.value(side="server")
+        base_cli = g.value(side="client")
+        t = threading.Thread(
+            target=lambda: client.call(srv.address, "wedge", None,
+                                       timeout=10.0),
+            daemon=True)
+        try:
+            t.start()
+            _wait_for(lambda: g.value(side="server") == base_srv + 1,
+                      msg="server inflight to rise")
+            assert g.value(side="client") == base_cli + 1
+        finally:
+            release.set()
+            t.join(timeout=10)
+            client.close()
+            srv.stop()
+        assert g.value(side="server") == base_srv
+        assert g.value(side="client") == base_cli
+
+
+# ---------------------------------------------------------------------------
+# federated metrics
+
+
+class TestFederatedMetrics:
+    def test_cluster_metrics_merge_node_labels(self, cloud_server):
+        _a, _b, srv = cloud_server
+        st, out, _hd = _get(srv, "/3/Metrics?cluster=true")
+        assert st == 200
+        assert out["partial"] is False and out["errors"] == {}
+        assert out["nodes"] == ["node-a", "node-b"]
+        series = out["metrics"]["rpc_calls_total"]["series"]
+        nodes = {s["labels"]["node"] for s in series}
+        assert {"node-a", "node-b", "_cluster"} <= nodes
+        # counters sum into the _cluster aggregate: for any label set the
+        # aggregate equals the per-node sum
+        per_node = {}
+        agg = {}
+        for s in series:
+            key = tuple(sorted((k, v) for k, v in s["labels"].items()
+                               if k != "node"))
+            if s["labels"]["node"] == "_cluster":
+                agg[key] = s["value"]
+            else:
+                per_node[key] = per_node.get(key, 0.0) + s["value"]
+        assert agg and all(
+            abs(agg[k] - per_node[k]) < 1e-9 for k in agg)
+        # gauges got NO aggregate
+        gser = out["metrics"]["cluster_size"]["series"]
+        assert all(s["labels"]["node"] != "_cluster" for s in gser)
+
+    def test_cluster_metrics_partial_when_member_down(self, cloud_server):
+        a, b, srv = cloud_server
+        errs = T.REGISTRY.get("metrics_scrape_errors_total")
+        before = errs.total()
+        b.stop()
+        # a killed PROCESS closes its sockets; an in-process stop() leaves
+        # the peer's pooled connections half-alive — drain them so the
+        # scrape meets a genuinely dead member
+        a.client.pool.close_all()
+        st, out, _hd = _get(srv, "/3/Metrics?cluster=true")
+        assert st == 200  # degraded, never a 5xx
+        assert out["partial"] is True
+        assert "node-b" in out["errors"]
+        assert "node-a" in out["nodes"] and "node-b" not in out["nodes"]
+        assert errs.total() > before
+        # merged payload still has node-a's series
+        series = out["metrics"]["rpc_calls_total"]["series"]
+        assert any(s["labels"]["node"] == "node-a" for s in series)
+
+    def test_cluster_prometheus_variant(self, cloud_server):
+        _a, _b, srv = cloud_server
+        with urllib.request.urlopen(
+                srv.url + "/3/Metrics/prometheus?cluster=true") as resp:
+            assert resp.status == 200
+            text = resp.read().decode()
+        assert 'node="node-a"' in text and 'node="node-b"' in text
+        assert 'node="_cluster"' in text
+        # histogram contract survives the merge: +Inf bucket == count
+        assert "rpc_call_seconds_bucket" in text
+
+    def test_histogram_buckets_merge_in_aggregate(self):
+        snap_a = {"m_seconds": {
+            "type": "histogram", "help": "", "buckets": [0.1, 1.0],
+            "series": [{"labels": {}, "bucket_counts": [2, 1],
+                        "sum": 1.5, "count": 4}],
+        }}
+        snap_b = {"m_seconds": {
+            "type": "histogram", "help": "", "buckets": [0.1, 1.0],
+            "series": [{"labels": {}, "bucket_counts": [0, 3],
+                        "sum": 2.0, "count": 3}],
+        }}
+        merged = T.merge_snapshots({"na": snap_a, "nb": snap_b})
+        agg = [s for s in merged["m_seconds"]["series"]
+               if s["labels"]["node"] == "_cluster"]
+        assert agg == [{"labels": {"node": "_cluster"},
+                        "bucket_counts": [2, 4], "sum": 3.5, "count": 7}]
+
+    def test_single_node_cluster_query_degenerates_cleanly(self):
+        from h2o3_tpu.api import start_server
+
+        srv = start_server(port=0)
+        try:
+            st, out, _hd = _get(srv, "/3/Metrics?cluster=true")
+            assert st == 200 and out["partial"] is False
+            assert len(out["nodes"]) == 1
+            node = out["nodes"][0]
+            series = out["metrics"]["rest_requests_total"]["series"]
+            assert all(s["labels"]["node"] in (node, "_cluster")
+                       for s in series)
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# merged cluster timeline
+
+
+class TestClusterTimeline:
+    def test_merged_timeline_tags_nodes_and_sorts(self, cloud_server):
+        a, _b, srv = cloud_server
+        # let at least one heartbeat sample the clock
+        _wait_for(lambda: all(
+            m.clock_skew_ms is not None for m in a.members_sorted()
+            if m.info.name != "node-a"), msg="clock-skew sample")
+        st, out, _hd = _get(srv, "/3/Timeline?cluster=true&count=200")
+        assert st == 200 and out["partial"] is False
+        names = {n["name"] for n in out["nodes"]}
+        assert names == {"node-a", "node-b"}
+        meta_b = next(n for n in out["nodes"] if n["name"] == "node-b")
+        assert isinstance(meta_b["skew_ms"], float)
+        assert meta_b["rtt_ms"] is not None
+        assert out["events"], "merged stream is non-empty"
+        assert all("node" in e for e in out["events"])
+        ts = [e["ns"] for e in out["events"]]
+        assert ts == sorted(ts)
+
+    def test_merged_timeline_partial_when_member_down(self, cloud_server):
+        a, b, srv = cloud_server
+        b.stop()
+        a.client.pool.close_all()  # see the federated-metrics twin test
+        st, out, _hd = _get(srv, "/3/Timeline?cluster=true&count=50")
+        assert st == 200 and out["partial"] is True
+        down = [n for n in out["nodes"] if "error" in n]
+        assert down and down[0]["name"] == "node-b"
+
+    def test_timeline_node_proxy(self, cloud_server):
+        _a, _b, srv = cloud_server
+        st, out, _hd = _get(srv, "/3/Timeline/nodes/1?count=20")
+        assert st == 200
+        assert out["node"] == "node-b"
+        assert "events" in out and "total_events" in out
+        st, out0, _hd = _get(srv, "/3/Timeline/nodes/0?count=20")
+        assert st == 200 and out0["node"] == "node-a"
+        # self index and remote proxy answer ONE shape (clock comparison
+        # needs now_ns from both)
+        assert set(out0) == set(out)
+        assert "now_ns" in out0
+        st, _out, _hd = _get(srv, "/3/Timeline/nodes/9")
+        assert st == 404
+        st, _out, _hd = _get(srv, "/3/Timeline/nodes/bogus")
+        assert st == 404
+
+
+# ---------------------------------------------------------------------------
+# trace_view smoke (CI: the renderer cannot rot)
+
+
+class TestTraceView:
+    def test_smoke_renders_nested_spans_from_snapshot(self, tmp_path):
+        with T.Span("outer_view", route="/3/X") as outer:
+            timeline.record("note_event", detail="hi")
+            with T.Span("inner_view", member="node-z"):
+                pass
+        snap = {"events": _trace_events(outer.trace_id)}
+        path = tmp_path / "snap.json"
+        path.write_text(json.dumps(snap))
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_ROOT, "scripts", "trace_view.py"),
+             str(path)],
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, proc.stderr
+        out = proc.stdout
+        assert f"trace {outer.trace_id}" in out
+        assert "outer_view" in out and "inner_view" in out
+        # the child renders indented under the parent
+        lines = out.splitlines()
+        i_outer = next(i for i, ln in enumerate(lines) if "outer_view" in ln)
+        i_inner = next(i for i, ln in enumerate(lines) if "inner_view" in ln)
+        indent = len(lines[i_inner]) - len(lines[i_inner].lstrip())
+        assert indent > len(lines[i_outer]) - len(lines[i_outer].lstrip())
+        # plain records attach as notes
+        assert "note_event" in out
+
+    def test_bad_input_is_a_clean_error(self, tmp_path):
+        path = tmp_path / "junk.json"
+        path.write_text("{not json")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_ROOT, "scripts", "trace_view.py"),
+             str(path)],
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 1
+        assert "trace_view:" in proc.stderr
