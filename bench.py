@@ -55,9 +55,10 @@ _CACHE_ENV = {
 # just skipping the setdefault) so an externally exported cache dir can't
 # reach CPU children either.
 if os.environ.get("BENCH_FORCE_CPU") or "--cache-bench" in sys.argv \
-        or "--parse-bench" in sys.argv or "--cluster-bench" in sys.argv:
-    # --cache-bench / --parse-bench / --cluster-bench are CPU-only by
-    # construction: same hazard
+        or "--parse-bench" in sys.argv or "--cluster-bench" in sys.argv \
+        or "--chaos-bench" in sys.argv:
+    # --cache-bench / --parse-bench / --cluster-bench / --chaos-bench
+    # are CPU-only by construction: same hazard
     for _k in _CACHE_ENV:
         os.environ.pop(_k, None)
 else:
@@ -747,6 +748,156 @@ def _cluster_bench() -> None:
         set_local_cloud(None)
 
 
+def _chaos_bench() -> None:
+    """Chaos recovery microbench (the failure model's price tags).
+
+    Boots a 3-node localhost cloud (this process + two nodeproc
+    children), replicates keys across it, then SIGKILLs one child and
+    measures what recovery actually costs: how long until the first
+    replica-served read of a key the victim homed (the read-repair
+    path), what fraction of replicated keys stay readable through the
+    death, distributed map_reduce wall clock with a rescheduled range
+    vs healthy, and the time for membership to reconverge on the
+    survivors.  Prints ONE JSON line and mirrors it to
+    CHAOS_BENCH.json.  CPU-only: the fan-out payloads are tiny."""
+    import platform
+    import signal as _signal
+    import tempfile
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from h2o3_tpu.cluster import tasks as ctasks
+    from h2o3_tpu.cluster.membership import boot_node, set_local_cloud
+    from h2o3_tpu.keyed import KeyedStore
+    from h2o3_tpu.util import telemetry
+
+    import numpy as np
+
+    tmp = tempfile.mkdtemp(prefix="chaos_bench_")
+    with open(os.path.join(tmp, "chaos_bench_mrfns.py"), "w") as f:
+        f.write(
+            "import jax.numpy as jnp\n"
+            "def stat(cols, mask):\n"
+            "    return {'s': jnp.sum(jnp.where(mask, cols['x'], 0.0)),\n"
+            "            'n': jnp.sum(mask.astype(jnp.float32))}\n")
+    sys.path.insert(0, tmp)
+    import chaos_bench_mrfns as mrfns
+
+    store = KeyedStore()
+    cloud = boot_node("chaos-bench", "cb-n0", hb_interval=0.1, store=store)
+    router = store.router
+    flat = os.path.join(tmp, "flatfile")
+    with open(flat, "w") as f:
+        f.write(f"{cloud.info.host}:{cloud.info.port}\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = tmp + os.pathsep + _HERE + os.pathsep + \
+        env.get("PYTHONPATH", "")
+    children = {}
+    for name in ("cb-n1", "cb-n2"):
+        children[name] = subprocess.Popen(
+            [sys.executable, "-m", "h2o3_tpu.cluster.nodeproc",
+             "--cluster-name", "chaos-bench", "--node-name", name,
+             "--flatfile", flat, "--hb-interval", "0.1"],
+            stdin=subprocess.PIPE, stdout=subprocess.DEVNULL,
+            stderr=subprocess.STDOUT, cwd=tmp, env=env)
+    try:
+        t_form = time.perf_counter()
+        t0 = time.time()
+        while time.time() - t0 < 60:
+            if cloud.size() == 3 and cloud.consensus():
+                break
+            time.sleep(0.02)
+        else:
+            raise RuntimeError("3-node chaos-bench cloud never formed")
+        formation_s = time.perf_counter() - t_form
+
+        victim = "cb-n2"
+        keys = {f"chaos-bench/k{i}": [i, i * 2] for i in range(32)}
+        for k, v in sorted(keys.items()):
+            store.put(k, v, replicas=3)
+        victim_keys = [k for k in sorted(keys)
+                       if router.home_name(k) == victim]
+
+        cols = {"x": (np.arange(30011) % 97).astype(np.float32)}
+        baseline = ctasks.distributed_map_reduce(
+            mrfns.stat, cols, cloud=None)
+        healthy = []
+        for _ in range(3):
+            t = time.perf_counter()
+            out = ctasks.distributed_map_reduce(mrfns.stat, cols,
+                                                cloud=cloud)
+            healthy.append(time.perf_counter() - t)
+        assert float(out["s"]) == float(baseline["s"])
+        healthy_s = sorted(healthy)[1]  # median of 3
+
+        # -- nemesis: SIGKILL one child, then price the recovery paths
+        children[victim].send_signal(_signal.SIGKILL)
+        children[victim].wait(timeout=10)
+        t_kill = time.perf_counter()
+
+        first_read_us = None
+        readable = 0
+        for k in victim_keys + [k for k in sorted(keys)
+                                if k not in victim_keys]:
+            t = time.perf_counter()
+            ok = store.get(k) == keys[k]
+            dt = time.perf_counter() - t
+            readable += bool(ok)
+            if first_read_us is None and k in victim_keys:
+                first_read_us = round(dt * 1e6, 1)
+
+        t = time.perf_counter()
+        recovered = ctasks.distributed_map_reduce(mrfns.stat, cols,
+                                                  cloud=cloud)
+        recovered_s = time.perf_counter() - t
+        bit_identical = (float(recovered["s"]) == float(baseline["s"])
+                         and float(recovered["n"]) == float(baseline["n"]))
+
+        while time.time() - t0 < 120:
+            if cloud.size() == 2:
+                break
+            time.sleep(0.02)
+        reconverge_s = time.perf_counter() - t_kill
+
+        tel = {k: v for k, v in telemetry.REGISTRY.summary().items()
+               if k.startswith(("cluster_fanout", "cluster_dkv",
+                                "cluster_removals", "rpc_retries"))}
+        result = {
+            "metric": "chaos_reconverge_seconds",
+            "value": round(reconverge_s, 3),
+            "unit": ("seconds from SIGKILL to survivor membership "
+                     "(3->2 nodes, hb 0.1s)"),
+            "vs_baseline": round(recovered_s / max(healthy_s, 1e-9), 2),
+            "detail": {
+                "host_cpus": os.cpu_count(),
+                "platform": platform.platform(),
+                "formation_s": round(formation_s, 3),
+                "mr_healthy_p50_s": round(healthy_s, 4),
+                "mr_recovered_s": round(recovered_s, 4),
+                "mr_recovered_bit_identical": bit_identical,
+                "keys_replicated": len(keys),
+                "keys_homed_on_victim": len(victim_keys),
+                "keys_readable_after_kill": readable,
+                "first_victim_key_read_us": first_read_us,
+                "vs_baseline_is": "recovered map_reduce / healthy p50",
+            },
+            "telemetry": {k: (round(v, 3) if isinstance(v, float) else v)
+                          for k, v in tel.items()},
+        }
+        with open(os.path.join(_HERE, "CHAOS_BENCH.json"), "w") as f:
+            json.dump(result, f, indent=1)
+        print(json.dumps(result))
+    finally:
+        for child in children.values():
+            try:
+                child.stdin.close()
+                child.wait(timeout=10)
+            except Exception:
+                child.kill()
+        cloud.stop()
+        set_local_cloud(None)
+
+
 def main() -> None:
     t_start = time.time()
     # two probe attempts: a single transient tunnel blip (one-off
@@ -805,5 +956,7 @@ if __name__ == "__main__":
         _parse_bench()
     elif "--cluster-bench" in sys.argv:
         _cluster_bench()
+    elif "--chaos-bench" in sys.argv:
+        _chaos_bench()
     else:
         main()

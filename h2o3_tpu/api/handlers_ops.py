@@ -679,6 +679,37 @@ def register(r: RequestServer, server: H2OServer) -> None:  # noqa: C901
                 "replicas": homes[1:],
                 "local": router.is_home(key)}
 
+    def _faults_mod():
+        """The chaos plane's REST half — 403 unless the process opted in
+        (H2O3_TPU_FAULTS=1 / a fault-plan env): nemesis scripts steer a
+        test node, production nodes refuse the surface outright."""
+        from h2o3_tpu.cluster import faults
+
+        if not faults.surface_enabled():
+            raise RestError(
+                403, "fault injection disabled (set H2O3_TPU_FAULTS=1)")
+        return faults
+
+    def faults_get(params):
+        faults = _faults_mod()
+        plan = faults.active_plan()
+        return {"plan": plan.to_dict() if plan is not None else None,
+                "hits": plan.hits() if plan is not None else []}
+
+    def faults_set(params):
+        faults = _faults_mod()
+        try:
+            plan = faults.plan_from_dict(params or {})
+        except (TypeError, ValueError) as e:
+            raise RestError(400, f"bad fault plan: {e}")
+        faults.set_plan(plan)
+        return {"installed": True, "seed": plan.seed,
+                "rules": len(plan.rules)}
+
+    def faults_clear(params):
+        _faults_mod().clear_plan()
+        return {"cleared": True}
+
     def log_and_echo(params):
         from h2o3_tpu.util.log import get_logger
 
@@ -846,6 +877,12 @@ def register(r: RequestServer, server: H2OServer) -> None:  # noqa: C901
                "store a JSON value (routed to its home node)")
     r.register("GET", "/3/DKV/{key}/home", dkv_home,
                "key home + replica placement")
+    r.register("GET", "/3/Faults", faults_get,
+               "active fault plan + per-rule hit counts (test-only)")
+    r.register("POST", "/3/Faults", faults_set,
+               "install a fault plan on this node (test-only)")
+    r.register("DELETE", "/3/Faults", faults_clear,
+               "clear the active fault plan (test-only)")
     r.register("POST", "/3/LogAndEcho", log_and_echo, "log a message")
     r.register("GET", "/3/KillMinus3", kill_minus_3,
                "dump thread stacks to the log")

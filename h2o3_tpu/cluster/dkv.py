@@ -24,6 +24,7 @@ from __future__ import annotations
 import bisect
 import hashlib
 import threading
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -36,6 +37,17 @@ _FORWARDS = telemetry.counter(
     "cluster_dkv_forwards_total",
     "DKV operations forwarded to / served for another node",
     labels=("op", "direction"),
+)
+_READ_REPAIR = telemetry.counter(
+    "cluster_dkv_read_repair_total",
+    "gets served from a ring successor and re-put to the current home "
+    "(the key re-homes on read after its home died)",
+)
+_SWEEP = telemetry.counter(
+    "cluster_dkv_replica_sweep_total",
+    "replica anti-entropy sweep outcomes, by action (promoted/reaped/"
+    "kept/adopted/reseeded/rehomed/restored)",
+    labels=("action",),
 )
 
 #: virtual nodes per member on the hash ring — enough that key load
@@ -106,19 +118,43 @@ class DkvRouter:
     #: per-op RPC timeout — DKV values can be whole frames
     TIMEOUT = 60.0
 
+    #: keys one anti-entropy sweep pass may touch — bounded so the
+    #: heartbeat cadence it piggybacks on never stalls behind a big store
+    SWEEP_BATCH = 16
+
     def __init__(self, cloud: Cloud, store) -> None:
         self.cloud = cloud
         self.store = store
         self._ring_lock = threading.Lock()
         self._ring: Optional[HashRing] = None
         self._ring_key: Optional[Tuple[str, ...]] = None
-        #: keys THIS node (as home) fanned replica copies out for — the
-        #: home performed the replication, so only it knows which keys
-        #: need a successor reap on remove (set ops are GIL-atomic)
-        self._replicated: set = set()
+        #: key -> replica depth THIS node (as home) fanned copies out
+        #: for — the home performed the replication, so only it knows
+        #: which keys need a successor reap on remove and a re-seed
+        #: after membership churn (dict ops are GIL-atomic)
+        self._replicated: Dict[str, int] = {}
+        #: keys THIS node holds as a ring successor's replica copy —
+        #: the sweep validates each against the key's CURRENT home, so
+        #: a copy whose home died between replicate and remove is
+        #: reaped instead of leaking until the holder churns
+        self._replica_copies: set = set()
+        #: sweep cursor state: pending holder-side checks + the ring
+        #: generation the home-side re-seed last ran against
+        self._sweep_queue: List[str] = []
+        self._reseed_pending: set = set()
+        self._swept_ring: Optional[Tuple[str, ...]] = None
+        #: keys this node served a remove for (bounded FIFO) — the
+        #: holders' sweep uses it to tell "the key WAS removed" (reap
+        #: the copy) from "the home never had it / restarted empty"
+        #: (restore the copy to the home); without the distinction a
+        #: home that rejoins empty would get its keys' last surviving
+        #: replicas reaped instead of re-seeded
+        self._removed: "OrderedDict[str, None]" = OrderedDict()
         cloud.rpc_server.register("dkv_put", self._serve_put)
         cloud.rpc_server.register("dkv_get", self._serve_get)
         cloud.rpc_server.register("dkv_remove", self._serve_remove)
+        cloud.rpc_server.register("dkv_replica_check",
+                                  self._serve_replica_check)
 
     # -- ring ----------------------------------------------------------------
     def _members(self) -> List[Member]:
@@ -180,14 +216,32 @@ class DkvRouter:
 
     def remote_get(self, key: str, default: Any = None) -> Any:
         """Ask the home; if it is unreachable, fall through the ring
-        successors (where replica copies live) before giving up."""
+        successors (where replica copies live) before giving up.  A
+        value served by a successor triggers READ-REPAIR: it is re-put
+        to the HOME-ELECT (the shallowest candidate that is still
+        reachable), so the key re-homes on its first read after the
+        home died — within the suspicion window, before membership
+        churn rebuilds the ring."""
         first_err: Optional[_rpc.RPCError] = None
-        for m in self.home_members(key, MAX_REPLICAS):
+        candidates = self.home_members(key, MAX_REPLICAS)
+        #: shallowest candidate that answered but lacks the key — the
+        #: node the ring will route to once the dead home is removed,
+        #: and therefore where a successor-served value must re-home
+        elect: Optional[int] = None
+        for j, m in enumerate(candidates):
             if m.info.name == self.cloud.info.name:
                 sentinel = object()
                 v = self.store.get(key, sentinel, _local=True)
                 if v is not sentinel:
+                    if j > 0:
+                        self._read_repair(key, v, m if elect is None
+                                          else candidates[elect])
                     return v
+                if elect is None:
+                    # a local miss AT the home position (j == 0) still
+                    # elects this node: it is where the key re-homes
+                    # (the just-rejoined-empty-home case)
+                    elect = j
                 continue
             _FORWARDS.inc(op="get", direction="sent")
             try:
@@ -202,10 +256,23 @@ class DkvRouter:
                     first_err = e
                 continue  # fall through to the next ring candidate
             if resp.get("found"):
-                return resp.get("value")
-            # the home answered: absent is authoritative for the RING —
-            # but a pre-join local copy is still the caller's data
-            return self._local_fallback(key, default)
+                v = resp.get("value")
+                if j > 0:
+                    # every candidate shallower than the elect was
+                    # unreachable; no elect means the serving holder
+                    # itself is next in line — promote its copy
+                    self._read_repair(key, v, m if elect is None
+                                      else candidates[elect])
+                return v
+            if j == 0:
+                # the HOME answered: absent is authoritative for the
+                # RING — but a pre-join local copy is still the
+                # caller's data
+                return self._local_fallback(key, default)
+            # a successor answered "absent": not authoritative — a
+            # deeper replica may still hold the only surviving copy
+            if elect is None:
+                elect = j
         sentinel = object()
         v = self.store.get(key, sentinel, _local=True)
         if v is not sentinel:
@@ -213,6 +280,33 @@ class DkvRouter:
         if first_err is not None:
             raise first_err
         return default
+
+    def _read_repair(self, key: str, value: Any, target: Member) -> None:
+        """Re-home a replica-served value onto the home-elect (the
+        shallowest REACHABLE ring candidate — the dead home ahead of it
+        cannot take the put).  When the elect is this node or the
+        serving holder itself, the copy is promoted to an
+        authoritative, tracked one so the key keeps its replica depth.
+        Best-effort: the surviving copy keeps serving reads even if
+        the repair put fails."""
+        if not self.routes_value(value):
+            return
+        try:
+            if target.info.name == self.cloud.info.name:
+                self.store.put(key, value, _local=True)
+                self._replica_copies.discard(key)
+                self._replicated.setdefault(key, 2)
+                self.replicate(key, value, self._replicated[key])
+            else:
+                _FORWARDS.inc(op="put", direction="sent")
+                self.cloud.client.call(
+                    target.info.addr, "dkv_put",
+                    {"key": key, "value": value, "replicas": 2},
+                    timeout=self.TIMEOUT, target=target.info.ident,
+                    retries=1)
+        except _rpc.RPCError:
+            return
+        _READ_REPAIR.inc()
 
     def remote_remove(self, key: str) -> None:
         """Removal routes to the key's HOME only; the home — which
@@ -222,6 +316,7 @@ class DkvRouter:
         temp keys) thus costs at most one RPC, zero when we are home."""
         homes = self.home_members(key, 1)
         if not homes or homes[0].info.name == self.cloud.info.name:
+            self._mark_removed(key)
             self._reap_replicas(key)
             return
         m = homes[0]
@@ -242,13 +337,14 @@ class DkvRouter:
 
     def _reap_replicas(self, key: str) -> None:
         """Home-side: remove successor copies IF this home fanned any.
-        A home that died between replicate and remove leaks its replica
-        copies until their holders churn — acceptable for best-effort
-        metadata replicas; the alternative (broadcast every remove) cost
-        every sweep a retry ladder against any dying member."""
+        A home that died between replicate and remove no longer leaks
+        its replica copies forever: the holders' anti-entropy sweep
+        (:meth:`sweep_replicas`) checks each copy against the key's
+        CURRENT home and reaps copies the home does not hold."""
         if key not in self._replicated:
             return
-        self._replicated.discard(key)
+        self._replicated.pop(key, None)
+        self._reseed_pending.discard(key)
         for m in self.home_members(key, MAX_REPLICAS)[1:]:
             if m.info.name == self.cloud.info.name:
                 continue
@@ -266,7 +362,8 @@ class DkvRouter:
         for m in self.home_members(key, min(replicas, MAX_REPLICAS))[1:]:
             if m.info.name == self.cloud.info.name:
                 continue
-            self._replicated.add(key)  # a copy MAY land: reap on remove
+            # a copy MAY land: reap on remove, re-seed on ring churn
+            self._replicated[key] = int(replicas)
             _FORWARDS.inc(op="replicate", direction="sent")
             try:
                 self.cloud.client.call(
@@ -282,6 +379,9 @@ class DkvRouter:
         key = payload["key"]
         value = payload.get("value")
         if payload.get("replica_copy"):
+            # tag the copy: the sweep validates every tagged key against
+            # its current home, so an orphaned copy is reapable later
+            self._replica_copies.add(key)
             self.store.put(key, value, _local=True)
         else:
             # _local: this node answers AS the home — re-entering the
@@ -291,8 +391,14 @@ class DkvRouter:
             # rpc-worker thread per hop). Store locally, replicate
             # explicitly.
             self.store.put(key, value, _local=True)
+            # serving AS home supersedes any replica tag this node held
+            # for the key (e.g. a read-repair promoting the copy), and a
+            # replicated put is tracked even when every successor push
+            # is skipped — churn re-seeds ride the tracking
+            self._replica_copies.discard(key)
             replicas = int(payload.get("replicas", 1))
             if replicas > 1:
+                self._replicated[key] = replicas
                 self.replicate(key, value, replicas)
         return {"key": key, "home": self.cloud.info.name}
 
@@ -304,6 +410,12 @@ class DkvRouter:
             return {"found": False}
         return {"found": True, "value": v}
 
+    def _mark_removed(self, key: str) -> None:
+        self._removed[key] = None
+        self._removed.move_to_end(key)
+        while len(self._removed) > 4096:
+            self._removed.popitem(last=False)
+
     def _serve_remove(self, payload: Dict[str, Any]) -> Dict[str, Any]:
         _FORWARDS.inc(op="remove", direction="served")
         key = payload["key"]
@@ -311,9 +423,162 @@ class DkvRouter:
             self.store.remove(key, _local=True)
         except ValueError as e:  # Lockable: surface the lock holders
             raise _rpc.RpcFault(str(e), code=423)
-        if not payload.get("replica_copy"):
+        self._mark_removed(key)
+        if payload.get("replica_copy"):
+            self._replica_copies.discard(key)
+        else:
             self._reap_replicas(key)  # serving AS home: reap successors
         return {"removed": True}
+
+    def _serve_replica_check(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        """Home side of the holders' sweep: does this node (the key's
+        current home) hold the key?  Holding it without tracking it
+        (e.g. it arrived by read-repair before this node knew it was
+        home) adopts tracking, so the NEXT remove reaps successors."""
+        key = payload["key"]
+        sentinel = object()
+        v = self.store.get(key, sentinel, _local=True)
+        if v is sentinel:
+            # "removed" disambiguates for the holder: a key this home
+            # REMOVED is an orphan copy (reap it); a key this home
+            # simply never had (it restarted empty, or the arc just
+            # moved here) must be restored from the copy instead
+            return {"exists": False, "removed": key in self._removed}
+        if key not in self._replicated:
+            self._replicated[key] = 2
+            _SWEEP.inc(action="adopted")
+        return {"exists": True}
+
+    # -- anti-entropy sweep (piggybacked on the gossip cadence) ---------------
+    def sweep_replicas(self) -> None:
+        """One bounded anti-entropy pass, run once per gossip cycle.
+
+        Home side: after membership churn re-homes arcs, every key this
+        node tracked as home is either re-seeded onto its (possibly new)
+        successors, or — when this node is no longer the home — pushed
+        to the new home and demoted to a tagged replica copy.
+
+        Holder side: up to :data:`SWEEP_BATCH` tagged replica copies are
+        validated against the key's CURRENT ring home; a copy whose home
+        no longer holds the key is an orphan ("home died between
+        replicate and remove") and is reaped, a copy whose holder is now
+        the ring home is promoted to an authoritative, tracked copy."""
+        if not self.active():
+            return
+        ring, _by_ident = self._current_ring()
+        ring_key = tuple(ring.idents)
+        if ring_key != self._swept_ring:
+            self._swept_ring = ring_key
+            self._reseed_pending = set(self._replicated)
+        self._sweep_homes()
+        self._sweep_copies()
+
+    def _sweep_homes(self) -> None:
+        me = self.cloud.info.name
+        budget = self.SWEEP_BATCH
+        while budget > 0 and self._reseed_pending:
+            key = self._reseed_pending.pop()
+            budget -= 1
+            replicas = self._replicated.get(key)
+            if replicas is None:
+                continue  # removed since the ring changed
+            sentinel = object()
+            value = self.store.get(key, sentinel, _local=True)
+            if value is sentinel:
+                self._replicated.pop(key, None)
+                continue
+            homes = self.home_members(key, MAX_REPLICAS)
+            if not homes:
+                continue
+            if homes[0].info.name == me:
+                # still home: refresh copies onto the current successors
+                self.replicate(key, value, replicas)
+                _SWEEP.inc(action="reseeded")
+                continue
+            # the arc moved: push the value to the new home (which fans
+            # its own replicas) and demote our copy to a tagged replica
+            try:
+                _FORWARDS.inc(op="put", direction="sent")
+                self.cloud.client.call(
+                    homes[0].info.addr, "dkv_put",
+                    {"key": key, "value": value, "replicas": replicas},
+                    timeout=self.TIMEOUT, target=homes[0].info.ident,
+                    retries=1)
+            except _rpc.RPCError:
+                self._reseed_pending.add(key)  # retry next cycle
+                continue
+            self._replicated.pop(key, None)
+            self._replica_copies.add(key)
+            _SWEEP.inc(action="rehomed")
+
+    def _sweep_copies(self) -> None:
+        me = self.cloud.info.name
+        if not self._sweep_queue:
+            self._sweep_queue = list(self._replica_copies)
+        batch = 0
+        while batch < self.SWEEP_BATCH and self._sweep_queue:
+            key = self._sweep_queue.pop()
+            if key not in self._replica_copies:
+                continue
+            batch += 1
+            homes = self.home_members(key, MAX_REPLICAS)
+            names = [m.info.name for m in homes]
+            if not homes:
+                continue
+            if names[0] == me:
+                # this holder IS the home now: promote the copy to the
+                # authoritative one and fan fresh replicas
+                self._replica_copies.discard(key)
+                sentinel = object()
+                value = self.store.get(key, sentinel, _local=True)
+                if value is not sentinel:
+                    self._replicated.setdefault(key, 2)
+                    self.replicate(key, value, self._replicated[key])
+                _SWEEP.inc(action="promoted")
+                continue
+            if me in names[1:]:
+                # valid successor: keep iff the current home holds the
+                # key (an RPC failure keeps the copy — re-check next
+                # cycle rather than reap on a transient)
+                try:
+                    resp = self.cloud.client.call(
+                        homes[0].info.addr, "dkv_replica_check",
+                        {"key": key}, timeout=self.TIMEOUT,
+                        target=homes[0].info.ident, retries=1)
+                except _rpc.RPCError:
+                    continue
+                if resp.get("exists"):
+                    _SWEEP.inc(action="kept")
+                    continue
+                if not resp.get("removed"):
+                    # the home LACKS the key but never removed it — it
+                    # restarted empty or just inherited the arc; this
+                    # copy may be the last one alive, so restore it to
+                    # the home (which re-tracks and fans replicas)
+                    # instead of reaping
+                    sentinel = object()
+                    value = self.store.get(key, sentinel, _local=True)
+                    if value is not sentinel:
+                        try:
+                            _FORWARDS.inc(op="put", direction="sent")
+                            self.cloud.client.call(
+                                homes[0].info.addr, "dkv_put",
+                                {"key": key, "value": value,
+                                 "replicas": 2},
+                                timeout=self.TIMEOUT,
+                                target=homes[0].info.ident, retries=1)
+                            _SWEEP.inc(action="restored")
+                        except _rpc.RPCError:
+                            pass  # keep the copy; retry next cycle
+                        continue
+            # orphan: the home REMOVED the key (died between replicate
+            # and remove), or this node left the key's arc
+            self._replica_copies.discard(key)
+            try:
+                self.store.remove(key, _local=True)
+            except (KeyError, ValueError):
+                pass
+            _SWEEP.inc(action="reaped")
 
 
 def install(cloud: Cloud, store=None) -> DkvRouter:
@@ -323,4 +588,6 @@ def install(cloud: Cloud, store=None) -> DkvRouter:
         from h2o3_tpu.keyed import DKV as store  # noqa: N811
     router = DkvRouter(cloud, store)
     store.router = router
+    # anti-entropy rides the gossip cadence: one bounded sweep per cycle
+    cloud.add_cycle_hook(router.sweep_replicas)
     return router

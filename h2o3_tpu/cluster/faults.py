@@ -1,0 +1,238 @@
+"""Deterministic fault injection for the cluster stack — the chaos plane.
+
+A process holds at most one :class:`FaultPlan`: a seed plus an ordered
+list of :class:`FaultRule`\\ s.  The RPC layer consults the plan at two
+choke points — the client's attempt loop and the server's frame handler —
+so one small module can drop, delay, duplicate, reorder or black-hole
+frames, partition node pairs, and crash a process on demand, without any
+of those layers knowing more than "ask the plan".
+
+Determinism is the contract that makes chaos scenarios assertable:
+
+* count-based rules (``after``/``max_hits``) fire on exact match ordinals,
+  independent of wall clock;
+* probabilistic rules (``p < 1``) and sampled delays draw from a per-rule
+  PRNG derived from ``(seed, rule_index)``, so two runs of a
+  single-threaded workload under the same plan inject the same faults;
+* the plan's shared PRNG also seeds the RPC retry ladder's full-jitter
+  backoff, so even retry spacing replays under a fixed seed.
+
+Rules are matched first-wins in list order.  Action semantics:
+
+``drop``
+    client side: the attempt fails with ``ConnectionError`` before any
+    bytes move (a request frame lost in flight); server side: the method
+    EXECUTES but the response frame is discarded and the connection
+    closed — the classic lost-ack that forces the caller's retry through
+    the idempotency memo.
+``black_hole``
+    client side: the attempt raises ``socket.timeout`` immediately —
+    models a peer that swallows frames without consuming the caller's
+    real wall clock; server side: same as ``drop``.
+``partition``
+    directional client-side ``drop`` matched on (src, dst) — two rules
+    with swapped ends make a symmetric partition, one rule makes the
+    asymmetric half.
+``delay``
+    sleep ``delay_ms`` before the attempt (client) or before dispatch
+    (server) — the slow-node ladder.
+``reorder``
+    sleep a per-rule-PRNG uniform draw in ``[0, delay_ms]`` — concurrent
+    frames overtake each other, which is how a FIFO-per-connection
+    transport exhibits reordering.
+``duplicate``
+    client side only: after a successful attempt the SAME envelope (same
+    idempotency token) is sent again — the server's dedup memo must make
+    the duplicate invisible.
+``crash``
+    ``os._exit(137)`` — a SIGKILL-shaped death, no finalizers.
+
+Enablement: :func:`install_from_env` reads ``H2O3_TPU_FAULT_PLAN`` (a
+JSON plan, or ``@/path/to/plan.json``) at node boot; the test-only
+RPC/REST nemesis surface registers when :func:`surface_enabled` — env
+``H2O3_TPU_FAULTS=1`` or a plan env present.  Production processes set
+neither and pay one ``is None`` check per consult point.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import json
+import os
+import random
+import threading
+from typing import Any, Dict, List, Optional
+
+from h2o3_tpu.util import telemetry
+
+_INJECTED = telemetry.counter(
+    "cluster_faults_injected_total",
+    "faults the active FaultPlan injected, by action",
+    labels=("action",),
+)
+
+#: every action a rule may carry (validated at plan build, not at match)
+ACTIONS = ("drop", "delay", "duplicate", "reorder", "black_hole",
+           "partition", "crash")
+
+#: sides a rule can bind to — the consult points in rpc.py
+SIDES = ("client", "server")
+
+
+@dataclasses.dataclass
+class FaultRule:
+    """One match-and-inject rule.  Globs (`fnmatch`) match the injecting
+    node's name (``src``), the call target ident/address (``dst``) and
+    the RPC method name."""
+
+    action: str
+    side: str = "client"
+    src: str = "*"
+    dst: str = "*"
+    method: str = "*"
+    #: probability a matching event injects (drawn from the rule's PRNG)
+    p: float = 1.0
+    #: skip the first N matching events (count-based scheduling)
+    after: int = 0
+    #: stop injecting after N hits; 0 = unlimited
+    max_hits: int = 0
+    #: delay/reorder magnitude
+    delay_ms: float = 0.0
+
+    def __post_init__(self) -> None:
+        if self.action not in ACTIONS:
+            raise ValueError(
+                f"unknown fault action {self.action!r}; one of {ACTIONS}")
+        if self.side not in SIDES:
+            raise ValueError(
+                f"unknown fault side {self.side!r}; one of {SIDES}")
+
+
+@dataclasses.dataclass(frozen=True)
+class Directive:
+    """What a consult point must do: the matched action plus a resolved
+    delay in seconds (already sampled for ``reorder``)."""
+
+    action: str
+    delay_s: float = 0.0
+
+
+_RULE_FIELDS = {f.name for f in dataclasses.fields(FaultRule)}
+
+
+class FaultPlan:
+    """Seeded, counter-tracked rule set; one per process at most."""
+
+    def __init__(self, seed: int = 0,
+                 rules: Optional[List[FaultRule]] = None) -> None:
+        self.seed = int(seed)
+        self.rules: List[FaultRule] = list(rules or [])
+        self._lock = threading.Lock()
+        #: shared PRNG — backoff jitter rides it so retry spacing replays
+        self.rng = random.Random(self.seed)
+        #: per-rule PRNGs: rule i's draws depend only on (seed, i) and
+        #: its own match ordinal, never on other rules' traffic
+        self._rngs = [random.Random((self.seed << 16) ^ i)
+                      for i in range(len(self.rules))]
+        self._matches = [0] * len(self.rules)
+        self._hits = [0] * len(self.rules)
+
+    def consult(self, side: str, src: str, dst: str,
+                method: str) -> Optional[Directive]:
+        """First matching rule that fires, as a :class:`Directive`."""
+        for i, r in enumerate(self.rules):
+            if r.side != side:
+                continue
+            if not (fnmatch.fnmatch(src or "", r.src)
+                    and fnmatch.fnmatch(dst or "", r.dst)
+                    and fnmatch.fnmatch(method or "", r.method)):
+                continue
+            with self._lock:
+                self._matches[i] += 1
+                if self._matches[i] <= r.after:
+                    continue
+                if r.max_hits and self._hits[i] >= r.max_hits:
+                    continue
+                if r.p < 1.0 and self._rngs[i].random() >= r.p:
+                    continue
+                self._hits[i] += 1
+                delay = r.delay_ms / 1000.0
+                if r.action == "reorder":
+                    delay = self._rngs[i].uniform(0.0, delay)
+            _INJECTED.inc(action=r.action)
+            return Directive(r.action, delay)
+        return None
+
+    def hits(self) -> List[int]:
+        """Per-rule injection counts (a nemesis asserts its faults LANDED
+        — a scenario whose rules never fired proves nothing)."""
+        with self._lock:
+            return list(self._hits)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"seed": self.seed,
+                "rules": [dataclasses.asdict(r) for r in self.rules]}
+
+
+def plan_from_dict(d: Dict[str, Any]) -> FaultPlan:
+    """Build a plan from its JSON shape; unknown rule fields are ignored
+    so a newer nemesis script can drive an older node."""
+    rules = [
+        FaultRule(**{k: v for k, v in r.items() if k in _RULE_FIELDS})
+        for r in d.get("rules", [])
+    ]
+    return FaultPlan(seed=int(d.get("seed", 0)), rules=rules)
+
+
+# ---------------------------------------------------------------------------
+# process-wide singleton + enablement
+
+_PLAN: Optional[FaultPlan] = None
+#: jitter source when no plan is active (unseeded: production spread)
+_BACKOFF_RNG = random.Random()
+
+
+def active_plan() -> Optional[FaultPlan]:
+    return _PLAN
+
+
+def set_plan(plan: Optional[FaultPlan]) -> None:
+    global _PLAN
+    _PLAN = plan
+
+
+def clear_plan() -> None:
+    set_plan(None)
+
+
+def backoff_rng() -> random.Random:
+    """The retry ladder's jitter source: the active plan's seeded PRNG
+    under chaos (deterministic spacing), a plain Random otherwise."""
+    plan = _PLAN
+    return plan.rng if plan is not None else _BACKOFF_RNG
+
+
+def surface_enabled() -> bool:
+    """Whether the test-only nemesis RPC/REST surface may register."""
+    return (os.environ.get("H2O3_TPU_FAULTS") == "1"
+            or bool(os.environ.get("H2O3_TPU_FAULT_PLAN")))
+
+
+def install_from_env() -> Optional[FaultPlan]:
+    """Install the plan ``H2O3_TPU_FAULT_PLAN`` describes (inline JSON or
+    ``@/path``); returns it, or None when the env is unset."""
+    spec = os.environ.get("H2O3_TPU_FAULT_PLAN", "").strip()
+    if not spec:
+        return None
+    if spec.startswith("@"):
+        with open(spec[1:]) as f:
+            spec = f.read()
+    plan = plan_from_dict(json.loads(spec))
+    set_plan(plan)
+    return plan
+
+
+def crash_now(code: int = 137) -> None:
+    """SIGKILL-shaped death: no atexit, no flush, no goodbye frame."""
+    os._exit(code)

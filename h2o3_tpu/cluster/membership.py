@@ -34,6 +34,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from h2o3_tpu.cluster import faults as _faults
 from h2o3_tpu.cluster import rpc as _rpc
 from h2o3_tpu.util import telemetry
 
@@ -51,6 +52,10 @@ _SUSPICIONS = telemetry.counter(
     "cluster_suspicions_total", "members marked suspect (missed beats)")
 _REMOVALS = telemetry.counter(
     "cluster_removals_total", "members removed from the cloud")
+_REJOINS = telemetry.counter(
+    "cluster_rejoins_total",
+    "fenced members that completed the 410 -> rejoin handshake and "
+    "re-entered the cloud")
 _SCRAPE_ERRORS = telemetry.counter(
     "metrics_scrape_errors_total",
     "cluster-wide metric/timeline scrapes that could not reach a member "
@@ -224,6 +229,8 @@ class Cloud:
         self._needs_rejoin = False
         self._stopping = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None
+        #: per-gossip-cycle callbacks (bounded anti-entropy piggybacks)
+        self._cycle_hooks: List[Any] = []
         self.rpc_server.register("heartbeat", self._on_heartbeat)
         self.rpc_server.register("ping", lambda p: {
             "pong": True, "name": self.info.name})
@@ -241,6 +248,8 @@ class Cloud:
             "consensus": self.consensus(),
             "size": self.size(),
         })
+        if _faults.surface_enabled():
+            self.enable_fault_surface()
         _CLUSTER_SIZE.set(1)
         _CLUSTER_VERSION.set(self.version)
 
@@ -275,6 +284,45 @@ class Cloud:
     def local_member(self) -> Member:
         with self._lock:
             return self._members[self.info.name]
+
+    def add_cycle_hook(self, fn) -> None:
+        """Run ``fn()`` once per gossip cycle, after suspicion/consensus
+        — the piggyback point for bounded anti-entropy work (the DKV
+        replica sweep rides it).  A hook that raises is logged and kept;
+        it must never kill the heartbeat loop."""
+        self._cycle_hooks.append(fn)
+
+    def enable_fault_surface(self) -> None:
+        """Register the test-only nemesis RPC methods so multi-process
+        chaos harnesses can script faults on (and crash) a live node.
+        Called automatically when ``H2O3_TPU_FAULTS=1`` or a fault-plan
+        env is present; never in production boots."""
+        def _set(p: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+            plan = _faults.plan_from_dict(p or {})
+            _faults.set_plan(plan)
+            return {"installed": True, "seed": plan.seed,
+                    "rules": len(plan.rules)}
+
+        def _get(p: Any) -> Dict[str, Any]:
+            plan = _faults.active_plan()
+            return {"plan": plan.to_dict() if plan is not None else None,
+                    "hits": plan.hits() if plan is not None else []}
+
+        def _clear(p: Any) -> Dict[str, Any]:
+            _faults.clear_plan()
+            return {"cleared": True}
+
+        def _crash(p: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+            # ack first, die a beat later: the nemesis learns its kill
+            # LANDED rather than inferring it from a connection error
+            delay = float((p or {}).get("delay_s", 0.05))
+            threading.Timer(delay, _faults.crash_now).start()
+            return {"crashing": True, "delay_s": delay}
+
+        self.rpc_server.register("fault_plan_set", _set)
+        self.rpc_server.register("fault_plan_get", _get)
+        self.rpc_server.register("fault_plan_clear", _clear)
+        self.rpc_server.register("fault_crash", _crash)
 
     def advertise_rest_port(self, port: int) -> None:
         """Publish this node's REST port into its member info (gossip
@@ -519,6 +567,10 @@ class Cloud:
             if changed or peer_version > self.version:
                 self.version = max(self.version, peer_version) + (
                     1 if changed else 0)
+            if self._needs_rejoin:
+                # a fenced epoch just got acknowledged end-to-end: the
+                # peer accepted our rejoin beat at the current version
+                _REJOINS.inc()
             self._needs_rejoin = False
 
     def _beat_quietly(self, addr: Tuple[str, int]) -> None:
@@ -564,6 +616,14 @@ class Cloud:
             self._check_suspicion()
             self.consensus()
             self._publish_gauges()
+            for hook in list(self._cycle_hooks):
+                try:
+                    hook()
+                except Exception:  # noqa: BLE001 — hooks never kill gossip
+                    from h2o3_tpu.util.log import get_logger
+
+                    get_logger("cluster").warning(
+                        "gossip cycle hook %r failed", hook, exc_info=True)
 
     def _adopt_fence(self, e: "_rpc.RemoteError") -> None:
         """A 410 fence carries the cloud's current version: adopt it and
@@ -740,6 +800,9 @@ def boot_node(
     from h2o3_tpu.cluster import dkv as _dkv
     from h2o3_tpu.cluster import tasks as _tasks
 
+    # a plan shipped via H2O3_TPU_FAULT_PLAN must be live before the
+    # first join beat — chaos scenarios fault the join itself
+    _faults.install_from_env()
     cloud = Cloud(cloud_name, node_name, host=host, port=port,
                   client=client, hb_interval=hb_interval)
     # declare the process's trace identity: every timeline event this node

@@ -50,6 +50,7 @@ import uuid
 from collections import OrderedDict
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from h2o3_tpu.cluster import faults as _faults
 from h2o3_tpu.cluster import transport
 from h2o3_tpu.util import telemetry
 
@@ -295,17 +296,48 @@ class RpcClient:
         t0 = time.perf_counter()
         last_exc: Optional[BaseException] = None
         timed_out = False
+        plan = _faults.active_plan()
         _INFLIGHT_CLIENT.inc()
         try:
             for attempt in range(ladder + 1):
                 if attempt:
                     _RPC_RETRIES.inc()
-                    time.sleep(min(
+                    # FULL-jitter backoff, U(0, min(cap, base*2^(a-1))):
+                    # N callers retrying against one recovering member
+                    # spread out instead of re-converging into a
+                    # thundering herd each doubling; under an active
+                    # fault plan the draw comes from its seeded PRNG so
+                    # chaos runs replay their retry spacing
+                    time.sleep(_faults.backoff_rng().uniform(0.0, min(
                         self.backoff_base * (2 ** (attempt - 1)),
                         self.backoff_max,
-                    ))
+                    )))
+                fd = None if plan is None else plan.consult(
+                    "client", self.node_name, target, method)
                 try:
+                    if fd is not None:
+                        if fd.action in ("drop", "partition"):
+                            raise ConnectionError(
+                                f"fault-injected {fd.action}: "
+                                f"{method} -> {target}")
+                        if fd.action == "black_hole":
+                            # models a frame-swallowing peer without
+                            # consuming the attempt's real wall clock
+                            raise socket.timeout(
+                                f"fault-injected black_hole: "
+                                f"{method} -> {target}")
+                        if fd.action == "crash":
+                            _faults.crash_now()
+                        if fd.delay_s > 0.0:
+                            time.sleep(fd.delay_s)
                     raw = _one_attempt(attempt)
+                    if fd is not None and fd.action == "duplicate":
+                        # re-send the SAME envelope (same token): the
+                        # server's dedup memo must absorb it
+                        try:
+                            _one_attempt(attempt)
+                        except (socket.timeout, ConnectionError, OSError):
+                            pass
                 except socket.timeout as e:
                     timed_out = True
                     last_exc = e
@@ -486,7 +518,7 @@ class RpcServer:
                 return  # every old entry is in flight: protected
             self._seen_bytes -= len(self._seen.pop(victim)[1])
 
-    def _handle(self, raw: bytes) -> bytes:
+    def _handle(self, raw: bytes) -> Optional[bytes]:
         try:
             req = pickle.loads(raw)
             token = req["id"]
@@ -496,6 +528,14 @@ class RpcServer:
                 "type": type(e).__name__, "msg": f"bad request frame: {e}",
                 "code": 400,
             }})
+        plan = _faults.active_plan()
+        fd = None if plan is None else plan.consult(
+            "server", self.node_name, "", method)
+        if fd is not None:
+            if fd.action == "crash":
+                _faults.crash_now()
+            if fd.delay_s > 0.0:
+                time.sleep(fd.delay_s)
         _INFLIGHT_SERVER.inc()
         try:
             with self._lock:
@@ -525,6 +565,12 @@ class RpcServer:
                     self._seen[token] = (event, response)
                     self._seen_bytes += len(response)
             event.set()
+            if fd is not None and fd.action in ("drop", "black_hole"):
+                # server-side drop is a LOST RESPONSE: the method ran and
+                # its result is memoized; returning None makes the
+                # transport close the connection unreplied, so the
+                # caller's retry must come back through the dedup memo
+                return None
             return response
         finally:
             _INFLIGHT_SERVER.dec()

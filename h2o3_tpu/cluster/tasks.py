@@ -38,6 +38,13 @@ _TASKS_METER = telemetry.counter(
 )
 _FANOUT = telemetry.gauge(
     "cluster_task_fanout", "members the most recent fan-out spanned")
+_RECOVERED = telemetry.counter(
+    "cluster_fanout_recovered_total",
+    "fan-out work units re-run after a member failure: path=survivor "
+    "rescheduled onto another live member, path=local fell back to the "
+    "caller (the last resort)",
+    labels=("path",),
+)
 
 #: name -> handler; a task must be registered on every node of the cloud
 #: (one codebase per cloud), like DTask classes on the shared classpath
@@ -159,9 +166,14 @@ def distributed_map_reduce(
 
     ``fn`` must be importable on every member (module-level, one shared
     codebase) — a closure raises immediately rather than failing remotely.
-    Falls back to plain local execution when no multi-node cloud is live,
-    and re-runs a failed member's range locally (the caller IS the reduce
-    point, so a lost member costs latency, not the answer).
+    Falls back to plain local execution when no multi-node cloud is live.
+
+    SELF-healing, not caller-healing: a failed member's range is first
+    rescheduled onto the surviving members (canonical order, starting at
+    the failed member's ring neighbor) so the cluster — not the caller —
+    absorbs the loss; the caller re-runs a range locally only as the
+    last resort.  ``cluster_fanout_recovered_total{path}`` distinguishes
+    the two.
     """
     if reduce not in _COMBINE:
         raise ValueError(
@@ -187,6 +199,29 @@ def distributed_map_reduce(
     _FANOUT.set(k)
     partials: List[Any] = [None] * k
     errors: List[Optional[Exception]] = [None] * k
+    #: members whose submit failed — later reschedules skip them (set
+    #: mutations are GIL-atomic; worst case a race costs one wasted RPC)
+    failed: set = set()
+
+    def _reschedule(i: int, part: Dict[str, np.ndarray]) -> Any:
+        """Re-run range ``i`` on a surviving member; caller-local only
+        when every survivor is gone or also fails."""
+        for step in range(1, k):
+            m2 = workers[(i + step) % k]
+            if (m2.info.name in failed
+                    or m2.info.name == cloud.info.name
+                    or not m2.healthy):
+                continue
+            try:
+                out = submit(cloud, m2, "mr_shard",
+                             {"fn": fn, "columns": part, "reduce": reduce},
+                             timeout=timeout)
+                _RECOVERED.inc(path="survivor")
+                return out
+            except _rpc.RPCError:
+                failed.add(m2.info.name)
+        _RECOVERED.inc(path="local")
+        return _mr_shard_local(fn, part, reduce)
 
     # one span covers the whole fan-out; its context is captured and handed
     # to every worker thread (spans are thread-local, so without the explicit
@@ -217,7 +252,8 @@ def distributed_map_reduce(
                             timeout=timeout)
                 except _rpc.RPCError as e:
                     errors[i] = e
-                    partials[i] = _mr_shard_local(fn, part, reduce)  # recover
+                    failed.add(member.info.name)
+                    partials[i] = _reschedule(i, part)
 
         threads = [threading.Thread(target=_run, args=(i, m), daemon=True)
                    for i, m in enumerate(workers)]
@@ -238,9 +274,13 @@ def distributed_map_reduce(
                 continue
             p = partials[i]
             if p is None:
+                # the member never answered inside the deadline: the
+                # fan-out already consumed its full timeout, so the last
+                # resort (caller-local) is the only honest option left
                 part = {name: np.ascontiguousarray(arr[lo:hi])
                         for name, arr in columns.items()}
                 p = _mr_shard_local(fn, part, reduce)
+                _RECOVERED.inc(path="local")
                 recovered += 1
             parts.append(p)
         if recovered or any(e is not None for e in errors):
@@ -288,6 +328,27 @@ def distributed_parse_chunks(
         return _parse._reduce_chunks(results, setup)
     _FANOUT.set(len(workers))
     napack = _parse._pipeline_napack(setup)
+    failed: set = set()
+
+    def _recover_chunk(i: int, chunk: bytes, first: Member):
+        """Reschedule a failed chunk onto surviving members before the
+        caller-local last resort (mirrors distributed_map_reduce)."""
+        for step in range(1, len(workers)):
+            m2 = workers[(i + step) % len(workers)]
+            if (m2.info.name in failed
+                    or m2.info.name in (first.info.name, cloud.info.name)
+                    or not m2.healthy):
+                continue
+            try:
+                out = submit(cloud, m2, "parse_chunk",
+                             {"chunk": chunk, "setup": setup},
+                             timeout=timeout)
+                _RECOVERED.inc(path="survivor")
+                return out
+            except _rpc.RPCError:
+                failed.add(m2.info.name)
+        _RECOVERED.inc(path="local")
+        return _parse._parse_chunk(chunk, setup, na, napack)
 
     with telemetry.Span("distributed_parse", chunks=len(chunks),
                         members=len(workers)):
@@ -310,8 +371,8 @@ def distributed_parse_chunks(
                             {"chunk": chunk, "setup": setup},
                             timeout=timeout)
                 except _rpc.RPCError:
-                    results[i] = _parse._parse_chunk(  # recover locally
-                        chunk, setup, na, napack)
+                    failed.add(member.info.name)
+                    results[i] = _recover_chunk(i, chunk, member)
 
         # bounded fan-out: a couple of chunks in flight per member pipelines
         # the stream at constant memory — one thread (and one pickled copy
@@ -327,5 +388,6 @@ def distributed_parse_chunks(
         ex.shutdown(wait=False, cancel_futures=True)
         for i, r in enumerate(results):
             if r is None:  # member never answered in time: tokenize here
+                _RECOVERED.inc(path="local")
                 results[i] = _parse._parse_chunk(chunks[i], setup, na, napack)
         return _parse._reduce_chunks(results, setup)

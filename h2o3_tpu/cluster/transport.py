@@ -19,7 +19,7 @@ import socket
 import struct
 import threading
 import time
-from typing import Callable, Dict, List, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 #: frame header: payload byte length, network order
 _HEADER = struct.Struct("!I")
@@ -140,10 +140,12 @@ class ConnectionPool:
 class TransportServer:
     """Frame server: accept loop + one thread per connection, each frame
     handed to ``handler(payload) -> response`` and the response framed
-    back.  Binds port 0 by default — the resolved address is the node's
-    identity, published via flatfile/address-file rendezvous."""
+    back (a ``None`` response drops the connection unreplied — the
+    fault-injection hook for lost-response frames).  Binds port 0 by
+    default — the resolved address is the node's identity, published via
+    flatfile/address-file rendezvous."""
 
-    def __init__(self, handler: Callable[[bytes], bytes],
+    def __init__(self, handler: Callable[[bytes], Optional[bytes]],
                  host: str = "127.0.0.1", port: int = 0) -> None:
         self._handler = handler
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -181,7 +183,18 @@ class TransportServer:
         try:
             while not self._stopping.is_set():
                 payload = recv_frame(sock)
-                send_frame(sock, self._handler(payload))
+                if self._stopping.is_set():
+                    # a frame that raced stop() dies unreplied: a stopped
+                    # server must look crashed to its callers' pooled
+                    # sockets, not serve one parting frame each
+                    break
+                response = self._handler(payload)
+                if response is None:
+                    # the handler dropped the response (fault injection):
+                    # close unreplied so the caller sees a dead socket
+                    # immediately instead of hanging its full timeout
+                    break
+                send_frame(sock, response)
         except (ConnectionError, OSError):
             pass  # client went away: its pooled socket died with it
         finally:

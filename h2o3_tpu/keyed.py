@@ -335,14 +335,26 @@ class KeyedStore:
         if r is not None:
             return r.remote_get(key, default)
         _DKV_GETS.inc()
+        sentinel = object()
         with self._lock:
-            v = self._store.get(key, default)
+            v = self._store.get(key, sentinel)
             if not isinstance(v, _SpilledFrame):
-                if _frame_nbytes(v) > 0:
-                    self._tick += 1
-                    self._access[key] = self._tick
-                return v
-        return self._unspill(key, v)
+                if v is not sentinel:
+                    if _frame_nbytes(v) > 0:
+                        self._tick += 1
+                        self._access[key] = self._tick
+                    return v
+            else:
+                return self._unspill(key, v)
+        # local miss on a key THIS node homes: a replica successor may
+        # hold the only surviving copy (this node restarted empty and
+        # rejoined) — walk the ring before declaring it absent; the walk
+        # read-repairs the value back onto this home
+        if not _local:
+            r = self.router
+            if r is not None and r.active():
+                return r.remote_get(key, default)
+        return default
 
     def peek(self, key: str, default: Any = None) -> Any:
         """The stored value WITHOUT faulting a spilled frame back in —

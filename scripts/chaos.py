@@ -1,0 +1,631 @@
+#!/usr/bin/env python
+"""Jepsen-style chaos runner: workload + nemesis + invariant checks.
+
+Each scenario composes three pieces over the real cluster stack (no
+mocks — the same transport/RPC/gossip/DKV code production runs):
+
+* a **workload** — replicated DKV puts/gets, distributed map_reduce,
+  grid search — generating state whose correct value is known up front;
+* a **nemesis** — a seeded :mod:`h2o3_tpu.cluster.faults` plan (drops,
+  delays, duplicates, partitions) or a real ``SIGKILL`` on a child
+  process, driven through the test-only fault RPC surface;
+* **invariants** — boolean checks (bit-exact results, no false
+  removals, reconvergence, telemetry proof of the recovery path)
+  asserted after the dust settles.
+
+Verdicts are dicts of booleans ONLY — no timings, no counts — so two
+runs with the same ``--seed`` must produce byte-identical verdicts
+(the determinism contract ``tests/test_chaos.py`` enforces).
+
+Fast scenarios (``dup_reorder``, ``slow_node``, ``partition_gossip``)
+build multiple Cloud instances in-process and finish in seconds; slow
+scenarios (``kill_fanout``, ``kill_grid``) spawn real node processes
+and kill -9 them mid-work.
+
+Usage::
+
+    python scripts/chaos.py --scenario all  --seed 42   # everything
+    python scripts/chaos.py --scenario fast --seed 42   # in-process only
+    python scripts/chaos.py --scenario kill_fanout
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# chaos clouds gossip fast so suspicion windows stay sub-second
+os.environ.setdefault("H2O3_TPU_HB_INTERVAL", "0.1")
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_HERE)
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+import numpy as np  # noqa: E402
+
+#: name -> (fn(seed) -> verdict dict, is_slow)
+SCENARIOS: Dict[str, Tuple[Callable[[int], Dict[str, bool]], bool]] = {}
+
+
+def scenario(name: str, slow: bool = False):
+    def _reg(fn):
+        SCENARIOS[name] = (fn, slow)
+        return fn
+    return _reg
+
+
+# ---------------------------------------------------------------------------
+# shared harness
+
+
+def _wait(pred: Callable[[], bool], deadline_s: float,
+          every: float = 0.02) -> bool:
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline_s:
+        if pred():
+            return True
+        time.sleep(every)
+    return pred()
+
+
+def _mini_cloud(n: int, hb: float, prefix: str):
+    """``n`` full in-process nodes (gossip + DKV + DTask), formed."""
+    from h2o3_tpu.cluster import dkv as _dkv
+    from h2o3_tpu.cluster import tasks as _tasks
+    from h2o3_tpu.cluster.membership import Cloud
+    from h2o3_tpu.keyed import KeyedStore
+
+    clouds, stores = [], []
+    for i in range(n):
+        c = Cloud("chaos", f"{prefix}{i}", hb_interval=hb)
+        s = KeyedStore()
+        _dkv.install(c, s)
+        _tasks.install(c)
+        clouds.append(c)
+        stores.append(s)
+    seeds = [c.info.addr for c in clouds]
+    for c in clouds:
+        c.start([a for a in seeds if a != c.info.addr])
+    formed = _wait(lambda: all(c.size() == n for c in clouds), 15.0)
+    return clouds, stores, formed
+
+
+def _teardown(clouds) -> None:
+    from h2o3_tpu.cluster import faults
+
+    faults.clear_plan()
+    for c in clouds:
+        try:
+            c.stop()
+        except Exception:
+            pass
+
+
+def _counter_value(name: str, **labels) -> float:
+    from h2o3_tpu.util import telemetry
+
+    c = telemetry.REGISTRY.get(name)
+    if c is None:
+        return 0.0
+    return c.value(**labels) if labels else c.total()
+
+
+def mr_stat(cols, mask):
+    """Module-level MR fn (crosses the wire by module reference)."""
+    import jax.numpy as jnp
+
+    x = cols["x"]
+    y = cols["y"]
+    return {
+        "n": jnp.sum(mask.astype(jnp.float32)),
+        "sx": jnp.sum(jnp.where(mask, x, 0.0)),
+        "sy": jnp.sum(jnp.where(mask, y, 0.0)),
+        "sxy": jnp.sum(jnp.where(mask, x * y, 0.0)),
+    }
+
+
+def _mr_columns(n: int = 3000) -> Dict[str, np.ndarray]:
+    # integer-valued floats: every partial sum is exactly representable
+    # in float32, so k-way split order cannot perturb the reduction
+    x = np.arange(n, dtype=np.float64) % 97.0
+    y = (np.arange(n, dtype=np.float64) * 7.0) % 31.0
+    return {"x": x, "y": y}
+
+
+def _tree_bytes(t: Any) -> bytes:
+    import jax
+
+    return b"".join(np.asarray(v).tobytes()
+                    for v in jax.tree.leaves(t))
+
+
+# ---------------------------------------------------------------------------
+# fast scenarios (in-process clouds, seeded fault plans)
+
+
+@scenario("dup_reorder")
+def s_dup_reorder(seed: int) -> Dict[str, bool]:
+    """Duplicated + reordered mutation frames: every dkv_put frame is
+    sent twice and dkv_get frames land after a random delay, from both
+    nodes concurrently.  Invariants: all values bit-exact from both
+    sides, both fault rules actually fired, and the idempotency-token
+    dedup provably collapsed duplicated executions (a counted RPC
+    method under the duplicate rule executes exactly once per call)."""
+    from h2o3_tpu.cluster import faults
+
+    clouds, stores, formed = _mini_cloud(2, hb=0.1, prefix="dr")
+    v: Dict[str, bool] = {"formed": formed}
+    try:
+        plan = faults.plan_from_dict({"seed": seed, "rules": [
+            {"action": "duplicate", "method": "dkv_put"},
+            {"action": "reorder", "method": "dkv_get", "delay_ms": 15},
+            {"action": "duplicate", "method": "chaos_count"},
+        ]})
+        faults.set_plan(plan)
+
+        executions: List[int] = []
+        clouds[1].rpc_server.register(
+            "chaos_count", lambda p: executions.append(1) or {"ok": True})
+
+        keys = {f"chaos/dup-{i}": [i, i * i, f"v{i}"] for i in range(24)}
+        items = sorted(keys.items())
+
+        def _put(store, half):
+            for k, val in half:
+                store.put(k, val, replicas=2)
+
+        t0 = threading.Thread(target=_put, args=(stores[0], items[:12]))
+        t1 = threading.Thread(target=_put, args=(stores[1], items[12:]))
+        t0.start(); t1.start(); t0.join(); t1.join()
+
+        v["values_exact"] = all(
+            stores[0].get(k) == val and stores[1].get(k) == val
+            for k, val in keys.items())
+
+        n_calls = 10
+        for i in range(n_calls):
+            clouds[0].client.call(clouds[1].info.addr, "chaos_count",
+                                  {"i": i}, timeout=5.0,
+                                  target=clouds[1].info.ident)
+        hits = plan.hits()
+        v["duplicates_injected"] = hits[0] > 0 and hits[2] > 0
+        v["reorders_injected"] = hits[1] > 0
+        # dedup proof: every frame was sent twice, yet each logical call
+        # executed exactly once — the duplicate parked on the memo
+        v["dedup_exact"] = len(executions) == n_calls
+    finally:
+        _teardown(clouds)
+    return v
+
+
+@scenario("slow_node")
+def s_slow_node(seed: int) -> Dict[str, bool]:
+    """Delay ladder against one slow member under DKV + map_reduce
+    load: every frame TO node 2 is held ~40ms (well inside the beat
+    timeout).  Invariants: no false suspicion/removal, replicated
+    values exact through the slow path, distributed map_reduce
+    bit-identical to the local run."""
+    from h2o3_tpu.cluster import faults
+    from h2o3_tpu.cluster.tasks import distributed_map_reduce
+
+    removals0 = _counter_value("cluster_removals_total")
+    clouds, stores, formed = _mini_cloud(3, hb=0.15, prefix="sn")
+    v: Dict[str, bool] = {"formed": formed}
+    try:
+        slow_port = clouds[2].info.port
+        plan = faults.plan_from_dict({"seed": seed, "rules": [
+            {"action": "delay", "side": "client",
+             "dst": f"*:{slow_port}", "delay_ms": 40},
+        ]})
+        faults.set_plan(plan)
+
+        keys = {f"chaos/slow-{i}": {"i": i, "p": i ** 2} for i in range(12)}
+        for k, val in sorted(keys.items()):
+            stores[0].put(k, val, replicas=2)
+        v["values_exact"] = all(
+            stores[j].get(k) == val
+            for j in range(3) for k, val in keys.items())
+
+        cols = _mr_columns()
+        local = distributed_map_reduce(mr_stat, cols, cloud=None)
+        dist = distributed_map_reduce(mr_stat, cols, cloud=clouds[0])
+        v["mr_bit_identical"] = _tree_bytes(local) == _tree_bytes(dist)
+
+        v["delays_injected"] = plan.hits()[0] > 0
+        v["no_false_removal"] = (
+            all(c.size() == 3 for c in clouds)
+            and _counter_value("cluster_removals_total") == removals0)
+    finally:
+        _teardown(clouds)
+    return v
+
+
+@scenario("partition_gossip")
+def s_partition_gossip(seed: int) -> Dict[str, bool]:
+    """Asymmetric then symmetric partition during gossip.  Phase 1
+    drops only a->c heartbeats: c still beats a, so nobody may be
+    removed.  Phase 2 isolates c in both directions past the removal
+    window: a/b must drop to a 2-cloud and c to a 1-cloud, while a
+    replicated key stays readable from the majority side.  Healing the
+    partition must reconverge all three with hash consensus (no fence:
+    the isolated node's cloud version survived).  A final RESTART
+    drill then stops c and boots a fresh process-equivalent in its
+    place: the newcomer reuses the name with a reset version, so it
+    must be fenced (410), rejoin, and the restarted node's keys must
+    re-home onto it — observable as read-repair or a sweep re-home."""
+    from h2o3_tpu.cluster import dkv as _dkv
+    from h2o3_tpu.cluster import faults
+    from h2o3_tpu.cluster import tasks as _tasks
+    from h2o3_tpu.cluster.membership import Cloud
+    from h2o3_tpu.keyed import KeyedStore
+
+    rejoins0 = _counter_value("cluster_rejoins_total")
+    clouds, stores, formed = _mini_cloud(3, hb=0.05, prefix="pg")
+    a, b, c = clouds
+    c2 = None
+    v: Dict[str, bool] = {"formed": formed}
+    try:
+        key, val = "chaos/part-key", {"payload": list(range(8))}
+        stores[0].put(key, val, replicas=3)
+        keys = {f"chaos/part-{i}": [i, i + 0.5] for i in range(40)}
+        for k2, val2 in sorted(keys.items()):
+            stores[0].put(k2, val2, replicas=3)
+
+        c_port = c.info.port
+        plan = faults.plan_from_dict({"seed": seed, "rules": [
+            {"action": "drop", "side": "client",
+             "src": a.info.name, "dst": f"*:{c_port}"},
+        ]})
+        faults.set_plan(plan)
+        time.sleep(1.0)  # ~4x the removal window
+        v["asymmetric_hits"] = plan.hits()[0] > 0
+        v["no_removal_asymmetric"] = all(cl.size() == 3 for cl in clouds)
+
+        plan2 = faults.plan_from_dict({"seed": seed + 1, "rules": [
+            {"action": "drop", "side": "client", "dst": f"*:{c_port}"},
+            {"action": "drop", "side": "client", "src": c.info.name},
+        ]})
+        faults.set_plan(plan2)
+        v["partition_detected"] = _wait(
+            lambda: a.size() == 2 and b.size() == 2 and c.size() == 1, 15.0)
+        v["readable_during_partition"] = (
+            stores[0].get(key) == val and stores[1].get(key) == val)
+
+        faults.clear_plan()
+        v["reconverged"] = _wait(
+            lambda: all(cl.size() == 3 for cl in clouds)
+            and len({cl.cloud_hash() for cl in clouds}) == 1
+            and all(cl.consensus() for cl in clouds), 20.0)
+        v["readable_after_heal"] = all(
+            stores[j].get(key) == val for j in range(3))
+
+        # -- restart drill: stop c, boot a fresh same-name node --------
+        c.stop()
+        v["death_detected"] = _wait(
+            lambda: a.size() == 2 and b.size() == 2, 15.0)
+        repairs0 = _counter_value("cluster_dkv_read_repair_total")
+        rehomes0 = _counter_value("cluster_dkv_replica_sweep_total",
+                                  action="rehomed")
+        restores0 = _counter_value("cluster_dkv_replica_sweep_total",
+                                   action="restored")
+        c2 = Cloud("chaos", c.info.name, hb_interval=0.05)
+        store_c2 = KeyedStore()
+        _dkv.install(c2, store_c2)
+        _tasks.install(c2)
+        c2.start([a.info.addr, b.info.addr])
+        v["restart_rejoined"] = _wait(
+            lambda: a.size() == 3 and b.size() == 3 and c2.size() == 3,
+            20.0)
+        # the fresh node's version reset to 1, so re-admission MUST have
+        # gone through the 410 fence -> rejoin path
+        v["rejoin_counted"] = (
+            _counter_value("cluster_rejoins_total") > rejoins0)
+        # every key is readable from the restarted (empty) node; keys
+        # whose arc it owns re-home onto it via read-repair, keys the
+        # survivors tracked re-home via the sweep — either path must
+        # surface in telemetry
+        v["readable_after_restart"] = all(
+            store_c2.get(k2) == val2 for k2, val2 in sorted(keys.items()))
+        v["rehome_observable"] = (
+            _counter_value("cluster_dkv_read_repair_total") > repairs0
+            or _counter_value("cluster_dkv_replica_sweep_total",
+                              action="rehomed") > rehomes0
+            or _counter_value("cluster_dkv_replica_sweep_total",
+                              action="restored") > restores0)
+    finally:
+        if c2 is not None:
+            try:
+                c2.stop()
+            except Exception:
+                pass
+        _teardown(clouds)
+    return v
+
+
+# ---------------------------------------------------------------------------
+# slow scenarios (real child processes, SIGKILL nemesis)
+
+
+def _env(extra_path: str = "") -> Dict[str, str]:
+    env = dict(os.environ)
+    path = _ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    if extra_path:
+        path = extra_path + os.pathsep + path
+    env["PYTHONPATH"] = path
+    env["PYTHONUNBUFFERED"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["H2O3_TPU_HB_INTERVAL"] = "0.2"
+    env["H2O3_TPU_FAULTS"] = "1"  # nemesis RPC surface on every node
+    return env
+
+
+def _spawn_node(name: str, addr_file: str,
+                flatfile: Optional[str] = None,
+                extra_path: str = "") -> subprocess.Popen:
+    cmd = [sys.executable, "-m", "h2o3_tpu.cluster.nodeproc",
+           "--cluster-name", "chaoskill", "--node-name", name,
+           "--port", "0", "--address-file", addr_file]
+    if flatfile:
+        cmd += ["--flatfile", flatfile]
+    return subprocess.Popen(
+        cmd, stdin=subprocess.PIPE, stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL, env=_env(extra_path), cwd=_ROOT)
+
+
+def _read_addr(path: str, deadline_s: float = 30.0) -> Tuple[str, int]:
+    ok = _wait(lambda: os.path.exists(path)
+               and os.path.getsize(path) > 0, deadline_s)
+    if not ok:
+        raise RuntimeError(f"address file {path} never appeared")
+    host, port = open(path).read().strip().rsplit(":", 1)
+    return host, int(port)
+
+
+@scenario("kill_fanout", slow=True)
+def s_kill_fanout(seed: int) -> Dict[str, bool]:
+    """SIGKILL a member mid-``distributed_map_reduce``.  A fault plan
+    installed over the nemesis RPC surface slows the victim's dtask
+    handling so the kill provably lands mid-shard.  Invariants: the
+    result is bit-identical to the local run, the range re-ran on the
+    SURVIVOR (``path=survivor`` metered here, the survivor's own
+    ``mr_shard`` meter moved — remote-side proof), and membership
+    reconverges to the survivors."""
+    from h2o3_tpu.cluster import dkv as _dkv
+    from h2o3_tpu.cluster import faults
+    from h2o3_tpu.cluster import rpc as _rpc
+    from h2o3_tpu.cluster import tasks as _tasks
+    from h2o3_tpu.cluster.membership import Cloud
+    from h2o3_tpu.cluster.tasks import distributed_map_reduce
+    from h2o3_tpu.keyed import KeyedStore
+
+    tmp = tempfile.mkdtemp(prefix="chaos-kill-")
+    # the MR fn must be importable by the SAME module path on every
+    # node — a tmp module on everyone's PYTHONPATH, never __main__
+    mrfns = os.path.join(tmp, "chaos_mrfns.py")
+    with open(mrfns, "w") as f:
+        f.write(
+            "import jax.numpy as jnp\n\n\n"
+            "def stat(cols, mask):\n"
+            "    x = cols['x']\n"
+            "    y = cols['y']\n"
+            "    return {'n': jnp.sum(mask.astype(jnp.float32)),\n"
+            "            'sx': jnp.sum(jnp.where(mask, x, 0.0)),\n"
+            "            'sy': jnp.sum(jnp.where(mask, y, 0.0)),\n"
+            "            'sxy': jnp.sum(jnp.where(mask, x * y, 0.0))}\n")
+    sys.path.insert(0, tmp)
+    import chaos_mrfns  # noqa: E402  (the tmp module written above)
+
+    victim = _spawn_node("victim", os.path.join(tmp, "victim.addr"),
+                         extra_path=tmp)
+    surv = None
+    cloud = None
+    v: Dict[str, bool] = {}
+    try:
+        victim_addr = _read_addr(os.path.join(tmp, "victim.addr"))
+        flatfile = os.path.join(tmp, "flatfile")
+        with open(flatfile, "w") as f:
+            f.write(f"{victim_addr[0]}:{victim_addr[1]}\n")
+        surv = _spawn_node("survivor", os.path.join(tmp, "surv.addr"),
+                           flatfile=flatfile, extra_path=tmp)
+        surv_addr = _read_addr(os.path.join(tmp, "surv.addr"))
+
+        cloud = Cloud("chaoskill", "driver", hb_interval=0.2)
+        _dkv.install(cloud, KeyedStore())
+        _tasks.install(cloud)
+        cloud.start([victim_addr, surv_addr])
+        v["formed"] = _wait(lambda: cloud.size() == 3, 30.0)
+
+        # nemesis: hold the victim's dtask handling long enough that
+        # SIGKILL lands while its shard is provably in flight
+        cloud.client.call(victim_addr, "fault_plan_set", {
+            "seed": seed,
+            "rules": [{"action": "delay", "side": "server",
+                       "method": "dtask", "delay_ms": 2500}],
+        }, timeout=5.0)
+
+        cols = _mr_columns(4001)
+        local = distributed_map_reduce(chaos_mrfns.stat, cols, cloud=None)
+        rec0 = _counter_value("cluster_fanout_recovered_total",
+                              path="survivor")
+        box: Dict[str, Any] = {}
+
+        def _dmr():
+            try:
+                box["out"] = distributed_map_reduce(
+                    chaos_mrfns.stat, cols, cloud=cloud, timeout=60.0)
+            except Exception as e:  # invariant failure, not a crash
+                box["err"] = e
+
+        th = threading.Thread(target=_dmr, daemon=True)
+        th.start()
+        time.sleep(0.8)  # fan-out is in flight, victim is mid-delay
+        victim.kill()
+        th.join(timeout=90.0)
+
+        v["mr_completed"] = "out" in box
+        v["mr_bit_identical"] = (
+            "out" in box
+            and _tree_bytes(local) == _tree_bytes(box["out"]))
+        v["survivor_rescheduled"] = _counter_value(
+            "cluster_fanout_recovered_total", path="survivor") > rec0
+
+        # remote-side proof: the survivor's OWN mr_shard meter moved
+        try:
+            snap = cloud.client.call(surv_addr, "metrics", None, timeout=5.0)
+            served = snap.get("cluster_tasks_total", 0)
+            v["survivor_metered"] = served >= 2
+        except _rpc.RPCError:
+            v["survivor_metered"] = False
+
+        v["membership_reconverged"] = _wait(lambda: cloud.size() == 2, 20.0)
+    finally:
+        for p in (victim, surv):
+            if p is not None:
+                try:
+                    p.kill()
+                except OSError:
+                    pass
+        if cloud is not None:
+            cloud.stop()
+        faults.clear_plan()
+        if tmp in sys.path:
+            sys.path.remove(tmp)
+    return v
+
+
+@scenario("kill_grid", slow=True)
+def s_kill_grid(seed: int) -> Dict[str, bool]:
+    """SIGKILL a grid search mid-run, then resume it from its recovery
+    snapshots.  The child process builds a 4-model GLM grid with
+    ``recovery_dir`` set and SIGKILLs ITSELF on entry to the third
+    build — a real ``kill -9`` at a deterministic point (exactly 2
+    models checkpointed).  ``auto_recover`` in this process must then
+    finish exactly the remaining models from the snapshot."""
+    tmp = tempfile.mkdtemp(prefix="chaos-grid-")
+    rec_dir = os.path.join(tmp, "rec")
+    script = os.path.join(tmp, "grid_child.py")
+    with open(script, "w") as f:
+        f.write(f"""
+import os, signal
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+from h2o3_tpu.frame.frame import ColType, Column, Frame
+from h2o3_tpu.models.glm import GLM, GLMParameters
+from h2o3_tpu.models.grid import GridSearch
+
+rng = np.random.default_rng({seed})
+n = 300
+X = rng.normal(size=(n, 3))
+y = (X[:, 0] - X[:, 1] + 0.3 * rng.normal(size=n) > 0).astype(np.int32)
+cols = [Column(f"x{{i}}", X[:, i]) for i in range(3)]
+cols.append(Column("y", y, ColType.CAT, ["n", "p"]))
+fr = Frame(cols)
+
+built = {{"n": 0}}
+
+
+class KillGLM(GLM):
+    def _fit(self, frame, valid=None):
+        built["n"] += 1
+        if built["n"] == 3:
+            # nemesis: a REAL kill -9, mid-third-build, with exactly
+            # two models checkpointed (resume re-instantiates plain
+            # GLM from the snapshot's algo name, not this subclass)
+            os.kill(os.getpid(), signal.SIGKILL)
+        return super()._fit(frame, valid)
+
+
+GridSearch(KillGLM,
+           GLMParameters(response_column="y", family="binomial", seed=1),
+           {{"lambda_": [0.0, 0.01, 0.1, 1.0]}},
+           recovery_dir={rec_dir!r}).train(fr)
+""")
+    child = subprocess.Popen([sys.executable, script],
+                             stdout=subprocess.DEVNULL,
+                             stderr=subprocess.DEVNULL, env=_env(), cwd=_ROOT)
+    v: Dict[str, bool] = {}
+    try:
+        child.wait(timeout=180.0)
+        meta_path = os.path.join(rec_dir, "recovery.json")
+
+        def _models_done() -> int:
+            try:
+                with open(meta_path) as f:
+                    return len(json.load(f).get("models", []))
+            except (OSError, ValueError):
+                return 0
+
+        v["killed_midway"] = (child.returncode == -signal.SIGKILL
+                              and _models_done() == 2)
+
+        from h2o3_tpu.recovery import auto_recover
+
+        grid = auto_recover(rec_dir)
+        v["resumed_complete"] = (grid is not None
+                                 and len(grid.models) == 4)
+        # on_done cleaned the snapshot up — the resume COMPLETED the
+        # grid rather than leaving a half-recovered state behind
+        v["snapshot_cleaned"] = not os.path.exists(meta_path)
+    finally:
+        try:
+            child.kill()
+        except OSError:
+            pass
+    return v
+
+
+# ---------------------------------------------------------------------------
+# runner
+
+
+def run_scenario(name: str, seed: int) -> Dict[str, bool]:
+    fn, _slow = SCENARIOS[name]
+    return fn(seed)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--scenario", default="fast",
+                    help="all | fast | " + " | ".join(SCENARIOS))
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--json", default="",
+                    help="also write verdicts to this path")
+    args = ap.parse_args(argv)
+
+    if args.scenario == "all":
+        names = list(SCENARIOS)
+    elif args.scenario == "fast":
+        names = [n for n, (_f, slow) in SCENARIOS.items() if not slow]
+    elif args.scenario in SCENARIOS:
+        names = [args.scenario]
+    else:
+        ap.error(f"unknown scenario {args.scenario!r}")
+
+    verdicts: Dict[str, Dict[str, bool]] = {}
+    ok = True
+    for name in names:
+        print(f"== chaos scenario {name} (seed={args.seed}) ==", flush=True)
+        verdicts[name] = run_scenario(name, args.seed)
+        for inv, passed in verdicts[name].items():
+            print(f"   {'PASS' if passed else 'FAIL'}  {inv}", flush=True)
+        ok = ok and all(verdicts[name].values())
+
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({"seed": args.seed, "verdicts": verdicts}, f, indent=2)
+    print("chaos:", "ALL PASS" if ok else "FAILURES", flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

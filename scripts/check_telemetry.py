@@ -66,8 +66,9 @@ _METRIC_SUFFIXES = ("_total", "_seconds", "_bytes", "_entries", "_workers",
 
 
 #: README sections whose backticked metric references the registry must
-#: actually contain (the Clustering section documents cluster_*/rpc_*)
-_METRIC_SECTIONS = ("Observability", "Clustering")
+#: actually contain (Clustering documents cluster_*/rpc_*, Failure
+#: model the chaos-plane meters)
+_METRIC_SECTIONS = ("Observability", "Clustering", "Failure model")
 
 
 def readme_documented_metrics(readme_path: str) -> set:
@@ -100,6 +101,7 @@ def live_metrics() -> set:
     import h2o3_tpu.cluster.membership  # noqa: F401  cluster_* meters
     import h2o3_tpu.cluster.dkv      # noqa: F401  cluster_dkv_* meters
     import h2o3_tpu.cluster.tasks    # noqa: F401  cluster_tasks_* meters
+    import h2o3_tpu.cluster.faults   # noqa: F401  cluster_faults_* meters
     from h2o3_tpu.util import telemetry
 
     return set(telemetry.REGISTRY.names())

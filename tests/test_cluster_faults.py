@@ -1,0 +1,533 @@
+"""Chaos plane, in-process tier: the deterministic FaultPlan, the RPC
+consult points it drives, the retry ladder's full-jitter backoff, DKV
+read-repair through a dead home, the replica anti-entropy sweep's
+reap-vs-restore disambiguation, survivor rescheduling of a partitioned
+member's fan-out ranges, and the test-only nemesis RPC/REST surface.
+
+Everything runs multiple Cloud instances inside ONE process over real
+loopback sockets (same machinery as test_cluster.py); the multi-process
+chaos drills live in scripts/chaos.py and tests/test_chaos.py.
+"""
+
+import json
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.cluster import dkv as cdkv
+from h2o3_tpu.cluster import faults
+from h2o3_tpu.cluster import rpc as crpc
+from h2o3_tpu.cluster import tasks as ctasks
+from h2o3_tpu.cluster.faults import FaultPlan, FaultRule, plan_from_dict
+from h2o3_tpu.cluster.membership import Cloud
+from h2o3_tpu.keyed import KeyedStore
+from h2o3_tpu.util import telemetry
+
+
+def _mr_stat(cols, mask):
+    """Module-level map fn: crosses the RPC wire by module reference."""
+    import jax.numpy as jnp
+
+    return {
+        "s": jnp.sum(jnp.where(mask, cols["x"], 0.0)),
+        "n": jnp.sum(mask.astype(jnp.float32)),
+    }
+
+
+def _wait_for(cond, timeout=10.0, every=0.02, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(every)
+    pytest.fail(f"timed out after {timeout}s waiting for {msg}")
+
+
+def _counter_total(name):
+    m = telemetry.REGISTRY.get(name)
+    return 0.0 if m is None else m.total()
+
+
+def _counter_value(name, **labels):
+    m = telemetry.REGISTRY.get(name)
+    return 0.0 if m is None else m.value(**labels)
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    faults.clear_plan()
+    yield
+    faults.clear_plan()
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan unit tier: matching, windows, determinism, JSON shape
+
+
+class TestFaultPlan:
+    def test_first_match_wins_and_globs(self):
+        plan = FaultPlan(seed=1, rules=[
+            FaultRule(action="delay", method="dkv_*", delay_ms=5.0),
+            FaultRule(action="drop", method="*"),
+        ])
+        d = plan.consult("client", "node-a", "h:1", "dkv_get")
+        assert d is not None and d.action == "delay"
+        assert d.delay_s == pytest.approx(0.005)
+        # the catch-all never sees dkv_* traffic (first match won) but
+        # does see everything else
+        assert plan.consult("client", "node-a", "h:1", "echo").action == "drop"
+        assert plan.hits() == [1, 1]
+
+    def test_side_and_endpoint_matching(self):
+        plan = FaultPlan(seed=0, rules=[
+            FaultRule(action="drop", side="server", src="node-b"),
+            FaultRule(action="partition", src="node-a", dst="*:9999"),
+        ])
+        assert plan.consult("server", "node-a", "", "m") is None
+        assert plan.consult("server", "node-b", "", "m").action == "drop"
+        assert plan.consult("client", "node-a", "h:1234", "m") is None
+        assert plan.consult(
+            "client", "node-a", "h:9999", "m").action == "partition"
+
+    def test_after_and_max_hits_windows(self):
+        plan = FaultPlan(seed=0, rules=[
+            FaultRule(action="drop", after=2, max_hits=3),
+        ])
+        fired = [plan.consult("client", "n", "d", "m") is not None
+                 for _ in range(8)]
+        # skips matches 1-2, injects on 3-5, exhausted afterwards
+        assert fired == [False, False, True, True, True, False, False, False]
+        assert plan.hits() == [3]
+
+    def test_probabilistic_rules_replay_under_seed(self):
+        def run(seed):
+            plan = FaultPlan(seed=seed, rules=[
+                FaultRule(action="drop", p=0.5),
+            ])
+            return [plan.consult("client", "n", "d", "m") is not None
+                    for _ in range(64)]
+
+        a, b = run(7), run(7)
+        assert a == b  # same seed -> identical injection schedule
+        assert run(8) != a  # and the seed actually matters
+        assert 8 < sum(a) < 56  # p=0.5 really is probabilistic
+
+    def test_reorder_sampled_delay_replays_and_bounds(self):
+        def draws(seed):
+            plan = FaultPlan(seed=seed, rules=[
+                FaultRule(action="reorder", delay_ms=20.0),
+            ])
+            return [plan.consult("client", "n", "d", "m").delay_s
+                    for _ in range(16)]
+
+        a = draws(3)
+        assert a == draws(3)
+        assert all(0.0 <= d <= 0.020 for d in a)
+        assert len(set(a)) > 8  # a spread, not a constant
+
+    def test_per_rule_prng_isolated_from_other_rules(self):
+        # rule 1's draws depend only on (seed, index) and its own match
+        # ordinal — traffic hitting rule 0 must not perturb them
+        mk = lambda: FaultPlan(seed=9, rules=[
+            FaultRule(action="drop", method="noise", p=0.5),
+            FaultRule(action="reorder", method="probe", delay_ms=10.0),
+        ])
+        quiet = mk()
+        probe_only = [quiet.consult("client", "n", "d", "probe").delay_s
+                      for _ in range(8)]
+        noisy = mk()
+        for _ in range(50):
+            noisy.consult("client", "n", "d", "noise")
+        with_noise = [noisy.consult("client", "n", "d", "probe").delay_s
+                      for _ in range(8)]
+        assert probe_only == with_noise
+
+    def test_plan_from_dict_roundtrip_and_unknown_fields(self):
+        d = {"seed": 5, "rules": [
+            {"action": "delay", "method": "dkv_put", "delay_ms": 2.0,
+             "added_in_a_newer_nemesis": True},
+        ]}
+        plan = plan_from_dict(d)
+        assert plan.seed == 5 and len(plan.rules) == 1
+        assert plan.rules[0].method == "dkv_put"
+        back = plan.to_dict()
+        assert back["seed"] == 5
+        assert back["rules"][0]["action"] == "delay"
+        assert "added_in_a_newer_nemesis" not in back["rules"][0]
+
+    def test_rule_validation(self):
+        with pytest.raises(ValueError, match="unknown fault action"):
+            FaultRule(action="explode")
+        with pytest.raises(ValueError, match="unknown fault side"):
+            FaultRule(action="drop", side="middle")
+
+    def test_install_from_env_inline_and_path(self, monkeypatch, tmp_path):
+        spec = {"seed": 11, "rules": [{"action": "drop", "method": "x"}]}
+        monkeypatch.setenv("H2O3_TPU_FAULT_PLAN", json.dumps(spec))
+        assert faults.surface_enabled()
+        plan = faults.install_from_env()
+        assert plan is faults.active_plan() and plan.seed == 11
+        faults.clear_plan()
+
+        p = tmp_path / "plan.json"
+        p.write_text(json.dumps(spec))
+        monkeypatch.setenv("H2O3_TPU_FAULT_PLAN", f"@{p}")
+        plan = faults.install_from_env()
+        assert plan.seed == 11 and len(plan.rules) == 1
+
+        monkeypatch.delenv("H2O3_TPU_FAULT_PLAN")
+        faults.clear_plan()
+        assert faults.install_from_env() is None
+        assert faults.active_plan() is None
+
+
+# ---------------------------------------------------------------------------
+# retry ladder: full-jitter backoff spread + seeded replay
+
+
+class TestBackoffJitter:
+    def _closed_port(self):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def test_full_jitter_spread_and_seeded_replay(self, monkeypatch):
+        samples = []
+        monkeypatch.setattr(crpc.time, "sleep", samples.append)
+        addr = ("127.0.0.1", self._closed_port())
+
+        def run():
+            samples.clear()
+            faults.set_plan(FaultPlan(seed=42))  # seeds the jitter source
+            client = crpc.RpcClient(retries=6, backoff_base=0.01,
+                                    backoff_max=0.04, node_name="jitter")
+            with pytest.raises(crpc.RPCConnectionError):
+                client.call(addr, "echo", None, timeout=0.5, target="gone")
+            return list(samples)
+
+        first = run()
+        assert len(first) == 6  # one sleep before each retry attempt
+        for a, s in enumerate(first, start=1):
+            # FULL jitter: U(0, min(cap, base * 2^(a-1))) — never a bare
+            # deterministic doubling
+            assert 0.0 <= s <= min(0.04, 0.01 * (2 ** (a - 1))) + 1e-12
+        assert len(set(first)) >= 3  # a spread, not a constant ladder
+        assert max(first) > 0.0
+
+        # a fresh plan with the SAME seed replays the exact spacing —
+        # this is what makes chaos runs reproducible end to end
+        assert run() == first
+
+        # and without a plan the draws come from an unseeded PRNG:
+        # still bounded, still a spread
+        faults.clear_plan()
+        samples.clear()
+        client = crpc.RpcClient(retries=6, backoff_base=0.01,
+                                backoff_max=0.04, node_name="jitter")
+        with pytest.raises(crpc.RPCConnectionError):
+            client.call(addr, "echo", None, timeout=0.5, target="gone")
+        assert len(samples) == 6
+        assert all(0.0 <= s <= 0.04 + 1e-12 for s in samples)
+
+
+# ---------------------------------------------------------------------------
+# RPC consult points: client drop ladder, lost-response dedup, duplicate
+# absorption, black-hole timeout — on a bare server/client pair
+
+
+class TestRpcFaultInjection:
+    @pytest.fixture()
+    def pair(self):
+        srv = crpc.RpcServer(node_name="srv")
+        executions = []
+
+        def count_me(payload):
+            executions.append(payload)
+            return {"n": len(executions)}
+
+        srv.register("count_me", count_me)
+        client = crpc.RpcClient(retries=3, backoff_base=0.001,
+                                backoff_max=0.004, node_name="cli")
+        try:
+            yield srv, client, executions
+        finally:
+            srv.stop()
+
+    def test_client_drop_consumes_the_ladder(self, pair):
+        srv, client, executions = pair
+        plan = FaultPlan(seed=0, rules=[
+            FaultRule(action="drop", side="client",
+                      method="count_me", max_hits=2),
+        ])
+        faults.set_plan(plan)
+        before = _counter_value("cluster_faults_injected_total",
+                                action="drop")
+        out = client.call(srv.address, "count_me", {}, timeout=2.0,
+                          target="srv")
+        # two attempts died on the floor, the third got through — and the
+        # method ran exactly once (the dropped attempts never sent bytes)
+        assert out == {"n": 1} and len(executions) == 1
+        assert plan.hits() == [2]
+        assert _counter_value("cluster_faults_injected_total",
+                              action="drop") - before == 2
+
+    def test_server_drop_forces_retry_through_dedup(self, pair):
+        srv, client, executions = pair
+        faults.set_plan(FaultPlan(seed=0, rules=[
+            FaultRule(action="drop", side="server",
+                      method="count_me", max_hits=1),
+        ]))
+        out = client.call(srv.address, "count_me", {}, timeout=2.0,
+                          target="srv")
+        # the lost-ack classic: the first execution's response was
+        # discarded, the retry carried the SAME token and was served
+        # from the memo — one execution, correct result
+        assert out == {"n": 1}
+        assert len(executions) == 1
+
+    def test_duplicate_envelope_absorbed_by_memo(self, pair):
+        srv, client, executions = pair
+        faults.set_plan(FaultPlan(seed=0, rules=[
+            FaultRule(action="duplicate", side="client",
+                      method="count_me", max_hits=1),
+        ]))
+        out = client.call(srv.address, "count_me", {}, timeout=2.0,
+                          target="srv")
+        assert out == {"n": 1}
+        _wait_for(lambda: len(executions) == 1, timeout=2.0,
+                  msg="duplicate absorbed without a second execution")
+        time.sleep(0.05)  # the duplicate frame has landed by now
+        assert len(executions) == 1
+
+    def test_black_hole_exhausts_as_timeout(self, pair):
+        srv, client, executions = pair
+        plan = FaultPlan(seed=0, rules=[
+            FaultRule(action="black_hole", side="client",
+                      method="count_me"),
+        ])
+        faults.set_plan(plan)
+        with pytest.raises(crpc.RPCTimeoutError):
+            client.call(srv.address, "count_me", {}, timeout=2.0,
+                        target="srv", retries=1)
+        assert plan.hits() == [2]  # both ladder attempts swallowed
+        assert executions == []  # no bytes ever reached the server
+
+
+# ---------------------------------------------------------------------------
+# cluster tier: read-repair, sweep reap-vs-restore, survivor rescheduling
+
+
+@pytest.fixture()
+def fault_cloud3():
+    """A formed 3-node cloud with DKV + DTask installed, suspicion set
+    far out so fault windows are entirely script-controlled."""
+    clouds, stores = [], []
+    try:
+        for i in range(3):
+            c = Cloud("faultcloud", f"fc-{i}", hb_interval=0.05,
+                      suspect_beats=200)
+            s = KeyedStore()
+            cdkv.install(c, s)
+            ctasks.install(c)
+            clouds.append(c)
+            stores.append(s)
+        seeds = []
+        for c in clouds:
+            c.start(list(seeds))
+            seeds.append(c.info.addr)
+        _wait_for(lambda: all(c.size() == 3 for c in clouds),
+                  msg="3-node fault cloud formation")
+        yield clouds, stores
+    finally:
+        faults.clear_plan()
+        for c in clouds:
+            c.stop()
+
+
+def _key_homed(router, first, second, prefix):
+    """A key whose ring candidates start [first, second] — placement is
+    port-dependent, so probe rather than assume."""
+    for i in range(400):
+        k = f"{prefix}-{i}"
+        names = [m.info.name for m in router.home_members(k, 3)]
+        if names[:2] == [first, second]:
+            return k
+    pytest.fail(f"no key found with candidate order [{first}, {second}]")
+
+
+class TestReadRepairAndSweep:
+    def test_read_repair_through_dead_home(self, fault_cloud3):
+        clouds, stores = fault_cloud3
+        a, b, c = clouds
+        ra = stores[0].router
+        # homed on b, replica copy on c; caller a is neither
+        key = _key_homed(ra, b.info.name, c.info.name, "chaos/rr")
+        stores[0].put(key, [1, 2, 3], replicas=2)
+        _wait_for(lambda: stores[2].get(key, _local=True) == [1, 2, 3],
+                  timeout=2.0, msg="replica copy lands on the successor")
+        b.stop()  # dies INSIDE the suspicion window: still in the ring
+        before = _counter_total("cluster_dkv_read_repair_total")
+        assert stores[0].get(key) == [1, 2, 3]  # served by the successor
+        assert _counter_total("cluster_dkv_read_repair_total") - before == 1
+        # the serving holder was promoted to home-elect: it now tracks
+        # the key as an authoritative, replicated one
+        rc = stores[2].router
+        assert key in rc._replicated
+        assert key not in rc._replica_copies
+
+    def test_sweep_reaps_orphan_copy(self, fault_cloud3):
+        clouds, stores = fault_cloud3
+        a, b, c = clouds
+        ra = stores[0].router
+        key = _key_homed(ra, b.info.name, a.info.name, "chaos/reap")
+        stores[0].put(key, {"v": 1}, replicas=2)
+        _wait_for(lambda: stores[0].get(key, _local=True) == {"v": 1},
+                  timeout=2.0, msg="replica copy lands on node a")
+        # make b's home-side reap push fail: its dkv_remove to the
+        # holder is dropped on the client side, orphaning a's copy
+        faults.set_plan(FaultPlan(seed=0, rules=[
+            FaultRule(action="drop", side="client", src=b.info.name,
+                      method="dkv_remove"),
+        ]))
+        before = _counter_value("cluster_dkv_replica_sweep_total",
+                                action="reaped")
+        stores[1].remove(key)
+        faults.clear_plan()
+        # the orphan does NOT leak: the holder's heartbeat-piggybacked
+        # sweep validates the copy against the home, learns the key WAS
+        # removed (the home's removed-set disambiguates), and reaps it
+        _wait_for(lambda: key not in ra._replica_copies, timeout=5.0,
+                  msg="orphan copy reaped by the anti-entropy sweep")
+        assert _counter_value("cluster_dkv_replica_sweep_total",
+                              action="reaped") - before >= 1
+        assert stores[0].get(key, "GONE", _local=True) == "GONE"
+
+    def test_sweep_restores_copy_to_amnesiac_home(self, fault_cloud3):
+        clouds, stores = fault_cloud3
+        a, b, c = clouds
+        ra = stores[0].router
+        key = _key_homed(ra, b.info.name, a.info.name, "chaos/restore")
+        stores[0].put(key, [9, 9], replicas=2)
+        _wait_for(lambda: stores[0].get(key, _local=True) == [9, 9],
+                  timeout=2.0, msg="replica copy lands on node a")
+        # the home loses the value WITHOUT serving a remove (a restart
+        # that came back empty): _local bypasses the routed path, so
+        # b's removed-set never learns the key
+        before = _counter_value("cluster_dkv_replica_sweep_total",
+                                action="restored")
+        stores[1].remove(key, _local=True)
+        _wait_for(lambda: stores[1].get(key, _local=True) == [9, 9],
+                  timeout=5.0, msg="value restored onto the home")
+        assert _counter_value("cluster_dkv_replica_sweep_total",
+                              action="restored") - before >= 1
+        assert key in ra._replica_copies  # the copy survives the restore
+
+    def test_fanout_rescheduled_onto_survivors(self, fault_cloud3):
+        clouds, stores = fault_cloud3
+        a, b, c = clouds
+        n = 3001
+        cols = {"x": (np.arange(n) % 97).astype(np.float32)}
+        baseline = ctasks.distributed_map_reduce(_mr_stat, cols, cloud=None)
+        # partition c off from the driver: every dtask to it dies
+        # client-side, so its ranges must land on the survivors
+        faults.set_plan(FaultPlan(seed=0, rules=[
+            FaultRule(action="partition", side="client",
+                      dst=f"*:{c.info.addr[1]}", method="dtask"),
+        ]))
+        before = _counter_value("cluster_fanout_recovered_total",
+                                path="survivor")
+        out = ctasks.distributed_map_reduce(_mr_stat, cols, cloud=a)
+        assert _counter_value("cluster_fanout_recovered_total",
+                              path="survivor") - before >= 1
+        # bit-identical despite the reschedule: integer-valued float32
+        # partials are exact, so the k-way split cannot perturb sums
+        assert float(out["s"]) == float(baseline["s"])
+        assert float(out["n"]) == float(baseline["n"])
+
+
+# ---------------------------------------------------------------------------
+# nemesis surfaces: RPC (gated by env) and REST /3/Faults (gated per call)
+
+
+class TestNemesisSurface:
+    def test_rpc_surface_absent_by_default(self, monkeypatch):
+        monkeypatch.delenv("H2O3_TPU_FAULTS", raising=False)
+        monkeypatch.delenv("H2O3_TPU_FAULT_PLAN", raising=False)
+        c = Cloud("nofaults", "plain", hb_interval=0.05)
+        try:
+            assert "fault_plan_set" not in c.rpc_server._methods
+            assert "fault_crash" not in c.rpc_server._methods
+        finally:
+            c.stop()
+
+    def test_rpc_surface_roundtrip(self, monkeypatch):
+        monkeypatch.setenv("H2O3_TPU_FAULTS", "1")
+        a = Cloud("nemesis", "nem-a", hb_interval=0.05)
+        b = Cloud("nemesis", "nem-b", hb_interval=0.05)
+        try:
+            a.start([])
+            b.start([a.info.addr])
+            _wait_for(lambda: a.size() == 2 and b.size() == 2,
+                      msg="nemesis cloud formation")
+            spec = {"seed": 13, "rules": [
+                {"action": "delay", "method": "never_called",
+                 "delay_ms": 1.0}]}
+            out = a.client.call(b.info.addr, "fault_plan_set", spec)
+            assert out == {"installed": True, "seed": 13, "rules": 1}
+            got = a.client.call(b.info.addr, "fault_plan_get", None)
+            assert got["plan"]["seed"] == 13
+            assert got["plan"]["rules"][0]["method"] == "never_called"
+            assert got["hits"] == [0]
+            out = a.client.call(b.info.addr, "fault_plan_clear", None)
+            assert out == {"cleared": True}
+            assert faults.active_plan() is None  # in-process: shared
+        finally:
+            faults.clear_plan()
+            a.stop()
+            b.stop()
+
+
+@pytest.mark.leaks_keys
+def test_rest_faults_surface_gated(monkeypatch):
+    import urllib.error
+    import urllib.request
+
+    from h2o3_tpu.api import start_server
+
+    def req(server, method, path, data=None):
+        body = json.dumps(data).encode() if data is not None else None
+        headers = {"Content-Type": "application/json"} if body else {}
+        r = urllib.request.Request(
+            server.url + path, data=body, headers=headers, method=method)
+        try:
+            with urllib.request.urlopen(r) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    monkeypatch.delenv("H2O3_TPU_FAULTS", raising=False)
+    monkeypatch.delenv("H2O3_TPU_FAULT_PLAN", raising=False)
+    s = start_server(port=0)
+    try:
+        st, _ = req(s, "GET", "/3/Faults")
+        assert st == 403  # production boots never expose the nemesis
+        monkeypatch.setenv("H2O3_TPU_FAULTS", "1")
+        st, body = req(s, "POST", "/3/Faults", {
+            "seed": 3, "rules": [{"action": "delay", "method": "x",
+                                  "delay_ms": 1.0}]})
+        assert st == 200 and body["installed"] and body["rules"] == 1
+        st, body = req(s, "GET", "/3/Faults")
+        assert st == 200 and body["plan"]["seed"] == 3
+        st, _ = req(s, "POST", "/3/Faults",
+                    {"rules": [{"action": "explode"}]})
+        assert st == 400
+        st, body = req(s, "DELETE", "/3/Faults")
+        assert st == 200
+        assert faults.active_plan() is None
+    finally:
+        faults.clear_plan()
+        s.stop()

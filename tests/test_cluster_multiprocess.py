@@ -363,3 +363,120 @@ class TestThreeNodeFormation:
         finally:
             for p in procs:
                 p.kill()
+
+
+def _write_chaos_mr_worker(tmp):
+    """worker0: forms a 3-node cloud with two nodeproc peers, scripts a
+    server-side dtask delay onto the victim (w2) through the nemesis RPC
+    surface, then runs distributed map_reduce while the harness SIGKILLs
+    the victim mid-flight.  Asserts the result is bit-identical to the
+    local path, that the victim's range was rescheduled onto a SURVIVOR
+    (not re-run caller-locally), and that the survivor's own meters
+    prove it absorbed the extra range."""
+    with open(os.path.join(tmp, "mrfns.py"), "w") as f:
+        f.write(
+            "import jax.numpy as jnp\n"
+            "def stat(cols, mask):\n"
+            "    return {'s': jnp.sum(jnp.where(mask, cols['x'], 0.0)),\n"
+            "            'n': jnp.sum(mask.astype(jnp.float32))}\n")
+    script = f"""
+import sys, time
+sys.path.insert(0, {REPO!r})
+sys.path.insert(0, {tmp!r})
+import numpy as np
+import mrfns
+from h2o3_tpu.cluster.membership import Cloud
+from h2o3_tpu.cluster import tasks as ctasks
+from h2o3_tpu.util import telemetry
+
+cloud = Cloud("killcloud", "w0", hb_interval=0.2)
+ctasks.install(cloud)
+import os
+with open({tmp!r} + "/w0.addr.tmp", "w") as f:
+    f.write(f"{{cloud.info.host}}:{{cloud.info.port}}\\n")
+os.replace({tmp!r} + "/w0.addr.tmp", {tmp!r} + "/w0.addr")
+cloud.start([])
+deadline = time.monotonic() + 90
+while time.monotonic() < deadline:
+    if cloud.size() == 3 and cloud.consensus():
+        break
+    time.sleep(0.05)
+assert cloud.size() == 3, f"cloud never formed: {{cloud.size()}}"
+
+victim = next(m for m in cloud.members_sorted() if m.info.name == "w2")
+survivor = next(m for m in cloud.members_sorted() if m.info.name == "w1")
+# nemesis: the victim sits on its dtask long enough for the harness's
+# SIGKILL (fired on "MR START") to land while the range is in flight
+out = cloud.client.call(victim.info.addr, "fault_plan_set", {{
+    "seed": 7, "rules": [{{"action": "delay", "side": "server",
+                           "method": "dtask", "delay_ms": 2500}}]}})
+assert out["installed"], out
+
+cols = {{"x": np.arange(4001, dtype=np.float64)}}
+local = ctasks.distributed_map_reduce(mrfns.stat, cols, cloud=None)
+print("MR START", flush=True)
+dist = ctasks.distributed_map_reduce(mrfns.stat, cols, cloud=cloud)
+for k in ("s", "n"):
+    a, b = np.asarray(local[k]), np.asarray(dist[k])
+    assert a.tobytes() == b.tobytes(), f"{{k}}: {{a}} != {{b}}"
+
+# the dead member's range went to a SURVIVOR, not the caller-local
+# last resort
+rec = telemetry.REGISTRY.get("cluster_fanout_recovered_total")
+assert rec is not None and rec.value(path="survivor") >= 1, (
+    rec and rec.value(path="survivor"))
+# remote-side proof: the survivor's own meters counted both its range
+# and the rescheduled one
+peer_metrics = cloud.client.call(
+    survivor.info.addr, "metrics", None, timeout=10.0)
+assert peer_metrics.get("cluster_tasks_total", 0) >= 2, peer_metrics
+
+# and the cloud reconverges on the survivors
+deadline = time.monotonic() + 60
+while time.monotonic() < deadline:
+    if cloud.size() == 2:
+        break
+    time.sleep(0.05)
+assert cloud.size() == 2, f"victim never removed: {{cloud.size()}}"
+cloud.stop()
+print("W0 OK", flush=True)
+"""
+    path = os.path.join(tmp, "worker0_chaos.py")
+    with open(path, "w") as f:
+        f.write(script)
+    return path
+
+
+class TestSigkillDuringFanout:
+    """SIGKILL a member while its map_reduce range is in flight: the
+    cluster — not the caller — absorbs the loss, bit-exactly."""
+
+    def test_sigkill_mid_map_reduce(self, tmp_path):
+        tmp = str(tmp_path)
+        env = _env()
+        env["H2O3_TPU_FAULTS"] = "1"  # nemesis RPC surface on every node
+        w0 = _Proc([sys.executable, _write_chaos_mr_worker(tmp)],
+                   cwd=tmp, env=env)
+        peers = {}
+        try:
+            addr0 = _wait_file(os.path.join(tmp, "w0.addr"))
+            flat = os.path.join(tmp, "flat")
+            with open(flat, "w") as f:
+                f.write(addr0 + "\n")
+            for name in ("w1", "w2"):
+                peers[name] = _Proc(
+                    [sys.executable, "-m", "h2o3_tpu.cluster.nodeproc",
+                     "--cluster-name", "killcloud", "--node-name", name,
+                     "--flatfile", flat, "--hb-interval", "0.2"],
+                    cwd=tmp, env=env)
+            w0.wait_for_line("MR START", timeout=240)
+            # the victim's injected 2.5s dtask delay is still ticking:
+            # this SIGKILL lands while it owns an in-flight range
+            time.sleep(0.8)
+            peers["w2"].kill(signal.SIGKILL)
+            w0.wait_for_line("W0 OK", timeout=240)
+            assert w0.proc.wait(timeout=30) == 0
+        finally:
+            for p in peers.values():
+                p.kill()
+            w0.kill()
