@@ -56,9 +56,9 @@ _CACHE_ENV = {
 # reach CPU children either.
 if os.environ.get("BENCH_FORCE_CPU") or "--cache-bench" in sys.argv \
         or "--parse-bench" in sys.argv or "--cluster-bench" in sys.argv \
-        or "--chaos-bench" in sys.argv:
-    # --cache-bench / --parse-bench / --cluster-bench / --chaos-bench
-    # are CPU-only by construction: same hazard
+        or "--chaos-bench" in sys.argv or "--serve-bench" in sys.argv:
+    # --cache-bench / --parse-bench / --cluster-bench / --chaos-bench /
+    # --serve-bench are CPU-only by construction: same hazard
     for _k in _CACHE_ENV:
         os.environ.pop(_k, None)
 else:
@@ -898,6 +898,247 @@ def _chaos_bench() -> None:
         set_local_cloud(None)
 
 
+def _serve_bench():
+    """Serving-plane microbench (the async front-end's price tags).
+
+    Trains one GBM in-process, parks a scoring frame in DKV, then runs
+    closed-loop keep-alive HTTP clients (asyncio, one loop — 4096 real
+    client threads would measure the client, not the server) against three
+    transports: the thread-per-connection baseline (server_threaded.py),
+    the event loop with coalescing off, and the event loop with the
+    scoring coalescer on.  Per cell: first-request (cold) latency, warm
+    p50/p99, RPS, status mix.  The headline is warm scoring RPS of the
+    coalescing event loop vs the threaded baseline at the reference
+    client count; the overload cell (4096 clients) must answer with
+    nothing outside 2xx/408/413/429.  Prints ONE JSON line and mirrors
+    it to SERVE_BENCH.json.  CPU-only: scoring programs are tiny.
+    BENCH_SERVE_SMOKE=1 shrinks everything for the tier-1 test."""
+    import asyncio
+    import platform
+    import threading  # noqa: F401  (server machinery: imported for clarity)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import numpy as np
+
+    from h2o3_tpu import Frame
+    from h2o3_tpu.api.server import H2OServer
+    from h2o3_tpu.api.server_threaded import ThreadedH2OServer
+    from h2o3_tpu.keyed import DKV
+    from h2o3_tpu.models.tree.gbm import GBM
+    from h2o3_tpu.util import telemetry
+
+    smoke = bool(os.environ.get("BENCH_SERVE_SMOKE"))
+    n_train = 1500 if smoke else 20000
+    n_score = 256 if smoke else 2048
+    ntrees = 3 if smoke else 8
+    duration = 0.4 if smoke else 3.0
+    client_counts = [4] if smoke else [16, 256, 4096]
+    ref_clients = 4 if smoke else 256
+    overload_clients = 4 if smoke else 4096
+    # thread-per-connection cannot field the overload cell: 4096 clients
+    # would need 4096 server threads on this host
+    threaded_max_clients = 256
+    pred_keyspace = 64  # predictions_frame targets cycle: DKV stays bounded
+
+    Xtr, ytr = synth_higgs(n_train, seed=1)
+    names = [f"x{i}" for i in range(Xtr.shape[1])]
+    train_fr = Frame.from_dict(
+        {n: Xtr[:, i] for i, n in enumerate(names)} | {"y": ytr})
+    model = GBM(response_column="y", ntrees=ntrees, max_depth=4,
+                seed=7).train(train_fr)
+    Xs, _ = synth_higgs(n_score, seed=2)
+    score_fr = Frame.from_dict({n: Xs[:, i] for i, n in enumerate(names)})
+    score_fr.key = "serve_bench.hex"
+    DKV.put(score_fr.key, score_fr)
+    path = f"/3/Predictions/models/{model.key}/frames/{score_fr.key}"
+
+    def _request_bytes(i):
+        body = json.dumps(
+            {"predictions_frame": f"serve_bench_pred_{i % pred_keyspace}"}
+        ).encode()
+        return (f"POST {path} HTTP/1.1\r\nHost: localhost\r\n"
+                f"Content-Type: application/json\r\n"
+                f"Content-Length: {len(body)}\r\n\r\n"
+                ).encode() + body
+
+    async def _read_response(reader):
+        line = await reader.readline()
+        if not line:
+            raise ConnectionError("server closed")
+        parts = line.split()
+        status = int(parts[1])
+        # the threaded baseline answers HTTP/1.0: close-per-response
+        # unless it says keep-alive (reconnect cost is part of its price)
+        length, keep = 0, parts[0] != b"HTTP/1.0"
+        while True:
+            h = await reader.readline()
+            if h in (b"\r\n", b"\n", b""):
+                break
+            k, _, v = h.decode("latin-1").partition(":")
+            k = k.strip().lower()
+            if k == "content-length":
+                length = int(v)
+            elif k == "connection" and "close" in v.lower():
+                keep = False
+        if length:
+            await reader.readexactly(length)
+        return status, keep
+
+    async def _client(host, port, req, stop_t, lat, statuses, errors,
+                      stagger):
+        await asyncio.sleep(stagger)
+        reader = writer = None
+        try:
+            while time.perf_counter() < stop_t:
+                if writer is None:
+                    try:
+                        reader, writer = await asyncio.open_connection(
+                            host, port)
+                    except OSError:
+                        errors[0] += 1
+                        await asyncio.sleep(0.01)
+                        continue
+                t0 = time.perf_counter()
+                try:
+                    writer.write(req)
+                    await writer.drain()
+                    status, keep = await _read_response(reader)
+                except (OSError, ConnectionError,
+                        asyncio.IncompleteReadError):
+                    errors[0] += 1
+                    writer.close()
+                    writer = None
+                    continue
+                lat.append(time.perf_counter() - t0)
+                statuses[status] = statuses.get(status, 0) + 1
+                if status < 200 or status >= 300:
+                    lat.pop()  # RPS/latency count successes only
+                if not keep:
+                    writer.close()
+                    writer = None
+                if status == 429:
+                    await asyncio.sleep(0.005)  # shed: back off, retry
+        finally:
+            if writer is not None:
+                writer.close()
+
+    async def _run_cell(host, port, n_clients):
+        # cold: the first request a fresh transport serves (process-wide
+        # jit caches persist across cells, so only the first cell pays
+        # the compile — recorded as-is, the matrix shows it)
+        t0 = time.perf_counter()
+        reader, writer = await asyncio.open_connection(host, port)
+        writer.write(_request_bytes(0))
+        await writer.drain()
+        st, _ = await _read_response(reader)
+        cold_ms = (time.perf_counter() - t0) * 1e3
+        writer.close()
+        if st != 200:
+            raise RuntimeError(f"cold request answered {st}")
+        lat, statuses, errors = [], {}, [0]
+        stop_t = time.perf_counter() + duration + 0.25
+        await asyncio.gather(*(
+            _client(host, port, _request_bytes(i), stop_t, lat, statuses,
+                    errors, stagger=0.25 * i / n_clients)
+            for i in range(n_clients)))
+        lat.sort()
+        n_ok = len(lat)
+        return {
+            "cold_ms": round(cold_ms, 2),
+            "p50_ms": round(lat[n_ok // 2] * 1e3, 3) if n_ok else None,
+            "p99_ms": round(lat[min(n_ok - 1, int(n_ok * 0.99))] * 1e3,
+                            3) if n_ok else None,
+            "rps": round(n_ok / duration, 1),
+            "statuses": {str(k): v for k, v in sorted(statuses.items())},
+            "conn_errors": errors[0],
+        }
+
+    servers = [
+        ("threaded", lambda: ThreadedH2OServer(port=0)),
+        ("event_loop", lambda: H2OServer(
+            port=0, http=dict(batch_window_ms=0))),
+        ("event_loop_coalesce", lambda: H2OServer(
+            port=0, http=dict(batch_window_ms=4.0))),
+    ]
+    cells = []
+    warm_rps = {}
+    try:
+        for sname, mk in servers:
+            for n_clients in client_counts:
+                if sname == "threaded" and n_clients > threaded_max_clients:
+                    cells.append({"server": sname, "clients": n_clients,
+                                  "skipped": "thread-per-connection "
+                                             "cannot field this load"})
+                    continue
+                srv = mk().start()
+                try:
+                    cell = asyncio.run(
+                        _run_cell("127.0.0.1", srv.port, n_clients))
+                finally:
+                    srv.stop()
+                cell.update(server=sname, clients=n_clients)
+                cells.append(cell)
+                warm_rps[(sname, n_clients)] = cell["rps"]
+
+        # bit-identity: what the coalesced path left in DKV == serial
+        serial = model.predict(score_fr)
+        got = DKV.get(f"serve_bench_pred_{0}")
+        bit_identical = bool(got is not None and all(
+            np.array_equal(np.asarray(a.data, dtype=np.float64),
+                           np.asarray(b.data, dtype=np.float64))
+            for a, b in zip(serial.columns, got.columns)))
+
+        overload = next(
+            (c for c in cells if c.get("server") == "event_loop_coalesce"
+             and c.get("clients") == overload_clients), None)
+        overload_clean = overload is not None and not [
+            s for s in overload["statuses"]
+            if not (200 <= int(s) < 300 or int(s) in (408, 413, 429))]
+        base = warm_rps.get(("threaded", ref_clients), 0.0)
+        coal = warm_rps.get(("event_loop_coalesce", ref_clients), 0.0)
+        speedup = round(coal / base, 2) if base else 0.0
+        tel = {k: v for k, v in telemetry.REGISTRY.summary().items()
+               if k.startswith(("http_", "predict_batch_size"))}
+        result = {
+            "metric": "serve_warm_rps_speedup",
+            "value": speedup,
+            "unit": (f"x warm scoring RPS at {ref_clients} clients, "
+                     "coalescing event loop vs thread-per-connection"),
+            "vs_baseline": speedup,
+            "detail": {
+                "host_cpus": os.cpu_count(),
+                "platform": platform.platform(),
+                "model": f"GBM ntrees={ntrees} depth=4 on "
+                         f"{n_train}x28 synth-higgs",
+                "score_rows": n_score,
+                "duration_s": duration,
+                "matrix": cells,
+                "bit_identical": bit_identical,
+                "overload_clean": overload_clean,
+                "smoke": smoke,
+            },
+            "telemetry": {k: (round(v, 3) if isinstance(v, float) else v)
+                          for k, v in tel.items()},
+        }
+        if not smoke:
+            with open(os.path.join(_HERE, "SERVE_BENCH.json"), "w") as f:
+                json.dump(result, f, indent=1)
+        print(json.dumps(result))
+        return result
+    finally:
+        DKV.remove(score_fr.key)
+        for i in range(pred_keyspace):
+            try:
+                DKV.remove(f"serve_bench_pred_{i}")
+            except Exception:
+                pass
+        try:
+            DKV.remove(model.key)
+        except Exception:
+            pass
+
+
 def main() -> None:
     t_start = time.time()
     # two probe attempts: a single transient tunnel blip (one-off
@@ -958,5 +1199,7 @@ if __name__ == "__main__":
         _cluster_bench()
     elif "--chaos-bench" in sys.argv:
         _chaos_bench()
+    elif "--serve-bench" in sys.argv:
+        _serve_bench()
     else:
         main()

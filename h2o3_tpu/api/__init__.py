@@ -5,10 +5,15 @@ dispatch, RequestServer.java:56-80,241; 125 v3 endpoints registered in
 RegisterV3Api.java; schema/handler pattern under api/schemas3/), served by
 the ``h2o-webserver-iface`` facade over Jetty.
 
-TPU-native: a threaded stdlib HTTP server (the cluster control plane is
-host-side Python; device compute stays in jitted programs), the same
-versioned route layout (/3/..., /99/Rapids), JSON responses shaped like the
-reference's schema objects so h2o-py-style clients port over.
+TPU-native: an asyncio event-loop front-end (``server.py``) with admission
+control (connection cap, per-route budgets, bounded queue — overload sheds
+429 + Retry-After) and coalesced batched scoring (``coalesce.py``: same-model
+predictions collect for a window and execute as one devcache-warm dispatch);
+the cluster control plane is host-side Python, device compute stays in
+jitted programs.  The same versioned route layout (/3/..., /99/Rapids) and
+JSON responses shaped like the reference's schema objects so h2o-py-style
+clients port over.  The earlier thread-per-connection transport survives in
+``server_threaded.py`` as the serving-bench baseline.
 """
 
 from h2o3_tpu.api.server import H2OServer, start_server

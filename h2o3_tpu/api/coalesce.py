@@ -1,0 +1,156 @@
+"""Scoring coalescer: same-key requests collected for a short window,
+executed as ONE batched dispatch.
+
+Reference: the TensorFlow-Serving batching layer the system paper points
+at — the serving front-end owns batching, the runtime only sees one
+warm-cache dispatch.  Here the event-loop server submits every coalescable
+REST request (POST /3/Predictions, keyed by model) through a Coalescer;
+the first entry arms a window timer, later entries ride along, and the
+batch closes on window expiry or when a bound trips.  Followers never
+occupy worker threads — a whole batch is one job on the bounded pool — so
+batch size is limited by admission control, not by worker count.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from h2o3_tpu.util import telemetry
+
+#: requests per batched dispatch; total_count is the number of dispatches,
+#: sum the number of coalesced requests — the coalescer tests assert on
+#: exactly that ratio
+_BATCH_SIZE = telemetry.histogram(
+    "predict_batch_size",
+    "coalesced scoring requests per batched dispatch",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
+)
+
+
+class _Batch:
+    __slots__ = ("key", "fn", "entries", "groups", "rows", "closed", "timer")
+
+    def __init__(self, key: Any, fn: Callable[[List[Any]], List[Any]]) -> None:
+        self.key = key
+        self.fn = fn
+        self.entries: List[Tuple[Any, Future]] = []
+        self.groups: set = set()
+        self.rows = 0
+        self.closed = False
+        self.timer: Optional[threading.Timer] = None
+
+
+class Coalescer:
+    """Collects submissions against one key for ``window_s``, then runs the
+    batch function ONCE on the worker pool; per-entry futures resolve with
+    its aligned results.
+
+    The window is a bounded latency floor traded for one dispatch instead
+    of N.  A batch closes early when ``max_requests`` entries accumulate or
+    the row total crosses ``max_rows`` — rows are summed over DISTINCT row
+    groups only (identical frames dedup to one scoring pass, so a thousand
+    callers of the same frame cost its rows once, not a thousand times).
+    """
+
+    def __init__(
+        self,
+        dispatch: Callable[[Callable[[], None]], None],
+        window_s: float,
+        max_rows: int,
+        max_requests: int,
+    ) -> None:
+        self._dispatch = dispatch
+        self.window_s = float(window_s)
+        self.max_rows = int(max_rows)
+        self.max_requests = int(max_requests)
+        self._lock = threading.Lock()
+        self._open: Dict[Any, _Batch] = {}
+
+    def submit(
+        self,
+        fn: Callable[[List[Any]], List[Any]],
+        key: Any,
+        payload: Any,
+        rows_hint: int = 0,
+        group: Any = None,
+    ) -> Future:
+        """Queue ``payload`` into the open batch for ``key`` (creating one
+        if needed).  ``fn(payloads)`` runs once per batch and must return
+        one result per payload, aligned; the returned Future resolves with
+        this payload's result."""
+        fut: Future = Future()
+        full = False
+        with self._lock:
+            b = self._open.get(key)
+            if b is None:
+                b = _Batch(key, fn)
+                self._open[key] = b
+                b.timer = threading.Timer(self.window_s, self._close, (b,))
+                b.timer.daemon = True
+                b.timer.start()
+            b.entries.append((payload, fut))
+            g = group if group is not None else object()
+            if g not in b.groups:
+                b.groups.add(g)
+                b.rows += int(rows_hint)
+            full = (len(b.entries) >= self.max_requests
+                    or b.rows > self.max_rows)
+            if full:
+                self._detach(b)
+        if full:
+            self._fire(b)
+        return fut
+
+    def flush(self) -> None:
+        """Close every open batch immediately (server drain: queued
+        scoring requests finish instead of waiting out their window)."""
+        with self._lock:
+            batches = [b for b in self._open.values() if not b.closed]
+            for b in batches:
+                self._detach(b)
+        for b in batches:
+            self._fire(b)
+
+    # -- internals -----------------------------------------------------------
+    def _detach(self, b: _Batch) -> None:
+        # caller holds the lock; after this no submit can join the batch
+        b.closed = True
+        if self._open.get(b.key) is b:
+            del self._open[b.key]
+
+    def _close(self, b: _Batch) -> None:
+        # the window timer path
+        with self._lock:
+            if b.closed:
+                return
+            self._detach(b)
+        self._fire(b)
+
+    def _fire(self, b: _Batch) -> None:
+        if b.timer is not None:
+            b.timer.cancel()
+        self._dispatch(lambda: self._run(b))
+
+    def _run(self, b: _Batch) -> None:
+        _BATCH_SIZE.observe(len(b.entries))
+        try:
+            results = b.fn([p for p, _ in b.entries])
+            if len(results) != len(b.entries):
+                raise RuntimeError(
+                    f"batch fn returned {len(results)} results for "
+                    f"{len(b.entries)} entries"
+                )
+        except BaseException as e:  # noqa: BLE001
+            for _, fut in b.entries:
+                try:
+                    fut.set_exception(e)
+                except Exception:
+                    pass  # drained/cancelled caller: response abandoned
+            return
+        for (_, fut), res in zip(b.entries, results):
+            try:
+                fut.set_result(res)
+            except Exception:
+                pass  # drained/cancelled caller: response abandoned

@@ -819,10 +819,9 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
         finally:
             os.unlink(path)
 
-    def predict(params, model_id, frame_id):
-        m = _get_model(model_id)
-        fr = _get_frame(frame_id)
-        pred = m.predict(fr)
+    def _predict_out(m, model_id, frame_id, params, pred, metrics_fn):
+        """Assemble one /3/Predictions response: register the predictions
+        frame, best-effort metrics + the DKV scoring record."""
         dest = params.get("predictions_frame") or DKV.make_key("pred")
         DKV.put(dest, pred)
         out: Dict[str, Any] = {
@@ -835,7 +834,7 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
             ]
         }
         try:
-            mm = m.model_performance(fr)
+            mm = metrics_fn()
             out["model_metrics"][0].update(_metrics_schema(mm) or {})
             # leave the DKV-resident scoring record the /3/ModelMetrics
             # routes fetch/delete (hex/ModelMetrics.buildKey)
@@ -845,6 +844,97 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
         except Exception:
             pass  # frames without a response can still be scored
         return out
+
+    def predict_batch(requests):
+        """Batched /3/Predictions body: the serving coalescer keys batches
+        on model_id, so every entry here shares one model and the whole
+        batch costs ONE raw-score dispatch (Model.predict_raw_batched) —
+        identical frames score once and share the result, distinct frames
+        row-stack.  Returns one result-or-exception per entry, aligned;
+        exceptions map to the same status the serial handler produces."""
+        results: List[Any] = [None] * len(requests)
+        try:
+            m = _get_model(requests[0][1]["model_id"])
+        except BaseException as e:  # noqa: BLE001
+            return [e] * len(requests)
+        # models with a bespoke predict()/score shape (PCA names PC
+        # columns, aggregator has no row scoring) can't share a raw pass:
+        # serial per entry, exactly the pre-coalescer behavior
+        if type(m).predict is not Model.predict:
+            for i, (params, kw) in enumerate(requests):
+                try:
+                    fr = _get_frame(kw["frame_id"])
+                    results[i] = _predict_out(
+                        m, kw["model_id"], kw["frame_id"], params,
+                        m.predict(fr), lambda fr=fr: m.model_performance(fr))
+                except BaseException as e:  # noqa: BLE001
+                    results[i] = e
+            return results
+        frames: List[Any] = [None] * len(requests)
+        for i, (_params, kw) in enumerate(requests):
+            try:
+                frames[i] = _get_frame(kw["frame_id"])
+            except BaseException as e:  # noqa: BLE001
+                results[i] = e
+        live = [i for i in range(len(requests)) if results[i] is None]
+        try:
+            scored: List[Any] = m.predict_raw_batched(
+                [frames[i] for i in live])
+        except BaseException:  # noqa: BLE001
+            # one bad frame must not poison the batch: retry serially so
+            # only the offender fails
+            scored = []
+            for i in live:
+                try:
+                    pre = m._apply_preprocessors(frames[i])
+                    scored.append((m._predict_raw(pre), pre))
+                except BaseException as e:  # noqa: BLE001
+                    scored.append(e)
+        own_perf = type(m).model_performance is Model.model_performance
+        for i, s in zip(live, scored):
+            params, kw = requests[i]
+            if isinstance(s, BaseException):
+                results[i] = s
+                continue
+            try:
+                raw, pre = s
+                results[i] = _predict_out(
+                    m, kw["model_id"], kw["frame_id"], params,
+                    m.prediction_from_raw(raw),
+                    # reuse the batch's raw scores for the metrics instead
+                    # of scoring again (unless the model overrides
+                    # model_performance with stored stats of its own)
+                    (lambda raw=raw, pre=pre: m._metrics_from_raw(pre, raw))
+                    if own_perf
+                    else (lambda fr=frames[i]: m.model_performance(fr)))
+            except BaseException as e:  # noqa: BLE001
+                results[i] = e
+        return results
+
+    def predict(params, model_id, frame_id):
+        # a single request IS a batch of one — serial and coalesced
+        # scoring share every line of code, which is what makes the
+        # batched results bit-identical by construction
+        out = predict_batch(
+            [(params, {"model_id": model_id, "frame_id": frame_id})])[0]
+        if isinstance(out, BaseException):
+            raise out
+        return out
+
+    def _predict_rows_hint(kw):
+        fr = DKV.peek(kw.get("frame_id", ""))
+        try:
+            return int(getattr(fr, "nrows", 0) or 0)
+        except Exception:
+            return 0
+
+    # coalescing contract with the event-loop server: batch same-model
+    # requests (key), bound batches by summed rows over distinct frames
+    # (group/rows)
+    predict._h2o3_batch = predict_batch
+    predict._h2o3_batch_key = lambda kw: kw.get("model_id")
+    predict._h2o3_batch_group = lambda kw: kw.get("frame_id")
+    predict._h2o3_batch_rows = _predict_rows_hint
 
     # ---- binary persistence (Model.exportBinaryModel / importBinaryModel,
     # /3/Models/.../save + /99/Models.bin; FramePersist save/load) ----------

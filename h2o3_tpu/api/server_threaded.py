@@ -1,0 +1,227 @@
+"""The previous thread-per-connection REST front-end, kept as the serving
+bench baseline.
+
+This is the stdlib ``ThreadingHTTPServer`` implementation ``api/server.py``
+shipped before the event-loop rewrite: one thread per connection, no
+admission control, no batching, a blanket 120s socket timeout as the only
+slow-client guard.  ``bench.py --serve-bench`` runs it head-to-head against
+the event-loop server (with and without the scoring coalescer) so
+SERVE_BENCH.json carries the before/after; nothing else should use it.
+
+It shares the route registry, auth, error shapes and request meters with
+the event-loop server — only the transport differs.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import traceback
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+
+from h2o3_tpu import __version__
+from h2o3_tpu.api.server import (
+    _LIVE_URLS,
+    _REST_REQUESTS,
+    _REST_SECONDS,
+    H2OServer,
+    RestError,
+    _json_default,
+    _trace_header,
+)
+from h2o3_tpu.util import telemetry
+
+
+class ThreadedH2OServer(H2OServer):
+    """Thread-per-connection H2OServer (the pre-event-loop transport)."""
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "ThreadedH2OServer":
+        from h2o3_tpu.util import log as _log
+
+        _log.init()
+        registry = self.registry
+        srv = self
+
+        class Handler(BaseHTTPRequestHandler):
+            server_version = f"h2o3-tpu/{__version__}"
+            timeout = 120  # a dead client must not pin its thread forever
+
+            def log_message(self, *a):  # quiet; the Log subsystem records
+                pass
+
+            def _params(self) -> Dict[str, Any]:
+                parsed = urllib.parse.urlparse(self.path)
+                params: Dict[str, Any] = {
+                    k: v[0] if len(v) == 1 else v
+                    for k, v in urllib.parse.parse_qs(parsed.query).items()
+                }
+                length = int(self.headers.get("Content-Length") or 0)
+                if length:
+                    body = self.rfile.read(length)
+                    ctype = self.headers.get("Content-Type", "")
+                    if "json" in ctype:
+                        params.update(json.loads(body))
+                    elif "octet-stream" in ctype:
+                        params["_raw_body"] = body
+                    else:  # h2o-py posts urlencoded forms
+                        try:
+                            params.update(
+                                {
+                                    k: v[0] if len(v) == 1 else v
+                                    for k, v in urllib.parse.parse_qs(
+                                        body.decode()
+                                    ).items()
+                                }
+                            )
+                        except UnicodeDecodeError:
+                            params["_raw_body"] = body
+                return params
+
+            def _respond(self, method: str) -> None:
+                from h2o3_tpu.util.log import get_logger
+
+                cur = threading.current_thread()
+                if cur.name.startswith("Thread-"):
+                    cur.name = "http-worker"
+                parsed = urllib.parse.urlparse(self.path)
+                found = registry.match(method, parsed.path)
+                route = found[2] if found else "(unmatched)"
+                status = 200
+                ctype = "application/json"
+                extra_headers: List[Tuple[str, str]] = []
+                span: Optional[telemetry.Span] = None
+                t0 = time.perf_counter()
+                if not srv._check_auth(self.headers.get("Authorization")):
+                    get_logger("rest").info("%s %s", method, parsed.path)
+                    status = 401
+                    payload = json.dumps(
+                        {"http_status": 401, "msg": "authentication required"}
+                    ).encode()
+                    extra_headers.append(
+                        ("WWW-Authenticate", 'Basic realm="h2o3-tpu"'))
+                else:
+                    span = telemetry.Span(
+                        "rest", method=method, route=route,
+                        path=parsed.path,
+                        trace_id=_trace_header(
+                            self.headers.get("X-H2O3-Trace-Id")),
+                        parent_id=_trace_header(
+                            self.headers.get("X-H2O3-Span-Id")),
+                    )
+                    try:
+                        with span:
+                            get_logger("rest").info(
+                                "%s %s", method, parsed.path)
+                            if found is None:
+                                raise RestError(
+                                    404,
+                                    f"no route for {method} {parsed.path}",
+                                )
+                            handler, path_kw, _ = found
+                            out = handler(self._params(), **path_kw)
+                        if (
+                            isinstance(out, tuple) and len(out) == 2
+                            and isinstance(out[0], (bytes, bytearray))
+                        ):
+                            payload, ctype = out
+                        elif isinstance(out, (bytes, bytearray)):
+                            payload, ctype = out, "application/octet-stream"
+                        else:
+                            payload = json.dumps(
+                                out, default=_json_default).encode()
+                    except RestError as e:
+                        status = e.status
+                        payload = json.dumps(
+                            {
+                                "http_status": e.status,
+                                "msg": str(e),
+                                "dev_msg": str(e),
+                                "exception_type": "RestError",
+                            }
+                        ).encode()
+                        ctype = "application/json"
+                    except Exception as e:  # noqa: BLE001
+                        status = 500
+                        payload = json.dumps(
+                            {
+                                "http_status": 500,
+                                "msg": f"{type(e).__name__}: {e}",
+                                "dev_msg": traceback.format_exc(),
+                                "exception_type": type(e).__name__,
+                            }
+                        ).encode()
+                        ctype = "application/json"
+                _REST_REQUESTS.inc(
+                    method=method, route=route, status=str(status))
+                _REST_SECONDS.observe(
+                    time.perf_counter() - t0, method=method, route=route)
+                if span is not None and span.trace_id:
+                    extra_headers.append(("X-H2O3-Trace-Id", span.trace_id))
+                self.send_response(status)
+                for k, v in extra_headers:
+                    self.send_header(k, v)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                if (urllib.parse.urlparse(self.path).path == "/3/Steam.web"
+                        and "websocket" in
+                        (self.headers.get("Upgrade") or "").lower()):
+                    if not srv._check_auth(
+                            self.headers.get("Authorization")):
+                        self.send_response(401)
+                        self.end_headers()
+                        return
+                    from h2o3_tpu.api import steam
+
+                    steam.serve_websocket(self)
+                    return
+                self._respond("GET")
+
+            def do_POST(self):
+                self._respond("POST")
+
+            def do_DELETE(self):
+                self._respond("DELETE")
+
+        self._httpd: Optional[ThreadingHTTPServer] = ThreadingHTTPServer(
+            (self.ip, self.port), Handler)
+        if self.ssl_cert:
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(self.ssl_cert, self.ssl_key)
+            # lazy handshake: with do_handshake_on_connect the handshake
+            # would run inside accept(), letting one stalled client block
+            # the accept loop for everyone; deferred, it happens on first
+            # read inside the per-connection handler thread
+            self._httpd.socket = ctx.wrap_socket(
+                self._httpd.socket, server_side=True,
+                do_handshake_on_connect=False,
+            )
+        self.port = self._httpd.server_address[1]
+        from h2o3_tpu import cluster
+
+        _cloud = cluster.local_cloud()
+        if _cloud is not None:
+            _cloud.advertise_rest_port(self.port)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="http-accept",
+        )
+        self._thread.start()
+        _LIVE_URLS.add(self.url)
+        return self
+
+    def stop(self) -> None:
+        httpd, self._httpd = getattr(self, "_httpd", None), None
+        if httpd:
+            _LIVE_URLS.discard(self.url)
+            httpd.shutdown()
+            httpd.server_close()

@@ -16,7 +16,7 @@ from __future__ import annotations
 import time
 import uuid
 from dataclasses import dataclass, field as dataclass_field
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -204,18 +204,78 @@ class Model:
     def predict(self, frame: Frame) -> Frame:
         """Predictions frame: 'predict' (+ per-class probability columns)."""
         frame = self._apply_preprocessors(frame)
-        raw = self._predict_raw(frame)
+        return self.prediction_from_raw(self._predict_raw(frame))
+
+    def prediction_from_raw(self, raw: np.ndarray) -> Frame:
+        """Raw scores -> the predictions frame (the second half of
+        ``predict``; the serving coalescer computes raw once per batch and
+        fans it out per caller through here)."""
         if not self.is_classifier:
             return prediction_frame(raw, None)
         return prediction_frame(raw, self.data_info.response_domain,
                                 self.default_threshold())
 
+    def predict_raw_batched(
+        self, frames: Sequence[Frame]
+    ) -> List[Tuple[np.ndarray, Frame]]:
+        """One raw-score pass over several frames (the coalesced REST
+        scoring entry).  Returns ``(raw, preprocessed_frame)`` per input,
+        aligned.  Identical frames — same object, or equal (names, types,
+        version) stamps, the devcache identity — score ONCE and share the
+        result; distinct frames with one schema row-stack into a single
+        ``_predict_raw`` dispatch and split back per caller.  Every
+        ``_predict_raw`` scores row-wise (no cross-row coupling), so both
+        paths are bit-identical to per-frame calls; anything unstackable
+        falls back to one dispatch per distinct frame."""
+        pres = [self._apply_preprocessors(f) for f in frames]
+        uniq: List[Frame] = []
+        which: List[int] = []
+        seen: Dict[Any, int] = {}
+        for f in pres:
+            try:
+                sig: Any = (tuple(f.names),
+                            tuple(c.type for c in f.columns), f.version)
+            except Exception:
+                sig = id(f)
+            i = seen.get(sig)
+            if i is None:
+                i = seen[sig] = len(uniq)
+                uniq.append(f)
+            which.append(i)
+        if len(uniq) == 1:
+            raws = [self._predict_raw(uniq[0])]
+        else:
+            head = uniq[0]
+            same_schema = all(
+                u.names == head.names
+                and [c.type for c in u.columns]
+                == [c.type for c in head.columns]
+                for u in uniq[1:]
+            )
+            if same_schema:
+                stacked = head
+                for u in uniq[1:]:
+                    stacked = stacked.rbind(u)
+                raw_all = self._predict_raw(stacked)
+                raws, off = [], 0
+                for u in uniq:
+                    raws.append(raw_all[off:off + u.nrows])
+                    off += u.nrows
+            else:
+                raws = [self._predict_raw(u) for u in uniq]
+        return [(raws[i], pres[k]) for k, i in enumerate(which)]
+
     def model_performance(self, frame: Frame) -> Any:
         """Score a frame and build the right ModelMetrics (Model.score + MM builders)."""
+        frame = self._apply_preprocessors(frame)
+        return self._metrics_from_raw(frame, self._predict_raw(frame))
+
+    def _metrics_from_raw(self, frame: Frame, raw: np.ndarray) -> Any:
+        """ModelMetrics from an already-computed raw score over an already-
+        preprocessed frame — ``model_performance`` minus the scoring pass,
+        so the batched REST path never scores the same frame twice."""
         from h2o3_tpu.models.data_info import response_vector
 
-        frame = self._apply_preprocessors(frame)
-        raw = self._predict_raw(frame)
         y = response_vector(self.data_info, frame)
         w = (
             frame.col(self.params.weights_column).numeric_view()

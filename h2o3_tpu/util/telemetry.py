@@ -231,6 +231,25 @@ class Counter(Metric):
         }
 
 
+class _GaugeTrack:
+    """with-block in-flight accounting: inc on entry, dec on exit.  Key
+    resolution happens once at :meth:`Gauge.track` time, so entering the
+    block on a hot path (one per REST request) is a lock plus a dict op."""
+
+    __slots__ = ("_metric", "_key")
+
+    def __init__(self, metric: "Gauge", key: Tuple[str, ...]) -> None:
+        self._metric = metric
+        self._key = key
+
+    def __enter__(self) -> "_GaugeTrack":
+        self._metric._inc_key(self._key, 1.0)
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self._metric._inc_key(self._key, -1.0)
+
+
 class Gauge(Metric):
     """A value that goes both ways (dkv_keys, mesh_devices, ...)."""
 
@@ -257,6 +276,12 @@ class Gauge(Metric):
         key = self._key(labels)
         with self._lock:
             return float(self._series.get(key, 0.0))
+
+    def track(self, **labels: Any) -> _GaugeTrack:
+        """Context manager: inc on entry, dec on exit — the in-flight
+        idiom (http_inflight while a request is admitted, connections
+        while open) without the try/finally boilerplate."""
+        return _GaugeTrack(self, self._key(labels))
 
     expose = Counter.expose
     snapshot = Counter.snapshot
