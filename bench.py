@@ -56,9 +56,10 @@ _CACHE_ENV = {
 # reach CPU children either.
 if os.environ.get("BENCH_FORCE_CPU") or "--cache-bench" in sys.argv \
         or "--parse-bench" in sys.argv or "--cluster-bench" in sys.argv \
-        or "--chaos-bench" in sys.argv or "--serve-bench" in sys.argv:
+        or "--chaos-bench" in sys.argv or "--serve-bench" in sys.argv \
+        or "--rapids-bench" in sys.argv:
     # --cache-bench / --parse-bench / --cluster-bench / --chaos-bench /
-    # --serve-bench are CPU-only by construction: same hazard
+    # --serve-bench / --rapids-bench are CPU-only by construction: same hazard
     for _k in _CACHE_ENV:
         os.environ.pop(_k, None)
 else:
@@ -342,6 +343,120 @@ def _cache_bench() -> None:
         "jit_cache_hit_ratio": round(hits / total, 3) if total else None,
         "telemetry": summary,
     }))
+
+
+def _rapids_bench() -> None:
+    """CPU-runnable rapids query-fusion bench (fusion PR acceptance).
+
+    One ~20-op munging pipeline (column selects, scale, abs-clip via
+    ifelse, sqrt, floor, modulo, compare, sum reduce) over a generated
+    2-column frame, three ways: op-at-a-time interpreter
+    (H2O3_TPU_RAPIDS_FUSION=0), fused cold (first dispatch: lowering +
+    trace + compile + upload), fused warm (plan cache + devcache hits).
+    Asserts fused/interpreted bit-identity in-run and a zero-recompile,
+    zero-upload warm path; a second pipeline with a non-fusible log1p in
+    the middle pins fallback-at-the-boundary parity. Writes
+    RAPIDS_BENCH.json and prints the same JSON (`--rapids-bench`)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from h2o3_tpu.frame.frame import Column, ColType, Frame
+    from h2o3_tpu.rapids.runtime import Session, exec_rapids
+    from h2o3_tpu.util import telemetry
+
+    n_rows = int(os.environ.get("BENCH_RAPIDS_ROWS", 2_000_000))
+    reps = int(os.environ.get("BENCH_RAPIDS_REPS", 5))
+    rng = np.random.default_rng(7)
+    session = Session()
+    fr = Frame([
+        Column("x", rng.standard_normal(n_rows), ColType.NUM),
+        Column("y", rng.standard_normal(n_rows), ColType.NUM),
+    ])
+    session.assign("rb", fr)
+
+    # all-fusible ~20-op pipeline, one scalar out (sum-reduce root)
+    pipeline = (
+        "(sum (* (+ (sqrt (abs (+ (cols_py rb 0) (cols_py rb 1)))) "
+        "(ifelse (> (cols_py rb 0) 0) (cols_py rb 0) (- 0 (cols_py rb 0)))) "
+        "(+ (* (floor (cols_py rb 1)) 0.25) (% (cols_py rb 0) 3))))"
+    )
+    # same shape with a non-fusible log1p inside: the region fractures at
+    # the boundary and must still be bit-identical
+    mixed = (
+        "(sum (* (log1p (abs (+ (cols_py rb 0) (cols_py rb 1)))) "
+        "(+ (* (floor (cols_py rb 1)) 0.25) (% (cols_py rb 0) 3))))"
+    )
+
+    def bits(v: float) -> int:
+        return int(np.float64(v).view(np.uint64))
+
+    def run(expr, fusion: bool) -> tuple:
+        os.environ["H2O3_TPU_RAPIDS_FUSION"] = "1" if fusion else "0"
+        t0 = time.perf_counter()
+        out = exec_rapids(expr, session)
+        return time.perf_counter() - t0, float(out.value)
+
+    def counters():
+        def val(name, **labels):
+            c = telemetry.REGISTRY.get(name)
+            return float(c.value(**labels)) if c is not None else 0.0
+
+        return {
+            "jit_miss": val("mapreduce_jit_cache_total",
+                            op="map_batches", result="miss"),
+            "plan_miss": val("mapreduce_plan_cache_total",
+                             op="rapids_fusion", result="miss"),
+            "upload_bytes": val("shard_bytes_total"),
+            "devcache_miss": val("devcache_requests_total",
+                                 kind="frame_table", result="miss"),
+        }
+
+    interp_s, interp_v = zip(*(run(pipeline, fusion=False)
+                               for _ in range(reps)))
+    cold_s, cold_v = run(pipeline, fusion=True)
+    snap = counters()
+    warm = [run(pipeline, fusion=True) for _ in range(reps)]
+    warm_s = [t for t, _ in warm]
+    warm_deltas = {k: counters()[k] - snap[k] for k in snap}
+
+    mixed_interp = run(mixed, fusion=False)[1]
+    mixed_fused = run(mixed, fusion=True)[1]
+
+    values = {interp_v[0], cold_v} | {v for _, v in warm}
+    bit_identical = len({bits(v) for v in values}) == 1
+    mixed_identical = bits(mixed_interp) == bits(mixed_fused)
+    warm_clean = all(v == 0.0 for v in warm_deltas.values())
+
+    interp_best = min(interp_s)
+    warm_best = min(warm_s)
+    fusion_counter = telemetry.REGISTRY.get("rapids_fusion_total")
+    result = {
+        "metric": "rapids_fusion_warm_speedup",
+        "unit": "x (interpreted wall / fused warm wall, same pipeline)",
+        "n_rows": n_rows,
+        "pipeline_ops": 20,
+        "interpreted_s": round(interp_best, 4),
+        "fused_cold_s": round(cold_s, 4),
+        "fused_warm_s": round(warm_best, 4),
+        "speedup_warm": round(interp_best / warm_best, 2),
+        "rows_per_sec": {
+            "interpreted": int(n_rows / interp_best),
+            "fused_warm": int(n_rows / warm_best),
+        },
+        "bit_identical": bit_identical,
+        "mixed_fallback_bit_identical": mixed_identical,
+        "warm_zero_recompile_zero_upload": warm_clean,
+        "warm_deltas": warm_deltas,
+        "fused_regions": fusion_counter.value(result="fused"),
+        "fallback_regions": fusion_counter.value(result="fallback"),
+    }
+    with open(os.path.join(_HERE, "RAPIDS_BENCH.json"), "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))
+    if not (bit_identical and mixed_identical and warm_clean):
+        sys.exit(1)
 
 
 def _parse_bench_csv(target_mb: float) -> str:
@@ -1201,5 +1316,7 @@ if __name__ == "__main__":
         _chaos_bench()
     elif "--serve-bench" in sys.argv:
         _serve_bench()
+    elif "--rapids-bench" in sys.argv:
+        _rapids_bench()
     else:
         main()

@@ -165,6 +165,35 @@ def _get_plan(op: str, fn: Callable, reduce: str, table: "FrameTable",
     return plan
 
 
+def plan_memo(namespace: str, key: Tuple, build: Callable[[], object]):
+    """Generic entry point into the dispatch plan cache for callers that
+    assemble their own compiled programs (the rapids fusion pass memoizes
+    lowered column-programs here keyed on canonical S-expression + input
+    schema). Shares the LRU — and its budget and eviction accounting — with
+    the shard_map dispatch plans; evicting a fused plan also retires the
+    jitted program it holds, since map_batches keys on the program's
+    function identity."""
+    full = ("memo", namespace, key)
+    with _plans_lock:
+        hit = _plans.get(full)
+        if hit is not None:
+            _plans.move_to_end(full)
+            _PLAN_CACHE.inc(op=namespace, result="hit")
+            return hit
+    _PLAN_CACHE.inc(op=namespace, result="miss")
+    value = build()
+    with _plans_lock:
+        existing = _plans.get(full)
+        if existing is not None:
+            return existing  # lost a build race: converge on one plan
+        _plans[full] = value
+        limit = _plan_cache_size()
+        while len(_plans) > limit:
+            _plans.popitem(last=False)
+            _PLAN_EVICTIONS.inc()
+    return value
+
+
 def _dispatch(op: str, table: "FrameTable", call):
     """Shared accounting envelope: count + span + jit hit/miss attribution."""
     telemetry.install_jax_compile_listener()

@@ -38,6 +38,7 @@ __all__ = [
     "device_nbytes",
     "frame_token",
     "mesh_fingerprint",
+    "region_token",
 ]
 
 #: cache traffic by placement kind (frame_table, glm_design, tree_bins, ...)
@@ -105,6 +106,24 @@ def frame_token(frame, columns: Optional[Sequence[str]] = None) -> Optional[Tupl
     except (AttributeError, KeyError, TypeError):
         return None
     return ("frame", nrows, token)
+
+
+def region_token(inputs: Sequence[Tuple[Any, Sequence[str]]]) -> Optional[Tuple]:
+    """Combined data-identity token over several ``(frame, columns)`` inputs.
+
+    The fusion plan-cache entry point: a fused region reads column subsets of
+    one or more frames, and this token — a tuple of per-input
+    :func:`frame_token` stamps — identifies the exact device-input state of
+    one dispatch. Equal tokens mean every referenced column is byte-identical,
+    so per-dispatch input validation (dtype/str checks) can be memoized on
+    it. None if any input lacks version stamps (callers then re-validate)."""
+    parts = []
+    for frame, columns in inputs:
+        tok = frame_token(frame, list(columns))
+        if tok is None:
+            return None
+        parts.append(tok)
+    return ("region", tuple(parts))
 
 
 def device_nbytes(value: Any) -> int:

@@ -205,6 +205,37 @@ def _expand_range(tok: str) -> np.ndarray:
     return base + stride * np.arange(count, dtype=np.float64)
 
 
+def canonical_sexpr(node: AstNode) -> str:
+    """Deterministic S-expression serialization of an AST subtree.
+
+    The fusion pass keys compiled column-programs on this string (plus the
+    input schema), so two textually different but structurally identical
+    expressions share one compiled plan. Number literals serialize through
+    ``repr(float)`` (shortest round-trip form), strings are quoted/escaped,
+    lists expand to their parsed elements — whitespace and range-syntax
+    differences in the source text cannot split the cache.
+    """
+    if isinstance(node, AstNum):
+        return repr(node.value)
+    if isinstance(node, AstStr):
+        return '"' + node.value.replace("\\", "\\\\").replace('"', '\\"') + '"'
+    if isinstance(node, AstId):
+        return node.name
+    if isinstance(node, AstNumList):
+        return "[" + " ".join(repr(float(v)) for v in node.values) + "]"
+    if isinstance(node, AstStrList):
+        return "[" + " ".join(
+            '"' + s.replace("\\", "\\\\").replace('"', '\\"') + '"'
+            for s in node.values
+        ) + "]"
+    if isinstance(node, AstExec):
+        parts = [canonical_sexpr(node.op)] + [canonical_sexpr(a) for a in node.args]
+        return "(" + " ".join(parts) + ")"
+    if isinstance(node, AstFun):
+        return "{" + " ".join(node.params) + " . " + canonical_sexpr(node.body) + "}"
+    raise RapidsParseError(f"cannot serialize {node!r}")
+
+
 def _parse_fun(sc: _Scanner) -> AstFun:
     sc.next()  # {
     params: List[str] = []

@@ -6,23 +6,88 @@ name; clients emit exactly these ops (SURVEY.md Appendix A inventory).
 
 Here each primitive is a function ``prim(env, args: List[Val]) -> Val``
 registered under one or more rapids names.
+
+Fusibility: a prim may additionally declare itself *fusible* — eligible for
+the rapids fusion pass (h2o3_tpu/rapids/fusion.py), which compiles maximal
+subtrees of fusible ops into ONE jitted column-program instead of
+interpreting them op-at-a-time. A fusible prim carries an ``emit(jnp, *args)``
+tracer that reproduces its host-numpy elementwise semantics **bit-exactly**
+under XLA (float64): only prims whose emitters pass the bit-parity suite in
+tests/test_rapids_fusion.py may claim the flag, and
+scripts/check_telemetry.py lints that every flagged prim has both an emitter
+and a parity case. Prims whose XLA counterpart differs from numpy in even
+the last ulp (pow, the transcendental family, scipy specials) deliberately
+stay unfused and run through the interpreter at region boundaries.
 """
 
 from __future__ import annotations
 
-from typing import Callable, Dict, List
+from typing import Callable, Dict, List, Optional
 
 PRIMS: Dict[str, Callable] = {}
 
 
-def prim(*names: str):
-    """Register a primitive under the given rapids op names."""
+class FuseSpec:
+    """Fusibility declaration for one prim.
+
+    kind:
+      * ``binop``  — 2-arg elementwise with H2O broadcasting (emit required)
+      * ``uniop``  — 1-arg columnwise map (emit required)
+      * ``ifelse`` — 3-arg vectorized conditional (emit required)
+      * ``select`` — static column re-indexing (cols/cols_py; structural,
+                     no emit — the fusion pass rewires column references)
+      * ``reduce`` — trailing reducer: the fused program materializes its
+                     child chain in one dispatch and the reducer itself runs
+                     as a host epilogue THROUGH the registered prim, so the
+                     combine is bit-identical to the interpreter by
+                     construction (numpy pairwise summation vs an XLA
+                     reduction would differ in rounding)
+
+    ``fuse_args(ast_args)`` — optional static predicate over the *unevaluated*
+    AST argument list; a node whose args fail it is treated as a region leaf
+    (e.g. ``round`` only fuses the digits=0 form, ``cols`` only literal
+    selectors, reducers only the single-arg form).
+    """
+
+    __slots__ = ("name", "kind", "emit", "fuse_args")
+
+    _EMIT_KINDS = ("binop", "uniop", "ifelse")
+
+    def __init__(self, name: str, kind: str, emit: Optional[Callable],
+                 fuse_args: Optional[Callable]) -> None:
+        if kind not in ("binop", "uniop", "ifelse", "select", "reduce"):
+            raise RuntimeError(f"prim {name!r}: unknown fuse kind {kind!r}")
+        if kind in self._EMIT_KINDS and emit is None:
+            raise RuntimeError(
+                f"prim {name!r} is flagged fusible ({kind}) but has no "
+                f"emit(jnp) tracer")
+        self.name = name
+        self.kind = kind
+        self.emit = emit
+        self.fuse_args = fuse_args
+
+
+#: rapids name -> FuseSpec for every prim the fusion pass may fold
+FUSIBLE: Dict[str, FuseSpec] = {}
+
+
+def prim(*names: str, fusible: bool = False, kind: Optional[str] = None,
+         emit: Optional[Callable] = None,
+         fuse_args: Optional[Callable] = None):
+    """Register a primitive under the given rapids op names.
+
+    ``fusible=True`` additionally registers a :class:`FuseSpec` so the
+    fusion pass may fold the op into a compiled column-program; ``kind``,
+    ``emit`` and ``fuse_args`` describe how (see FuseSpec).
+    """
 
     def deco(fn):
         for n in names:
             if n in PRIMS:
                 raise RuntimeError(f"duplicate rapids prim {n!r}")
             PRIMS[n] = fn
+            if fusible:
+                FUSIBLE[n] = FuseSpec(n, kind, emit, fuse_args)
         return fn
 
     return deco

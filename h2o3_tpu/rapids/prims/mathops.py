@@ -16,8 +16,8 @@ from h2o3_tpu.rapids.prims.util import map_columns
 from h2o3_tpu.rapids.runtime import RapidsError, Val
 
 
-def _uniop(name: str, fn):
-    @prim(name)
+def _uniop(name: str, fn, emit=None):
+    @prim(name, fusible=emit is not None, kind="uniop", emit=emit)
     def op(env, args, fn=fn, name=name):
         if len(args) != 1:
             raise RapidsError(f"{name} expects 1 arg")
@@ -30,39 +30,52 @@ def _uniop(name: str, fn):
     return op
 
 
-_uniop("abs", np.abs)
+def _e_sign(jnp, x):
+    # numpy's sign(-0.0) is +0.0; XLA's keeps the zero's sign
+    return jnp.where(x == 0.0, 0.0, jnp.sign(x))
+
+
+# Fusible unaries are exactly the ops whose XLA float64 output is
+# bit-identical to numpy's for every input (exact arithmetic / rounding /
+# selection, plus sin/cos whose libm tables agree on this backend — all
+# verified by the tests/test_rapids_fusion.py parity suite). The
+# transcendental family (exp/log/tan/hyperbolics/inverse-trig) and the scipy
+# specials differ from numpy in the last ulp under XLA and stay interpreted.
+_uniop("abs", np.abs, emit=lambda jnp, x: jnp.abs(x))
 _uniop("acos", np.arccos)
 _uniop("acosh", np.arccosh)
 _uniop("asin", np.arcsin)
 _uniop("asinh", np.arcsinh)
 _uniop("atan", np.arctan)
 _uniop("atanh", np.arctanh)
-_uniop("ceiling", np.ceil)
-_uniop("cos", np.cos)
-_uniop("cospi", lambda x: np.cos(np.pi * x))
+_uniop("ceiling", np.ceil, emit=lambda jnp, x: jnp.ceil(x))
+_uniop("cos", np.cos, emit=lambda jnp, x: jnp.cos(x))
+_uniop("cospi", lambda x: np.cos(np.pi * x),
+       emit=lambda jnp, x: jnp.cos(jnp.pi * x))
 _uniop("cosh", np.cosh)
 _uniop("digamma", _sp_special.digamma)
 _uniop("exp", np.exp)
 _uniop("expm1", np.expm1)
-_uniop("floor", np.floor)
+_uniop("floor", np.floor, emit=lambda jnp, x: jnp.floor(x))
 _uniop("gamma", _sp_special.gamma)
 _uniop("lgamma", _sp_special.gammaln)
 _uniop("log", np.log)
 _uniop("log10", np.log10)
 _uniop("log1p", np.log1p)
 _uniop("log2", np.log2)
-_uniop("sgn", np.sign)
-_uniop("sign", np.sign)
-_uniop("sin", np.sin)
-_uniop("sinpi", lambda x: np.sin(np.pi * x))
+_uniop("sgn", np.sign, emit=_e_sign)
+_uniop("sign", np.sign, emit=_e_sign)
+_uniop("sin", np.sin, emit=lambda jnp, x: jnp.sin(x))
+_uniop("sinpi", lambda x: np.sin(np.pi * x),
+       emit=lambda jnp, x: jnp.sin(jnp.pi * x))
 _uniop("sinh", np.sinh)
-_uniop("sqrt", np.sqrt)
+_uniop("sqrt", np.sqrt, emit=lambda jnp, x: jnp.sqrt(x))
 _uniop("tan", np.tan)
 _uniop("tanpi", lambda x: np.tan(np.pi * x))
 _uniop("tanh", np.tanh)
 _uniop("trigamma", lambda x: _sp_special.polygamma(1, x))
-_uniop("trunc", np.trunc)
-_uniop("none", lambda x: x)  # AstNoOp
+_uniop("trunc", np.trunc, emit=lambda jnp, x: jnp.trunc(x))
+_uniop("none", lambda x: x, emit=lambda jnp, x: x)  # AstNoOp
 
 
 def _round_half_even(x, digits):
@@ -70,7 +83,20 @@ def _round_half_even(x, digits):
     return np.round(x, int(digits))
 
 
-@prim("round")
+def _round_fuse_args(ast_args):
+    # only the digits=0 form fuses: XLA round matches numpy's half-to-even
+    # exactly there, while the scaled digits!=0 path multiplies by 10^d and
+    # diverges in the last ulp
+    from h2o3_tpu.rapids.parser import AstNum
+
+    if len(ast_args) == 1:
+        return True
+    return (len(ast_args) == 2 and isinstance(ast_args[1], AstNum)
+            and ast_args[1].value == 0)
+
+
+@prim("round", fusible=True, kind="uniop",
+      emit=lambda jnp, x: jnp.round(x), fuse_args=_round_fuse_args)
 def round_(env, args):
     digits = args[1].as_num() if len(args) > 1 else 0
     v = args[0]

@@ -50,7 +50,17 @@ def rename(env, args):
 
 
 # -- slicing -----------------------------------------------------------------
-@prim("cols", "cols_py")
+def _cols_fuse_args(ast_args):
+    # a literal selector re-indexes columns statically inside a fused
+    # program; computed selectors (frames, expressions) fall back
+    from h2o3_tpu.rapids.parser import AstNum, AstNumList, AstStr, AstStrList
+
+    return len(ast_args) == 2 and isinstance(
+        ast_args[1], (AstNum, AstNumList, AstStr, AstStrList))
+
+
+@prim("cols", "cols_py", fusible=True, kind="select",
+      fuse_args=_cols_fuse_args)
 def cols(env, args):
     fr = args[0].as_frame()
     return Val.frame(fr.cols([fr.names[i] for i in col_indices(fr, args[1])]))
@@ -238,7 +248,8 @@ def relevel(env, args):
 
 
 # -- NA handling -------------------------------------------------------------
-@prim("is.na")
+@prim("is.na", fusible=True, kind="uniop",
+      emit=lambda jnp, x: jnp.isnan(x).astype(jnp.float64))
 def is_na(env, args):
     fr = args[0].as_frame()
     return Val.frame(

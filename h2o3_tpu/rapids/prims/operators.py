@@ -14,14 +14,65 @@ from h2o3_tpu.rapids.prims.util import binop_frame, numeric_data
 from h2o3_tpu.rapids.runtime import RapidsError, Val
 
 
-def _binop(name: str, fn):
-    @prim(name)
+def _binop(name: str, fn, emit=None):
+    @prim(name, fusible=emit is not None, kind="binop", emit=emit)
     def op(env, args, fn=fn, name=name):
         if len(args) != 2:
             raise RapidsError(f"{name} expects 2 args")
         return _maybe_string_eq(name, args) or binop_frame(args[0], args[1], fn, name)
 
     return op
+
+
+# ---------------------------------------------------------------------------
+# emit(jnp) tracers — the XLA forms of the fusible operators. Each MUST be
+# bit-identical (up to NaN payload) to the host-numpy fn it mirrors for every
+# float64 input; ``^`` (power) stays unfused because XLA's pow differs from
+# numpy in the last ulp for negative exponents.
+
+
+def _e_mod(jnp, a, b):
+    # XLA's mod gives a +0.0 remainder where numpy's carries the divisor's
+    # sign; re-sign exact-zero results to match npy_divmod
+    out = jnp.mod(a, b)
+    return jnp.where(out == 0.0, jnp.copysign(0.0, b), out)
+
+
+def _e_intdiv(jnp, a, b):
+    # replica of numpy's npy_divmod quotient (fmod -> sign adjust -> snap to
+    # integer): plain floor(a/b) diverges on signed zeros, b==0 (numpy
+    # returns a/b there) and inf dividends (numpy's fmod poisons them to NaN)
+    mod = jnp.fmod(a, b)
+    div = (a - mod) / b
+    adj = (mod != 0) & ((b < 0) != (mod < 0))
+    div = jnp.where(adj, div - 1.0, div)
+    fd = jnp.floor(div)
+    fd = jnp.where((div - fd) > 0.5, fd + 1.0, fd)
+    fd = jnp.where(div == 0, jnp.copysign(0.0, a / b), fd)
+    return jnp.where(b == 0, a / b, fd)
+
+
+def _e_cmp(op):
+    def e(jnp, a, b, op=op):
+        out = op(a, b).astype(jnp.float64)
+        na = jnp.isnan(a) | jnp.isnan(b)
+        return jnp.where(na, jnp.nan, out)
+
+    return e
+
+
+def _e_and(jnp, a, b):
+    out = ((a != 0) & (b != 0)).astype(jnp.float64)
+    na = jnp.isnan(a) | jnp.isnan(b)
+    zero = (a == 0) | (b == 0)
+    return jnp.where(na & ~zero, jnp.nan, out)
+
+
+def _e_or(jnp, a, b):
+    out = ((a != 0) | (b != 0)).astype(jnp.float64)
+    na = jnp.isnan(a) | jnp.isnan(b)
+    one = (~jnp.isnan(a) & (a != 0)) | (~jnp.isnan(b) & (b != 0))
+    return jnp.where(na & ~one, jnp.nan, out)
 
 
 def _maybe_string_eq(name, args):
@@ -46,7 +97,16 @@ def _maybe_string_eq(name, args):
             except ValueError:
                 eq = np.zeros(len(c), dtype=np.float64)
         elif c.type in (ColType.STR, ColType.UUID):
-            eq = np.array([v == s for v in c.data], dtype=np.float64)
+            # vectorized object-array compare: elementwise __eq__ against the
+            # scalar, NA (None) cells compare unequal. Some object payloads
+            # defeat numpy's elementwise broadcast (it may return a single
+            # bool) — fall back to the per-row loop for those.
+            arr = np.asarray(c.data, dtype=object)
+            raw = arr == s
+            if not (isinstance(raw, np.ndarray) and raw.shape == arr.shape):
+                raw = np.fromiter((v == s for v in arr), dtype=bool,
+                                  count=len(arr))
+            eq = raw.astype(np.float64)
         else:
             eq = np.zeros(len(c), dtype=np.float64)
         if name == "!=":
@@ -65,21 +125,21 @@ def _cmp(fn):
     return g
 
 
-_binop("+", lambda a, b: a + b)
-_binop("-", lambda a, b: a - b)
-_binop("*", lambda a, b: a * b)
-_binop("/", lambda a, b: a / b)
-_binop("^", lambda a, b: np.power(a, b))
-_binop("%", lambda a, b: np.mod(a, b))  # R-style modulo (AstMod)
-_binop("%%", lambda a, b: np.mod(a, b))
-_binop("intDiv", lambda a, b: np.floor_divide(a, b))
-_binop("%/%", lambda a, b: np.floor_divide(a, b))
-_binop("==", _cmp(lambda a, b: a == b))
-_binop("!=", _cmp(lambda a, b: a != b))
-_binop("<", _cmp(lambda a, b: a < b))
-_binop("<=", _cmp(lambda a, b: a <= b))
-_binop(">", _cmp(lambda a, b: a > b))
-_binop(">=", _cmp(lambda a, b: a >= b))
+_binop("+", lambda a, b: a + b, emit=lambda jnp, a, b: a + b)
+_binop("-", lambda a, b: a - b, emit=lambda jnp, a, b: a - b)
+_binop("*", lambda a, b: a * b, emit=lambda jnp, a, b: a * b)
+_binop("/", lambda a, b: a / b, emit=lambda jnp, a, b: a / b)
+_binop("^", lambda a, b: np.power(a, b))  # unfused: XLA pow is off by ulps
+_binop("%", lambda a, b: np.mod(a, b), emit=_e_mod)  # R-style modulo (AstMod)
+_binop("%%", lambda a, b: np.mod(a, b), emit=_e_mod)
+_binop("intDiv", lambda a, b: np.floor_divide(a, b), emit=_e_intdiv)
+_binop("%/%", lambda a, b: np.floor_divide(a, b), emit=_e_intdiv)
+_binop("==", _cmp(lambda a, b: a == b), emit=_e_cmp(lambda a, b: a == b))
+_binop("!=", _cmp(lambda a, b: a != b), emit=_e_cmp(lambda a, b: a != b))
+_binop("<", _cmp(lambda a, b: a < b), emit=_e_cmp(lambda a, b: a < b))
+_binop("<=", _cmp(lambda a, b: a <= b), emit=_e_cmp(lambda a, b: a <= b))
+_binop(">", _cmp(lambda a, b: a > b), emit=_e_cmp(lambda a, b: a > b))
+_binop(">=", _cmp(lambda a, b: a >= b), emit=_e_cmp(lambda a, b: a >= b))
 # logical: NA-aware and/or (AstAnd/AstOr: 0 && NA == 0, 1 || NA == 1)
 
 
@@ -97,13 +157,20 @@ def _or(a, b):
     return np.where(na & ~one, np.nan, out)
 
 
-_binop("&", _and)
-_binop("&&", _and)
-_binop("|", _or)
-_binop("||", _or)
+_binop("&", _and, emit=_e_and)
+_binop("&&", _and, emit=_e_and)
+_binop("|", _or, emit=_e_or)
+_binop("||", _or, emit=_e_or)
 
 
-@prim("ifelse")
+@prim(
+    "ifelse",
+    fusible=True,
+    kind="ifelse",
+    emit=lambda jnp, t, y, n: jnp.where(
+        jnp.isnan(t), jnp.nan, jnp.where(t != 0, y, n)
+    ),
+)
 def ifelse(env, args):
     """(ifelse test yes no) — vectorized conditional (AstIfElse)."""
     if len(args) != 3:
@@ -142,7 +209,14 @@ def ifelse(env, args):
     return Val.frame(Frame(cols))
 
 
-@prim("not")
+@prim(
+    "not",
+    fusible=True,
+    kind="uniop",
+    emit=lambda jnp, x: jnp.where(
+        jnp.isnan(x), jnp.nan, (x == 0).astype(jnp.float64)
+    ),
+)
 def not_(env, args):
     """(not fr) — logical negation, NA-propagating (math/AstNot)."""
     from h2o3_tpu.rapids.prims.util import map_columns

@@ -21,11 +21,18 @@ def _numeric_cols(fr: Frame):
     return [c for c in fr.columns if c.type not in (ColType.STR, ColType.UUID)]
 
 
-def _reduce(name, col_fn, all_fn=None):
+def _reduce(name, col_fn, all_fn=None, fusible=False):
     """Reducer over every numeric column. With na_rm=0 (default), NAs poison
-    the result (reference Max vs MaxNa pairs); the *Na variants skip NAs."""
+    the result (reference Max vs MaxNa pairs); the *Na variants skip NAs.
 
-    @prim(name)
+    Fusible reducers may root a fused region: the elementwise chain below
+    them compiles into one dispatch and the reducer runs as a host epilogue
+    through this very prim, keeping the combine bit-identical (numpy pairwise
+    summation does not match an XLA reduction's rounding). Only the
+    single-arg form fuses — an explicit na_rm argument falls back."""
+
+    @prim(name, fusible=fusible, kind="reduce",
+          fuse_args=(lambda ast_args: len(ast_args) == 1) if fusible else None)
     def op(env, args, col_fn=col_fn, name=name):
         v = args[0]
         na_rm = (
@@ -49,15 +56,15 @@ def _reduce(name, col_fn, all_fn=None):
     return op
 
 
-_reduce("max", np.max)
-_reduce("maxNA", np.max)
-_reduce("min", np.min)
-_reduce("minNA", np.min)
-_reduce("sum", np.sum)
-_reduce("sumNA", np.sum)
-_reduce("prod", np.prod)
-_reduce("prodNA", np.prod)
-_reduce("mean", np.mean)
+_reduce("max", np.max, fusible=True)
+_reduce("maxNA", np.max, fusible=True)
+_reduce("min", np.min, fusible=True)
+_reduce("minNA", np.min, fusible=True)
+_reduce("sum", np.sum, fusible=True)
+_reduce("sumNA", np.sum, fusible=True)
+_reduce("prod", np.prod, fusible=True)
+_reduce("prodNA", np.prod, fusible=True)
+_reduce("mean", np.mean, fusible=True)
 _reduce("median", np.median)
 _reduce("sd", lambda d: np.std(d, ddof=1))
 _reduce("mad", lambda d: 1.4826 * np.median(np.abs(d - np.median(d))))

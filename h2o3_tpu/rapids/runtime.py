@@ -225,8 +225,16 @@ def parse_rapids(text: str) -> AstNode:
 
 def exec_rapids(text: str, session: Optional[Session] = None) -> Val:
     """Parse + execute one rapids expression (Rapids.exec, Rapids.java:49)."""
+    import time
+
+    from h2o3_tpu.rapids import fusion
+
     session = session or Session()
-    return eval_ast(parse_rapids(text), Env(session))
+    fusion.begin_eval()
+    start = time.perf_counter()
+    result = eval_ast(parse_rapids(text), Env(session))
+    fusion.observe_eval(time.perf_counter() - start)
+    return result
 
 
 def eval_ast(node: AstNode, env: Env) -> Val:
@@ -280,6 +288,11 @@ def _eval_exec(node: AstExec, env: Env) -> Val:
                                 node.args, env)
         prim = PRIMS.get(op_name)
         if prim is not None:
+            from h2o3_tpu.rapids import fusion
+
+            fused = fusion.try_fuse(node, env)
+            if fused is not None:
+                return fused
             args = [eval_ast(a, env) for a in node.args]
             return prim(env, args)
         fn_val = env.lookup(op_name) or (

@@ -104,6 +104,7 @@ def live_metrics() -> set:
     import h2o3_tpu.cluster.tasks    # noqa: F401  cluster_tasks_* meters
     import h2o3_tpu.cluster.faults   # noqa: F401  cluster_faults_* meters
     import h2o3_tpu.api.coalesce     # noqa: F401  predict_batch_size
+    import h2o3_tpu.rapids.fusion    # noqa: F401  rapids_fusion_* meters
     from h2o3_tpu.util import telemetry
 
     return set(telemetry.REGISTRY.names())
@@ -153,6 +154,35 @@ def main() -> int:
             f"metric {name!r} but the telemetry registry never declares it"
         )
 
+    # fusion registry lint: a prim flagged fusible without an emitter would
+    # silently fall back on every query (binop/uniop/ifelse kinds), and a
+    # fusible prim with no parity test case is an unverified bit-identity
+    # claim — both fail the build
+    from h2o3_tpu.rapids.prims import FUSIBLE
+
+    emit_kinds = ("binop", "uniop", "ifelse")
+    for name, spec in sorted(FUSIBLE.items()):
+        if spec.kind in emit_kinds and spec.emit is None:
+            failures.append(
+                f"fusible prim {name!r} (kind={spec.kind}) has no emitter")
+    parity_path = os.path.join(_ROOT, "tests", "test_rapids_fusion.py")
+    try:
+        with open(parity_path) as f:
+            parity_src = f.read()
+    except OSError:
+        parity_src = ""
+        failures.append("tests/test_rapids_fusion.py is missing — every "
+                        "fusible prim needs a fused-vs-interpreted parity case")
+    untested = [
+        name for name in sorted(FUSIBLE)
+        if f'"{name}"' not in parity_src and f"'{name}'" not in parity_src
+    ]
+    for name in untested:
+        failures.append(
+            f"fusible prim {name!r} has no parity case in "
+            f"tests/test_rapids_fusion.py"
+        )
+
     from h2o3_tpu.api.registry import algo_map
 
     train_routes = {t for m, t in routes if m == "POST"}
@@ -177,7 +207,8 @@ def main() -> int:
     print(
         f"check_telemetry: OK — {len(obs)} observability routes documented, "
         f"{n_doc_metrics} documented metrics registered, "
-        f"{len(algo_map())} algos registered"
+        f"{len(algo_map())} algos registered, "
+        f"{len(FUSIBLE)} fusible prims emitter+parity checked"
     )
     return 0
 

@@ -63,6 +63,17 @@ def test_cmp_string_eq(sess):
     out = ex(sess, '(== (cols fr [2]) "x")').as_frame()
     np.testing.assert_allclose(out.col(0).data, [1, 0, 1, 0, 1])
 
+def test_cmp_string_eq_na_cells(sess):
+    # STR column with missing cells: NA compares unequal (0.0, not NaN)
+    # through the vectorized object-dtype path
+    fr = Frame([Column("s", np.array(["x", None, "y", None, "x"], dtype=object),
+                       ColType.STR)])
+    sess.assign("strs", fr)
+    out = ex(sess, '(== (cols strs [0]) "x")').as_frame()
+    np.testing.assert_array_equal(out.col(0).data, [1.0, 0.0, 0.0, 0.0, 1.0])
+    out = ex(sess, '(!= (cols strs [0]) "x")').as_frame()
+    np.testing.assert_array_equal(out.col(0).data, [0.0, 1.0, 1.0, 1.0, 0.0])
+
 def test_ifelse(sess):
     out = ex(sess, "(ifelse (> (cols fr [1]) 25) 1 0)").as_frame()
     np.testing.assert_allclose(out.col(0).data, [0, 0, 1, 1, 1])

@@ -1,0 +1,392 @@
+"""Rapids query fusion tests — fused-vs-interpreted bit-identity.
+
+Every prim in the fusibility registry gets a parity case over a
+special-values frame (NaN, ±inf, ±0.0, negative zero-crossing div/mod
+operands); the oracle is the op-at-a-time interpreter itself with
+``H2O3_TPU_RAPIDS_FUSION=0``. Identity is *bitwise* (uint64 views; the
+one exemption is NaN payloads — both-NaN cells compare equal). The
+registry-completeness test plus the scripts/check_telemetry.py lint keep
+this table in lockstep with the FUSIBLE registry.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.frame.frame import Column, ColType, Frame
+from h2o3_tpu.rapids import Session, exec_rapids
+from h2o3_tpu.rapids.prims import FUSIBLE
+from h2o3_tpu.util import telemetry
+
+# rapids assignments leave frames in the DKV by design (see test_rapids.py)
+pytestmark = pytest.mark.leaks_keys
+
+
+def _counter(name, **labels):
+    c = telemetry.REGISTRY.get(name)
+    return float(c.value(**labels)) if c is not None else 0.0
+
+
+def bits_equal(a, b):
+    """Bitwise float64 equality, NaN-payload exempt (both-NaN is equal)."""
+    a = np.atleast_1d(np.asarray(a, dtype=np.float64))
+    b = np.atleast_1d(np.asarray(b, dtype=np.float64))
+    if a.shape != b.shape:
+        return False
+    bad = (a.view(np.uint64) != b.view(np.uint64)) & ~(
+        np.isnan(a) & np.isnan(b))
+    return not bad.any()
+
+
+def assert_same_val(ref, got, ctx=""):
+    assert ref.kind == got.kind, (ctx, ref, got)
+    if ref.is_frame():
+        rf, gf = ref.value, got.value
+        assert [c.name for c in rf.columns] == [c.name for c in gf.columns], ctx
+        for rc, gc in zip(rf.columns, gf.columns):
+            assert rc.type is gc.type, (ctx, rc.name)
+            if rc.type in (ColType.STR, ColType.UUID):
+                assert list(rc.data) == list(gc.data), (ctx, rc.name)
+            else:
+                assert rc.domain == gc.domain, (ctx, rc.name)
+                assert bits_equal(rc.numeric_view(), gc.numeric_view()), \
+                    (ctx, rc.name)
+    else:
+        assert bits_equal(np.asarray(ref.value, dtype=np.float64),
+                          np.asarray(got.value, dtype=np.float64)), ctx
+
+
+def run_both(sess, expr):
+    """(interpreted, fused, fused_delta, fallback_delta) for one expr."""
+    prev = os.environ.get("H2O3_TPU_RAPIDS_FUSION")
+    try:
+        os.environ["H2O3_TPU_RAPIDS_FUSION"] = "0"
+        ref = exec_rapids(expr, sess)
+        f0 = _counter("rapids_fusion_total", result="fused")
+        b0 = _counter("rapids_fusion_total", result="fallback")
+        os.environ["H2O3_TPU_RAPIDS_FUSION"] = "1"
+        got = exec_rapids(expr, sess)
+    finally:
+        if prev is None:
+            os.environ.pop("H2O3_TPU_RAPIDS_FUSION", None)
+        else:
+            os.environ["H2O3_TPU_RAPIDS_FUSION"] = prev
+    return (ref, got,
+            _counter("rapids_fusion_total", result="fused") - f0,
+            _counter("rapids_fusion_total", result="fallback") - b0)
+
+
+def _special_frame():
+    # div/mod sign rules, inf dividends, signed zeros, NaN propagation
+    a = [1.5, -2.5, np.nan, np.inf, -np.inf, 0.0, -0.0, 3.0, -3.0, 7.25,
+         -7.25, 2.0, 1e300, -1e-300, 5.0, -5.5, -1.0, 0.5, -0.25, 9.0]
+    b = [2.0, -3.0, 1.0, 2.0, 2.0, -0.0, 0.0, -2.0, np.nan, np.inf,
+         -np.inf, 0.5, 1e-300, 1e300, -5.0, 5.5, np.inf, -0.0, 4.0, -9.0]
+    rng = np.random.default_rng(11)
+    ra = rng.standard_normal(200) * 10
+    rb = rng.standard_normal(200) * 10
+    ra[::13] = np.nan
+    rb[::17] = np.nan
+    return Frame([
+        Column("a", np.concatenate([a, ra]), ColType.NUM),
+        Column("b", np.concatenate([b, rb]), ColType.NUM),
+    ])
+
+
+@pytest.fixture
+def sess():
+    s = Session()
+    s.assign("pf", _special_frame())
+    return s
+
+
+#: one fused-region expression per fusible prim (registry lint: every
+#: FUSIBLE name must appear quoted here with a parity case)
+PARITY_CASES = {
+    "+": '(+ (cols_py pf 0) (cols_py pf 1))',
+    "-": '(- (cols_py pf 0) (cols_py pf 1))',
+    "*": '(* (cols_py pf 0) (cols_py pf 1))',
+    "/": '(/ (cols_py pf 0) (cols_py pf 1))',
+    "%": '(% (cols_py pf 0) (cols_py pf 1))',
+    "%%": '(%% (cols_py pf 0) (cols_py pf 1))',
+    "intDiv": '(intDiv (cols_py pf 0) (cols_py pf 1))',
+    "%/%": '(%/% (cols_py pf 0) (cols_py pf 1))',
+    "==": '(== (cols_py pf 0) (cols_py pf 1))',
+    "!=": '(!= (cols_py pf 0) (cols_py pf 1))',
+    "<": '(< (cols_py pf 0) (cols_py pf 1))',
+    "<=": '(<= (cols_py pf 0) (cols_py pf 1))',
+    ">": '(> (cols_py pf 0) (cols_py pf 1))',
+    ">=": '(>= (cols_py pf 0) (cols_py pf 1))',
+    "&": '(& (cols_py pf 0) (cols_py pf 1))',
+    "&&": '(&& (cols_py pf 0) (cols_py pf 1))',
+    "|": '(| (cols_py pf 0) (cols_py pf 1))',
+    "||": '(|| (cols_py pf 0) (cols_py pf 1))',
+    "not": '(not (cols_py pf 0))',
+    "ifelse": '(ifelse (> (cols_py pf 0) 0) (cols_py pf 0) (cols_py pf 1))',
+    "abs": '(abs (cols_py pf 0))',
+    "ceiling": '(ceiling (cols_py pf 0))',
+    "floor": '(floor (cols_py pf 0))',
+    "trunc": '(trunc (cols_py pf 0))',
+    "round": '(round (cols_py pf 0) 0)',
+    "sqrt": '(sqrt (cols_py pf 0))',
+    "sign": '(sign (cols_py pf 0))',
+    "sgn": '(sgn (cols_py pf 0))',
+    "sin": '(sin (cols_py pf 0))',
+    "cos": '(cos (cols_py pf 0))',
+    "sinpi": '(sinpi (cols_py pf 0))',
+    "cospi": '(cospi (cols_py pf 0))',
+    "none": '(none (cols_py pf 0))',
+    "is.na": '(is.na (cols_py pf 0))',
+    "cols": '(* (cols pf [0]) 2)',
+    "cols_py": '(* (cols_py pf 1) 2)',
+    "max": '(max (* (cols_py pf 0) 2))',
+    "maxNA": '(maxNA (* (cols_py pf 0) 2))',
+    "min": '(min (* (cols_py pf 0) 2))',
+    "minNA": '(minNA (* (cols_py pf 0) 2))',
+    "sum": '(sum (* (cols_py pf 0) 2))',
+    "sumNA": '(sumNA (* (cols_py pf 0) 2))',
+    "prod": '(prod (* (cols_py pf 0) 0))',
+    "prodNA": '(prodNA (ifelse (is.na (cols_py pf 0)) 1 2))',
+    "mean": '(mean (* (cols_py pf 0) 2))',
+}
+
+
+def test_registry_completeness():
+    """Every fusible prim has a parity case and vice versa — a new
+    fusible registration without a case here fails the build (this test
+    AND the scripts/check_telemetry.py lint)."""
+    assert set(PARITY_CASES) == set(FUSIBLE)
+
+
+@pytest.mark.parametrize("name", sorted(PARITY_CASES))
+def test_parity(sess, name):
+    ref, got, fused, fallback = run_both(sess, PARITY_CASES[name])
+    assert fused >= 1 and fallback == 0, (name, fused, fallback)
+    assert_same_val(ref, got, ctx=name)
+
+
+# -- broadcasting ------------------------------------------------------------
+
+def test_frame_scalar_broadcast(sess):
+    ref, got, fused, _ = run_both(sess, '(* (+ pf 1) 2)')
+    assert fused >= 1
+    assert_same_val(ref, got)
+
+
+def test_scalar_frame_broadcast(sess):
+    ref, got, fused, _ = run_both(sess, '(- 1 (/ 2 pf))')
+    assert fused >= 1
+    assert_same_val(ref, got)
+
+
+def test_rhs_single_col_broadcast(sess):
+    """frame ⊕ 1-col frame: the single rhs column pairs with every lhs
+    column and output names come from the lhs."""
+    ref, got, fused, _ = run_both(sess, '(* (+ pf 0) (cols_py pf 1))')
+    assert fused >= 1
+    assert [c.name for c in got.value.columns] == ["a", "b"]
+    assert_same_val(ref, got)
+
+
+def test_lhs_single_col_broadcast_raises_identically(sess):
+    """1-col frame ⊕ frame: H2O names every output column after the lhs
+    column — duplicate names, which the Frame constructor rejects. The
+    fused path must surface the same error."""
+    for flag in ("0", "1"):
+        os.environ["H2O3_TPU_RAPIDS_FUSION"] = flag
+        with pytest.raises(ValueError, match="duplicate column names"):
+            exec_rapids('(* (cols_py pf 1) (+ pf 0))', sess)
+    os.environ.pop("H2O3_TPU_RAPIDS_FUSION", None)
+
+
+def test_row_mismatch_falls_back(sess):
+    """Fusing across frames of different heights is never attempted —
+    the interpreter's 1-row broadcast (or error) semantics win."""
+    one = Frame([Column("k", np.array([2.0]), ColType.NUM)])
+    sess.assign("one", one)
+    ref, got, _, fallback = run_both(sess, '(* (+ (cols_py pf 0) one) 3)')
+    assert fallback >= 1
+    assert_same_val(ref, got)
+
+
+# -- fallback at the region boundary -----------------------------------------
+
+def test_mixed_tree_boundary(sess):
+    """A non-fusible transcendental mid-tree fractures the region: the
+    chain above it fuses with the log1p result as a leaf, bit-identically."""
+    expr = '(sum (* (log1p (abs (cols_py pf 0))) 2))'
+    ref, got, fused, fallback = run_both(sess, expr)
+    assert fused >= 1 and fallback == 0
+    assert_same_val(ref, got)
+
+
+def test_pow_never_fuses(sess):
+    """XLA pow differs from numpy in last-ulp cases, so ^ is deliberately
+    not fusible — it evaluates as an interpreter leaf."""
+    assert "^" not in FUSIBLE
+    ref, got, _, _ = run_both(sess, '(sum (* (^ (cols_py pf 0) 2) 3))')
+    assert_same_val(ref, got)
+
+
+def test_scalar_leaf(sess):
+    """An interior reducer is a region leaf: its NUM result enters the
+    fused program as a runtime scalar slot, not a recompile per value."""
+    expr = '(* (- (cols_py pf 0) (mean (cols_py pf 0))) 2)'
+    ref, got, fused, fallback = run_both(sess, expr)
+    assert fused >= 1 and fallback == 0
+    assert_same_val(ref, got)
+
+
+def test_str_arithmetic_raises_identically(sess):
+    fs = Frame([
+        Column("x", np.arange(8, dtype=np.float64), ColType.NUM),
+        Column("s", np.array(["p", "q", None, "r"] * 2, dtype=object),
+               ColType.STR),
+    ])
+    sess.assign("fs", fs)
+    errs = []
+    for flag in ("0", "1"):
+        os.environ["H2O3_TPU_RAPIDS_FUSION"] = flag
+        with pytest.raises(Exception) as ei:
+            exec_rapids('(* (+ fs 1) 2)', sess)
+        errs.append(type(ei.value))
+    os.environ.pop("H2O3_TPU_RAPIDS_FUSION", None)
+    assert errs[0] is errs[1]
+
+
+def test_str_passthrough_select(sess):
+    """String columns ride through pure column selection untouched."""
+    fs = Frame([
+        Column("x", np.arange(6, dtype=np.float64), ColType.NUM),
+        Column("s", np.array(["p", "q", None, "r", "p", "q"], dtype=object),
+               ColType.STR),
+    ])
+    sess.assign("fs2", fs)
+    ref, got, _, _ = run_both(sess, '(cols (cols fs2 [0 1]) [1])')
+    assert_same_val(ref, got)
+    assert got.value.col(0).type is ColType.STR
+
+
+def test_cat_codes_and_domain(sess):
+    cat = Column("c", np.array([0, 1, -1, 2, 1, 0] * 4, dtype=np.int32),
+                 ColType.CAT, domain=["lo", "mid", "hi"])
+    fc = Frame([Column("x", np.arange(24, dtype=np.float64), ColType.NUM), cat])
+    sess.assign("fc", fc)
+    # numeric compute over a CAT column runs on its codes (NA at -1)
+    ref, got, fused, _ = run_both(sess, '(* (+ (cols_py fc 1) 1) 2)')
+    assert fused >= 1
+    assert_same_val(ref, got)
+    # bare pass-through keeps the Column type and domain
+    ref, got, _, _ = run_both(sess, '(cols (cols fc [0 1]) [1])')
+    assert_same_val(ref, got)
+    assert got.value.col(0).type is ColType.CAT
+    assert got.value.col(0).domain == ["lo", "mid", "hi"]
+    # both-CAT ifelse may be domain-preserving: must fall back, identically
+    ref, got, _, fallback = run_both(
+        sess, '(ifelse (> (cols_py fc 0) 10) (cols_py fc 1) (cols_py fc 1))')
+    assert fallback >= 1
+    assert_same_val(ref, got)
+
+
+# -- caching -----------------------------------------------------------------
+
+def test_warm_path_zero_recompile(sess):
+    expr = ('(sum (ifelse (> (+ (cols_py pf 0) (cols_py pf 1)) 0) '
+            '(cols_py pf 0) (cols_py pf 1)))')
+    os.environ["H2O3_TPU_RAPIDS_FUSION"] = "1"
+    try:
+        cold = exec_rapids(expr, sess)
+        snap = {
+            "jit_miss": _counter("mapreduce_jit_cache_total",
+                                 op="map_batches", result="miss"),
+            "plan_miss": _counter("mapreduce_plan_cache_total",
+                                  op="rapids_fusion", result="miss"),
+            "upload": _counter("shard_bytes_total"),
+            "dev_miss": _counter("devcache_requests_total",
+                                 kind="frame_table", result="miss"),
+        }
+        warm = exec_rapids(expr, sess)
+        assert bits_equal(cold.value, warm.value)
+        assert _counter("mapreduce_jit_cache_total",
+                        op="map_batches", result="miss") == snap["jit_miss"]
+        assert _counter("mapreduce_plan_cache_total",
+                        op="rapids_fusion", result="miss") == snap["plan_miss"]
+        assert _counter("shard_bytes_total") == snap["upload"]
+        assert _counter("devcache_requests_total",
+                        kind="frame_table", result="miss") == snap["dev_miss"]
+    finally:
+        os.environ.pop("H2O3_TPU_RAPIDS_FUSION", None)
+
+
+def test_devcache_invalidation_after_assign(sess):
+    """Rectangle assignment bumps column versions: the next fused dispatch
+    re-uploads and sees the new data (never stale device state)."""
+    rng = np.random.default_rng(3)
+    vf = Frame([Column("u", rng.standard_normal(64), ColType.NUM),
+                Column("v", rng.standard_normal(64), ColType.NUM)])
+    sess.assign("vf", vf)
+    expr = '(sum (* (+ (cols_py vf 0) (cols_py vf 1)) 2))'
+    os.environ["H2O3_TPU_RAPIDS_FUSION"] = "1"
+    try:
+        before = exec_rapids(expr, sess)
+        exec_rapids(expr, sess)  # warm
+        miss0 = _counter("devcache_requests_total",
+                         kind="frame_table", result="miss")
+        exec_rapids('(tmp= vf (:= vf (* (cols_py vf 0) 0.5) [0] _))', sess)
+        after = exec_rapids(expr, sess)
+        assert _counter("devcache_requests_total",
+                        kind="frame_table", result="miss") > miss0
+    finally:
+        os.environ.pop("H2O3_TPU_RAPIDS_FUSION", None)
+    os.environ["H2O3_TPU_RAPIDS_FUSION"] = "0"
+    try:
+        ref = exec_rapids(expr, sess)
+    finally:
+        os.environ.pop("H2O3_TPU_RAPIDS_FUSION", None)
+    assert bits_equal(ref.value, after.value)
+    assert not bits_equal(before.value, after.value)
+
+
+# -- knobs -------------------------------------------------------------------
+
+def test_kill_switch(sess):
+    expr = '(sum (* (+ (cols_py pf 0) (cols_py pf 1)) 2))'
+    os.environ["H2O3_TPU_RAPIDS_FUSION"] = "0"
+    try:
+        f0 = _counter("rapids_fusion_total", result="fused")
+        b0 = _counter("rapids_fusion_total", result="fallback")
+        out = exec_rapids(expr, sess)
+        assert _counter("rapids_fusion_total", result="fused") == f0
+        assert _counter("rapids_fusion_total", result="fallback") == b0
+    finally:
+        os.environ.pop("H2O3_TPU_RAPIDS_FUSION", None)
+    ref, got, _, _ = run_both(sess, expr)
+    assert bits_equal(out.value, ref.value)
+
+
+def test_min_ops_gate(sess):
+    os.environ["H2O3_TPU_RAPIDS_FUSION"] = "1"
+    try:
+        f0 = _counter("rapids_fusion_total", result="fused")
+        exec_rapids('(+ pf 1)', sess)  # 1 op < default min of 2: interpreted
+        assert _counter("rapids_fusion_total", result="fused") == f0
+        os.environ["H2O3_TPU_RAPIDS_FUSION_MIN_OPS"] = "5"
+        exec_rapids('(* (+ pf 1) 2)', sess)  # 2 ops < 5: interpreted
+        assert _counter("rapids_fusion_total", result="fused") == f0
+        os.environ["H2O3_TPU_RAPIDS_FUSION_MIN_OPS"] = "2"
+        exec_rapids('(* (+ pf 1) 2)', sess)
+        assert _counter("rapids_fusion_total", result="fused") == f0 + 1
+    finally:
+        os.environ.pop("H2O3_TPU_RAPIDS_FUSION", None)
+        os.environ.pop("H2O3_TPU_RAPIDS_FUSION_MIN_OPS", None)
+
+
+def test_fusible_registry_emitters():
+    """Mirror of the scripts/check_telemetry.py lint: compute-kind fusible
+    prims always carry an emitter (FuseSpec enforces it at registration)."""
+    for name, spec in FUSIBLE.items():
+        if spec.kind in ("binop", "uniop", "ifelse"):
+            assert spec.emit is not None, name
+        else:
+            assert spec.kind in ("select", "reduce"), name
