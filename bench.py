@@ -699,9 +699,11 @@ def _cluster_bench() -> None:
     subprocess as node 1 (port 0 + address-file rendezvous, exactly the
     multi-process tests' harness), then measures the control plane: RPC
     round-trip latency percentiles, RPC throughput by payload size, and
-    DKV put/get on keys homed locally vs on the remote node.  Prints ONE
-    JSON line and mirrors it to CLUSTER_BENCH.json.  No jax import — the
-    cluster layer is pure stdlib, so this runs anywhere in milliseconds.
+    DKV put/get on keys homed locally vs on the remote node, plus a
+    ``dist_frame`` cell: chunk-homed parse wall, chunk-homed vs local
+    ``map_reduce`` wall, and partials-vs-frame bytes on the wire.  Prints
+    ONE JSON line and mirrors it to CLUSTER_BENCH.json.  The control
+    plane itself stays jax-free; only the dist_frame cell jits.
     """
     import platform
     import tempfile
@@ -828,6 +830,84 @@ def _cluster_bench() -> None:
                 "put_p50_us": round(_pct(puts, 0.5) * 1e6, 1),
                 "get_p50_us": round(_pct(gets, 0.5) * 1e6, 1),
             }
+        # chunk-homed distributed Frame: parse-to-homes wall, chunk-homed
+        # vs local map_reduce wall, and partials-vs-frame bytes on the
+        # wire (the one jax user in this bench: the map side jits on
+        # both members)
+        import numpy as np
+
+        from h2o3_tpu.cluster import frames as cframes
+        from h2o3_tpu.cluster import tasks as ctasks
+        from h2o3_tpu.frame.parse import _iter_body_chunks, parse_setup
+
+        n = 60000
+        xs = np.arange(n) % 97
+        ys = (np.arange(n) * 7) % 31
+        text = "x,y\n" + "".join(f"{xs[i]},{ys[i]}\n" for i in range(n))
+        setup = parse_setup(text)
+        chunks_in = list(_iter_body_chunks(
+            [text.encode()], 32768, setup.header, setup.skip_blank_lines))
+        t = time.perf_counter()
+        fr = ctasks.distributed_parse_chunks(
+            chunks_in, setup, cloud=cloud, key="bench_dist_frame")
+        parse_wall = time.perf_counter() - t
+        host = {"x": xs.astype(np.float64), "y": ys.astype(np.float64)}
+        local_mr = ctasks.distributed_map_reduce(
+            cframes.mr_sum_xy, host, cloud=None)  # warms the local jit
+        t = time.perf_counter()
+        ctasks.distributed_map_reduce(cframes.mr_sum_xy, host, cloud=None)
+        local_wall = time.perf_counter() - t
+
+        def _sent_bytes():
+            c = telemetry.REGISTRY.get("rpc_payload_bytes_total")
+            return 0.0 if c is None else c.value(direction="sent")
+
+        ctasks.distributed_map_reduce(
+            cframes.mr_sum_xy, fr, cloud=cloud)  # warms the remote jit
+        s0 = _sent_bytes()
+        t = time.perf_counter()
+        dist_mr = ctasks.distributed_map_reduce(
+            cframes.mr_sum_xy, fr, cloud=cloud)
+        homed_wall = time.perf_counter() - t
+        mr_sent = _sent_bytes() - s0
+        frame_bytes = 2 * 8 * n
+        import jax as _jax
+
+        bit_identical = all(
+            np.asarray(a).tobytes() == np.asarray(b).tobytes()
+            for a, b in zip(_jax.tree.leaves(local_mr),
+                            _jax.tree.leaves(dist_mr)))
+        # one-home-dead recovery wall: SIGKILL the peer (this cell runs
+        # last, nothing downstream needs it) and re-run the chunk-homed
+        # map_reduce — the caller holds the dead home's replica chunks,
+        # so the ladder recovers path=replica without a re-parse
+        rec = telemetry.REGISTRY.get("cluster_fanout_recovered_total")
+        rep0 = rec.value(path="replica") if rec is not None else 0.0
+        child.kill()
+        t = time.perf_counter()
+        dead_mr = ctasks.distributed_map_reduce(
+            cframes.mr_sum_xy, fr, cloud=cloud)
+        dead_wall = time.perf_counter() - t
+        dead_identical = all(
+            np.asarray(a).tobytes() == np.asarray(b).tobytes()
+            for a, b in zip(_jax.tree.leaves(local_mr),
+                            _jax.tree.leaves(dead_mr)))
+        rep1 = rec.value(path="replica") if rec is not None else 0.0
+        lay = getattr(fr, "chunk_layout", None) or {}
+        dist_frame = {
+            "rows": n,
+            "chunks": len(chunks_in),
+            "groups": len(lay.get("groups", ())),
+            "parse_to_homes_ms": round(parse_wall * 1e3, 1),
+            "map_reduce_local_ms": round(local_wall * 1e3, 1),
+            "map_reduce_chunk_homed_ms": round(homed_wall * 1e3, 1),
+            "map_reduce_one_home_dead_ms": round(dead_wall * 1e3, 1),
+            "recovered_path_replica": int(rep1 - rep0),
+            "mr_sent_bytes": int(mr_sent),
+            "frame_bytes": frame_bytes,
+            "partials_only": bool(mr_sent < frame_bytes / 4),
+            "bit_identical": bit_identical and dead_identical,
+        }
         tel = {k: v for k, v in telemetry.REGISTRY.summary().items()
                if k.startswith(("rpc_", "cluster_"))}
         result = {
@@ -845,6 +925,7 @@ def _cluster_bench() -> None:
                 "telemetry_overhead": trace_overhead,
                 "rpc_throughput_by_bytes": thru,
                 "dkv": dkv,
+                "dist_frame": dist_frame,
                 "vs_baseline_is": "remote get p50 / local get p50",
             },
             "telemetry": {k: (round(v, 3) if isinstance(v, float) else v)

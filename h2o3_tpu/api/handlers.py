@@ -479,6 +479,15 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
     r.register("POST", "/3/Parse", parse_ep, "parse to frame")
 
     # ---- frames -----------------------------------------------------------
+    def _chunk_homes(v):
+        """Chunk layout + replica health for a ring-homed frame; None
+        for an ordinary node-local frame (the common case: one getattr)."""
+        if getattr(v, "chunk_layout", None) is None:
+            return None
+        from h2o3_tpu.cluster.frames import layout_health
+
+        return layout_health(v)
+
     def frames_list(params):
         out = []
         for k in DKV.keys_of_type(Frame):
@@ -486,13 +495,22 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
             v = DKV.peek(k)
             if v is None:
                 continue
-            out.append({"frame_id": {"name": k}, "rows": v.nrows,
-                        "num_columns": v.ncols})
+            row = {"frame_id": {"name": k}, "rows": v.nrows,
+                   "num_columns": v.ncols}
+            homes = _chunk_homes(v)
+            if homes is not None:
+                row["chunk_homes"] = homes
+            out.append(row)
         return {"frames": out}
 
     def frame_get(params, frame_id):
         rows = int(params.get("row_count", 10))
-        return {"frames": [_frame_schema(_get_frame(frame_id), frame_id, rows)]}
+        fr = _get_frame(frame_id)
+        schema = _frame_schema(fr, frame_id, rows)
+        homes = _chunk_homes(fr)
+        if homes is not None:
+            schema["chunk_homes"] = homes
+        return {"frames": [schema]}
 
     def frame_summary(params, frame_id):
         return frame_get(params, frame_id)

@@ -24,6 +24,7 @@ from __future__ import annotations
 import bisect
 import hashlib
 import threading
+import time
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -81,6 +82,20 @@ def _hash64(s: str) -> int:
     return int.from_bytes(hashlib.md5(s.encode()).digest()[:8], "big")
 
 
+def ring_key(key: str) -> str:
+    """Canonical ring-placement key.  Frame chunk keys
+    (``fr#<frame>#g<j>t<t>#c<i>``) hash by their GROUP ANCHOR — everything
+    before the ``#c<i>`` suffix — so all chunks of a group land
+    contiguously on ONE home and ride every ring mechanism (replica
+    walk, read-repair, anti-entropy sweep) as a unit.  Every other key
+    hashes as itself."""
+    if key.startswith("fr#"):
+        i = key.rfind("#c")
+        if i > 0 and key[i + 2:].isdigit():
+            return key[:i]
+    return key
+
+
 class HashRing:
     """Consistent-hash ring over member idents."""
 
@@ -100,7 +115,7 @@ class HashRing:
         if not self._hashes:
             return []
         out: List[str] = []
-        i = bisect.bisect_right(self._hashes, _hash64(key))
+        i = bisect.bisect_right(self._hashes, _hash64(ring_key(key)))
         for step in range(len(self._hashes)):
             owner = self._owners[(i + step) % len(self._hashes)]
             if owner not in out:
@@ -143,13 +158,22 @@ class DkvRouter:
         self._sweep_queue: List[str] = []
         self._reseed_pending: set = set()
         self._swept_ring: Optional[Tuple[str, ...]] = None
-        #: keys this node served a remove for (bounded FIFO) — the
-        #: holders' sweep uses it to tell "the key WAS removed" (reap
-        #: the copy) from "the home never had it / restarted empty"
-        #: (restore the copy to the home); without the distinction a
-        #: home that rejoins empty would get its keys' last surviving
-        #: replicas reaped instead of re-seeded
-        self._removed: "OrderedDict[str, None]" = OrderedDict()
+        #: key -> remove EPOCH this node served a remove for (bounded
+        #: FIFO) — the holders' sweep uses it to tell "the key WAS
+        #: removed" (reap the copy) from "the home never had it /
+        #: restarted empty" (restore the copy to the home); without the
+        #: distinction a home that rejoins empty would get its keys'
+        #: last surviving replicas reaped instead of re-seeded.  The
+        #: epoch makes the memory comparable across nodes: a copy
+        #: survives a tombstone only when its write epoch is newer,
+        #: so a restarted-amnesiac home cannot resurrect a key whose
+        #: removal another walk member still remembers
+        self._removed: "OrderedDict[str, int]" = OrderedDict()
+        #: key -> write epoch of the value THIS node holds (bounded) —
+        #: minted at put on the home, carried on replicate/restore
+        #: payloads so every copy knows how old it is vs a tombstone
+        self._key_epochs: "OrderedDict[str, int]" = OrderedDict()
+        self._epoch = 0
         cloud.rpc_server.register("dkv_put", self._serve_put)
         cloud.rpc_server.register("dkv_get", self._serve_get)
         cloud.rpc_server.register("dkv_remove", self._serve_remove)
@@ -195,6 +219,36 @@ class DkvRouter:
         """True for plain-data values the ring owns; framework objects
         (anything else) are node-local (see ROUTABLE_VALUE_TYPES)."""
         return isinstance(value, ROUTABLE_VALUE_TYPES)
+
+    # -- write/remove epochs -------------------------------------------------
+    def _next_epoch(self) -> int:
+        """Monotonic on this node, anchored to wall-clock ms so epochs
+        minted by different nodes stay roughly comparable (remove
+        tombstones only need to outrank writes that happened BEFORE the
+        remove, which wall clocks order within heartbeat tolerances)."""
+        self._epoch = max(self._epoch + 1, int(time.time() * 1000))
+        return self._epoch
+
+    @staticmethod
+    def _bound(d: "OrderedDict[str, int]", key: str) -> None:
+        d.move_to_end(key)
+        while len(d) > 4096:
+            d.popitem(last=False)
+
+    def note_put(self, key: str, epoch: Optional[int] = None) -> int:
+        """Record a write epoch for a key stored locally and clear any
+        tombstone the write supersedes.  Called by the store's local put
+        path (fresh writes mint an epoch) and by the replica-copy
+        landing path (the copy adopts the HOME's epoch, so a delayed
+        replicate that loses the race with a remove stays older than
+        the tombstone and is reaped by the sweep, never restored)."""
+        e = self._next_epoch() if epoch is None else int(epoch)
+        self._key_epochs[key] = e
+        self._bound(self._key_epochs, key)
+        removed = self._removed.get(key)
+        if removed is not None and e >= removed:
+            self._removed.pop(key, None)
+        return e
 
     # -- client side (called from KeyedStore) --------------------------------
     def remote_put(self, key: str, value: Any, replicas: int = 1) -> str:
@@ -345,6 +399,7 @@ class DkvRouter:
             return
         self._replicated.pop(key, None)
         self._reseed_pending.discard(key)
+        epoch = self._removed.get(key, 0)
         for m in self.home_members(key, MAX_REPLICAS)[1:]:
             if m.info.name == self.cloud.info.name:
                 continue
@@ -352,7 +407,7 @@ class DkvRouter:
             try:
                 self.cloud.client.call(
                     m.info.addr, "dkv_remove",
-                    {"key": key, "replica_copy": True},
+                    {"key": key, "replica_copy": True, "epoch": epoch},
                     timeout=self.TIMEOUT, target=m.info.ident)
             except _rpc.RPCError:
                 pass  # a dead member's copy dies with the member
@@ -368,7 +423,8 @@ class DkvRouter:
             try:
                 self.cloud.client.call(
                     m.info.addr, "dkv_put",
-                    {"key": key, "value": value, "replica_copy": True},
+                    {"key": key, "value": value, "replica_copy": True,
+                     "epoch": self._key_epochs.get(key, 0)},
                     timeout=self.TIMEOUT, target=m.info.ident)
             except _rpc.RPCError:
                 pass  # best-effort: the home copy is the authority
@@ -383,6 +439,12 @@ class DkvRouter:
             # its current home, so an orphaned copy is reapable later
             self._replica_copies.add(key)
             self.store.put(key, value, _local=True)
+            # the copy ADOPTS the home's write epoch (overriding the
+            # fresh one the local put minted): a replicate that lost the
+            # race with a remove stays OLDER than the tombstone, so the
+            # sweep reaps it instead of resurrecting the key
+            if payload.get("epoch"):
+                self.note_put(key, payload["epoch"])
         else:
             # _local: this node answers AS the home — re-entering the
             # routed put here would consult our own ring view, which can
@@ -410,11 +472,12 @@ class DkvRouter:
             return {"found": False}
         return {"found": True, "value": v}
 
-    def _mark_removed(self, key: str) -> None:
-        self._removed[key] = None
-        self._removed.move_to_end(key)
-        while len(self._removed) > 4096:
-            self._removed.popitem(last=False)
+    def _mark_removed(self, key: str, epoch: Optional[int] = None) -> None:
+        e = self._next_epoch() if epoch is None else \
+            max(int(epoch), self._removed.get(key, 0))
+        self._removed[key] = e
+        self._bound(self._removed, key)
+        self._key_epochs.pop(key, None)
 
     def _serve_remove(self, payload: Dict[str, Any]) -> Dict[str, Any]:
         _FORWARDS.inc(op="remove", direction="served")
@@ -423,7 +486,10 @@ class DkvRouter:
             self.store.remove(key, _local=True)
         except ValueError as e:  # Lockable: surface the lock holders
             raise _rpc.RpcFault(str(e), code=423)
-        self._mark_removed(key)
+        # a reap fan-out carries the home's remove epoch so every walk
+        # member records the SAME tombstone (even members holding no
+        # copy — they answer replica_check for survivors later)
+        self._mark_removed(key, payload.get("epoch"))
         if payload.get("replica_copy"):
             self._replica_copies.discard(key)
         else:
@@ -442,8 +508,12 @@ class DkvRouter:
             # "removed" disambiguates for the holder: a key this home
             # REMOVED is an orphan copy (reap it); a key this home
             # simply never had (it restarted empty, or the arc just
-            # moved here) must be restored from the copy instead
-            return {"exists": False, "removed": key in self._removed}
+            # moved here) must be restored from the copy instead.  The
+            # epoch lets the holder rank its copy against the tombstone
+            # — and lets OTHER walk members veto a restore toward a
+            # restarted-amnesiac home that forgot the removal
+            return {"exists": False, "removed": key in self._removed,
+                    "removed_epoch": int(self._removed.get(key, 0))}
         if key not in self._replicated:
             self._replicated[key] = 2
             _SWEEP.inc(action="adopted")
@@ -511,6 +581,33 @@ class DkvRouter:
             self._replica_copies.add(key)
             _SWEEP.inc(action="rehomed")
 
+    def _tombstoned(self, key: str) -> bool:
+        """Resurrection guard for a copy about to be PROMOTED or
+        RESTORED: is there a remove tombstone for ``key``, anywhere on
+        its current ring walk, newer than the copy's write epoch?  The
+        home alone cannot be trusted here — it may have restarted empty
+        and forgotten the removal — so the other walk members are
+        polled too.  A copy with no recorded epoch ranks oldest (0):
+        any tombstone outranks it, which errs toward re-delete — the
+        safe side, since a live key is re-put (minting a newer epoch)
+        while a deleted one must stay dead."""
+        copy_epoch = self._key_epochs.get(key, 0)
+        if self._removed.get(key, 0) > copy_epoch:
+            return True
+        me = self.cloud.info.name
+        for m in self.home_members(key, MAX_REPLICAS):
+            if m.info.name == me:
+                continue
+            try:
+                resp = self.cloud.client.call(
+                    m.info.addr, "dkv_replica_check", {"key": key},
+                    timeout=self.TIMEOUT, target=m.info.ident, retries=1)
+            except _rpc.RPCError:
+                continue  # unreachable: no removal evidence from it
+            if int(resp.get("removed_epoch", 0) or 0) > copy_epoch:
+                return True
+        return False
+
     def _sweep_copies(self) -> None:
         me = self.cloud.info.name
         if not self._sweep_queue:
@@ -526,17 +623,20 @@ class DkvRouter:
             if not homes:
                 continue
             if names[0] == me:
-                # this holder IS the home now: promote the copy to the
-                # authoritative one and fan fresh replicas
-                self._replica_copies.discard(key)
-                sentinel = object()
-                value = self.store.get(key, sentinel, _local=True)
-                if value is not sentinel:
-                    self._replicated.setdefault(key, 2)
-                    self.replicate(key, value, self._replicated[key])
-                _SWEEP.inc(action="promoted")
-                continue
-            if me in names[1:]:
+                # this holder IS the home now — but ring churn can route
+                # a stale copy here (the removing home died and the arc
+                # moved): promote only copies no walk member remembers
+                # removing, else fall through to the reap
+                if not self._tombstoned(key):
+                    self._replica_copies.discard(key)
+                    sentinel = object()
+                    value = self.store.get(key, sentinel, _local=True)
+                    if value is not sentinel:
+                        self._replicated.setdefault(key, 2)
+                        self.replicate(key, value, self._replicated[key])
+                    _SWEEP.inc(action="promoted")
+                    continue
+            elif me in names[1:]:
                 # valid successor: keep iff the current home holds the
                 # key (an RPC failure keeps the copy — re-check next
                 # cycle rather than reap on a transient)
@@ -550,12 +650,15 @@ class DkvRouter:
                 if resp.get("exists"):
                     _SWEEP.inc(action="kept")
                     continue
-                if not resp.get("removed"):
-                    # the home LACKS the key but never removed it — it
-                    # restarted empty or just inherited the arc; this
-                    # copy may be the last one alive, so restore it to
-                    # the home (which re-tracks and fans replicas)
-                    # instead of reaping
+                copy_epoch = self._key_epochs.get(key, 0)
+                home_removed = bool(resp.get("removed")) and \
+                    int(resp.get("removed_epoch", 0) or 0) >= copy_epoch
+                if not home_removed and not self._tombstoned(key):
+                    # the home LACKS the key and no walk member recalls
+                    # a removal newer than this copy — it restarted
+                    # empty or just inherited the arc; this copy may be
+                    # the last one alive, so restore it to the home
+                    # (which re-tracks and fans replicas)
                     sentinel = object()
                     value = self.store.get(key, sentinel, _local=True)
                     if value is not sentinel:
@@ -572,7 +675,8 @@ class DkvRouter:
                             pass  # keep the copy; retry next cycle
                         continue
             # orphan: the home REMOVED the key (died between replicate
-            # and remove), or this node left the key's arc
+            # and remove), a tombstone newer than the copy survives on
+            # the walk, or this node left the key's arc
             self._replica_copies.discard(key)
             try:
                 self.store.remove(key, _local=True)
@@ -588,6 +692,11 @@ def install(cloud: Cloud, store=None) -> DkvRouter:
         from h2o3_tpu.keyed import DKV as store  # noqa: N811
     router = DkvRouter(cloud, store)
     store.router = router
+    #: the cloud remembers its store so layers that receive only the
+    #: cloud (task executors, chunk-home fan-out) resolve the SAME
+    #: store the router serves — critical with several in-process
+    #: Clouds, where the global DKV singleton is the wrong one
+    cloud.dkv_store = store
     # anti-entropy rides the gossip cadence: one bounded sweep per cycle
     cloud.add_cycle_hook(router.sweep_replicas)
     return router

@@ -206,6 +206,17 @@ def clear_plan() -> None:
     set_plan(None)
 
 
+def consult_subtask(node_name: str, task: str) -> Optional[Directive]:
+    """Server-side consult for one named DTask: matches method
+    ``dtask:<task>`` so a plan can target a single task kind on a single
+    node (the RPC-layer consult only sees the umbrella ``dtask``).
+    Returns None when no plan is active."""
+    plan = _PLAN
+    if plan is None:
+        return None
+    return plan.consult("server", node_name or "", "", f"dtask:{task}")
+
+
 def backoff_rng() -> random.Random:
     """The retry ladder's jitter source: the active plan's seeded PRNG
     under chaos (deterministic spacing), a plain Random otherwise."""

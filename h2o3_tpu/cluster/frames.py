@@ -1,0 +1,723 @@
+"""L4: distributed Frames — chunk homes on the DKV ring.
+
+Reference: a ``Vec`` is a *distributed* column whose chunks live on
+ESPC-assigned home nodes and compute moves to the data
+(``water/fvec/Vec.java`` chunk/ESPC arithmetic, ``water/MRTask.java``
+map-side execution).  Here a chunk-homed parse tokenizes each CSV chunk
+ON its ring home and stores the tokenized payload there (replicated to
+``H2O3_TPU_CHUNK_REPLICAS`` ring successors at write time); the frame
+the caller gets back is a :class:`DistFrame` — a lazy Frame whose
+columns live as chunk ranges on the ring, described by a routable
+LAYOUT dict stored under ``fr#<key>#layout``.
+
+Placement: every chunk key ``fr#<key>#g<j>t<t>#c<i>`` ring-hashes by its
+GROUP ANCHOR (``dkv.ring_key``), so a group's chunks land contiguously
+on one member and ride the DKV's existing fault machinery — replica
+walk, read-repair, anti-entropy sweep — as a unit.  The anchor's ``t``
+is probed at parse time so group ``j`` homes on worker ``j``: placement
+stays balanced and deterministic for a fixed membership.
+
+``map_reduce`` over a chunk-homed frame runs map-side on each group's
+CURRENT ring home over its local chunks (the existing shard_map path)
+with only partials crossing the wire.  When a home dies mid-fan-out the
+group re-executes from replica chunks on the ring successors
+(``cluster_fanout_recovered_total{path=replica}``); survivors and the
+caller are deeper rungs of the same ladder.  A restarted-empty home
+pulls its chunks back through the store's read-repair walk.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from h2o3_tpu.cluster import rpc as _rpc
+from h2o3_tpu.cluster import transport
+from h2o3_tpu.cluster.dkv import MAX_REPLICAS
+from h2o3_tpu.frame.frame import ColType, Column, Frame, NA_CAT
+from h2o3_tpu.util import telemetry
+
+_CHUNK_HOMES = telemetry.gauge(
+    "cluster_chunk_homes",
+    "chunk groups the most recent chunk-homed parse landed on ring "
+    "members (one group of contiguous chunks per home)",
+)
+_REPLICA_BYTES = telemetry.counter(
+    "cluster_chunk_replica_bytes",
+    "tokenized chunk payload bytes fanned to ring-successor replicas "
+    "at parse time (write-time durability cost of chunk homes)",
+)
+
+#: room the pickled RPC/store envelope (key, token, trace ids, pickle
+#: framing) needs around a chunk payload inside one transport frame
+_ENVELOPE_SLACK = 1 << 16
+
+
+class ChunkTooLargeError(ValueError):
+    """A chunk payload cannot cross the wire in one transport frame —
+    raised with the offending chunk id BEFORE the opaque mid-transfer
+    ``FrameTooLarge`` the transport would otherwise die with."""
+
+    def __init__(self, chunk_id: str, nbytes: int, limit: int) -> None:
+        super().__init__(
+            f"chunk {chunk_id!r} is {nbytes} bytes but at most {limit} "
+            f"fit one transport frame (transport.MAX_FRAME_BYTES = "
+            f"{transport.MAX_FRAME_BYTES} minus envelope slack); re-parse "
+            f"with smaller chunks (set H2O3_TPU_PARSE_CHUNK_BYTES below "
+            f"{limit}) or raise transport.MAX_FRAME_BYTES on every member")
+        self.chunk_id = chunk_id
+        self.nbytes = nbytes
+        self.limit = limit
+
+
+def guard_chunk_payload(chunk_id: str, value: Any) -> int:
+    """Size ``value`` as it will cross the wire and raise a typed
+    :class:`ChunkTooLargeError` when it cannot fit one transport frame.
+    Returns the measured byte size (the replica-bytes meter reuses it)."""
+    if isinstance(value, (bytes, bytearray, memoryview)):
+        nbytes = len(value)
+    else:
+        nbytes = len(pickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL))
+    limit = max(0, int(transport.MAX_FRAME_BYTES) - _ENVELOPE_SLACK)
+    if nbytes > limit:
+        raise ChunkTooLargeError(chunk_id, nbytes, limit)
+    return nbytes
+
+
+def chunk_replicas() -> int:
+    """Replica depth for chunk payloads: ``H2O3_TPU_CHUNK_REPLICAS``
+    (default 2 = home + one successor), clamped to the ring's reachable
+    depth."""
+    try:
+        r = int(os.environ.get("H2O3_TPU_CHUNK_REPLICAS", "2"))
+    except ValueError:
+        r = 2
+    return max(1, min(r, MAX_REPLICAS))
+
+
+# ---------------------------------------------------------------------------
+# key scheme (see dkv.ring_key for the placement contract)
+
+
+def layout_key(frame_key: str) -> str:
+    return f"fr#{frame_key}#layout"
+
+
+def chunk_key(anchor: str, i: int) -> str:
+    """Chunk ``i`` (GLOBAL chunk index) of the group homed at ``anchor``."""
+    return f"{anchor}#c{i}"
+
+
+def _probe_anchor(router, frame_key: str, g: int, want_ident: str) -> str:
+    """Smallest ``t`` whose anchor ``fr#<key>#g<g>t<t>`` ring-homes on
+    the wanted member — group ``g`` then deterministically homes on
+    worker ``g`` and parse placement stays balanced regardless of how
+    the raw hashes fall."""
+    fallback = f"fr#{frame_key}#g{g}t0"
+    for t in range(512):
+        cand = f"fr#{frame_key}#g{g}t{t}"
+        hm = router.home_members(cand, 1)
+        if hm and hm[0].info.ident == want_ident:
+            return cand
+    return fallback
+
+
+def _layout_stamp(espc: Sequence[int], anchors: Sequence[str]) -> str:
+    return hashlib.md5(
+        repr((tuple(int(e) for e in espc), tuple(anchors))).encode()
+    ).hexdigest()[:12]
+
+
+# ---------------------------------------------------------------------------
+# DistFrame — the lazy caller-side handle
+
+
+class DistFrame(Frame):
+    """A Frame whose chunks live on their DKV ring homes.
+
+    Shape/metadata (``nrows``/``ncols``/``names``/``types``) answer from
+    the layout without touching the ring, so listings never materialize.
+    Any column access gathers every chunk through the store (ring walk +
+    read-repair) and reduces with the parse pipeline's own phase-2 merge
+    — the materialized frame is bit-identical to a local parse."""
+
+    def __init__(self, layout: Dict[str, Any], setup, store) -> None:
+        # deliberately NOT calling Frame.__init__: there are no resident
+        # columns yet, and _cols below materializes on first touch
+        self.chunk_layout = layout
+        self.key = layout["frame_key"]
+        self._setup = setup
+        self._store = store
+        self._materialized: Optional[List[Column]] = None
+
+    # -- lazy column storage -------------------------------------------------
+    @property
+    def _cols(self) -> List[Column]:
+        if self._materialized is None:
+            self._materialized = self._gather()
+        return self._materialized
+
+    def _gather(self) -> List[Column]:
+        from h2o3_tpu.frame import parse as _parse
+
+        results = []
+        for grp in self.chunk_layout["groups"]:
+            for i in range(grp["lo"], grp["hi"]):
+                ck = chunk_key(grp["anchor"], i)
+                v = self._store.get(ck)
+                if v is None:
+                    raise KeyError(
+                        f"chunk {ck} of frame {self.key!r} is unreachable "
+                        f"on the ring (home and every replica down?)")
+                results.append(tuple(v))
+        return _parse._reduce_chunks(results, self._setup)._cols
+
+    # -- metadata off the layout (no ring traffic) ---------------------------
+    @property
+    def nbytes_resident(self) -> int:
+        """Host bytes this handle actually pins — 0 until materialized.
+        The store's spill sizing reads this instead of ``columns`` so a
+        put/list of a DistFrame never gathers remote chunks."""
+        if self._materialized is None:
+            return 0
+        return int(sum(getattr(c.data, "nbytes", 0)
+                       for c in self._materialized))
+
+    @property
+    def nrows(self) -> int:
+        return int(self.chunk_layout["espc"][-1])
+
+    @property
+    def ncols(self) -> int:
+        return len(self.chunk_layout["column_names"])
+
+    @property
+    def names(self) -> List[str]:
+        return list(self.chunk_layout["column_names"])
+
+    @property
+    def types(self) -> Dict[str, ColType]:
+        return dict(zip(self.chunk_layout["column_names"],
+                        self.chunk_layout["column_types"]))
+
+    def __repr__(self) -> str:
+        lay = self.chunk_layout
+        state = "resident" if self._materialized is not None else "remote"
+        return (f"<DistFrame {self.key!r} {self.nrows}x{self.ncols} "
+                f"groups={len(lay['groups'])} replicas={lay['replicas']} "
+                f"{state}>")
+
+
+# ---------------------------------------------------------------------------
+# parse-to-homes (caller side)
+
+
+def _resolve_store(cloud, store=None):
+    if store is not None:
+        return store
+    store = getattr(cloud, "dkv_store", None)
+    if store is not None:
+        return store
+    from h2o3_tpu.keyed import DKV
+
+    return DKV
+
+
+def distributed_parse_to_homes(
+    chunks: Sequence[bytes],
+    setup,
+    cloud,
+    store=None,
+    timeout: float = 300.0,
+    key: Optional[str] = None,
+) -> Frame:
+    """Phase-1 tokenization that LANDS each chunk on its ring home
+    instead of returning payloads to the caller: contiguous chunk ranges
+    (one group per worker) fan out as ``parse_chunk_home`` tasks, each
+    home tokenizes locally, stores the payload under its chunk key with
+    ``chunk_replicas()`` copies, and returns only shape metadata (nrows
+    + CAT domains).  The caller assembles the ESPC + global domains into
+    the routable layout and returns a lazy :class:`DistFrame`.
+
+    A home that fails mid-parse degrades per chunk: the caller tokenizes
+    that chunk itself and routes the payload through the store (which
+    forwards to the chunk's current ring home) — parse completes against
+    any single-member loss."""
+    from h2o3_tpu.cluster import tasks as _tasks
+    from h2o3_tpu.frame import parse as _parse
+
+    store = _resolve_store(cloud, store)
+    router = getattr(store, "router", None)
+    workers = _tasks._healthy_workers(cloud) if cloud is not None else []
+    chunks = list(chunks)
+    if router is None or not router.active() or len(workers) < 2:
+        # no routable ring: plain local reduce (the caller's fallback)
+        na = frozenset(setup.na_strings)
+        napack = _parse._pipeline_napack(setup)
+        return _parse._reduce_chunks(
+            [_parse._parse_chunk(c, setup, na, napack) for c in chunks],
+            setup)
+    if key is None:
+        import uuid
+
+        key = f"frame_{uuid.uuid4().hex[:10]}"
+
+    k = len(workers)
+    nchunks = len(chunks)
+    ngroups = max(1, min(k, nchunks))
+    gbounds = [round(j * nchunks / ngroups) for j in range(ngroups + 1)]
+    replicas = chunk_replicas()
+    anchors = [_probe_anchor(router, key, j, workers[j].info.ident)
+               for j in range(ngroups)]
+    group_of = np.searchsorted(gbounds, np.arange(nchunks), side="right") - 1
+
+    na = frozenset(setup.na_strings)
+    napack = _parse._pipeline_napack(setup)
+    nrows = [0] * nchunks
+    stored = [0] * nchunks
+    chunk_domains: List[Optional[list]] = [None] * nchunks
+
+    def _local_land(i: int, j: int) -> Dict[str, Any]:
+        """Caller-side fallback: tokenize here, route the payload to the
+        chunk's CURRENT ring home through the store."""
+        n, payloads, used_native = _parse._parse_chunk(
+            chunks[i], setup, na, napack)
+        value = [int(n), payloads, bool(used_native)]
+        nbytes = guard_chunk_payload(chunk_key(anchors[j], i), value)
+        store.put(chunk_key(anchors[j], i), value, replicas=replicas)
+        doms = [p[1] if isinstance(p, tuple) else None for p in payloads]
+        return {"nrows": int(n), "domains": doms, "nbytes": nbytes}
+
+    with telemetry.Span("distributed_parse_to_homes", chunks=nchunks,
+                        groups=ngroups, replicas=replicas):
+        ctx = telemetry.current_trace_context()
+
+        def _run(i: int) -> None:
+            j = int(group_of[i])
+            target = workers[j]
+            ck = chunk_key(anchors[j], i)
+            guard_chunk_payload(ck, chunks[i])
+            with telemetry.Span(
+                    "parse_chunk_home", trace_id=ctx["trace_id"],
+                    parent_id=ctx["span_id"], member=target.info.name,
+                    chunk=i):
+                try:
+                    if target.info.name == cloud.info.name:
+                        resp = parse_chunk_home(
+                            {"chunk": chunks[i], "setup": setup,
+                             "chunk_key": ck, "replicas": replicas},
+                            cloud, store)
+                    else:
+                        resp = _tasks.submit(
+                            cloud, target, "parse_chunk_home",
+                            {"chunk": chunks[i], "setup": setup,
+                             "chunk_key": ck, "replicas": replicas},
+                            timeout=timeout)
+                except _rpc.RPCError:
+                    resp = _local_land(i, j)
+                nrows[i] = int(resp["nrows"])
+                stored[i] = int(resp.get("nbytes", 0))
+                chunk_domains[i] = resp["domains"]
+
+        from concurrent.futures import ThreadPoolExecutor
+        from concurrent.futures import wait as _futures_wait
+
+        ex = ThreadPoolExecutor(max_workers=2 * k,
+                                thread_name_prefix="parse-home")
+        futs = [ex.submit(_run, i) for i in range(nchunks)]
+        _futures_wait(futs, timeout=timeout)
+        ex.shutdown(wait=False, cancel_futures=True)
+        for i, f in enumerate(futs):
+            if not f.done():
+                raise TimeoutError(
+                    f"chunk {i} did not land on its home in {timeout}s")
+            f.result()  # re-raise guard/tokenize errors with their type
+
+    espc = [0] * (nchunks + 1)
+    for i in range(nchunks):
+        espc[i + 1] = espc[i] + nrows[i]
+    # global CAT domains with the EXACT _reduce_chunks math, so map-side
+    # code remapping is bit-identical to a materializing gather
+    domains: Dict[str, list] = {}
+    for jcol, name in enumerate(setup.column_names):
+        if setup.column_types[jcol] is ColType.CAT:
+            doms = [(chunk_domains[i] or [None] * len(setup.column_names))
+                    [jcol] or [] for i in range(nchunks)]
+            domains[name] = (
+                sorted(set().union(*map(set, doms))) if doms else [])
+    layout = {
+        "frame_key": key,
+        "espc": espc,
+        "replicas": replicas,
+        "groups": [
+            {"g": j, "anchor": anchors[j],
+             "lo": gbounds[j], "hi": gbounds[j + 1],
+             "home": workers[j].info.ident,
+             "home_name": workers[j].info.name}
+            for j in range(ngroups)
+        ],
+        "column_names": list(setup.column_names),
+        "column_types": list(setup.column_types),
+        "domains": domains,
+        "nbytes": int(sum(stored)),
+        "stamp": _layout_stamp(espc, anchors),
+    }
+    store.put(layout_key(key), layout, replicas=MAX_REPLICAS)
+    _CHUNK_HOMES.set(ngroups)
+    return DistFrame(layout, setup, store)
+
+
+# ---------------------------------------------------------------------------
+# home-side task bodies (registered as context tasks in cluster/tasks.py)
+
+
+def parse_chunk_home(payload: Dict[str, Any], cloud, store) -> Dict[str, Any]:
+    """Tokenize one chunk ON its home and store the payload locally with
+    replica fan-out; only shape metadata returns to the caller."""
+    from h2o3_tpu.frame import parse as _parse
+
+    setup = payload["setup"]
+    na = frozenset(setup.na_strings)
+    napack = _parse._pipeline_napack(setup)
+    n, payloads, used_native = _parse._parse_chunk(
+        payload["chunk"], setup, na, napack)
+    value = [int(n), payloads, bool(used_native)]
+    ck = payload["chunk_key"]
+    replicas = int(payload.get("replicas", 1))
+    nbytes = guard_chunk_payload(ck, value)
+    store.put(ck, value, replicas=replicas)
+    if replicas > 1:
+        _REPLICA_BYTES.inc(nbytes * (replicas - 1))
+    doms = [p[1] if isinstance(p, tuple) else None for p in payloads]
+    return {"nrows": int(n), "domains": doms, "nbytes": nbytes,
+            "native": bool(used_native)}
+
+
+#: (frame_key, stamp) -> layout, and (frame_key, stamp, g, names) ->
+#: assembled host columns — both bounded LRU so repeated map_reduce over
+#: the same chunk-homed frame re-runs from warm host columns instead of
+#: re-walking the ring per call
+_CACHE_LOCK = threading.Lock()
+_LAYOUT_CACHE: "OrderedDict[Tuple[str, str], Dict[str, Any]]" = OrderedDict()
+_GROUP_CACHE: "OrderedDict[tuple, Dict[str, np.ndarray]]" = OrderedDict()
+_LAYOUT_CACHE_MAX = 8
+_GROUP_CACHE_MAX = 8
+
+
+def _cache_put(cache: OrderedDict, key, value, cap: int) -> None:
+    with _CACHE_LOCK:
+        cache[key] = value
+        cache.move_to_end(key)
+        while len(cache) > cap:
+            cache.popitem(last=False)
+
+
+def _layout_for(store, frame_key: str, stamp: str) -> Dict[str, Any]:
+    with _CACHE_LOCK:
+        lay = _LAYOUT_CACHE.get((frame_key, stamp))
+    if lay is not None:
+        return lay
+    lay = store.get(layout_key(frame_key))
+    if not isinstance(lay, dict):
+        raise _rpc.RpcFault(
+            f"layout for frame {frame_key!r} unreachable", code=404)
+    if lay.get("stamp") != stamp:
+        # the caller holds a different parse of this key than the ring —
+        # conflict, not absence: the caller falls down its ladder
+        raise _rpc.RpcFault(
+            f"layout stamp mismatch for frame {frame_key!r}", code=409)
+    _cache_put(_LAYOUT_CACHE, (frame_key, stamp), lay, _LAYOUT_CACHE_MAX)
+    return lay
+
+
+def _fetch_group_chunks(store, layout: Dict[str, Any], g: int) -> list:
+    grp = layout["groups"][g]
+    vals = []
+    for i in range(grp["lo"], grp["hi"]):
+        ck = chunk_key(grp["anchor"], i)
+        v = store.get(ck)
+        if v is None:
+            raise _rpc.RpcFault(
+                f"chunk {ck} unreachable on the ring", code=404)
+        vals.append(v)
+    return vals
+
+
+def columns_from_group(store, layout: Dict[str, Any], g: int,
+                       names: Sequence[str]) -> Dict[str, np.ndarray]:
+    """Assemble one group's host columns (float64 numeric views) from
+    its chunks — local hits on the home/replica holder, ring walk +
+    read-repair anywhere else.  CAT codes remap to the layout's GLOBAL
+    domain with the same arithmetic as the parse phase-2 merge, so every
+    executor sees the numbers a materializing gather would."""
+    ckey = (layout["frame_key"], layout["stamp"], int(g), tuple(names))
+    with _CACHE_LOCK:
+        cached = _GROUP_CACHE.get(ckey)
+        if cached is not None:
+            _GROUP_CACHE.move_to_end(ckey)
+    if cached is not None:
+        return cached
+    vals = _fetch_group_chunks(store, layout, g)
+    col_names = layout["column_names"]
+    col_types = layout["column_types"]
+    out: Dict[str, np.ndarray] = {}
+    for name in names:
+        j = col_names.index(name)
+        ctype = col_types[j]
+        if ctype is ColType.CAT:
+            gdl = layout["domains"].get(name) or []
+            gd = np.array(gdl) if gdl else None
+            parts = []
+            for v in vals:
+                codes, dom = v[1][j]
+                if dom:
+                    remap = np.searchsorted(
+                        gd, np.array(dom)).astype(np.int32)
+                    codes = np.where(
+                        codes >= 0, remap[np.clip(codes, 0, None)], NA_CAT
+                    ).astype(np.int32)
+                parts.append(codes)
+            data = (np.concatenate(parts) if parts
+                    else np.empty(0, dtype=np.int32))
+            view = data.astype(np.float64)
+            view[data < 0] = np.nan
+            out[name] = view
+        elif ctype in (ColType.STR, ColType.UUID):
+            raise TypeError(
+                f"column {name!r} of type {ctype} has no numeric view")
+        else:
+            parts = [np.asarray(v[1][j], dtype=np.float64) for v in vals]
+            out[name] = (np.concatenate(parts) if parts
+                         else np.empty(0, dtype=np.float64))
+    _cache_put(_GROUP_CACHE, ckey, out, _GROUP_CACHE_MAX)
+    return out
+
+
+def mr_chunks(payload: Dict[str, Any], cloud, store) -> Any:
+    """Map-side execution over one group's LOCAL chunks: assemble the
+    group's columns (cache-warm after the first call) and run the
+    existing shard_map+psum path; only the partial returns."""
+    from h2o3_tpu.cluster import tasks as _tasks
+
+    layout = _layout_for(store, payload["frame_key"], payload["stamp"])
+    cols = columns_from_group(
+        store, layout, int(payload["g"]), list(payload["names"]))
+    return _tasks._mr_shard_local(
+        payload["fn"], cols, payload.get("reduce", "sum"))
+
+
+# ---------------------------------------------------------------------------
+# chunk-homed map_reduce (caller side)
+
+
+def map_reduce_chunk_homed(
+    fn,
+    frame: Frame,
+    reduce: str = "sum",
+    cloud=None,
+    timeout: float = 300.0,
+    names: Optional[Sequence[str]] = None,
+) -> Any:
+    """MRTask over a chunk-homed frame: each group executes on its
+    CURRENT ring home over home-local chunks, only partials cross the
+    wire, and the caller combines them in group order.
+
+    Recovery ladder when a group's home fails mid-fan-out (self-healing,
+    replica-first): (1) the group's ring successors hold replica CHUNKS,
+    so they re-execute from local copies (``path=replica``); (2) any
+    other healthy member re-executes by walking the ring for the chunks
+    (``path=survivor``); (3) the caller assembles the group itself from
+    whatever replicas answer the walk (``path=local``) — never by
+    re-parsing the source."""
+    from h2o3_tpu.cluster import tasks as _tasks
+
+    layout = frame.chunk_layout
+    if layout is None:
+        raise ValueError("map_reduce_chunk_homed needs a chunk-homed frame")
+    if reduce not in _tasks._COMBINE:
+        raise ValueError(
+            f"unknown reduce {reduce!r}; valid choices: "
+            f"{sorted(_tasks._COMBINE)}")
+    if names is None:
+        names = [n for n, t in zip(layout["column_names"],
+                                   layout["column_types"])
+                 if t not in (ColType.STR, ColType.UUID)]
+    names = list(names)
+    if cloud is None:
+        from h2o3_tpu.cluster import active_cloud
+
+        cloud = active_cloud()
+    store = getattr(frame, "_store", None) or _resolve_store(cloud)
+    router = getattr(store, "router", None)
+    workers = _tasks._healthy_workers(cloud) if cloud is not None else []
+    groups = layout["groups"]
+    if (cloud is None or router is None or not router.active()
+            or len(workers) < 2 or not groups):
+        # no multi-node ring: gather through the store and run the plain
+        # local path — bit-identical to a resident single-node frame
+        host = {n: frame.col(n).numeric_view() for n in names}
+        return _tasks._mr_shard_local(fn, host, reduce)
+    if getattr(fn, "__name__", "<lambda>") == "<lambda>" or \
+            getattr(fn, "__closure__", None):
+        raise ValueError(
+            "distributed map_reduce needs a module-level fn (it crosses "
+            "the wire by module reference); got a lambda/closure")
+
+    my_name = cloud.info.name
+    _tasks._FANOUT.set(len(groups))
+    partials: List[Any] = [None] * len(groups)
+    errors: List[Optional[BaseException]] = [None] * len(groups)
+
+    def _exec_local(g: int) -> Any:
+        cols = columns_from_group(store, layout, g, names)
+        return _tasks._mr_shard_local(fn, cols, reduce)
+
+    with telemetry.Span("map_reduce_chunk_homed", groups=len(groups),
+                        rows=int(layout["espc"][-1]), reduce=reduce):
+        ctx = telemetry.current_trace_context()
+
+        def _run(gi: int) -> None:
+            grp = groups[gi]
+            payload = {"frame_key": layout["frame_key"],
+                       "stamp": layout["stamp"], "g": gi,
+                       "names": names, "fn": fn, "reduce": reduce}
+            cands = router.home_members(grp["anchor"], MAX_REPLICAS)
+            with telemetry.Span(
+                    "mr_group", trace_id=ctx["trace_id"],
+                    parent_id=ctx["span_id"], group=gi,
+                    anchor=grp["anchor"]):
+                # rung 0: the group's CURRENT ring home — data-local in
+                # the healthy case, and the node a restarted-empty home
+                # re-adopts its chunks on (its executor's ring walk
+                # read-repairs them back)
+                try:
+                    if cands and cands[0].info.name == my_name:
+                        partials[gi] = _exec_local(gi)
+                        return
+                    if cands:
+                        partials[gi] = _tasks.submit(
+                            cloud, cands[0], "mr_chunks", payload,
+                            timeout=timeout)
+                        return
+                except (_rpc.RPCError, _rpc.RpcFault):
+                    pass
+                # rung 1: ring successors hold replica CHUNKS — the dead
+                # home's range re-executes from copies, not re-parse
+                for m in cands[1:]:
+                    try:
+                        if m.info.name == my_name:
+                            out = _exec_local(gi)
+                        else:
+                            out = _tasks.submit(cloud, m, "mr_chunks",
+                                                payload, timeout=timeout)
+                        _tasks._RECOVERED.inc(path="replica")
+                        partials[gi] = out
+                        return
+                    except (_rpc.RPCError, _rpc.RpcFault):
+                        continue
+                # rung 2: any other healthy member (walks the ring for
+                # the chunks itself)
+                cand_names = {m.info.name for m in cands}
+                for m in workers:
+                    if (m.info.name in cand_names
+                            or m.info.name == my_name or not m.healthy):
+                        continue
+                    try:
+                        out = _tasks.submit(cloud, m, "mr_chunks",
+                                            payload, timeout=timeout)
+                        _tasks._RECOVERED.inc(path="survivor")
+                        partials[gi] = out
+                        return
+                    except (_rpc.RPCError, _rpc.RpcFault):
+                        continue
+                # rung 3: the caller itself, from replica chunks via the
+                # store's ring walk — the last resort
+                try:
+                    partials[gi] = _exec_local(gi)
+                    _tasks._RECOVERED.inc(path="local")
+                except BaseException as e:  # noqa: BLE001 — surfaced below
+                    errors[gi] = e
+
+        threads = [threading.Thread(target=_run, args=(gi,), daemon=True)
+                   for gi in range(len(groups))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=timeout)
+
+        for gi in range(len(groups)):
+            if partials[gi] is None and errors[gi] is None:
+                # never answered in the deadline: caller-local last resort
+                partials[gi] = _exec_local(gi)
+                _tasks._RECOVERED.inc(path="local")
+        for e in errors:
+            if e is not None:
+                raise e
+
+        import jax
+
+        op = _tasks._COMBINE[reduce]
+        out = partials[0]
+        for p in partials[1:]:
+            out = jax.tree.map(op, out, p)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# REST surface helpers (/3/Frames chunk layout + replica health)
+
+
+def layout_health(frame: Frame, cloud=None) -> Optional[Dict[str, Any]]:
+    """Chunk layout + replica health for the /3/Frames listing: per
+    group, whether the frozen home is still a healthy member and how
+    many ring candidates for its anchor are currently alive.  Answers
+    from membership state only — no ring traffic."""
+    layout = getattr(frame, "chunk_layout", None)
+    if layout is None:
+        return None
+    if cloud is None:
+        try:
+            from h2o3_tpu.cluster import active_cloud
+
+            cloud = active_cloud()
+        except Exception:
+            cloud = None
+    store = getattr(frame, "_store", None)
+    router = getattr(store, "router", None) if store is not None else None
+    groups_out = []
+    for grp in layout["groups"]:
+        ent = {"group": grp["g"], "home": grp["home_name"],
+               "chunks": [grp["lo"], grp["hi"]], "anchor": grp["anchor"]}
+        if router is not None:
+            cands = router.home_members(grp["anchor"], MAX_REPLICAS)
+            ent["holders_alive"] = len(cands)
+            ent["home_alive"] = bool(
+                cands and any(m.info.ident == grp["home"] for m in cands))
+        groups_out.append(ent)
+    healthy = all(g.get("home_alive", True) for g in groups_out)
+    return {
+        "replicas": layout["replicas"],
+        "espc": list(layout["espc"]),
+        "nbytes": layout.get("nbytes", 0),
+        "groups": groups_out,
+        "healthy": healthy,
+    }
+
+
+#: module-level MR fns — importable on every member (one codebase per
+#: cloud), used by the cluster bench's dist_frame cell and tests
+def mr_sum_xy(cols, mask):
+    import jax.numpy as jnp
+
+    w = mask.astype(jnp.float32) if hasattr(mask, "astype") else mask
+    return {
+        "sx": jnp.sum(jnp.where(mask, cols["x"], 0.0)),
+        "sy": jnp.sum(jnp.where(mask, cols["y"], 0.0)),
+        "n": jnp.sum(w),
+    }

@@ -71,6 +71,13 @@ _RPC_SERVED = telemetry.counter(
     "rpc_served_total", "RPC requests served by the local node",
     labels=("method", "result"),
 )
+_RPC_PAYLOAD_BYTES = telemetry.counter(
+    "rpc_payload_bytes_total",
+    "encoded RPC envelope bytes this node's client moved, by direction "
+    "(sent = requests out, received = responses in) — the wire meter "
+    "that proves a chunk-homed map_reduce ships partials, not chunks",
+    labels=("direction",),
+)
 _RPC_INFLIGHT = telemetry.gauge(
     "rpc_inflight",
     "RPC calls currently in flight (client: awaiting a response; server: "
@@ -87,6 +94,10 @@ _INFLIGHT_SERVER = _RPC_INFLIGHT.bind(side="server")
 #: closed set per process, so the cache is tiny and the per-call observe
 #: drops to a dict hit + locked update
 _seconds_bound: Dict[Tuple[str, str], telemetry._Bound] = {}
+
+#: bound byte-meter series — ticked once per attempt on the hot path
+_SENT_BYTES = _RPC_PAYLOAD_BYTES.bind(direction="sent")
+_RECEIVED_BYTES = _RPC_PAYLOAD_BYTES.bind(direction="received")
 
 
 def _observe_seconds(method: str, side: str, v: float) -> None:
@@ -380,6 +391,7 @@ class RpcClient:
         pooled connection that fails is closed and the next tried WITHIN
         the attempt — only a fresh dial's failure, or any timeout,
         charges the retry ladder."""
+        _SENT_BYTES.inc(len(request))
         while True:
             conn = self.pool.pop_idle(addr)
             if conn is None:
@@ -393,6 +405,7 @@ class RpcClient:
                 conn.close()  # stale pooled socket: try the next
                 continue
             self.pool.put(conn)
+            _RECEIVED_BYTES.inc(len(raw))
             return raw
         conn = self.pool.dial(addr, timeout)
         try:
@@ -401,6 +414,7 @@ class RpcClient:
             conn.close()  # response may still arrive: poisoned
             raise
         self.pool.put(conn)
+        _RECEIVED_BYTES.inc(len(raw))
         return raw
 
     def close(self) -> None:

@@ -19,11 +19,18 @@ Two fan-outs mirror the two distributed workloads this repro has:
   (``frame/parse._parse_chunk``) over members, reducing with the parse
   pipeline's own phase-2 merge, so multi-node parse shares the serial
   path's bit-identity contract.
+
+When the cloud has a DKV store installed (:func:`dkv.install`), both
+fan-outs upgrade to CHUNK HOMES (``cluster/frames.py``): parse lands
+tokenized chunks on their ring homes with replication and map_reduce
+over the resulting :class:`~h2o3_tpu.cluster.frames.DistFrame` executes
+map-side on each home with only partials crossing the wire.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -40,15 +47,20 @@ _FANOUT = telemetry.gauge(
     "cluster_task_fanout", "members the most recent fan-out spanned")
 _RECOVERED = telemetry.counter(
     "cluster_fanout_recovered_total",
-    "fan-out work units re-run after a member failure: path=survivor "
-    "rescheduled onto another live member, path=local fell back to the "
-    "caller (the last resort)",
+    "fan-out work units re-run after a member failure: path=replica "
+    "re-executed a chunk group from replica chunks on the dead home's "
+    "ring successors, path=survivor rescheduled onto another live "
+    "member, path=local fell back to the caller (the last resort)",
     labels=("path",),
 )
 
 #: name -> handler; a task must be registered on every node of the cloud
 #: (one codebase per cloud), like DTask classes on the shared classpath
 _REGISTRY: Dict[str, Callable[[Any], Any]] = {}
+
+#: name -> handler(payload, cloud, store) for tasks that need the node's
+#: own cloud + DKV store (the chunk-home tasks store/read ring data)
+_CTX_REGISTRY: Dict[str, Callable[[Any, Any, Any], Any]] = {}
 
 
 def register_task(name: str, fn: Optional[Callable[[Any], Any]] = None):
@@ -59,14 +71,51 @@ def register_task(name: str, fn: Optional[Callable[[Any], Any]] = None):
     return _reg(fn) if fn is not None else _reg
 
 
-def _run_task(payload: Dict[str, Any]) -> Any:
+def register_ctx_task(name: str,
+                      fn: Optional[Callable[[Any, Any, Any], Any]] = None):
+    """Register (or decorate) a context task handler — called as
+    ``fn(payload, cloud, store)`` with the EXECUTING node's cloud and
+    installed DKV store."""
+    def _reg(f: Callable[[Any, Any, Any], Any]):
+        _CTX_REGISTRY[name] = f
+        return f
+    return _reg(fn) if fn is not None else _reg
+
+
+def _consult_subtask_faults(cloud, name: str) -> None:
+    """Per-task nemesis hook: the RPC server consult sees every dtask as
+    method ``dtask``; this one matches ``dtask:<name>`` so a chaos plan
+    can target one task kind on one node (e.g. delay only ``mr_chunks``
+    on the victim home)."""
+    from h2o3_tpu.cluster import faults as _faults
+
+    d = _faults.consult_subtask(
+        getattr(getattr(cloud, "info", None), "name", "") or "", name)
+    if d is None:
+        return
+    if d.action == "crash":
+        _faults.crash_now()
+    if d.action in ("delay", "reorder") and d.delay_s > 0:
+        time.sleep(d.delay_s)
+    elif d.action in ("drop", "black_hole"):
+        raise _rpc.RpcFault(f"fault-injected drop of dtask:{name}", code=503)
+
+
+def _run_task(payload: Dict[str, Any], cloud=None, store=None) -> Any:
     name = payload.get("task")
+    cfn = _CTX_REGISTRY.get(name)
     fn = _REGISTRY.get(name)
-    if fn is None:
+    if cfn is None and fn is None:
         _TASKS_METER.inc(task=str(name), result="unknown")
         raise _rpc.RpcFault(f"unknown task {name!r}", code=404)
+    _consult_subtask_faults(cloud, str(name))
     try:
-        out = fn(payload.get("payload"))
+        if cfn is not None:
+            if store is None:
+                store = getattr(cloud, "dkv_store", None)
+            out = cfn(payload.get("payload"), cloud, store)
+        else:
+            out = fn(payload.get("payload"))
     except Exception:
         _TASKS_METER.inc(task=str(name), result="error")
         raise
@@ -74,9 +123,14 @@ def _run_task(payload: Dict[str, Any]) -> Any:
     return out
 
 
-def install(cloud: Cloud) -> None:
-    """Register the DTask endpoint on a cloud's RPC server."""
-    cloud.rpc_server.register("dtask", _run_task)
+def install(cloud: Cloud, store=None) -> None:
+    """Register the DTask endpoint on a cloud's RPC server.  ``store``
+    resolves lazily from ``cloud.dkv_store`` (set by :func:`dkv.install`)
+    so install order between the two does not matter."""
+    cloud.rpc_server.register(
+        "dtask",
+        lambda p: _run_task(
+            p, cloud, store or getattr(cloud, "dkv_store", None)))
 
 
 def submit(cloud: Cloud, member: Member, task: str, payload: Any = None,
@@ -113,6 +167,16 @@ def _table_from_columns(columns: Dict[str, np.ndarray]):
     return FrameTable(arrays, row_mask(n, some.shape[0], mesh), n, mesh)
 
 
+# XLA:CPU wedges when multi-device collective programs are launched
+# concurrently from several Python threads of one process: the virtual
+# device threads interleave across the two programs' collectives and wait
+# on each other forever.  Only the in-process test topology (many Clouds,
+# one interpreter) can hit this — a real node owns its process — so a
+# process-global lock around the shard execution costs nothing in
+# production while making the in-process fan-out deadlock-free.
+_SHARD_EXEC_LOCK = threading.Lock()
+
+
 def _mr_shard_local(fn: Callable, columns: Dict[str, np.ndarray],
                     reduce: str) -> Any:
     """Run fn over one node's row range; partials come back as numpy so
@@ -121,8 +185,9 @@ def _mr_shard_local(fn: Callable, columns: Dict[str, np.ndarray],
 
     from h2o3_tpu.compute.mapreduce import map_reduce
 
-    out = map_reduce(fn, _table_from_columns(columns), reduce=reduce)
-    return jax.tree.map(np.asarray, out)
+    with _SHARD_EXEC_LOCK:
+        out = map_reduce(fn, _table_from_columns(columns), reduce=reduce)
+        return jax.tree.map(np.asarray, out)
 
 
 @register_task("mr_shard")
@@ -139,6 +204,24 @@ def _task_parse_chunk(payload: Dict[str, Any]) -> Any:
     na = frozenset(setup.na_strings)
     napack = _parse._pipeline_napack(setup)
     return _parse._parse_chunk(payload["chunk"], setup, na, napack)
+
+
+@register_ctx_task("parse_chunk_home")
+def _task_parse_chunk_home(payload: Dict[str, Any], cloud, store) -> Any:
+    from h2o3_tpu.cluster import frames as _frames
+
+    if store is None:
+        raise _rpc.RpcFault("no DKV store installed on this node", code=503)
+    return _frames.parse_chunk_home(payload, cloud, store)
+
+
+@register_ctx_task("mr_chunks")
+def _task_mr_chunks(payload: Dict[str, Any], cloud, store) -> Any:
+    from h2o3_tpu.cluster import frames as _frames
+
+    if store is None:
+        raise _rpc.RpcFault("no DKV store installed on this node", code=503)
+    return _frames.mr_chunks(payload, cloud, store)
 
 
 # ---------------------------------------------------------------------------
@@ -182,6 +265,13 @@ def distributed_map_reduce(
         from h2o3_tpu.cluster import active_cloud
 
         cloud = active_cloud()
+    if getattr(columns, "chunk_layout", None) is not None:
+        # a chunk-homed DistFrame: execute map-side on each chunk group's
+        # ring home — only partials cross the wire (cluster/frames.py)
+        from h2o3_tpu.cluster import frames as _frames
+
+        return _frames.map_reduce_chunk_homed(
+            fn, columns, reduce=reduce, cloud=cloud, timeout=timeout)
     if cloud is None:
         return _mr_shard_local(fn, columns, reduce)
     workers = _healthy_workers(cloud)
@@ -307,11 +397,16 @@ def distributed_parse_chunks(
     setup,
     cloud: Optional[Cloud] = None,
     timeout: float = 300.0,
+    key: Optional[str] = None,
 ):
-    """Phase-1 chunk tokenization round-robined over cloud members,
-    reduced with the pipeline's own phase-2 merge — multi-node parse with
-    the serial path's bit-identity contract.  Local-only when no
-    multi-node cloud is live."""
+    """Phase-1 chunk tokenization fanned over cloud members.  On a cloud
+    with a live DKV ring this lands each chunk ON its ring home with
+    replication and returns a lazy chunk-homed
+    :class:`~h2o3_tpu.cluster.frames.DistFrame` (``key`` names it; see
+    ``cluster/frames.py``).  Without a routable store it round-robins
+    tokenization and reduces with the pipeline's own phase-2 merge —
+    either way the frame the caller observes is bit-identical to the
+    serial path.  Local-only when no multi-node cloud is live."""
     from h2o3_tpu.frame import parse as _parse
 
     na = frozenset(setup.na_strings)
@@ -326,6 +421,13 @@ def distributed_parse_chunks(
         for i, chunk in enumerate(chunks):
             results[i] = _parse._parse_chunk(chunk, setup, na, napack)
         return _parse._reduce_chunks(results, setup)
+    store = getattr(cloud, "dkv_store", None)
+    router = getattr(store, "router", None) if store is not None else None
+    if router is not None and router.active():
+        from h2o3_tpu.cluster import frames as _frames
+
+        return _frames.distributed_parse_to_homes(
+            chunks, setup, cloud, store=store, timeout=timeout, key=key)
     _FANOUT.set(len(workers))
     napack = _parse._pipeline_napack(setup)
     failed: set = set()

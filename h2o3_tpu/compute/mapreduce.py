@@ -396,10 +396,18 @@ def map_reduce_frame(
     — or a cloud of one — this is exactly the local path.  Returns the
     reduced pytree as HOST (numpy) arrays in both cases, so callers see
     one contract regardless of where the shards ran."""
-    names = list(columns) if columns is not None else [
-        c.name for c in frame.columns
-        if c.type not in (ColType.STR, ColType.UUID)
-    ]
+    layout = getattr(frame, "chunk_layout", None)
+    if columns is not None:
+        names = list(columns)
+    elif layout is not None:
+        # metadata off the layout: listing a chunk-homed frame's numeric
+        # columns must not gather its remote chunks
+        names = [n for n, t in zip(layout["column_names"],
+                                   layout["column_types"])
+                 if t not in (ColType.STR, ColType.UUID)]
+    else:
+        names = [c.name for c in frame.columns
+                 if c.type not in (ColType.STR, ColType.UUID)]
     try:
         from h2o3_tpu.cluster import active_cloud
 
@@ -411,6 +419,13 @@ def map_reduce_frame(
     # distributed path's member/RPC child spans hang underneath
     with telemetry.Span("map_reduce_frame", rows=int(frame.nrows),
                         columns=len(names), distributed=cloud is not None):
+        if cloud is not None and layout is not None:
+            # chunk-homed frame: map-side execution on each group's ring
+            # home, only partials cross the wire (cluster/frames.py)
+            from h2o3_tpu.cluster.frames import map_reduce_chunk_homed
+
+            return map_reduce_chunk_homed(
+                fn, frame, reduce=reduce, cloud=cloud, names=names)
         if cloud is None:
             table = FrameTable.from_frame(frame, columns=names)
             out = map_reduce(fn, table, reduce=reduce)

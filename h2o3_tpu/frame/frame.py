@@ -242,6 +242,12 @@ class Frame:
     Heavier relational ops (group-by, merge, sort) live in h2o3_tpu/rapids/.
     """
 
+    #: chunk-home layout when this frame's chunks live distributed on the
+    #: DKV ring (h2o3_tpu/cluster/frames.py DistFrame overrides per
+    #: instance); None marks an ordinary resident frame, and the cluster
+    #: fan-outs key their chunk-homed paths off this attribute
+    chunk_layout: Optional[Dict[str, Any]] = None
+
     def __init__(self, columns: Sequence[Column], key: Optional[str] = None) -> None:
         cols = list(columns)
         if cols:

@@ -60,7 +60,12 @@ _PARSE_WORKERS = telemetry.gauge(
 
 #: chunk-parallel pipeline knobs. Workers default to the host's cores (the
 #: reference's chunk-parallel MultiFileParseTask shape); chunk size trades
-#: scheduling granularity against per-chunk overhead.
+#: scheduling granularity against per-chunk overhead.  On a multi-node
+#: cloud each raw chunk additionally ships to its DKV ring home, so
+#: ``H2O3_TPU_PARSE_CHUNK_BYTES`` must stay under one transport frame
+#: (cluster.transport.MAX_FRAME_BYTES minus envelope slack) — the
+#: chunk-home guard (cluster.frames.guard_chunk_payload) refuses typed,
+#: naming this knob, before anything hits the wire.
 DEFAULT_CHUNK_BYTES = 8 << 20
 _SAMPLE_BYTES = 1 << 20
 

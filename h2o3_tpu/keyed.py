@@ -70,6 +70,12 @@ class _SpilledFrame:
 
 
 def _frame_nbytes(obj: Any) -> int:
+    # a chunk-homed DistFrame reports its RESIDENT bytes explicitly: its
+    # ``columns`` property would gather every remote chunk, so sizing it
+    # through the generic path below would materialize it on every put
+    resident = getattr(obj, "nbytes_resident", None)
+    if resident is not None:
+        return int(resident)
     cols = getattr(obj, "columns", None)
     if cols is None or not hasattr(obj, "nrows"):
         return 0
@@ -317,6 +323,12 @@ class KeyedStore:
                 self._access[key] = self._tick
             _DKV_PUTS.inc()
             _DKV_KEYS.set(len(self._store))
+        r2 = self.router
+        if r2 is not None and r2.routes_value(value):
+            # stamp a write epoch and clear any tombstone this write
+            # supersedes (a legitimate re-put after remove resurrects;
+            # a stale replica restore must not — see DkvRouter.note_put)
+            r2.note_put(key)
         if spillable:
             self._maybe_spill()
         if replicas > 1 and not _local:

@@ -352,6 +352,158 @@ def s_partition_gossip(seed: int) -> Dict[str, bool]:
     return v
 
 
+@scenario("kill_chunk_home")
+def s_kill_chunk_home(seed: int) -> Dict[str, bool]:
+    """Chunk-homed distributed Frame through a home's death.  A CSV
+    parses ONTO the ring (``distributed_parse_chunks`` with a live DKV
+    lands tokenized chunks on their chunk-group homes, replicated to
+    ring successors), then ``distributed_map_reduce`` over the resulting
+    DistFrame runs map-side with only partials crossing the wire —
+    proven by the RPC byte meter.  The nemesis then makes one home
+    (never the caller) refuse its ``mr_chunks`` task and stops it
+    mid-fan-out: the group must re-execute from REPLICA chunks on the
+    ring successors (``path=replica``), never by caller re-parse
+    (``path=local`` stays zero), bit-identical to the local run.  A
+    fresh same-name node then boots empty in the victim's place: every
+    one of the dead home's chunks must read back through the ring walk
+    and the re-home must surface in repair/sweep telemetry.  (A real
+    SIGKILL mid-flight on child processes is the multiprocess tier —
+    ``TestSigkillChunkHome``; in-process ``stop()`` drains in-flight
+    dispatches gracefully, so the refusal rule is what makes the death
+    observable at task granularity here.)"""
+    from h2o3_tpu.cluster import dkv as _dkv
+    from h2o3_tpu.cluster import faults
+    from h2o3_tpu.cluster import tasks as _tasks
+    from h2o3_tpu.cluster.frames import DistFrame, chunk_key
+    from h2o3_tpu.cluster.membership import Cloud
+    from h2o3_tpu.frame.parse import (
+        _iter_body_chunks, parse_csv, parse_setup,
+    )
+    from h2o3_tpu.keyed import KeyedStore
+
+    clouds, stores, formed = _mini_cloud(3, hb=0.05, prefix="ch")
+    a = clouds[0]
+    c2 = None
+    v: Dict[str, bool] = {"formed": formed}
+    try:
+        # integer-valued floats (exact float32 partials under any
+        # partitioning) + a CAT column so domain merging is on the line
+        n = 24000
+        xs = np.arange(n) % 97
+        ys = (np.arange(n) * 7) % 31
+        cats = ("lo", "mid", "hi")
+        text = "x,y,c\n" + "".join(
+            f"{xs[i]},{ys[i]},{cats[i % 3]}\n" for i in range(n))
+        setup = parse_setup(text)
+        chunks = list(_iter_body_chunks(
+            [text.encode()], 16384, setup.header, setup.skip_blank_lines))
+        serial = parse_csv(text)
+
+        fr = _tasks.distributed_parse_chunks(
+            chunks, setup, cloud=a, key=f"chaos_df_{seed}")
+        lay = getattr(fr, "chunk_layout", None)
+        v["parsed_chunk_homed"] = isinstance(fr, DistFrame) and bool(lay)
+        if not v["parsed_chunk_homed"]:
+            return v
+        v["chunks_spread"] = len(
+            {g["home_name"] for g in lay["groups"]}) >= 2
+
+        host = {nm: serial.col(nm).numeric_view() for nm in ("x", "y")}
+        local = _tasks.distributed_map_reduce(mr_stat, host, cloud=None)
+        frame_bytes = sum(
+            serial.col(nm).numeric_view().nbytes for nm in serial.names)
+
+        sent0 = _counter_value("rpc_payload_bytes_total", direction="sent")
+        dist = _tasks.distributed_map_reduce(mr_stat, fr, cloud=a)
+        sent_mr = _counter_value(
+            "rpc_payload_bytes_total", direction="sent") - sent0
+        v["mr_bit_identical"] = _tree_bytes(local) == _tree_bytes(dist)
+        # map-side execution ships partials (plus gossip noise), never
+        # the columns — a host-dict fan-out would ship ~2/3 of the frame
+        v["partials_only"] = sent_mr < frame_bytes / 4
+
+        # -- nemesis: one home (never the caller) refuses its group and
+        # dies mid-fan-out ---------------------------------------------
+        victim_name = next(g["home_name"] for g in lay["groups"]
+                           if g["home_name"] != a.info.name)
+        victim = next(c for c in clouds if c.info.name == victim_name)
+        plan = faults.plan_from_dict({"seed": seed, "rules": [
+            {"action": "drop", "side": "server", "src": victim_name,
+             "method": "dtask:mr_chunks"},
+        ]})
+        faults.set_plan(plan)
+        rep0 = _counter_value("cluster_fanout_recovered_total",
+                              path="replica")
+        loc0 = _counter_value("cluster_fanout_recovered_total",
+                              path="local")
+        box: Dict[str, Any] = {}
+
+        def _dmr():
+            try:
+                box["out"] = _tasks.distributed_map_reduce(
+                    mr_stat, fr, cloud=a, timeout=60.0)
+            except Exception as e:  # invariant failure, not a crash
+                box["err"] = e
+
+        th = threading.Thread(target=_dmr, daemon=True)
+        th.start()
+        time.sleep(0.3)
+        victim.stop()
+        th.join(timeout=90.0)
+        v["refusal_injected"] = plan.hits()[0] > 0
+        v["killed_mr_completed"] = "out" in box
+        v["killed_mr_bit_identical"] = (
+            "out" in box and _tree_bytes(local) == _tree_bytes(box["out"]))
+        v["replica_recovered"] = _counter_value(
+            "cluster_fanout_recovered_total", path="replica") > rep0
+        v["no_caller_reparse"] = _counter_value(
+            "cluster_fanout_recovered_total", path="local") == loc0
+        faults.clear_plan()
+
+        # -- restart drill: a fresh same-name EMPTY node re-adopts the
+        # dead home's chunks through the ring walk ---------------------
+        v["death_detected"] = _wait(
+            lambda: all(c.size() == 2 for c in clouds
+                        if c.info.name != victim_name), 15.0)
+        repairs0 = _counter_value("cluster_dkv_read_repair_total")
+        sweep0 = {
+            act: _counter_value("cluster_dkv_replica_sweep_total",
+                                action=act)
+            for act in ("restored", "reseeded", "rehomed", "promoted")
+        }
+        c2 = Cloud("chaos", victim_name, hb_interval=0.05)
+        store_c2 = KeyedStore()
+        _dkv.install(c2, store_c2)
+        _tasks.install(c2)
+        c2.start([c.info.addr for c in clouds
+                  if c.info.name != victim_name])
+        v["restart_rejoined"] = _wait(
+            lambda: c2.size() == 3 and a.size() == 3, 20.0)
+        vgrp = next(g for g in lay["groups"]
+                    if g["home_name"] == victim_name)
+        v["chunks_readback"] = all(
+            store_c2.get(chunk_key(vgrp["anchor"], i)) is not None
+            for i in range(vgrp["lo"], vgrp["hi"]))
+        dist2 = _tasks.distributed_map_reduce(mr_stat, fr, cloud=a)
+        v["post_restart_mr_bit_identical"] = (
+            _tree_bytes(local) == _tree_bytes(dist2))
+        v["rehome_observable"] = _wait(
+            lambda: (
+                _counter_value("cluster_dkv_read_repair_total") > repairs0
+                or any(
+                    _counter_value("cluster_dkv_replica_sweep_total",
+                                   action=act) > sweep0[act]
+                    for act in sweep0)), 10.0)
+    finally:
+        if c2 is not None:
+            try:
+                c2.stop()
+            except Exception:
+                pass
+        _teardown(clouds)
+    return v
+
+
 # ---------------------------------------------------------------------------
 # slow scenarios (real child processes, SIGKILL nemesis)
 

@@ -62,14 +62,16 @@ def readme_documented_routes(readme_path: str) -> set:
 #: ``{label,...}`` hint) are treated as metric references the registry
 #: must actually contain
 _METRIC_SUFFIXES = ("_total", "_seconds", "_bytes", "_entries", "_workers",
-                    "_inflight", "_depth", "_batch_size", "_connections")
+                    "_inflight", "_depth", "_batch_size", "_connections",
+                    "_homes")
 
 
 #: README sections whose backticked metric references the registry must
 #: actually contain (Clustering documents cluster_*/rpc_*, Failure
-#: model the chaos-plane meters, Serving plane the http_*/batching meters)
-_METRIC_SECTIONS = ("Observability", "Clustering", "Failure model",
-                    "Serving plane")
+#: model the chaos-plane meters, Distributed Frames the chunk-home
+#: meters, Serving plane the http_*/batching meters)
+_METRIC_SECTIONS = ("Observability", "Clustering", "Distributed Frames",
+                    "Failure model", "Serving plane")
 
 
 def readme_documented_metrics(readme_path: str) -> set:
@@ -103,6 +105,7 @@ def live_metrics() -> set:
     import h2o3_tpu.cluster.dkv      # noqa: F401  cluster_dkv_* meters
     import h2o3_tpu.cluster.tasks    # noqa: F401  cluster_tasks_* meters
     import h2o3_tpu.cluster.faults   # noqa: F401  cluster_faults_* meters
+    import h2o3_tpu.cluster.frames   # noqa: F401  cluster_chunk_* meters
     import h2o3_tpu.api.coalesce     # noqa: F401  predict_batch_size
     import h2o3_tpu.rapids.fusion    # noqa: F401  rapids_fusion_* meters
     from h2o3_tpu.util import telemetry

@@ -38,7 +38,7 @@ def _run_twice(name):
 def test_scenarios_registered():
     names = set(chaos.SCENARIOS)
     assert {"dup_reorder", "slow_node", "partition_gossip",
-            "kill_fanout", "kill_grid"} <= names
+            "kill_chunk_home", "kill_fanout", "kill_grid"} <= names
     # the ISSUE floor: at least four scripted scenarios
     assert len(names) >= 4
 
@@ -53,6 +53,10 @@ def test_slow_node_deterministic():
 
 def test_partition_gossip_deterministic():
     _run_twice("partition_gossip")
+
+
+def test_kill_chunk_home_deterministic():
+    _run_twice("kill_chunk_home")
 
 
 @pytest.mark.slow

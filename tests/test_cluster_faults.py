@@ -426,6 +426,52 @@ class TestReadRepairAndSweep:
                               action="restored") - before >= 1
         assert key in ra._replica_copies  # the copy survives the restore
 
+    def test_remove_vs_amnesia_no_resurrection(self, fault_cloud3):
+        """The remove-vs-amnesia race: the home removes a replicated key
+        but its reap push to the holder is dropped; the home then
+        restarts EMPTY (forgetting the removal).  The holder's sweep
+        sees exists=False, removed=False from the home — the exact
+        signature of the legitimate amnesiac-restore path — and before
+        the per-key remove epochs would have resurrected the key.  Now
+        the OTHER walk members still carry a tombstone epoch newer than
+        the copy's write epoch, so the sweep reaps instead of restoring.
+        (The positive control — a value lost WITHOUT a remove is still
+        restored — is test_sweep_restores_copy_to_amnesiac_home.)"""
+        clouds, stores = fault_cloud3
+        a, b, c = clouds
+        ra, rb = stores[0].router, stores[1].router
+        key = _key_homed(ra, b.info.name, a.info.name, "chaos/resurrect")
+        stores[0].put(key, [4, 2], replicas=2)
+        _wait_for(lambda: stores[0].get(key, _local=True) == [4, 2],
+                  timeout=2.0, msg="replica copy lands on node a")
+        # the reap push toward the HOLDER is dropped (seeded plan), but
+        # the third walk member still records the tombstone epoch; the
+        # holder's own sweep is frozen (its replica_check dropped) so
+        # the amnesia below is staged BEFORE the sweep can adjudicate
+        faults.set_plan(FaultPlan(seed=7, rules=[
+            FaultRule(action="drop", side="client", src=b.info.name,
+                      dst=f"*:{a.info.addr[1]}", method="dkv_remove"),
+            FaultRule(action="drop", side="client", src=a.info.name,
+                      method="dkv_replica_check"),
+        ]))
+        stores[1].remove(key)
+        assert key in ra._replica_copies  # the orphaned copy survives
+        # the home restarts empty: store already lacks the key; it also
+        # forgets every removal and every tracked replication
+        rb._removed.clear()
+        rb._key_epochs.clear()
+        rb._replicated.clear()
+        faults.clear_plan()
+        before = _counter_value("cluster_dkv_replica_sweep_total",
+                                action="restored")
+        _wait_for(lambda: key not in ra._replica_copies, timeout=5.0,
+                  msg="stale copy reaped despite the amnesiac home")
+        # reaped, never restored: the key stays dead everywhere
+        assert _counter_value("cluster_dkv_replica_sweep_total",
+                              action="restored") == before
+        assert stores[0].get(key, "GONE", _local=True) == "GONE"
+        assert stores[1].get(key, "GONE", _local=True) == "GONE"
+
     def test_fanout_rescheduled_onto_survivors(self, fault_cloud3):
         clouds, stores = fault_cloud3
         a, b, c = clouds
@@ -447,6 +493,44 @@ class TestReadRepairAndSweep:
         # partials are exact, so the k-way split cannot perturb sums
         assert float(out["s"]) == float(baseline["s"])
         assert float(out["n"]) == float(baseline["n"])
+
+
+# ---------------------------------------------------------------------------
+# chunk shipping: the transport-frame guard fails typed, not mid-transfer
+
+
+class TestChunkPayloadGuard:
+    """A chunk that cannot fit one transport frame must fail BEFORE the
+    wire with a typed error naming the chunk and the remediation — not
+    as an opaque mid-transfer transport death."""
+
+    def test_boundary_exact_fit_and_one_over(self, monkeypatch):
+        from h2o3_tpu.cluster import frames, transport
+
+        monkeypatch.setattr(transport, "MAX_FRAME_BYTES",
+                            frames._ENVELOPE_SLACK + 1024)
+        # exactly at the limit: passes, returns the measured size
+        assert frames.guard_chunk_payload("fr#k#g0t0#c0", b"x" * 1024) == 1024
+        # one byte over: typed refusal with id + size + limit + hint
+        with pytest.raises(frames.ChunkTooLargeError) as ei:
+            frames.guard_chunk_payload("fr#k#g0t0#c7", b"x" * 1025)
+        err = ei.value
+        assert err.chunk_id == "fr#k#g0t0#c7"
+        assert err.nbytes == 1025
+        assert err.limit == 1024
+        assert "H2O3_TPU_PARSE_CHUNK_BYTES" in str(err)
+        assert isinstance(err, ValueError)  # callers' ValueError nets work
+
+    def test_non_bytes_payload_measured_as_pickled(self, monkeypatch):
+        from h2o3_tpu.cluster import frames, transport
+
+        monkeypatch.setattr(transport, "MAX_FRAME_BYTES",
+                            frames._ENVELOPE_SLACK + 64)
+        # a tokenized-chunk dict ships pickled: the guard must size that
+        # wire form, not some notional raw length
+        with pytest.raises(frames.ChunkTooLargeError) as ei:
+            frames.guard_chunk_payload("fr#k#g1t0#c3", {"cols": "y" * 256})
+        assert ei.value.nbytes > 256  # pickle framing counted too
 
 
 # ---------------------------------------------------------------------------

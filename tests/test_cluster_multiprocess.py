@@ -8,13 +8,17 @@ ports, no collisions under parallel CI.  Every wait carries its own
 watchdog deadline so a wedged node fails the test with output instead of
 hanging the tier.
 
-Three tests:
+The tests:
   * 2-node full-stack cloud over ``python -m h2o3_tpu`` — /3/Cloud
     quorum on both nodes, cross-node DKV through the REST surface, node
     RPC proxies, and the suspicion flip after a SIGKILL (tier-1);
   * 2-node map_reduce fan-out bit-exactness with a real remote DTask
     executor (tier-1);
-  * 3-node formation via the light nodeproc entry (marked slow).
+  * 3-node formation via the light nodeproc entry (marked slow);
+  * SIGKILL drills (marked slow): a member killed mid-fan-out whose
+    range a survivor absorbs, and a chunk HOME killed mid-chunk-homed
+    map_reduce whose range survivors re-execute from replica chunks —
+    then re-adopts its chunks after a same-ident reboot.
 """
 
 import json
@@ -474,6 +478,197 @@ class TestSigkillDuringFanout:
             # this SIGKILL lands while it owns an in-flight range
             time.sleep(0.8)
             peers["w2"].kill(signal.SIGKILL)
+            w0.wait_for_line("W0 OK", timeout=240)
+            assert w0.proc.wait(timeout=30) == 0
+        finally:
+            for p in peers.values():
+                p.kill()
+            w0.kill()
+
+
+def _write_chunk_home_worker(tmp):
+    """worker0: forms a 3-node cloud, parses a CSV chunk-homed across the
+    ring, scripts a server-side dtask delay onto a victim HOME, then runs
+    a chunk-homed map_reduce while the harness SIGKILLs that home
+    mid-flight.  Asserts the reduction is bit-identical to the local
+    path, that the dead home's ranges re-executed FROM REPLICA CHUNKS
+    (path=replica, zero caller-local re-parses), and — once the harness
+    reboots the victim on its OLD port (same ident, same ring arcs) —
+    that the restarted-empty home re-adopts its chunks through the
+    read-repair walk and the chunk-homed MR still reduces bit-exactly."""
+    with open(os.path.join(tmp, "mrfns.py"), "w") as f:
+        f.write(
+            "import jax.numpy as jnp\n"
+            "def stat(cols, mask):\n"
+            "    return {'n': jnp.sum(mask.astype(jnp.float32)),\n"
+            "            'sx': jnp.sum(jnp.where(mask, cols['x'], 0.0)),\n"
+            "            'sy': jnp.sum(jnp.where(mask, cols['y'], 0.0))}\n")
+    script = f"""
+import sys, time
+sys.path.insert(0, {REPO!r})
+sys.path.insert(0, {tmp!r})
+import numpy as np
+import mrfns
+from h2o3_tpu.cluster.membership import boot_node
+from h2o3_tpu.cluster import tasks as ctasks
+from h2o3_tpu.util import telemetry
+
+cloud = boot_node("chunkcloud", "w0",
+                  address_file={tmp!r} + "/w0.addr")
+deadline = time.monotonic() + 90
+while time.monotonic() < deadline:
+    if cloud.size() == 3 and cloud.consensus():
+        break
+    time.sleep(0.05)
+assert cloud.size() == 3, f"cloud never formed: {{cloud.size()}}"
+
+from h2o3_tpu.frame.parse import _iter_body_chunks, parse_setup
+from h2o3_tpu.cluster.frames import DistFrame, chunk_key
+
+n = 12000
+x = np.arange(n) % 97
+y = (np.arange(n) * 7) % 31
+text = "x,y\\n" + "".join(f"{{x[i]}},{{y[i]}}\\n" for i in range(n))
+setup = parse_setup(text)
+chunks = list(_iter_body_chunks([text.encode()], 8192, setup.header,
+                                setup.skip_blank_lines))
+assert len(chunks) >= 6, len(chunks)
+fr = ctasks.distributed_parse_chunks(chunks, setup, cloud=cloud,
+                                     key="mp_dist_frame")
+assert isinstance(fr, DistFrame), type(fr)
+lay = fr.chunk_layout
+assert len({{g["home_name"] for g in lay["groups"]}}) >= 2, lay["groups"]
+vgrp = next(g for g in lay["groups"] if g["home_name"] != "w0")
+victim_name = vgrp["home_name"]
+victim = next(m for m in cloud.members_sorted()
+              if m.info.name == victim_name)
+print("VICTIM " + victim_name, flush=True)
+
+# nemesis: the victim home sits on its chunk task long enough for the
+# harness's SIGKILL (fired on "MR START") to land while its range is
+# in flight
+out = cloud.client.call(victim.info.addr, "fault_plan_set", {{
+    "seed": 7, "rules": [{{"action": "delay", "side": "server",
+                           "method": "dtask", "delay_ms": 2500}}]}})
+assert out["installed"], out
+
+host = {{"x": x.astype(np.float64), "y": y.astype(np.float64)}}
+local = ctasks.distributed_map_reduce(mrfns.stat, host, cloud=None)
+
+def _same(a, b):
+    import jax
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    return all(np.asarray(p).tobytes() == np.asarray(q).tobytes()
+               for p, q in zip(la, lb))
+
+print("MR START", flush=True)
+dist = ctasks.distributed_map_reduce(mrfns.stat, fr, cloud=cloud,
+                                     timeout=120.0)
+assert _same(local, dist), (local, dist)
+rec = telemetry.REGISTRY.get("cluster_fanout_recovered_total")
+assert rec is not None and rec.value(path="replica") >= 1, (
+    rec and rec.value(path="replica"))
+# the dead home's range came from replica chunks, NOT a caller re-parse
+assert rec.value(path="local") == 0, rec.value(path="local")
+
+deadline = time.monotonic() + 60
+while time.monotonic() < deadline:
+    if cloud.size() == 2:
+        break
+    time.sleep(0.05)
+assert cloud.size() == 2, f"victim never removed: {{cloud.size()}}"
+print("VICTIM DEAD", flush=True)
+
+# the harness now reboots the victim on its OLD port: same ident, so
+# the ring hands it back exactly the arcs (and chunks) it owned
+deadline = time.monotonic() + 120
+while time.monotonic() < deadline:
+    if cloud.size() == 3 and cloud.consensus():
+        break
+    time.sleep(0.05)
+assert cloud.size() == 3, f"victim never rejoined: {{cloud.size()}}"
+reborn = next(m for m in cloud.members_sorted()
+              if m.info.name == victim_name)
+
+# restarted-empty home re-adopts its chunks: routed gets drive the
+# read-repair walk, the anti-entropy sweep converges the rest, and the
+# direct (local-only) probe on the reborn node proves possession
+want = list(range(vgrp["lo"], vgrp["hi"]))
+store = cloud.dkv_store
+deadline = time.monotonic() + 60
+adopted = 0
+while time.monotonic() < deadline:
+    for i in want:
+        assert store.get(chunk_key(vgrp["anchor"], i)) is not None
+    adopted = sum(
+        1 for i in want
+        if cloud.client.call(reborn.info.addr, "dkv_get",
+                             {{"key": chunk_key(vgrp["anchor"], i)}},
+                             timeout=10.0).get("found"))
+    if adopted == len(want):
+        break
+    time.sleep(0.5)
+assert adopted == len(want), f"re-adopted {{adopted}}/{{len(want)}}"
+
+dist2 = ctasks.distributed_map_reduce(mrfns.stat, fr, cloud=cloud,
+                                      timeout=120.0)
+assert _same(local, dist2), (local, dist2)
+cloud.stop()
+print("W0 OK", flush=True)
+"""
+    path = os.path.join(tmp, "worker0_chunk_home.py")
+    with open(path, "w") as f:
+        f.write(script)
+    return path
+
+
+@pytest.mark.slow
+class TestSigkillChunkHome:
+    """SIGKILL a chunk HOME while its chunk-homed map_reduce range is in
+    flight: survivors re-execute the range from replica chunks
+    (path=replica, never a caller re-parse), and a same-ident reboot
+    re-adopts the dead home's chunks through the read-repair walk."""
+
+    def test_sigkill_chunk_home_mid_map_reduce(self, tmp_path):
+        tmp = str(tmp_path)
+        env = _env()
+        env["H2O3_TPU_FAULTS"] = "1"  # nemesis RPC surface on every node
+        w0 = _Proc([sys.executable, _write_chunk_home_worker(tmp)],
+                   cwd=tmp, env=env)
+        peers = {}
+        addrs = {}
+        try:
+            addr0 = _wait_file(os.path.join(tmp, "w0.addr"))
+            flat = os.path.join(tmp, "flat")
+            with open(flat, "w") as f:
+                f.write(addr0 + "\n")
+            for name in ("w1", "w2"):
+                addr_file = os.path.join(tmp, f"{name}.addr")
+                peers[name] = _Proc(
+                    [sys.executable, "-m", "h2o3_tpu.cluster.nodeproc",
+                     "--cluster-name", "chunkcloud", "--node-name", name,
+                     "--flatfile", flat, "--address-file", addr_file,
+                     "--hb-interval", "0.2"],
+                    cwd=tmp, env=env)
+                addrs[name] = _wait_file(addr_file)
+            out = w0.wait_for_line("VICTIM ", timeout=240)
+            victim = out.split("VICTIM ", 1)[1].split()[0]
+            assert victim in peers, victim
+            w0.wait_for_line("MR START", timeout=240)
+            # the victim home's injected 2.5s dtask delay is still
+            # ticking: this SIGKILL lands while its range is in flight
+            time.sleep(0.8)
+            peers[victim].kill(signal.SIGKILL)
+            w0.wait_for_line("VICTIM DEAD", timeout=240)
+            # reboot the victim on its OLD port — same ident, so the
+            # ring hands the restarted-empty home its old arcs back
+            old_port = addrs[victim].rpartition(":")[2]
+            peers[victim + "'"] = _Proc(
+                [sys.executable, "-m", "h2o3_tpu.cluster.nodeproc",
+                 "--cluster-name", "chunkcloud", "--node-name", victim,
+                 "--flatfile", flat, "--port", old_port,
+                 "--hb-interval", "0.2"],
+                cwd=tmp, env=env)
             w0.wait_for_line("W0 OK", timeout=240)
             assert w0.proc.wait(timeout=30) == 0
         finally:
