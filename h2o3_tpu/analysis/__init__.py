@@ -1,0 +1,39 @@
+"""Project-specific static analyzer for h2o3_tpu invariants.
+
+Six passes over the repo's own sources, driven by ``scripts/analyze.py``
+and run in tier-1 (see ``tests/test_analysis.py``):
+
+- **lock-discipline** (LOCK001/LOCK002) — no blocking work under a
+  ``threading`` lock; no lock-order inversions. The PR 11 deadlock class.
+- **tracer-purity** (TRACE001) — jitted/shard-mapped/fusion-emit
+  functions stay side-effect free.
+- **seeded-determinism** (SEED001–SEED003) — chaos/retry decisions draw
+  only from plan-derived PRNGs, never the global RNG or the wall clock.
+- **knob-registry** (KNOB001/KNOB002) — ``H2O3_TPU_*`` env knobs and
+  README.md stay in sync, both directions.
+- **rpc-payload** (ROUTE001/ROUTE002) — nothing unroutable is handed to
+  DKV puts or RPC payloads at the call site.
+- **telemetry-drift** (TDRIFT001–TDRIFT005) — observability docs match
+  the live route/metric/prim registries (absorbed
+  ``scripts/check_telemetry.py``).
+
+Importing this package (and every AST pass) pulls no runtime modules —
+no jax, no server — so incremental ``--changed-only`` runs stay fast.
+Only the telemetry-drift pass imports the runtime, lazily.
+"""
+
+from .core import (Context, Finding, Module, analyze, analyze_source,
+                   default_passes, load_baseline, save_baseline,
+                   split_baselined)
+
+__all__ = [
+    "Context",
+    "Finding",
+    "Module",
+    "analyze",
+    "analyze_source",
+    "default_passes",
+    "load_baseline",
+    "save_baseline",
+    "split_baselined",
+]

@@ -1,0 +1,148 @@
+"""Shared AST helpers for the analyzer passes.
+
+Everything here is intentionally syntactic: no imports are executed, no
+types are inferred. Passes trade a little precision for a framework that
+runs in milliseconds over the whole repo.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+FuncNode = (ast.FunctionDef, ast.AsyncFunctionDef)
+ScopeNode = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+
+
+def dotted_name(node: ast.expr) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    """Dotted name of a call's target (``self.f`` -> ``self.f``)."""
+    return dotted_name(call.func)
+
+
+@dataclass
+class FuncInfo:
+    """One function/method definition with its local call fan-out."""
+
+    qualname: str                 #: ``Class.method`` or ``func``
+    node: ast.AST
+    class_name: Optional[str] = None
+    #: simple names this function calls (bare ``f()`` and ``self.m()``)
+    local_calls: Set[str] = field(default_factory=set)
+
+
+def walk_no_nested_funcs(node: ast.AST) -> Iterator[ast.AST]:
+    """Yield descendants, NOT descending into nested function/lambda
+    definitions (their bodies run later, not at the yield site)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        yield child
+        if isinstance(child, FuncNode) or isinstance(child, ast.Lambda):
+            continue
+        stack.extend(ast.iter_child_nodes(child))
+
+
+def index_functions(tree: ast.Module) -> Dict[str, FuncInfo]:
+    """qualname -> FuncInfo for every def in the module (methods use
+    ``Class.method``; nested defs use ``outer.<locals>.inner``)."""
+    out: Dict[str, FuncInfo] = {}
+
+    def visit(node: ast.AST, prefix: str, class_name: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                visit(child, f"{prefix}{child.name}.", None)
+            elif isinstance(child, FuncNode):
+                qual = f"{prefix}{child.name}"
+                info = FuncInfo(qualname=qual, node=child,
+                                class_name=_enclosing_class(prefix))
+                for sub in walk_no_nested_funcs(child):
+                    if isinstance(sub, ast.Call):
+                        name = call_name(sub)
+                        if name is None:
+                            continue
+                        if name.startswith("self."):
+                            info.local_calls.add(name[len("self."):]
+                                                 .split(".")[0])
+                        elif "." not in name:
+                            info.local_calls.add(name)
+                out[qual] = info
+                visit(child, f"{qual}.<locals>.", None)
+            else:
+                visit(child, prefix, class_name)
+
+    def _enclosing_class(prefix: str) -> Optional[str]:
+        parts = [p for p in prefix.split(".") if p and p != "<locals>"]
+        return parts[-1] if parts else None
+
+    visit(tree, "", None)
+    return out
+
+
+def enclosing_symbol(tree: ast.Module, lineno: int) -> str:
+    """Qualname of the innermost def/class containing ``lineno``."""
+    best = ""
+    best_span = None
+
+    def visit(node: ast.AST, prefix: str) -> None:
+        nonlocal best, best_span
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ScopeNode):
+                start = child.lineno
+                end = getattr(child, "end_lineno", start) or start
+                qual = f"{prefix}{child.name}"
+                if start <= lineno <= end:
+                    span = end - start
+                    if best_span is None or span <= best_span:
+                        best, best_span = qual, span
+                    visit(child, f"{qual}.")
+            else:
+                visit(child, prefix)
+
+    visit(tree, "")
+    return best
+
+
+def resolve_local_callable(call: ast.Call, module_tree: ast.Module
+                           ) -> Optional[ast.AST]:
+    """If an argument position holds a Name bound to a module-level def
+    or lambda, return that def's node. Used to chase ``jit(fn)`` to fn."""
+    return None  # resolution is done per-pass with the name tables below
+
+
+def module_level_defs(tree: ast.Module) -> Dict[str, ast.AST]:
+    """name -> def/lambda node for module-level functions and
+    ``name = lambda ...`` bindings."""
+    out: Dict[str, ast.AST] = {}
+    for node in tree.body:
+        if isinstance(node, FuncNode):
+            out[node.name] = node
+        elif isinstance(node, ast.Assign) and isinstance(node.value,
+                                                         ast.Lambda):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out[tgt.id] = node.value
+    return out
+
+
+def with_items_of(node: ast.With) -> List[Tuple[ast.expr, str]]:
+    """(context-expr, source-ish dotted name or '') per with-item."""
+    out = []
+    for item in node.items:
+        name = dotted_name(item.context_expr)
+        if name is None and isinstance(item.context_expr, ast.Call):
+            name = call_name(item.context_expr) or ""
+        out.append((item.context_expr, name or ""))
+    return out

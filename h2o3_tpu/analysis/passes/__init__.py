@@ -1,0 +1,3 @@
+"""Analyzer passes. Each module exposes ``RULES`` (id -> summary) and
+``run(ctx) -> List[Finding]``; registration lives in
+``h2o3_tpu.analysis.core.default_passes``."""

@@ -1,0 +1,93 @@
+"""knob-registry: every ``H2O3_TPU_*`` env knob must be documented.
+
+KNOB001 — an ``H2O3_TPU_*`` env var is referenced in code (a direct
+``os.environ.get`` read, a subscript, or a config-table constant) but
+README.md never mentions it. Undocumented knobs are how two nodes end
+up booted with silently different behavior.
+
+KNOB002 — README.md names an ``H2O3_TPU_*`` knob that no code reads:
+either stale docs or a typo'd knob name that operators will set to no
+effect.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Tuple
+
+from ..astutil import enclosing_symbol
+from ..core import Context, Finding
+
+RULES = {
+    "KNOB001": "env knob read in code but undocumented in README",
+    "KNOB002": "env knob documented in README but never read in code",
+}
+
+_KNOB_RE = re.compile(r"H2O3_TPU_[A-Z0-9_]+")
+
+
+def _env_reads(tree: ast.Module) -> List[Tuple[str, int]]:
+    """(knob, line) for every code reference to a knob.
+
+    Any string constant that is *exactly* a knob name counts: direct
+    ``os.environ.get("H2O3_TPU_X")`` reads, subscripts, and table-driven
+    configs like server.py's ``{"max_conns": ("H2O3_TPU_HTTP_MAX_CONNS",
+    ...)}``. Docstrings and error messages mention knobs inside prose so
+    they never full-match.
+    """
+    out: List[Tuple[str, int]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+                and _KNOB_RE.fullmatch(node.value):
+            out.append((node.value, node.lineno))
+    return out
+
+
+def _readme(ctx: Context) -> Tuple[str, str]:
+    """(text, display-path) of the README the docs side is checked
+    against; ``analyze_source`` injects ``ctx.readme_text`` instead."""
+    text = getattr(ctx, "readme_text", None)
+    if text is not None:
+        return text, "README.md"
+    try:
+        with open(ctx.readme_path, encoding="utf-8") as f:
+            return f.read(), "README.md"
+    except OSError:
+        return "", "README.md"
+
+
+def run(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    readme_text, readme_rel = _readme(ctx)
+    documented: Dict[str, int] = {}
+    for i, line in enumerate(readme_text.splitlines(), start=1):
+        for knob in _KNOB_RE.findall(line):
+            documented.setdefault(knob, i)
+
+    # the KNOB002 direction only needs the set of knob names referenced
+    # anywhere — a source regex for exact quoted literals is ~10x
+    # cheaper than walking every module's AST
+    read_anywhere = set()
+    quoted = re.compile(r"""["'](H2O3_TPU_[A-Z0-9_]+)["']""")
+    for mod in ctx.all_modules:
+        read_anywhere.update(quoted.findall(mod.source))
+
+    for mod in ctx.modules:
+        for knob, line in _env_reads(mod.tree):
+            if knob not in documented:
+                findings.append(Finding(
+                    rule="KNOB001", file=mod.rel, line=line,
+                    symbol=enclosing_symbol(mod.tree, line),
+                    message=f"env knob {knob} is read here but README.md "
+                            f"never documents it",
+                    snippet=mod.line_text(line)))
+
+    for knob, line in sorted(documented.items()):
+        if knob not in read_anywhere:
+            findings.append(Finding(
+                rule="KNOB002", file=readme_rel, line=line, symbol=knob,
+                message=f"README.md documents env knob {knob} but no code "
+                        f"reads it",
+                snippet=knob))
+    return findings

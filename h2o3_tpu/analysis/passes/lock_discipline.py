@@ -1,0 +1,258 @@
+"""lock-discipline: no blocking work while a ``threading`` lock is held.
+
+LOCK001 — a blocking operation (RPC ``call``, socket send/recv, jitted
+device dispatch, ``map_batches``/shard execution, ``subprocess``,
+``time.sleep``, disk I/O) executes while a ``threading.Lock``/``RLock``
+is held, either directly inside the ``with`` body or via a local
+function call (one module-local call graph, fixpoint-propagated). This
+is the PR 11 deadlock class: a lock shared with RPC server threads plus
+a dispatch that can block on another node's progress.
+
+LOCK002 — lock-order inversion: two locks are acquired in opposite
+orders somewhere in the codebase (global acquisition graph, cycle
+detection across modules).
+
+Locks are recognized from ``NAME = threading.Lock()`` module globals,
+``self.x = threading.Lock()`` attributes, and — as a heuristic — any
+``with`` expression whose name contains "lock". Condition-variable
+``cv.wait()`` inside ``with cv:`` is not flagged (it releases the lock).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..astutil import (FuncNode, call_name, dotted_name, index_functions,
+                       walk_no_nested_funcs)
+from ..core import Context, Finding
+
+RULES = {
+    "LOCK001": "blocking operation reachable while a threading lock is held",
+    "LOCK002": "lock-order inversion across modules",
+}
+
+#: attribute-call suffixes that block on another thread/process/host
+BLOCKING_ATTRS = {
+    "call", "submit", "request", "sendall", "send", "recv", "recv_into",
+    "accept", "connect", "wait", "makedirs", "urlopen", "getaddrinfo",
+    "create_connection", "block_until_ready",
+}
+
+#: bare/any-position call names that execute shards or touch disk
+BLOCKING_NAMES = {
+    "map_reduce", "map_batches", "distributed_map_reduce", "_mr_shard_local",
+    "save_frame", "load_frame", "urlopen", "open",
+}
+
+_SUBPROCESS_FNS = {"run", "check_output", "check_call", "Popen"}
+
+
+def classify_blocking(call: ast.Call) -> Optional[str]:
+    """Human-readable reason if this call is blocking, else None."""
+    name = call_name(call)
+    if name is None:
+        return None
+    parts = name.split(".")
+    last = parts[-1]
+    if name == "time.sleep":
+        return "time.sleep"
+    if (name.startswith("subprocess.") and last in _SUBPROCESS_FNS) \
+            or name == "os.system":
+        return f"subprocess ({name})"
+    if name.startswith(("jnp.", "jax.numpy.")):
+        return f"device dispatch ({name})"
+    if name in BLOCKING_NAMES or last in BLOCKING_NAMES:
+        return f"blocking call ({name})"
+    if len(parts) > 1 and last in BLOCKING_ATTRS:
+        return f"blocking call ({name})"
+    return None
+
+
+class _ModuleLocks:
+    """Lock inventory + per-function blocking/acquisition facts."""
+
+    def __init__(self, mod) -> None:
+        self.mod = mod
+        self.funcs = index_functions(mod.tree)
+        #: local name ("X" or "Class.X" or "self.X" form) -> global lock id
+        self.global_locks: Dict[str, str] = {}
+        self.attr_locks: Set[str] = set()   # attribute names, e.g. "_lock"
+        self._collect_lock_defs()
+        #: simple func name -> blocking reason (after fixpoint)
+        self.blocking: Dict[str, str] = {}
+        #: simple func name -> lock ids its body acquires (after fixpoint)
+        self.acquires: Dict[str, Set[str]] = {}
+        self._analyze_functions()
+
+    def _collect_lock_defs(self) -> None:
+        for node in ast.walk(self.mod.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            value = node.value
+            if not isinstance(value, ast.Call):
+                continue
+            vname = call_name(value) or ""
+            if vname not in ("threading.Lock", "threading.RLock",
+                             "Lock", "RLock"):
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    self.global_locks[tgt.id] = f"{self.mod.rel}:{tgt.id}"
+                elif (isinstance(tgt, ast.Attribute)
+                      and isinstance(tgt.value, ast.Name)
+                      and tgt.value.id == "self"):
+                    self.attr_locks.add(tgt.attr)
+
+    def lock_id(self, expr: ast.expr) -> Optional[str]:
+        """Global lock id if ``expr`` names a lock, else None."""
+        name = dotted_name(expr)
+        if not name:
+            return None
+        if name in self.global_locks:
+            return self.global_locks[name]
+        if name.startswith("self.") and name[5:] in self.attr_locks:
+            return f"{self.mod.rel}:self.{name[5:]}"
+        if "lock" in name.lower():
+            return f"{self.mod.rel}:{name}"
+        return None
+
+    def _analyze_functions(self) -> None:
+        simple: Dict[str, List] = {}
+        for qual, info in self.funcs.items():
+            simple.setdefault(qual.split(".")[-1], []).append(info)
+        direct_block: Dict[str, str] = {}
+        direct_acq: Dict[str, Set[str]] = {}
+        for qual, info in self.funcs.items():
+            name = qual.split(".")[-1]
+            for node in walk_no_nested_funcs(info.node):
+                if isinstance(node, ast.Call):
+                    why = classify_blocking(node)
+                    if why and name not in direct_block:
+                        direct_block[name] = why
+                elif isinstance(node, ast.With):
+                    for item in node.items:
+                        lid = self.lock_id(item.context_expr)
+                        if lid:
+                            direct_acq.setdefault(name, set()).add(lid)
+        # fixpoint over the module-local call graph
+        self.blocking = dict(direct_block)
+        self.acquires = {k: set(v) for k, v in direct_acq.items()}
+        changed = True
+        while changed:
+            changed = False
+            for qual, info in self.funcs.items():
+                name = qual.split(".")[-1]
+                for callee in info.local_calls:
+                    if callee in self.blocking and name not in self.blocking:
+                        self.blocking[name] = (
+                            f"{self.blocking[callee]} via {callee}()")
+                        changed = True
+                    for lid in self.acquires.get(callee, ()):
+                        acq = self.acquires.setdefault(name, set())
+                        if lid not in acq:
+                            acq.add(lid)
+                            changed = True
+
+
+def _finding(mod, node: ast.AST, rule: str, symbol: str, msg: str) -> Finding:
+    return Finding(rule=rule, file=mod.rel, line=node.lineno, symbol=symbol,
+                   message=msg, snippet=mod.line_text(node.lineno))
+
+
+def run(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    #: (held lock id, acquired lock id) -> first site (mod, node, symbol)
+    edges: Dict[Tuple[str, str], Tuple[object, ast.AST, str]] = {}
+
+    # fast gate: a module whose source never says "lock" has no lock
+    # regions, no edges, and nothing to report — skip the AST work
+    lockful = [m for m in ctx.all_modules if "lock" in m.source.lower()]
+    infos = {m.rel: _ModuleLocks(m) for m in lockful}
+
+    analyzed = {m.rel for m in ctx.modules}
+    for mod in lockful:
+        ml = infos[mod.rel]
+        for qual, func in ml.funcs.items():
+            for node in walk_no_nested_funcs(func.node):
+                if not isinstance(node, ast.With):
+                    continue
+                held = [(ml.lock_id(i.context_expr),
+                         dotted_name(i.context_expr) or "")
+                        for i in node.items]
+                held = [(lid, nm) for lid, nm in held if lid]
+                if not held:
+                    continue
+                _scan_region(mod, ml, node, held, qual, findings,
+                             edges, report=mod.rel in analyzed)
+
+    # LOCK002: cycles in the global lock-acquisition graph
+    graph: Dict[str, Set[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+    for (a, b), (mod, node, symbol) in sorted(
+            edges.items(), key=lambda kv: (kv[1][0].rel, kv[1][1].lineno)):
+        if _reachable(graph, b, a) and mod.rel in analyzed:
+            findings.append(_finding(
+                mod, node, "LOCK002", symbol,
+                f"lock-order inversion: acquires {b.split(':')[-1]!r} while "
+                f"holding {a.split(':')[-1]!r}, but the opposite order also "
+                f"exists in the codebase"))
+    return findings
+
+
+def _scan_region(mod, ml: _ModuleLocks, with_node: ast.With,
+                 held: List[Tuple[str, str]], symbol: str,
+                 findings: List[Finding], edges: Dict, report: bool) -> None:
+    held_ids = [lid for lid, _ in held]
+    held_names = {nm for _, nm in held}
+    stack = []
+    for stmt in with_node.body:
+        stack.append(stmt)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, FuncNode) or isinstance(node, ast.Lambda):
+            continue
+        if isinstance(node, ast.With):
+            for item in node.items:
+                lid = ml.lock_id(item.context_expr)
+                if lid:
+                    for h in held_ids:
+                        if h != lid:
+                            edges.setdefault((h, lid), (mod, node, symbol))
+        if isinstance(node, ast.Call):
+            name = call_name(node) or ""
+            # cv.wait() inside `with cv:` releases the lock — not blocking
+            owner = name.rsplit(".", 1)[0] if "." in name else ""
+            if name.endswith(".wait") and owner in held_names:
+                stack.extend(ast.iter_child_nodes(node))
+                continue
+            why = classify_blocking(node)
+            if why is None:
+                base = name.split(".")
+                callee = base[-1] if (len(base) == 2 and base[0] == "self") \
+                    else (name if "." not in name else None)
+                if callee and callee in ml.blocking:
+                    why = f"{ml.blocking[callee]} (via local call "\
+                          f"{callee}())"
+            if why and report:
+                lock_desc = ", ".join(
+                    lid.split(":")[-1] for lid in held_ids)
+                findings.append(_finding(
+                    mod, node, "LOCK001", symbol,
+                    f"{why} while holding {lock_desc}"))
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _reachable(graph: Dict[str, Set[str]], src: str, dst: str) -> bool:
+    seen = set()
+    stack = [src]
+    while stack:
+        n = stack.pop()
+        if n == dst:
+            return True
+        if n in seen:
+            continue
+        seen.add(n)
+        stack.extend(graph.get(n, ()))
+    return False

@@ -1,0 +1,92 @@
+"""rpc-payload: values crossing the wire must be routable.
+
+``cluster/dkv.py`` defines ``ROUTABLE_VALUE_TYPES`` — plain data only.
+Functions, lambdas, and closures pickle by *module reference*: they
+appear to serialize locally and then fail (or silently resolve to
+different code) on the receiving node, which is why
+``distributed_map_reduce`` rejects them at runtime. These rules catch
+the statically-obvious cases at the call site instead of at unpickle
+time on a remote host.
+
+ROUTE001 — a lambda or a reference to a locally-defined function is
+handed to a DKV ``put``/``remote_put``/``replicate`` value slot.
+ROUTE002 — a lambda appears anywhere inside an RPC ``call``/``submit``
+payload expression.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from ..astutil import (call_name, dotted_name, enclosing_symbol,
+                       module_level_defs)
+from ..core import Context, Finding
+
+RULES = {
+    "ROUTE001": "non-routable value handed to DKV put/replicate",
+    "ROUTE002": "lambda inside an RPC call/submit payload",
+}
+
+#: receiver-name fragments that mark a ``.put()`` as a DKV store put
+#: (bare ``q.put(item)`` on local queues is not a wire crossing)
+_STORE_HINTS = ("store", "dkv", "router", "kv", "catalog")
+
+
+def _lambda_in(expr: ast.expr) -> Optional[ast.AST]:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Lambda):
+            return node
+    return None
+
+
+def run(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in ctx.modules:
+        top = module_level_defs(mod.tree)
+
+        def flag(rule: str, node: ast.AST, msg: str) -> None:
+            line = getattr(node, "lineno", 0)
+            findings.append(Finding(
+                rule=rule, file=mod.rel, line=line,
+                symbol=enclosing_symbol(mod.tree, line), message=msg,
+                snippet=mod.line_text(line)))
+
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node) or ""
+            parts = name.split(".")
+            last = parts[-1]
+
+            value = None
+            if last in ("remote_put", "replicate") and len(node.args) >= 2:
+                value = node.args[1]
+            elif last == "put" and len(node.args) >= 2:
+                recv = ".".join(parts[:-1]).lower()
+                if any(h in recv for h in _STORE_HINTS):
+                    value = node.args[1]
+            if value is not None:
+                lam = _lambda_in(value)
+                if lam is not None:
+                    flag("ROUTE001", lam,
+                         f"lambda in the value handed to {name}(); "
+                         f"functions pickle by module reference and are "
+                         f"not ROUTABLE_VALUE_TYPES-compatible")
+                elif isinstance(value, ast.Name) and value.id in top:
+                    flag("ROUTE001", value,
+                         f"locally-defined function {value.id!r} handed to "
+                         f"{name}(); not ROUTABLE_VALUE_TYPES-compatible")
+
+            if last in ("call", "submit") and len(parts) > 1:
+                for arg in list(node.args) + [
+                        kw.value for kw in node.keywords
+                        if kw.value is not None]:
+                    lam = _lambda_in(arg)
+                    if lam is not None:
+                        flag("ROUTE002", lam,
+                             f"lambda inside the payload of {name}(); "
+                             f"lambdas cannot cross the wire (pickled by "
+                             f"module reference)")
+                        break
+    return findings

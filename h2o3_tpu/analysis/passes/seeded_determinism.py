@@ -1,0 +1,92 @@
+"""seeded-determinism: chaos/retry decisions must draw from seeded PRNGs.
+
+PR 8's chaos plane promises byte-identical verdicts for the same
+``--seed``: every jitter, drop, and delay decision must come from a
+plan-derived ``random.Random(seed)`` (``FaultPlan`` per-rule streams,
+``backoff_rng()``), never from the process-global ``random`` module or
+the wall clock. These rules only apply to the decision-making scope
+files — the rest of the codebase may use ``random`` freely.
+
+SEED001 — bare module-level ``random.<fn>()`` call in a scope file.
+SEED002 — unseeded ``random.Random()`` constructed in a scope file.
+SEED003 — ``time.time()`` / ``time.time_ns()`` used in a chaos decision
+file (``faults.py``, ``scripts/chaos.py``) where it would leak
+wall-clock nondeterminism into verdicts.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..astutil import call_name
+from ..core import Context, Finding
+from ..astutil import enclosing_symbol
+
+RULES = {
+    "SEED001": "bare random.* call in a determinism-scoped file",
+    "SEED002": "unseeded random.Random() in a determinism-scoped file",
+    "SEED003": "wall-clock read in a chaos decision file",
+}
+
+#: files whose control decisions must be plan-seeded
+SEED_SCOPE = (
+    "h2o3_tpu/cluster/faults.py",
+    "h2o3_tpu/cluster/rpc.py",
+    "scripts/chaos.py",
+)
+
+#: files where wall-clock reads leak into chaos verdicts
+TIME_SCOPE = (
+    "h2o3_tpu/cluster/faults.py",
+    "scripts/chaos.py",
+)
+
+_RANDOM_MODULE_FNS = {
+    "random", "uniform", "randint", "randrange", "choice", "choices",
+    "shuffle", "sample", "expovariate", "gauss", "normalvariate",
+    "betavariate", "triangular", "seed", "getrandbits",
+}
+
+
+def run(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in ctx.modules:
+        seed_scoped = mod.rel in SEED_SCOPE
+        time_scoped = mod.rel in TIME_SCOPE
+        if not (seed_scoped or time_scoped):
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node) or ""
+            line = node.lineno
+            if seed_scoped:
+                parts = name.split(".")
+                if (len(parts) == 2 and parts[0] in ("random", "np.random")
+                        and parts[1] in _RANDOM_MODULE_FNS):
+                    findings.append(Finding(
+                        rule="SEED001", file=mod.rel, line=line,
+                        symbol=enclosing_symbol(mod.tree, line),
+                        message=f"{name}() draws from the process-global "
+                                f"RNG; chaos/retry decisions must use a "
+                                f"plan-derived random.Random(seed)",
+                        snippet=mod.line_text(line)))
+                if name in ("random.Random", "Random") and not node.args \
+                        and not node.keywords:
+                    findings.append(Finding(
+                        rule="SEED002", file=mod.rel, line=line,
+                        symbol=enclosing_symbol(mod.tree, line),
+                        message="random.Random() without a seed is "
+                                "nondeterministic; derive the seed from "
+                                "the fault plan",
+                        snippet=mod.line_text(line)))
+            if time_scoped and name in ("time.time", "time.time_ns"):
+                findings.append(Finding(
+                    rule="SEED003", file=mod.rel, line=line,
+                    symbol=enclosing_symbol(mod.tree, line),
+                    message=f"{name}() leaks wall-clock nondeterminism "
+                            f"into chaos decisions; thread a logical "
+                            f"clock or plan-derived value instead",
+                    snippet=mod.line_text(line)))
+    return findings

@@ -1,0 +1,231 @@
+"""telemetry-drift: observability docs must match the live registries.
+
+The sixth pass absorbs ``scripts/check_telemetry.py`` (which remains as
+a thin shim over :func:`collect`). Unlike the AST passes this one
+imports the runtime — server routes, the telemetry registry, and the
+fusion prim table are *live* objects — so it is skipped by
+``--changed-only`` runs unless a telemetry-relevant file changed.
+
+TDRIFT001 — observability route registered but undocumented in
+README.md's Observability table, or documented but not registered.
+TDRIFT002 — README documents a metric name the telemetry registry
+never declares.
+TDRIFT003 — a fusible prim has no emitter (silent fallback on every
+query).
+TDRIFT004 — a fusible prim has no fused-vs-interpreted parity case.
+TDRIFT005 — the algo registry and the ``/3/ModelBuilders/{algo}`` train
+route have drifted apart.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import List, Tuple
+
+from ..core import Context, Finding
+
+RULES = {
+    "TDRIFT001": "observability route table drift (README vs server)",
+    "TDRIFT002": "documented metric missing from the telemetry registry",
+    "TDRIFT003": "fusible prim without an emitter",
+    "TDRIFT004": "fusible prim without a parity test case",
+    "TDRIFT005": "algo registry vs train route drift",
+}
+
+#: route prefixes that constitute the observability surface
+OBS_PREFIXES = (
+    "/3/Logs",
+    "/3/Timeline",
+    "/3/Metrics",
+    "/3/Profiler",
+    "/3/JStack",
+    "/3/WaterMeterCpuTicks",
+    "/3/Ping",
+)
+
+#: backticked tokens with one of these suffixes (optionally carrying a
+#: ``{label,...}`` hint) are treated as metric references the registry
+#: must actually contain
+_METRIC_SUFFIXES = ("_total", "_seconds", "_bytes", "_entries", "_workers",
+                    "_inflight", "_depth", "_batch_size", "_connections",
+                    "_homes")
+
+#: README sections whose backticked metric references the registry must
+#: actually contain
+_METRIC_SECTIONS = ("Observability", "Clustering", "Distributed Frames",
+                    "Failure model", "Serving plane")
+
+
+def readme_documented_routes(readme_path: str) -> set:
+    """Route strings out of the Observability section's markdown table."""
+    with open(readme_path) as f:
+        text = f.read()
+    m = re.search(r"^## Observability$(.*?)(?=^## |\Z)", text,
+                  re.MULTILINE | re.DOTALL)
+    if not m:
+        return set()
+    routes = set()
+    for line in m.group(1).splitlines():
+        if not line.startswith("|"):
+            continue
+        cell = line.split("|")[1].strip().strip("`")
+        parts = cell.split()
+        if len(parts) == 2 and parts[0] in ("GET", "POST", "DELETE"):
+            # table escapes | inside parameter hints; the route is parts[1]
+            routes.add((parts[0], parts[1]))
+    return routes
+
+
+def readme_documented_metrics(readme_path: str) -> set:
+    """Metric names referenced in the metric-documenting sections' prose."""
+    with open(readme_path) as f:
+        text = f.read()
+    names = set()
+    for section in _METRIC_SECTIONS:
+        m = re.search(rf"^## {section}$(.*?)(?=^## |\Z)", text,
+                      re.MULTILINE | re.DOTALL)
+        if not m:
+            continue
+        for tok in re.findall(r"`([a-z][a-z0-9_]*)(?:\{[a-z0-9_,]+\})?`",
+                              m.group(1)):
+            if tok.endswith(_METRIC_SUFFIXES):
+                names.add(tok)
+    return names
+
+
+def live_metrics() -> set:
+    """Registry names after importing every metric-declaring module the
+    server pulls in (parse/ingest/devcache/mapreduce come via the server
+    import below; list the frame layer explicitly so the lint cannot go
+    vacuous if a route stops importing it)."""
+    import h2o3_tpu.frame.ingest     # noqa: F401  parse_* / ingest_* meters
+    import h2o3_tpu.frame.devcache   # noqa: F401  devcache_* meters
+    import h2o3_tpu.compute.mapreduce  # noqa: F401  mapreduce_* meters
+    import h2o3_tpu.models.framework  # noqa: F401  model_fit_seconds
+    import h2o3_tpu.cluster.rpc      # noqa: F401  rpc_* meters
+    import h2o3_tpu.cluster.membership  # noqa: F401  cluster_* meters
+    import h2o3_tpu.cluster.dkv      # noqa: F401  cluster_dkv_* meters
+    import h2o3_tpu.cluster.tasks    # noqa: F401  cluster_tasks_* meters
+    import h2o3_tpu.cluster.faults   # noqa: F401  cluster_faults_* meters
+    import h2o3_tpu.cluster.frames   # noqa: F401  cluster_chunk_* meters
+    import h2o3_tpu.api.coalesce     # noqa: F401  predict_batch_size
+    import h2o3_tpu.rapids.fusion    # noqa: F401  rapids_fusion_* meters
+    from h2o3_tpu.util import telemetry
+
+    return set(telemetry.REGISTRY.names())
+
+
+def live_routes():
+    """(method, template) pairs off a constructed (not started) server."""
+    from h2o3_tpu.api.server import H2OServer
+
+    return H2OServer(port=0).registry.templates()
+
+
+def collect(root: str, readme_path: str
+            ) -> Tuple[List[Tuple[str, str, str, str]], str]:
+    """((rule, file, symbol, message) failures, OK-summary string)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    failures: List[Tuple[str, str, str, str]] = []
+
+    routes = live_routes()
+    documented = readme_documented_routes(readme_path)
+    if not documented:
+        failures.append((
+            "TDRIFT001", "README.md", "observability-table",
+            "README.md has no '## Observability' route table at all"))
+    obs = [
+        (m, t) for m, t in routes
+        if any(t.startswith(p) for p in OBS_PREFIXES)
+    ]
+    for m, t in sorted(obs):
+        if (m, t) not in documented:
+            failures.append((
+                "TDRIFT001", "README.md", f"{m} {t}",
+                f"observability route {m} {t} is registered but missing "
+                f"from README.md's Observability table"))
+    stale = {
+        (m, t) for m, t in documented
+        if any(t.startswith(p) for p in OBS_PREFIXES)
+        and (m, t) not in set(routes)
+    }
+    for m, t in sorted(stale):
+        failures.append((
+            "TDRIFT001", "README.md", f"{m} {t}",
+            f"README.md documents {m} {t} but no such route is registered"))
+
+    registered = live_metrics()
+    ghost = readme_documented_metrics(readme_path) - registered
+    for name in sorted(ghost):
+        failures.append((
+            "TDRIFT002", "README.md", name,
+            f"README.md's {'/'.join(_METRIC_SECTIONS)} sections document "
+            f"metric {name!r} but the telemetry registry never declares it"))
+
+    # fusion registry lint: a prim flagged fusible without an emitter would
+    # silently fall back on every query (binop/uniop/ifelse kinds), and a
+    # fusible prim with no parity test case is an unverified bit-identity
+    # claim — both fail the build
+    from h2o3_tpu.rapids.prims import FUSIBLE
+
+    emit_kinds = ("binop", "uniop", "ifelse")
+    for name, spec in sorted(FUSIBLE.items()):
+        if spec.kind in emit_kinds and spec.emit is None:
+            failures.append((
+                "TDRIFT003", "h2o3_tpu/rapids/prims.py", name,
+                f"fusible prim {name!r} (kind={spec.kind}) has no emitter"))
+    parity_path = os.path.join(root, "tests", "test_rapids_fusion.py")
+    try:
+        with open(parity_path) as f:
+            parity_src = f.read()
+    except OSError:
+        parity_src = ""
+        failures.append((
+            "TDRIFT004", "tests/test_rapids_fusion.py", "missing-file",
+            "tests/test_rapids_fusion.py is missing — every fusible prim "
+            "needs a fused-vs-interpreted parity case"))
+    untested = [
+        name for name in sorted(FUSIBLE)
+        if f'"{name}"' not in parity_src and f"'{name}'" not in parity_src
+    ]
+    for name in untested:
+        failures.append((
+            "TDRIFT004", "tests/test_rapids_fusion.py", name,
+            f"fusible prim {name!r} has no parity case in "
+            f"tests/test_rapids_fusion.py"))
+
+    from h2o3_tpu.api.registry import algo_map
+
+    train_routes = {t for m, t in routes if m == "POST"}
+    if "/3/ModelBuilders/{algo}" not in train_routes:
+        failures.append((
+            "TDRIFT005", "h2o3_tpu/api/registry.py", "train-route",
+            "train route /3/ModelBuilders/{algo} not registered"))
+    else:
+        # every registry algo name must be a clean single path segment,
+        # so the train route's {algo} placeholder can actually match it
+        for algo in algo_map():
+            if not re.match(r"^[a-z0-9_]+$", algo):
+                failures.append((
+                    "TDRIFT005", "h2o3_tpu/api/registry.py", algo,
+                    f"algo {algo!r} in api/registry.py cannot be a "
+                    f"URL path segment of /3/ModelBuilders/{{algo}}"))
+
+    n_doc_metrics = len(readme_documented_metrics(readme_path))
+    summary = (
+        f"check_telemetry: OK — {len(obs)} observability routes documented, "
+        f"{n_doc_metrics} documented metrics registered, "
+        f"{len(algo_map())} algos registered, "
+        f"{len(FUSIBLE)} fusible prims emitter+parity checked"
+    )
+    return failures, summary
+
+
+def run(ctx: Context) -> List[Finding]:
+    failures, _summary = collect(ctx.root, ctx.readme_path)
+    return [
+        Finding(rule=rule, file=file, line=1, symbol=symbol,
+                message=message, snippet=symbol)
+        for rule, file, symbol, message in failures
+    ]

@@ -296,11 +296,18 @@ class FrameTable:
         names = tuple(columns) if columns is not None else tuple(self.arrays)
         with self._matrix_lock:
             cached = self._matrix_cache.get(names)
-            if cached is not None:
-                _DEVCACHE_REQUESTS.inc(kind="table_matrix", result="hit")
-                return cached
-            _DEVCACHE_REQUESTS.inc(kind="table_matrix", result="miss")
-            m = jnp.stack([self.arrays[n] for n in names], axis=1)
+        if cached is not None:
+            _DEVCACHE_REQUESTS.inc(kind="table_matrix", result="hit")
+            return cached
+        _DEVCACHE_REQUESTS.inc(kind="table_matrix", result="miss")
+        # stack OUTSIDE the lock: a device dispatch while holding a lock
+        # other threads contend is the deadlock class _SHARD_EXEC_LOCK
+        # exists to prevent; the insert below re-checks like _get_plan
+        m = jnp.stack([self.arrays[n] for n in names], axis=1)
+        with self._matrix_lock:
+            cur = self._matrix_cache.get(names)
+            if cur is not None:
+                return cur  # lost the stack race; the winner is cached
             self._matrix_cache[names] = m
             if self._devcache_key is not None:
                 # a stacked matrix on a cache-resident table is resident

@@ -171,14 +171,20 @@ class KeyedStore:
         import os
         import tempfile
 
+        ice = None
+        if nbytes is not None:
+            ice = ice_dir or os.environ.get(
+                "H2O3_TPU_ICE_ROOT"
+            ) or os.path.join(tempfile.gettempdir(), "h2o3_tpu_ice")
+            # directory creation is disk I/O; under the store RLock it
+            # would freeze every concurrent DKV op (and re-entrancy would
+            # run the spill's serialize with the lock held too)
+            os.makedirs(ice, exist_ok=True)
         with self._lock:
             self._budget = nbytes
-            if nbytes is not None:
-                self._ice_dir = ice_dir or os.environ.get(
-                    "H2O3_TPU_ICE_ROOT"
-                ) or os.path.join(tempfile.gettempdir(), "h2o3_tpu_ice")
-                os.makedirs(self._ice_dir, exist_ok=True)
-            self._maybe_spill()
+            if ice is not None:
+                self._ice_dir = ice
+        self._maybe_spill()
 
     def resident_frame_bytes(self) -> int:
         with self._lock:
@@ -348,16 +354,20 @@ class KeyedStore:
             return r.remote_get(key, default)
         _DKV_GETS.inc()
         sentinel = object()
+        marker = None
         with self._lock:
             v = self._store.get(key, sentinel)
-            if not isinstance(v, _SpilledFrame):
-                if v is not sentinel:
-                    if _frame_nbytes(v) > 0:
-                        self._tick += 1
-                        self._access[key] = self._tick
-                    return v
-            else:
-                return self._unspill(key, v)
+            if isinstance(v, _SpilledFrame):
+                marker = v
+            elif v is not sentinel:
+                if _frame_nbytes(v) > 0:
+                    self._tick += 1
+                    self._access[key] = self._tick
+                return v
+        if marker is not None:
+            # reload outside the store lock: _unspill's disk read must not
+            # run under it (RLock re-entrancy would silently keep it held)
+            return self._unspill(key, marker)
         # local miss on a key THIS node homes: a replica successor may
         # hold the only surviving copy (this node restarted empty and
         # rejoined) — walk the ring before declaring it absent; the walk

@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""Run the h2o3_tpu static analyzer (see ``h2o3_tpu/analysis/``).
+
+Exit 0 when every finding is either suppressed inline
+(``# h2o3: noqa[RULE]``) or accepted in the checked-in baseline
+(``analysis_baseline.json``); exit 1 and print the new findings
+otherwise. Tier-1 invokes this via ``tests/test_analysis.py``.
+
+Flags:
+  --json            machine-readable output (schema version 1)
+  --changed-only    analyze only files changed since ``git merge-base
+                    HEAD main`` (plus worktree/untracked changes); the
+                    runtime-importing telemetry-drift pass is skipped
+                    unless a telemetry-relevant file changed, so
+                    incremental runs stay fast (<2s, no jax import)
+  --passes A,B      run only the named passes
+  --baseline PATH   alternate baseline file
+  --update-baseline rewrite the baseline to accept all current findings
+                    (existing justifications are preserved)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+import types
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_HERE)
+sys.path.insert(0, _ROOT)
+
+# Import the analysis package without executing h2o3_tpu/__init__.py:
+# the package init pulls the frame layer (and therefore jax), which
+# would put multiple seconds of import time on every --changed-only
+# run. A stub parent with the real __path__ lets submodule imports
+# (including the telemetry-drift pass's lazy runtime imports) work
+# normally.
+if "h2o3_tpu" not in sys.modules:
+    _pkg = types.ModuleType("h2o3_tpu")
+    _pkg.__path__ = [os.path.join(_ROOT, "h2o3_tpu")]
+    with open(os.path.join(_ROOT, "h2o3_tpu", "__init__.py")) as _f:
+        _m = re.search(r'__version__ = "([^"]+)"', _f.read())
+    _pkg.__version__ = _m.group(1) if _m else "0"
+    sys.modules["h2o3_tpu"] = _pkg
+
+from h2o3_tpu.analysis import core  # noqa: E402
+
+#: changed paths matching these prefixes re-arm the telemetry-drift
+#: pass in --changed-only mode (it imports the runtime, so it is
+#: skipped when nothing it checks can have moved)
+TDRIFT_TRIGGERS = (
+    "README.md",
+    "h2o3_tpu/api/",
+    "h2o3_tpu/rapids/",
+    "h2o3_tpu/util/telemetry.py",
+    "tests/test_rapids_fusion.py",
+    "scripts/check_telemetry.py",
+)
+
+
+def _git(*args: str) -> str:
+    try:
+        return subprocess.run(
+            ["git", *args], cwd=_ROOT, capture_output=True, text=True,
+            timeout=30, check=False).stdout
+    except OSError:
+        return ""
+
+
+def changed_files() -> list:
+    """Paths changed vs merge-base with main, plus worktree/untracked."""
+    base = _git("merge-base", "HEAD", "main").strip() or "HEAD"
+    out = set()
+    out.update(_git("diff", "--name-only", base).splitlines())
+    out.update(_git("ls-files", "--others", "--exclude-standard")
+               .splitlines())
+    return sorted(p for p in out if p)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--changed-only", action="store_true")
+    ap.add_argument("--passes", default=None,
+                    help="comma-separated subset of passes to run")
+    ap.add_argument("--baseline",
+                    default=os.path.join(_ROOT, "analysis_baseline.json"))
+    ap.add_argument("--update-baseline", action="store_true")
+    ap.add_argument("paths", nargs="*",
+                    help="repo-relative files to analyze (default: all)")
+    args = ap.parse_args(argv)
+
+    pass_names = ([p.strip() for p in args.passes.split(",") if p.strip()]
+                  if args.passes else None)
+
+    files = None
+    if args.paths:
+        files = [os.path.relpath(os.path.abspath(p), _ROOT)
+                 .replace(os.sep, "/") for p in args.paths]
+    elif args.changed_only:
+        changed = changed_files()
+        surface = set(core.iter_source_files(_ROOT))
+        files = [p for p in changed if p in surface]
+        if pass_names is None:
+            pass_names = [n for n in core.default_passes()
+                          if n != "telemetry-drift"]
+            if any(p.startswith(TDRIFT_TRIGGERS) for p in changed):
+                pass_names.append("telemetry-drift")
+        if not files and "telemetry-drift" not in pass_names:
+            print("analyze: OK — no analyzable files changed")
+            return 0
+
+    findings = core.analyze(_ROOT, files=files, pass_names=pass_names)
+    baseline = core.load_baseline(args.baseline)
+    new, accepted = core.split_baselined(findings, baseline)
+
+    if args.update_baseline:
+        justifications = {fp: e.get("justification", "")
+                          for fp, e in baseline.items()
+                          if e.get("justification")}
+        core.save_baseline(args.baseline, findings, justifications)
+        print(f"analyze: baseline updated — {len(findings)} accepted "
+              f"finding(s) in {args.baseline}")
+        return 0
+
+    if args.as_json:
+        print(json.dumps({
+            "version": 1,
+            "findings": [f.to_dict() for f in new],
+            "baselined": len(accepted),
+            "passes": pass_names or sorted(core.default_passes()),
+        }, indent=2))
+        return 1 if new else 0
+
+    for f in new:
+        print(f.render())
+    if new:
+        print(f"analyze: {len(new)} new finding(s) "
+              f"({len(accepted)} baselined). Fix them, add "
+              f"'# h2o3: noqa[RULE]' with a reason, or re-baseline via "
+              f"--update-baseline with a justification.", file=sys.stderr)
+        return 1
+    scanned = len(files) if files is not None \
+        else len(core.iter_source_files(_ROOT))
+    print(f"analyze: OK — {scanned} file(s), "
+          f"{len(pass_names or core.default_passes())} pass(es), "
+          f"{len(accepted)} baselined finding(s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

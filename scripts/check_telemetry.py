@@ -1,23 +1,17 @@
 #!/usr/bin/env python
 """Lint: observability docs must match the live REST route registry.
 
-Two checks, both cheap enough for tier-1 (CPU-only, no server socket):
-
-1. Every *observability* route registered on the server (anything under the
-   prefixes below) must appear in README.md's "## Observability" route
-   table. A new metrics/logging/profiling route that nobody documented
-   fails the build.
-2. Every algo in ``h2o3_tpu/api/registry.py``'s ``algo_map`` must be
-   servable through the registered ``/3/ModelBuilders/{algo}`` train route
-   — the registry and the route table cannot drift apart.
-
-Exit 0 = in sync; exit 1 prints what is missing.
+Thin shim: the checks now live in the static-analysis framework as the
+``telemetry-drift`` pass (``h2o3_tpu/analysis/passes/telemetry_drift.py``)
+and also run via ``scripts/analyze.py``. This entry point keeps the
+original contract — exit 0 and a ``check_telemetry: OK`` summary when
+in sync, exit 1 with one ``check_telemetry: <problem>`` line per drift
+on stderr — so existing tier-1 wiring and docs stay valid.
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -26,193 +20,16 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 _ROOT = os.path.dirname(_HERE)
 sys.path.insert(0, _ROOT)
 
-#: route prefixes that constitute the observability surface
-OBS_PREFIXES = (
-    "/3/Logs",
-    "/3/Timeline",
-    "/3/Metrics",
-    "/3/Profiler",
-    "/3/JStack",
-    "/3/WaterMeterCpuTicks",
-    "/3/Ping",
-)
-
-
-def readme_documented_routes(readme_path: str) -> set:
-    """Route strings out of the Observability section's markdown table."""
-    with open(readme_path) as f:
-        text = f.read()
-    m = re.search(r"^## Observability$(.*?)(?=^## |\Z)", text,
-                  re.MULTILINE | re.DOTALL)
-    if not m:
-        return set()
-    routes = set()
-    for line in m.group(1).splitlines():
-        if not line.startswith("|"):
-            continue
-        cell = line.split("|")[1].strip().strip("`")
-        parts = cell.split()
-        if len(parts) == 2 and parts[0] in ("GET", "POST", "DELETE"):
-            # table escapes | inside parameter hints; the route is parts[1]
-            routes.add((parts[0], parts[1]))
-    return routes
-
-
-#: backticked tokens with one of these suffixes (optionally carrying a
-#: ``{label,...}`` hint) are treated as metric references the registry
-#: must actually contain
-_METRIC_SUFFIXES = ("_total", "_seconds", "_bytes", "_entries", "_workers",
-                    "_inflight", "_depth", "_batch_size", "_connections",
-                    "_homes")
-
-
-#: README sections whose backticked metric references the registry must
-#: actually contain (Clustering documents cluster_*/rpc_*, Failure
-#: model the chaos-plane meters, Distributed Frames the chunk-home
-#: meters, Serving plane the http_*/batching meters)
-_METRIC_SECTIONS = ("Observability", "Clustering", "Distributed Frames",
-                    "Failure model", "Serving plane")
-
-
-def readme_documented_metrics(readme_path: str) -> set:
-    """Metric names referenced in the metric-documenting sections' prose."""
-    with open(readme_path) as f:
-        text = f.read()
-    names = set()
-    for section in _METRIC_SECTIONS:
-        m = re.search(rf"^## {section}$(.*?)(?=^## |\Z)", text,
-                      re.MULTILINE | re.DOTALL)
-        if not m:
-            continue
-        for tok in re.findall(r"`([a-z][a-z0-9_]*)(?:\{[a-z0-9_,]+\})?`",
-                              m.group(1)):
-            if tok.endswith(_METRIC_SUFFIXES):
-                names.add(tok)
-    return names
-
-
-def live_metrics() -> set:
-    """Registry names after importing every metric-declaring module the
-    server pulls in (parse/ingest/devcache/mapreduce come via the server
-    import below; list the frame layer explicitly so the lint cannot go
-    vacuous if a route stops importing it)."""
-    import h2o3_tpu.frame.ingest     # noqa: F401  parse_* / ingest_* meters
-    import h2o3_tpu.frame.devcache   # noqa: F401  devcache_* meters
-    import h2o3_tpu.compute.mapreduce  # noqa: F401  mapreduce_* meters
-    import h2o3_tpu.models.framework  # noqa: F401  model_fit_seconds
-    import h2o3_tpu.cluster.rpc      # noqa: F401  rpc_* meters
-    import h2o3_tpu.cluster.membership  # noqa: F401  cluster_* meters
-    import h2o3_tpu.cluster.dkv      # noqa: F401  cluster_dkv_* meters
-    import h2o3_tpu.cluster.tasks    # noqa: F401  cluster_tasks_* meters
-    import h2o3_tpu.cluster.faults   # noqa: F401  cluster_faults_* meters
-    import h2o3_tpu.cluster.frames   # noqa: F401  cluster_chunk_* meters
-    import h2o3_tpu.api.coalesce     # noqa: F401  predict_batch_size
-    import h2o3_tpu.rapids.fusion    # noqa: F401  rapids_fusion_* meters
-    from h2o3_tpu.util import telemetry
-
-    return set(telemetry.REGISTRY.names())
-
-
-def live_routes():
-    """(method, template) pairs off a constructed (not started) server."""
-    from h2o3_tpu.api.server import H2OServer
-
-    return H2OServer(port=0).registry.templates()
-
 
 def main() -> int:
-    failures = []
+    from h2o3_tpu.analysis.passes.telemetry_drift import collect
 
-    routes = live_routes()
-    documented = readme_documented_routes(os.path.join(_ROOT, "README.md"))
-    if not documented:
-        failures.append(
-            "README.md has no '## Observability' route table at all")
-    obs = [
-        (m, t) for m, t in routes
-        if any(t.startswith(p) for p in OBS_PREFIXES)
-    ]
-    for m, t in sorted(obs):
-        if (m, t) not in documented:
-            failures.append(
-                f"observability route {m} {t} is registered but missing "
-                f"from README.md's Observability table"
-            )
-    stale = {
-        (m, t) for m, t in documented
-        if any(t.startswith(p) for p in OBS_PREFIXES)
-        and (m, t) not in set(routes)
-    }
-    for m, t in sorted(stale):
-        failures.append(
-            f"README.md documents {m} {t} but no such route is registered"
-        )
-
-    registered = live_metrics()
-    ghost = readme_documented_metrics(os.path.join(_ROOT, "README.md")) \
-        - registered
-    for name in sorted(ghost):
-        failures.append(
-            f"README.md's {'/'.join(_METRIC_SECTIONS)} sections document "
-            f"metric {name!r} but the telemetry registry never declares it"
-        )
-
-    # fusion registry lint: a prim flagged fusible without an emitter would
-    # silently fall back on every query (binop/uniop/ifelse kinds), and a
-    # fusible prim with no parity test case is an unverified bit-identity
-    # claim — both fail the build
-    from h2o3_tpu.rapids.prims import FUSIBLE
-
-    emit_kinds = ("binop", "uniop", "ifelse")
-    for name, spec in sorted(FUSIBLE.items()):
-        if spec.kind in emit_kinds and spec.emit is None:
-            failures.append(
-                f"fusible prim {name!r} (kind={spec.kind}) has no emitter")
-    parity_path = os.path.join(_ROOT, "tests", "test_rapids_fusion.py")
-    try:
-        with open(parity_path) as f:
-            parity_src = f.read()
-    except OSError:
-        parity_src = ""
-        failures.append("tests/test_rapids_fusion.py is missing — every "
-                        "fusible prim needs a fused-vs-interpreted parity case")
-    untested = [
-        name for name in sorted(FUSIBLE)
-        if f'"{name}"' not in parity_src and f"'{name}'" not in parity_src
-    ]
-    for name in untested:
-        failures.append(
-            f"fusible prim {name!r} has no parity case in "
-            f"tests/test_rapids_fusion.py"
-        )
-
-    from h2o3_tpu.api.registry import algo_map
-
-    train_routes = {t for m, t in routes if m == "POST"}
-    if "/3/ModelBuilders/{algo}" not in train_routes:
-        failures.append("train route /3/ModelBuilders/{algo} not registered")
-    else:
-        # every registry algo name must be a clean single path segment,
-        # so the train route's {algo} placeholder can actually match it
-        for algo in algo_map():
-            if not re.match(r"^[a-z0-9_]+$", algo):
-                failures.append(
-                    f"algo {algo!r} in api/registry.py cannot be a "
-                    f"URL path segment of /3/ModelBuilders/{{algo}}"
-                )
-
+    failures, summary = collect(_ROOT, os.path.join(_ROOT, "README.md"))
     if failures:
-        for f in failures:
-            print(f"check_telemetry: {f}", file=sys.stderr)
+        for _rule, _file, _symbol, message in failures:
+            print(f"check_telemetry: {message}", file=sys.stderr)
         return 1
-    n_doc_metrics = len(
-        readme_documented_metrics(os.path.join(_ROOT, "README.md")))
-    print(
-        f"check_telemetry: OK — {len(obs)} observability routes documented, "
-        f"{n_doc_metrics} documented metrics registered, "
-        f"{len(algo_map())} algos registered, "
-        f"{len(FUSIBLE)} fusible prims emitter+parity checked"
-    )
+    print(summary)
     return 0
 
 
