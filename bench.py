@@ -57,9 +57,10 @@ _CACHE_ENV = {
 if os.environ.get("BENCH_FORCE_CPU") or "--cache-bench" in sys.argv \
         or "--parse-bench" in sys.argv or "--cluster-bench" in sys.argv \
         or "--chaos-bench" in sys.argv or "--serve-bench" in sys.argv \
-        or "--rapids-bench" in sys.argv:
+        or "--rapids-bench" in sys.argv or "--hist-bench" in sys.argv:
     # --cache-bench / --parse-bench / --cluster-bench / --chaos-bench /
-    # --serve-bench / --rapids-bench are CPU-only by construction: same hazard
+    # --serve-bench / --rapids-bench / --hist-bench are CPU-only by
+    # construction: same hazard
     for _k in _CACHE_ENV:
         os.environ.pop(_k, None)
 else:
@@ -692,6 +693,107 @@ def _run_child(arg: str, timeout: int, extra_env=None):
     return False, None, "no JSON line in child stdout"
 
 
+def _hist_bench() -> None:
+    """CPU booster-histogram microbench (the XLA scatter path).
+
+    Times ``build_histogram_sharded`` — the per-level inner loop of the
+    tree booster — on synthetic Higgs-shaped data quantized once with
+    ``make_bins``/``apply_bins``, at node counts matching tree levels
+    0..depth (2^level histogram nodes).  Per level it reports the cold
+    wall (first call, plan compile included), the warm wall (median of
+    repeat calls on the cached plan), the warm-plan delta between them,
+    and rows/s from the warm wall.  Prints ONE JSON line and mirrors it
+    to HIST_BENCH.json.  CPU-only by construction: ``H2O3_TPU_HIST_IMPL``
+    is pinned to ``scatter`` so numbers compare across hosts without a
+    TPU in the loop (the Pallas kernel tier is scripts/bench_hist_kernel
+    on real hardware)."""
+    import platform
+
+    os.environ["H2O3_TPU_HIST_IMPL"] = "scatter"
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from h2o3_tpu.ops.histogram import (
+        apply_bins,
+        build_histogram_sharded,
+        make_bins,
+    )
+
+    n = int(os.environ.get("BENCH_HIST_ROWS", 200_000))
+    nfeat = int(os.environ.get("BENCH_HIST_FEATS", 28))
+    nbins = int(os.environ.get("BENCH_HIST_BINS", 64))
+    depth = int(os.environ.get("BENCH_HIST_DEPTH", 6))
+    reps = int(os.environ.get("BENCH_HIST_REPS", 5))
+
+    X, _y = synth_higgs(n, nfeat, seed=0)
+    t = time.perf_counter()
+    edges = make_bins(X, nbins=nbins, seed=0)
+    make_bins_ms = (time.perf_counter() - t) * 1e3
+    t = time.perf_counter()
+    codes = apply_bins(X, edges)
+    apply_bins_ms = (time.perf_counter() - t) * 1e3
+
+    bins = jnp.asarray(codes, dtype=jnp.int32)
+    rng = np.random.default_rng(1)
+    g = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    h = jnp.asarray(rng.uniform(0.1, 1.0, size=n).astype(np.float32))
+    n_bins1 = nbins + 1  # + the NA bucket at the end
+
+    levels = []
+    for lvl in range(depth + 1):
+        k = 2 ** lvl
+        nodes = jnp.asarray(rng.integers(0, k, size=n).astype(np.int32))
+        t = time.perf_counter()
+        jax.block_until_ready(build_histogram_sharded(
+            bins, nodes, g, h, k, n_bins1))
+        cold = time.perf_counter() - t
+        walls = []
+        for _ in range(reps):
+            t = time.perf_counter()
+            jax.block_until_ready(build_histogram_sharded(
+                bins, nodes, g, h, k, n_bins1))
+            walls.append(time.perf_counter() - t)
+        warm = sorted(walls)[len(walls) // 2]
+        levels.append({
+            "level": lvl,
+            "n_nodes": k,
+            "cold_ms": round(cold * 1e3, 2),
+            "warm_ms": round(warm * 1e3, 2),
+            "warm_plan_delta_ms": round((cold - warm) * 1e3, 2),
+            "rows_per_sec": round(n / max(warm, 1e-9), 1),
+        })
+    deepest = levels[-1]
+    result = {
+        "metric": "cpu_hist_scatter_rows_per_sec",
+        "value": deepest["rows_per_sec"],
+        "unit": (f"rows/sec (warm scatter histogram, level {depth}: "
+                 f"{deepest['n_nodes']} nodes, {nfeat} features, "
+                 f"{nbins} bins)"),
+        "vs_baseline": round(
+            levels[0]["rows_per_sec"]
+            / max(deepest["rows_per_sec"], 1e-9), 2),
+        "detail": {
+            "host_cpus": os.cpu_count(),
+            "platform": platform.platform(),
+            "python": platform.python_version(),
+            "impl": "scatter",
+            "rows": n,
+            "features": nfeat,
+            "nbins": nbins,
+            "make_bins_ms": round(make_bins_ms, 1),
+            "apply_bins_ms": round(apply_bins_ms, 1),
+            "per_level": levels,
+            "vs_baseline_is": "level-0 rows/s / deepest-level rows/s",
+        },
+    }
+    with open(os.path.join(_HERE, "HIST_BENCH.json"), "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))
+
+
 def _cluster_bench() -> None:
     """2-node localhost cloud microbench (application-plane cluster).
 
@@ -877,6 +979,58 @@ def _cluster_bench() -> None:
             np.asarray(a).tobytes() == np.asarray(b).tobytes()
             for a, b in zip(_jax.tree.leaves(local_mr),
                             _jax.tree.leaves(dist_mr)))
+        # distributed model search: the same 6-cell GLM grid walked
+        # single-node vs fanned across both members (cluster/search.py).
+        # Each path runs once untimed to warm its jit caches, then once
+        # timed; the leaderboards must be bit-identical either way (the
+        # subsystem's determinism contract).  Runs BEFORE the dead-home
+        # cell below: it needs the peer alive.
+        from h2o3_tpu.frame.frame import ColType, Column, Frame
+        from h2o3_tpu.models.glm import GLM, GLMParameters
+        from h2o3_tpu.models.grid import GridSearch, cell_key, metric_value
+
+        srng = np.random.default_rng(5)
+        sn = 400
+        sX = srng.normal(size=(sn, 3))
+        slogit = sX @ np.array([1.0, -2.0, 0.5])
+        sy = (srng.random(sn)
+              < 1.0 / (1.0 + np.exp(-slogit))).astype(np.float64)
+        scols = [Column(f"x{i}", sX[:, i]) for i in range(3)]
+        scols.append(Column("y", sy, ColType.CAT, ["n", "p"]))
+        sfr = Frame(scols)
+
+        def _grid():
+            return GridSearch(
+                GLM,
+                GLMParameters(response_column="y", family="binomial",
+                              seed=7, nfolds=2),
+                {"alpha": [0.0, 0.5, 1.0], "lambda_": [0.01, 0.1]})
+
+        def _srows(grid):
+            return [(cell_key(hp), metric_value(m, "auto")[0])
+                    for hp, m in zip(grid.hyper_params, grid.models)]
+
+        os.environ["H2O3_TPU_SEARCH_DIST"] = "0"
+        try:
+            _grid().train(sfr)  # warms the local jit
+            t = time.perf_counter()
+            sg1 = _grid().train(sfr)
+            search_1node = time.perf_counter() - t
+        finally:
+            os.environ.pop("H2O3_TPU_SEARCH_DIST", None)
+        _grid().train(sfr)  # warms the peer's jit + its frame transfer
+        t = time.perf_counter()
+        sg2 = _grid().train(sfr)
+        search_2node = time.perf_counter() - t
+        search_speedup = search_1node / max(search_2node, 1e-9)
+        dist_search = {
+            "cells": 6,
+            "grid_wall_1node_ms": round(search_1node * 1e3, 1),
+            "grid_wall_2node_ms": round(search_2node * 1e3, 1),
+            "speedup": round(search_speedup, 2),
+            "scaling_efficiency": round(search_speedup / 2.0, 2),
+            "leaderboard_bit_identical": _srows(sg1) == _srows(sg2),
+        }
         # one-home-dead recovery wall: SIGKILL the peer (this cell runs
         # last, nothing downstream needs it) and re-run the chunk-homed
         # map_reduce — the caller holds the dead home's replica chunks,
@@ -926,6 +1080,7 @@ def _cluster_bench() -> None:
                 "rpc_throughput_by_bytes": thru,
                 "dkv": dkv,
                 "dist_frame": dist_frame,
+                "dist_search": dist_search,
                 "vs_baseline_is": "remote get p50 / local get p50",
             },
             "telemetry": {k: (round(v, 3) if isinstance(v, float) else v)
@@ -1399,5 +1554,7 @@ if __name__ == "__main__":
         _serve_bench()
     elif "--rapids-bench" in sys.argv:
         _rapids_bench()
+    elif "--hist-bench" in sys.argv:
+        _hist_bench()
     else:
         main()

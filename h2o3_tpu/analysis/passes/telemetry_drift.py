@@ -54,7 +54,8 @@ _METRIC_SUFFIXES = ("_total", "_seconds", "_bytes", "_entries", "_workers",
 #: README sections whose backticked metric references the registry must
 #: actually contain
 _METRIC_SECTIONS = ("Observability", "Clustering", "Distributed Frames",
-                    "Failure model", "Serving plane")
+                    "Distributed model search", "Failure model",
+                    "Serving plane")
 
 
 def readme_documented_routes(readme_path: str) -> set:
@@ -109,6 +110,7 @@ def live_metrics() -> set:
     import h2o3_tpu.cluster.tasks    # noqa: F401  cluster_tasks_* meters
     import h2o3_tpu.cluster.faults   # noqa: F401  cluster_faults_* meters
     import h2o3_tpu.cluster.frames   # noqa: F401  cluster_chunk_* meters
+    import h2o3_tpu.cluster.search   # noqa: F401  cluster_search_* meters
     import h2o3_tpu.api.coalesce     # noqa: F401  predict_batch_size
     import h2o3_tpu.rapids.fusion    # noqa: F401  rapids_fusion_* meters
     from h2o3_tpu.util import telemetry

@@ -116,6 +116,7 @@ def _job_schema(job: Job) -> Dict[str, Any]:
         "description": job.description,
         "status": job.status,
         "progress": job.progress,
+        "progress_msg": getattr(job, "progress_msg", None),
         "msec": int(job.run_time * 1000),
         "exception": str(job.exception) if job.exception else None,
         "dest": getattr(job, "dest", None),
@@ -1082,7 +1083,16 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
         })
         base = _coerce_params(pcls, params)
         gs = GridSearch(bcls, base, hyper, crit)
-        grid = gs.train(fr)
+        # the search runs under a Job so /3/Jobs shows live cluster-wide
+        # completion while members stream search_progress events into it
+        job = Job(f"grid search ({algo})").start()
+        try:
+            grid = gs.train(fr, job=job)
+        except Exception as e:
+            job.fail(e)
+            raise
+        job.dest = grid.grid_id
+        job.done()
         want = params.get("grid_id")
         if want and want != grid.grid_id:
             # client-chosen grid id (GridSearchHandler honors grid_id)
@@ -1095,6 +1105,7 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
             "grid_id": {"name": grid.grid_id},
             "model_ids": [{"name": k} for k in grid.model_ids],
             "failure_details": [msg for _, msg in grid.failures],
+            "job": _job_schema(job),
         }
 
     def grids_list(params):
@@ -1109,12 +1120,23 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
             raise RestError(404, f"grid {grid_id!r} not found")
         sort_by = params.get("sort_by", "auto")
         gs = g.get_grid(sort_by)
-        return {
+        out = {
             "grid_id": {"name": grid_id},
             "model_ids": [{"name": k} for k in gs.model_ids],
             "hyper_params": gs.hyper_params,
             "failure_details": [msg for _, msg in gs.failures],
         }
+        # live cluster-wide completion while a distributed search runs
+        # (members stream per-model search_progress events to the caller)
+        try:
+            from h2o3_tpu.cluster.search import search_progress
+
+            prog = search_progress(grid_id)
+        except Exception:
+            prog = None
+        if prog is not None:
+            out["progress"] = prog
+        return out
 
     def grid_export(params, grid_id):
         """export_grid (hex/grid Grid.exportBinary): pickle-free archive."""

@@ -85,6 +85,12 @@ class _Step:
     id: str
     weight: int  # work allocation units (WorkAllocations.java)
     build: Callable[["AutoML", Frame], List[Model]]
+    #: (builder_cls, params_cls, extra-params dict) for steps that are a
+    #: single fully-determined model build — the shape the distributed
+    #: search plane (cluster/search.py) can fan across cluster members.
+    #: None for steps with sequential dependencies (grids read the
+    #: budget, exploitation/ensembles read the leaderboard).
+    spec: Optional[Any] = None
 
 
 class AutoML:
@@ -225,30 +231,38 @@ class AutoML:
 
         steps: List[_Step] = []
 
-        def add(algo: str, sid: str, weight: int, fn) -> None:
+        def add(algo: str, sid: str, weight: int, fn, spec=None) -> None:
             if self._algo_allowed(algo):
-                steps.append(_Step(f"{algo}_{sid}", weight, fn))
+                steps.append(_Step(f"{algo}_{sid}", weight, fn, spec))
 
+        def one(bcls, pcls, **extra):
+            """A fully-determined single-model step: the sequential build
+            closure plus the (builder, params, extra) spec the distributed
+            search plane fans out — both train the SAME params."""
+            return (
+                lambda a, f: a._one(bcls, pcls, f, **extra),
+                (bcls, pcls, extra),
+            )
+
+        fam = (
+            "multinomial" if self._nclasses > 2
+            else "binomial" if self._nclasses == 2 else "gaussian"
+        )
         # the reference's default plan order (AutoML.java defaultModelingPlan)
-        add("xgboost", "def_1", 10, lambda a, f: a._one(
-            XGBoost, XGBoostParameters, f, ntrees=50, max_depth=6, learn_rate=0.1))
-        add("glm", "def_1", 10, lambda a, f: a._one(
-            GLM, GLMParameters, f,
-            family=(
-                "multinomial" if a._nclasses > 2
-                else "binomial" if a._nclasses == 2 else "gaussian"
-            ),
-            alpha=0.5, lambda_=1e-4))
-        add("drf", "def_1", 10, lambda a, f: a._one(
-            DRF, DRFParameters, f, ntrees=50, max_depth=12))
-        add("gbm", "def_1", 10, lambda a, f: a._one(
-            GBM, GBMParameters, f, ntrees=50, max_depth=5, learn_rate=0.1))
-        add("gbm", "def_2", 10, lambda a, f: a._one(
-            GBM, GBMParameters, f, ntrees=50, max_depth=3, learn_rate=0.1))
-        add("deeplearning", "def_1", 10, lambda a, f: a._one(
-            DeepLearning, DeepLearningParameters, f, hidden=[32, 32], epochs=10))
-        add("xgboost", "def_2", 10, lambda a, f: a._one(
-            XGBoost, XGBoostParameters, f, ntrees=100, max_depth=4, learn_rate=0.05))
+        add("xgboost", "def_1", 10, *one(
+            XGBoost, XGBoostParameters, ntrees=50, max_depth=6, learn_rate=0.1))
+        add("glm", "def_1", 10, *one(
+            GLM, GLMParameters, family=fam, alpha=0.5, lambda_=1e-4))
+        add("drf", "def_1", 10, *one(
+            DRF, DRFParameters, ntrees=50, max_depth=12))
+        add("gbm", "def_1", 10, *one(
+            GBM, GBMParameters, ntrees=50, max_depth=5, learn_rate=0.1))
+        add("gbm", "def_2", 10, *one(
+            GBM, GBMParameters, ntrees=50, max_depth=3, learn_rate=0.1))
+        add("deeplearning", "def_1", 10, *one(
+            DeepLearning, DeepLearningParameters, hidden=[32, 32], epochs=10))
+        add("xgboost", "def_2", 10, *one(
+            XGBoost, XGBoostParameters, ntrees=100, max_depth=4, learn_rate=0.05))
         add("gbm", "grid_1", 20, self._gbm_grid)
         if self.exploitation_ratio > 0:
             steps.append(_Step("exploitation", 10, lambda a, f: a._exploitation(f)))
@@ -256,6 +270,82 @@ class AutoML:
             lambda a, f: a._stacked(f, best_of_family=True))
         add("stackedensemble", "all", 5, lambda a, f: a._stacked(f, best_of_family=False))
         return steps
+
+    def _distribute_prefix(
+        self, steps: List[_Step], frame: Frame
+    ) -> List[_Step]:
+        """Fan the plan's leading run of fully-determined single-model
+        steps across a live cloud (cluster/search.py) and return the
+        remaining steps for the sequential loop.
+
+        Leaderboard-identical to the sequential run: each step's params
+        (seed included) are exactly what ``_one`` would build, and the
+        leaderboard re-sorts by metric on every add, so training order
+        cannot change the ranking.  Wall-clock-budgeted runs stay
+        sequential — ``max_runtime_secs`` is enforced at step boundaries
+        and a fan-out has none."""
+        if self.max_runtime_secs:
+            return steps
+        try:
+            from h2o3_tpu.cluster import search as _search
+
+            cloud = _search.search_cloud()
+        except Exception:
+            cloud = None
+        if cloud is None:
+            return steps
+        prefix: List[_Step] = []
+        rest = list(steps)
+        while rest and rest[0].spec is not None:
+            prefix.append(rest.pop(0))
+        if self.max_models:
+            room = max(self.max_models, 0)
+            prefix, over = prefix[:room], prefix[room:]
+            # steps past the budget rejoin the loop so the event log
+            # records each skip exactly like the sequential run
+            rest = over + rest
+        if len(prefix) < 2:
+            return steps
+        ev = self.event_log
+        ev.log(
+            "ModelTraining",
+            f"distributing {len(prefix)} steps across "
+            f"{cloud.size()} cluster members",
+        )
+        cells = []
+        for i, step in enumerate(prefix):
+            bcls, pcls, extra = step.spec
+            cells.append({
+                "index": i,
+                "builder_cls": bcls,
+                "params": pcls(**self._common(dict(extra))),
+                "hp": {"step": step.id},
+            })
+        results = _search.fan_out(
+            cloud, frame, None, cells,
+            search_id=self.project_key,
+            stopping_metric=self.sort_metric,
+        )
+        for i, step in enumerate(prefix):
+            st = results.get(i)
+            if st is None:
+                ev.log("ModelTraining", f"step {step.id} failed: no result")
+                continue
+            kind, val = st
+            if kind != "ok":
+                ev.log("ModelTraining", f"step {step.id} failed: {val}")
+                continue
+            m = _search.model_from_blob(val["model"])
+            if self._te_model is not None:
+                m.preprocessors = [self._te_model]
+            self.leaderboard.add(m)
+            v, _ = metric_value(m, self.sort_metric)
+            ev.log(
+                "ModelTraining",
+                f"{step.id} -> {m.key} metric={v:.5f} "
+                f"(built on {val.get('member', '?')})",
+            )
+        return rest
 
     def _gbm_grid(self, a: "AutoML", frame: Frame) -> List[Model]:
         """Random GBM grid (modeling/GBMStepsProvider grid step)."""
@@ -380,7 +470,11 @@ class AutoML:
             except Exception as e:  # preprocessing failure never kills the run
                 ev.log("DataProcessing", f"target encoding failed: {e}")
 
-        for step in self._default_plan():
+        plan = self._default_plan()
+        # cluster-parallel prefix: independent default models fan out
+        # across members; grids/exploitation/ensembles stay sequential
+        plan = self._distribute_prefix(plan, training_frame)
+        for step in plan:
             if self._out_of_time():
                 ev.log("Workflow", f"time budget exhausted before {step.id}")
                 break

@@ -1,0 +1,614 @@
+"""Distributed model search — grid/AutoML cells fanned across cluster members.
+
+The caller (the node running GridSearch or AutoML) partitions independent
+model builds — "cells" — over the cloud's DTask plane (``cluster/tasks.py``):
+``search_init`` ships the training frame(s) to each member ONCE,
+``search_cell`` trains one cell there and returns ``(hyperparams, scoring
+summary, serialized model artifact)`` — the model rehydrates on the caller
+through ``models/persist.py``, so training rows cross the wire per member
+and never per model (the XGBoost-GPU merge-only-partials discipline
+applied to AutoML).
+
+Determinism contract: per-cell seeds derive from ``(search_seed, canonical
+cell key)`` — never dispatch or completion order — and the caller records
+results in canonical walk order, so the resulting Grid/Leaderboard is
+bit-identical to a single-node run at a fixed seed regardless of member
+count or scheduling.
+
+Recovery ladder (composing the fan-out and snapshot mechanisms): a member
+dying mid-search costs only its in-flight cells — survivors re-claim them
+(``cluster_search_recovered_total{path="survivor"}``) and the caller
+trains the remainder itself only as the last resort (``path="local"``) —
+while the caller's recovery snapshot records per-cell completion so
+``auto_recover`` resumes an interrupted distributed grid without
+retraining finished cells.
+
+Progress streams back per model: members call the caller's
+``search_progress`` RPC as cells start and finish, so ``/3/Jobs`` and
+``/3/Grids/{id}`` show live cluster-wide completion.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict, deque
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from h2o3_tpu.cluster import rpc as _rpc
+from h2o3_tpu.cluster import tasks as _tasks
+from h2o3_tpu.cluster.membership import Cloud
+from h2o3_tpu.util import telemetry
+from h2o3_tpu.util.log import get_logger
+
+log = get_logger("cluster.search")
+
+_CELLS = telemetry.counter(
+    "cluster_search_cells_total",
+    "search cells (one hyperparameter combo = one model build) executed "
+    "anywhere in the cloud; result=ok|error",
+    labels=("result",),
+)
+_RECOVERED = telemetry.counter(
+    "cluster_search_recovered_total",
+    "search cells re-claimed after a member failure: path=survivor "
+    "completed by another live member, path=local fell back to the "
+    "caller (the last resort)",
+    labels=("path",),
+)
+_PROGRESS_EVENTS = telemetry.counter(
+    "cluster_search_progress_total",
+    "per-model search_progress events observed by the caller; "
+    "status=building|done|error",
+    labels=("status",),
+)
+
+#: RPC error code a member raises when a cell's MODEL BUILD failed —
+#: deterministic, so the caller records a grid failure instead of
+#: rescheduling (an infra 5xx reschedules onto a survivor instead)
+CELL_BUILD_FAILED = 520
+
+
+def _dist_enabled() -> bool:
+    return os.environ.get("H2O3_TPU_SEARCH_DIST", "1").lower() not in (
+        "0", "false", "off")
+
+
+def _inflight_per_member() -> int:
+    return max(1, int(os.environ.get("H2O3_TPU_SEARCH_INFLIGHT", "2")))
+
+
+def _cell_timeout() -> float:
+    return float(os.environ.get("H2O3_TPU_SEARCH_TIMEOUT_S", "600"))
+
+
+def _cache_cap() -> int:
+    return max(1, int(os.environ.get("H2O3_TPU_SEARCH_CACHE", "4")))
+
+
+def search_cloud() -> Optional[Cloud]:
+    """The live cloud when distribution is on and at least two healthy
+    non-client members exist, else None (local execution)."""
+    if not _dist_enabled():
+        return None
+    from h2o3_tpu.cluster import active_cloud
+
+    cloud = active_cloud()
+    if cloud is None:
+        return None
+    if len(_tasks._healthy_workers(cloud)) < 2:
+        return None
+    return cloud
+
+
+# ---------------------------------------------------------------------------
+# determinism: canonical cell keys and per-cell seeds live in models/grid.py
+# (the home of the walk they canonicalize); re-exported here as the search
+# plane's public contract
+from h2o3_tpu.models.grid import cell_key, cell_seed  # noqa: E402,F401
+
+# ---------------------------------------------------------------------------
+# wire format: frames cross once per member, models come back as blobs
+
+
+def frame_payload(fr) -> Dict[str, Any]:
+    """A Frame as plain host data (no rollup caches, no device arrays)."""
+    return {
+        "names": list(fr.names),
+        "cols": [
+            {
+                "name": c.name,
+                "type": c.type.name,
+                "domain": list(c.domain) if c.domain else None,
+                "data": np.asarray(c.data),
+            }
+            for c in fr.columns
+        ],
+    }
+
+
+def frame_restore(payload: Optional[Dict[str, Any]]):
+    if payload is None:
+        return None
+    from h2o3_tpu.frame.frame import Column, ColType, Frame
+
+    cols = [
+        Column(d["name"], d["data"], ColType[d["type"]], d["domain"])
+        for d in payload["cols"]
+    ]
+    return Frame(cols)
+
+
+def model_to_blob(model) -> bytes:
+    from h2o3_tpu.models.persist import dumps_model
+
+    return dumps_model(model)
+
+
+def model_from_blob(blob: bytes):
+    """Rehydrate a member-built model on the caller and register it.  A
+    key collision with a live different object (possible across node
+    processes — keys are minted per-process) re-keys the arrival."""
+    from h2o3_tpu.keyed import DKV
+    from h2o3_tpu.models.persist import loads_model
+
+    m = loads_model(blob, register=False)
+    if getattr(m, "key", None) and DKV.get(m.key) is not None:
+        m.key = DKV.make_key("model")
+    if getattr(m, "key", None):
+        DKV.put(m.key, m)
+    return m
+
+
+# ---------------------------------------------------------------------------
+# member side: cached search context + cell execution
+
+#: search_id -> {"frame": Frame, "valid": Frame|None}; tiny LRU so a
+#: member never holds more than a few live searches' training data
+_CTX_CACHE: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+_CTX_LOCK = threading.Lock()
+
+
+def _ctx_put(search_id: str, ctx: Dict[str, Any]) -> None:
+    with _CTX_LOCK:
+        _CTX_CACHE[search_id] = ctx
+        _CTX_CACHE.move_to_end(search_id)
+        while len(_CTX_CACHE) > _cache_cap():
+            _CTX_CACHE.popitem(last=False)
+
+
+def _ctx_get(search_id: str) -> Optional[Dict[str, Any]]:
+    with _CTX_LOCK:
+        ctx = _CTX_CACHE.get(search_id)
+        if ctx is not None:
+            _CTX_CACHE.move_to_end(search_id)
+        return ctx
+
+
+def _ctx_drop(search_id: str) -> None:
+    with _CTX_LOCK:
+        _CTX_CACHE.pop(search_id, None)
+
+
+def search_init(payload: Dict[str, Any], cloud, store) -> Dict[str, Any]:
+    """DTask ``search_init``: cache the search's frames on this member."""
+    _ctx_put(payload["search_id"], {
+        "frame": frame_restore(payload["frame"]),
+        "valid": frame_restore(payload.get("valid")),
+    })
+    return {"ok": True}
+
+
+def search_end(payload: Dict[str, Any], cloud, store) -> Dict[str, Any]:
+    """DTask ``search_end``: drop the cached context (best-effort)."""
+    _ctx_drop(payload["search_id"])
+    return {"ok": True}
+
+
+def _send_progress(cloud, caller: Optional[Dict[str, Any]],
+                   event: Dict[str, Any]) -> None:
+    """Stream one per-model event to the caller's search_progress RPC.
+    Best-effort: progress is cosmetic; results ride the task response."""
+    if caller is None or cloud is None:
+        _note_progress(event)  # caller-local build: no wire needed
+        return
+    if caller.get("name") == getattr(
+            getattr(cloud, "info", None), "name", None):
+        _note_progress(event)
+        return
+    try:
+        cloud.client.call(
+            tuple(caller["addr"]), "search_progress", event,
+            timeout=5.0, target=caller.get("ident", ""), retries=0)
+    except Exception:
+        pass
+
+
+def _execute_cell(payload: Dict[str, Any], cloud) -> Dict[str, Any]:
+    """Train one cell against the cached context.  Shared by the member
+    DTask handler and the caller's local path so both meter identically."""
+    search_id = payload["search_id"]
+    ctx = _ctx_get(search_id)
+    if ctx is None:
+        raise _rpc.RpcFault(
+            f"no cached context for search {search_id!r}", code=404)
+    caller = payload.get("caller")
+    event = {
+        "search_id": search_id,
+        "job_key": payload.get("job_key"),
+        "index": payload["index"],
+        "total": payload.get("total", 0),
+        "hp": payload.get("hp", {}),
+        "member": getattr(getattr(cloud, "info", None), "name", "local"),
+    }
+    _send_progress(cloud, caller, {**event, "status": "building"})
+    builder_cls = payload["builder_cls"]
+    params = payload["params"]
+    try:
+        # XLA:CPU wedges when several threads of one process launch
+        # multi-device collective programs concurrently (see
+        # tasks._SHARD_EXEC_LOCK) — model training runs shard_map+psum,
+        # so every cell build in the process serializes behind that lock
+        with _tasks._SHARD_EXEC_LOCK:
+            model = builder_cls(params).train(ctx["frame"], ctx["valid"])
+    except Exception as e:
+        _CELLS.inc(result="error")
+        _send_progress(cloud, caller, {**event, "status": "error"})
+        raise _rpc.RpcFault(
+            f"cell build failed: {type(e).__name__}: {e}",
+            code=CELL_BUILD_FAILED)
+    from h2o3_tpu.models.grid import metric_value
+
+    v, larger = metric_value(model, payload.get("stopping_metric", "auto"))
+    summary = {"metric": v, "larger": larger}
+    _CELLS.inc(result="ok")
+    _send_progress(
+        cloud, caller, {**event, "status": "done", "metric": v})
+    return {
+        "index": payload["index"],
+        "hp": payload.get("hp", {}),
+        "summary": summary,
+        "model": model_to_blob(model),
+        "member": event["member"],
+    }
+
+
+def search_cell(payload: Dict[str, Any], cloud, store) -> Dict[str, Any]:
+    """DTask ``search_cell``: one hyperparameter combo -> one model."""
+    return _execute_cell(payload, cloud)
+
+
+# ---------------------------------------------------------------------------
+# caller side: live progress registry + search_progress RPC
+
+#: search_id -> {"total", "done", "building", "errors", "by_member"}
+_PROGRESS: Dict[str, Dict[str, Any]] = {}
+_PROGRESS_LOCK = threading.Lock()
+
+
+def _note_progress(event: Dict[str, Any]) -> Dict[str, Any]:
+    """Fold one per-model event into the live registry and the Job."""
+    status = str(event.get("status", ""))
+    _PROGRESS_EVENTS.inc(status=status or "unknown")
+    sid = event.get("search_id", "")
+    with _PROGRESS_LOCK:
+        st = _PROGRESS.setdefault(sid, {
+            "total": 0, "done": 0, "errors": 0,
+            "building": [], "by_member": {},
+        })
+        if event.get("total"):
+            st["total"] = int(event["total"])
+        member = event.get("member", "?")
+        idx = event.get("index")
+        if status == "building":
+            if idx not in st["building"]:
+                st["building"].append(idx)
+        else:
+            if idx in st["building"]:
+                st["building"].remove(idx)
+        if status == "done":
+            st["done"] += 1
+            st["by_member"][member] = st["by_member"].get(member, 0) + 1
+        elif status == "error":
+            st["errors"] += 1
+        snapshot = {k: (list(v) if isinstance(v, list) else
+                        dict(v) if isinstance(v, dict) else v)
+                    for k, v in st.items()}
+    job_key = event.get("job_key")
+    if job_key:
+        from h2o3_tpu.keyed import DKV
+
+        job = DKV.get(job_key)
+        if job is not None and snapshot["total"]:
+            job.update(snapshot["done"] / snapshot["total"])
+            job.progress_msg = (
+                f"{snapshot['done']}/{snapshot['total']} models across "
+                f"{max(len(snapshot['by_member']), 1)} member(s)")
+    return {"ok": True}
+
+
+def search_progress(search_id: str) -> Optional[Dict[str, Any]]:
+    """Live completion state for ``/3/Grids/{id}`` (None once unknown)."""
+    with _PROGRESS_LOCK:
+        st = _PROGRESS.get(search_id)
+        if st is None:
+            return None
+        return {k: (list(v) if isinstance(v, list) else
+                    dict(v) if isinstance(v, dict) else v)
+                for k, v in st.items()}
+
+
+def _clear_progress(search_id: str) -> None:
+    with _PROGRESS_LOCK:
+        _PROGRESS.pop(search_id, None)
+
+
+def install_progress_rpc(cloud: Cloud) -> None:
+    """Register the caller-side ``search_progress`` RPC (idempotent)."""
+    cloud.rpc_server.register("search_progress", _note_progress)
+
+
+# ---------------------------------------------------------------------------
+# the fan-out scheduler
+
+
+def fan_out(
+    cloud: Cloud,
+    frame,
+    valid,
+    cells: List[Dict[str, Any]],
+    search_id: str,
+    job=None,
+    stopping_metric: str = "auto",
+    timeout: Optional[float] = None,
+    deadline=None,
+) -> Dict[int, Any]:
+    """Run ``cells`` (each ``{"index", "builder_cls", "params", "hp"}``)
+    across the cloud's healthy members; returns index -> ("ok", result) |
+    ("error", message).
+
+    A shared work queue feeds every member ``H2O3_TPU_SEARCH_INFLIGHT``
+    cells at a time; a member whose dispatch fails on an infrastructure
+    error is marked dead and its in-flight cell goes back on the queue
+    for survivors (``path=survivor``); a cell's deterministic build
+    failure is recorded, never retried.  Cells left when every member is
+    gone train on the caller (``path=local``).  Incomplete only when the
+    job is cancelled or the deadline passes mid-run."""
+    timeout = _cell_timeout() if timeout is None else timeout
+    workers = _tasks._healthy_workers(cloud)
+    install_progress_rpc(cloud)
+    caller_ref = {
+        "addr": tuple(cloud.info.addr),
+        "ident": cloud.info.ident,
+        "name": cloud.info.name,
+    }
+    ctx_payload = {
+        "search_id": search_id,
+        "frame": frame_payload(frame),
+        "valid": frame_payload(valid) if valid is not None else None,
+    }
+    # the caller participates without the wire: prime its own cache
+    _ctx_put(search_id, {"frame": frame, "valid": valid})
+
+    total = len(cells)
+    queue: deque = deque(range(total))
+    results: Dict[int, Any] = {}
+    reassigned: set = set()
+    qlock = threading.Lock()
+    job_key = getattr(job, "key", None) if job is not None else None
+
+    import time as _time
+
+    def _expired() -> bool:
+        if job is not None and job.stop_requested:
+            return True
+        return deadline is not None and _time.time() >= deadline
+
+    def _cell_payload(idx: int) -> Dict[str, Any]:
+        cell = cells[idx]
+        return {
+            "search_id": search_id,
+            "index": cell["index"],
+            "builder_cls": cell["builder_cls"],
+            "params": cell["params"],
+            "hp": cell.get("hp", {}),
+            "caller": caller_ref,
+            "job_key": job_key,
+            "total": total,
+            "stopping_metric": stopping_metric,
+        }
+
+    def _take() -> Optional[int]:
+        with qlock:
+            if not queue:
+                return None
+            return queue.popleft()
+
+    def _settle(idx: int, outcome) -> None:
+        with qlock:
+            results[idx] = outcome
+            was_reassigned = idx in reassigned
+        if outcome[0] == "ok" and was_reassigned:
+            _RECOVERED.inc(path="survivor")
+
+    def _requeue(idx: int) -> None:
+        # failed-member cells go to the FRONT so survivors re-claim the
+        # oldest work first; completion order is irrelevant to results
+        with qlock:
+            reassigned.add(idx)
+            queue.appendleft(idx)
+
+    def _member_loop(member) -> None:
+        remote = member.info.name != cloud.info.name
+        if remote:
+            try:
+                _tasks.submit(cloud, member, "search_init", ctx_payload,
+                              timeout=timeout)
+            except _rpc.RPCError as e:
+                log.warning("search %s: member %s init failed: %s",
+                            search_id, member.info.name, e)
+                return
+        while not _expired():
+            idx = _take()
+            if idx is None:
+                return
+            try:
+                if remote:
+                    out = _tasks.submit(cloud, member, "search_cell",
+                                        _cell_payload(idx), timeout=timeout)
+                else:
+                    out = _execute_cell(_cell_payload(idx), cloud)
+            except _rpc.RemoteError as e:
+                if e.code == CELL_BUILD_FAILED:
+                    # deterministic model failure: retrying elsewhere
+                    # would fail identically — record it like the
+                    # single-node path does
+                    _settle(idx, ("error", str(e)))
+                    continue
+                log.warning("search %s: member %s lost cell %d: %s",
+                            search_id, member.info.name, idx, e)
+                _requeue(idx)
+                return  # member refused/unreachable: stop feeding it
+            except _rpc.RPCError as e:
+                log.warning("search %s: member %s lost cell %d: %s",
+                            search_id, member.info.name, idx, e)
+                _requeue(idx)
+                return
+            except Exception as e:  # caller-local build failure
+                _settle(idx, ("error", f"{type(e).__name__}: {e}"))
+                continue
+            _settle(idx, ("ok", out))
+
+    threads = []
+    inflight = _inflight_per_member()
+    with telemetry.Span("search_fanout", members=len(workers), cells=total):
+        for member in workers:
+            lanes = inflight if member.info.name != cloud.info.name else 1
+            for _ in range(lanes):
+                t = threading.Thread(
+                    target=_member_loop, args=(member,), daemon=True,
+                    name=f"search-{member.info.name}")
+                threads.append(t)
+                t.start()
+        for t in threads:
+            t.join()
+        # last resort: every member gone (or none ever viable) — the
+        # caller absorbs the remainder so the search still completes
+        while not _expired():
+            idx = _take()
+            if idx is None:
+                break
+            try:
+                out = _execute_cell(_cell_payload(idx), cloud)
+            except Exception as e:
+                _settle(idx, ("error", f"{type(e).__name__}: {e}"))
+                continue
+            with qlock:
+                results[idx] = ("ok", out)
+            _RECOVERED.inc(path="local")
+        # drop member-side caches eagerly; the LRU would get there anyway
+        for member in workers:
+            if member.info.name == cloud.info.name or not member.healthy:
+                continue
+            try:
+                _tasks.submit(cloud, member, "search_end",
+                              {"search_id": search_id}, timeout=5.0)
+            except _rpc.RPCError:
+                pass
+    _ctx_drop(search_id)
+    return results
+
+
+# ---------------------------------------------------------------------------
+# the grid driver's distributed path
+
+
+def distributed_grid_search(
+    gs,
+    grid,
+    frame,
+    valid,
+    cloud: Cloud,
+    rec=None,
+    job=None,
+    scores: Optional[List[float]] = None,
+    init_larger: bool = True,
+    consumed=None,
+):
+    """Execute a GridSearch's walk across the cloud.
+
+    Dispatch happens in rounds: each round materializes the next
+    still-needed cells from the canonical walker (all of them, or
+    ``max_models - built`` when capped), fans them out, then RECORDS the
+    results in canonical walk order under exactly the single-node budget
+    and early-stopping predicates — so the recorded model sequence, the
+    scores it implies, and the stopping decision are bit-identical to
+    the single-node run at a fixed seed.  A failed cell consumes a walk
+    position (like single-node) and the next round draws replacements.
+    """
+    import time as _time
+
+    scores = [] if scores is None else scores
+    c = gs.criteria
+    t0 = _time.time()
+    deadline = (t0 + c.max_runtime_secs) if c.max_runtime_secs else None
+    walker = gs._walk(consumed)
+    direction = {"larger": init_larger}
+    search_id = grid.grid_id
+    _clear_progress(search_id)
+    stopped = False
+
+    def _budget_full() -> bool:
+        return bool(c.max_models) and len(grid.models) >= c.max_models
+
+    while not stopped and not _budget_full():
+        if deadline is not None and _time.time() >= deadline:
+            break
+        if job is not None and job.stop_requested:
+            break
+        want = (c.max_models - len(grid.models)) if c.max_models else None
+        batch: List[Dict[str, Any]] = []
+        for hp in walker:
+            batch.append(hp)
+            if want is not None and len(batch) >= want:
+                break
+        if not batch:
+            break
+        cells = [
+            {
+                "index": i,
+                "builder_cls": gs.builder_cls,
+                "params": gs._cell_params(hp),
+                "hp": hp,
+            }
+            for i, hp in enumerate(batch)
+        ]
+        results = fan_out(
+            cloud, frame, valid, cells, search_id=search_id, job=job,
+            stopping_metric=c.stopping_metric, deadline=deadline)
+        # canonical-order recording: identical predicate sequence to the
+        # single-node loop, so budgets and early stopping cut at exactly
+        # the same cell regardless of completion order
+        for i, hp in enumerate(batch):
+            if _budget_full() or gs._stopped_early(scores, direction):
+                stopped = True
+                break
+            st = results.get(i)
+            if st is None:
+                # cancelled / deadline mid-round: this cell never ran
+                continue
+            kind, val = st
+            if kind == "ok":
+                model = model_from_blob(val["model"])
+                gs._record(grid, hp, model, scores, c, direction)
+                if rec is not None:
+                    rec.on_model(model, info={"hp": hp})
+            else:
+                grid.failures.append((hp, val))
+                if rec is not None:
+                    rec.on_failure({"hp": hp, "error": val})
+
+    grid.runtime_secs = _time.time() - t0
+    return grid

@@ -224,6 +224,27 @@ def _task_mr_chunks(payload: Dict[str, Any], cloud, store) -> Any:
     return _frames.mr_chunks(payload, cloud, store)
 
 
+@register_ctx_task("search_init")
+def _task_search_init(payload: Dict[str, Any], cloud, store) -> Any:
+    from h2o3_tpu.cluster import search as _search
+
+    return _search.search_init(payload, cloud, store)
+
+
+@register_ctx_task("search_cell")
+def _task_search_cell(payload: Dict[str, Any], cloud, store) -> Any:
+    from h2o3_tpu.cluster import search as _search
+
+    return _search.search_cell(payload, cloud, store)
+
+
+@register_ctx_task("search_end")
+def _task_search_end(payload: Dict[str, Any], cloud, store) -> Any:
+    from h2o3_tpu.cluster import search as _search
+
+    return _search.search_end(payload, cloud, store)
+
+
 # ---------------------------------------------------------------------------
 # fan-outs
 

@@ -70,6 +70,9 @@ class Job:
         self.key = DKV.make_key("job")
         self.description = description
         self.progress = 0.0
+        #: live human-readable detail (e.g. distributed search streaming
+        #: "3/12 models across 4 member(s)" via the search_progress RPC)
+        self.progress_msg: Optional[str] = None
         self.status = "CREATED"
         self.start_time: Optional[float] = None
         self.end_time: Optional[float] = None

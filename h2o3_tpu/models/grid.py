@@ -16,6 +16,7 @@ fatal (GridSearch.java's failed-params tracking).
 
 from __future__ import annotations
 
+import hashlib
 import itertools
 import json
 import time
@@ -28,6 +29,25 @@ import numpy as np
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.keyed import DKV
 from h2o3_tpu.models.framework import Model, ModelBuilder
+
+
+def cell_key(hp: Dict[str, Any]) -> str:
+    """Canonical identity of one grid cell: the sorted-JSON hyperparameter
+    combo.  The recovery snapshot's consumed-multiset, the distributed
+    search plane, and per-cell seeding all agree on this encoding."""
+    return json.dumps(hp, sort_keys=True, default=str)
+
+
+def cell_seed(search_seed: Optional[int], key: str) -> Optional[int]:
+    """Per-cell builder seed derived from ``(search_seed, canonical cell
+    key)``.  Position-independent by construction: reordering the walk,
+    fanning cells across cluster members, or resuming a snapshot can
+    never re-seed a cell — the prerequisite for the bit-identical
+    leaderboard contract (cluster/search.py)."""
+    if search_seed is None or search_seed == -1:
+        return None
+    digest = hashlib.md5(f"{int(search_seed)}|{key}".encode()).digest()
+    return int.from_bytes(digest[:8], "big") & 0x7FFFFFFF
 
 
 @dataclass
@@ -216,7 +236,37 @@ class GridSearch:
             if not hasattr(params, k):
                 raise ValueError(f"unknown hyperparameter {k!r} for {builder_cls.__name__}")
 
-    def train(self, frame: Frame, valid: Optional[Frame] = None) -> Grid:
+    # -- determinism: canonical per-cell seeds -------------------------------
+    def _search_seed(self) -> Optional[int]:
+        """The seed the whole search derives per-cell seeds from: the
+        search criteria's seed, else the base params' seed, else None."""
+        if self.criteria.seed not in (-1, None):
+            return int(self.criteria.seed)
+        base = getattr(self.params, "seed", -1)
+        if base not in (-1, None):
+            return int(base)
+        return None
+
+    def _cell_params(self, hp: Dict[str, Any]):
+        """Final builder params for one cell.  When a seed is in play it
+        derives from ``(search_seed, canonical cell key)`` — NOT from the
+        walk position — so dispatch and completion order can never
+        re-seed a cell.  A seed the user put in the hyper grid itself is
+        an explicit per-cell choice and is honored as-is."""
+        p = replace(self.params, **hp)
+        if "seed" in hp or not hasattr(p, "seed"):
+            return p
+        derived = cell_seed(self._search_seed(), cell_key(hp))
+        if derived is None:
+            return p
+        return replace(p, seed=derived)
+
+    def train(
+        self,
+        frame: Frame,
+        valid: Optional[Frame] = None,
+        job=None,
+    ) -> Grid:
         rec = None
         if self.recovery_dir:
             from h2o3_tpu.recovery import Recovery
@@ -235,10 +285,52 @@ class GridSearch:
                 },
                 frames,
             )
-        grid = self._run(Grid(), frame, valid, rec, skip=0, scores=[])
-        if rec is not None:
+        grid = self._execute(Grid(), frame, valid, rec, scores=[], job=job)
+        if rec is not None and not (job is not None and job.stop_requested):
+            # a cancelled recoverable search keeps its snapshot so
+            # auto_recover can finish it without retraining done cells
             rec.on_done()
         return grid
+
+    def _execute(
+        self,
+        grid: Grid,
+        frame: Frame,
+        valid: Optional[Frame],
+        rec,
+        scores: List[float],
+        init_larger: bool = True,
+        consumed: Optional[List[Dict[str, Any]]] = None,
+        job=None,
+    ) -> Grid:
+        """Run the walk locally, or fan cells across the cloud when a
+        multi-member cloud is live (cluster/search.py) — same recorded
+        model sequence either way."""
+        cloud = None
+        try:
+            from h2o3_tpu.cluster import search as _search
+
+            cloud = _search.search_cloud()
+        except Exception:
+            cloud = None
+        if cloud is not None:
+            from h2o3_tpu.cluster.search import distributed_grid_search
+
+            return distributed_grid_search(
+                self, grid, frame, valid, cloud, rec=rec, job=job,
+                scores=scores, init_larger=init_larger, consumed=consumed)
+        return self._run(
+            grid, frame, valid, rec, scores=scores,
+            init_larger=init_larger, consumed=consumed, job=job)
+
+    def n_cells_hint(self) -> int:
+        """Planned cell count (for progress fractions): the hyper product
+        capped by max_models.  Early stopping can finish under it."""
+        sizes = [len(v) for v in self.hyper_params.values()]
+        total = int(np.prod(sizes)) if sizes else 0
+        if self.criteria.max_models:
+            total = min(total, self.criteria.max_models)
+        return total
 
     @staticmethod
     def _resume(rec, state, frames, models) -> Grid:
@@ -274,13 +366,58 @@ class GridSearch:
             grid.failures.append((f_.get("hp", {}), f_.get("error", "?")))
             # failed combos consumed walker positions too
             consumed.append(f_.get("hp", {}))
-        grid = gs._run(
+        grid = gs._execute(
             grid, frames["train"], frames.get("valid"), rec,
             consumed=consumed, scores=scores,
             init_larger=larger,
         )
         rec.on_done()
         return grid
+
+    def _walk(self, consumed: Optional[List[Dict[str, Any]]] = None):
+        """The canonical cell walk: strategy order, minus each combo a
+        resume snapshot already consumed (multiset semantics, by value —
+        positional skipping misaligns when a snapshot file vanished)."""
+        c = self.criteria
+        if c.strategy.lower() == "cartesian":
+            walker = _cartesian(self.hyper_params)
+        elif c.strategy.lower() in ("randomdiscrete", "random_discrete"):
+            walker = _random_discrete(self.hyper_params, c.seed)
+        else:
+            raise ValueError(f"unknown strategy {c.strategy!r}")
+        if consumed:
+            from collections import Counter
+
+            budget = Counter(cell_key(hp) for hp in consumed)
+
+            def _filtered(inner):
+                for hp in inner:
+                    k = cell_key(hp)
+                    if budget.get(k):
+                        budget[k] -= 1
+                        continue
+                    yield hp
+
+            walker = _filtered(walker)
+        return walker
+
+    def _stopped_early(self, scores: List[float], direction) -> bool:
+        """ScoreKeeper.stopEarly over the finished-model metric sequence:
+        stop when the best of the last `stopping_rounds` models does not
+        improve on the best before them by stopping_tolerance (relative).
+        Shared verbatim by the local loop and the distributed recorder so
+        both cut the walk at exactly the same cell."""
+        c = self.criteria
+        k = c.stopping_rounds
+        if not k or len(scores) < 2 * k:
+            return False
+        arr = np.array(scores, dtype=np.float64)
+        if not direction["larger"]:
+            arr = -arr
+        recent = np.max(arr[-k:])
+        before = np.max(arr[:-k])
+        improvement = (recent - before) / max(abs(before), 1e-12)
+        return improvement < c.stopping_tolerance
 
     def _run(
         self,
@@ -292,47 +429,22 @@ class GridSearch:
         scores: List[float] = None,
         init_larger: bool = True,
         consumed: Optional[List[Dict[str, Any]]] = None,
+        job=None,
     ) -> Grid:
         scores = [] if scores is None else scores
         c = self.criteria
         t0 = time.time()
-        if c.strategy.lower() == "cartesian":
-            walker = _cartesian(self.hyper_params)
-        elif c.strategy.lower() in ("randomdiscrete", "random_discrete"):
-            walker = _random_discrete(self.hyper_params, c.seed)
-        else:
-            raise ValueError(f"unknown strategy {c.strategy!r}")
+        walker = self._walk(consumed)
         if skip:
             walker = itertools.islice(walker, skip, None)
-        if consumed:
-            # resume: skip each already-consumed combo ONCE, by value —
-            # positional skipping misaligns when a snapshot file vanished
-            # (that combo must be retrained). Multiset semantics so a
-            # random walk that repeats a combo isn't over-skipped.
-            from collections import Counter
-
-            def _hpkey(hp: Dict[str, Any]) -> str:
-                return json.dumps(hp, sort_keys=True, default=str)
-
-            budget = Counter(_hpkey(hp) for hp in consumed)
-
-            def _filtered(inner):
-                for hp in inner:
-                    k = _hpkey(hp)
-                    if budget.get(k):
-                        budget[k] -= 1
-                        continue
-                    yield hp
-
-            walker = _filtered(walker)
         # metric direction comes from the first finished model (set in
         # _record); on resume the preloaded scores arrive with their
         # recovered direction so early stopping never compares inverted
         direction = {"larger": init_larger}
+        n_hint = self.n_cells_hint()
 
         def build_one(hp: Dict[str, Any]):
-            p = replace(self.params, **hp)
-            return self.builder_cls(p).train(frame, valid)
+            return self.builder_cls(self._cell_params(hp)).train(frame, valid)
 
         def out_of_budget() -> bool:
             if c.max_models and len(grid.models) >= c.max_models:
@@ -342,25 +454,18 @@ class GridSearch:
             return False
 
         def stopped_early() -> bool:
-            """ScoreKeeper.stopEarly over the finished-model metric sequence:
-            stop when the best of the last `stopping_rounds` models does not
-            improve on the best before them by stopping_tolerance (relative)."""
-            k = c.stopping_rounds
-            if not k or len(scores) < 2 * k:
-                return False
-            arr = np.array(scores, dtype=np.float64)
-            if not direction["larger"]:
-                arr = -arr
-            recent = np.max(arr[-k:])
-            before = np.max(arr[:-k])
-            improvement = (recent - before) / max(abs(before), 1e-12)
-            return improvement < c.stopping_tolerance
+            return self._stopped_early(scores, direction)
 
         if self.parallelism == 1:
             for hp in walker:
                 if out_of_budget() or stopped_early():
                     break
+                if job is not None and job.stop_requested:
+                    break
                 self._build_into(grid, hp, build_one, scores, c, direction, rec=rec)
+                if job is not None and n_hint:
+                    job.update(
+                        (len(grid.models) + len(grid.failures)) / n_hint)
         else:
             with ThreadPoolExecutor(max_workers=self.parallelism) as pool:
                 pending = []

@@ -168,9 +168,8 @@ class _Decoder:
 # public API
 
 
-def save_model(model, path: Union[str, os.PathLike]) -> str:
-    """Serialize a trained model (any algo) to ``path``. Returns the path."""
-    path = os.fspath(path)
+def _write_archive(dest, model) -> None:
+    """Write the zip(JSON tree + npz) container to a path or file object."""
     enc = _Encoder()
     tree = enc.enc(model)
     meta = {
@@ -180,11 +179,53 @@ def save_model(model, path: Union[str, os.PathLike]) -> str:
     }
     buf = io.BytesIO()
     np.savez_compressed(buf, **enc.arrays)
-    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+    with zipfile.ZipFile(dest, "w", zipfile.ZIP_DEFLATED) as z:
         z.writestr("meta.json", json.dumps(meta))
         z.writestr("model.json", json.dumps(tree))
         z.writestr("arrays.npz", buf.getvalue())
+
+
+def _read_archive(src):
+    """Decode a container written by :func:`_write_archive`."""
+    with zipfile.ZipFile(src, "r") as z:
+        meta = json.loads(z.read("meta.json"))
+        if meta.get("version", 0) > FORMAT_VERSION:
+            raise ValueError(f"model file version {meta['version']} too new")
+        tree = json.loads(z.read("model.json"))
+        arrays = np.load(io.BytesIO(z.read("arrays.npz")), allow_pickle=False)
+        return _Decoder(arrays).dec(tree)
+
+
+def save_model(model, path: Union[str, os.PathLike]) -> str:
+    """Serialize a trained model (any algo) to ``path``. Returns the path."""
+    path = os.fspath(path)
+    _write_archive(path, model)
     return path
+
+
+def dumps_model(model) -> bytes:
+    """The :func:`save_model` container as bytes — the wire form a
+    distributed-search member ships a finished cell's model back in."""
+    buf = io.BytesIO()
+    _write_archive(buf, model)
+    return buf.getvalue()
+
+
+def loads_model(data: bytes, key: Optional[str] = None, register: bool = False):
+    """Decode a :func:`dumps_model` blob.  ``register=False`` by default:
+    the receiving side (cluster/search.py) must collision-check keys
+    minted in another node's process before the model joins the DKV."""
+    model = _read_archive(io.BytesIO(data))
+    if not register:
+        return model
+    from h2o3_tpu.keyed import DKV
+
+    if key:
+        model.key = key
+        DKV.put(key, model)
+    elif getattr(model, "key", None):
+        DKV.put(model.key, model)
+    return model
 
 
 def load_model(
@@ -201,13 +242,7 @@ def load_model(
     from h2o3_tpu.keyed import DKV
 
     path = os.fspath(path)
-    with zipfile.ZipFile(path, "r") as z:
-        meta = json.loads(z.read("meta.json"))
-        if meta.get("version", 0) > FORMAT_VERSION:
-            raise ValueError(f"model file version {meta['version']} too new")
-        tree = json.loads(z.read("model.json"))
-        arrays = np.load(io.BytesIO(z.read("arrays.npz")), allow_pickle=False)
-        model = _Decoder(arrays).dec(tree)
+    model = _read_archive(path)
     if not register:
         return model
     if key:

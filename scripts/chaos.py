@@ -504,6 +504,166 @@ def s_kill_chunk_home(seed: int) -> Dict[str, bool]:
     return v
 
 
+@scenario("kill_search_member")
+def s_kill_search_member(seed: int) -> Dict[str, bool]:
+    """Distributed grid search through a member's death, then a
+    cancel -> ``auto_recover`` resume drill.  Phase 1: a 6-cell GLM
+    grid fans out over a 3-node cloud; a fault rule lets the victim
+    train exactly ONE cell then refuse every later ``search_cell``
+    (``after: 1``), and the nemesis stops it mid-search.  Invariants:
+    the search completes with the full model count, the leaderboard is
+    bit-identical to the single-node baseline in canonical walk order,
+    survivors re-claimed the victim's cells (``path=survivor``
+    metered), and the global cell meter moved by exactly the cell
+    count — no cell trained twice (dropped dispatches 503 BEFORE the
+    handler, so the victim never half-trains).  Phase 2: the same grid
+    with ``recovery_dir`` is cancelled via its Job once >=2 cells
+    stream ``done`` progress; the snapshot must survive the cancel and
+    ``auto_recover`` must finish the grid WITHOUT retraining finished
+    cells (total cells across cancel+resume == 6), hp-sorted rows
+    bit-identical to the baseline (resume inserts snapshot models
+    first, so canonical-order comparison does not apply)."""
+    from h2o3_tpu.cluster import faults
+    from h2o3_tpu.cluster.membership import set_local_cloud
+    from h2o3_tpu.frame.frame import ColType, Column, Frame
+    from h2o3_tpu.models.framework import Job
+    from h2o3_tpu.models.glm import GLM, GLMParameters
+    from h2o3_tpu.models.grid import GridSearch, cell_key, metric_value
+    from h2o3_tpu.recovery import auto_recover
+
+    rng = np.random.default_rng(seed)
+    n = 400
+    X = rng.normal(size=(n, 3))
+    logit = X @ np.array([1.0, -2.0, 0.5])
+    y = (rng.random(n) < 1.0 / (1.0 + np.exp(-logit))).astype(np.float64)
+    cols = [Column(f"x{i}", X[:, i]) for i in range(3)]
+    cols.append(Column("y", y, ColType.CAT, ["n", "p"]))
+    fr = Frame(cols)
+
+    # nonzero lambdas: at lambda_=0 alpha is inert, metrics tie, and the
+    # leaderboard sort order would depend on insertion order
+    hyper = {"alpha": [0.0, 0.5, 1.0], "lambda_": [0.01, 0.1]}
+    n_cells = 6
+
+    def _gs(rec_dir=None):
+        return GridSearch(
+            GLM,
+            GLMParameters(response_column="y", family="binomial",
+                          seed=7, nfolds=2),
+            hyper, recovery_dir=rec_dir)
+
+    def _rows(grid):
+        return [(cell_key(hp), metric_value(m, "auto")[0])
+                for hp, m in zip(grid.hyper_params, grid.models)]
+
+    # single-node baseline BEFORE any cloud exists (no local cloud set,
+    # so the walk runs in-process): the bit-identity reference
+    base = _rows(_gs().train(fr))
+
+    clouds, stores, formed = _mini_cloud(3, hb=0.05, prefix="ks")
+    a = clouds[0]
+    victim = clouds[1]
+    v: Dict[str, bool] = {"formed": formed}
+    set_local_cloud(a)
+    try:
+        # -- phase 1: victim trains one cell, refuses the rest, dies --
+        plan = faults.plan_from_dict({"seed": seed, "rules": [
+            {"action": "drop", "side": "server",
+             "src": victim.info.name, "method": "dtask:search_cell",
+             "after": 1},
+        ]})
+        faults.set_plan(plan)
+        cells0 = _counter_value("cluster_search_cells_total")
+        surv0 = _counter_value("cluster_search_recovered_total",
+                               path="survivor")
+        box: Dict[str, Any] = {}
+
+        def _train():
+            try:
+                box["grid"] = _gs().train(fr)
+            except Exception as e:  # invariant failure, not a crash
+                box["err"] = e
+
+        th = threading.Thread(target=_train, daemon=True)
+        th.start()
+        time.sleep(0.5)
+        victim.stop()
+        th.join(timeout=180.0)
+        faults.clear_plan()
+
+        v["search_completed"] = "grid" in box
+        grid1 = box.get("grid")
+        v["full_model_count"] = (grid1 is not None
+                                 and len(grid1.models) == n_cells)
+        # distributed recording is canonical walk order: compare directly
+        v["leaderboard_bit_identical"] = (grid1 is not None
+                                          and _rows(grid1) == base)
+        v["refusal_injected"] = plan.hits()[0] > 0
+        v["survivor_recovered"] = _counter_value(
+            "cluster_search_recovered_total", path="survivor") > surv0
+        # in-process clouds share one meter: exactly n_cells training
+        # runs happened ANYWHERE — the victim's dropped dispatches were
+        # refused before the handler, never half-trained
+        v["no_cell_trained_twice"] = (
+            _counter_value("cluster_search_cells_total") - cells0
+            == float(n_cells))
+
+        # survivors must notice the death before the resume drill so
+        # phase 2 never dispatches into the corpse
+        v["death_detected"] = _wait(
+            lambda: all(c.size() == 2 for c in clouds
+                        if c.info.name != victim.info.name), 15.0)
+
+        # -- phase 2: cancel mid-search, resume from the snapshot ------
+        tmp = tempfile.mkdtemp(prefix="chaos-search-")
+        rec_dir = os.path.join(tmp, "rec")
+        meta_path = os.path.join(rec_dir, "recovery.json")
+        cells1 = _counter_value("cluster_search_cells_total")
+        done0 = _counter_value("cluster_search_progress_total",
+                               status="done")
+        job = Job("chaos distributed search").start()
+        watcher_saw = {"two_done": False}
+
+        def _watch():
+            if _wait(lambda: _counter_value(
+                    "cluster_search_progress_total",
+                    status="done") - done0 >= 2.0, 120.0):
+                watcher_saw["two_done"] = True
+                job.cancel()
+
+        wth = threading.Thread(target=_watch, daemon=True)
+        wth.start()
+        grid2 = _gs(rec_dir=rec_dir).train(fr, job=job)
+        wth.join(timeout=130.0)
+        v["cancel_landed"] = watcher_saw["two_done"]
+
+        partial = len(grid2.models) + len(grid2.failures)
+        # the cancel races completion: when it interrupted the search
+        # the snapshot MUST survive; when every cell finished first the
+        # snapshot was legitimately cleaned and there is nothing to test
+        interrupted = partial < n_cells
+        v["snapshot_kept_when_partial"] = (
+            os.path.exists(meta_path) if interrupted else True)
+        if os.path.exists(meta_path):
+            grid3 = auto_recover(rec_dir)
+        else:
+            grid3 = grid2
+        v["resumed_complete"] = (grid3 is not None
+                                 and len(grid3.models) == n_cells)
+        # resume inserts snapshot models before walk-order ones, so
+        # compare hp-sorted rows (floats still bit-exact)
+        v["resume_rows_bit_identical"] = (
+            grid3 is not None and sorted(_rows(grid3)) == sorted(base))
+        # cancel + resume together trained each cell exactly once
+        v["no_retrain_after_resume"] = (
+            _counter_value("cluster_search_cells_total") - cells1
+            == float(n_cells))
+    finally:
+        set_local_cloud(None)
+        _teardown(clouds)
+    return v
+
+
 # ---------------------------------------------------------------------------
 # slow scenarios (real child processes, SIGKILL nemesis)
 

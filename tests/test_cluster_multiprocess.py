@@ -675,3 +675,142 @@ class TestSigkillChunkHome:
             for p in peers.values():
                 p.kill()
             w0.kill()
+
+
+def _write_search_worker(tmp):
+    """worker0: forms a 3-node cloud, runs the single-node baseline grid
+    BEFORE becoming the local cloud (so it walks in-process), scripts a
+    server-side delay onto the victim's ``search_cell`` dtask through
+    the nemesis RPC surface, then fans the same grid across the cloud
+    while the harness SIGKILLs the victim mid-cell.  Asserts the
+    distributed leaderboard is bit-identical to the baseline in
+    canonical walk order, that survivors re-claimed the victim's cells
+    (``path=survivor`` metered caller-side), that progress streamed
+    from at least two members, and that membership reconverges."""
+    script = f"""
+import sys, time
+sys.path.insert(0, {REPO!r})
+import numpy as np
+from h2o3_tpu.cluster import dkv as cdkv
+from h2o3_tpu.cluster import tasks as ctasks
+from h2o3_tpu.cluster.membership import Cloud, set_local_cloud
+from h2o3_tpu.keyed import KeyedStore
+from h2o3_tpu.models.glm import GLM, GLMParameters
+from h2o3_tpu.models.grid import GridSearch, cell_key, metric_value
+from h2o3_tpu.util import telemetry
+
+cloud = Cloud("searchkill", "w0", hb_interval=0.2)
+cdkv.install(cloud, KeyedStore())
+ctasks.install(cloud)
+import os
+with open({tmp!r} + "/w0.addr.tmp", "w") as f:
+    f.write(f"{{cloud.info.host}}:{{cloud.info.port}}\\n")
+os.replace({tmp!r} + "/w0.addr.tmp", {tmp!r} + "/w0.addr")
+cloud.start([])
+deadline = time.monotonic() + 90
+while time.monotonic() < deadline:
+    if cloud.size() == 3 and cloud.consensus():
+        break
+    time.sleep(0.05)
+assert cloud.size() == 3, f"cloud never formed: {{cloud.size()}}"
+
+rng = np.random.default_rng(11)
+n = 400
+X = rng.normal(size=(n, 3))
+logit = X @ np.array([1.0, -2.0, 0.5])
+y = (rng.random(n) < 1.0 / (1.0 + np.exp(-logit))).astype(np.float64)
+from h2o3_tpu.frame.frame import ColType, Column, Frame
+cols = [Column(f"x{{i}}", X[:, i]) for i in range(3)]
+cols.append(Column("y", y, ColType.CAT, ["n", "p"]))
+fr = Frame(cols)
+
+def gs():
+    return GridSearch(
+        GLM,
+        GLMParameters(response_column="y", family="binomial",
+                      seed=7, nfolds=2),
+        {{"alpha": [0.0, 0.5, 1.0], "lambda_": [0.01, 0.1]}})
+
+def rows(grid):
+    return [(cell_key(hp), metric_value(m, "auto")[0])
+            for hp, m in zip(grid.hyper_params, grid.models)]
+
+# baseline walks in-process: no local cloud is set yet
+base = rows(gs().train(fr))
+assert len(base) == 6
+
+victim = next(m for m in cloud.members_sorted() if m.info.name == "w2")
+# nemesis: the victim sits on each search_cell long enough for the
+# harness's SIGKILL (fired on "SEARCH START") to land mid-cell
+out = cloud.client.call(victim.info.addr, "fault_plan_set", {{
+    "seed": 7, "rules": [{{"action": "delay", "side": "server",
+                           "method": "dtask:search_cell",
+                           "delay_ms": 2500}}]}})
+assert out["installed"], out
+
+set_local_cloud(cloud)
+print("SEARCH START", flush=True)
+grid = gs().train(fr)
+set_local_cloud(None)
+assert len(grid.models) == 6, grid
+assert rows(grid) == base, (rows(grid), base)
+
+rec = telemetry.REGISTRY.get("cluster_search_recovered_total")
+assert rec is not None and rec.value(path="survivor") >= 1, (
+    rec and rec.value(path="survivor"))
+from h2o3_tpu.cluster.search import search_progress
+prog = search_progress(grid.grid_id)
+assert prog is not None and prog["done"] == 6, prog
+assert len(prog["by_member"]) >= 2, prog
+
+deadline = time.monotonic() + 60
+while time.monotonic() < deadline:
+    if cloud.size() == 2:
+        break
+    time.sleep(0.05)
+assert cloud.size() == 2, f"victim never removed: {{cloud.size()}}"
+cloud.stop()
+print("W0 OK", flush=True)
+"""
+    path = os.path.join(tmp, "worker0_search.py")
+    with open(path, "w") as f:
+        f.write(script)
+    return path
+
+
+@pytest.mark.slow
+class TestSigkillSearchMember:
+    """SIGKILL a member while it owns in-flight grid cells: survivors
+    re-claim them and the leaderboard stays bit-identical to the
+    single-node walk."""
+
+    def test_sigkill_mid_grid_search(self, tmp_path):
+        tmp = str(tmp_path)
+        env = _env()
+        env["H2O3_TPU_FAULTS"] = "1"  # nemesis RPC surface on every node
+        env["JAX_PLATFORMS"] = "cpu"
+        w0 = _Proc([sys.executable, _write_search_worker(tmp)],
+                   cwd=tmp, env=env)
+        peers = {}
+        try:
+            addr0 = _wait_file(os.path.join(tmp, "w0.addr"))
+            flat = os.path.join(tmp, "flat")
+            with open(flat, "w") as f:
+                f.write(addr0 + "\n")
+            for name in ("w1", "w2"):
+                peers[name] = _Proc(
+                    [sys.executable, "-m", "h2o3_tpu.cluster.nodeproc",
+                     "--cluster-name", "searchkill", "--node-name", name,
+                     "--flatfile", flat, "--hb-interval", "0.2"],
+                    cwd=tmp, env=env)
+            w0.wait_for_line("SEARCH START", timeout=240)
+            # the victim's injected 2.5s search_cell delay is still
+            # ticking: this SIGKILL lands while it owns in-flight cells
+            time.sleep(0.8)
+            peers["w2"].kill(signal.SIGKILL)
+            w0.wait_for_line("W0 OK", timeout=240)
+            assert w0.proc.wait(timeout=30) == 0
+        finally:
+            for p in peers.values():
+                p.kill()
+            w0.kill()

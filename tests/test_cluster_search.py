@@ -1,0 +1,263 @@
+"""Distributed model search (h2o3_tpu/cluster/search.py) on in-process
+clouds: grid fan-out must be BIT-IDENTICAL to the single-node walk at a
+fixed seed regardless of member count or completion order, progress must
+stream back into the caller's Job, and the per-cell seed contract
+(derived from the canonical cell key, never the draw position) must hold.
+
+Reference analogues: hex/grid/GridSearch.java (the walk), water/Job.java
+(progress), hex/faulttolerance/Recovery.java (resume without retraining).
+
+The member-death and cancel->resume drills live in scripts/chaos.py
+(``kill_search_member``) and the multiprocess SIGKILL tier."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.cluster import dkv as cdkv
+from h2o3_tpu.cluster import tasks as ctasks
+from h2o3_tpu.cluster.membership import Cloud, set_local_cloud
+from h2o3_tpu.frame.frame import ColType, Column, Frame
+from h2o3_tpu.keyed import KeyedStore
+from h2o3_tpu.models.framework import Job
+from h2o3_tpu.models.glm import GLM, GLMParameters
+from h2o3_tpu.models.grid import (
+    GridSearch,
+    SearchCriteria,
+    _random_discrete,
+    cell_key,
+    cell_seed,
+    metric_value,
+)
+
+pytestmark = pytest.mark.leaks_keys
+
+
+def _wait_for(cond, timeout=10.0, every=0.02, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(every)
+    pytest.fail(f"timed out after {timeout}s waiting for {msg}")
+
+
+def _frame(seed=0, n=400):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 3))
+    logit = X @ np.array([1.0, -2.0, 0.5])
+    y = (rng.random(n) < 1.0 / (1.0 + np.exp(-logit))).astype(np.float64)
+    cols = [Column(f"x{i}", X[:, i]) for i in range(3)]
+    cols.append(Column("y", y, ColType.CAT, ["n", "p"]))
+    return Frame(cols)
+
+
+def _rows(grid):
+    """(canonical hp key, metric) per model, walk order — the bit-exact
+    leaderboard signature (model keys are uuid-fresh, so not compared)."""
+    return [(cell_key(hp), metric_value(m, "auto")[0])
+            for hp, m in zip(grid.hyper_params, grid.models)]
+
+
+def _counter(name, **labels):
+    from h2o3_tpu.util import telemetry
+
+    c = telemetry.REGISTRY.get(name)
+    if c is None:
+        return 0.0
+    return c.value(**labels) if labels else c.total()
+
+
+@pytest.fixture()
+def three_clouds():
+    """A formed 3-node cloud with DKV + DTask planes installed; node 0
+    is the process-local (caller) cloud for the duration."""
+    clouds = []
+    for i in range(3):
+        c = Cloud("searchcloud", f"sn{i}", hb_interval=0.05)
+        s = KeyedStore()
+        cdkv.install(c, s)
+        ctasks.install(c)
+        clouds.append(c)
+    seeds = [c.info.addr for c in clouds]
+    try:
+        for c in clouds:
+            c.start([a for a in seeds if a != c.info.addr])
+        _wait_for(lambda: all(c.size() == 3 for c in clouds),
+                  msg="3-node cloud formation")
+        set_local_cloud(clouds[0])
+        yield clouds
+    finally:
+        set_local_cloud(None)
+        for c in clouds:
+            try:
+                c.stop()
+            except Exception:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# determinism contract: canonical cell keys and derived seeds
+
+
+class TestCellSeeds:
+    def test_cell_key_canonical(self):
+        # key order in the dict never changes the canonical key
+        assert (cell_key({"alpha": 0.5, "lambda_": 0.01})
+                == cell_key({"lambda_": 0.01, "alpha": 0.5}))
+        assert (cell_key({"alpha": 0.5})
+                != cell_key({"alpha": 1.0}))
+
+    def test_cell_seed_position_independent(self):
+        hps = [{"alpha": a, "lambda_": l}
+               for a in (0.0, 0.5, 1.0) for l in (0.0, 0.01)]
+        seeds_fwd = [cell_seed(7, cell_key(hp)) for hp in hps]
+        seeds_rev = [cell_seed(7, cell_key(hp)) for hp in reversed(hps)]
+        assert seeds_fwd == list(reversed(seeds_rev))
+        # distinct cells get distinct seeds; unseeded search derives none
+        assert len(set(seeds_fwd)) == len(hps)
+        assert cell_seed(None, cell_key(hps[0])) is None
+        assert cell_seed(-1, cell_key(hps[0])) is None
+
+    def test_cell_params_derive_from_key_not_draw_order(self):
+        gs = GridSearch(
+            GLM,
+            GLMParameters(response_column="y", family="binomial", seed=7),
+            {"alpha": [0.0, 0.5], "lambda_": [0.0, 0.01]})
+        hps = list(gs._walk())
+        fwd = {cell_key(hp): gs._cell_params(hp).seed for hp in hps}
+        rev = {cell_key(hp): gs._cell_params(hp).seed
+               for hp in reversed(hps)}
+        assert fwd == rev
+        assert all(s not in (-1, None) for s in fwd.values())
+
+    def test_explicit_seed_in_hyper_grid_honored(self):
+        gs = GridSearch(
+            GLM,
+            GLMParameters(response_column="y", family="binomial", seed=7),
+            {"seed": [11, 22]})
+        assert gs._cell_params({"seed": 11}).seed == 11
+        assert gs._cell_params({"seed": 22}).seed == 22
+
+    def test_random_discrete_walk_sequence_unchanged(self):
+        """Regression pin: keying per-cell seeds on the canonical hp key
+        must NOT have perturbed the seeded walk itself — the combo
+        sequence for a fixed seed is part of the resume contract."""
+        hyper = {"alpha": [0.0, 0.5, 1.0], "lambda_": [0.0, 0.01]}
+        got = list(_random_discrete(hyper, 123))
+        assert got == [
+            {"alpha": 0.0, "lambda_": 0.0},
+            {"alpha": 0.5, "lambda_": 0.01},
+            {"alpha": 0.0, "lambda_": 0.01},
+            {"alpha": 1.0, "lambda_": 0.01},
+            {"alpha": 0.5, "lambda_": 0.0},
+            {"alpha": 1.0, "lambda_": 0.0},
+        ]
+        # sampling without replacement covers the whole product space
+        assert len({cell_key(hp) for hp in got}) == 6
+
+
+# ---------------------------------------------------------------------------
+# wire format: frames out once, model blobs back
+
+
+class TestWireFormat:
+    def test_frame_payload_roundtrip(self):
+        from h2o3_tpu.cluster.search import frame_payload, frame_restore
+
+        fr = _frame(3, n=50)
+        fr2 = frame_restore(frame_payload(fr))
+        assert fr2.names == fr.names
+        for nm in fr.names:
+            a, b = fr.col(nm), fr2.col(nm)
+            assert a.type == b.type and a.domain == b.domain
+            assert np.array_equal(np.asarray(a.data), np.asarray(b.data))
+
+    def test_model_blob_roundtrip(self):
+        from h2o3_tpu.cluster.search import model_from_blob, model_to_blob
+
+        fr = _frame(4, n=120)
+        m = GLM(GLMParameters(
+            response_column="y", family="binomial", seed=5)).train(fr)
+        m2 = model_from_blob(model_to_blob(m))
+        p1 = m.predict(fr).col("pp").numeric_view()
+        p2 = m2.predict(fr).col("pp").numeric_view()
+        assert np.array_equal(p1, p2)
+
+
+# ---------------------------------------------------------------------------
+# the fan-out itself (in-process clouds, real sockets)
+
+
+class TestDistributedGrid:
+    HYPER = {"alpha": [0.0, 0.5, 1.0], "lambda_": [0.01, 0.1]}
+
+    def _gs(self, criteria=None):
+        return GridSearch(
+            GLM,
+            GLMParameters(response_column="y", family="binomial",
+                          seed=7, nfolds=2),
+            self.HYPER, search_criteria=criteria)
+
+    def test_search_cloud_gates(self, three_clouds):
+        from h2o3_tpu.cluster.search import search_cloud
+
+        assert search_cloud() is three_clouds[0]
+        os.environ["H2O3_TPU_SEARCH_DIST"] = "0"
+        try:
+            assert search_cloud() is None
+        finally:
+            os.environ.pop("H2O3_TPU_SEARCH_DIST", None)
+
+    def test_bit_identical_to_single_node(self, three_clouds):
+        fr = _frame(0)
+        os.environ["H2O3_TPU_SEARCH_DIST"] = "0"
+        try:
+            base = _rows(self._gs().train(fr))
+        finally:
+            os.environ.pop("H2O3_TPU_SEARCH_DIST", None)
+
+        cells0 = _counter("cluster_search_cells_total")
+        done0 = _counter("cluster_search_progress_total", status="done")
+        job = Job("dist grid").start()
+        grid = self._gs().train(fr, job=job)
+
+        assert len(grid.models) == 6 and not grid.failures
+        assert _rows(grid) == base  # bit-identical, canonical walk order
+        # every cell trained exactly once, somewhere in the cloud
+        # (in-process clouds share one telemetry registry)
+        assert _counter("cluster_search_cells_total") - cells0 == 6.0
+        # per-model completion streamed back over search_progress
+        assert (_counter("cluster_search_progress_total", status="done")
+                - done0 == 6.0)
+        assert job.progress == 1.0
+        assert job.progress_msg is not None
+        assert "6/6" in job.progress_msg
+
+    def test_progress_accessor_live_and_job_updates(self, three_clouds):
+        from h2o3_tpu.cluster.search import search_progress
+
+        fr = _frame(1)
+        job = Job("dist grid progress").start()
+        grid = self._gs().train(fr, job=job)
+        prog = search_progress(grid.grid_id)
+        assert prog is not None
+        assert prog["done"] == prog["total"] == 6
+        assert prog["errors"] == 0
+        # cells really spread: more than one member reported completions
+        assert len(prog["by_member"]) >= 2
+
+    def test_random_discrete_distributed_matches_local(self, three_clouds):
+        fr = _frame(2)
+        crit = SearchCriteria(strategy="RandomDiscrete", seed=123,
+                              max_models=4)
+        os.environ["H2O3_TPU_SEARCH_DIST"] = "0"
+        try:
+            base = _rows(self._gs(crit).train(fr))
+        finally:
+            os.environ.pop("H2O3_TPU_SEARCH_DIST", None)
+        grid = self._gs(crit).train(fr)
+        assert len(grid.models) == 4
+        assert _rows(grid) == base
