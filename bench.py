@@ -57,10 +57,11 @@ _CACHE_ENV = {
 if os.environ.get("BENCH_FORCE_CPU") or "--cache-bench" in sys.argv \
         or "--parse-bench" in sys.argv or "--cluster-bench" in sys.argv \
         or "--chaos-bench" in sys.argv or "--serve-bench" in sys.argv \
-        or "--rapids-bench" in sys.argv or "--hist-bench" in sys.argv:
+        or "--rapids-bench" in sys.argv or "--hist-bench" in sys.argv \
+        or "--obs-bench" in sys.argv:
     # --cache-bench / --parse-bench / --cluster-bench / --chaos-bench /
-    # --serve-bench / --rapids-bench / --hist-bench are CPU-only by
-    # construction: same hazard
+    # --serve-bench / --rapids-bench / --hist-bench / --obs-bench are
+    # CPU-only by construction: same hazard
     for _k in _CACHE_ENV:
         os.environ.pop(_k, None)
 else:
@@ -962,7 +963,11 @@ def _cluster_bench() -> None:
 
         def _sent_bytes():
             c = telemetry.REGISTRY.get("rpc_payload_bytes_total")
-            return 0.0 if c is None else c.value(direction="sent")
+            if c is None:
+                return 0.0
+            # sum over the method label: this cell wants total egress
+            return sum(s["value"] for s in c.snapshot()["series"]
+                       if s["labels"].get("direction") == "sent")
 
         ctasks.distributed_map_reduce(
             cframes.mr_sum_xy, fr, cloud=cloud)  # warms the remote jit
@@ -1097,6 +1102,192 @@ def _cluster_bench() -> None:
             child.kill()
         cloud.stop()
         set_local_cloud(None)
+
+
+def _obs_bench() -> None:
+    """Cost-ledger overhead + end-to-end attribution bench (--obs-bench).
+
+    Two A/B cells, ledger charging ON vs OFF in alternating blocks (so
+    scheduler/cache drift cancels out of the comparison):
+
+    * **warm fused Rapids dispatch** — plan-cache + devcache hits, the
+      hot serving path; the ledger's design puts zero charge events on
+      it, and this cell is the proof
+    * **traced RPC echo** on a 2-node in-process cloud — every call pays
+      two real charge events (sent + received bytes), the worst per-call
+      ledger tax in the system.  Like the --cluster-bench telemetry
+      cell, the <5% p50 budget is operationalized at a 500us reference
+      RTT (the loopback percentage is reported but pessimistic: a
+      sub-100us RTT amplifies a ~2us fixed cost)
+
+    Then the in-run attribution assertion: a REST request (bench-local
+    route) whose handler runs ``distributed_map_reduce`` must leave a
+    ledger on its trace carrying BOTH client-side categories (RPC bytes)
+    and remote-side categories (the peer's shard wall).  Writes
+    OBS_BENCH.json and prints the same JSON; exits 1 when over budget or
+    when attribution came back empty.
+    """
+    import urllib.request
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from h2o3_tpu.api import start_server
+    from h2o3_tpu.cluster import frames as cframes
+    from h2o3_tpu.cluster import tasks as ctasks
+    from h2o3_tpu.cluster.membership import Cloud, set_local_cloud
+    from h2o3_tpu.frame.frame import Column, ColType, Frame
+    from h2o3_tpu.rapids.runtime import Session, exec_rapids
+    from h2o3_tpu.util import ledger as ledger_mod
+    from h2o3_tpu.util import telemetry
+
+    n_rows = int(os.environ.get("BENCH_OBS_ROWS", 200_000))
+    reps = int(os.environ.get("BENCH_OBS_REPS", 40))
+
+    def _pct(samples, q):
+        s = sorted(samples)
+        return s[min(len(s) - 1, int(q * len(s)))]
+
+    def _ab(fn, n, warmup=3):
+        """Alternating-block A/B: returns (on_samples, off_samples)."""
+        for _ in range(warmup):
+            fn()
+        on, off = [], []
+        block = max(1, n // 4)
+        for _ in range(4):
+            for enabled, sink in ((True, on), (False, off)):
+                ledger_mod.set_enabled(enabled)
+                for _ in range(block):
+                    t = time.perf_counter()
+                    fn()
+                    sink.append(time.perf_counter() - t)
+        ledger_mod.set_enabled(True)
+        return on, off
+
+    # -- cell 1: warm fused Rapids dispatch --------------------------------
+    rng = np.random.default_rng(7)
+    session = Session()
+    session.assign("ob", Frame([
+        Column("x", rng.standard_normal(n_rows), ColType.NUM),
+        Column("y", rng.standard_normal(n_rows), ColType.NUM),
+    ]))
+    expr = ("(sum (* (sqrt (abs (+ (cols_py ob 0) (cols_py ob 1)))) "
+            "(+ (* (floor (cols_py ob 1)) 0.25) (% (cols_py ob 0) 3))))")
+    os.environ["H2O3_TPU_RAPIDS_FUSION"] = "1"
+    rap_on, rap_off = _ab(lambda: exec_rapids(expr, session), reps)
+    rap_on_ms = _pct(rap_on, 0.5) * 1e3
+    rap_off_ms = _pct(rap_off, 0.5) * 1e3
+    rap_pct = (rap_on_ms - rap_off_ms) / max(rap_off_ms, 1e-9) * 100
+    rapids_cell = {
+        "ledger_off_p50_ms": round(rap_off_ms, 3),
+        "ledger_on_p50_ms": round(rap_on_ms, 3),
+        "overhead_pct_p50": round(rap_pct, 2),
+        "budget": {"pct_p50": 5.0},
+        "within_budget": rap_pct <= 5.0,
+    }
+
+    # -- cell 2 + attribution: 2-node cloud, REST front -------------------
+    a = Cloud("obs-bench", "obs-n0", hb_interval=0.2)
+    b = Cloud("obs-bench", "obs-n1", hb_interval=0.2)
+    srv = None
+    try:
+        a.start([])
+        b.start([a.info.addr])
+        t0 = time.time()
+        while time.time() - t0 < 30:
+            if a.size() == 2 and a.consensus() and b.consensus():
+                break
+            time.sleep(0.05)
+        else:
+            raise RuntimeError("2-node obs-bench cloud never formed")
+        ctasks.install(a)
+        ctasks.install(b)
+        peer = next(m for m in a.members_sorted()
+                    if m.info.name == "obs-n1")
+
+        def _echo():
+            with telemetry.Span("obs_bench_echo"):
+                a.client.call(peer.info.addr, "echo", b"x", timeout=5.0,
+                              target=peer.info.ident)
+
+        echo_on, echo_off = _ab(_echo, reps * 4)
+        on_us = _pct(echo_on, 0.5) * 1e6
+        off_us = _pct(echo_off, 0.5) * 1e6
+        overhead_us = on_us - off_us
+        ref_rtt_us, budget_us = 500.0, 500.0 * 0.05
+        echo_cell = {
+            "ledger_off_p50_us": round(off_us, 1),
+            "ledger_on_p50_us": round(on_us, 1),
+            "overhead_us_p50": round(overhead_us, 1),
+            "overhead_pct_p50_loopback": round(
+                overhead_us / max(off_us, 1e-9) * 100, 1),
+            "budget": {
+                "pct_p50": 5.0,
+                "reference_rtt_us": ref_rtt_us,
+                "overhead_budget_us": budget_us,
+            },
+            "within_budget": overhead_us <= budget_us,
+        }
+
+        # REST -> distributed_map_reduce attribution, through the full
+        # middleware (the REST span is the trace root the remote shard
+        # execution must fold back into)
+        set_local_cloud(a)
+        srv = start_server(port=0)
+        host = {"x": np.arange(50_000, dtype=np.float64),
+                "y": (np.arange(50_000, dtype=np.float64) * 3) % 17}
+
+        def bench_dmr(params):
+            out = ctasks.distributed_map_reduce(
+                cframes.mr_sum_xy, host, cloud=a)
+            return {"leaves": [float(v) for v in jax.tree.leaves(out)]}
+
+        srv.registry.register("GET", "/3/BenchDMR", bench_dmr,
+                              "bench-only: REST-rooted distributed mr")
+        with urllib.request.urlopen(srv.url + "/3/BenchDMR") as resp:
+            assert resp.status == 200
+            tid = resp.headers["X-H2O3-Trace-Id"]
+        entry = ledger_mod.LEDGER.get(tid)
+        assert entry is not None, "REST dmr trace has no ledger entry"
+        total = entry["total"]
+        client_ok = (total.get(ledger_mod.RPC_SENT_BYTES, 0) > 0
+                     and total.get(ledger_mod.RPC_RECV_BYTES, 0) > 0)
+        remote = entry["nodes"].get("obs-n1", {})
+        remote_ok = remote.get(ledger_mod.SHARD_WALL_SECONDS, 0) > 0
+        attribution = {
+            "trace_id": tid,
+            "client_categories_nonempty": client_ok,
+            "remote_categories_nonempty": remote_ok,
+            "nodes": sorted(entry["nodes"]),
+            "total": {k: round(v, 6) for k, v in sorted(total.items())},
+        }
+    finally:
+        if srv is not None:
+            srv.stop()
+        set_local_cloud(None)
+        a.stop()
+        b.stop()
+
+    ok = (rapids_cell["within_budget"] and echo_cell["within_budget"]
+          and client_ok and remote_ok)
+    result = {
+        "metric": "ledger_overhead_pct_p50_warm_rapids",
+        "value": rapids_cell["overhead_pct_p50"],
+        "unit": "% (ledger on vs off, warm fused Rapids dispatch p50)",
+        "detail": {
+            "n_rows": n_rows,
+            "rapids_warm_dispatch": rapids_cell,
+            "rpc_echo_traced": echo_cell,
+            "rest_dmr_attribution": attribution,
+        },
+    }
+    with open(os.path.join(_HERE, "OBS_BENCH.json"), "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))
+    if not ok:
+        sys.exit(1)
 
 
 def _chaos_bench() -> None:
@@ -1556,5 +1747,7 @@ if __name__ == "__main__":
         _rapids_bench()
     elif "--hist-bench" in sys.argv:
         _hist_bench()
+    elif "--obs-bench" in sys.argv:
+        _obs_bench()
     else:
         main()

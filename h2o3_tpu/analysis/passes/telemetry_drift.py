@@ -37,6 +37,8 @@ RULES = {
 OBS_PREFIXES = (
     "/3/Logs",
     "/3/Timeline",
+    "/3/Traces",
+    "/3/SlowOps",
     "/3/Metrics",
     "/3/Profiler",
     "/3/JStack",
@@ -52,10 +54,12 @@ _METRIC_SUFFIXES = ("_total", "_seconds", "_bytes", "_entries", "_workers",
                     "_homes")
 
 #: README sections whose backticked metric references the registry must
-#: actually contain
+#: actually contain — ``##`` sections or ``###`` subsections (the cost
+#: ledger and cluster profiler live under ``## Observability``)
 _METRIC_SECTIONS = ("Observability", "Clustering", "Distributed Frames",
                     "Distributed model search", "Failure model",
-                    "Serving plane")
+                    "Serving plane", "Cost ledger & slow-op log",
+                    "Cluster profiler")
 
 
 def readme_documented_routes(readme_path: str) -> set:
@@ -84,12 +88,16 @@ def readme_documented_metrics(readme_path: str) -> set:
         text = f.read()
     names = set()
     for section in _METRIC_SECTIONS:
-        m = re.search(rf"^## {section}$(.*?)(?=^## |\Z)", text,
-                      re.MULTILINE | re.DOTALL)
+        # ## sections end at the next ##; ### subsections end at the next
+        # heading of EITHER depth ("### X" never matches "^## " — the
+        # required trailing space — so ## behavior is unchanged)
+        m = re.search(
+            rf"^(##|###) {re.escape(section)}$(.*?)(?=^\1 |^## |\Z)",
+            text, re.MULTILINE | re.DOTALL)
         if not m:
             continue
         for tok in re.findall(r"`([a-z][a-z0-9_]*)(?:\{[a-z0-9_,]+\})?`",
-                              m.group(1)):
+                              m.group(2)):
             if tok.endswith(_METRIC_SUFFIXES):
                 names.add(tok)
     return names
@@ -113,6 +121,7 @@ def live_metrics() -> set:
     import h2o3_tpu.cluster.search   # noqa: F401  cluster_search_* meters
     import h2o3_tpu.api.coalesce     # noqa: F401  predict_batch_size
     import h2o3_tpu.rapids.fusion    # noqa: F401  rapids_fusion_* meters
+    import h2o3_tpu.util.ledger      # noqa: F401  ledger_* / slowop_* meters
     from h2o3_tpu.util import telemetry
 
     return set(telemetry.REGISTRY.names())

@@ -14,9 +14,11 @@ batch size is limited by admission control, not by worker count.
 from __future__ import annotations
 
 import threading
+import time
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from h2o3_tpu.util import ledger as _ledger
 from h2o3_tpu.util import telemetry
 
 #: requests per batched dispatch; total_count is the number of dispatches,
@@ -35,7 +37,8 @@ class _Batch:
     def __init__(self, key: Any, fn: Callable[[List[Any]], List[Any]]) -> None:
         self.key = key
         self.fn = fn
-        self.entries: List[Tuple[Any, Future]] = []
+        #: (payload, future, trace_id-or-None) per coalesced request
+        self.entries: List[Tuple[Any, Future, Optional[str]]] = []
         self.groups: set = set()
         self.rows = 0
         self.closed = False
@@ -75,11 +78,14 @@ class Coalescer:
         payload: Any,
         rows_hint: int = 0,
         group: Any = None,
+        trace_id: Optional[str] = None,
     ) -> Future:
         """Queue ``payload`` into the open batch for ``key`` (creating one
         if needed).  ``fn(payloads)`` runs once per batch and must return
         one result per payload, aligned; the returned Future resolves with
-        this payload's result."""
+        this payload's result.  ``trace_id`` names the submitting
+        request's trace so the batch's dispatch cost can split across
+        every rider (cost ledger)."""
         fut: Future = Future()
         full = False
         with self._lock:
@@ -90,7 +96,7 @@ class Coalescer:
                 b.timer = threading.Timer(self.window_s, self._close, (b,))
                 b.timer.daemon = True
                 b.timer.start()
-            b.entries.append((payload, fut))
+            b.entries.append((payload, fut, trace_id))
             g = group if group is not None else object()
             if g not in b.groups:
                 b.groups.add(g)
@@ -135,22 +141,36 @@ class Coalescer:
 
     def _run(self, b: _Batch) -> None:
         _BATCH_SIZE.observe(len(b.entries))
+        t0 = time.perf_counter()
         try:
-            results = b.fn([p for p, _ in b.entries])
+            results = b.fn([p for p, _, _ in b.entries])
             if len(results) != len(b.entries):
                 raise RuntimeError(
                     f"batch fn returned {len(results)} results for "
                     f"{len(b.entries)} entries"
                 )
         except BaseException as e:  # noqa: BLE001
-            for _, fut in b.entries:
+            self._charge_shares(b, time.perf_counter() - t0)
+            for _, fut, _ in b.entries:
                 try:
                     fut.set_exception(e)
                 except Exception:
                     pass  # drained/cancelled caller: response abandoned
             return
-        for (_, fut), res in zip(b.entries, results):
+        self._charge_shares(b, time.perf_counter() - t0)
+        for (_, fut, _), res in zip(b.entries, results):
             try:
                 fut.set_result(res)
             except Exception:
                 pass  # drained/cancelled caller: response abandoned
+
+    @staticmethod
+    def _charge_shares(b: _Batch, wall_s: float) -> None:
+        """Split one dispatch's wall equally across every rider's trace:
+        the shares of the K coalesced requests sum to the batch cost, so
+        followers are never free and the leader is never blamed for K."""
+        share = wall_s / len(b.entries) if b.entries else 0.0
+        for _, _, tid in b.entries:
+            if tid:
+                _ledger.charge(
+                    _ledger.COALESCE_SHARE_SECONDS, share, trace_id=tid)

@@ -1302,11 +1302,11 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
         n = int(params.get("count", params.get("n", 1000)))
         cloud = _active_cloud() if _truthy(params.get("cluster")) else None
         if cloud is None:
-            return {
+            return _attach_ledgers({
                 "events": timeline.snapshot(n),
                 "total_events": timeline.total_events(),
                 "now": int(time.time() * 1000),
-            }
+            }, params)
         results, errors = cloud.poll_members(
             "timeline_snapshot", {"count": n})
         members = {m.info.name: m for m in cloud.members_sorted()}
@@ -1337,14 +1337,90 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
         for name in sorted(errors):
             nodes_meta.append({"name": name, "error": errors[name]})
         events.sort(key=lambda e: e.get("ns", 0))
-        return {
+        return _attach_ledgers({
             "events": events,
             "nodes": nodes_meta,
             "partial": bool(errors),
             "total_events": sum(nm.get("total_events", 0)
                                 for nm in nodes_meta),
             "now": int(time.time() * 1000),
-        }
+        }, params)
+
+    def _attach_ledgers(resp, params):
+        """``?ledgers=true``: attach this node's cost-ledger entries for
+        every trace id present in the returned events, so a saved
+        timeline snapshot carries the data trace_view.py needs to render
+        per-span cost columns."""
+        if not _truthy(params.get("ledgers")):
+            return resp
+        from h2o3_tpu.util import ledger as ledger_mod
+
+        tids = [e.get("trace_id") for e in resp.get("events", [])
+                if e.get("trace_id")]
+        resp["ledgers"] = ledger_mod.LEDGER.snapshot_many(tids)
+        return resp
+
+    def traces_ep(params, trace_id):
+        """Per-trace cost breakdown (node x category), federated: every
+        member is asked for its ledger entry over the trace_ledger RPC
+        and the per-node maps merge — 404 only when NO reachable member
+        knows the trace; an unreachable member degrades the answer to
+        ``partial: true``, never a 5xx."""
+        from h2o3_tpu.util import ledger as ledger_mod
+
+        cloud = _active_cloud()
+        if cloud is None:
+            entry = ledger_mod.LEDGER.get(trace_id)
+            if entry is None:
+                raise RestError(
+                    404, f"no cost ledger for trace {trace_id!r}")
+            entry["partial"] = False
+            return entry
+        results, errors = cloud.poll_members(
+            "trace_ledger", {"trace_id": trace_id})
+        nodes: Dict[str, Any] = {}
+        spans: Dict[str, Any] = {}
+        meta: Dict[str, Any] = {}
+        known = False
+        for name in sorted(results):
+            led = (results[name] or {}).get("ledger")
+            if not led:
+                continue
+            known = True
+            # merge by OVERWRITING per-node keys, never summing: each
+            # node's charges live under its own name (disjoint in a real
+            # multi-process cloud), and in-process test clouds share one
+            # process-wide ledger — every member returns the same entry,
+            # so summing would multiply every cost by the member count
+            for node, cats in (led.get("nodes") or {}).items():
+                nodes[node] = dict(cats)
+            for sid, cats in (led.get("spans") or {}).items():
+                spans[sid] = dict(cats)
+            for k, v in led.items():
+                if k not in ("trace_id", "nodes", "spans", "total"):
+                    meta.setdefault(k, v)
+        if not known:
+            raise RestError(404, f"no cost ledger for trace {trace_id!r}")
+        total: Dict[str, float] = {}
+        for cats in nodes.values():
+            for k, v in cats.items():
+                total[k] = total.get(k, 0.0) + v
+        out = {"trace_id": trace_id, "nodes": nodes, "spans": spans,
+               "total": total, "partial": bool(errors)}
+        if errors:
+            out["errors"] = {k: errors[k] for k in sorted(errors)}
+        for k, v in meta.items():
+            out.setdefault(k, v)
+        return out
+
+    def slowops_ep(params):
+        """The slow-op exemplar log: the N worst traces per route above
+        the threshold, each with its ledger snapshot attached.
+        ``?route=`` filters to one route."""
+        from h2o3_tpu.util import ledger as ledger_mod
+
+        return ledger_mod.SLOWOPS.snapshot(
+            route=params.get("route") or None)
 
     def jstack(params):
         """Real per-thread stack dump (util/JStackCollectorTask.java)."""
@@ -1452,6 +1528,9 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
     r.register("GET", "/3/Metrics/prometheus", metrics_prometheus,
                "telemetry registry (Prometheus text exposition)")
     r.register("GET", "/3/Timeline", timeline_ep, "event timeline")
+    r.register("GET", "/3/Traces/{trace_id}", traces_ep,
+               "per-trace cost ledger (node x category)")
+    r.register("GET", "/3/SlowOps", slowops_ep, "slow-op exemplar log")
     r.register("GET", "/3/JStack", jstack, "thread dump")
     r.register("GET", "/3/Logs", logs_ep, "recent log lines")
     r.register("GET", "/3/Logs/download", logs_download, "full log download")

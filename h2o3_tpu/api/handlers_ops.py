@@ -988,7 +988,7 @@ def register(r: RequestServer, server: H2OServer) -> None:  # noqa: C901
     # ---- profiler (ProfileCollectorTask -> /3/Profiler; TPU half:
     # jax.profiler trace toggle) --------------------------------------------
     def profiler_ep(params):
-        from h2o3_tpu.util import profiler
+        from h2o3_tpu.util import profiler, telemetry
 
         # default filter drops ONLY the server's own threads — the accept
         # loop ("http-accept") and request workers ("http-worker", named by
@@ -996,14 +996,60 @@ def register(r: RequestServer, server: H2OServer) -> None:  # noqa: C901
         # visible. exclude="" disables, any other value is a name regex;
         # the applied filter is echoed so nothing is hidden silently
         exclude = params.get("exclude", r"^http[-_]")
+        duration = float(params.get("duration", 0.25))
+        depth = int(params.get("depth", 10))
+        cluster_q = str(params.get("cluster", "")).lower() in (
+            "1", "true", "yes", "on")
+        if cluster_q:
+            from h2o3_tpu import cluster as _cluster
+
+            c = _cluster.active_cloud()
+            if c is not None:
+                return _profiler_cluster(c, duration, depth, exclude)
+            # no live cloud: the single-node answer, flagged complete
         return {"nodes": [{
-            "node_name": "localhost",
+            "node_name": telemetry.node_name() or "localhost",
             "exclude": exclude,
             "profile": profiler.collect(
-                duration_s=float(params.get("duration", 0.25)),
-                depth=int(params.get("depth", 10)),
-                exclude=exclude or None),
+                duration_s=duration, depth=depth, exclude=exclude or None),
         }]}
+
+    def _profiler_cluster(c, duration, depth, exclude):
+        """Federate the sampling profiler exactly the way /3/Metrics was:
+        scrape every member (profiler_snapshot RPC — each samples for
+        ``duration``), node-tag the collapsed stacks, append a
+        ``_cluster`` aggregate, and degrade to ``partial: true`` — never
+        5xx — when a member is unreachable."""
+        results, errors = c.poll_members(
+            "profiler_snapshot",
+            {"duration": duration, "depth": depth, "exclude": exclude},
+            timeout=duration + 5.0,
+        )
+        nodes = []
+        agg: Dict[tuple, int] = {}
+        for name in sorted(results):
+            snap = results[name] or {}
+            prof = snap.get("profile") or []
+            nodes.append({
+                "node_name": name, "exclude": exclude, "profile": prof})
+            for entry in prof:
+                key = tuple(entry.get("stacktrace") or ())
+                agg[key] = agg.get(key, 0) + int(entry.get("count", 0))
+        total = sum(agg.values())
+        # per-node pct is sweeps-presence and cannot merge exactly, so
+        # the aggregate's pct is each stack's share of cluster samples
+        merged = [
+            {"stacktrace": list(k), "count": v,
+             "pct": round(100.0 * v / total, 1) if total else 0.0}
+            for k, v in sorted(agg.items(), key=lambda kv: -kv[1])[:50]
+        ]
+        nodes.append({
+            "node_name": "_cluster", "exclude": exclude, "profile": merged})
+        return {
+            "nodes": nodes,
+            "partial": bool(errors),
+            "errors": {k: errors[k] for k in sorted(errors)},
+        }
 
     def profiler_trace(params):
         from h2o3_tpu.util.profiler import TRACE

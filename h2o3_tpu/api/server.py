@@ -40,6 +40,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from h2o3_tpu import __version__
+from h2o3_tpu.util import ledger as _ledger
 from h2o3_tpu.util import telemetry
 
 Route = Tuple[str, "re.Pattern[str]", List[str], Callable, str]
@@ -386,6 +387,7 @@ def _run_job(job: _Job) -> None:
         "rest", method=job.method, route=job.route, path=job.path,
         trace_id=job.trace_id, parent_id=job.parent_id,
     )
+    t0 = time.perf_counter()
     try:
         with span:
             # logged INSIDE the span so the /3/Logs line carries this
@@ -397,6 +399,12 @@ def _run_job(job: _Job) -> None:
     except BaseException as e:  # noqa: BLE001
         status, payload = _error_body(e)
         ctype = "application/json"
+    # cost accounting BEFORE the future resolves: a client reading its
+    # response can immediately GET /3/Traces/{id} and see route/wall meta
+    wall_ms = (time.perf_counter() - t0) * 1e3
+    _ledger.LEDGER.annotate(span.trace_id, route=job.route,
+                            wall_ms=round(wall_ms, 3), status=status)
+    _ledger.SLOWOPS.record(job.route, wall_ms, span.trace_id, status)
     _resolve(job.future, (status, payload, ctype, span.trace_id))
 
 
@@ -416,11 +424,15 @@ def _run_batch(route: str, batch_fn: Callable, jobs: List[_Job]) -> List[_Resp]:
             built.append(None)
         except BaseException as e:  # noqa: BLE001
             built.append(e)
+    # the batch span runs under the LEADER's trace (jobs[0]); each rider
+    # keeps its own trace id for its response header and its ledger share
+    # (the coalescer splits the dispatch wall across riders)
     span = telemetry.Span(
         "rest", method=jobs[0].method, route=route, batch=len(jobs),
         trace_id=jobs[0].trace_id, parent_id=jobs[0].parent_id,
     )
     outs: List[Any]
+    t0 = time.perf_counter()
     with span:
         for job in jobs:
             log.info("%s %s (coalesced x%d)", job.method, job.path, len(jobs))
@@ -432,9 +444,10 @@ def _run_batch(route: str, batch_fn: Callable, jobs: List[_Job]) -> List[_Resp]:
                     f"for {len(live)} requests")
         except BaseException as e:  # noqa: BLE001
             outs = [e] * len(live)
+    wall_ms = (time.perf_counter() - t0) * 1e3
     results: List[_Resp] = []
     it = iter(outs)
-    for err in built:
+    for job, err in zip(jobs, built):
         res = err if err is not None else next(it)
         if isinstance(res, BaseException):
             status, payload = _error_body(res)
@@ -446,7 +459,12 @@ def _run_batch(route: str, batch_fn: Callable, jobs: List[_Job]) -> List[_Resp]:
             except BaseException as e:  # noqa: BLE001
                 status, payload = _error_body(e)
                 ctype = "application/json"
-        results.append((status, payload, ctype, span.trace_id))
+        tid = job.trace_id or span.trace_id
+        _ledger.LEDGER.annotate(tid, route=route,
+                                wall_ms=round(wall_ms, 3), status=status,
+                                batch=len(jobs))
+        _ledger.SLOWOPS.record(route, wall_ms, tid, status)
+        results.append((status, payload, ctype, tid))
     return results
 
 
@@ -919,6 +937,12 @@ class H2OServer:
                        _trace_header(headers.get("x-h2o3-trace-id")),
                        _trace_header(headers.get("x-h2o3-span-id")))
             if coalesce:
+                if job.trace_id is None:
+                    # every coalesced rider gets its own trace identity up
+                    # front (not just the leader's batch span), so the
+                    # dispatch cost splits across rider traces and each
+                    # response echoes an id /3/Traces/{id} can resolve
+                    job.trace_id = telemetry._new_id()
                 key = (route, handler._h2o3_batch_key(path_kw))
                 group_fn = getattr(handler, "_h2o3_batch_group", None)
                 rows_fn = getattr(handler, "_h2o3_batch_rows", None)
@@ -927,6 +951,7 @@ class H2OServer:
                     key, job,
                     rows_hint=rows_fn(path_kw) if rows_fn else 0,
                     group=(key, group_fn(path_kw)) if group_fn else None,
+                    trace_id=job.trace_id,
                 )
             else:
                 cfut = job.future

@@ -41,6 +41,7 @@ from h2o3_tpu.cluster import rpc as _rpc
 from h2o3_tpu.cluster import transport
 from h2o3_tpu.cluster.dkv import MAX_REPLICAS
 from h2o3_tpu.frame.frame import ColType, Column, Frame, NA_CAT
+from h2o3_tpu.util import ledger as _ledger
 from h2o3_tpu.util import telemetry
 
 _CHUNK_HOMES = telemetry.gauge(
@@ -446,6 +447,9 @@ def _fetch_group_chunks(store, layout: Dict[str, Any], g: int) -> list:
             raise _rpc.RpcFault(
                 f"chunk {ck} unreachable on the ring", code=404)
         vals.append(v)
+    # cache-miss path only (columns_from_group short-circuits on its
+    # group cache), so the charge counts real ring/chunk reads
+    _ledger.charge(_ledger.CHUNK_READS, len(vals))
     return vals
 
 

@@ -241,6 +241,8 @@ class Cloud:
             telemetry.REGISTRY.summary()))
         self.rpc_server.register("metrics_snapshot", self._on_metrics_snapshot)
         self.rpc_server.register("timeline_snapshot", self._on_timeline_snapshot)
+        self.rpc_server.register("profiler_snapshot", self._on_profiler_snapshot)
+        self.rpc_server.register("trace_ledger", self._on_trace_ledger)
         self.rpc_server.register("members", lambda p: {
             "members": [m.info.ident for m in self.members_sorted()],
             "hash": self.cloud_hash(),
@@ -694,6 +696,39 @@ class Cloud:
             int((payload or {}).get("count", 1000)))
         out["node"] = self.info.name
         return out
+
+    def _on_profiler_snapshot(
+            self, payload: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+        """Sample this node's Python stacks — the per-member half of
+        ``GET /3/Profiler?cluster=true``.  Blocks for ``duration`` seconds
+        (the caller's poll timeout must cover it)."""
+        from h2o3_tpu.util import profiler
+
+        p = payload or {}
+        exclude = p.get("exclude")
+        return {
+            "node": self.info.name,
+            "exclude": exclude,
+            "profile": profiler.collect(
+                duration_s=float(p.get("duration", 0.25)),
+                depth=int(p.get("depth", 10)),
+                exclude=exclude or None,
+            ),
+        }
+
+    def _on_trace_ledger(
+            self, payload: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+        """This node's cost-ledger entry for one trace — the per-member
+        half of ``GET /3/Traces/{trace_id}``.  ``ledger: None`` when the
+        trace never charged anything here (absence is data, not error)."""
+        from h2o3_tpu.util import ledger as _ledger_mod
+
+        tid = str((payload or {}).get("trace_id", ""))
+        return {
+            "node": self.info.name,
+            "trace_id": tid,
+            "ledger": _ledger_mod.LEDGER.get(tid) if tid else None,
+        }
 
     # -- cluster-wide scrape fan-out ------------------------------------------
     def poll_members(

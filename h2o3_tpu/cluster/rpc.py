@@ -52,6 +52,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 from h2o3_tpu.cluster import faults as _faults
 from h2o3_tpu.cluster import transport
+from h2o3_tpu.util import ledger as _ledger
 from h2o3_tpu.util import telemetry
 
 _RPC_CALLS = telemetry.counter(
@@ -74,9 +75,11 @@ _RPC_SERVED = telemetry.counter(
 _RPC_PAYLOAD_BYTES = telemetry.counter(
     "rpc_payload_bytes_total",
     "encoded RPC envelope bytes this node's client moved, by direction "
-    "(sent = requests out, received = responses in) — the wire meter "
-    "that proves a chunk-homed map_reduce ships partials, not chunks",
-    labels=("direction",),
+    "(sent = requests out, received = responses in) and method — the "
+    "wire meter that proves a chunk-homed map_reduce ships partials, "
+    "not chunks, with control-plane vs data-plane traffic separated "
+    "on the method label",
+    labels=("direction", "method"),
 )
 _RPC_INFLIGHT = telemetry.gauge(
     "rpc_inflight",
@@ -95,9 +98,28 @@ _INFLIGHT_SERVER = _RPC_INFLIGHT.bind(side="server")
 #: drops to a dict hit + locked update
 _seconds_bound: Dict[Tuple[str, str], telemetry._Bound] = {}
 
-#: bound byte-meter series — ticked once per attempt on the hot path
-_SENT_BYTES = _RPC_PAYLOAD_BYTES.bind(direction="sent")
-_RECEIVED_BYTES = _RPC_PAYLOAD_BYTES.bind(direction="received")
+#: (direction, method) -> bound byte-meter series — same closed-set cache
+#: pattern as ``_seconds_bound``, so the per-attempt tick stays a dict hit
+_payload_bound: Dict[Tuple[str, str], telemetry._Bound] = {}
+
+#: wire direction -> cost-ledger category
+_LEDGER_BYTES_CAT = {"sent": _ledger.RPC_SENT_BYTES,
+                     "received": _ledger.RPC_RECV_BYTES}
+
+
+def _charge_bytes(direction: str, method: str, n: int) -> None:
+    """Meter one attempt's wire bytes AND bill them to the open trace.
+
+    During ``_attempt`` the CALLER's span is still current on this thread
+    (the rpc_client wrapper is a recorded event, not a pushed span), so
+    the ledger charge lands on the originating trace; untraced calls
+    (heartbeats) tick the meter and charge nothing."""
+    b = _payload_bound.get((direction, method))
+    if b is None:
+        b = _payload_bound[(direction, method)] = _RPC_PAYLOAD_BYTES.bind(
+            direction=direction, method=method)
+    b.inc(n)
+    _ledger.charge(_LEDGER_BYTES_CAT[direction], n)
 
 
 def _observe_seconds(method: str, side: str, v: float) -> None:
@@ -266,7 +288,7 @@ class RpcClient:
 
         def _one_attempt(attempt: int) -> bytes:
             if trace_ctx is None:
-                return self._attempt(addr, request, timeout)
+                return self._attempt(addr, request, timeout, method)
             if attempt == 0:
                 # common case: the envelope carries the rpc_client ids (no
                 # per-attempt span — one span per side keeps traced
@@ -281,7 +303,7 @@ class RpcClient:
                 })
                 t_a = time.perf_counter()
                 try:
-                    return self._attempt(addr, req, timeout)
+                    return self._attempt(addr, req, timeout, method)
                 except Exception:
                     if ladder:  # a retry will follow: show attempt 0
                         _record_attempt(telemetry._new_id(), t_a, False, 0)
@@ -297,7 +319,7 @@ class RpcClient:
             })
             t_a = time.perf_counter()
             try:
-                raw = self._attempt(addr, req, timeout)
+                raw = self._attempt(addr, req, timeout, method)
             except Exception:
                 _record_attempt(attempt_id, t_a, False, attempt)
                 raise
@@ -385,13 +407,13 @@ class RpcClient:
             _observe_seconds(method, "client", time.perf_counter() - t0)
 
     def _attempt(self, addr: transport.Address, request: bytes,
-                 timeout: float) -> bytes:
+                 timeout: float, method: str) -> bytes:
         """One ladder attempt.  Every idle pooled socket to a restarted
         peer is stale at once (pool max_idle == ladder depth), so a
         pooled connection that fails is closed and the next tried WITHIN
         the attempt — only a fresh dial's failure, or any timeout,
         charges the retry ladder."""
-        _SENT_BYTES.inc(len(request))
+        _charge_bytes("sent", method, len(request))
         while True:
             conn = self.pool.pop_idle(addr)
             if conn is None:
@@ -405,7 +427,7 @@ class RpcClient:
                 conn.close()  # stale pooled socket: try the next
                 continue
             self.pool.put(conn)
-            _RECEIVED_BYTES.inc(len(raw))
+            _charge_bytes("received", method, len(raw))
             return raw
         conn = self.pool.dial(addr, timeout)
         try:
@@ -414,7 +436,7 @@ class RpcClient:
             conn.close()  # response may still arrive: poisoned
             raise
         self.pool.put(conn)
-        _RECEIVED_BYTES.inc(len(raw))
+        _charge_bytes("received", method, len(raw))
         return raw
 
     def close(self) -> None:

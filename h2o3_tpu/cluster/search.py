@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional
 
@@ -40,6 +41,7 @@ import numpy as np
 from h2o3_tpu.cluster import rpc as _rpc
 from h2o3_tpu.cluster import tasks as _tasks
 from h2o3_tpu.cluster.membership import Cloud
+from h2o3_tpu.util import ledger as _ledger
 from h2o3_tpu.util import telemetry
 from h2o3_tpu.util.log import get_logger
 
@@ -251,8 +253,13 @@ def _execute_cell(payload: Dict[str, Any], cloud) -> Dict[str, Any]:
         # multi-device collective programs concurrently (see
         # tasks._SHARD_EXEC_LOCK) — model training runs shard_map+psum,
         # so every cell build in the process serializes behind that lock
+        t0 = time.perf_counter()
         with _tasks._SHARD_EXEC_LOCK:
             model = builder_cls(params).train(ctx["frame"], ctx["valid"])
+        # a member-executed cell runs under the rpc_server span, so the
+        # wall bills the originating search trace under this node's name
+        _ledger.charge(
+            _ledger.SEARCH_CELL_SECONDS, time.perf_counter() - t0)
     except Exception as e:
         _CELLS.inc(result="error")
         _send_progress(cloud, caller, {**event, "status": "error"})

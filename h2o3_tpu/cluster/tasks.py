@@ -37,6 +37,7 @@ import numpy as np
 
 from h2o3_tpu.cluster import rpc as _rpc
 from h2o3_tpu.cluster.membership import Cloud, Member
+from h2o3_tpu.util import ledger as _ledger
 from h2o3_tpu.util import telemetry
 
 _TASKS_METER = telemetry.counter(
@@ -185,9 +186,15 @@ def _mr_shard_local(fn: Callable, columns: Dict[str, np.ndarray],
 
     from h2o3_tpu.compute.mapreduce import map_reduce
 
+    t0 = time.perf_counter()
     with _SHARD_EXEC_LOCK:
         out = map_reduce(fn, _table_from_columns(columns), reduce=reduce)
-        return jax.tree.map(np.asarray, out)
+        out = jax.tree.map(np.asarray, out)
+    # on a remote node this runs under the rpc_server span, so the wall
+    # (lock wait included — it is wall the trace experienced) folds back
+    # to the ORIGINATING trace under the serving node's name
+    _ledger.charge(_ledger.SHARD_WALL_SECONDS, time.perf_counter() - t0)
+    return out
 
 
 @register_task("mr_shard")

@@ -55,6 +55,7 @@ from h2o3_tpu.frame.devcache import (
 )
 from h2o3_tpu.frame.frame import ColType, Frame
 from h2o3_tpu.parallel.mesh import DATA_AXIS, default_mesh, row_mask, shard_rows
+from h2o3_tpu.util import ledger as _ledger
 from h2o3_tpu.util import telemetry
 
 #: per-primitive accounting (DrJAX's point for MapReduce-in-JAX: you cannot
@@ -152,6 +153,7 @@ def _get_plan(op: str, fn: Callable, reduce: str, table: "FrameTable",
             _PLAN_CACHE.inc(op=op, result="hit")
             return plan
     _PLAN_CACHE.inc(op=op, result="miss")
+    _ledger.charge(_ledger.PLAN_CACHE_MISSES, 1)
     plan = build()
     with _plans_lock:
         existing = _plans.get(key)
@@ -181,6 +183,7 @@ def plan_memo(namespace: str, key: Tuple, build: Callable[[], object]):
             _PLAN_CACHE.inc(op=namespace, result="hit")
             return hit
     _PLAN_CACHE.inc(op=namespace, result="miss")
+    _ledger.charge(_ledger.PLAN_CACHE_MISSES, 1)
     value = build()
     with _plans_lock:
         existing = _plans.get(full)
@@ -203,10 +206,18 @@ def _dispatch(op: str, table: "FrameTable", call):
     # thread-local delta: compiles run on the dispatching thread, so this
     # stays correct when several builds dispatch concurrently
     compiles_before = telemetry.thread_compile_count()
+    compile_secs_before = telemetry.thread_compile_seconds()
     t0 = time.perf_counter()
     with telemetry.Span("mapreduce", op=op, shards=n_shards,
                         rows=table.n_valid):
         out = call()
+        # charge inside the span so the delta lands on the mapreduce
+        # span_id; compiles run on the dispatching thread, so the
+        # thread-local delta is this dispatch's own compile bill
+        compile_secs = (telemetry.thread_compile_seconds()
+                        - compile_secs_before)
+        if compile_secs > 0.0:
+            _ledger.charge(_ledger.COMPILE_SECONDS, compile_secs)
     _WALL.observe(time.perf_counter() - t0, op=op)
     missed = telemetry.thread_compile_count() > compiles_before
     _JIT_CACHE.inc(op=op, result="miss" if missed else "hit")

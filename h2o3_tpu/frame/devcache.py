@@ -29,6 +29,7 @@ import threading
 from collections import OrderedDict
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
+from h2o3_tpu.util import ledger as _ledger
 from h2o3_tpu.util import telemetry
 
 __all__ = [
@@ -212,6 +213,9 @@ class DeviceFrameCache:
         REQUESTS.inc(kind=kind, result="miss")
         value = build()  # host->device transfer happens without the lock
         nbytes = device_nbytes(value)
+        # the trace whose miss paid the host->device transfer is billed
+        # for it (still outside the lock)
+        _ledger.charge(_ledger.DEVCACHE_UPLOAD_BYTES, nbytes)
         with self._lock:
             entry = self._entries.get(key)
             if entry is not None:  # lost a concurrent build race: keep first
@@ -258,6 +262,9 @@ class DeviceFrameCache:
                 if not keys:
                     del self._by_frame_key[fk]
         _EVICTIONS.inc(reason=reason)
+        # the trace whose insertion (or invalidation) displaced the entry
+        # pays; the ledger lock is a leaf, safe under this cache's lock
+        _ledger.charge(_ledger.DEVCACHE_EVICTIONS, 1)
 
     def _shrink(self) -> None:
         # caller holds the lock; never evict the most recent entry — a

@@ -1,0 +1,361 @@
+"""Per-trace cost attribution: the ledger every choke point charges into.
+
+PR 6 made one ``trace_id`` thread REST -> distributed map_reduce -> remote
+shard execution, but nothing answered "which request paid for that compile,
+that devcache upload, those RPC bytes, on which node?".  This module is
+that answer — the TensorFlow-paper point that whole-system performance
+work lives or dies on per-step cost visibility, with DrJAX's placement
+split honored by attributing device work per *node*, not per process:
+
+* :class:`CostLedger` — a process-wide, lock-leaf LRU map
+  ``trace_id -> {node: {category: amount}}`` (plus a bounded per-span
+  breakdown for the trace viewer).  ``charge(category, amount)`` reads the
+  calling thread's open :class:`~h2o3_tpu.util.telemetry.Span`, so the
+  existing span/RPC-envelope context is the attribution context: a remote
+  dtask executing under an ``rpc_server`` span charges the *caller's*
+  trace under the *serving* node's name with zero extra wiring.  Untraced
+  work (heartbeats, background sweeps) charges nothing and pays one
+  attribute read.
+* :class:`SlowOpLog` — a threshold-gated ring of the N worst traces per
+  REST route, each record carrying its ledger snapshot: exemplars, not
+  averages (``GET /3/SlowOps``).
+
+Charge sites (grep the category constants): jit compile seconds and plan
+cache misses (``compute/mapreduce.py``), devcache upload bytes and
+evictions (``frame/devcache.py``), RPC wire bytes both directions
+(``cluster/rpc.py``), shard walls (``cluster/tasks.py``), chunk reads
+(``cluster/frames.py``), coalesced-batch shares (``api/coalesce.py``),
+and search cell walls (``cluster/search.py``).
+
+Surface: ``GET /3/Traces/{trace_id}`` federates per-node ledgers over the
+``trace_ledger`` RPC (``cluster/membership.py``); ``GET /3/Timeline``
+gains ``?ledgers=true``; ``scripts/trace_view.py`` renders per-span cost
+columns when a saved snapshot carries ledger data.
+
+Locking discipline (the ``KeyedStore.get`` lesson): the ledger lock is a
+LEAF — nothing blocking, no other lock, runs inside it; meters tick after
+it releases.  Both maps are bounded: ``H2O3_TPU_LEDGER_TRACES`` (default
+512, LRU) caps tracked traces, ``H2O3_TPU_SLOWOP_PER_ROUTE`` (default 8)
+caps exemplars per route.  ``H2O3_TPU_LEDGER=0`` disables charging
+entirely; ``H2O3_TPU_SLOWOP_MS`` (default 250) gates the slow-op ring.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence
+
+from h2o3_tpu.util import telemetry
+
+__all__ = [
+    "CostLedger",
+    "LEDGER",
+    "SLOWOPS",
+    "SlowOpLog",
+    "charge",
+    "set_enabled",
+    # charge-site category constants
+    "COMPILE_SECONDS",
+    "PLAN_CACHE_MISSES",
+    "DEVCACHE_UPLOAD_BYTES",
+    "DEVCACHE_EVICTIONS",
+    "RPC_SENT_BYTES",
+    "RPC_RECV_BYTES",
+    "SHARD_WALL_SECONDS",
+    "CHUNK_READS",
+    "COALESCE_SHARE_SECONDS",
+    "SEARCH_CELL_SECONDS",
+]
+
+#: the closed category vocabulary — one constant per choke point, so the
+#: breakdown in ``GET /3/Traces/{id}`` reads the same on every node
+COMPILE_SECONDS = "compile_seconds"
+PLAN_CACHE_MISSES = "plan_cache_misses"
+DEVCACHE_UPLOAD_BYTES = "devcache_upload_bytes"
+DEVCACHE_EVICTIONS = "devcache_evictions"
+RPC_SENT_BYTES = "rpc_sent_bytes"
+RPC_RECV_BYTES = "rpc_recv_bytes"
+SHARD_WALL_SECONDS = "shard_wall_seconds"
+CHUNK_READS = "chunk_reads"
+COALESCE_SHARE_SECONDS = "coalesce_share_seconds"
+SEARCH_CELL_SECONDS = "search_cell_seconds"
+
+_CHARGES = telemetry.counter(
+    "ledger_charges_total",
+    "cost-ledger charge events by category (each event adds its amount "
+    "to the charging trace's per-node breakdown)",
+    labels=("category",),
+)
+_EVICTIONS = telemetry.counter(
+    "ledger_evictions_total",
+    "trace ledgers dropped by the H2O3_TPU_LEDGER_TRACES LRU bound",
+)
+_ENTRIES = telemetry.gauge(
+    "ledger_entries", "trace ledgers currently tracked"
+)
+_SLOWOPS = telemetry.counter(
+    "slowop_records_total",
+    "requests recorded into the slow-op exemplar ring",
+    labels=("route",),
+)
+
+#: per-category bound counter handles (categories are a small closed set)
+_charge_bound: Dict[str, telemetry._Bound] = {}
+
+
+def _bound_charge(category: str) -> telemetry._Bound:
+    b = _charge_bound.get(category)
+    if b is None:
+        b = _charge_bound[category] = _CHARGES.bind(category=category)
+    return b
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return max(1, int(os.environ.get(name, default)))
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_on(name: str, default: bool = True) -> bool:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.strip().lower() not in ("0", "false", "no", "off", "")
+
+
+#: per-trace span-breakdown bound: enough for any real request tree; a
+#: pathological trace folds its tail into one "_overflow" bucket instead
+#: of growing without limit
+_SPAN_CAP = 128
+
+
+class _TraceCosts:
+    __slots__ = ("nodes", "spans", "meta")
+
+    def __init__(self) -> None:
+        self.nodes: Dict[str, Dict[str, float]] = {}
+        self.spans: Dict[str, Dict[str, float]] = {}
+        self.meta: Dict[str, Any] = {}
+
+
+class CostLedger:
+    """Bounded process-wide map of per-trace resource charges.
+
+    The lock is a leaf: every region is pure dict work — no RPC, no
+    dispatch, no I/O, no other lock — so any charge site may call
+    :meth:`charge` while holding its own lock (devcache eviction does)
+    without joining the LOCK001/LOCK002 deadlock class."""
+
+    def __init__(self, max_traces: Optional[int] = None) -> None:
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, _TraceCosts]" = OrderedDict()
+        self._max_traces = (
+            _env_int("H2O3_TPU_LEDGER_TRACES", 512)
+            if max_traces is None else max(1, int(max_traces)))
+        self._enabled = _env_on("H2O3_TPU_LEDGER", True)
+
+    # -- switches ------------------------------------------------------------
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def set_enabled(self, on: bool) -> None:
+        """Flip charging on/off (the --obs-bench A/B switch; boot honors
+        ``H2O3_TPU_LEDGER``)."""
+        self._enabled = bool(on)
+
+    # -- the charge API ------------------------------------------------------
+    def charge(
+        self,
+        category: str,
+        amount: float,
+        trace_id: Optional[str] = None,
+        node: Optional[str] = None,
+        span_id: Optional[str] = None,
+    ) -> None:
+        """Add ``amount`` of ``category`` to the charging trace's ledger.
+
+        With no explicit ``trace_id`` the calling thread's open span is
+        the context — which is exactly the span/RPC-envelope context that
+        already threads one trace across nodes, so a remote dispatch
+        under an ``rpc_server`` span folds its charges back to the
+        originating trace.  No open trace: no-op (heartbeats stay free).
+        ``node`` defaults to the effective node identity (the serving
+        node inside a ``node_scope``)."""
+        if not self._enabled:
+            return
+        if trace_id is None:
+            sp = telemetry.current_span()
+            if sp is None or sp.trace_id is None:
+                return
+            trace_id = sp.trace_id
+            if span_id is None:
+                span_id = sp.span_id
+        if node is None:
+            node = telemetry.node_name() or "localhost"
+        amount = float(amount)
+        evicted = 0
+        with self._lock:
+            e = self._entries.get(trace_id)
+            if e is None:
+                e = self._entries[trace_id] = _TraceCosts()
+                while len(self._entries) > self._max_traces:
+                    self._entries.popitem(last=False)
+                    evicted += 1
+            else:
+                self._entries.move_to_end(trace_id)
+            cats = e.nodes.setdefault(node, {})
+            cats[category] = cats.get(category, 0.0) + amount
+            if span_id is not None:
+                sk = span_id if (span_id in e.spans
+                                 or len(e.spans) < _SPAN_CAP) else "_overflow"
+                scats = e.spans.setdefault(sk, {})
+                scats[category] = scats.get(category, 0.0) + amount
+            n_entries = len(self._entries)
+        # meters tick AFTER the leaf lock releases
+        _bound_charge(category).inc()
+        if evicted:
+            _EVICTIONS.inc(evicted)
+        _ENTRIES.set(n_entries)
+
+    def annotate(self, trace_id: Optional[str], **meta: Any) -> None:
+        """Attach request metadata (route, wall_ms, status) to an
+        EXISTING trace ledger — a request that charged nothing keeps no
+        entry, so annotation never grows the map."""
+        if not self._enabled or not trace_id:
+            return
+        with self._lock:
+            e = self._entries.get(trace_id)
+            if e is None:
+                return
+            e.meta.update(meta)
+
+    # -- read side -----------------------------------------------------------
+    def get(self, trace_id: str) -> Optional[Dict[str, Any]]:
+        """JSON-able cost breakdown for one trace, or None: per-node and
+        per-span category maps plus a cross-node ``total``."""
+        with self._lock:
+            e = self._entries.get(trace_id)
+            if e is None:
+                return None
+            nodes = {n: dict(c) for n, c in e.nodes.items()}
+            spans = {s: dict(c) for s, c in e.spans.items()}
+            meta = dict(e.meta)
+        total: Dict[str, float] = {}
+        for cats in nodes.values():
+            for k, v in cats.items():
+                total[k] = total.get(k, 0.0) + v
+        out: Dict[str, Any] = {
+            "trace_id": trace_id, "nodes": nodes, "spans": spans,
+            "total": total,
+        }
+        out.update(meta)
+        return out
+
+    def snapshot_many(
+            self, trace_ids: Sequence[str]) -> Dict[str, Dict[str, Any]]:
+        """Breakdowns for every known id in ``trace_ids`` (the
+        ``/3/Timeline?ledgers=true`` attachment)."""
+        out = {}
+        for tid in dict.fromkeys(trace_ids):  # de-dup, keep order
+            entry = self.get(tid)
+            if entry is not None:
+                out[tid] = entry
+        return out
+
+    def trace_ids(self) -> List[str]:
+        with self._lock:
+            return list(self._entries)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+        _ENTRIES.set(0)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+class SlowOpLog:
+    """The N worst traces per route, threshold-gated, ledgers attached.
+
+    A request slower than ``H2O3_TPU_SLOWOP_MS`` lands here with its
+    ledger snapshot frozen at record time — the exemplar an operator
+    drills into when the p99 moves, instead of an average that hides it.
+    The snapshot is taken BEFORE this object's lock so the region stays
+    a leaf (no ledger-lock nesting, no blocking work)."""
+
+    def __init__(self, threshold_ms: Optional[float] = None,
+                 per_route: Optional[int] = None) -> None:
+        self._lock = threading.Lock()
+        self._by_route: Dict[str, List[Dict[str, Any]]] = {}
+        self.threshold_ms = (
+            _env_float("H2O3_TPU_SLOWOP_MS", 250.0)
+            if threshold_ms is None else float(threshold_ms))
+        self.per_route = (
+            _env_int("H2O3_TPU_SLOWOP_PER_ROUTE", 8)
+            if per_route is None else max(1, int(per_route)))
+
+    def record(self, route: str, wall_ms: float,
+               trace_id: Optional[str] = None,
+               status: Optional[int] = None) -> bool:
+        """Consider one finished request; True when it entered the ring."""
+        if self.threshold_ms < 0 or wall_ms < self.threshold_ms:
+            return False
+        entry = LEDGER.get(trace_id) if trace_id else None  # pre-lock
+        rec = {
+            "route": route,
+            "wall_ms": round(float(wall_ms), 3),
+            "trace_id": trace_id,
+            "status": status,
+            "ts_ms": int(time.time() * 1000),
+            "ledger": entry,
+        }
+        kept = False
+        with self._lock:
+            ring = self._by_route.setdefault(route, [])
+            ring.append(rec)
+            ring.sort(key=lambda r: -r["wall_ms"])
+            del ring[self.per_route:]
+            kept = rec in ring
+        if kept:
+            _SLOWOPS.inc(route=route)
+        return kept
+
+    def snapshot(self, route: Optional[str] = None) -> Dict[str, Any]:
+        with self._lock:
+            routes = ([route] if route is not None
+                      else sorted(self._by_route))
+            out = {r: [dict(rec) for rec in self._by_route.get(r, [])]
+                   for r in routes}
+        return {"threshold_ms": self.threshold_ms,
+                "per_route": self.per_route,
+                "routes": {r: v for r, v in out.items() if v}}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._by_route.clear()
+
+
+#: process-wide instances (one ledger per node, like the WaterMeter)
+LEDGER = CostLedger()
+SLOWOPS = SlowOpLog()
+
+#: the terse charge-site spelling: ``_ledger.charge(CAT, amount)``
+charge = LEDGER.charge
+
+
+def set_enabled(on: bool) -> None:
+    LEDGER.set_enabled(on)

@@ -832,6 +832,8 @@ def install_jax_compile_listener() -> bool:
                 _JIT_COMPILES.inc()
                 _JIT_COMPILE_SECS.inc(secs)
                 _tls_compiles.count = getattr(_tls_compiles, "count", 0) + 1
+                _tls_compiles.seconds = (
+                    getattr(_tls_compiles, "seconds", 0.0) + secs)
 
         monitoring.register_event_duration_secs_listener(_on_duration)
         _jit_listener_installed = True
@@ -847,3 +849,9 @@ def thread_compile_count() -> int:
     """Compiles observed on the CALLING thread — per-dispatch deltas give
     correct cache hit/miss attribution under concurrent builds."""
     return getattr(_tls_compiles, "count", 0)
+
+
+def thread_compile_seconds() -> float:
+    """Compile wall seconds observed on the CALLING thread; the cost
+    ledger charges per-dispatch deltas of this to the open trace."""
+    return getattr(_tls_compiles, "seconds", 0.0)

@@ -120,6 +120,20 @@ def _counter_value(name: str, **labels) -> float:
     return c.value(**labels) if labels else c.total()
 
 
+def _counter_sum(name: str, **labels) -> float:
+    """Sum every series of ``name`` matching the given label subset —
+    for families with more labels than the caller pins (e.g.
+    ``rpc_payload_bytes_total{direction,method}`` summed over method)."""
+    from h2o3_tpu.util import telemetry
+
+    c = telemetry.REGISTRY.get(name)
+    if c is None:
+        return 0.0
+    return sum(
+        s["value"] for s in c.snapshot()["series"]
+        if all(s["labels"].get(k) == v for k, v in labels.items()))
+
+
 def mr_stat(cols, mask):
     """Module-level MR fn (crosses the wire by module reference)."""
     import jax.numpy as jnp
@@ -413,9 +427,9 @@ def s_kill_chunk_home(seed: int) -> Dict[str, bool]:
         frame_bytes = sum(
             serial.col(nm).numeric_view().nbytes for nm in serial.names)
 
-        sent0 = _counter_value("rpc_payload_bytes_total", direction="sent")
+        sent0 = _counter_sum("rpc_payload_bytes_total", direction="sent")
         dist = _tasks.distributed_map_reduce(mr_stat, fr, cloud=a)
-        sent_mr = _counter_value(
+        sent_mr = _counter_sum(
             "rpc_payload_bytes_total", direction="sent") - sent0
         v["mr_bit_identical"] = _tree_bytes(local) == _tree_bytes(dist)
         # map-side execution ships partials (plus gossip noise), never

@@ -24,6 +24,13 @@ Span contract: every span records parent_id, None for roots); other events
 under the same trace (plain ``timeline.record`` calls, ``timed`` blocks)
 attach beneath the span that was open when they were recorded.  Spans whose
 parent fell off the ring render as roots, flagged ``(orphan)``.
+
+When the snapshot was saved with ``?ledgers=true`` (a top-level
+``ledgers`` map of trace_id -> cost breakdown), each span line gains the
+cost columns the ledger attributed to it — ``$ compile 0.123s``,
+``upload 1.2KB`` (devcache bytes), ``wire 3.4KB`` (RPC bytes both
+directions) — and the trace header line shows the cross-node totals.
+Snapshots without ledger data render exactly as before.
 """
 
 from __future__ import annotations
@@ -87,10 +94,53 @@ def _label(ev: Dict[str, Any]) -> str:
     return " ".join(parts)
 
 
+def _fmt_bytes(n: float) -> str:
+    n = float(n)
+    if n < 1024:
+        return f"{int(n)}B"
+    for unit in ("KB", "MB", "GB"):
+        n /= 1024.0
+        if n < 1024 or unit == "GB":
+            return f"{n:.1f}{unit}"
+    return f"{n:.1f}GB"
+
+
+def _cost_suffix(costs: Optional[Dict[str, Any]]) -> str:
+    """The per-span cost columns: compile s / upload B / wire B (other
+    charged categories show as key=value so nothing is hidden)."""
+    if not costs:
+        return ""
+    shown = set()
+    parts = []
+    c = float(costs.get("compile_seconds", 0.0))
+    if c:
+        parts.append(f"compile {c:.3f}s")
+    shown.add("compile_seconds")
+    u = float(costs.get("devcache_upload_bytes", 0.0))
+    if u:
+        parts.append(f"upload {_fmt_bytes(u)}")
+    shown.add("devcache_upload_bytes")
+    w = (float(costs.get("rpc_sent_bytes", 0.0))
+         + float(costs.get("rpc_recv_bytes", 0.0)))
+    if w:
+        parts.append(f"wire {_fmt_bytes(w)}")
+    shown.update(("rpc_sent_bytes", "rpc_recv_bytes"))
+    for k in sorted(costs):
+        if k not in shown and costs[k]:
+            v = costs[k]
+            parts.append(f"{k}={v:.3f}" if isinstance(v, float)
+                         else f"{k}={v}")
+    return "  $ " + " ".join(parts) if parts else ""
+
+
 def render(events: List[Dict[str, Any]],
-           trace_id: Optional[str] = None) -> str:
+           trace_id: Optional[str] = None,
+           ledgers: Optional[Dict[str, Any]] = None) -> str:
     """The trace trees of ``events`` as indented text, one per trace,
-    newest trace last.  ``trace_id`` narrows to one trace."""
+    newest trace last.  ``trace_id`` narrows to one trace; ``ledgers``
+    (trace_id -> cost breakdown, the ``?ledgers=true`` attachment) adds
+    per-span cost columns and per-trace totals."""
+    ledgers = ledgers or {}
     traces: Dict[str, List[Dict[str, Any]]] = {}
     order: List[str] = []
     for ev in events:
@@ -121,14 +171,19 @@ def render(events: List[Dict[str, Any]],
             sid = e.get("span_id")
             (notes.setdefault(sid, []) if sid in by_id else loose).append(e)
 
+        ledger = ledgers.get(tid) if isinstance(ledgers, dict) else None
+        span_costs = (ledger or {}).get("spans") or {}
+        total_suffix = _cost_suffix((ledger or {}).get("total"))
+
         lines.append(
             f"trace {tid} ({len(spans)} span{'s' if len(spans) != 1 else ''}"
             + (f", {len(plain)} event{'s' if len(plain) != 1 else ''}"
-               if plain else "") + ")")
+               if plain else "") + ")" + total_suffix)
 
         def _walk(span: Dict[str, Any], depth: int) -> None:
             flag = " (orphan)" if span.get("_orphan") else ""
-            lines.append("  " * depth + _label(span) + flag)
+            lines.append("  " * depth + _label(span) + flag
+                         + _cost_suffix(span_costs.get(span.get("span_id"))))
             for note in sorted(notes.get(span["span_id"], []),
                                key=lambda e: e.get("ns", 0)):
                 lines.append("  " * (depth + 1) + "- " + _label(note))
@@ -164,7 +219,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     except (OSError, ValueError, json.JSONDecodeError) as e:
         print(f"trace_view: {e}", file=sys.stderr)
         return 1
-    sys.stdout.write(render(events, trace_id=args.trace))
+    ledgers = (payload.get("ledgers")
+               if isinstance(payload, dict) else None)
+    sys.stdout.write(render(events, trace_id=args.trace, ledgers=ledgers))
     return 0
 
 

@@ -1,0 +1,476 @@
+"""Per-trace cost attribution: the ledger, the slow-op exemplar log,
+`GET /3/Traces/{id}` federation, and the cluster-federated profiler.
+
+Reference frame: the reference's WaterMeter answers "what is this NODE
+doing"; the ledger answers "what did this REQUEST cost, where" — the
+per-step cost visibility the TF-paper line of work insists on.  The
+cluster halves run multiple Cloud instances in one process over real
+loopback sockets, which means every member shares ONE process-wide
+ledger — assertions merge-by-overwrite-aware, like the endpoint itself.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from h2o3_tpu.api.coalesce import Coalescer
+from h2o3_tpu.cluster.membership import Cloud, set_local_cloud
+from h2o3_tpu.util import ledger as L
+from h2o3_tpu.util import telemetry as T
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_HERE)
+
+
+def _mr_ledger_stat(cols, mask):
+    """Module-level map fn (crosses the wire by module reference); unique
+    to this file so its first dispatch is a guaranteed fresh compile."""
+    import jax.numpy as jnp
+
+    return {
+        "s": jnp.sum(jnp.where(mask, cols["x"] * 3.0, 0.0)),
+        "n": jnp.sum(mask.astype(jnp.float32)),
+    }
+
+
+def _wait_for(cond, timeout=10.0, every=0.02, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(every)
+    pytest.fail(f"timed out after {timeout}s waiting for {msg}")
+
+
+@pytest.fixture(autouse=True)
+def _clean_ledger():
+    L.LEDGER.clear()
+    L.SLOWOPS.clear()
+    yield
+    L.LEDGER.clear()
+    L.SLOWOPS.clear()
+
+
+@pytest.fixture()
+def two_clouds():
+    a = Cloud("ledgercloud", "node-a", hb_interval=0.05)
+    b = Cloud("ledgercloud", "node-b", hb_interval=0.05)
+    try:
+        a.start([])
+        b.start([a.info.addr])
+        _wait_for(
+            lambda: a.size() == 2 and b.size() == 2
+            and a.consensus() and b.consensus(),
+            msg="2-node cloud formation")
+        yield a, b
+    finally:
+        a.stop()
+        b.stop()
+
+
+@pytest.fixture()
+def cloud_server(two_clouds):
+    from h2o3_tpu.api import start_server
+
+    a, b = two_clouds
+    set_local_cloud(a)
+    srv = start_server(port=0)
+    try:
+        yield a, b, srv
+    finally:
+        srv.stop()
+        set_local_cloud(None)
+
+
+def _get(srv, path):
+    try:
+        with urllib.request.urlopen(srv.url + path) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+# ---------------------------------------------------------------------------
+# the ledger core
+
+
+class TestCostLedger:
+    def test_charge_attributes_by_node_span_and_category(self):
+        led = L.CostLedger(max_traces=16)
+        led.charge(L.COMPILE_SECONDS, 0.25, trace_id="t1", node="n1",
+                   span_id="s1")
+        led.charge(L.COMPILE_SECONDS, 0.75, trace_id="t1", node="n2",
+                   span_id="s2")
+        led.charge(L.RPC_SENT_BYTES, 100, trace_id="t1", node="n1",
+                   span_id="s1")
+        e = led.get("t1")
+        assert e["nodes"] == {
+            "n1": {"compile_seconds": 0.25, "rpc_sent_bytes": 100.0},
+            "n2": {"compile_seconds": 0.75},
+        }
+        assert e["spans"]["s1"]["rpc_sent_bytes"] == 100.0
+        # the cross-node total sums per-node maps
+        assert e["total"] == {"compile_seconds": 1.0,
+                              "rpc_sent_bytes": 100.0}
+
+    def test_charge_defaults_to_current_span_context(self):
+        led = L.CostLedger(max_traces=16)
+        with T.Span("ledger_unit") as sp:
+            led.charge(L.CHUNK_READS, 3)
+        e = led.get(sp.trace_id)
+        assert e is not None
+        (node,) = e["nodes"]
+        assert e["nodes"][node] == {"chunk_reads": 3.0}
+        assert e["spans"][sp.span_id] == {"chunk_reads": 3.0}
+
+    def test_untraced_charge_is_a_noop(self):
+        led = L.CostLedger(max_traces=16)
+        assert T.current_span() is None
+        led.charge(L.CHUNK_READS, 1)
+        assert len(led) == 0
+
+    def test_disabled_ledger_charges_nothing(self):
+        led = L.CostLedger(max_traces=16)
+        led.set_enabled(False)
+        led.charge(L.CHUNK_READS, 1, trace_id="t1")
+        assert len(led) == 0 and led.get("t1") is None
+        led.set_enabled(True)
+        led.charge(L.CHUNK_READS, 1, trace_id="t1")
+        assert led.get("t1")["total"] == {"chunk_reads": 1.0}
+
+    def test_lru_bound_evicts_oldest(self):
+        led = L.CostLedger(max_traces=4)
+        for i in range(10):
+            led.charge(L.CHUNK_READS, 1, trace_id=f"t{i}", node="n")
+        assert len(led) == 4
+        assert led.trace_ids() == ["t6", "t7", "t8", "t9"]
+        # a charge touches its trace: it survives the next eviction round
+        led.charge(L.CHUNK_READS, 1, trace_id="t6", node="n")
+        led.charge(L.CHUNK_READS, 1, trace_id="tA", node="n")
+        assert "t6" in led.trace_ids() and "t7" not in led.trace_ids()
+
+    def test_span_map_bounded_with_overflow_bucket(self):
+        led = L.CostLedger(max_traces=4)
+        for i in range(200):
+            led.charge(L.CHUNK_READS, 1, trace_id="t", node="n",
+                       span_id=f"sp{i}")
+        e = led.get("t")
+        assert len(e["spans"]) == 129  # _SPAN_CAP named spans + _overflow
+        assert e["spans"]["_overflow"]["chunk_reads"] == 72.0
+        # node-level attribution never truncates
+        assert e["nodes"]["n"]["chunk_reads"] == 200.0
+
+    def test_annotate_only_touches_existing_traces(self):
+        led = L.CostLedger(max_traces=4)
+        led.annotate("ghost", route="GET /x")
+        assert len(led) == 0
+        led.charge(L.CHUNK_READS, 1, trace_id="t", node="n")
+        led.annotate("t", route="GET /x", wall_ms=12.5)
+        e = led.get("t")
+        assert e["route"] == "GET /x" and e["wall_ms"] == 12.5
+
+    def test_charge_meter_counts_events_by_category(self):
+        c = T.REGISTRY.get("ledger_charges_total")
+        before = c.total()
+        led = L.CostLedger(max_traces=4)
+        led.charge(L.DEVCACHE_UPLOAD_BYTES, 4096, trace_id="t", node="n")
+        led.charge(L.DEVCACHE_UPLOAD_BYTES, 4096, trace_id="t", node="n")
+        assert c.total() == before + 2  # events, not bytes
+
+
+class TestSlowOpLog:
+    def test_threshold_gates_and_ring_keeps_the_worst(self):
+        log = L.SlowOpLog(threshold_ms=100.0, per_route=3)
+        assert log.record("GET /x", 99.9) is False
+        for w in (150.0, 500.0, 120.0, 300.0, 101.0):
+            log.record("GET /x", w)
+        snap = log.snapshot()
+        walls = [r["wall_ms"] for r in snap["routes"]["GET /x"]]
+        assert walls == [500.0, 300.0, 150.0]
+
+    def test_negative_threshold_disables(self):
+        log = L.SlowOpLog(threshold_ms=-1.0, per_route=3)
+        assert log.record("GET /x", 1e9) is False
+        assert log.snapshot()["routes"] == {}
+
+    def test_record_attaches_the_ledger_snapshot(self):
+        L.LEDGER.charge(L.COMPILE_SECONDS, 0.5, trace_id="slow-t",
+                        node="n1")
+        log = L.SlowOpLog(threshold_ms=0.0, per_route=2)
+        assert log.record("POST /y", 42.0, trace_id="slow-t", status=200)
+        rec = log.snapshot(route="POST /y")["routes"]["POST /y"][0]
+        assert rec["status"] == 200
+        assert rec["ledger"]["nodes"]["n1"]["compile_seconds"] == 0.5
+
+
+# ---------------------------------------------------------------------------
+# coalesced-batch share accounting
+
+
+class TestCoalesceShares:
+    def test_batch_of_k_splits_cost_evenly_and_sums_to_dispatch(self):
+        K, sleep_s = 4, 0.05
+        ran = threading.Event()
+
+        def batch_fn(payloads):
+            time.sleep(sleep_s)
+            ran.set()
+            return [p * 2 for p in payloads]
+
+        co = Coalescer(dispatch=lambda fn: fn(), window_s=30.0,
+                       max_rows=10**9, max_requests=K)
+        tids = [f"rider{i:02d}" for i in range(K)]
+        futs = [co.submit(batch_fn, "m1", i, trace_id=tids[i])
+                for i in range(K)]  # Kth submission trips max_requests
+        assert ran.wait(10)
+        assert [f.result(timeout=10) for f in futs] == [0, 2, 4, 6]
+        shares = []
+        for tid in tids:
+            e = L.LEDGER.get(tid)
+            assert e is not None, f"no ledger entry for {tid}"
+            shares.append(e["total"][L.COALESCE_SHARE_SECONDS])
+        # equal split, and the shares sum back to the one dispatch's wall
+        assert len(set(shares)) == 1
+        assert sum(shares) >= sleep_s
+        assert abs(sum(shares) - K * shares[0]) < 1e-12
+
+    def test_failed_batch_still_charges_riders(self):
+        def batch_fn(payloads):
+            time.sleep(0.01)
+            raise RuntimeError("scoring exploded")
+
+        co = Coalescer(dispatch=lambda fn: fn(), window_s=30.0,
+                       max_rows=10**9, max_requests=2)
+        f1 = co.submit(batch_fn, "m2", 1, trace_id="boom1")
+        f2 = co.submit(batch_fn, "m2", 2, trace_id="boom2")
+        with pytest.raises(RuntimeError):
+            f1.result(timeout=10)
+        with pytest.raises(RuntimeError):
+            f2.result(timeout=10)
+        for tid in ("boom1", "boom2"):
+            assert L.LEDGER.get(tid)["total"][L.COALESCE_SHARE_SECONDS] > 0
+
+    def test_untraced_riders_charge_nothing(self):
+        co = Coalescer(dispatch=lambda fn: fn(), window_s=30.0,
+                       max_rows=10**9, max_requests=1)
+        fut = co.submit(lambda ps: [p for p in ps], "m3", 7)
+        assert fut.result(timeout=10) == 7
+        assert len(L.LEDGER) == 0
+
+
+# ---------------------------------------------------------------------------
+# cross-node attribution: remote work folds back to the caller's trace
+
+
+class TestRemoteAttribution:
+    def test_remote_shard_charges_callers_trace_under_remote_node(
+            self, two_clouds):
+        import numpy as np
+
+        from h2o3_tpu.cluster import tasks as ctasks
+        from h2o3_tpu.cluster.tasks import distributed_map_reduce
+
+        ctasks.install(two_clouds[0])
+        ctasks.install(two_clouds[1])
+        x = np.arange(64, dtype=np.float64)
+        with T.Span("ledger_fit") as caller:
+            out = distributed_map_reduce(
+                _mr_ledger_stat, {"x": x}, reduce="sum",
+                cloud=two_clouds[0])
+        assert float(out["s"]) == float((x * 3.0).sum())
+        e = L.LEDGER.get(caller.trace_id)
+        assert e is not None
+        # the remote member executed its shard IN OUR TRACE, charged
+        # under ITS node name (the rpc_server envelope context)
+        assert "node-b" in e["nodes"], sorted(e["nodes"])
+        assert e["nodes"]["node-b"][L.SHARD_WALL_SECONDS] > 0
+        # the fresh map fn compiled somewhere inside this trace, and the
+        # mr_chunks payloads crossed the wire both ways
+        assert e["total"].get(L.COMPILE_SECONDS, 0) > 0
+        assert e["total"][L.RPC_SENT_BYTES] > 0
+        assert e["total"][L.RPC_RECV_BYTES] > 0
+
+    def test_traces_endpoint_federates_and_degrades(self, cloud_server):
+        import numpy as np
+
+        from h2o3_tpu.cluster import tasks as ctasks
+        from h2o3_tpu.cluster.tasks import distributed_map_reduce
+
+        a, b, srv = cloud_server
+        ctasks.install(a)
+        ctasks.install(b)
+        x = np.arange(32, dtype=np.float64)
+        with T.Span("rest_ledger_fit") as caller:
+            distributed_map_reduce(
+                _mr_ledger_stat, {"x": x}, reduce="sum", cloud=a)
+        st, out = _get(srv, f"/3/Traces/{caller.trace_id}")
+        assert st == 200
+        assert out["trace_id"] == caller.trace_id
+        assert out["partial"] is False
+        assert "node-b" in out["nodes"]
+        # overwrite-merge: the federated view matches the (shared,
+        # process-wide) local entry — per category, never multiplied by
+        # the member count
+        local = L.LEDGER.get(caller.trace_id)
+        assert out["total"] == local["total"]
+        st, _ = _get(srv, "/3/Traces/feedfacefeedface")
+        assert st == 404
+        # one dead member: still 200, partial, with node-a's data intact
+        b.stop()
+        a.client.pool.close_all()
+        st, out = _get(srv, f"/3/Traces/{caller.trace_id}")
+        assert st == 200 and out["partial"] is True
+        assert "node-b" in out["errors"]
+        assert out["total"][L.SHARD_WALL_SECONDS] > 0
+
+
+# ---------------------------------------------------------------------------
+# REST surface: slow-op log, ledgers-on-timeline, federated profiler
+
+
+class TestRestSurface:
+    def test_slowops_endpoint_captures_slow_requests(
+            self, cloud_server, monkeypatch):
+        _a, _b, srv = cloud_server
+        monkeypatch.setattr(L.SLOWOPS, "threshold_ms", 0.0)
+        st, _ = _get(srv, "/3/Ping")
+        assert st == 200
+        st, out = _get(srv, "/3/SlowOps")
+        assert st == 200
+        assert out["per_route"] >= 1
+        ping = [r for route, recs in out["routes"].items()
+                if "/3/Ping" in route for r in recs]
+        assert ping and ping[0]["wall_ms"] >= 0
+        # route narrowing
+        route = next(r for r in out["routes"] if "/3/Ping" in r)
+        st, out = _get(srv, "/3/SlowOps?route=" +
+                       urllib.request.quote(route, safe=""))
+        assert st == 200 and list(out["routes"]) == [route]
+
+    def test_timeline_ledgers_param_attaches_cost_breakdowns(
+            self, cloud_server):
+        _a, _b, srv = cloud_server
+        with T.Span("timeline_ledger_unit") as sp:
+            L.charge(L.DEVCACHE_UPLOAD_BYTES, 2048)
+        st, out = _get(srv, "/3/Timeline?count=500&ledgers=true")
+        assert st == 200
+        assert sp.trace_id in out["ledgers"]
+        entry = out["ledgers"][sp.trace_id]
+        assert entry["total"][L.DEVCACHE_UPLOAD_BYTES] == 2048.0
+        # without the param: no attachment
+        st, out = _get(srv, "/3/Timeline?count=50")
+        assert st == 200 and "ledgers" not in out
+
+    def test_cluster_profiler_merges_members_with_aggregate(
+            self, cloud_server):
+        _a, _b, srv = cloud_server
+        st, out = _get(srv, "/3/Profiler?cluster=true&duration=0.05")
+        assert st == 200
+        assert out["partial"] is False and out["errors"] == {}
+        names = [n["node_name"] for n in out["nodes"]]
+        assert names[-1] == "_cluster"
+        assert {"node-a", "node-b", "_cluster"} <= set(names)
+        agg = out["nodes"][-1]["profile"]
+        assert agg, "merged aggregate sampled no stacks"
+        assert all(
+            {"stacktrace", "count", "pct"} <= set(s) for s in agg)
+        # pct re-normalizes over the merged total
+        assert sum(s["pct"] for s in agg) <= 100.0 + 1e-6
+
+    def test_cluster_profiler_partial_when_member_down(self, cloud_server):
+        a, b, srv = cloud_server
+        b.stop()
+        a.client.pool.close_all()
+        st, out = _get(srv, "/3/Profiler?cluster=true&duration=0.05")
+        assert st == 200  # degraded, never a 5xx
+        assert out["partial"] is True
+        assert "node-b" in out["errors"]
+        names = {n["node_name"] for n in out["nodes"]}
+        assert "node-a" in names and "_cluster" in names
+
+    def test_local_profiler_path_unchanged_without_cluster_param(
+            self, cloud_server):
+        _a, _b, srv = cloud_server
+        st, out = _get(srv, "/3/Profiler?duration=0.05")
+        assert st == 200
+        assert "partial" not in out
+        assert len(out["nodes"]) == 1 and out["nodes"][0]["profile"]
+
+
+# ---------------------------------------------------------------------------
+# rpc byte meter: method label on ALL traffic
+
+
+class TestRpcByteMeter:
+    def test_payload_bytes_labelled_by_method(self, two_clouds):
+        a, b = two_clouds
+        c = T.REGISTRY.get("rpc_payload_bytes_total")
+
+        def _val(direction, method):
+            return sum(
+                s["value"] for s in c.snapshot()["series"]
+                if s["labels"].get("direction") == direction
+                and s["labels"].get("method") == method)
+
+        sent0, recv0 = _val("sent", "echo"), _val("received", "echo")
+        a.client.call(b.info.addr, "echo", b"ledger-bytes", timeout=5.0,
+                      target=b.info.ident)
+        assert _val("sent", "echo") > sent0
+        assert _val("received", "echo") > recv0
+        # heartbeat traffic meters under its own method label, so shard
+        # shipping is separable from gossip
+        _wait_for(lambda: _val("sent", "heartbeat") > 0,
+                  msg="heartbeat bytes to meter")
+
+
+# ---------------------------------------------------------------------------
+# trace_view cost columns
+
+
+class TestTraceViewCosts:
+    def _render(self, tmp_path, snap):
+        path = tmp_path / "snap.json"
+        path.write_text(json.dumps(snap))
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_ROOT, "scripts", "trace_view.py"),
+             str(path)],
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, proc.stderr
+        return proc.stdout
+
+    def test_ledger_snapshot_renders_cost_columns(self, tmp_path):
+        with T.Span("costed_view", route="/3/X") as outer:
+            L.charge(L.COMPILE_SECONDS, 0.125)
+            L.charge(L.DEVCACHE_UPLOAD_BYTES, 4096)
+            L.charge(L.RPC_SENT_BYTES, 1024)
+            L.charge(L.RPC_RECV_BYTES, 1024)
+        from h2o3_tpu.util import timeline
+        events = [e for e in timeline.snapshot(timeline.CAPACITY)
+                  if e.get("trace_id") == outer.trace_id]
+        snap = {"events": events,
+                "ledgers": L.LEDGER.snapshot_many([outer.trace_id])}
+        out = self._render(tmp_path, snap)
+        assert "compile 0.125s" in out
+        assert "upload 4.0KB" in out
+        assert "wire 2.0KB" in out
+        # the trace header carries the totals too
+        header = next(ln for ln in out.splitlines()
+                      if ln.startswith(f"trace {outer.trace_id}"))
+        assert "$" in header
+
+    def test_plain_snapshot_renders_without_cost_columns(self, tmp_path):
+        with T.Span("plain_view") as outer:
+            pass
+        from h2o3_tpu.util import timeline
+        events = [e for e in timeline.snapshot(timeline.CAPACITY)
+                  if e.get("trace_id") == outer.trace_id]
+        out = self._render(tmp_path, {"events": events})
+        assert f"trace {outer.trace_id}" in out
+        assert "$" not in out
