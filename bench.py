@@ -694,6 +694,157 @@ def _run_child(arg: str, timeout: int, extra_env=None):
     return False, None, "no JSON line in child stdout"
 
 
+def _dist_hist_cell() -> dict:
+    """The distributed-training cell of ``--hist-bench``: one GBM fit run
+    1-node (``H2O3_TPU_DIST_HIST=local`` — the same engine with every
+    histogram op executed caller-side, the bit-identity reference) and
+    again against a 3-node in-process cloud with the frame parsed onto
+    chunk homes (``models/tree/dist_hist.py``).  Reports fit wall and
+    mean per-level wall for both modes, the partials-vs-rows wire ratio
+    — histogram-partial bytes actually shipped vs the f64 frame body the
+    move-the-data path would ship — and the bit-identity flag.  The
+    partials bound is asserted in-run (``partials_bounded``): per level
+    at most ``n_nodes x n_features x (nbins+1) x 3 x 8`` bytes per home.
+    """
+    import pickle
+
+    import numpy as np
+
+    from h2o3_tpu.cluster import dkv as cdkv
+    from h2o3_tpu.cluster import tasks as ctasks
+    from h2o3_tpu.cluster.membership import Cloud, set_local_cloud
+    from h2o3_tpu.frame.parse import _iter_body_chunks, parse_setup
+    from h2o3_tpu.keyed import KeyedStore
+    from h2o3_tpu.models.grid import metric_value
+    from h2o3_tpu.models.tree.gbm import GBM, GBMParameters
+    from h2o3_tpu.util import telemetry
+
+    n = int(os.environ.get("BENCH_DIST_HIST_ROWS", 30_000))
+    nbins, depth, ntrees = 16, 3, 4
+
+    def _meter(name, **labels):
+        c = telemetry.REGISTRY.get(name)
+        if c is None:
+            return 0.0
+        return sum(s["value"] for s in c.snapshot()["series"]
+                   if all(s["labels"].get(k) == v
+                          for k, v in labels.items()))
+
+    cats = ("lo", "mid", "hi")
+    yes_no = ("no", "yes")
+    lines = ["x,y,z,c,resp"]
+    for i in range(n):
+        x, y, z = i % 97, (i * 7) % 31, (i * 13) % 53
+        lines.append(f"{x},{y},{z},{cats[i % 3]},"
+                     f"{yes_no[int((x * 3 + y) % 11 < 5)]}")
+    text = "\n".join(lines) + "\n"
+
+    clouds = []
+    for i in range(3):
+        c = Cloud("histbench", f"hb{i}", hb_interval=0.05)
+        cdkv.install(c, KeyedStore())
+        ctasks.install(c)
+        clouds.append(c)
+    seeds = [c.info.addr for c in clouds]
+    for c in clouds:
+        c.start([a for a in seeds if a != c.info.addr])
+    deadline = time.monotonic() + 20.0
+    while time.monotonic() < deadline and not all(
+            c.size() == 3 for c in clouds):
+        time.sleep(0.02)
+
+    saved = os.environ.get("H2O3_TPU_DIST_HIST")
+    try:
+        set_local_cloud(clouds[0])
+        setup = parse_setup(text)
+        chunks = list(_iter_body_chunks(
+            [text.encode()], 32768, setup.header, setup.skip_blank_lines))
+        fr = ctasks.distributed_parse_chunks(
+            chunks, setup, cloud=clouds[0], key="bench_dist_hist_df")
+        n_homes = len({g["home_name"]
+                       for g in fr.chunk_layout["groups"]})
+
+        def _fit():
+            m = GBM(GBMParameters(
+                response_column="resp", ntrees=ntrees, max_depth=depth,
+                nbins=nbins, min_rows=1.0, seed=17)).train(fr)
+            arrays = [
+                np.stack(getattr(t, f))
+                for t in m.booster.trees_per_class
+                for f in ("feat", "split_bin", "default_left",
+                          "is_split", "leaf")]
+            return pickle.dumps([arrays,
+                                 np.asarray(m.booster.init_margin),
+                                 metric_value(m, "auto")[0]])
+
+        def _timed_fit(mode):
+            os.environ["H2O3_TPU_DIST_HIST"] = mode
+            _fit()  # warms the mode's jit / binned contexts
+            lv0 = _meter("dist_hist_levels_total")
+            pb0 = _meter("dist_hist_partial_bytes_total")
+            w0 = _meter("rpc_payload_bytes_total", direction="sent")
+            t = time.perf_counter()
+            sig = _fit()
+            wall = time.perf_counter() - t
+            return {
+                "sig": sig,
+                "wall": wall,
+                "levels": _meter("dist_hist_levels_total") - lv0,
+                "partial_bytes": (
+                    _meter("dist_hist_partial_bytes_total") - pb0),
+                "sent_bytes": (
+                    _meter("rpc_payload_bytes_total",
+                           direction="sent") - w0),
+            }
+
+        local = _timed_fit("local")
+        dist = _timed_fit("1")
+
+        # the per-level arithmetic from the README: worst case
+        # 2^(depth-1) sibling nodes x F features x (nbins + 1 NA
+        # bucket) x {sum_g, sum_h, sum_w} x f64, per home
+        F, n_bins1 = 4, nbins + 1
+        per_level_cap = (1 << max(depth - 1, 0)) * F * n_bins1 * 3 * 8
+        frame_bytes = 8 * n * 5
+        partials_bounded = (
+            dist["levels"] > 0
+            and dist["partial_bytes"]
+            <= dist["levels"] * per_level_cap * n_homes)
+        return {
+            "rows": n,
+            "homes": n_homes,
+            "ntrees": ntrees,
+            "max_depth": depth,
+            "nbins": nbins,
+            "fit_wall_1node_ms": round(local["wall"] * 1e3, 1),
+            "fit_wall_3node_ms": round(dist["wall"] * 1e3, 1),
+            "level_ops_3node": int(dist["levels"]),
+            "mean_level_ms_1node": round(
+                local["wall"] * 1e3 / max(local["levels"], 1), 2),
+            "mean_level_ms_3node": round(
+                dist["wall"] * 1e3 / max(dist["levels"], 1), 2),
+            "partial_bytes": int(dist["partial_bytes"]),
+            "frame_body_bytes": frame_bytes,
+            "partials_vs_rows_ratio": round(
+                dist["partial_bytes"] / max(frame_bytes, 1), 4),
+            "wire_sent_bytes": int(dist["sent_bytes"]),
+            "partials_bounded": bool(partials_bounded),
+            "wire_under_frame": bool(dist["sent_bytes"] < frame_bytes),
+            "bit_identical": local["sig"] == dist["sig"],
+        }
+    finally:
+        if saved is None:
+            os.environ.pop("H2O3_TPU_DIST_HIST", None)
+        else:
+            os.environ["H2O3_TPU_DIST_HIST"] = saved
+        set_local_cloud(None)
+        for c in clouds:
+            try:
+                c.stop()
+            except Exception:
+                pass
+
+
 def _hist_bench() -> None:
     """CPU booster-histogram microbench (the XLA scatter path).
 
@@ -703,7 +854,9 @@ def _hist_bench() -> None:
     0..depth (2^level histogram nodes).  Per level it reports the cold
     wall (first call, plan compile included), the warm wall (median of
     repeat calls on the cached plan), the warm-plan delta between them,
-    and rows/s from the warm wall.  Prints ONE JSON line and mirrors it
+    and rows/s from the warm wall.  The ``dist_hist`` cell then prices
+    map-side training over chunk homes (see :func:`_dist_hist_cell`).
+    Prints ONE JSON line and mirrors it
     to HIST_BENCH.json.  CPU-only by construction: ``H2O3_TPU_HIST_IMPL``
     is pinned to ``scatter`` so numbers compare across hosts without a
     TPU in the loop (the Pallas kernel tier is scripts/bench_hist_kernel
@@ -767,6 +920,7 @@ def _hist_bench() -> None:
             "rows_per_sec": round(n / max(warm, 1e-9), 1),
         })
     deepest = levels[-1]
+    dist_cell = _dist_hist_cell()
     result = {
         "metric": "cpu_hist_scatter_rows_per_sec",
         "value": deepest["rows_per_sec"],
@@ -787,6 +941,7 @@ def _hist_bench() -> None:
             "make_bins_ms": round(make_bins_ms, 1),
             "apply_bins_ms": round(apply_bins_ms, 1),
             "per_level": levels,
+            "dist_hist": dist_cell,
             "vs_baseline_is": "level-0 rows/s / deepest-level rows/s",
         },
     }

@@ -57,9 +57,9 @@ _METRIC_SUFFIXES = ("_total", "_seconds", "_bytes", "_entries", "_workers",
 #: actually contain — ``##`` sections or ``###`` subsections (the cost
 #: ledger and cluster profiler live under ``## Observability``)
 _METRIC_SECTIONS = ("Observability", "Clustering", "Distributed Frames",
-                    "Distributed model search", "Failure model",
-                    "Serving plane", "Cost ledger & slow-op log",
-                    "Cluster profiler")
+                    "Distributed model search", "Distributed training",
+                    "Failure model", "Serving plane",
+                    "Cost ledger & slow-op log", "Cluster profiler")
 
 
 def readme_documented_routes(readme_path: str) -> set:
@@ -119,6 +119,7 @@ def live_metrics() -> set:
     import h2o3_tpu.cluster.faults   # noqa: F401  cluster_faults_* meters
     import h2o3_tpu.cluster.frames   # noqa: F401  cluster_chunk_* meters
     import h2o3_tpu.cluster.search   # noqa: F401  cluster_search_* meters
+    import h2o3_tpu.models.tree.dist_hist  # noqa: F401  dist_hist_* meters
     import h2o3_tpu.api.coalesce     # noqa: F401  predict_batch_size
     import h2o3_tpu.rapids.fusion    # noqa: F401  rapids_fusion_* meters
     import h2o3_tpu.util.ledger      # noqa: F401  ledger_* / slowop_* meters

@@ -63,8 +63,36 @@ _SESSIONS: Dict[str, Session] = {}
 def _get_frame(frame_id: str) -> Frame:
     fr = DKV.get(frame_id)
     if not isinstance(fr, Frame):
-        raise RestError(404, f"frame {frame_id!r} not found")
+        fr = _dist_frame_from_ring(frame_id)
+        if fr is None:
+            raise RestError(404, f"frame {frame_id!r} not found")
     return fr
+
+
+def _dist_frame_from_ring(frame_id: str) -> Optional[Frame]:
+    """A chunk-homed frame resolved from the DKV ring: any member whose
+    local registry misses the key can still serve (or fit against) a
+    frame parsed to homes elsewhere in the cloud — the layout and parse
+    setup live beside the chunks at MAX_REPLICAS depth."""
+    from h2o3_tpu.cluster import active_cloud
+
+    cloud = active_cloud()
+    store = getattr(cloud, "dkv_store", None) if cloud is not None else None
+    if store is None:
+        return None
+    from h2o3_tpu.cluster import frames as _frames
+
+    try:
+        layout = store.get(_frames.layout_key(frame_id))
+        if not isinstance(layout, dict):
+            return None
+        setup = store.get(_frames.setup_key(frame_id))
+        if setup is None:
+            return None
+        return _frames.DistFrame(
+            layout, _frames.setup_from_payload(setup), store)
+    except Exception:
+        return None
 
 
 def _get_model(model_id: str) -> Model:

@@ -110,6 +110,44 @@ def layout_key(frame_key: str) -> str:
     return f"fr#{frame_key}#layout"
 
 
+def setup_key(frame_key: str) -> str:
+    """The parse setup that produced the frame — stored beside the layout
+    so any member (a grid-search executor restoring a ``__dist__`` frame
+    reference, a REST handler resolving a key) can rebuild a full
+    :class:`DistFrame` handle from the ring alone."""
+    return f"fr#{frame_key}#setup"
+
+
+def setup_payload(setup) -> Dict[str, Any]:
+    """A :class:`~h2o3_tpu.frame.parse.ParseSetup` as a plain dict —
+    dataclasses are node-local in the DKV (``ROUTABLE_VALUE_TYPES``),
+    so the ring copy stored under :func:`setup_key` must be plain data
+    or it would silently never leave the caller."""
+    return {
+        "separator": setup.separator,
+        "header": bool(setup.header),
+        "column_names": list(setup.column_names),
+        "column_types": list(setup.column_types),
+        "na_strings": list(setup.na_strings),
+        "skip_blank_lines": bool(setup.skip_blank_lines),
+        "quote_char": setup.quote_char,
+    }
+
+
+def setup_from_payload(d: Dict[str, Any]):
+    from h2o3_tpu.frame.parse import ParseSetup
+
+    if not isinstance(d, dict):
+        return d  # already a ParseSetup (a caller-local store hit)
+    return ParseSetup(
+        separator=d["separator"], header=d["header"],
+        column_names=list(d["column_names"]),
+        column_types=list(d["column_types"]),
+        na_strings=tuple(d["na_strings"]),
+        skip_blank_lines=d["skip_blank_lines"],
+        quote_char=d["quote_char"])
+
+
 def chunk_key(anchor: str, i: int) -> str:
     """Chunk ``i`` (GLOBAL chunk index) of the group homed at ``anchor``."""
     return f"{anchor}#c{i}"
@@ -369,9 +407,18 @@ def distributed_parse_to_homes(
         "nbytes": int(sum(stored)),
         "stamp": _layout_stamp(espc, anchors),
     }
+    store.put(setup_key(key), setup_payload(setup), replicas=MAX_REPLICAS)
     store.put(layout_key(key), layout, replicas=MAX_REPLICAS)
     _CHUNK_HOMES.set(ngroups)
     return DistFrame(layout, setup, store)
+
+
+def materialize(frame):
+    """A plain resident :class:`Frame` from any frame handle — gathers a
+    :class:`DistFrame`'s chunks, passes an already-local frame through."""
+    if getattr(frame, "chunk_layout", None) is None:
+        return frame
+    return Frame(list(frame._cols), key=getattr(frame, "key", None))
 
 
 # ---------------------------------------------------------------------------

@@ -116,7 +116,19 @@ from h2o3_tpu.models.grid import cell_key, cell_seed  # noqa: E402,F401
 
 
 def frame_payload(fr) -> Dict[str, Any]:
-    """A Frame as plain host data (no rollup caches, no device arrays)."""
+    """A Frame as plain host data (no rollup caches, no device arrays).
+
+    A chunk-homed :class:`~h2o3_tpu.cluster.frames.DistFrame` ships as a
+    tiny ``__dist__`` reference instead — its rows are already on the
+    ring, so members rebuild the handle from the layout/setup keys and
+    train against the homes directly (map-side histograms for the tree
+    algos, lazy gather for everything else) rather than receiving a full
+    copy per member."""
+    if getattr(fr, "chunk_layout", None) is not None:
+        return {"__dist__": {
+            "frame_key": fr.key,
+            "stamp": fr.chunk_layout["stamp"],
+        }}
     return {
         "names": list(fr.names),
         "cols": [
@@ -131,9 +143,25 @@ def frame_payload(fr) -> Dict[str, Any]:
     }
 
 
-def frame_restore(payload: Optional[Dict[str, Any]]):
+def frame_restore(payload: Optional[Dict[str, Any]], store=None):
     if payload is None:
         return None
+    ref = payload.get("__dist__")
+    if ref is not None:
+        from h2o3_tpu.cluster import frames as _frames
+
+        if store is None:
+            raise _rpc.RpcFault(
+                f"no DKV store on this member to resolve chunk-homed "
+                f"frame {ref['frame_key']!r}", code=503)
+        layout = _frames._layout_for(store, ref["frame_key"], ref["stamp"])
+        setup = store.get(_frames.setup_key(ref["frame_key"]))
+        if setup is None:
+            raise _rpc.RpcFault(
+                f"parse setup for frame {ref['frame_key']!r} unreachable "
+                f"on the ring", code=404)
+        return _frames.DistFrame(
+            layout, _frames.setup_from_payload(setup), store)
     from h2o3_tpu.frame.frame import Column, ColType, Frame
 
     cols = [
@@ -197,8 +225,8 @@ def _ctx_drop(search_id: str) -> None:
 def search_init(payload: Dict[str, Any], cloud, store) -> Dict[str, Any]:
     """DTask ``search_init``: cache the search's frames on this member."""
     _ctx_put(payload["search_id"], {
-        "frame": frame_restore(payload["frame"]),
-        "valid": frame_restore(payload.get("valid")),
+        "frame": frame_restore(payload["frame"], store),
+        "valid": frame_restore(payload.get("valid"), store),
     })
     return {"ok": True}
 

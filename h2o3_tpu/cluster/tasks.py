@@ -252,6 +252,41 @@ def _task_search_end(payload: Dict[str, Any], cloud, store) -> Any:
     return _search.search_end(payload, cloud, store)
 
 
+@register_ctx_task("hist_open")
+def _task_hist_open(payload: Dict[str, Any], cloud, store) -> Any:
+    from h2o3_tpu.models.tree import dist_hist as _dh
+
+    return _dh.hist_open(payload, cloud, store)
+
+
+@register_ctx_task("hist_bind")
+def _task_hist_bind(payload: Dict[str, Any], cloud, store) -> Any:
+    from h2o3_tpu.models.tree import dist_hist as _dh
+
+    return _dh.hist_bind(payload, cloud, store)
+
+
+@register_ctx_task("hist_level")
+def _task_hist_level(payload: Dict[str, Any], cloud, store) -> Any:
+    from h2o3_tpu.models.tree import dist_hist as _dh
+
+    return _dh.hist_level(payload, cloud, store)
+
+
+@register_ctx_task("hist_replay")
+def _task_hist_replay(payload: Dict[str, Any], cloud, store) -> Any:
+    from h2o3_tpu.models.tree import dist_hist as _dh
+
+    return _dh.hist_replay(payload, cloud, store)
+
+
+@register_ctx_task("hist_fin")
+def _task_hist_fin(payload: Dict[str, Any], cloud, store) -> Any:
+    from h2o3_tpu.models.tree import dist_hist as _dh
+
+    return _dh.hist_fin(payload, cloud, store)
+
+
 # ---------------------------------------------------------------------------
 # fan-outs
 

@@ -97,3 +97,65 @@ def quantiles(x, probs: Sequence[float], mask=None) -> np.ndarray:
     ).astype(np.float64)
     v_lo, v_hi = vals[: len(p)], vals[len(p) :]
     return v_lo + frac * (v_hi - v_lo)
+
+
+# ---------------------------------------------------------------------------
+# mergeable per-partition sketches (GlobalQuantilesCalc over chunk homes)
+#
+# The distributed booster bins each feature ONCE from per-home sketch
+# partials: every chunk home summarizes its local rows (exact uniques for
+# low-cardinality columns, a dense quantile grid otherwise), the caller
+# merges the partials into global [nbins-1] edges, and only the tiny
+# sketches cross the wire — never rows.  The merge is a deterministic
+# function of the partials in canonical group order, so every topology
+# that sees the same group decomposition produces identical edges.
+
+
+def sketch_column(col: np.ndarray, nbins: int, grid: int = 8) -> dict:
+    """One partition's summary of a feature column (NaNs ignored):
+    ``{"n", "uniques"}`` when at most ``nbins`` distinct values exist,
+    else ``{"n", "q"}`` with a ``grid * nbins + 1``-point quantile grid."""
+    valid = col[~np.isnan(col)]
+    n = int(valid.size)
+    if n == 0:
+        return {"n": 0}
+    uniq = np.unique(valid.astype(np.float64))
+    if uniq.size <= nbins:
+        return {"n": n, "uniques": uniq}
+    q = np.quantile(valid.astype(np.float64),
+                    np.linspace(0.0, 1.0, grid * nbins + 1))
+    return {"n": n, "q": q}
+
+
+def merge_edges(parts, nbins: int) -> np.ndarray:
+    """Global interior bin edges [nbins-1] from per-partition sketches.
+
+    Low-cardinality columns (every partial exact, union still <= nbins)
+    get exact midpoint edges with +inf padding — the same low-card rule
+    as ``ops.histogram.make_bins``, so categorical codes and indicators
+    each keep their own bin.  Otherwise the pooled, count-weighted
+    sketch points answer the interior quantile targets."""
+    parts = [p for p in parts if p.get("n", 0) > 0]
+    if not parts:
+        return np.arange(nbins - 1, dtype=np.float64)
+    if all("uniques" in p for p in parts):
+        uniq = np.unique(np.concatenate([p["uniques"] for p in parts]))
+        if uniq.size <= nbins:
+            mids = (uniq[:-1] + uniq[1:]) / 2.0
+            e = np.full(nbins - 1, np.inf)
+            e[: mids.size] = mids
+            return e
+    pts_l, wts_l = [], []
+    for p in parts:
+        arr = np.asarray(p.get("q", p.get("uniques")), np.float64)
+        pts_l.append(arr)
+        wts_l.append(np.full(arr.size, p["n"] / arr.size, np.float64))
+    pts = np.concatenate(pts_l)
+    wts = np.concatenate(wts_l)
+    order = np.argsort(pts, kind="stable")
+    pts, wts = pts[order], wts[order]
+    cw = np.cumsum(wts)
+    qs = np.linspace(0.0, 1.0, nbins + 1)[1:-1]
+    idx = np.searchsorted(cw, qs * cw[-1], side="left")
+    e = pts[np.clip(idx, 0, pts.size - 1)]
+    return np.maximum.accumulate(e)

@@ -716,6 +716,17 @@ def train_boosted(
     and re-uploading. cache_frame_key links the entry to a DKV frame for
     lifecycle eviction. None bypasses the cache entirely.
     """
+    if getattr(X, "is_dist_hist", False):
+        # chunk-homed training: the level loop fans hist_level ctx-DTasks
+        # to the chunk homes and only histogram partials cross the wire
+        from h2o3_tpu.models.tree import dist_hist as _dist_hist
+
+        return _dist_hist.train_boosted_dist(
+            X, objective, y, n_class_trees, init_margin, params,
+            average=average, monitor=monitor,
+            score_interval=score_interval, timings=timings,
+            weights=weights, offset=offset)
+
     import time as _time
 
     from jax.sharding import NamedSharding, PartitionSpec as P

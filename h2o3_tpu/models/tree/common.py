@@ -13,7 +13,7 @@ reference's set-valued splits (``hex/tree/DTree.java``).
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import Any, List, Optional, Tuple
 
 import numpy as np
 
@@ -332,6 +332,10 @@ def tree_cache_token(frame: Frame, p, encoding: str):
     Returns None (cache bypass) for frames without version stamps."""
     from h2o3_tpu.frame import devcache
 
+    if getattr(frame, "chunk_layout", None) is not None:
+        # chunk-homed frame: frame_token would materialize every remote
+        # chunk just to stamp versions — bypass the device cache instead
+        return None
     tok = devcache.frame_token(frame)
     if tok is None:
         return None
@@ -363,6 +367,18 @@ def tree_fit_setup(frame: Frame, p, model_cls, use_offset: bool):
     mono) with the keep mask (NA response / zero-weight / NA-offset rows)
     already applied to X/y/weights/offset."""
     from h2o3_tpu.models.data_info import response_vector
+
+    if getattr(frame, "chunk_layout", None) is not None:
+        from h2o3_tpu.models.tree import dist_hist
+
+        enc = resolve_tree_encoding(
+            getattr(p, "categorical_encoding", "auto"))
+        if dist_hist.use_dist(frame, p, enc):
+            # chunk-homed frame + map-side engine eligible: rows stay on
+            # their homes, only sketches/aux vectors gather once
+            return dist_hist.dist_fit_setup(frame, p, model_cls, use_offset)
+        # ineligible combination (knob off, checkpoint, monotone, custom
+        # objective, explicit one-hot): materialize and run the legacy path
 
     ignored = list(p.ignored_columns)
     aux_cols = [p.weights_column] + ([p.offset_column] if use_offset else [])
@@ -553,11 +569,37 @@ class TreeModelBase(Model):
                     f"offset_column {off!r} has NA values in the scoring frame"
                 )
             margin = margin + off_vals[:, None]
+        return self._raw_from_margin(margin)
+
+    def _raw_from_margin(self, margin: np.ndarray) -> np.ndarray:
+        """Raw scores (probabilities / inverse-linked response) from the
+        ensemble margin — shared by the materializing predict path and the
+        distributed fit's margin-resident scoring."""
         return (
             margin_to_probs(self.distribution, margin)
             if self.is_classifier
             else link_inverse(self.distribution, margin[:, 0])
         )
+
+    def model_performance(self, frame: Frame) -> Any:
+        ev = getattr(self.booster, "dist_eval", None)
+        if ev is not None and frame is ev["frame"]:
+            # the distributed fit already holds this frame's final margins
+            # (over its kept rows) — score them without materializing rows
+            return self._metrics_from_dist(ev)
+        return super().model_performance(frame)
+
+    def _metrics_from_dist(self, ev: dict) -> Any:
+        raw = self._raw_from_margin(np.asarray(ev["margin"], np.float64))
+        y = np.asarray(ev["y"], np.float64)
+        w = ev.get("w")
+        if not self.is_classifier:
+            return M.regression_metrics(y, raw, weights=w)
+        if self.nclasses == 2:
+            return M.binomial_metrics(y, raw[:, 1], weights=w)
+        return M.multinomial_metrics(
+            y.astype(np.int64), raw, self.data_info.response_domain,
+            weights=w)
 
     def predict_contributions(self, frame: Frame, background_frame=None) -> Frame:
         """Exact per-feature SHAP contributions on the margin scale

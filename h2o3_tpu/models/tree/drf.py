@@ -45,9 +45,8 @@ class DRFParameters(ModelParameters):
 class DRFModel(TreeModelBase):
     algo_name = "drf"
 
-    def _predict_raw(self, frame: Frame) -> np.ndarray:
-        X = tree_matrix(self.data_info, frame, encoding=self.tree_encoding)
-        margin = self.booster.predict_margin(X)  # averaged leaf values per class
+    def _raw_from_margin(self, margin: np.ndarray) -> np.ndarray:
+        # margin: averaged leaf values per class
         if not self.is_classifier:
             return margin[:, 0]
         p = np.clip(margin, 1e-9, None)
@@ -68,20 +67,31 @@ class DRF(ModelBuilder):
         super().__init__(params or DRFParameters(**kw))
 
     def _fit(self, frame: Frame, valid: Optional[Frame] = None) -> DRFModel:
+        from h2o3_tpu.models.tree import dist_hist
+        from h2o3_tpu.models.tree.common import resolve_tree_encoding
+
         p: DRFParameters = self.params
-        ignored = list(p.ignored_columns)
-        if p.weights_column and p.weights_column not in ignored:
-            ignored.append(p.weights_column)
-        info = tree_data_info(frame, p.response_column, ignored)
-        y = response_vector(info, frame)
-        nclasses = len(info.response_domain) if info.response_domain else 1
-        model = DRFModel(p, info, "gaussian")
-        X = tree_matrix(info, frame, encoding=model.tree_encoding)
-        keep = ~np.isnan(y)
-        weights = extract_weights(frame, p, keep)
-        X, y = X[keep], y[keep]
-        if weights is not None:
-            weights = weights[keep]
+        if dist_hist.use_dist(frame, p, resolve_tree_encoding(
+                getattr(p, "categorical_encoding", "auto"))):
+            # chunk-homed frame: rows stay on their homes; the targets
+            # (and grad/hess) are rebuilt map-side at bind time
+            model, X, y, weights, nclasses = dist_hist.dist_drf_front(
+                frame, p, DRFModel)
+        else:
+            ignored = list(p.ignored_columns)
+            if p.weights_column and p.weights_column not in ignored:
+                ignored.append(p.weights_column)
+            info = tree_data_info(frame, p.response_column, ignored)
+            y = response_vector(info, frame)
+            nclasses = (len(info.response_domain)
+                        if info.response_domain else 1)
+            model = DRFModel(p, info, "gaussian")
+            X = tree_matrix(info, frame, encoding=model.tree_encoding)
+            keep = ~np.isnan(y)
+            weights = extract_weights(frame, p, keep)
+            X, y = X[keep], y[keep]
+            if weights is not None:
+                weights = weights[keep]
         F = X.shape[1]
 
         mtries = p.mtries

@@ -140,6 +140,49 @@ def apply_bins(X: np.ndarray, edges: np.ndarray) -> np.ndarray:
 
 
 # ---------------------------------------------------------------------------
+# histogram-partial payload guard (distributed training)
+
+
+class HistPartialTooLargeError(ValueError):
+    """A histogram partial would exceed the RPC frame limit.
+
+    Raised caller-side before a distributed fit starts (worst level of the
+    planned tree) and home-side before a partial ships, so the operator
+    sees the arithmetic and the remediation instead of a transport
+    ``MAX_FRAME_BYTES`` failure mid-level."""
+
+    def __init__(self, what: str, nbytes: int, limit: int,
+                 n_classes: int, n_nodes: int, n_features: int,
+                 n_bins1: int) -> None:
+        self.nbytes = int(nbytes)
+        self.limit = int(limit)
+        super().__init__(
+            f"histogram partial for {what} is {nbytes} bytes "
+            f"({n_classes} classes x {n_nodes} nodes x {n_features} "
+            f"features x {n_bins1} bins x 3 channels x 8 bytes) "
+            f"but the RPC frame limit leaves {limit}; lower "
+            f"H2O3_TPU_TREE_BLOCK to ship fewer class trees per level, "
+            f"or reduce max_depth / nbins")
+
+
+def guard_hist_payload(what: str, n_classes: int, n_nodes: int,
+                       n_features: int, n_bins1: int) -> int:
+    """Raise :class:`HistPartialTooLargeError` if a ``(classes, nodes,
+    features, bins, 3)`` float64 partial cannot fit one RPC frame.
+    Returns the payload size in bytes."""
+    nbytes = int(n_classes) * int(n_nodes) * int(n_features) \
+        * int(n_bins1) * 3 * 8
+    # lazy: ops must stay importable without the cluster package loaded
+    from h2o3_tpu.cluster import transport
+
+    limit = max(0, int(transport.MAX_FRAME_BYTES) - (1 << 16))
+    if nbytes > limit:
+        raise HistPartialTooLargeError(
+            what, nbytes, limit, n_classes, n_nodes, n_features, n_bins1)
+    return nbytes
+
+
+# ---------------------------------------------------------------------------
 # the scatter-add histogram
 
 

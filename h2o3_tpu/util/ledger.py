@@ -25,7 +25,8 @@ cache misses (``compute/mapreduce.py``), devcache upload bytes and
 evictions (``frame/devcache.py``), RPC wire bytes both directions
 (``cluster/rpc.py``), shard walls (``cluster/tasks.py``), chunk reads
 (``cluster/frames.py``), coalesced-batch shares (``api/coalesce.py``),
-and search cell walls (``cluster/search.py``).
+search cell walls (``cluster/search.py``), and distributed tree-level
+histogram walls per home (``models/tree/dist_hist.py``).
 
 Surface: ``GET /3/Traces/{trace_id}`` federates per-node ledgers over the
 ``trace_ledger`` RPC (``cluster/membership.py``); ``GET /3/Timeline``
@@ -68,6 +69,7 @@ __all__ = [
     "CHUNK_READS",
     "COALESCE_SHARE_SECONDS",
     "SEARCH_CELL_SECONDS",
+    "HIST_LEVEL_WALL",
 ]
 
 #: the closed category vocabulary — one constant per choke point, so the
@@ -82,6 +84,7 @@ SHARD_WALL_SECONDS = "shard_wall_seconds"
 CHUNK_READS = "chunk_reads"
 COALESCE_SHARE_SECONDS = "coalesce_share_seconds"
 SEARCH_CELL_SECONDS = "search_cell_seconds"
+HIST_LEVEL_WALL = "hist_level_wall"
 
 _CHARGES = telemetry.counter(
     "ledger_charges_total",

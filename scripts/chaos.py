@@ -518,6 +518,126 @@ def s_kill_chunk_home(seed: int) -> Dict[str, bool]:
     return v
 
 
+@scenario("kill_hist_home")
+def s_kill_hist_home(seed: int) -> Dict[str, bool]:
+    """Map-side distributed tree training through a home's death.  A CSV
+    parses ONTO the ring, a GBM reference fit runs with the same engine
+    forced caller-local (``H2O3_TPU_DIST_HIST=local``), then the
+    distributed fit fans ``hist_level`` ctx-DTasks to the chunk homes —
+    only ``(feature, bin, {Σg,Σh,Σw})`` partials cross the wire, proven
+    by the payload meter against the level arithmetic
+    ``n_nodes x F x (nbins+1) x 3 x 8 x n_homes``.  The nemesis makes
+    one home (never the caller) refuse every ``hist_level`` and stops
+    it mid-fit: the fit must finish down the replica rung of the ladder
+    (``cluster_fanout_recovered_total{path=replica}``), applying each
+    refused op exactly once (drops fault BEFORE the handler, so no
+    double-counted rows are possible), and the final trees + training
+    metric must be BIT-IDENTICAL to the pre-kill local reference."""
+    import pickle
+
+    from h2o3_tpu.cluster import faults
+    from h2o3_tpu.cluster import tasks as _tasks
+    from h2o3_tpu.cluster.frames import DistFrame
+    from h2o3_tpu.cluster.membership import set_local_cloud
+    from h2o3_tpu.frame.parse import _iter_body_chunks, parse_setup
+    from h2o3_tpu.models.grid import metric_value
+    from h2o3_tpu.models.tree.gbm import GBM, GBMParameters
+
+    n = 12000
+    xs = np.arange(n) % 97
+    ys = (np.arange(n) * 7) % 31
+    zs = (np.arange(n) * 13) % 53
+    cats = ("lo", "mid", "hi")
+    bins = ("no", "yes")
+    text = "x,y,z,c,resp\n" + "".join(
+        f"{xs[i]},{ys[i]},{zs[i]},{cats[i % 3]},"
+        f"{bins[int((xs[i] * 3 + ys[i]) % 11 < 5)]}\n" for i in range(n))
+    setup = parse_setup(text)
+    chunks = list(_iter_body_chunks(
+        [text.encode()], 16384, setup.header, setup.skip_blank_lines))
+
+    def _fit():
+        m = GBM(GBMParameters(
+            response_column="resp", ntrees=6, max_depth=3, nbins=16,
+            min_rows=1.0, seed=seed)).train(fr)
+        bt = m.booster
+        arrays = [np.stack(getattr(t, f)) for t in bt.trees_per_class
+                  for f in ("feat", "split_bin", "default_left",
+                            "is_split", "leaf")]
+        return pickle.dumps([arrays, np.asarray(bt.init_margin),
+                             metric_value(m, "auto")[0]])
+
+    clouds, stores, formed = _mini_cloud(3, hb=0.05, prefix="hh")
+    a = clouds[0]
+    v: Dict[str, bool] = {"formed": formed}
+    mode_prev = os.environ.get("H2O3_TPU_DIST_HIST")
+    set_local_cloud(a)
+    try:
+        fr = _tasks.distributed_parse_chunks(
+            chunks, setup, cloud=a, key=f"chaos_hist_{seed}")
+        lay = getattr(fr, "chunk_layout", None)
+        v["parsed_chunk_homed"] = isinstance(fr, DistFrame) and bool(lay)
+        if not v["parsed_chunk_homed"]:
+            return v
+
+        os.environ["H2O3_TPU_DIST_HIST"] = "local"
+        ref = _fit()
+        os.environ["H2O3_TPU_DIST_HIST"] = "1"
+
+        # healthy distributed fit: wire discipline
+        frame_bytes = 8 * int(lay["espc"][-1]) * len(lay["column_names"])
+        wire0 = _counter_sum("rpc_payload_bytes_total")
+        lv0 = _counter_value("dist_hist_levels_total")
+        pb0 = _counter_value("dist_hist_partial_bytes_total")
+        v["healthy_bit_identical"] = _fit() == ref
+        wire = _counter_sum("rpc_payload_bytes_total") - wire0
+        levels = _counter_value("dist_hist_levels_total") - lv0
+        partial = _counter_value("dist_hist_partial_bytes_total") - pb0
+        # per level each home ships <= n_nodes x F x n_bins1 x 3 x 8
+        per_level_cap = 4 * 4 * 17 * 3 * 8 * len(lay["groups"])
+        v["partials_bounded"] = (
+            levels > 0 and partial <= levels * per_level_cap)
+        v["wire_under_frame"] = wire < frame_bytes
+
+        # -- nemesis: one home refuses hist_level and dies mid-fit ------
+        victim_name = next(g["home_name"] for g in lay["groups"]
+                           if g["home_name"] != a.info.name)
+        victim = next(c for c in clouds if c.info.name == victim_name)
+        plan = faults.plan_from_dict({"seed": seed, "rules": [
+            {"action": "drop", "side": "server", "src": victim_name,
+             "method": "dtask:hist_level"},
+        ]})
+        faults.set_plan(plan)
+        rep0 = _counter_value("cluster_fanout_recovered_total",
+                              path="replica")
+        box: Dict[str, Any] = {}
+
+        def _train():
+            try:
+                box["sig"] = _fit()
+            except Exception as e:  # invariant failure, not a crash
+                box["err"] = e
+
+        th = threading.Thread(target=_train, daemon=True)
+        th.start()
+        time.sleep(0.3)
+        victim.stop()
+        th.join(timeout=120.0)
+        v["refusal_injected"] = plan.hits()[0] > 0
+        v["killed_fit_completed"] = "sig" in box
+        v["killed_fit_bit_identical"] = box.get("sig") == ref
+        v["replica_recovered"] = _counter_value(
+            "cluster_fanout_recovered_total", path="replica") > rep0
+    finally:
+        if mode_prev is None:
+            os.environ.pop("H2O3_TPU_DIST_HIST", None)
+        else:
+            os.environ["H2O3_TPU_DIST_HIST"] = mode_prev
+        set_local_cloud(None)
+        _teardown(clouds)
+    return v
+
+
 @scenario("kill_search_member")
 def s_kill_search_member(seed: int) -> Dict[str, bool]:
     """Distributed grid search through a member's death, then a

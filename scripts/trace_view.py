@@ -29,7 +29,8 @@ When the snapshot was saved with ``?ledgers=true`` (a top-level
 ``ledgers`` map of trace_id -> cost breakdown), each span line gains the
 cost columns the ledger attributed to it — ``$ compile 0.123s``,
 ``upload 1.2KB`` (devcache bytes), ``wire 3.4KB`` (RPC bytes both
-directions) — and the trace header line shows the cross-node totals.
+directions), ``hist 0.5s`` (distributed tree-level histogram wall) —
+and the trace header line shows the cross-node totals.
 Snapshots without ledger data render exactly as before.
 """
 
@@ -125,6 +126,10 @@ def _cost_suffix(costs: Optional[Dict[str, Any]]) -> str:
     if w:
         parts.append(f"wire {_fmt_bytes(w)}")
     shown.update(("rpc_sent_bytes", "rpc_recv_bytes"))
+    hl = float(costs.get("hist_level_wall", 0.0))
+    if hl:
+        parts.append(f"hist {hl:.3f}s")
+    shown.add("hist_level_wall")
     for k in sorted(costs):
         if k not in shown and costs[k]:
             v = costs[k]

@@ -38,7 +38,7 @@ def _run_twice(name):
 def test_scenarios_registered():
     names = set(chaos.SCENARIOS)
     assert {"dup_reorder", "slow_node", "partition_gossip",
-            "kill_chunk_home", "kill_search_member",
+            "kill_chunk_home", "kill_hist_home", "kill_search_member",
             "kill_fanout", "kill_grid"} <= names
     # the ISSUE floor: at least four scripted scenarios
     assert len(names) >= 4
@@ -58,6 +58,10 @@ def test_partition_gossip_deterministic():
 
 def test_kill_chunk_home_deterministic():
     _run_twice("kill_chunk_home")
+
+
+def test_kill_hist_home_deterministic():
+    _run_twice("kill_hist_home")
 
 
 def test_kill_search_member_deterministic():
