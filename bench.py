@@ -1275,6 +1275,10 @@ def _obs_bench() -> None:
       RTT (the loopback percentage is reported but pessimistic: a
       sub-100us RTT amplifies a ~2us fixed cost)
 
+    The same two workloads are re-run as ``flight`` cells with the
+    flight recorder ON vs OFF (ledger left on in both arms), proving the
+    always-on ring stays inside the same <5% p50 budget.
+
     Then the in-run attribution assertion: a REST request (bench-local
     route) whose handler runs ``distributed_map_reduce`` must leave a
     ledger on its trace carrying BOTH client-side categories (RPC bytes)
@@ -1295,6 +1299,7 @@ def _obs_bench() -> None:
     from h2o3_tpu.cluster.membership import Cloud, set_local_cloud
     from h2o3_tpu.frame.frame import Column, ColType, Frame
     from h2o3_tpu.rapids.runtime import Session, exec_rapids
+    from h2o3_tpu.util import flight as flight_mod
     from h2o3_tpu.util import ledger as ledger_mod
     from h2o3_tpu.util import telemetry
 
@@ -1305,20 +1310,23 @@ def _obs_bench() -> None:
         s = sorted(samples)
         return s[min(len(s) - 1, int(q * len(s)))]
 
-    def _ab(fn, n, warmup=3):
-        """Alternating-block A/B: returns (on_samples, off_samples)."""
+    def _ab(fn, n, warmup=3, toggle=None):
+        """Alternating-block A/B: returns (on_samples, off_samples).
+        ``toggle`` flips the subsystem under test (default: the cost
+        ledger; the flight cells pass the recorder's switch)."""
+        toggle = toggle or ledger_mod.set_enabled
         for _ in range(warmup):
             fn()
         on, off = [], []
         block = max(1, n // 4)
         for _ in range(4):
             for enabled, sink in ((True, on), (False, off)):
-                ledger_mod.set_enabled(enabled)
+                toggle(enabled)
                 for _ in range(block):
                     t = time.perf_counter()
                     fn()
                     sink.append(time.perf_counter() - t)
-        ledger_mod.set_enabled(True)
+        toggle(True)
         return on, off
 
     # -- cell 1: warm fused Rapids dispatch --------------------------------
@@ -1341,6 +1349,23 @@ def _obs_bench() -> None:
         "overhead_pct_p50": round(rap_pct, 2),
         "budget": {"pct_p50": 5.0},
         "within_budget": rap_pct <= 5.0,
+    }
+
+    # -- flight cell 1: same warm dispatch, recorder ON vs OFF ------------
+    # the hot serving path has no flight choke points (only evictions and
+    # shed record), so this cell proves the always-on default costs nothing
+    # where latency matters most
+    frap_on, frap_off = _ab(lambda: exec_rapids(expr, session), reps,
+                            toggle=flight_mod.set_enabled)
+    frap_on_ms = _pct(frap_on, 0.5) * 1e3
+    frap_off_ms = _pct(frap_off, 0.5) * 1e3
+    frap_pct = (frap_on_ms - frap_off_ms) / max(frap_off_ms, 1e-9) * 100
+    flight_rapids_cell = {
+        "flight_off_p50_ms": round(frap_off_ms, 3),
+        "flight_on_p50_ms": round(frap_on_ms, 3),
+        "overhead_pct_p50": round(frap_pct, 2),
+        "budget": {"pct_p50": 5.0},
+        "within_budget": frap_pct <= 5.0,
     }
 
     # -- cell 2 + attribution: 2-node cloud, REST front -------------------
@@ -1386,6 +1411,29 @@ def _obs_bench() -> None:
             "within_budget": overhead_us <= budget_us,
         }
 
+        # -- flight cell 2: traced echo, recorder ON vs OFF ---------------
+        # every successful non-heartbeat call appends one structured event
+        # to the ring — the recorder's worst per-call tax; same 500us
+        # reference-RTT budget as the ledger cell
+        fecho_on, fecho_off = _ab(_echo, reps * 4,
+                                  toggle=flight_mod.set_enabled)
+        f_on_us = _pct(fecho_on, 0.5) * 1e6
+        f_off_us = _pct(fecho_off, 0.5) * 1e6
+        f_overhead_us = f_on_us - f_off_us
+        flight_echo_cell = {
+            "flight_off_p50_us": round(f_off_us, 1),
+            "flight_on_p50_us": round(f_on_us, 1),
+            "overhead_us_p50": round(f_overhead_us, 1),
+            "overhead_pct_p50_loopback": round(
+                f_overhead_us / max(f_off_us, 1e-9) * 100, 1),
+            "budget": {
+                "pct_p50": 5.0,
+                "reference_rtt_us": ref_rtt_us,
+                "overhead_budget_us": budget_us,
+            },
+            "within_budget": f_overhead_us <= budget_us,
+        }
+
         # REST -> distributed_map_reduce attribution, through the full
         # middleware (the REST span is the trace root the remote shard
         # execution must fold back into)
@@ -1426,6 +1474,8 @@ def _obs_bench() -> None:
         b.stop()
 
     ok = (rapids_cell["within_budget"] and echo_cell["within_budget"]
+          and flight_rapids_cell["within_budget"]
+          and flight_echo_cell["within_budget"]
           and client_ok and remote_ok)
     result = {
         "metric": "ledger_overhead_pct_p50_warm_rapids",
@@ -1435,6 +1485,10 @@ def _obs_bench() -> None:
             "n_rows": n_rows,
             "rapids_warm_dispatch": rapids_cell,
             "rpc_echo_traced": echo_cell,
+            "flight": {
+                "rapids_warm_dispatch": flight_rapids_cell,
+                "rpc_echo_traced": flight_echo_cell,
+            },
             "rest_dmr_attribution": attribution,
         },
     }

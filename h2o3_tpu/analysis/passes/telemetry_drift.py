@@ -39,6 +39,7 @@ OBS_PREFIXES = (
     "/3/Timeline",
     "/3/Traces",
     "/3/SlowOps",
+    "/3/Diagnostics",
     "/3/Metrics",
     "/3/Profiler",
     "/3/JStack",
@@ -51,7 +52,7 @@ OBS_PREFIXES = (
 #: must actually contain
 _METRIC_SUFFIXES = ("_total", "_seconds", "_bytes", "_entries", "_workers",
                     "_inflight", "_depth", "_batch_size", "_connections",
-                    "_homes")
+                    "_homes", "_state")
 
 #: README sections whose backticked metric references the registry must
 #: actually contain — ``##`` sections or ``###`` subsections (the cost
@@ -59,7 +60,8 @@ _METRIC_SUFFIXES = ("_total", "_seconds", "_bytes", "_entries", "_workers",
 _METRIC_SECTIONS = ("Observability", "Clustering", "Distributed Frames",
                     "Distributed model search", "Distributed training",
                     "Failure model", "Serving plane",
-                    "Cost ledger & slow-op log", "Cluster profiler")
+                    "Cost ledger & slow-op log", "Cluster profiler",
+                    "Health plane")
 
 
 def readme_documented_routes(readme_path: str) -> set:
@@ -123,6 +125,8 @@ def live_metrics() -> set:
     import h2o3_tpu.api.coalesce     # noqa: F401  predict_batch_size
     import h2o3_tpu.rapids.fusion    # noqa: F401  rapids_fusion_* meters
     import h2o3_tpu.util.ledger      # noqa: F401  ledger_* / slowop_* meters
+    import h2o3_tpu.util.flight     # noqa: F401  flight_events_total
+    import h2o3_tpu.cluster.health  # noqa: F401  cluster_health_state
     from h2o3_tpu.util import telemetry
 
     return set(telemetry.REGISTRY.names())

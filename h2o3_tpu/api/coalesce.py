@@ -18,6 +18,7 @@ import time
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from h2o3_tpu.util import flight as _flight
 from h2o3_tpu.util import ledger as _ledger
 from h2o3_tpu.util import telemetry
 
@@ -88,11 +89,13 @@ class Coalescer:
         every rider (cost ledger)."""
         fut: Future = Future()
         full = False
+        opened = False
         with self._lock:
             b = self._open.get(key)
             if b is None:
                 b = _Batch(key, fn)
                 self._open[key] = b
+                opened = True
                 b.timer = threading.Timer(self.window_s, self._close, (b,))
                 b.timer.daemon = True
                 b.timer.start()
@@ -105,6 +108,9 @@ class Coalescer:
                     or b.rows > self.max_rows)
             if full:
                 self._detach(b)
+        if opened:  # flight events after the leaf lock releases
+            _flight.record(_flight.COALESCE, "info", "batch_open",
+                           trace_id=trace_id)
         if full:
             self._fire(b)
         return fut
@@ -141,6 +147,8 @@ class Coalescer:
 
     def _run(self, b: _Batch) -> None:
         _BATCH_SIZE.observe(len(b.entries))
+        _flight.record(_flight.COALESCE, "info", "batch_close",
+                       entries=len(b.entries), rows=int(b.rows))
         t0 = time.perf_counter()
         try:
             results = b.fn([p for p, _, _ in b.entries])

@@ -1444,11 +1444,43 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
     def slowops_ep(params):
         """The slow-op exemplar log: the N worst traces per route above
         the threshold, each with its ledger snapshot attached.
-        ``?route=`` filters to one route."""
+        ``?route=`` filters to one route.  The serving node's watchdog
+        summary rides along so one scrape answers "slow AND sick?"."""
+        from h2o3_tpu.cluster import health as health_mod
         from h2o3_tpu.util import ledger as ledger_mod
 
-        return ledger_mod.SLOWOPS.snapshot(
-            route=params.get("route") or None)
+        out = ledger_mod.SLOWOPS.snapshot(route=params.get("route") or None)
+        out["health"] = health_mod.summary()
+        return out
+
+    def diagnostics_ep(params):
+        """One-call support bundle: identity + knobs, watchdog verdicts,
+        the last-K flight events, worst SlowOps, membership view and
+        thread stacks.  ``?cluster=true`` federates over the
+        diagnostics_snapshot RPC — an unreachable member degrades the
+        answer to ``partial: true``, never a 5xx."""
+        from h2o3_tpu.cluster import health as health_mod
+
+        n = int(params.get("events", params.get("count", 200)))
+        if not _truthy(params.get("cluster")):
+            return health_mod.diagnostics_snapshot(
+                cloud=_active_cloud(), events=n)
+        cloud = _active_cloud()
+        if cloud is None:
+            bundle = health_mod.diagnostics_snapshot(events=n)
+            return {"kind": "diagnostics_cluster",
+                    "nodes": {bundle["node"]: bundle},
+                    "partial": False, "errors": {},
+                    "now": int(time.time() * 1000)}
+        results, errors = cloud.poll_members(
+            "diagnostics_snapshot", {"events": n})
+        return {
+            "kind": "diagnostics_cluster",
+            "nodes": {k: results[k] for k in sorted(results)},
+            "partial": bool(errors),
+            "errors": {k: errors[k] for k in sorted(errors)},
+            "now": int(time.time() * 1000),
+        }
 
     def jstack(params):
         """Real per-thread stack dump (util/JStackCollectorTask.java)."""
@@ -1559,6 +1591,8 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
     r.register("GET", "/3/Traces/{trace_id}", traces_ep,
                "per-trace cost ledger (node x category)")
     r.register("GET", "/3/SlowOps", slowops_ep, "slow-op exemplar log")
+    r.register("GET", "/3/Diagnostics", diagnostics_ep,
+               "support bundle (health, flight ring, slowops, stacks)")
     r.register("GET", "/3/JStack", jstack, "thread dump")
     r.register("GET", "/3/Logs", logs_ep, "recent log lines")
     r.register("GET", "/3/Logs/download", logs_download, "full log download")

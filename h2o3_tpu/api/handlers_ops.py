@@ -1007,9 +1007,12 @@ def register(r: RequestServer, server: H2OServer) -> None:  # noqa: C901
             if c is not None:
                 return _profiler_cluster(c, duration, depth, exclude)
             # no live cloud: the single-node answer, flagged complete
+        from h2o3_tpu.cluster import health as _health
+
         return {"nodes": [{
             "node_name": telemetry.node_name() or "localhost",
             "exclude": exclude,
+            "health": _health.summary(),
             "profile": profiler.collect(
                 duration_s=duration, depth=depth, exclude=exclude or None),
         }]}
@@ -1031,7 +1034,10 @@ def register(r: RequestServer, server: H2OServer) -> None:  # noqa: C901
             snap = results[name] or {}
             prof = snap.get("profile") or []
             nodes.append({
-                "node_name": name, "exclude": exclude, "profile": prof})
+                "node_name": name, "exclude": exclude,
+                # each member's watchdog verdict rode the profiler_snapshot
+                # payload — no second RPC to answer "is this node ok?"
+                "health": snap.get("health"), "profile": prof})
             for entry in prof:
                 key = tuple(entry.get("stacktrace") or ())
                 agg[key] = agg.get(key, 0) + int(entry.get("count", 0))

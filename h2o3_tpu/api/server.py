@@ -40,6 +40,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from h2o3_tpu import __version__
+from h2o3_tpu.util import flight as _flight
 from h2o3_tpu.util import ledger as _ledger
 from h2o3_tpu.util import telemetry
 
@@ -592,6 +593,11 @@ class H2OServer:
         from h2o3_tpu.util import log as _log
 
         _log.init()
+        # standalone REST nodes (no boot_node) still get a watchdog +
+        # crash hooks; on a clustered node boot_node already started it
+        from h2o3_tpu.cluster import health as _health
+
+        _health.start()
         opts = self.http
         self._pool = _WorkerPool(opts.workers)
         if opts.batch_window_ms > 0:
@@ -731,6 +737,9 @@ class H2OServer:
             with _HTTP_CONNS.track():
                 if self._nconns > self.http.max_conns:
                     _HTTP_SHED.inc(route="(connection_limit)")
+                    _flight.record(_flight.COALESCE, "warn", "shed",
+                                   route="(connection_limit)",
+                                   conns=self._nconns)
                     await _write_response(
                         writer, 429,
                         _body_bytes(429, "connection limit reached"),
@@ -907,6 +916,8 @@ class H2OServer:
         budget = self.http.budget_for(route)
         if self._route_inflight.get(route, 0) >= budget:
             _HTTP_SHED.inc(route=route)
+            _flight.record(_flight.COALESCE, "warn", "shed", route=route,
+                           why="route_budget")
             resp = (429,
                     _body_bytes(429, f"route {route} concurrency budget "
                                      f"({budget}) exhausted"),
@@ -919,6 +930,8 @@ class H2OServer:
                     and not self._draining)
         if not coalesce and self._queued >= self.http.queue:
             _HTTP_SHED.inc(route=route)
+            _flight.record(_flight.COALESCE, "warn", "shed", route=route,
+                           why="queue_full")
             resp = (429,
                     _body_bytes(429, f"request queue full "
                                      f"({self.http.queue})"),

@@ -41,6 +41,7 @@ from h2o3_tpu.cluster import rpc as _rpc
 from h2o3_tpu.cluster import transport
 from h2o3_tpu.cluster.dkv import MAX_REPLICAS
 from h2o3_tpu.frame.frame import ColType, Column, Frame, NA_CAT
+from h2o3_tpu.util import flight as _flight
 from h2o3_tpu.util import ledger as _ledger
 from h2o3_tpu.util import telemetry
 
@@ -632,8 +633,18 @@ def map_reduce_chunk_homed(
     with telemetry.Span("map_reduce_chunk_homed", groups=len(groups),
                         rows=int(layout["espc"][-1]), reduce=reduce):
         ctx = telemetry.current_trace_context()
+        fo = _flight.FANOUTS.begin("mr_chunk_homed", len(groups),
+                                   rows=int(layout["espc"][-1]))
+        _flight.record(_flight.FANOUT, "info", "schedule",
+                       kind="mr_chunk_homed", groups=len(groups))
 
         def _run(gi: int) -> None:
+            try:
+                _run_group(gi)
+            finally:
+                fo.progress()
+
+        def _run_group(gi: int) -> None:
             grp = groups[gi]
             payload = {"frame_key": layout["frame_key"],
                        "stamp": layout["stamp"], "g": gi,
@@ -668,6 +679,9 @@ def map_reduce_chunk_homed(
                             out = _tasks.submit(cloud, m, "mr_chunks",
                                                 payload, timeout=timeout)
                         _tasks._RECOVERED.inc(path="replica")
+                        _flight.record(_flight.RECOVERY, "warn",
+                                       "mr_group", path="replica",
+                                       group=gi, member=m.info.name)
                         partials[gi] = out
                         return
                     except (_rpc.RPCError, _rpc.RpcFault):
@@ -683,6 +697,9 @@ def map_reduce_chunk_homed(
                         out = _tasks.submit(cloud, m, "mr_chunks",
                                             payload, timeout=timeout)
                         _tasks._RECOVERED.inc(path="survivor")
+                        _flight.record(_flight.RECOVERY, "warn",
+                                       "mr_group", path="survivor",
+                                       group=gi, member=m.info.name)
                         partials[gi] = out
                         return
                     except (_rpc.RPCError, _rpc.RpcFault):
@@ -692,21 +709,28 @@ def map_reduce_chunk_homed(
                 try:
                     partials[gi] = _exec_local(gi)
                     _tasks._RECOVERED.inc(path="local")
+                    _flight.record(_flight.RECOVERY, "warn", "mr_group",
+                                   path="local", group=gi)
                 except BaseException as e:  # noqa: BLE001 — surfaced below
                     errors[gi] = e
 
         threads = [threading.Thread(target=_run, args=(gi,), daemon=True)
                    for gi in range(len(groups))]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join(timeout=timeout)
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=timeout)
+        finally:
+            fo.end()
 
         for gi in range(len(groups)):
             if partials[gi] is None and errors[gi] is None:
                 # never answered in the deadline: caller-local last resort
                 partials[gi] = _exec_local(gi)
                 _tasks._RECOVERED.inc(path="local")
+                _flight.record(_flight.RECOVERY, "warn", "mr_group",
+                               path="local", group=gi, deadline=True)
         for e in errors:
             if e is not None:
                 raise e

@@ -1,0 +1,463 @@
+"""Per-node stall watchdogs + the federated /3/Diagnostics bundle.
+
+The flight recorder (``util/flight.py``) remembers what a node DID; this
+module notices what a node is FAILING to do — while it happens, not in a
+post-mortem metrics scrape.  A single monitor thread per node evaluates
+declarative health rules over SNAPSHOTS of live state every
+``H2O3_TPU_HEALTH_INTERVAL_S`` seconds:
+
+===================  ====================================================
+check                fires when
+===================  ====================================================
+``rpc_stuck``        a client RPC has been in flight longer than
+                     ``H2O3_TPU_HEALTH_RPC_FACTOR`` x its full ladder
+                     budget (critical at 2x that)
+``fanout_stalled``   an active fan-out context has made no partial
+                     progress for ``H2O3_TPU_HEALTH_STALL_S`` seconds
+                     (critical at 2x)
+``heartbeat_overrun``  the local gossip cycle has not completed within
+                     ``H2O3_TPU_HEALTH_HB_FACTOR`` x ``hb_interval``
+                     (critical at 2x)
+``http_saturation``  ``http_queue_depth`` exceeds
+                     ``H2O3_TPU_HEALTH_QUEUE_PCT``% of the admission
+                     queue, or requests were shed
+                     (``H2O3_TPU_HEALTH_SHED``+) inside the sliding
+                     ``H2O3_TPU_HEALTH_WINDOW_S`` window
+``compile_storm``    more than ``H2O3_TPU_HEALTH_COMPILES`` jit compiles
+                     landed inside the sliding window (the ledger-visible
+                     recompile pathology)
+===================  ====================================================
+
+Every verdict TRANSITION fires a flight-recorder event and a log line;
+every tick publishes ``cluster_health_state{node,check}`` (0 ok,
+1 degraded, 2 critical).  A transition INTO critical escalates: all
+thread stacks are dumped into the flight ring (same path SIGUSR2 takes),
+so the crash file explains the stall even if the process never recovers.
+
+Locking discipline (LOCK001): the monitor owns no subsystem lock, ever —
+every input is a snapshot API (``rpc.inflight_snapshot()``,
+``flight.FANOUTS.snapshot()``, telemetry ``value()``/``total()`` reads,
+a single monotonic cycle stamp on the Cloud); its own verdict lock is a
+leaf around pure dict work.  Rule arithmetic lives in module-level pure
+functions so the window math is unit-testable without a thread.
+
+``diagnostics_snapshot()`` assembles this node's half of
+``GET /3/Diagnostics``: identity + ``H2O3_TPU_*`` knob snapshot, verdict
+table, last-K flight events, worst SlowOps, membership view, and thread
+stacks — federated by ``Cloud.poll_members`` under the established
+partial-never-5xx contract.  ``H2O3_TPU_HEALTH=0`` keeps the monitor
+from starting at boot.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from h2o3_tpu.util import flight as _flight
+from h2o3_tpu.util import telemetry
+
+__all__ = [
+    "HealthMonitor",
+    "MONITOR",
+    "start",
+    "stop",
+    "verdicts",
+    "summary",
+    "diagnostics_snapshot",
+    "thread_stacks",
+    # pure rule functions (unit-tested window arithmetic)
+    "rpc_stuck_rule",
+    "fanout_stall_rule",
+    "heartbeat_rule",
+    "http_saturation_rule",
+    "compile_storm_rule",
+]
+
+#: verdict severity order; gauge value = index
+STATES = ("ok", "degraded", "critical")
+_STATE_NUM = {s: float(i) for i, s in enumerate(STATES)}
+_STATE_SEV = {"ok": "info", "degraded": "warn", "critical": "error"}
+
+_HEALTH_STATE = telemetry.gauge(
+    "cluster_health_state",
+    "watchdog verdict per health check: 0 ok, 1 degraded, 2 critical",
+    labels=("node", "check"),
+)
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_on(name: str, default: bool = True) -> bool:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.strip().lower() not in ("0", "false", "no", "off", "")
+
+
+# ---------------------------------------------------------------------------
+# rule arithmetic: pure functions over snapshots, no I/O, no locks
+
+
+def rpc_stuck_rule(entries: List[Dict[str, Any]],
+                   factor: float) -> Tuple[str, str]:
+    """``entries`` from :func:`h2o3_tpu.cluster.rpc.inflight_snapshot`:
+    degraded when any call's age exceeds ``factor`` x its full ladder
+    budget, critical at twice that.  A healthy slow op — age inside its
+    own budget — never trips (the no-false-stall property the tests
+    pin)."""
+    worst, detail = "ok", ""
+    for e in entries:
+        budget = max(float(e.get("budget_s", 0.0)), 1e-9)
+        age = float(e.get("age_s", 0.0))
+        if age <= factor * budget:
+            continue
+        state = "critical" if age > 2.0 * factor * budget else "degraded"
+        if _STATE_NUM[state] > _STATE_NUM[worst]:
+            worst = state
+            detail = ("%s -> %s in flight %.2fs (budget %.2fs, attempt %d)"
+                      % (e.get("method", "?"), e.get("target", "?"), age,
+                         budget, int(e.get("attempt", 0))))
+    return worst, detail
+
+
+def fanout_stall_rule(entries: List[Dict[str, Any]],
+                      window_s: float) -> Tuple[str, str]:
+    """``entries`` from ``flight.FANOUTS.snapshot()``: an unfinished
+    fan-out idle past ``window_s`` is degraded, past 2x critical."""
+    worst, detail = "ok", ""
+    for e in entries:
+        if int(e.get("done", 0)) >= int(e.get("total", 0)):
+            continue
+        idle = float(e.get("idle_s", 0.0))
+        if idle <= window_s:
+            continue
+        state = "critical" if idle > 2.0 * window_s else "degraded"
+        if _STATE_NUM[state] > _STATE_NUM[worst]:
+            worst = state
+            detail = ("%s stalled %.1fs at %d/%d ranges"
+                      % (e.get("kind", "?"), idle, int(e.get("done", 0)),
+                         int(e.get("total", 0))))
+    return worst, detail
+
+
+def heartbeat_rule(cycle_age_s: Optional[float], hb_interval_s: float,
+                   factor: float) -> Tuple[str, str]:
+    """``cycle_age_s`` = seconds since the local gossip loop last
+    completed a cycle (None: no cloud running, trivially ok)."""
+    if cycle_age_s is None:
+        return "ok", ""
+    limit = factor * max(hb_interval_s, 1e-9) + 1.0
+    if cycle_age_s <= limit:
+        return "ok", ""
+    state = "critical" if cycle_age_s > 2.0 * limit else "degraded"
+    return state, ("gossip cycle overdue %.1fs (interval %.2fs)"
+                   % (cycle_age_s, hb_interval_s))
+
+
+def http_saturation_rule(depth: float, capacity: int, shed_delta: float,
+                         pct: int, shed_min: int) -> Tuple[str, str]:
+    if capacity > 0 and depth >= capacity:
+        return "critical", ("admission queue full (%d/%d)"
+                            % (int(depth), capacity))
+    degraded = []
+    if capacity > 0 and depth > capacity * pct / 100.0:
+        degraded.append("queue %d/%d" % (int(depth), capacity))
+    if shed_delta >= max(1, shed_min):
+        degraded.append("%d shed in window" % int(shed_delta))
+    if degraded:
+        return "degraded", ", ".join(degraded)
+    return "ok", ""
+
+
+def compile_storm_rule(compile_delta: float,
+                       threshold: int) -> Tuple[str, str]:
+    if compile_delta > 2 * threshold:
+        return "critical", "%d jit compiles in window" % int(compile_delta)
+    if compile_delta > threshold:
+        return "degraded", "%d jit compiles in window" % int(compile_delta)
+    return "ok", ""
+
+
+# ---------------------------------------------------------------------------
+# snapshot inputs (every one a point read; the monitor holds nothing open)
+
+
+def _metric_total(name: str) -> float:
+    m = telemetry.REGISTRY.get(name)
+    if m is None:
+        return 0.0
+    try:
+        return float(m.total())  # type: ignore[attr-defined]
+    except AttributeError:
+        return 0.0
+
+
+def _metric_value(name: str) -> float:
+    m = telemetry.REGISTRY.get(name)
+    if m is None:
+        return 0.0
+    try:
+        return float(m.value())  # type: ignore[attr-defined]
+    except (AttributeError, KeyError):
+        return 0.0
+
+
+def _cycle_age_s() -> Tuple[Optional[float], float]:
+    """(seconds since the local cloud's last completed gossip cycle,
+    its hb_interval) — (None, 1.0) when no cloud/loop is running."""
+    from h2o3_tpu.cluster import membership as _membership
+
+    cloud = _membership.local_cloud()
+    if cloud is None:
+        return None, 1.0
+    stamp = getattr(cloud, "last_cycle_mono", None)
+    if stamp is None or getattr(cloud, "_stopping", None) is None \
+            or cloud._stopping.is_set():
+        return None, float(getattr(cloud, "hb_interval", 1.0))
+    return time.monotonic() - stamp, float(cloud.hb_interval)
+
+
+class _WindowDelta:
+    """Value-now minus value-at-window-start over a sliding window of
+    (monotonic, value) samples — the shed-rate / compile-storm input."""
+
+    def __init__(self, window_s: float) -> None:
+        self.window_s = window_s
+        self._samples: "deque[Tuple[float, float]]" = deque()
+
+    def update(self, value: float) -> float:
+        now = time.monotonic()
+        self._samples.append((now, value))
+        while self._samples and self._samples[0][0] < now - self.window_s:
+            self._samples.popleft()
+        return value - self._samples[0][1]
+
+
+class HealthMonitor:
+    """The per-node watchdog thread.  Restartable: chaos scenarios stop
+    and start a fresh monitor per seeded run."""
+
+    def __init__(self, node: Optional[str] = None,
+                 interval_s: Optional[float] = None) -> None:
+        self.node = node or telemetry.node_name() or "localhost"
+        self.interval_s = (
+            _env_float("H2O3_TPU_HEALTH_INTERVAL_S", 1.0)
+            if interval_s is None else float(interval_s))
+        self.rpc_factor = _env_float("H2O3_TPU_HEALTH_RPC_FACTOR", 3.0)
+        self.stall_s = _env_float("H2O3_TPU_HEALTH_STALL_S", 10.0)
+        self.hb_factor = _env_float("H2O3_TPU_HEALTH_HB_FACTOR", 4.0)
+        self.queue_pct = _env_int("H2O3_TPU_HEALTH_QUEUE_PCT", 80)
+        self.shed_min = _env_int("H2O3_TPU_HEALTH_SHED", 1)
+        self.compiles = _env_int("H2O3_TPU_HEALTH_COMPILES", 20)
+        window_s = _env_float("H2O3_TPU_HEALTH_WINDOW_S", 30.0)
+        self._shed_win = _WindowDelta(window_s)
+        self._compile_win = _WindowDelta(window_s)
+        self._lock = threading.Lock()  # leaf: verdict dict only
+        self._verdicts: Dict[str, Dict[str, Any]] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._queue_cap = _env_int("H2O3_TPU_HTTP_QUEUE", 512)
+
+    # -- lifecycle -----------------------------------------------------------
+    @property
+    def running(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    def start(self) -> None:
+        if self.running:
+            return
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="health-monitor", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+        self._thread = None
+
+    def _loop(self) -> None:
+        self.tick()  # first verdict immediately, not one interval late
+        while not self._stop.wait(self.interval_s):
+            self.tick()
+
+    # -- one evaluation round ------------------------------------------------
+    def _checks(self) -> List[Tuple[str, Callable[[], Tuple[str, str]]]]:
+        from h2o3_tpu.cluster import rpc as _rpc
+
+        def _hb() -> Tuple[str, str]:
+            age, interval = _cycle_age_s()
+            return heartbeat_rule(age, interval, self.hb_factor)
+
+        return [
+            ("rpc_stuck", lambda: rpc_stuck_rule(
+                _rpc.inflight_snapshot(), self.rpc_factor)),
+            ("fanout_stalled", lambda: fanout_stall_rule(
+                _flight.FANOUTS.snapshot(), self.stall_s)),
+            ("heartbeat_overrun", _hb),
+            ("http_saturation", lambda: http_saturation_rule(
+                _metric_value("http_queue_depth"), self._queue_cap,
+                self._shed_win.update(_metric_total("http_shed_total")),
+                self.queue_pct, self.shed_min)),
+            ("compile_storm", lambda: compile_storm_rule(
+                self._compile_win.update(_metric_total("jit_compiles_total")),
+                self.compiles)),
+        ]
+
+    def tick(self) -> None:
+        """Evaluate every rule once (the loop body; tests call directly)."""
+        now_ms = int(time.time() * 1000)
+        for check, fn in self._checks():
+            try:
+                state, detail = fn()
+            except Exception as e:  # noqa: BLE001 — a broken rule must
+                state, detail = "ok", f"rule error: {e}"  # not kill the loop
+            with self._lock:
+                prev = self._verdicts.get(check)
+                changed = prev is None or prev["state"] != state
+                if changed:
+                    self._verdicts[check] = {
+                        "state": state, "detail": detail, "since_ms": now_ms}
+                else:
+                    prev["detail"] = detail
+            _HEALTH_STATE.set(_STATE_NUM[state], node=self.node, check=check)
+            if not changed:
+                continue
+            # transition: flight event + log line, stacks on -> critical
+            _flight.record(
+                _flight.HEALTH, _STATE_SEV[state], "verdict",
+                check=check, state=state, detail=detail)
+            from h2o3_tpu.util.log import get_logger
+
+            log = get_logger("health")
+            if state == "ok":
+                log.info("%s: %s recovered", self.node, check)
+            else:
+                log.warning("%s: %s %s — %s",
+                            self.node, check, state, detail)
+            if state == "critical":
+                _flight.dump_stacks(reason=f"watchdog:{check}")
+
+    # -- read side -----------------------------------------------------------
+    def verdicts(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {k: dict(v) for k, v in sorted(self._verdicts.items())}
+
+    def summary(self) -> Dict[str, Any]:
+        """The compact block /3/Profiler and /3/SlowOps embed: worst
+        state across checks plus the per-check states."""
+        with self._lock:
+            checks = {k: v["state"] for k, v in sorted(
+                self._verdicts.items())}
+        worst = "unknown" if not checks else max(
+            checks.values(), key=lambda s: _STATE_NUM[s])
+        return {"node": self.node, "state": worst, "checks": checks,
+                "running": self.running}
+
+
+#: process-wide monitor (replaced by start() so chaos runs get a fresh one)
+MONITOR = HealthMonitor()
+
+
+def start(node: Optional[str] = None,
+          interval_s: Optional[float] = None) -> HealthMonitor:
+    """Boot-time entry: (re)create and start the node's monitor, arm the
+    crash hooks, and register the crash-file enricher.  Honors
+    ``H2O3_TPU_HEALTH=0`` (returns the idle monitor without a thread)."""
+    global MONITOR
+    if MONITOR.running and node in (None, MONITOR.node):
+        return MONITOR
+    if MONITOR.running:
+        MONITOR.stop()
+    MONITOR = HealthMonitor(node=node, interval_s=interval_s)
+    _flight.set_crash_extras(
+        lambda: {"health": MONITOR.verdicts()})
+    if _env_on("H2O3_TPU_HEALTH", True):
+        _flight.install_crash_hooks()
+        MONITOR.start()
+    return MONITOR
+
+
+def stop() -> None:
+    MONITOR.stop()
+
+
+def verdicts() -> Dict[str, Dict[str, Any]]:
+    return MONITOR.verdicts()
+
+
+def summary() -> Dict[str, Any]:
+    return MONITOR.summary()
+
+
+# ---------------------------------------------------------------------------
+# the /3/Diagnostics bundle (per-member half, fanned out via poll_members)
+
+
+def thread_stacks(limit: int = 64) -> List[Dict[str, Any]]:
+    """Every live thread's current stack, JSON-able (the jstack shape)."""
+    frames = sys._current_frames()
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for ident, frame in list(frames.items())[:limit]:
+        out.append({
+            "thread": names.get(ident, str(ident)),
+            "frames": [ln.rstrip("\n")
+                       for ln in traceback.format_stack(frame)],
+        })
+    return out
+
+
+def knobs_snapshot() -> Dict[str, str]:
+    """Every ``H2O3_TPU_*`` env knob this process booted with."""
+    return {k: v for k, v in sorted(os.environ.items())
+            if k.startswith("H2O3_TPU_")}
+
+
+def diagnostics_snapshot(cloud: Any = None,
+                         events: int = 200) -> Dict[str, Any]:
+    """One node's diagnostics bundle: identity + knobs, health verdicts,
+    last-``events`` flight events, worst SlowOps, membership view, and
+    thread stacks.  Pure snapshot reads — safe to serve mid-wedge."""
+    from h2o3_tpu.util import ledger as _ledger
+
+    if cloud is None:
+        from h2o3_tpu.cluster import membership as _membership
+
+        cloud = _membership.local_cloud()
+    name = (cloud.info.name if cloud is not None
+            else telemetry.node_name() or "localhost")
+    return {
+        "kind": "diagnostics",
+        "node": name,
+        "pid": os.getpid(),
+        "now_ms": int(time.time() * 1000),
+        "knobs": knobs_snapshot(),
+        "health": {"summary": MONITOR.summary(),
+                   "verdicts": MONITOR.verdicts()},
+        "flight": _flight.RECORDER.snapshot(count=max(0, int(events))),
+        "slowops": _ledger.SLOWOPS.snapshot(),
+        "members": cloud.member_schemas() if cloud is not None else [],
+        "threads": thread_stacks(),
+    }

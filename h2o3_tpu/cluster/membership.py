@@ -36,6 +36,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from h2o3_tpu.cluster import faults as _faults
 from h2o3_tpu.cluster import rpc as _rpc
+from h2o3_tpu.util import flight as _flight
 from h2o3_tpu.util import telemetry
 
 _CLUSTER_SIZE = telemetry.gauge(
@@ -229,6 +230,9 @@ class Cloud:
         self._needs_rejoin = False
         self._stopping = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None
+        #: monotonic stamp of the last COMPLETED gossip cycle — the
+        #: heartbeat_overrun watchdog's only input from this class
+        self.last_cycle_mono: Optional[float] = None
         #: per-gossip-cycle callbacks (bounded anti-entropy piggybacks)
         self._cycle_hooks: List[Any] = []
         self.rpc_server.register("heartbeat", self._on_heartbeat)
@@ -243,6 +247,8 @@ class Cloud:
         self.rpc_server.register("timeline_snapshot", self._on_timeline_snapshot)
         self.rpc_server.register("profiler_snapshot", self._on_profiler_snapshot)
         self.rpc_server.register("trace_ledger", self._on_trace_ledger)
+        self.rpc_server.register("diagnostics_snapshot",
+                                 self._on_diagnostics_snapshot)
         self.rpc_server.register("members", lambda p: {
             "members": [m.info.ident for m in self.members_sorted()],
             "hash": self.cloud_hash(),
@@ -391,6 +397,7 @@ class Cloud:
                         f"{e.msg}", code=e.code) from e
             except _rpc.RPCError:
                 pass  # seed not up yet: the periodic loop keeps courting it
+        self.last_cycle_mono = time.monotonic()  # arm heartbeat_overrun
         self._hb_thread = threading.Thread(
             target=self._hb_loop, daemon=True,
             name=f"heartbeat-{self.info.name}")
@@ -569,11 +576,15 @@ class Cloud:
             if changed or peer_version > self.version:
                 self.version = max(self.version, peer_version) + (
                     1 if changed else 0)
+            rejoined = self._needs_rejoin
             if self._needs_rejoin:
                 # a fenced epoch just got acknowledged end-to-end: the
                 # peer accepted our rejoin beat at the current version
                 _REJOINS.inc()
             self._needs_rejoin = False
+        if rejoined:
+            _flight.record(_flight.MEMBERSHIP, "info", "rejoin",
+                           peer=receiver.ident, version=self.version)
 
     def _beat_quietly(self, addr: Tuple[str, int]) -> None:
         """One peer's beat with every outcome metered, never raising —
@@ -618,6 +629,7 @@ class Cloud:
             self._check_suspicion()
             self.consensus()
             self._publish_gauges()
+            self.last_cycle_mono = time.monotonic()
             for hook in list(self._cycle_hooks):
                 try:
                     hook()
@@ -634,6 +646,8 @@ class Cloud:
             self.version = max(
                 self.version, int(e.detail.get("version", self.version)))
             self._needs_rejoin = True
+        _flight.record(_flight.MEMBERSHIP, "warn", "fenced",
+                       version=self.version)
 
     def _check_suspicion(self) -> None:
         """Missed-beat suspicion → removal (Paxos's failure detection):
@@ -641,6 +655,7 @@ class Cloud:
         tombstone, bumping the cloud version) after twice that."""
         suspect_after = self.suspect_beats * self.hb_interval
         removed = []
+        suspected = []
         with self._lock:
             for name, m in list(self._members.items()):
                 if name == self.info.name:
@@ -654,7 +669,14 @@ class Cloud:
                     _REMOVALS.inc()
                 elif age > suspect_after and m.healthy:
                     m.healthy = False
+                    suspected.append((m.info.ident, age))
                     _SUSPICIONS.inc()
+        for ident, age in suspected:
+            _flight.record(_flight.MEMBERSHIP, "warn", "suspect",
+                           member=ident, silent_s=round(age, 2))
+        for ident in removed:
+            _flight.record(_flight.MEMBERSHIP, "error", "tombstone",
+                           member=ident, version=self.version)
         if removed:
             from h2o3_tpu.util.log import get_logger
 
@@ -706,15 +728,30 @@ class Cloud:
 
         p = payload or {}
         exclude = p.get("exclude")
+        from h2o3_tpu.cluster import health as _health
+
         return {
             "node": self.info.name,
             "exclude": exclude,
+            # the serving node's watchdog verdict rides the existing
+            # payload — one scrape answers "is this node ok", no 2nd RPC
+            "health": _health.summary(),
             "profile": profiler.collect(
                 duration_s=float(p.get("duration", 0.25)),
                 depth=int(p.get("depth", 10)),
                 exclude=exclude or None,
             ),
         }
+
+    def _on_diagnostics_snapshot(
+            self, payload: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+        """This node's diagnostics bundle — the per-member half of
+        ``GET /3/Diagnostics?cluster=true`` (knobs, verdicts, last-K
+        flight events, worst SlowOps, membership view, thread stacks)."""
+        from h2o3_tpu.cluster import health as _health
+
+        return _health.diagnostics_snapshot(
+            cloud=self, events=int((payload or {}).get("events", 200)))
 
     def _on_trace_ledger(
             self, payload: Optional[Dict[str, Any]]) -> Dict[str, Any]:
@@ -859,6 +896,11 @@ def boot_node(
         cloud.stop()
         set_local_cloud(None)
         raise
+    # the node's watchdog thread + crash hooks come up with the cloud
+    # (H2O3_TPU_HEALTH=0 leaves the monitor idle)
+    from h2o3_tpu.cluster import health as _health
+
+    _health.start(node=node_name)
     return cloud
 
 

@@ -52,6 +52,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 from h2o3_tpu.cluster import faults as _faults
 from h2o3_tpu.cluster import transport
+from h2o3_tpu.util import flight as _flight
 from h2o3_tpu.util import ledger as _ledger
 from h2o3_tpu.util import telemetry
 
@@ -105,6 +106,50 @@ _payload_bound: Dict[Tuple[str, str], telemetry._Bound] = {}
 #: wire direction -> cost-ledger category
 _LEDGER_BYTES_CAT = {"sent": _ledger.RPC_SENT_BYTES,
                      "received": _ledger.RPC_RECV_BYTES}
+
+#: in-flight CLIENT call table: the ``rpc_stuck`` watchdog rule reads
+#: :func:`inflight_snapshot` to find calls aged past N x their ladder
+#: budget — the gauge says HOW MANY are stuck, this says WHICH.  The
+#: lock is a leaf (pure dict work, ~1us per call round trip).
+_calls_lock = threading.Lock()
+_calls_inflight: Dict[int, Dict[str, Any]] = {}
+_calls_next = 0
+
+
+def _call_begin(method: str, target: str, timeout: float,
+                budget_s: float) -> int:
+    global _calls_next
+    entry = {"method": method, "target": target, "attempt": 0,
+             "timeout_s": float(timeout), "budget_s": float(budget_s),
+             "t0": time.monotonic()}
+    with _calls_lock:
+        _calls_next += 1
+        cid = _calls_next
+        _calls_inflight[cid] = entry
+    return cid
+
+
+def _call_attempt(cid: int, attempt: int) -> None:
+    with _calls_lock:
+        e = _calls_inflight.get(cid)
+        if e is not None:
+            e["attempt"] = attempt
+
+
+def _call_end(cid: int) -> None:
+    with _calls_lock:
+        _calls_inflight.pop(cid, None)
+
+
+def inflight_snapshot() -> list:
+    """JSON-able view of every client call currently in flight, each with
+    its ``age_s`` against the full ladder ``budget_s``."""
+    now = time.monotonic()
+    with _calls_lock:
+        entries = [dict(e) for e in _calls_inflight.values()]
+    for e in entries:
+        e["age_s"] = round(now - e.pop("t0"), 3)
+    return entries
 
 
 def _charge_bytes(direction: str, method: str, n: int) -> None:
@@ -331,10 +376,19 @@ class RpcClient:
         timed_out = False
         plan = _faults.active_plan()
         _INFLIGHT_CLIENT.inc()
+        cid = _call_begin(method, target, timeout,
+                          (ladder + 1) * timeout)
         try:
             for attempt in range(ladder + 1):
                 if attempt:
                     _RPC_RETRIES.inc()
+                    _call_attempt(cid, attempt)
+                    # every rung of the ladder is a flight event: after a
+                    # wedge, the recorder holds the full attempt trail
+                    _flight.record(
+                        _flight.RPC, "warn", "retry",
+                        trace_id=trace_ctx[0] if trace_ctx else None,
+                        method=method, target=target, attempt=attempt)
                     # FULL-jitter backoff, U(0, min(cap, base*2^(a-1))):
                     # N callers retrying against one recovering member
                     # spread out instead of re-converging into a
@@ -381,10 +435,22 @@ class RpcClient:
                 resp = pickle.loads(raw)
                 if resp.get("ok"):
                     _RPC_CALLS.inc(target=target, method=method, result="ok")
+                    if method != "heartbeat":  # gossip stays ring-free
+                        _flight.record(
+                            _flight.RPC, "info", "call",
+                            trace_id=trace_ctx[0] if trace_ctx else None,
+                            method=method, target=target,
+                            ms=round((time.perf_counter() - t0) * 1e3, 3))
                     return resp.get("value")
                 err = resp.get("error") or {}
                 _RPC_CALLS.inc(
                     target=target, method=method, result="remote_error")
+                _flight.record(
+                    _flight.RPC, "error", "remote_error",
+                    trace_id=trace_ctx[0] if trace_ctx else None,
+                    method=method, target=target,
+                    type=err.get("type", "Exception"),
+                    code=int(err.get("code", 500)))
                 raise RemoteError(
                     err.get("type", "Exception"),
                     err.get("msg", "remote call failed"),
@@ -393,6 +459,11 @@ class RpcClient:
                 )
             result = "timeout" if timed_out else "connect_error"
             _RPC_CALLS.inc(target=target, method=method, result=result)
+            _flight.record(
+                _flight.RPC, "error", result,
+                trace_id=trace_ctx[0] if trace_ctx else None,
+                method=method, target=target, attempts=ladder + 1,
+                timeout_s=timeout)
             if timed_out:
                 raise RPCTimeoutError(
                     f"{method} to {target} timed out after "
@@ -403,6 +474,7 @@ class RpcClient:
                 f"{ladder + 1} attempts: {last_exc}"
             ) from last_exc
         finally:
+            _call_end(cid)
             _INFLIGHT_CLIENT.dec()
             _observe_seconds(method, "client", time.perf_counter() - t0)
 
@@ -514,6 +586,8 @@ class RpcServer:
             _RPC_SERVED.inc(method=method, result="fault")
             if sp is not None:
                 sp.set(result="fault")
+            _flight.record(_flight.RPC, "warn", "dispatch_fault",
+                           method=method, code=e.code)
             return _encode({"ok": False, "error": {
                 "type": "RpcFault", "msg": str(e), "code": e.code,
                 "detail": e.detail,
@@ -522,6 +596,8 @@ class RpcServer:
             _RPC_SERVED.inc(method=method, result="error")
             if sp is not None:
                 sp.set(result="error")
+            _flight.record(_flight.RPC, "error", "dispatch_error",
+                           method=method, type=type(e).__name__)
             return _encode({"ok": False, "error": {
                 "type": type(e).__name__, "msg": str(e), "code": 500,
             }})

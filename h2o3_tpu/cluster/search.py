@@ -41,6 +41,7 @@ import numpy as np
 from h2o3_tpu.cluster import rpc as _rpc
 from h2o3_tpu.cluster import tasks as _tasks
 from h2o3_tpu.cluster.membership import Cloud
+from h2o3_tpu.util import flight as _flight
 from h2o3_tpu.util import ledger as _ledger
 from h2o3_tpu.util import telemetry
 from h2o3_tpu.util.log import get_logger
@@ -465,8 +466,11 @@ def fan_out(
         with qlock:
             results[idx] = outcome
             was_reassigned = idx in reassigned
+        _fo.progress()
         if outcome[0] == "ok" and was_reassigned:
             _RECOVERED.inc(path="survivor")
+            _flight.record(_flight.RECOVERY, "warn", "search_cell",
+                           path="survivor", cell=idx)
 
     def _requeue(idx: int) -> None:
         # failed-member cells go to the FRONT so survivors re-claim the
@@ -518,6 +522,9 @@ def fan_out(
 
     threads = []
     inflight = _inflight_per_member()
+    _fo = _flight.FANOUTS.begin("search", total, members=len(workers))
+    _flight.record(_flight.FANOUT, "info", "schedule", kind="search",
+                   cells=total, members=len(workers))
     with telemetry.Span("search_fanout", members=len(workers), cells=total):
         for member in workers:
             lanes = inflight if member.info.name != cloud.info.name else 1
@@ -542,7 +549,11 @@ def fan_out(
                 continue
             with qlock:
                 results[idx] = ("ok", out)
+            _fo.progress()
             _RECOVERED.inc(path="local")
+            _flight.record(_flight.RECOVERY, "warn", "search_cell",
+                           path="local", cell=idx)
+        _fo.end()
         # drop member-side caches eagerly; the LRU would get there anyway
         for member in workers:
             if member.info.name == cloud.info.name or not member.healthy:

@@ -37,6 +37,7 @@ import numpy as np
 
 from h2o3_tpu.cluster import rpc as _rpc
 from h2o3_tpu.cluster.membership import Cloud, Member
+from h2o3_tpu.util import flight as _flight
 from h2o3_tpu.util import ledger as _ledger
 from h2o3_tpu.util import telemetry
 
@@ -370,10 +371,15 @@ def distributed_map_reduce(
                              {"fn": fn, "columns": part, "reduce": reduce},
                              timeout=timeout)
                 _RECOVERED.inc(path="survivor")
+                _flight.record(_flight.RECOVERY, "warn", "mr_range",
+                               path="survivor", range=i,
+                               member=m2.info.name)
                 return out
             except _rpc.RPCError:
                 failed.add(m2.info.name)
         _RECOVERED.inc(path="local")
+        _flight.record(_flight.RECOVERY, "warn", "mr_range",
+                       path="local", range=i)
         return _mr_shard_local(fn, part, reduce)
 
     # one span covers the whole fan-out; its context is captured and handed
@@ -384,12 +390,18 @@ def distributed_map_reduce(
     with telemetry.Span("distributed_map_reduce", members=k, rows=int(n),
                         reduce=reduce):
         ctx = telemetry.current_trace_context()
+        # the watchdog's fanout_stalled rule reads this context: ranges
+        # scheduled now, progress ticked as each partial lands
+        fo = _flight.FANOUTS.begin("map_reduce", k, rows=int(n))
+        _flight.record(_flight.FANOUT, "info", "schedule",
+                       kind="map_reduce", members=k, rows=int(n))
 
         def _run(i: int, member: Member) -> None:
             lo, hi = bounds[i], bounds[i + 1]
             part = {name: np.ascontiguousarray(arr[lo:hi])
                     for name, arr in columns.items()}
             if hi <= lo:
+                fo.progress()
                 return  # empty range contributes the identity (skipped)
             with telemetry.Span(
                     "mr_member", trace_id=ctx["trace_id"],
@@ -407,13 +419,18 @@ def distributed_map_reduce(
                     errors[i] = e
                     failed.add(member.info.name)
                     partials[i] = _reschedule(i, part)
+                finally:
+                    fo.progress()
 
         threads = [threading.Thread(target=_run, args=(i, m), daemon=True)
                    for i, m in enumerate(workers)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join(timeout=timeout)
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=timeout)
+        finally:
+            fo.end()
 
         # take ONE snapshot per range: a member that answered contributes its
         # partial; a member that failed (error) already recovered inside _run;
@@ -434,6 +451,8 @@ def distributed_map_reduce(
                         for name, arr in columns.items()}
                 p = _mr_shard_local(fn, part, reduce)
                 _RECOVERED.inc(path="local")
+                _flight.record(_flight.RECOVERY, "warn", "mr_range",
+                               path="local", range=i, deadline=True)
                 recovered += 1
             parts.append(p)
         if recovered or any(e is not None for e in errors):
@@ -509,15 +528,24 @@ def distributed_parse_chunks(
                              {"chunk": chunk, "setup": setup},
                              timeout=timeout)
                 _RECOVERED.inc(path="survivor")
+                _flight.record(_flight.RECOVERY, "warn", "parse_chunk",
+                               path="survivor", chunk=i,
+                               member=m2.info.name)
                 return out
             except _rpc.RPCError:
                 failed.add(m2.info.name)
         _RECOVERED.inc(path="local")
+        _flight.record(_flight.RECOVERY, "warn", "parse_chunk",
+                       path="local", chunk=i)
         return _parse._parse_chunk(chunk, setup, na, napack)
 
     with telemetry.Span("distributed_parse", chunks=len(chunks),
                         members=len(workers)):
         ctx = telemetry.current_trace_context()
+        fo = _flight.FANOUTS.begin("parse", len(chunks),
+                                   members=len(workers))
+        _flight.record(_flight.FANOUT, "info", "schedule", kind="parse",
+                       chunks=len(chunks), members=len(workers))
 
         def _run(i: int, chunk: bytes, member: Member) -> None:
             # executor threads are not the caller's thread: join its trace
@@ -538,6 +566,8 @@ def distributed_parse_chunks(
                 except _rpc.RPCError:
                     failed.add(member.info.name)
                     results[i] = _recover_chunk(i, chunk, member)
+                finally:
+                    fo.progress()
 
         # bounded fan-out: a couple of chunks in flight per member pipelines
         # the stream at constant memory — one thread (and one pickled copy
@@ -547,12 +577,17 @@ def distributed_parse_chunks(
 
         ex = ThreadPoolExecutor(
             max_workers=2 * len(workers), thread_name_prefix="parse-fanout")
-        futs = [ex.submit(_run, i, c, workers[i % len(workers)])
-                for i, c in enumerate(chunks)]
-        _futures_wait(futs, timeout=timeout)
-        ex.shutdown(wait=False, cancel_futures=True)
+        try:
+            futs = [ex.submit(_run, i, c, workers[i % len(workers)])
+                    for i, c in enumerate(chunks)]
+            _futures_wait(futs, timeout=timeout)
+            ex.shutdown(wait=False, cancel_futures=True)
+        finally:
+            fo.end()
         for i, r in enumerate(results):
             if r is None:  # member never answered in time: tokenize here
                 _RECOVERED.inc(path="local")
+                _flight.record(_flight.RECOVERY, "warn", "parse_chunk",
+                               path="local", chunk=i, deadline=True)
                 results[i] = _parse._parse_chunk(chunks[i], setup, na, napack)
         return _parse._reduce_chunks(results, setup)

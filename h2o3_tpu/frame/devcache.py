@@ -29,6 +29,7 @@ import threading
 from collections import OrderedDict
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
+from h2o3_tpu.util import flight as _flight
 from h2o3_tpu.util import ledger as _ledger
 from h2o3_tpu.util import telemetry
 
@@ -263,8 +264,11 @@ class DeviceFrameCache:
                     del self._by_frame_key[fk]
         _EVICTIONS.inc(reason=reason)
         # the trace whose insertion (or invalidation) displaced the entry
-        # pays; the ledger lock is a leaf, safe under this cache's lock
+        # pays; the ledger and flight-ring locks are leaves, safe under
+        # this cache's lock
         _ledger.charge(_ledger.DEVCACHE_EVICTIONS, 1)
+        _flight.record(_flight.DEVCACHE, "info", "evict", reason=reason,
+                       nbytes=int(entry.nbytes))
 
     def _shrink(self) -> None:
         # caller holds the lock; never evict the most recent entry — a

@@ -58,6 +58,7 @@ from h2o3_tpu.compute.quantile import merge_edges, sketch_column
 from h2o3_tpu.frame.frame import ColType
 from h2o3_tpu.models.data_info import DataInfo
 from h2o3_tpu.ops.histogram import apply_bins, guard_hist_payload
+from h2o3_tpu.util import flight as _flight
 from h2o3_tpu.util import ledger as _ledger
 from h2o3_tpu.util import telemetry
 
@@ -685,6 +686,9 @@ class DistTreeMatrix:
             return [self._attempt(gi, "<caller>", task, p)
                     for gi, p in enumerate(payloads)]
         ctx = telemetry.current_trace_context()
+        fo = _flight.FANOUTS.begin(task, len(payloads))
+        _flight.record(_flight.FANOUT, "info", "schedule", kind=task,
+                       groups=len(payloads))
 
         def _run(gi: int, p: Dict[str, Any]):
             kw: Dict[str, Any] = {"group": gi, "task": task}
@@ -692,11 +696,17 @@ class DistTreeMatrix:
                 kw["trace_id"] = ctx["trace_id"]
                 kw["parent_id"] = ctx["span_id"]
             with telemetry.Span("hist_group", **kw):
-                return self._run_group(gi, task, p)
+                try:
+                    return self._run_group(gi, task, p)
+                finally:
+                    fo.progress()
 
-        futs = [self._ex.submit(_run, gi, p)
-                for gi, p in enumerate(payloads)]
-        return [f.result() for f in futs]
+        try:
+            futs = [self._ex.submit(_run, gi, p)
+                    for gi, p in enumerate(payloads)]
+            return [f.result() for f in futs]
+        finally:
+            fo.end()
 
     def _run_group(self, gi: int, task: str, payload: Dict[str, Any]):
         from h2o3_tpu.cluster import tasks as _tasks
@@ -736,10 +746,15 @@ class DistTreeMatrix:
                 continue
             if path != "home":
                 _tasks._RECOVERED.inc(path=path)
+                _flight.record(_flight.RECOVERY, "warn", "hist_group",
+                               path=path, group=gi, task=task,
+                               member=name)
             self._exec_map[gi] = name
             return out
         out = self._attempt(gi, "<caller>", task, payload)
         _tasks._RECOVERED.inc(path="local")
+        _flight.record(_flight.RECOVERY, "warn", "hist_group",
+                       path="local", group=gi, task=task)
         self._exec_map[gi] = "<caller>"
         return out
 

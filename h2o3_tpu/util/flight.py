@@ -1,0 +1,420 @@
+"""Per-node flight recorder: the bounded event ring the health plane reads.
+
+The ledger (PR 14) answers "what did that trace COST"; this module answers
+"what was this node DOING" — before a wedge, during a stall, after a
+crash.  It is H2O-3's water timeline recast for the health plane: a
+process-wide, lock-leaf, bounded ring of structured events written at the
+same choke points the ledger already charges into:
+
+* RPC client dispatch outcomes + every retry-ladder attempt
+  (``cluster/rpc.py``), server-side dispatch faults,
+* fan-out range scheduling and recovery-ladder rungs
+  (``cluster/tasks.py``, ``cluster/frames.py``, ``cluster/search.py``,
+  ``models/tree/dist_hist.py``),
+* membership suspicion / tombstone / rejoin transitions
+  (``cluster/membership.py``),
+* coalescer batch open/close and HTTP shed (``api/coalesce.py``,
+  ``api/server.py``), devcache evictions (``frame/devcache.py``),
+* watchdog verdict transitions and stack dumps
+  (``cluster/health.py``).
+
+Each event is a compact dict: monotonic ``seq``, wall-clock ``ts_ms``,
+``category`` (closed vocabulary below), ``severity`` (info/warn/error/
+critical), the recording ``node``, the open span's ``trace_id`` when one
+exists, and a small payload.  The ring holds the last
+``H2O3_TPU_FLIGHT_EVENTS`` (default 2048) events; older events are
+overwritten — a flight recorder, not a log.
+
+Crash/stall capture: :func:`install_crash_hooks` wires ``SIGUSR2`` (and
+the watchdog's stall escalation calls :func:`dump_stacks` directly) to
+dump every thread's stack INTO the ring, arms ``faulthandler`` so fatal
+signals append C-level tracebacks to a sidecar file, and registers an
+``atexit`` hook persisting the final ring to
+``$H2O3_TPU_FLIGHT_CRASH_DIR/flight-<node>-<pid>.json`` (crash files are
+written only when that knob names a directory).  ``scripts/diag_view.py``
+renders the saved file.
+
+In-flight fan-out state: :class:`FanoutTracker` (module instance
+``FANOUTS``) is the registry the ``fanout_stalled`` watchdog rule reads —
+``begin()`` at scheduling time, ``progress()`` per completed range,
+``end()`` in a finally.  Pure dict work under a leaf lock.
+
+Locking discipline (LOCK001): the ring lock is a LEAF — pure
+list/dict/deque work, no RPC, no I/O, no other lock — so any choke point
+may record while holding its own lock (devcache eviction does); the
+``flight_events_total{category}`` meter ticks after the lock releases.
+``H2O3_TPU_FLIGHT=0`` disables recording entirely (the --obs-bench A/B
+switch flips the same flag at runtime).
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import signal
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+from h2o3_tpu.util import telemetry
+
+__all__ = [
+    "FlightRecorder",
+    "FanoutTracker",
+    "RECORDER",
+    "FANOUTS",
+    "record",
+    "set_enabled",
+    "dump_stacks",
+    "install_crash_hooks",
+    "persist_crash",
+    "crash_path",
+    "set_crash_extras",
+    # event-category constants (the closed vocabulary)
+    "RPC",
+    "FANOUT",
+    "RECOVERY",
+    "MEMBERSHIP",
+    "COALESCE",
+    "DEVCACHE",
+    "HEALTH",
+    "STACKS",
+    "CRASH",
+]
+
+#: the closed category vocabulary — one constant per choke-point family,
+#: so ``flight_events_total{category}`` and the /3/Diagnostics bundle read
+#: the same on every node
+RPC = "rpc"
+FANOUT = "fanout"
+RECOVERY = "recovery"
+MEMBERSHIP = "membership"
+COALESCE = "coalesce"
+DEVCACHE = "devcache"
+HEALTH = "health"
+STACKS = "stacks"
+CRASH = "crash"
+
+#: severities, worst-last (diag_view sorts with this)
+SEVERITIES = ("info", "warn", "error", "critical")
+
+_EVENTS = telemetry.counter(
+    "flight_events_total",
+    "flight-recorder events appended to the ring, by category",
+    labels=("category",),
+)
+
+#: per-category bound counter handles (categories are a small closed set)
+_event_bound: Dict[str, telemetry._Bound] = {}
+
+
+def _bound_event(category: str) -> telemetry._Bound:
+    b = _event_bound.get(category)
+    if b is None:
+        b = _event_bound[category] = _EVENTS.bind(category=category)
+    return b
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return max(1, int(os.environ.get(name, default)))
+    except ValueError:
+        return default
+
+
+def _env_on(name: str, default: bool = True) -> bool:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.strip().lower() not in ("0", "false", "no", "off", "")
+
+
+class FlightRecorder:
+    """Bounded process-wide ring of structured health-plane events.
+
+    The lock is a leaf: every region is pure deque/dict work, so choke
+    points may record while holding their own locks (devcache does)
+    without joining the LOCK001/LOCK002 deadlock class."""
+
+    def __init__(self, capacity: Optional[int] = None) -> None:
+        self._lock = threading.Lock()
+        self._capacity = (
+            _env_int("H2O3_TPU_FLIGHT_EVENTS", 2048)
+            if capacity is None else max(1, int(capacity)))
+        self._ring: "deque[Dict[str, Any]]" = deque(maxlen=self._capacity)
+        self._seq = 0
+        self._enabled = _env_on("H2O3_TPU_FLIGHT", True)
+
+    # -- switches ------------------------------------------------------------
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def set_enabled(self, on: bool) -> None:
+        """Flip recording on/off (the --obs-bench A/B switch; boot honors
+        ``H2O3_TPU_FLIGHT``)."""
+        self._enabled = bool(on)
+
+    @property
+    def capacity(self) -> int:
+        return self._capacity
+
+    # -- the record API ------------------------------------------------------
+    def record(self, category: str, severity: str = "info",
+               msg: str = "", trace_id: Optional[str] = None,
+               **payload: Any) -> None:
+        """Append one event.  With no explicit ``trace_id`` the calling
+        thread's open span supplies one (same attribution context as the
+        ledger, one attribute read when untraced).  Payload values must
+        be small and JSON-able — this is a flight recorder, not a log."""
+        if not self._enabled:
+            return
+        if trace_id is None:
+            sp = telemetry.current_span()
+            if sp is not None:
+                trace_id = sp.trace_id
+        ev: Dict[str, Any] = {
+            "ts_ms": int(time.time() * 1000),
+            "category": category,
+            "severity": severity,
+            "node": telemetry.node_name() or "localhost",
+            "msg": msg,
+        }
+        if trace_id is not None:
+            ev["trace_id"] = trace_id
+        if payload:
+            ev.update(payload)
+        with self._lock:
+            self._seq += 1
+            ev["seq"] = self._seq
+            self._ring.append(ev)
+        # the meter ticks AFTER the leaf lock releases
+        _bound_event(category).inc()
+
+    # -- read side -----------------------------------------------------------
+    def snapshot(self, count: Optional[int] = None,
+                 category: Optional[str] = None,
+                 min_seq: int = 0) -> List[Dict[str, Any]]:
+        """The last ``count`` events, oldest first.  ``category`` filters;
+        ``min_seq`` returns only events recorded after a remembered
+        :attr:`seq` (the chaos plane's per-run delta window)."""
+        with self._lock:
+            events = [dict(e) for e in self._ring]
+        if category is not None:
+            events = [e for e in events if e["category"] == category]
+        if min_seq:
+            events = [e for e in events if e["seq"] > min_seq]
+        if count is not None and count >= 0:
+            events = events[-count:]
+        return events
+
+    @property
+    def seq(self) -> int:
+        """Sequence number of the newest event (0 when empty/fresh)."""
+        with self._lock:
+            return self._seq
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+
+class FanoutTracker:
+    """In-flight fan-out registry for the ``fanout_stalled`` watchdog.
+
+    ``begin()`` when a fan-out schedules its ranges, ``progress()`` as
+    partials land, ``end()`` in a finally.  The watchdog reads
+    :meth:`snapshot` — ages computed from ``time.monotonic`` so a wedged
+    context shows a growing ``idle_s`` no matter what the wall clock
+    does.  The lock is a leaf (pure dict work)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._active: Dict[int, Dict[str, Any]] = {}
+        self._next = 0
+
+    def begin(self, kind: str, total: int, **meta: Any) -> "_FanoutHandle":
+        now = time.monotonic()
+        entry = {"kind": kind, "total": int(total), "done": 0,
+                 "t0": now, "t_last": now}
+        entry.update(meta)
+        with self._lock:
+            self._next += 1
+            fid = self._next
+            self._active[fid] = entry
+        return _FanoutHandle(self, fid)
+
+    def _progress(self, fid: int, done: Optional[int]) -> None:
+        now = time.monotonic()
+        with self._lock:
+            e = self._active.get(fid)
+            if e is None:
+                return
+            e["done"] = int(done) if done is not None else e["done"] + 1
+            e["t_last"] = now
+
+    def _end(self, fid: int) -> None:
+        with self._lock:
+            self._active.pop(fid, None)
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        now = time.monotonic()
+        with self._lock:
+            entries = [dict(e) for e in self._active.values()]
+        for e in entries:
+            e["age_s"] = round(now - e.pop("t0"), 3)
+            e["idle_s"] = round(now - e.pop("t_last"), 3)
+        return entries
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._active)
+
+
+class _FanoutHandle:
+    __slots__ = ("_tracker", "_fid")
+
+    def __init__(self, tracker: FanoutTracker, fid: int) -> None:
+        self._tracker = tracker
+        self._fid = fid
+
+    def progress(self, done: Optional[int] = None) -> None:
+        self._tracker._progress(self._fid, done)
+
+    def end(self) -> None:
+        self._tracker._end(self._fid)
+
+
+# ---------------------------------------------------------------------------
+# crash / stall capture
+
+
+def dump_stacks(reason: str = "sigusr2") -> int:
+    """Dump every live thread's stack into the ring (one ``stacks`` event
+    per thread) and return the thread count.  Called by the SIGUSR2
+    handler and by the watchdog's stall escalation — no locks are held
+    while formatting."""
+    frames = sys._current_frames()
+    names = {t.ident: t.name for t in threading.enumerate()}
+    n = 0
+    for ident, frame in frames.items():
+        stack = traceback.format_stack(frame)
+        record(STACKS, "warn", "thread stack",
+               reason=reason, thread=names.get(ident, str(ident)),
+               frames=[ln.rstrip("\n") for ln in stack[-12:]])
+        n += 1
+    return n
+
+
+#: optional bundle-enricher installed by cluster/health.py so the crash
+#: file carries the final health verdicts without a util->cluster import
+_crash_extras: Optional[Callable[[], Dict[str, Any]]] = None
+
+
+def set_crash_extras(fn: Optional[Callable[[], Dict[str, Any]]]) -> None:
+    global _crash_extras
+    _crash_extras = fn
+
+
+def crash_path(node: Optional[str] = None) -> Optional[str]:
+    """Where :func:`persist_crash` writes by default, or None when
+    ``H2O3_TPU_FLIGHT_CRASH_DIR`` is unset (crash files disabled)."""
+    d = os.environ.get("H2O3_TPU_FLIGHT_CRASH_DIR")
+    if not d:
+        return None
+    node = node or telemetry.node_name() or "localhost"
+    safe = node.replace("/", "_").replace(":", "_")
+    return os.path.join(d, "flight-%s-%d.json" % (safe, os.getpid()))
+
+
+def persist_crash(path: Optional[str] = None,
+                  reason: str = "atexit") -> Optional[str]:
+    """Persist the final ring (plus health verdicts when the monitor is
+    up) to ``path`` or :func:`crash_path`; returns the path written, or
+    None when crash files are disabled.  Best-effort: a failed write
+    never raises out of an exit path."""
+    path = path or crash_path()
+    if path is None:
+        return None
+    bundle: Dict[str, Any] = {
+        "kind": "flight_crash",
+        "node": telemetry.node_name() or "localhost",
+        "pid": os.getpid(),
+        "reason": reason,
+        "ts_ms": int(time.time() * 1000),
+        "events": RECORDER.snapshot(),
+    }
+    extras = _crash_extras
+    if extras is not None:
+        try:
+            bundle.update(extras())
+        except Exception:  # noqa: BLE001 — exit path stays best-effort
+            pass
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(bundle, f, indent=1, sort_keys=True)
+        return path
+    except OSError:
+        return None
+
+
+_hooks_lock = threading.Lock()
+_hooks_installed = False
+
+
+def _on_sigusr2(signum, frame) -> None:  # noqa: ANN001 — signal signature
+    dump_stacks(reason="sigusr2")
+
+
+def _atexit_persist() -> None:
+    persist_crash(reason="atexit")
+
+
+def install_crash_hooks() -> bool:
+    """Idempotently arm crash/stall capture: SIGUSR2 -> stack dump into
+    the ring, ``faulthandler`` -> fatal C-level tracebacks into a sidecar
+    next to the crash file, ``atexit`` -> persist the final ring.  Signal
+    wiring silently skips off the main thread (REST/boot threads still
+    get the atexit hook).  Returns True when hooks are (already) armed."""
+    global _hooks_installed
+    with _hooks_lock:
+        if _hooks_installed:
+            return True
+        _hooks_installed = True
+    atexit.register(_atexit_persist)
+    try:
+        signal.signal(signal.SIGUSR2, _on_sigusr2)
+    except (ValueError, OSError):  # not the main thread / no SIGUSR2
+        pass
+    cpath = crash_path()
+    if cpath is not None:
+        try:
+            import faulthandler
+
+            os.makedirs(os.path.dirname(cpath) or ".", exist_ok=True)
+            f = open(cpath + ".stacks.txt", "w")  # noqa: SIM115 — lives
+            faulthandler.enable(file=f)           # for the process
+        except OSError:
+            pass
+    return True
+
+
+#: process-wide instances (one recorder per node, like the ledger)
+RECORDER = FlightRecorder()
+FANOUTS = FanoutTracker()
+
+#: the terse choke-point spelling: ``_flight.record(CAT, sev, ...)``
+record = RECORDER.record
+
+
+def set_enabled(on: bool) -> None:
+    RECORDER.set_enabled(on)

@@ -366,6 +366,123 @@ def s_partition_gossip(seed: int) -> Dict[str, bool]:
     return v
 
 
+@scenario("wedged_member")
+def s_wedged_member(seed: int) -> Dict[str, bool]:
+    """One member wedges — dtask frames TO it are held seconds (the call
+    stays in flight), its gossip is black-holed both ways — and the
+    HEALTH PLANE must see it first: the caller's watchdog flags
+    ``rpc_stuck`` strictly before heartbeat suspicion fires, the flight
+    recorder holds the retry-ladder trail against the victim, and a
+    federated diagnostics poll from a survivor degrades to partial
+    (never raises) with the victim in ``errors``.  Verdicts are
+    booleans only, so two runs with one seed must match byte-for-byte
+    (the fresh per-run HealthMonitor and flight seq-delta filtering
+    keep run 2 blind to run 1's residue)."""
+    from h2o3_tpu.cluster import faults
+    from h2o3_tpu.cluster import health as _health
+    from h2o3_tpu.util import flight as _flight
+
+    # per-run knobs: rpc_stuck at 1x the ladder budget (1.2s for a
+    # 0.3s x 4-attempt call), suspicion at 8 x 0.4s = 3.2s silent —
+    # a 2s window between watchdog and suspicion even on a loaded box
+    env0 = {k: os.environ.get(k) for k in
+            ("H2O3_TPU_HEALTH_RPC_FACTOR", "H2O3_TPU_HB_SUSPECT")}
+    os.environ["H2O3_TPU_HEALTH_RPC_FACTOR"] = "1.0"
+    os.environ["H2O3_TPU_HB_SUSPECT"] = "8"
+    clouds, stores, formed = _mini_cloud(3, hb=0.4, prefix="wm")
+    a, b, victim = clouds
+    v: Dict[str, bool] = {"formed": formed}
+    mon = _health.HealthMonitor(node=a.info.name, interval_s=0.05)
+    try:
+        vport = victim.info.port
+        vident = victim.info.ident
+        seq0 = _flight.RECORDER.seq  # run 2 ignores run 1's events
+
+        plan = faults.plan_from_dict({"seed": seed, "rules": [
+            # the wedge: dtask frames to the victim held 4s — the call
+            # ages IN FLIGHT (delay, unlike black_hole, consumes wall)
+            {"action": "delay", "side": "client", "method": "dtask",
+             "dst": f"*:{vport}", "delay_ms": 4000},
+            # gossip blackout both ways: the suspicion clock runs
+            {"action": "black_hole", "side": "client",
+             "method": "heartbeat", "dst": f"*:{vport}"},
+            {"action": "black_hole", "side": "client",
+             "method": "heartbeat", "src": victim.info.name},
+            # the victim cannot answer a diagnostics poll either
+            {"action": "black_hole", "side": "client",
+             "method": "diagnostics_snapshot", "dst": f"*:{vport}"},
+        ]})
+        faults.set_plan(plan)
+        mon.start()
+
+        def _wedged_call() -> None:
+            try:
+                a.client.call(victim.info.addr, "dtask",
+                              {"task": "echo", "payload": {"i": seed}},
+                              timeout=0.3, target=vident)
+            except Exception:
+                pass  # outcome immaterial — the in-flight AGE is the test
+
+        caller = threading.Thread(target=_wedged_call, daemon=True,
+                                  name="wedged-dtask")
+        caller.start()
+
+        def _suspected() -> bool:
+            return any(
+                ev["category"] == _flight.MEMBERSHIP
+                and ev["msg"] in ("suspect", "tombstone")
+                and vident in str(ev.get("member", ""))
+                for ev in _flight.RECORDER.snapshot(min_seq=seq0))
+
+        flagged = _wait(
+            lambda: (mon.verdicts().get("rpc_stuck") or {}).get(
+                "state") in ("degraded", "critical"), 2.6)
+        v["wedge_flagged"] = flagged
+        # the whole point: the watchdog saw the wedge while membership
+        # still considered the victim healthy
+        v["wedge_flagged_before_suspicion"] = flagged and not _suspected()
+        g = _health._HEALTH_STATE.value(node=a.info.name, check="rpc_stuck")
+        v["gauge_degraded"] = g >= 1.0
+        # the transition landed in the flight ring as a health event
+        v["stall_explained"] = any(
+            ev["category"] == _flight.HEALTH
+            and ev.get("check") == "rpc_stuck"
+            and ev.get("state") in ("degraded", "critical")
+            for ev in _flight.RECORDER.snapshot(min_seq=seq0))
+
+        # federated diagnostics from the survivor: the victim lands in
+        # errors, the answer degrades to partial — it never raises
+        try:
+            results, errors = a.poll_members(
+                "diagnostics_snapshot", {"events": 50}, timeout=1.0)
+            v["diagnostics_partial"] = (
+                victim.info.name in errors
+                and a.info.name in results
+                and b.info.name in results)
+        except Exception:
+            v["diagnostics_partial"] = False
+        # the ladder's attempts against the wedged node are in the ring
+        v["retry_trail_in_flight"] = any(
+            ev["category"] == _flight.RPC
+            and ev["msg"] in ("retry", "timeout", "connect_error")
+            and str(ev.get("target", "")).endswith(f":{vport}")
+            for ev in _flight.RECORDER.snapshot(min_seq=seq0))
+
+        # suspicion DOES eventually fire — the watchdog was early, not
+        # a replacement for the failure detector
+        v["suspicion_eventually"] = _wait(_suspected, 12.0)
+        caller.join(timeout=8.0)
+    finally:
+        mon.stop()
+        for k, old in env0.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
+        _teardown(clouds)
+    return v
+
+
 @scenario("kill_chunk_home")
 def s_kill_chunk_home(seed: int) -> Dict[str, bool]:
     """Chunk-homed distributed Frame through a home's death.  A CSV

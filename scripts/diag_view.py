@@ -1,0 +1,215 @@
+#!/usr/bin/env python
+"""Render a saved ``/3/Diagnostics`` bundle (or a flight crash file).
+
+Pure stdlib, no repo imports — point it at anything the health plane
+persists:
+
+    curl -s localhost:54321/3/Diagnostics > diag.json
+    python scripts/diag_view.py diag.json
+    curl -s 'localhost:54321/3/Diagnostics?cluster=true' \
+        | python scripts/diag_view.py -
+    python scripts/diag_view.py /var/crash/flight-node-a-1234.json
+
+Accepted shapes (distinguished by the top-level ``kind`` field):
+
+``diagnostics``          one node's bundle (identity + knobs, watchdog
+                         verdicts, flight ring tail, worst SlowOps,
+                         membership view, thread stacks)
+``diagnostics_cluster``  the federated ``?cluster=true`` shape — one
+                         bundle per reachable node plus a ``partial``
+                         flag and per-node errors
+``flight_crash``         the atexit/fatal-path crash file: the flight
+                         ring as it stood at death, plus whatever the
+                         crash-extras hook attached (health verdicts)
+
+Output: one section per node — health verdicts first (a support bundle
+answers "is it sick" before "what happened"), then the flight events
+oldest-first with severity flags, then slow ops and membership.
+``--events N`` bounds the flight tail, ``--stacks`` adds thread dumps.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+_SEV_MARK = {"info": " ", "warn": "!", "error": "E", "critical": "C"}
+
+#: flight-event fields that are structural; everything else prints as
+#: key=value payload detail
+_STRUCTURAL = {"ts_ms", "seq", "category", "severity", "node", "msg",
+               "trace_id"}
+
+
+def _fmt_ts(ms: Any) -> str:
+    try:
+        return time.strftime("%H:%M:%S", time.gmtime(float(ms) / 1000.0)) \
+            + f".{int(float(ms)) % 1000:03d}"
+    except (TypeError, ValueError):
+        return "--:--:--"
+
+
+def _event_line(ev: Dict[str, Any]) -> str:
+    sev = str(ev.get("severity", "info"))
+    parts = [
+        _SEV_MARK.get(sev, "?"),
+        _fmt_ts(ev.get("ts_ms")),
+        f"{ev.get('category', '?')}/{ev.get('msg', '')}",
+    ]
+    tid = ev.get("trace_id")
+    if tid:
+        parts.append(f"trace={tid}")
+    detail = " ".join(
+        f"{k}={ev[k]}" for k in sorted(ev) if k not in _STRUCTURAL)
+    if detail:
+        parts.append(detail)
+    return " ".join(parts)
+
+
+def _render_health(health: Optional[Dict[str, Any]], out: List[str]) -> None:
+    if not isinstance(health, dict):
+        return
+    summary = health.get("summary") or {}
+    verdicts = health.get("verdicts") or {}
+    state = summary.get("state", "unknown")
+    out.append(f"  health: {state}"
+               + ("" if summary.get("running", True) else " (monitor stopped)"))
+    for check in sorted(verdicts):
+        v = verdicts[check] or {}
+        detail = v.get("detail") or ""
+        out.append(f"    {check:<20} {v.get('state', '?'):<9}"
+                   + (f" {detail}" if detail else ""))
+
+
+def _render_flight(events: Any, limit: int, out: List[str]) -> None:
+    if not isinstance(events, list) or not events:
+        out.append("  flight: (empty ring)")
+        return
+    tail = events[-limit:] if limit else events
+    skipped = len(events) - len(tail)
+    out.append(f"  flight ({len(events)} events"
+               + (f", showing last {len(tail)}" if skipped else "") + "):")
+    for ev in tail:
+        if isinstance(ev, dict):
+            out.append("    " + _event_line(ev))
+
+
+def _render_slowops(slowops: Any, out: List[str]) -> None:
+    routes = (slowops or {}).get("routes") if isinstance(slowops, dict) else None
+    if not routes:
+        return
+    out.append("  slow ops:")
+    for route in sorted(routes):
+        for entry in routes[route] or []:
+            ms = entry.get("duration_ms", entry.get("ms", "?"))
+            out.append(f"    {route} {ms}ms trace={entry.get('trace_id', '-')}")
+
+
+def _render_members(members: Any, out: List[str]) -> None:
+    if not isinstance(members, list) or not members:
+        return
+    out.append("  members:")
+    for m in members:
+        if isinstance(m, dict):
+            name = m.get("name", m.get("node", "?"))
+            state = m.get("state", m.get("status", ""))
+            out.append(f"    {name} {state}".rstrip())
+
+
+def _render_stacks(threads: Any, out: List[str]) -> None:
+    if not isinstance(threads, list):
+        return
+    out.append(f"  threads ({len(threads)}):")
+    for t in threads:
+        if not isinstance(t, dict):
+            continue
+        out.append(f"    -- {t.get('thread', '?')}")
+        for frame in t.get("frames") or []:
+            for line in str(frame).rstrip().splitlines():
+                out.append("       " + line)
+
+
+def _render_bundle(b: Dict[str, Any], events: int, stacks: bool,
+                   out: List[str]) -> None:
+    out.append(f"node {b.get('node', '?')} (pid {b.get('pid', '?')})")
+    _render_health(b.get("health"), out)
+    _render_flight(b.get("flight"), events, out)
+    _render_slowops(b.get("slowops"), out)
+    _render_members(b.get("members"), out)
+    if stacks:
+        _render_stacks(b.get("threads"), out)
+    out.append("")
+
+
+def _render_crash(c: Dict[str, Any], events: int, out: List[str]) -> None:
+    out.append(f"flight crash file: node {c.get('node', '?')} "
+               f"(pid {c.get('pid', '?')}) reason={c.get('reason', '?')} "
+               f"at {_fmt_ts(c.get('ts_ms'))}")
+    health = c.get("health")
+    if isinstance(health, dict):
+        # crash extras store bare verdicts; reuse the bundle renderer shape
+        _render_health({"summary": {"state": "at-death"},
+                        "verdicts": health}, out)
+    _render_flight(c.get("events"), events, out)
+    out.append("")
+
+
+def render(payload: Any, events: int = 50, stacks: bool = False) -> str:
+    """The bundle as indented text; raises ValueError on unknown shapes."""
+    if not isinstance(payload, dict):
+        raise ValueError("unrecognized snapshot shape: want a JSON object")
+    kind = payload.get("kind")
+    out: List[str] = []
+    if kind == "diagnostics":
+        _render_bundle(payload, events, stacks, out)
+    elif kind == "diagnostics_cluster":
+        nodes = payload.get("nodes") or {}
+        errors = payload.get("errors") or {}
+        out.append(f"cluster diagnostics: {len(nodes)} node(s)"
+                   + (", PARTIAL" if payload.get("partial") else ""))
+        out.append("")
+        for name in sorted(nodes):
+            if isinstance(nodes[name], dict):
+                _render_bundle(nodes[name], events, stacks, out)
+        for name in sorted(errors):
+            out.append(f"node {name}: UNREACHABLE ({errors[name]})")
+        if errors:
+            out.append("")
+    elif kind == "flight_crash":
+        _render_crash(payload, events, out)
+    else:
+        raise ValueError(
+            f"unrecognized snapshot kind {kind!r}: want 'diagnostics', "
+            f"'diagnostics_cluster' or 'flight_crash'")
+    return "\n".join(out)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render a /3/Diagnostics bundle or flight crash file")
+    ap.add_argument("snapshot",
+                    help="path to the saved JSON, or '-' for stdin")
+    ap.add_argument("--events", type=int, default=50,
+                    help="flight events shown per node (default 50, 0=all)")
+    ap.add_argument("--stacks", action="store_true",
+                    help="include per-thread stack dumps")
+    args = ap.parse_args(argv)
+    try:
+        if args.snapshot == "-":
+            payload = json.load(sys.stdin)
+        else:
+            with open(args.snapshot) as f:
+                payload = json.load(f)
+        text = render(payload, events=args.events, stacks=args.stacks)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"diag_view: {e}", file=sys.stderr)
+        return 1
+    sys.stdout.write(text + ("\n" if not text.endswith("\n") else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

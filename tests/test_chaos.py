@@ -38,8 +38,8 @@ def _run_twice(name):
 def test_scenarios_registered():
     names = set(chaos.SCENARIOS)
     assert {"dup_reorder", "slow_node", "partition_gossip",
-            "kill_chunk_home", "kill_hist_home", "kill_search_member",
-            "kill_fanout", "kill_grid"} <= names
+            "wedged_member", "kill_chunk_home", "kill_hist_home",
+            "kill_search_member", "kill_fanout", "kill_grid"} <= names
     # the ISSUE floor: at least four scripted scenarios
     assert len(names) >= 4
 
@@ -54,6 +54,10 @@ def test_slow_node_deterministic():
 
 def test_partition_gossip_deterministic():
     _run_twice("partition_gossip")
+
+
+def test_wedged_member_deterministic():
+    _run_twice("wedged_member")
 
 
 def test_kill_chunk_home_deterministic():

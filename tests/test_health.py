@@ -1,0 +1,338 @@
+"""The health plane: flight-recorder ring semantics, watchdog rule
+arithmetic, SIGUSR2 stack capture, the federated ``/3/Diagnostics``
+bundle's partial-never-5xx contract, and the crash-file round trip
+through ``scripts/diag_view.py``.
+
+The ring and rule tests are pure unit checks (no cloud, no sockets);
+the federation tests run two real Cloud instances over loopback behind
+a live REST server — the same wiring a multi-process deployment uses.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from h2o3_tpu.cluster import health
+from h2o3_tpu.cluster.membership import Cloud, set_local_cloud
+from h2o3_tpu.util import flight
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_HERE)
+_DIAG_VIEW = os.path.join(_ROOT, "scripts", "diag_view.py")
+
+
+def _wait_for(cond, timeout=10.0, every=0.02, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(every)
+    pytest.fail(f"timed out after {timeout}s waiting for {msg}")
+
+
+# ---------------------------------------------------------------------------
+# the ring
+
+
+class TestFlightRing:
+    def test_bounded_with_overwrite_order(self):
+        r = flight.FlightRecorder(capacity=8)
+        for i in range(20):
+            r.record(flight.RPC, "info", "ev", i=i)
+        snap = r.snapshot()
+        # exactly capacity events survive, the OLDEST were overwritten,
+        # and what remains is oldest-first
+        assert [e["i"] for e in snap] == list(range(12, 20))
+        assert [e["seq"] for e in snap] == sorted(e["seq"] for e in snap)
+        assert r.seq == 20
+
+    def test_snapshot_filters(self):
+        r = flight.FlightRecorder(capacity=32)
+        r.record(flight.RPC, "info", "a")
+        cut = r.seq
+        r.record(flight.MEMBERSHIP, "warn", "b")
+        r.record(flight.RPC, "error", "c")
+        assert [e["msg"] for e in r.snapshot(category=flight.RPC)] == \
+            ["a", "c"]
+        assert [e["msg"] for e in r.snapshot(min_seq=cut)] == ["b", "c"]
+        assert [e["msg"] for e in r.snapshot(count=1)] == ["c"]
+
+    def test_disabled_recorder_drops_events(self):
+        r = flight.FlightRecorder(capacity=8)
+        r.set_enabled(False)
+        r.record(flight.RPC, "info", "dropped")
+        assert r.snapshot() == []
+        r.set_enabled(True)
+        r.record(flight.RPC, "info", "kept")
+        assert [e["msg"] for e in r.snapshot()] == ["kept"]
+
+    def test_event_carries_trace_id_from_open_span(self):
+        from h2o3_tpu.util import telemetry
+
+        r = flight.FlightRecorder(capacity=8)
+        with telemetry.Span("health_unit") as sp:
+            r.record(flight.COALESCE, "info", "in-span")
+        assert r.snapshot()[-1]["trace_id"] == sp.trace_id
+
+
+# ---------------------------------------------------------------------------
+# rule arithmetic — windows must not fire on HEALTHY slow work
+
+
+class TestRules:
+    def test_rpc_stuck_no_false_stall_inside_budget(self):
+        # a slow-but-sane call: aged half its ladder budget — ok
+        entries = [{"method": "dtask", "target": "n1:1",
+                    "age_s": 1.0, "budget_s": 2.0, "attempt": 1}]
+        assert health.rpc_stuck_rule(entries, factor=3.0)[0] == "ok"
+
+    def test_rpc_stuck_degrades_then_criticals(self):
+        e = {"method": "dtask", "target": "n1:1",
+             "age_s": 6.5, "budget_s": 2.0, "attempt": 2}
+        assert health.rpc_stuck_rule([e], factor=3.0)[0] == "degraded"
+        e2 = dict(e, age_s=13.0)
+        state, detail = health.rpc_stuck_rule([e2], factor=3.0)
+        assert state == "critical" and "dtask" in detail
+
+    def test_fanout_done_is_never_a_stall(self):
+        # all ranges settled: idle time is irrelevant
+        entries = [{"kind": "map_reduce", "total": 4, "done": 4,
+                    "idle_s": 99.0, "age_s": 100.0}]
+        assert health.fanout_stall_rule(entries, window_s=5.0)[0] == "ok"
+
+    def test_fanout_stall_windows(self):
+        live = {"kind": "parse", "total": 8, "done": 3,
+                "idle_s": 2.0, "age_s": 30.0}
+        assert health.fanout_stall_rule([live], window_s=5.0)[0] == "ok"
+        stalled = dict(live, idle_s=6.0)
+        assert health.fanout_stall_rule(
+            [stalled], window_s=5.0)[0] == "degraded"
+        dead = dict(live, idle_s=11.0)
+        assert health.fanout_stall_rule(
+            [dead], window_s=5.0)[0] == "critical"
+
+    def test_heartbeat_rule(self):
+        # no cloud -> nothing to judge
+        assert health.heartbeat_rule(None, 0.1, 4.0)[0] == "ok"
+        # limit is factor*interval + 1s of absolute slack: a cycle 2
+        # intervals late on a 100ms beat is still fine
+        assert health.heartbeat_rule(0.2, 0.1, 4.0)[0] == "ok"
+        assert health.heartbeat_rule(2.0, 0.1, 4.0)[0] == "degraded"
+        assert health.heartbeat_rule(4.0, 0.1, 4.0)[0] == "critical"
+
+    def test_http_saturation_rule(self):
+        ok = health.http_saturation_rule(10, 512, 0, pct=80, shed_min=1)
+        assert ok[0] == "ok"
+        deep = health.http_saturation_rule(500, 512, 0, pct=80, shed_min=1)
+        assert deep[0] == "degraded"
+        full = health.http_saturation_rule(512, 512, 0, pct=80, shed_min=1)
+        assert full[0] == "critical"
+        shed = health.http_saturation_rule(0, 512, 3, pct=80, shed_min=1)
+        assert shed[0] == "degraded"
+
+    def test_compile_storm_rule(self):
+        assert health.compile_storm_rule(5, 20)[0] == "ok"
+        assert health.compile_storm_rule(25, 20)[0] == "degraded"
+        assert health.compile_storm_rule(50, 20)[0] == "critical"
+
+    def test_monitor_tick_is_all_ok_on_an_idle_process(self):
+        mon = health.HealthMonitor(node="unit-idle", interval_s=0.05)
+        mon.tick()
+        states = {k: v["state"] for k, v in mon.verdicts().items()}
+        assert set(states) == {"rpc_stuck", "fanout_stalled",
+                               "heartbeat_overrun", "http_saturation",
+                               "compile_storm"}
+        assert all(s == "ok" for s in states.values())
+
+    def test_monitor_transition_records_flight_event_and_gauge(self):
+        mon = health.HealthMonitor(node="unit-trans", interval_s=0.05)
+        seq0 = flight.RECORDER.seq
+        fo = flight.FANOUTS.begin("unit_stall", 4)
+        try:
+            mon.stall_s = 0.01  # any idle time is a stall
+            time.sleep(0.05)
+            mon.tick()
+            v = mon.verdicts()["fanout_stalled"]
+            assert v["state"] in ("degraded", "critical")
+            evs = [e for e in flight.RECORDER.snapshot(min_seq=seq0)
+                   if e["category"] == flight.HEALTH
+                   and e.get("check") == "fanout_stalled"]
+            assert evs and evs[-1]["state"] == v["state"]
+            g = health._HEALTH_STATE.value(
+                node="unit-trans", check="fanout_stalled")
+            assert g >= 1.0
+        finally:
+            fo.end()
+
+
+# ---------------------------------------------------------------------------
+# SIGUSR2 -> all-thread stacks into the ring
+
+
+@pytest.mark.skipif(not hasattr(signal, "SIGUSR2"),
+                    reason="platform has no SIGUSR2")
+class TestSigusr2:
+    def test_signal_dumps_stacks_into_ring(self):
+        assert flight.install_crash_hooks() in (True, False)
+        seq0 = flight.RECORDER.seq
+        os.kill(os.getpid(), signal.SIGUSR2)
+        _wait_for(
+            lambda: any(e["category"] == flight.STACKS
+                        for e in flight.RECORDER.snapshot(min_seq=seq0)),
+            msg="SIGUSR2 stack dump in the flight ring")
+        evs = [e for e in flight.RECORDER.snapshot(min_seq=seq0)
+               if e["category"] == flight.STACKS]
+        # one event per thread, each naming the thread and carrying frames
+        assert any("MainThread" in str(e.get("thread")) for e in evs)
+        assert all(e.get("frames") for e in evs)
+
+
+# ---------------------------------------------------------------------------
+# federated /3/Diagnostics
+
+
+@pytest.fixture()
+def diag_cloud_server():
+    from h2o3_tpu.api import start_server
+
+    a = Cloud("healthcloud", "node-a", hb_interval=0.05)
+    b = Cloud("healthcloud", "node-b", hb_interval=0.05)
+    srv = None
+    try:
+        a.start([])
+        b.start([a.info.addr])
+        _wait_for(lambda: a.size() == 2 and b.size() == 2,
+                  msg="2-node cloud formation")
+        set_local_cloud(a)
+        srv = start_server(port=0)
+        yield a, b, srv
+    finally:
+        if srv is not None:
+            srv.stop()
+        set_local_cloud(None)
+        a.stop()
+        b.stop()
+
+
+def _get(srv, path):
+    try:
+        with urllib.request.urlopen(srv.url + path) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+class TestDiagnostics:
+    def test_local_bundle_shape(self, diag_cloud_server):
+        _a, _b, srv = diag_cloud_server
+        st, out = _get(srv, "/3/Diagnostics")
+        assert st == 200 and out["kind"] == "diagnostics"
+        assert {"node", "pid", "knobs", "health", "flight", "slowops",
+                "members", "threads"} <= set(out)
+        assert isinstance(out["flight"], list)
+        assert {m["name"] for m in out["members"]} == {"node-a", "node-b"}
+        assert out["health"]["summary"]["state"] in health.STATES + (
+            "unknown",)
+        # the local route is renderable by the viewer too
+        assert any(t.get("frames") for t in out["threads"])
+
+    def test_cluster_bundle_all_up(self, diag_cloud_server):
+        _a, _b, srv = diag_cloud_server
+        st, out = _get(srv, "/3/Diagnostics?cluster=true&events=10")
+        assert st == 200 and out["kind"] == "diagnostics_cluster"
+        assert out["partial"] is False and out["errors"] == {}
+        assert set(out["nodes"]) == {"node-a", "node-b"}
+        for bundle in out["nodes"].values():
+            assert bundle["kind"] == "diagnostics"
+            assert len(bundle["flight"]) <= 10
+
+    def test_cluster_bundle_partial_when_member_down(
+            self, diag_cloud_server):
+        a, b, srv = diag_cloud_server
+        b.stop()
+        a.client.pool.close_all()  # in-process stop leaves pooled sockets
+        st, out = _get(srv, "/3/Diagnostics?cluster=true")
+        assert st == 200  # degraded, NEVER a 5xx
+        assert out["partial"] is True
+        assert "node-b" in out["errors"]
+        assert "node-a" in out["nodes"] and "node-b" not in out["nodes"]
+
+    def test_slowops_carries_health_block(self, diag_cloud_server):
+        _a, _b, srv = diag_cloud_server
+        st, out = _get(srv, "/3/SlowOps")
+        assert st == 200
+        assert "health" in out and "checks" in out["health"]
+
+    def test_profiler_cluster_carries_health_per_node(
+            self, diag_cloud_server):
+        _a, _b, srv = diag_cloud_server
+        st, out = _get(srv, "/3/Profiler?cluster=true&duration=0.05")
+        assert st == 200
+        named = {n["node_name"]: n for n in out["nodes"]}
+        assert {"node-a", "node-b"} <= set(named)
+        # the health block rode the profiler_snapshot payload — one
+        # scrape, no second RPC
+        for node in ("node-a", "node-b"):
+            assert "checks" in (named[node]["health"] or {})
+
+
+# ---------------------------------------------------------------------------
+# crash file -> scripts/diag_view.py round trip
+
+
+class TestCrashRoundTrip:
+    def test_persist_and_render(self, tmp_path):
+        flight.record(flight.RPC, "error", "timeout",
+                      method="dtask", target="gone:1", attempts=4)
+        path = str(tmp_path / "flight-crash.json")
+        assert flight.persist_crash(path, reason="unit") == path
+        with open(path) as f:
+            saved = json.load(f)
+        assert saved["kind"] == "flight_crash"
+        assert saved["reason"] == "unit"
+        assert any(e.get("msg") == "timeout" for e in saved["events"])
+        out = subprocess.run(
+            [sys.executable, _DIAG_VIEW, path],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr
+        assert "flight crash file" in out.stdout
+        assert "rpc/timeout" in out.stdout
+
+    def test_viewer_renders_diagnostics_bundle(self, tmp_path):
+        bundle = health.diagnostics_snapshot(events=20)
+        path = str(tmp_path / "diag.json")
+        with open(path, "w") as f:
+            json.dump(bundle, f)
+        out = subprocess.run(
+            [sys.executable, _DIAG_VIEW, path, "--stacks"],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr
+        assert f"node {bundle['node']}" in out.stdout
+        assert "health:" in out.stdout
+
+    def test_viewer_rejects_garbage(self, tmp_path):
+        path = str(tmp_path / "junk.json")
+        with open(path, "w") as f:
+            json.dump({"kind": "nonsense"}, f)
+        out = subprocess.run(
+            [sys.executable, _DIAG_VIEW, path],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 1
+        assert "unrecognized" in out.stderr
+
+    def test_crash_path_gated_on_env(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("H2O3_TPU_FLIGHT_CRASH_DIR", raising=False)
+        assert flight.crash_path() is None  # no dir -> no crash litter
+        monkeypatch.setenv("H2O3_TPU_FLIGHT_CRASH_DIR", str(tmp_path))
+        p = flight.crash_path(node="unit/node")
+        assert p is not None and p.startswith(str(tmp_path))
+        assert "/" not in os.path.basename(p).replace(".json", "")
+        written = flight.persist_crash(reason="atexit-unit")
+        assert written is not None and os.path.exists(written)
