@@ -61,7 +61,7 @@ _METRIC_SECTIONS = ("Observability", "Clustering", "Distributed Frames",
                     "Distributed model search", "Distributed training",
                     "Failure model", "Serving plane",
                     "Cost ledger & slow-op log", "Cluster profiler",
-                    "Health plane")
+                    "Health plane", "Device cache")
 
 
 def readme_documented_routes(readme_path: str) -> set:
@@ -122,6 +122,7 @@ def live_metrics() -> set:
     import h2o3_tpu.cluster.frames   # noqa: F401  cluster_chunk_* meters
     import h2o3_tpu.cluster.search   # noqa: F401  cluster_search_* meters
     import h2o3_tpu.models.tree.dist_hist  # noqa: F401  dist_hist_* meters
+    import h2o3_tpu.ops.histogram    # noqa: F401  hist_plan_cache meter
     import h2o3_tpu.api.coalesce     # noqa: F401  predict_batch_size
     import h2o3_tpu.rapids.fusion    # noqa: F401  rapids_fusion_* meters
     import h2o3_tpu.util.ledger      # noqa: F401  ledger_* / slowop_* meters

@@ -274,6 +274,13 @@ def _task_hist_level(payload: Dict[str, Any], cloud, store) -> Any:
     return _dh.hist_level(payload, cloud, store)
 
 
+@register_ctx_task("hist_levels")
+def _task_hist_levels(payload: Dict[str, Any], cloud, store) -> Any:
+    from h2o3_tpu.models.tree import dist_hist as _dh
+
+    return _dh.hist_levels(payload, cloud, store)
+
+
 @register_ctx_task("hist_replay")
 def _task_hist_replay(payload: Dict[str, Any], cloud, store) -> Any:
     from h2o3_tpu.models.tree import dist_hist as _dh

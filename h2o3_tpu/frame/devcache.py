@@ -37,6 +37,7 @@ __all__ = [
     "DEVCACHE",
     "DeviceFrameCache",
     "cached",
+    "cached_host",
     "device_nbytes",
     "frame_token",
     "mesh_fingerprint",
@@ -324,6 +325,27 @@ def cached(
         return build()
     return DEVCACHE.get_or_put(
         (kind, token, extra_key, mesh_fingerprint(mesh)),
+        build,
+        frame_key=frame_key,
+        kind=kind,
+    )
+
+
+def cached_host(
+    kind: str,
+    token: Optional[Tuple],
+    extra_key,
+    build: Callable[[], Any],
+    frame_key: Optional[str] = None,
+) -> Any:
+    """Mesh-free variant of :func:`cached` for host-resident placements —
+    e.g. a chunk home's binned-code matrix, which is keyed by data identity
+    (layout stamp + bin-edges digest) and never sharded onto a mesh. Same
+    store, byte budget, counters, and upload-ledger charging."""
+    if token is None:
+        return build()
+    return DEVCACHE.get_or_put(
+        (kind, token, extra_key, "host"),
         build,
         frame_key=frame_key,
         kind=kind,

@@ -332,11 +332,20 @@ def tree_cache_token(frame: Frame, p, encoding: str):
     Returns None (cache bypass) for frames without version stamps."""
     from h2o3_tpu.frame import devcache
 
-    if getattr(frame, "chunk_layout", None) is not None:
-        # chunk-homed frame: frame_token would materialize every remote
-        # chunk just to stamp versions — bypass the device cache instead
-        return None
-    tok = devcache.frame_token(frame)
+    if (getattr(frame, "chunk_layout", None) is not None
+            and getattr(frame, "_materialized", None) is None):
+        # chunk-homed frame, rows still on their homes: the layout stamp
+        # identifies the distributed data state (chunks are immutable DKV
+        # puts under (frame_key, stamp) keys; remove/rekey evicts via the
+        # frame-key link) — the same identity the per-home bind cache
+        # keys on, without materializing chunks just to stamp versions.
+        # Once materialized, resident columns carry versions; use those
+        # so caller-side mutations invalidate as usual.
+        lay = frame.chunk_layout
+        tok = ("dist", lay["frame_key"], lay["stamp"],
+               int(lay["espc"][-1]))
+    else:
+        tok = devcache.frame_token(frame)
     if tok is None:
         return None
     return (

@@ -6,7 +6,7 @@ each home keeps a per-fit context (bin codes, margins, node positions)
 and per tree level only ``(feature, bin, {Sum g, Sum h, Sum w})`` histogram
 partials and the chosen splits cross the wire — never rows.
 
-Protocol (five ctx-DTasks, one global monotonic ``seq`` per fit):
+Protocol (six ctx-DTasks, one global monotonic ``seq`` per fit):
 
 ``hist_open``
     seq 0 — assemble the group's local columns from the ring, filter rows
@@ -18,12 +18,20 @@ Protocol (five ctx-DTasks, one global monotonic ``seq`` per fit):
     seq 1 — receive the merged global edges, bin locally
     (``apply_bins`` never ships bin codes), drop the raw feature matrix,
     and install the fit parameters (f0, objective, seed, sample rate).
+    The binned-code matrix is served resident from the device frame cache
+    (keyed on layout stamp + bin-edges digest), so a repeat fit on an
+    unmutated frame decodes and uploads nothing.
 ``hist_level``
     one op per level: ``level`` (apply parent routes, build this level's
     histogram partial — small side only under subtraction), ``totals``
     (terminal node G/H/W totals), ``fin`` (apply terminal routes, add the
     finished tree's leaf values into the local margins), and the seq-free
     ``margins`` read-back.
+``hist_levels``
+    several ``hist_level`` rounds in one RPC: output-free ``fin`` ops are
+    deferred caller-side (``H2O3_TPU_DIST_HIST_BATCH``) and ride with the
+    next output-bearing op — each item fences its own seq in issue order,
+    so state and recovery are exactly the sequential rounds'.
 ``hist_replay``
     recovery: rebuild a lost context from the caller's op log (open +
     bind + every routing-relevant op replayed without building output),
@@ -41,6 +49,7 @@ bit-identical across topologies for a fixed seed.
 
 from __future__ import annotations
 
+import hashlib
 import os
 import threading
 import time
@@ -55,6 +64,7 @@ import jax.numpy as jnp
 from h2o3_tpu.cluster import rpc as _rpc
 from h2o3_tpu.cluster.dkv import MAX_REPLICAS
 from h2o3_tpu.compute.quantile import merge_edges, sketch_column
+from h2o3_tpu.frame import devcache as _devcache
 from h2o3_tpu.frame.frame import ColType
 from h2o3_tpu.models.data_info import DataInfo
 from h2o3_tpu.ops.histogram import apply_bins, guard_hist_payload
@@ -72,6 +82,10 @@ _LEVELS = telemetry.counter(
 _PARTIAL_BYTES = telemetry.counter(
     "dist_hist_partial_bytes_total",
     "bytes of histogram partials produced by chunk homes")
+_BIND_CACHE = telemetry.counter(
+    "dist_hist_bind_cache_total",
+    "hist_bind binned-code lookups against the device frame cache",
+    labels=("result",))
 _CTX_ENTRIES = telemetry.gauge(
     "cluster_hist_context_entries",
     "live per-fit histogram contexts held by this member")
@@ -97,6 +111,13 @@ def _ctx_cap() -> int:
         return max(1, int(os.environ.get("H2O3_TPU_DIST_HIST_CTX", "4")))
     except ValueError:
         return 4
+
+
+def _batch_enabled() -> bool:
+    """``H2O3_TPU_DIST_HIST_BATCH``: coalesce output-free ``fin`` ops with
+    the next output-bearing op into one ``hist_levels`` round (default on;
+    ``0`` sends every op as its own ``hist_level`` RPC)."""
+    return os.environ.get("H2O3_TPU_DIST_HIST_BATCH", "1").strip() != "0"
 
 
 # ---------------------------------------------------------------------------
@@ -386,8 +407,19 @@ def hist_open(payload: Dict[str, Any], cloud, store) -> Dict[str, Any]:
     if off is not None:
         off = off[keep]
     nbins = int(payload["nbins"])
-    sketches = [sketch_column(X[:, f].astype(np.float64), nbins)
+    # per-fit quantile sketches are a pure function of the group's kept
+    # rows — identified by (layout stamp, column roles), the same identity
+    # the bind cache keys on — so a repeat fit serves them resident too
+    sk_token = ("hist_sketch_home", payload["frame_key"], payload["stamp"],
+                g, y_name, w_name or "", off_name or "", tuple(preds))
+
+    def _sketch():
+        return [sketch_column(X[:, f].astype(np.float64), nbins)
                 for f in range(X.shape[1])]
+
+    sketches = _devcache.cached_host(
+        "hist_sketch_home", sk_token, nbins, _sketch,
+        frame_key=str(payload["frame_key"]))
     st = _GroupState(g)
     st.X, st.y, st.w, st.off = X, y, w, off
     st.last_seq = 0
@@ -396,11 +428,45 @@ def hist_open(payload: Dict[str, Any], cloud, store) -> Dict[str, Any]:
             "sketches": sketches, "neg_weights": neg}
 
 
+def _edges_digest(edges: np.ndarray) -> str:
+    return hashlib.sha1(
+        np.ascontiguousarray(edges, np.float64).tobytes()).hexdigest()
+
+
+def _bind_codes(st: _GroupState, payload: Dict[str, Any],
+                edges: np.ndarray) -> np.ndarray:
+    """This group's binned-code matrix, served device-cache-resident.
+
+    Keyed on (frame_key, layout stamp, column roles, group, bin-edges
+    digest): the stamp identifies the distributed data state and the edges
+    are a pure function of (data, nbins), so a repeat fit on an unmutated
+    DistFrame hits — zero ``apply_bins`` decodes, zero upload bytes (the
+    miss path's ledger charge never happens). The entry is linked to the
+    frame key so a DKV remove/rekey evicts it. Entries are read-only by
+    protocol: routing/partials only ever index ``st.bins``."""
+    bk = payload.get("bins_key")
+    if bk is None:  # replayed pre-cache caller: decode uncached
+        _BIND_CACHE.inc(result="miss")
+        return np.asarray(apply_bins(st.X, edges))
+    token = tuple(tuple(x) if isinstance(x, list) else x for x in bk)
+    decoded = []
+
+    def _decode() -> np.ndarray:
+        decoded.append(True)
+        return np.asarray(apply_bins(st.X, edges))
+
+    bins = _devcache.cached_host(
+        "hist_bins_home", token, (st.g, _edges_digest(edges)), _decode,
+        frame_key=str(bk[0]))
+    _BIND_CACHE.inc(result="miss" if decoded else "hit")
+    return bins
+
+
 def hist_bind(payload: Dict[str, Any], cloud, store) -> Dict[str, Any]:
     st = _ctx_group(payload)
     _check_seq(st, int(payload["seq"]))
     edges = np.asarray(payload["edges"], np.float64)
-    st.bins = np.asarray(apply_bins(st.X, edges))
+    st.bins = _bind_codes(st, payload, edges)
     st.X = None
     st.F = int(edges.shape[0])
     st.n_bins1 = int(edges.shape[1]) + 2
@@ -428,6 +494,13 @@ def hist_bind(payload: Dict[str, Any], cloud, store) -> Dict[str, Any]:
     return {"n": int(n)}
 
 
+def _meter_level_out(st: _GroupState, op: Dict[str, Any], out) -> None:
+    if op["kind"] == "level" and out is not None:
+        guard_hist_payload("histogram partial", out.shape[0], out.shape[1],
+                           st.F, st.n_bins1)
+        _PARTIAL_BYTES.inc(float(out.nbytes))
+
+
 def hist_level(payload: Dict[str, Any], cloud, store) -> Any:
     st = _ctx_group(payload)
     op = payload["op"]
@@ -438,11 +511,32 @@ def hist_level(payload: Dict[str, Any], cloud, store) -> Any:
     out = _apply_op(st, op, build=True)
     if seq_fenced:
         _ledger.charge(_ledger.HIST_LEVEL_WALL, time.perf_counter() - t0)
-    if op["kind"] == "level" and out is not None:
-        guard_hist_payload("histogram partial", out.shape[0], out.shape[1],
-                           st.F, st.n_bins1)
-        _PARTIAL_BYTES.inc(float(out.nbytes))
+    _meter_level_out(st, op, out)
     return out
+
+
+def hist_levels(payload: Dict[str, Any], cloud, store) -> List[Any]:
+    """Batched protocol rounds: apply ``payload["ops"]`` — a list of
+    ``{"seq", "op"}`` items in issue order — against one group and return
+    the per-op outputs. Each fenced op checks/advances the seq exactly as
+    its own ``hist_level`` round would, so the batch converges to the same
+    state and the 404/409 -> replay ladder is unchanged (the payload's
+    top-level ``seq`` is the first fenced op's, the replay fence point)."""
+    st = _ctx_group(payload)
+    t0 = time.perf_counter()
+    outs: List[Any] = []
+    fenced = False
+    for item in payload["ops"]:
+        op = item["op"]
+        if op["kind"] != "margins":
+            _check_seq(st, int(item["seq"]))
+            fenced = True
+        out = _apply_op(st, op, build=True)
+        _meter_level_out(st, op, out)
+        outs.append(out)
+    if fenced:
+        _ledger.charge(_ledger.HIST_LEVEL_WALL, time.perf_counter() - t0)
+    return outs
 
 
 def hist_replay(payload: Dict[str, Any], cloud, store) -> Dict[str, Any]:
@@ -469,6 +563,7 @@ _HANDLERS = {
     "hist_open": hist_open,
     "hist_bind": hist_bind,
     "hist_level": hist_level,
+    "hist_levels": hist_levels,
     "hist_replay": hist_replay,
     "hist_fin": hist_fin,
 }
@@ -582,6 +677,10 @@ class DistTreeMatrix:
                        f"#{self.mode}#{n_fit}")
         self._seq = 0
         self._oplog: List[Dict[str, Any]] = []
+        #: output-free ops (seq already assigned, oplog already appended)
+        #: waiting to ride the next output-bearing hist_levels round
+        self._pending: List[Dict[str, Any]] = []
+        self._batch = _batch_enabled()
         self._bind_common: Optional[Dict[str, Any]] = None
         self._exec_map: Dict[int, str] = {}
         self._timeout = _timeout()
@@ -638,6 +737,12 @@ class DistTreeMatrix:
         self._bind_common = {
             "ctx_id": self.ctx_id,
             "seq": 1,
+            # data identity of the binned codes: homes key their decoded
+            # matrix on this + the edges digest so a repeat fit on an
+            # unmutated frame re-decodes nothing (see _bind_codes)
+            "bins_key": [self.layout["frame_key"], self.layout["stamp"],
+                         self.y_name, self.w_name or "", self.off_name or "",
+                         list(self.pred_names), int(self.nbins)],
             "edges": self.edges,
             "bases": [int(b) for b in self.bases[:-1]],
             "n_total": self.n_total,
@@ -655,17 +760,38 @@ class DistTreeMatrix:
         seq = self._seq + 1
         self._seq = seq
         self._oplog.append(op)
-        return self._fan("hist_level",
+        if (self._batch and op["kind"] == "fin"
+                and not op.get("want_margin")):
+            # output-free fin: defer it — the next output-bearing op (the
+            # following block's level 0, or the final margins read) ships
+            # it in the same hist_levels round, one dispatch + wire trip
+            # instead of two. Seq/oplog state is already advanced, so the
+            # replay ladder sees exactly the sequential history.
+            self._pending.append({"seq": seq, "op": op})
+            return []
+        return self._flush({"seq": seq, "op": op})
+
+    def _flush(self, item: Dict[str, Any]) -> List[Any]:
+        """One protocol round carrying ``item`` (plus any deferred ops):
+        a plain ``hist_level`` when nothing is pending, else a batched
+        ``hist_levels`` whose outputs list ends with ``item``'s."""
+        if not self._pending:
+            payloads = [{"ctx_id": self.ctx_id, "g": gi,
+                         "seq": item.get("seq", self._seq + 1),
+                         "op": item["op"]}
+                        for gi in range(len(self.groups))]
+            return self._fan("hist_level", payloads)
+        items = self._pending + [item]
+        self._pending = []
+        first_seq = int(items[0]["seq"])
+        outs = self._fan("hist_levels",
                          [{"ctx_id": self.ctx_id, "g": gi,
-                           "seq": seq, "op": op}
+                           "seq": first_seq, "ops": items}
                           for gi in range(len(self.groups))])
+        return [o[-1] for o in outs]
 
     def _margins(self) -> np.ndarray:
-        op = {"kind": "margins"}
-        outs = self._fan("hist_level",
-                         [{"ctx_id": self.ctx_id, "g": gi,
-                           "seq": self._seq + 1, "op": op}
-                          for gi in range(len(self.groups))])
+        outs = self._flush({"op": {"kind": "margins"}})
         return np.concatenate([np.asarray(o, np.float64) for o in outs],
                               axis=0)
 

@@ -24,6 +24,8 @@ this module is the portable XLA path and the correctness oracle.
 
 from __future__ import annotations
 
+import os
+import threading
 from functools import partial
 from typing import Optional, Tuple  # noqa: F401
 
@@ -39,6 +41,69 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
 from h2o3_tpu.parallel.mesh import DATA_AXIS
+from h2o3_tpu.util import telemetry
+
+
+# ---------------------------------------------------------------------------
+# fixed-shape level plans: the node-bucket ladder
+#
+# ``n_nodes`` is a static jit argname, so every tree level 2^d used to be a
+# fresh plan (~100-250 ms of XLA compile per level, per HIST_BENCH). Padding
+# the node dimension up to a small ladder of power-of-2 buckets makes one
+# traced plan serve every level in the bucket: pad rows are zero-filled (a
+# scatter-add / one-hot contraction never touches a node id beyond the real
+# range) and the real ``n_nodes`` rows are sliced back out, so the result is
+# bit-identical to the unpadded build.
+
+_DEFAULT_NODE_BUCKETS = (8, 64, 512)
+
+PLAN_CACHE = telemetry.counter(
+    "hist_plan_cache_total",
+    "histogram level-plan lookups against the padded-bucket jit cache",
+    labels=("result",),
+)
+
+_PLAN_LOCK = threading.Lock()
+_PLAN_KEYS: set = set()
+
+
+def node_buckets() -> Tuple[int, ...]:
+    """The node-capacity ladder from ``H2O3_TPU_HIST_NODE_BUCKETS``
+    (comma-separated, default ``8,64,512``; ``0``/empty disables padding)."""
+    raw = os.environ.get("H2O3_TPU_HIST_NODE_BUCKETS")
+    if raw is None:
+        return _DEFAULT_NODE_BUCKETS
+    try:
+        vals = sorted({int(t) for t in raw.split(",") if t.strip()})
+    except ValueError:
+        return _DEFAULT_NODE_BUCKETS
+    return tuple(v for v in vals if v > 0)
+
+
+def pad_nodes(n_nodes: int) -> int:
+    """Smallest ladder bucket >= ``n_nodes`` (identity above the ladder
+    or with the ladder disabled)."""
+    for b in node_buckets():
+        if n_nodes <= b:
+            return b
+    return n_nodes
+
+
+def _shape_sig(arrays) -> Tuple:
+    return tuple(
+        None if a is None else (tuple(a.shape), str(a.dtype)) for a in arrays
+    )
+
+
+def _note_plan(key: Tuple) -> None:
+    """Meter a plan-cache lookup: ``miss`` the first time a jit cache key
+    is seen by this process, ``hit`` after — the bench asserts warm tree
+    levels are all hits (compile-free) instead of inferring it from walls."""
+    with _PLAN_LOCK:
+        seen = key in _PLAN_KEYS
+        if not seen:
+            _PLAN_KEYS.add(key)
+    PLAN_CACHE.inc(result="hit" if seen else "miss")
 
 
 # ---------------------------------------------------------------------------
@@ -213,45 +278,57 @@ def _shard_histogram(bins, nodes, g, h, n_nodes: int, n_bins1: int, rw=None):
 
 
 def _shard_node_totals(nodes, g, h, n_nodes: int, rw=None):
-    """Per-node (Σg, Σh, Σw) [K, 3] — one masked one-hot contraction.
+    """Per-node (Σg, Σh, Σw) [K, 3] — one masked 1-D scatter-add per channel.
 
     The terminal tree level needs only these totals (leaf values), not the
     full per-(feature, bin) histogram: splitting is impossible at max
     depth, so the [K, F, B+1, 3] build there would be pure waste — and it
-    is the widest (most expensive) level of the whole tree."""
+    is the widest (most expensive) level of the whole tree.
+
+    Scatter (not a one-hot contraction): a scatter-add accumulates per
+    destination index in a capacity-independent order, so a node dimension
+    padded to the bucket ladder stays bit-identical to the unpadded build —
+    a dot_general's blocking (and with it the float accumulation order)
+    shifts with the padded K."""
     valid = nodes >= 0
+    node = jnp.where(valid, nodes, 0)  # masked rows add an exact 0.0 below
     w = valid.astype(g.dtype)
     cw = w if rw is None else w * rw
-    onehot = (
-        nodes[:, None] == jnp.arange(n_nodes, dtype=nodes.dtype)[None, :]
-    ).astype(g.dtype)  # [N, K]; node<0 never matches
-    vals = jnp.stack([g * w, h * w, cw], axis=1)  # [N, 3]
-    return jax.lax.dot_general(
-        onehot, vals, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )  # [K, 3]
+    chans = [
+        jnp.zeros(n_nodes, g.dtype).at[node].add(v)
+        for v in (g * w, h * w, cw)
+    ]
+    return jnp.stack(chans, axis=1)  # [K, 3]
 
 
 def node_totals_sharded(nodes, g, h, n_nodes: int, mesh=None, rw=None):
-    """Distributed per-node totals: shard-private contraction + psum."""
+    """Distributed per-node totals: shard-private contraction + psum.
+
+    The node dimension is padded to the bucket ladder (``pad_nodes``) so one
+    traced shape serves every level in a bucket; node ids never reach the
+    pad columns, so slicing the real rows back out is bit-identical."""
+    k_pad = pad_nodes(n_nodes)
+    _note_plan(("totals", k_pad, _shape_sig((nodes, g, h, rw)), mesh))
     if mesh is None:
-        return _shard_node_totals(nodes, g, h, n_nodes, rw=rw)
+        out = _shard_node_totals(nodes, g, h, k_pad, rw=rw)
+        return out[:n_nodes] if k_pad != n_nodes else out
 
     extras = [] if rw is None else [rw]
 
     def fn(nd, gg, hh, *rest):
         part = _shard_node_totals(
-            nd, gg, hh, n_nodes, rw=rest[0] if rest else None
+            nd, gg, hh, k_pad, rw=rest[0] if rest else None
         )
         return jax.lax.psum(part, DATA_AXIS)
 
-    return _shard_map(
+    out = _shard_map(
         fn,
         mesh=mesh,
         in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS))
         + tuple(P(DATA_AXIS) for _ in extras),
         out_specs=P(),
     )(nodes, g, h, *extras)
+    return out[:n_nodes] if k_pad != n_nodes else out
 
 
 def _hist_impl(impl: Optional[str]) -> str:
@@ -296,12 +373,17 @@ def build_histogram_sharded(
     it so the pallas path skips a per-call transpose. rw: optional [N]
     per-row count weight (weights_column: the count channel reports Σw).
     Returns replicated [n_nodes, F, n_bins1, 3].
+
+    The node dimension is padded up to the bucket ladder (``pad_nodes``)
+    before the jit call — one compiled plan per bucket instead of one per
+    tree level — and the real ``n_nodes`` rows are sliced back out.
     """
     # resolve the env overrides OUTSIDE the jit cache so changing them
     # between calls takes effect (the resolved values are static cache keys);
     # the scatter impl ignores dtype — pin it so flipping the dtype env var
     # neither recompiles nor (if invalid) breaks the path that never reads it
     impl = _hist_impl(impl)
+    k_pad = pad_nodes(n_nodes)
     kernel = "auto"
     if impl == "pallas":
         from h2o3_tpu.ops.pallas_histogram import (
@@ -313,14 +395,22 @@ def build_histogram_sharded(
         dtype = (
             "bf16" if _resolve_hist_dtype("auto") == jnp.bfloat16 else "f32"
         )
-        if n_nodes * _C <= _fact_max_kc():
+        # kernel choice keys off the PADDED count — that is the shape the
+        # kernel actually compiles for, so every level in a bucket picks
+        # the same kernel and shares the one plan
+        if k_pad * _C <= _fact_max_kc():
             kernel = "factorized"
     else:
         dtype = "f32"
-    return _build_histogram_jit(
-        bins, nodes, g, h, bins_fm, rw, n_nodes, n_bins1, mesh, impl, dtype,
+    _note_plan((
+        "hist", k_pad, n_bins1, _shape_sig((bins, nodes, g, h, bins_fm, rw)),
+        mesh, impl, dtype, kernel,
+    ))
+    out = _build_histogram_jit(
+        bins, nodes, g, h, bins_fm, rw, k_pad, n_bins1, mesh, impl, dtype,
         kernel,
     )
+    return out[:n_nodes] if k_pad != n_nodes else out
 
 
 @partial(

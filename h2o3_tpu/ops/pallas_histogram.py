@@ -45,6 +45,23 @@ from jax.experimental.pallas import tpu as pltpu
 # operand lane-friendly.
 _C = 4
 
+
+def _out_sds(shape, dtype, vma):
+    """ShapeDtypeStruct carrying the shard-varying axes when this jax
+    version tracks them (the ``vma`` kwarg and ``lax.pvary`` arrived
+    together); older versions have no VMA machinery to inform."""
+    try:
+        return jax.ShapeDtypeStruct(
+            shape, dtype, vma=frozenset(vma) if vma else None)
+    except TypeError:
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _pvary(x, vma):
+    if vma and hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, tuple(vma))
+    return x
+
 #: node-matmul kernel applies while K*_C <= this (VMEM budget for the
 #: [Fb*B1, K*C] accumulator + operands; ~16 MB/core on v5e)
 _NODE_MATMUL_MAX_KC = 512
@@ -181,8 +198,7 @@ def _build_histogram_nodematmul(
 
     # resident constant: one-hot sublane b (within a feature) covers bin b
     jmod = jnp.asarray(np.arange(n_bins1)[:, None], dtype=jnp.float32)
-    if vma:
-        jmod = jax.lax.pvary(jmod, tuple(vma))
+    jmod = _pvary(jmod, vma)
 
     out = pl.pallas_call(
         partial(_nm_kernel, n_feat_b=fb, n_bins1=n_bins1, n_nodes=n_nodes),
@@ -197,10 +213,8 @@ def _build_histogram_nodematmul(
         out_specs=pl.BlockSpec(
             (1, n_nodes * _C, fb * n_bins1), lambda f, t: (f, 0, 0)
         ),
-        out_shape=jax.ShapeDtypeStruct(
-            (n_ftiles, n_nodes * _C, fb * n_bins1), jnp.float32,
-            vma=frozenset(vma) if vma else None,
-        ),
+        out_shape=_out_sds(
+            (n_ftiles, n_nodes * _C, fb * n_bins1), jnp.float32, vma),
         interpret=interpret,
     )(jmod, bins_fm, nodes[:, None], vals)
 
@@ -319,10 +333,8 @@ def _build_histogram_factorized(
         out_specs=pl.BlockSpec(
             (1, fb * n_hi, kc * _FACT_LO), lambda f, t: (f, 0, 0)
         ),
-        out_shape=jax.ShapeDtypeStruct(
-            (n_ftiles, fb * n_hi, kc * _FACT_LO), jnp.float32,
-            vma=frozenset(vma) if vma else None,
-        ),
+        out_shape=_out_sds(
+            (n_ftiles, fb * n_hi, kc * _FACT_LO), jnp.float32, vma),
         interpret=interpret,
     )(bins_fm, nodes[:, None], vals)
 
@@ -522,10 +534,8 @@ def _build_histogram_pallas_jit(
         # slab n_nodes is the dummy for trailing all-pad tiles; vma marks the
         # per-shard output as varying over the mesh axes when called inside
         # shard_map (each shard builds its private histogram pre-psum)
-        out_shape=jax.ShapeDtypeStruct(
-            (n_nodes + 1, n_feat, _C, n_bins1), jnp.float32,
-            vma=frozenset(vma) if vma else None,
-        ),
+        out_shape=_out_sds(
+            (n_nodes + 1, n_feat, _C, n_bins1), jnp.float32, vma),
         interpret=interpret,
     )(item_node, item_first, bins_p, vals_p)
 

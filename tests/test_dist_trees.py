@@ -214,6 +214,63 @@ def test_partials_only(homed, monkeypatch):
 
 
 # ---------------------------------------------------------------------------
+# warm fits ride the device cache: zero re-decode, zero re-upload
+
+
+def test_warm_fit_reuses_resident_bins(homed, monkeypatch):
+    """A second fit on an unmutated DistFrame must serve every home's
+    binned codes and sketches from the device cache: zero apply_bins
+    decodes, zero upload-charging misses — one bind-cache hit per group."""
+    clouds, fr = homed
+    monkeypatch.setenv("H2O3_TPU_DIST_HIST", "1")
+    _fit("gbm", "reg", fr)  # cold at most once; later fits must be warm
+    n_groups = len(fr.chunk_layout["groups"])
+    hit0 = _counter("dist_hist_bind_cache_total", result="hit")
+    miss0 = _counter("dist_hist_bind_cache_total", result="miss")
+    up0 = _counter("devcache_requests_total",
+                   kind="hist_bins_home", result="miss")
+    sk0 = _counter("devcache_requests_total",
+                   kind="hist_sketch_home", result="miss")
+    _fit("gbm", "reg", fr)
+    assert _counter("dist_hist_bind_cache_total", result="miss") == miss0, (
+        "warm fit re-decoded binned codes")
+    assert _counter("dist_hist_bind_cache_total",
+                    result="hit") == hit0 + n_groups
+    assert _counter("devcache_requests_total", kind="hist_bins_home",
+                    result="miss") == up0, "warm fit re-uploaded binned codes"
+    assert _counter("devcache_requests_total", kind="hist_sketch_home",
+                    result="miss") == sk0, "warm fit re-sketched columns"
+
+
+# ---------------------------------------------------------------------------
+# batched level rounds
+
+
+def test_batched_rounds_bit_identical(homed, monkeypatch):
+    """Coalescing output-free fin ops into hist_levels multi-op rounds
+    must not move a single bit — and must actually batch (>=2 ops per
+    round) when enabled."""
+    clouds, fr = homed
+    monkeypatch.setenv("H2O3_TPU_DIST_HIST", "1")
+    monkeypatch.setenv("H2O3_TPU_DIST_HIST_BATCH", "0")
+    ref = _sig(_fit("gbm", "bin", fr))
+
+    calls = {"n": 0}
+    real = dist_hist.hist_levels
+
+    def counting(payload, cloud, store):
+        assert len(payload["ops"]) >= 2, "single-op round routed to batch op"
+        calls["n"] += 1
+        return real(payload, cloud, store)
+
+    monkeypatch.setenv("H2O3_TPU_DIST_HIST_BATCH", "1")
+    monkeypatch.setattr(dist_hist, "hist_levels", counting)
+    monkeypatch.setitem(dist_hist._HANDLERS, "hist_levels", counting)
+    assert _sig(_fit("gbm", "bin", fr)) == ref
+    assert calls["n"] > 0, "batching on but no multi-op round went out"
+
+
+# ---------------------------------------------------------------------------
 # context fencing + replay
 
 

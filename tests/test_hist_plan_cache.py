@@ -1,0 +1,169 @@
+"""Fixed-shape level plans: the node-bucket padding ladder.
+
+Contract (h2o3_tpu/ops/histogram.py): every histogram/totals launch pads
+its node dimension up to a bucket ladder (default 8/64/512, override
+``H2O3_TPU_HIST_NODE_BUCKETS``) so ONE traced jit plan serves every tree
+level that lands in the same bucket; the real node rows are sliced back
+out and the result is BIT-identical to the unpadded build, because the
+scatter-add accumulation order does not depend on the destination
+capacity. ``hist_plan_cache_total{result}`` meters lookups against the
+padded-shape plan cache — a warm fit must record zero misses.
+"""
+
+import pickle
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from h2o3_tpu import Frame
+from h2o3_tpu.models.grid import metric_value
+from h2o3_tpu.models.tree import DRF, GBM, XGBoost
+from h2o3_tpu.ops import histogram as H
+
+pytestmark = pytest.mark.leaks_keys
+
+
+# ---------------------------------------------------------------------------
+# the ladder itself
+
+
+def test_pad_nodes_default_ladder():
+    assert H.node_buckets() == (8, 64, 512)
+    # bucket edges: at the edge stays, one past jumps to the next rung,
+    # past the top rung runs unpadded
+    for n, want in [(1, 8), (7, 8), (8, 8), (9, 64), (64, 64),
+                    (65, 512), (512, 512), (513, 513), (4096, 4096)]:
+        assert H.pad_nodes(n) == want, (n, want)
+
+
+def test_pad_nodes_env_ladder(monkeypatch):
+    monkeypatch.setenv("H2O3_TPU_HIST_NODE_BUCKETS", "4,16")
+    assert H.node_buckets() == (4, 16)
+    assert [H.pad_nodes(n) for n in (1, 4, 5, 16, 17)] == [4, 4, 16, 16, 17]
+    # no positive buckets -> padding disabled, every shape runs as-is
+    monkeypatch.setenv("H2O3_TPU_HIST_NODE_BUCKETS", "0")
+    assert H.node_buckets() == ()
+    assert H.pad_nodes(3) == 3
+    # garbage falls back to the default ladder rather than breaking fits
+    monkeypatch.setenv("H2O3_TPU_HIST_NODE_BUCKETS", "eight")
+    assert H.node_buckets() == (8, 64, 512)
+
+
+# ---------------------------------------------------------------------------
+# bit-identity of padded launches, across the bucket boundaries
+
+
+def _level_inputs(rng, n, k, f=3, b=6):
+    bins = jnp.asarray(rng.integers(0, b + 1, size=(n, f)).astype(np.int32))
+    nodes = jnp.asarray(rng.integers(-1, k, size=n).astype(np.int32))
+    g = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    h = jnp.asarray(rng.random(n).astype(np.float32))
+    rw = jnp.asarray((1.0 + rng.random(n)).astype(np.float32))
+    return bins, nodes, g, h, rw, b + 1
+
+
+@pytest.mark.parametrize("k", [1, 7, 8, 9, 64, 65])
+@pytest.mark.parametrize("with_rw", [False, True])
+def test_padded_bit_identical(monkeypatch, rng, k, with_rw):
+    bins, nodes, g, h, rw, n_bins1 = _level_inputs(rng, 1024, k)
+    rw = rw if with_rw else None
+    hist = np.asarray(H.build_histogram_sharded(
+        bins, nodes, g, h, n_nodes=k, n_bins1=n_bins1, rw=rw))
+    tot = np.asarray(H.node_totals_sharded(nodes, g, h, n_nodes=k, rw=rw))
+    monkeypatch.setenv("H2O3_TPU_HIST_NODE_BUCKETS", "0")  # unpadded ref
+    ref_h = np.asarray(H.build_histogram_sharded(
+        bins, nodes, g, h, n_nodes=k, n_bins1=n_bins1, rw=rw))
+    ref_t = np.asarray(H.node_totals_sharded(nodes, g, h, n_nodes=k, rw=rw))
+    assert hist.shape == ref_h.shape == (k, 3, n_bins1, 3)
+    assert hist.tobytes() == ref_h.tobytes(), f"histogram drift at k={k}"
+    assert tot.tobytes() == ref_t.tobytes(), f"totals drift at k={k}"
+
+
+def test_pad_rows_are_exact_zero(rng):
+    # node ids never reach the pad rows, so the padded capacity beyond the
+    # real node count accumulates exact 0.0 — assert via the full padded
+    # build with the ladder forced to a single oversized bucket
+    bins, nodes, g, h, _, n_bins1 = _level_inputs(rng, 512, 3)
+    full = np.asarray(H._build_histogram_jit(
+        bins, nodes, g, h, None, None, 8, n_bins1, None, "scatter", "f32",
+        "auto"))
+    assert full.shape[0] == 8
+    assert not full[3:].any(), "pad rows picked up mass"
+
+
+# ---------------------------------------------------------------------------
+# plan-cache accounting: one miss per bucket, hits for every level after
+
+
+def _plan(result):
+    from h2o3_tpu.util import telemetry
+
+    c = telemetry.REGISTRY.get("hist_plan_cache_total")
+    return 0.0 if c is None else c.value(result=result)
+
+
+def test_one_plan_per_bucket(rng):
+    bins, nodes, g, h, _, n_bins1 = _level_inputs(rng, 2048, 8)
+    miss0, hit0 = _plan("miss"), _plan("hit")
+    for k in (1, 2, 4, 8):  # one bucket: four "levels", one plan
+        nk = jnp.asarray(rng.integers(-1, k, size=2048).astype(np.int32))
+        H.build_histogram_sharded(bins, nk, g, h, n_nodes=k, n_bins1=n_bins1)
+    miss = _plan("miss") - miss0
+    hit = _plan("hit") - hit0
+    assert miss <= 1, f"plan churn inside one bucket: {miss} misses"
+    assert miss + hit == 4
+
+
+# ---------------------------------------------------------------------------
+# whole-fit bit-identity: the ladder must never change a model
+
+
+def _frames(seed=7, n=3000):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 5))
+    reg = 3 * X[:, 0] + np.sin(3 * X[:, 1]) * 2 + X[:, 2] * X[:, 3]
+    cls = np.where(reg + 0.3 * rng.normal(size=n) > 0, "yes", "no")
+    cols = {f"x{i}": X[:, i] for i in range(5)}
+    return (Frame.from_dict(cols | {"y": reg}),
+            Frame.from_dict(cols | {"y": cls}))
+
+
+def _sig(model):
+    bt = model.booster
+    arrays = [
+        np.stack(getattr(t, f))
+        for t in bt.trees_per_class
+        for f in ("feat", "split_bin", "default_left", "is_split", "leaf")
+    ]
+    return pickle.dumps([arrays, np.asarray(bt.init_margin),
+                         metric_value(model, "auto")[0]])
+
+
+def _model(algo):
+    kw = dict(response_column="y", ntrees=3, max_depth=4, seed=11)
+    if algo == "gbm":
+        return GBM(**kw)
+    if algo == "drf":
+        return DRF(sample_rate=0.7, **kw)
+    return XGBoost(**kw)
+
+
+@pytest.mark.parametrize("algo", ["gbm", "drf", "xgb"])
+@pytest.mark.parametrize("resp", ["reg", "bin"])
+def test_fit_matrix_padded_vs_unpadded(monkeypatch, algo, resp):
+    fr_reg, fr_bin = _frames()
+    fr = fr_reg if resp == "reg" else fr_bin
+    padded = _model(algo).train(fr)
+    monkeypatch.setenv("H2O3_TPU_HIST_NODE_BUCKETS", "0")
+    unpadded = _model(algo).train(fr)
+    assert _sig(padded) == _sig(unpadded), f"{algo}/{resp} drifts under padding"
+
+
+def test_warm_fit_compiles_no_plans():
+    fr_reg, _ = _frames()
+    _model("gbm").train(fr_reg)  # cold: traces this shape family once
+    miss0 = _plan("miss")
+    _model("gbm").train(fr_reg)  # warm: every level must hit
+    assert _plan("miss") == miss0, "warm fit missed the plan cache"
