@@ -347,6 +347,167 @@ def _cache_bench() -> None:
     }))
 
 
+def _dist_rapids_cell() -> dict:
+    """The distributed-Rapids cell of ``--rapids-bench``: one fused
+    ``:=``/filter/reduce pipeline run caller-local over a materialized
+    frame (1-node, the bit-identity reference) and again over a
+    chunk-homed ``DistFrame`` on a 3-node in-process cloud
+    (``rapids/dist_exec.py``), where each region ships as a canonical
+    sexpr and the derived/filtered columns stay home-resident.  Reports
+    warm pipeline wall and per-op wall for both modes, the bytes that
+    actually moved (dtask payloads + ring reads, pinned so gossip noise
+    cannot pollute the cell) vs the f64 frame body a gather would move,
+    and asserts ``bit_identical`` + ``partials_only`` + a
+    zero-plan-compile warm path in-run."""
+    import numpy as np
+
+    from h2o3_tpu.cluster import dkv as cdkv
+    from h2o3_tpu.cluster import tasks as ctasks
+    from h2o3_tpu.cluster.membership import Cloud, set_local_cloud
+    from h2o3_tpu.frame.parse import _iter_body_chunks, parse_csv, \
+        parse_setup
+    from h2o3_tpu.keyed import KeyedStore
+    from h2o3_tpu.rapids.runtime import Session, exec_rapids
+    from h2o3_tpu.util import telemetry
+
+    n = int(os.environ.get("BENCH_DIST_RAPIDS_ROWS", 30_000))
+    reps = 3
+
+    def _meter(name, **labels):
+        c = telemetry.REGISTRY.get(name)
+        if c is None:
+            return 0.0
+        return sum(s["value"] for s in c.snapshot()["series"]
+                   if all(s["labels"].get(k) == v
+                          for k, v in labels.items()))
+
+    # integer-valued floats: reducer partials are exact in f64 under
+    # any chunk partitioning, so merge order cannot move bits
+    xs = np.arange(n) % 97
+    ys = (np.arange(n) * 7) % 31
+    text = "x,y\n" + "".join(f"{xs[i]},{ys[i]}\n" for i in range(n))
+
+    clouds = []
+    for i in range(3):
+        c = Cloud("rapbench", f"rb{i}", hb_interval=0.05)
+        cdkv.install(c, KeyedStore())
+        ctasks.install(c)
+        clouds.append(c)
+    seeds = [c.info.addr for c in clouds]
+    for c in clouds:
+        c.start([a for a in seeds if a != c.info.addr])
+    deadline = time.monotonic() + 20.0
+    while time.monotonic() < deadline and not all(
+            c.size() == 3 for c in clouds):
+        time.sleep(0.02)
+
+    saved = os.environ.get("H2O3_TPU_RAPIDS_FUSION")
+    try:
+        set_local_cloud(clouds[0])
+        os.environ["H2O3_TPU_RAPIDS_FUSION"] = "1"
+        setup = parse_setup(text)
+        chunks = list(_iter_body_chunks(
+            [text.encode()], 16384, setup.header,
+            setup.skip_blank_lines))
+        fr = ctasks.distributed_parse_chunks(
+            chunks, setup, cloud=clouds[0], key="bench_dist_rapids_df")
+        n_homes = len({g["home_name"]
+                       for g in fr.chunk_layout["groups"]})
+
+        session = Session()
+        session.assign("db", fr)
+        session.assign("lb", parse_csv(text))
+
+        # :=-derive onto the homes, filter through a shipped mask,
+        # reduce to partials — three regions, ~4 fused prims
+        n_ops = 4
+
+        def _pipeline(v):
+            exec_rapids(
+                f"(tmp= {v}d (:= {v}b (* (cols_py {v}b 0) 2) 1 _))",
+                session)
+            exec_rapids(
+                f"(tmp= {v}f (rows {v}d (< (cols_py {v}d 0) 48)))",
+                session)
+            out = exec_rapids(
+                f"(sum (* (cols_py {v}f 0) (cols_py {v}f 1)))", session)
+            return int(np.float64(out.value).view(np.uint64))
+
+        def _timed(v):
+            sig = _pipeline(v)  # cold: compiles, probes, caches
+            w0 = _meter("rpc_payload_bytes_total",
+                        direction="sent", method="dtask")
+            g0 = _meter("rpc_payload_bytes_total", method="dkv_get")
+            pb0 = _meter("rapids_dist_partial_bytes_total")
+            dd0 = _meter("rapids_dist_total", result="dist")
+            pm0 = (_meter("mapreduce_plan_cache_total",
+                          op="rapids_dist", result="miss")
+                   + _meter("mapreduce_plan_cache_total",
+                            op="rapids_fusion", result="miss"))
+            t = time.perf_counter()
+            sig = _pipeline(v)
+            wall = time.perf_counter() - t
+            meters = {
+                "moved_bytes": (
+                    _meter("rpc_payload_bytes_total",
+                           direction="sent", method="dtask") - w0
+                    + _meter("rpc_payload_bytes_total",
+                             method="dkv_get") - g0),
+                "partial_bytes": (
+                    _meter("rapids_dist_partial_bytes_total") - pb0),
+                "dist_regions": (
+                    _meter("rapids_dist_total", result="dist") - dd0),
+                "plan_misses": (
+                    _meter("mapreduce_plan_cache_total",
+                           op="rapids_dist", result="miss")
+                    + _meter("mapreduce_plan_cache_total",
+                             op="rapids_fusion", result="miss") - pm0),
+            }
+            for _ in range(reps - 1):
+                t = time.perf_counter()
+                _pipeline(v)
+                wall = min(wall, time.perf_counter() - t)
+            return {"sig": sig, "wall": wall, **meters}
+
+        local = _timed("l")
+        dist = _timed("d")
+
+        frame_bytes = 8 * n * 2
+        partials_only = dist["moved_bytes"] < frame_bytes / 4
+        return {
+            "rows": n,
+            "homes": n_homes,
+            "pipeline": ":= derive -> mask filter -> sum reduce",
+            "pipeline_ops": n_ops,
+            "warm_wall_1node_ms": round(local["wall"] * 1e3, 2),
+            "warm_wall_3node_ms": round(dist["wall"] * 1e3, 2),
+            "warm_per_op_ms_1node": round(
+                local["wall"] * 1e3 / n_ops, 3),
+            "warm_per_op_ms_3node": round(
+                dist["wall"] * 1e3 / n_ops, 3),
+            "dist_regions_per_run": int(dist["dist_regions"]),
+            "wire_moved_bytes": int(dist["moved_bytes"]),
+            "partial_bytes": int(dist["partial_bytes"]),
+            "frame_body_bytes": frame_bytes,
+            "wire_vs_frame_ratio": round(
+                dist["moved_bytes"] / max(frame_bytes, 1), 4),
+            "bit_identical": local["sig"] == dist["sig"],
+            "partials_only": bool(partials_only),
+            "warm_zero_plan_compile": dist["plan_misses"] == 0.0,
+        }
+    finally:
+        if saved is None:
+            os.environ.pop("H2O3_TPU_RAPIDS_FUSION", None)
+        else:
+            os.environ["H2O3_TPU_RAPIDS_FUSION"] = saved
+        set_local_cloud(None)
+        for c in clouds:
+            try:
+                c.stop()
+            except Exception:
+                pass
+
+
 def _rapids_bench() -> None:
     """CPU-runnable rapids query-fusion bench (fusion PR acceptance).
 
@@ -454,10 +615,14 @@ def _rapids_bench() -> None:
         "fused_regions": fusion_counter.value(result="fused"),
         "fallback_regions": fusion_counter.value(result="fallback"),
     }
+    dist_cell = _dist_rapids_cell()
+    result["dist_rapids"] = dist_cell
     with open(os.path.join(_HERE, "RAPIDS_BENCH.json"), "w") as f:
         json.dump(result, f, indent=1)
     print(json.dumps(result))
-    if not (bit_identical and mixed_identical and warm_clean):
+    if not (bit_identical and mixed_identical and warm_clean
+            and dist_cell["bit_identical"] and dist_cell["partials_only"]
+            and dist_cell["warm_zero_plan_compile"]):
         sys.exit(1)
 
 

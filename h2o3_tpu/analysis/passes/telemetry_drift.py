@@ -58,8 +58,8 @@ _METRIC_SUFFIXES = ("_total", "_seconds", "_bytes", "_entries", "_workers",
 #: actually contain — ``##`` sections or ``###`` subsections (the cost
 #: ledger and cluster profiler live under ``## Observability``)
 _METRIC_SECTIONS = ("Observability", "Clustering", "Distributed Frames",
-                    "Distributed model search", "Distributed training",
-                    "Failure model", "Serving plane",
+                    "Distributed Rapids", "Distributed model search",
+                    "Distributed training", "Failure model", "Serving plane",
                     "Cost ledger & slow-op log", "Cluster profiler",
                     "Health plane", "Device cache")
 
@@ -125,6 +125,7 @@ def live_metrics() -> set:
     import h2o3_tpu.ops.histogram    # noqa: F401  hist_plan_cache meter
     import h2o3_tpu.api.coalesce     # noqa: F401  predict_batch_size
     import h2o3_tpu.rapids.fusion    # noqa: F401  rapids_fusion_* meters
+    import h2o3_tpu.rapids.dist_exec  # noqa: F401  rapids_dist_* meters
     import h2o3_tpu.util.ledger      # noqa: F401  ledger_* / slowop_* meters
     import h2o3_tpu.util.flight     # noqa: F401  flight_events_total
     import h2o3_tpu.cluster.health  # noqa: F401  cluster_health_state

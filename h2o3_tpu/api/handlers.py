@@ -658,11 +658,18 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
             fr = val.as_frame()
             key = getattr(fr, "key", None) or DKV.make_key("rapids")
             DKV.put(key, fr)
-            return {
+            out = {
                 "key": {"name": key},
                 "num_rows": fr.nrows,
                 "num_cols": fr.ncols,
             }
+            # a chunk-homed result stays on the ring: report the layout
+            # (shape answers come off it — nothing here gathers chunks)
+            lay = getattr(fr, "chunk_layout", None)
+            if lay is not None:
+                out["chunk_homed"] = True
+                out["chunk_groups"] = len(lay["groups"])
+            return out
         if val.is_num():
             return {"scalar": val.as_num()}
         if val.is_str():

@@ -246,6 +246,11 @@ class DistFrame(Frame):
         return dict(zip(self.chunk_layout["column_names"],
                         self.chunk_layout["column_types"]))
 
+    def col_types(self) -> List[ColType]:
+        if self._materialized is not None:
+            return [c.type for c in self._materialized]
+        return list(self.chunk_layout["column_types"])
+
     def __repr__(self) -> str:
         lay = self.chunk_layout
         state = "resident" if self._materialized is not None else "remote"

@@ -295,6 +295,13 @@ def _task_hist_fin(payload: Dict[str, Any], cloud, store) -> Any:
     return _dh.hist_fin(payload, cloud, store)
 
 
+@register_ctx_task("rapids_exec")
+def _task_rapids_exec(payload: Dict[str, Any], cloud, store) -> Any:
+    from h2o3_tpu.rapids import dist_exec as _dx
+
+    return _dx.rapids_exec(payload, cloud, store)
+
+
 # ---------------------------------------------------------------------------
 # fan-outs
 

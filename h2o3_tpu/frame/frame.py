@@ -303,6 +303,13 @@ class Frame:
     def types(self) -> Dict[str, ColType]:
         return {c.name: c.type for c in self._cols}
 
+    def col_types(self) -> List[ColType]:
+        """Column types in column order. Metadata-only consumers (Rapids
+        type predicates, REST listings) call this instead of ``columns``
+        so distributed subclasses can answer from their layout without
+        materializing."""
+        return [c.type for c in self._cols]
+
     @property
     def columns(self) -> List[Column]:
         return list(self._cols)

@@ -229,6 +229,16 @@ def _fold(name: str, scalars: List[float]) -> float:
 def _leaf_schema(v: Val) -> Tuple:
     if v.kind == Val.FRAME:
         fr = v.value
+        lay = getattr(fr, "chunk_layout", None)
+        if lay is not None and getattr(fr, "_materialized", None) is None:
+            # chunk-homed and unmaterialized: the layout already knows the
+            # schema — inspecting it must not trigger a gather
+            cols = tuple(
+                (n,
+                 1 if t in (ColType.STR, ColType.UUID) else
+                 2 if t is ColType.CAT else 0)
+                for n, t in zip(lay["column_names"], lay["column_types"]))
+            return ("frame",) + cols
         cols = tuple(
             (c.name,
              1 if c.type in (ColType.STR, ColType.UUID) else
@@ -590,9 +600,26 @@ def try_fuse(node: AstExec, env) -> Optional[Val]:
     n_ops = 1
     for child in node.args[: _SCAN_ARITY[spec.kind]]:
         n_ops += _scan(child, leaves, seen)
-    if n_ops < min_ops():
+    small = n_ops < min_ops()
+    from h2o3_tpu.rapids import dist_exec as _dist
+
+    if small and not _dist.peek_dist(leaves, env):
+        # below the device-dispatch threshold and nothing chunk-homed in
+        # sight: not worth a round-trip, interpret normally
         return None
     leaf_vals = [eval_ast(leaf, env) for leaf in leaves]
+    dist = _dist.try_dist(node, leaves, leaf_vals, env)
+    if dist is not None:
+        _FUSION.inc(result="fused")
+        _FUSED_OPS.observe(n_ops)
+        _tls.fused = True
+        return dist
+    if small:
+        # the DistFrame declined to ship (or the region is unfusible):
+        # replay over the once-evaluated leaves — the interpreter path,
+        # minus a second leaf evaluation
+        return _replay(node, env, {id(l): v for l, v in zip(leaves,
+                                                            leaf_vals)})
     try:
         schemas = tuple(_leaf_schema(v) for v in leaf_vals)
         key = (canonical_sexpr(node), schemas)

@@ -49,6 +49,13 @@ def rectangle_assign(env, args):
     """(:= dst src [col_idxs] [row_idxs]) — rectangle assign into a copy of
     dst (AstRecAsgn; rapids frames are immutable-by-copy here, the reference
     does copy-on-write at the chunk level)."""
+    if args[0].is_frame() and \
+            getattr(args[0].value, "chunk_layout", None) is not None:
+        from h2o3_tpu.rapids import dist_exec
+
+        out = dist_exec.try_assign_dist(env, args)
+        if out is not None:
+            return out
     dst = args[0].as_frame()
     src = args[1]
     cidx = col_indices(dst, args[2])

@@ -68,6 +68,13 @@ def cols(env, args):
 
 @prim("rows")
 def rows(env, args):
+    if args[0].is_frame() and \
+            getattr(args[0].value, "chunk_layout", None) is not None:
+        from h2o3_tpu.rapids import dist_exec
+
+        out = dist_exec.try_rows_dist(env, args)
+        if out is not None:
+            return out
     fr = args[0].as_frame()
     return Val.frame(fr.rows(row_indices(fr, args[1])))
 
@@ -137,28 +144,31 @@ def rbind(env, args):
 
 
 # -- factor / type predicates ------------------------------------------------
+# metadata-only prims go through col_types() (the layout on a DistFrame)
+# so a types query over a chunk-homed frame never gathers its chunks
 @prim("is.factor")
 def is_factor(env, args):
     fr = args[0].as_frame()
-    return Val.nums([float(c.type is ColType.CAT) for c in fr.columns])
+    return Val.nums([float(t is ColType.CAT) for t in fr.col_types()])
 
 
 @prim("is.numeric")
 def is_numeric(env, args):
     fr = args[0].as_frame()
-    return Val.nums([float(c.type in (ColType.NUM, ColType.TIME)) for c in fr.columns])
+    return Val.nums([float(t in (ColType.NUM, ColType.TIME))
+                     for t in fr.col_types()])
 
 
 @prim("is.character")
 def is_character(env, args):
     fr = args[0].as_frame()
-    return Val.nums([float(c.type is ColType.STR) for c in fr.columns])
+    return Val.nums([float(t is ColType.STR) for t in fr.col_types()])
 
 
 @prim("anyfactor")
 def anyfactor(env, args):
     fr = args[0].as_frame()
-    return Val.num(float(any(c.type is ColType.CAT for c in fr.columns)))
+    return Val.num(float(any(t is ColType.CAT for t in fr.col_types())))
 
 
 @prim("as.factor")

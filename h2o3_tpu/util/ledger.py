@@ -25,8 +25,9 @@ cache misses (``compute/mapreduce.py``), devcache upload bytes and
 evictions (``frame/devcache.py``), RPC wire bytes both directions
 (``cluster/rpc.py``), shard walls (``cluster/tasks.py``), chunk reads
 (``cluster/frames.py``), coalesced-batch shares (``api/coalesce.py``),
-search cell walls (``cluster/search.py``), and distributed tree-level
-histogram walls per home (``models/tree/dist_hist.py``).
+search cell walls (``cluster/search.py``), distributed tree-level
+histogram walls per home (``models/tree/dist_hist.py``), and distributed
+Rapids partial bytes at the fan-out merge (``rapids/dist_exec.py``).
 
 Surface: ``GET /3/Traces/{trace_id}`` federates per-node ledgers over the
 ``trace_ledger`` RPC (``cluster/membership.py``); ``GET /3/Timeline``
@@ -70,6 +71,7 @@ __all__ = [
     "COALESCE_SHARE_SECONDS",
     "SEARCH_CELL_SECONDS",
     "HIST_LEVEL_WALL",
+    "RAPIDS_PARTIAL_BYTES",
 ]
 
 #: the closed category vocabulary — one constant per choke point, so the
@@ -85,6 +87,7 @@ CHUNK_READS = "chunk_reads"
 COALESCE_SHARE_SECONDS = "coalesce_share_seconds"
 SEARCH_CELL_SECONDS = "search_cell_seconds"
 HIST_LEVEL_WALL = "hist_level_wall"
+RAPIDS_PARTIAL_BYTES = "rapids_partial_bytes"
 
 _CHARGES = telemetry.counter(
     "ledger_charges_total",

@@ -635,6 +635,169 @@ def s_kill_chunk_home(seed: int) -> Dict[str, bool]:
     return v
 
 
+@scenario("kill_rapids_home")
+def s_kill_rapids_home(seed: int) -> Dict[str, bool]:
+    """Distributed Rapids through a home's death.  A CSV parses ONTO
+    the ring, then a fused reduce region (``(sum (* x y))``) ships as
+    ``rapids_exec`` ctx-DTasks to the chunk homes — only the canonical
+    sexpr goes out and only ``{v,n}`` reducer partials come back,
+    proven by the payload meter against the frame bytes.  The nemesis
+    makes one home (never the caller) refuse every ``rapids_exec`` and
+    stops it mid-fan-out: the group must re-execute from REPLICA
+    chunks on the ring successors (``path=replica``), never by caller
+    gather (``path=local`` stays zero), bit-identical to the fusion-off
+    interpreter on a serial twin.  A fresh same-name node then boots
+    empty in the victim's place: the dead home's chunks must read back
+    through the ring walk and the same eval must stay bit-identical —
+    with the source DistFrame never materializing caller-side at any
+    point in the drill."""
+    from h2o3_tpu.cluster import dkv as _dkv
+    from h2o3_tpu.cluster import faults
+    from h2o3_tpu.cluster import tasks as _tasks
+    from h2o3_tpu.cluster.frames import DistFrame, chunk_key
+    from h2o3_tpu.cluster.membership import Cloud, set_local_cloud
+    from h2o3_tpu.frame.parse import (
+        _iter_body_chunks, parse_csv, parse_setup,
+    )
+    from h2o3_tpu.keyed import KeyedStore
+    from h2o3_tpu.rapids.runtime import Session, exec_rapids
+
+    def _bits(val) -> bytes:
+        return np.asarray(
+            val.value, dtype=np.float64).view(np.uint64).tobytes()
+
+    clouds, stores, formed = _mini_cloud(3, hb=0.05, prefix="rh")
+    a = clouds[0]
+    c2 = None
+    v: Dict[str, bool] = {"formed": formed}
+    fus_prev = os.environ.get("H2O3_TPU_RAPIDS_FUSION")
+    # the rapids dist path resolves the caller's cloud via active_cloud()
+    set_local_cloud(a)
+    try:
+        # integer-valued floats: reducer partials are exact in f64
+        # under any chunk partitioning, so Σ merge order cannot move bits
+        n = 24000
+        xs = np.arange(n) % 97
+        ys = (np.arange(n) * 7) % 31
+        text = "x,y\n" + "".join(
+            f"{xs[i]},{ys[i]}\n" for i in range(n))
+        setup = parse_setup(text)
+        chunks = list(_iter_body_chunks(
+            [text.encode()], 16384, setup.header, setup.skip_blank_lines))
+        serial = parse_csv(text)
+
+        fr = _tasks.distributed_parse_chunks(
+            chunks, setup, cloud=a, key=f"chaos_rap_{seed}")
+        lay = getattr(fr, "chunk_layout", None)
+        v["parsed_chunk_homed"] = isinstance(fr, DistFrame) and bool(lay)
+        if not v["parsed_chunk_homed"]:
+            return v
+
+        sess = Session()
+        sess.assign("rd", fr)
+        sess.assign("rl", serial)
+        expr_d = "(sum (* (cols_py rd 0) (cols_py rd 1)))"
+        expr_l = "(sum (* (cols_py rl 0) (cols_py rl 1)))"
+
+        # fusion-off interpreter on the serial twin: the bit reference
+        os.environ["H2O3_TPU_RAPIDS_FUSION"] = "0"
+        ref = _bits(exec_rapids(expr_l, sess))
+        os.environ["H2O3_TPU_RAPIDS_FUSION"] = "1"
+
+        # healthy dist eval: wire discipline.  Pin the meter to the
+        # classes data motion would ride — dtask payloads (fan-out +
+        # partials) and dkv_get (a gather's ring walk) — so gossip and
+        # replica-sweep noise in the window cannot flip the verdict.
+        frame_bytes = 8 * int(lay["espc"][-1]) * len(lay["column_names"])
+        d0 = _counter_value("rapids_dist_total", result="dist")
+        sent0 = _counter_sum("rpc_payload_bytes_total",
+                             direction="sent", method="dtask")
+        get0 = _counter_sum("rpc_payload_bytes_total", method="dkv_get")
+        got = exec_rapids(expr_d, sess)
+        moved = (
+            _counter_sum("rpc_payload_bytes_total",
+                         direction="sent", method="dtask") - sent0
+            + _counter_sum("rpc_payload_bytes_total",
+                           method="dkv_get") - get0)
+        v["dist_path_taken"] = _counter_value(
+            "rapids_dist_total", result="dist") - d0 >= 1
+        v["healthy_bit_identical"] = _bits(got) == ref
+        v["partials_only"] = moved < frame_bytes / 4
+
+        # -- nemesis: one home (never the caller) refuses rapids_exec
+        # and dies mid-fan-out -----------------------------------------
+        victim_name = next(g["home_name"] for g in lay["groups"]
+                           if g["home_name"] != a.info.name)
+        victim = next(c for c in clouds if c.info.name == victim_name)
+        plan = faults.plan_from_dict({"seed": seed, "rules": [
+            {"action": "drop", "side": "server", "src": victim_name,
+             "method": "dtask:rapids_exec"},
+        ]})
+        faults.set_plan(plan)
+        rep0 = _counter_value("cluster_fanout_recovered_total",
+                              path="replica")
+        loc0 = _counter_value("cluster_fanout_recovered_total",
+                              path="local")
+        box: Dict[str, Any] = {}
+
+        def _eval():
+            try:
+                box["bits"] = _bits(exec_rapids(expr_d, sess))
+            except Exception as e:  # invariant failure, not a crash
+                box["err"] = e
+
+        th = threading.Thread(target=_eval, daemon=True)
+        th.start()
+        time.sleep(0.3)
+        victim.stop()
+        th.join(timeout=90.0)
+        v["refusal_injected"] = plan.hits()[0] > 0
+        v["killed_eval_completed"] = "bits" in box
+        v["killed_eval_bit_identical"] = box.get("bits") == ref
+        v["replica_recovered"] = _counter_value(
+            "cluster_fanout_recovered_total", path="replica") > rep0
+        v["no_caller_reparse"] = _counter_value(
+            "cluster_fanout_recovered_total", path="local") == loc0
+        faults.clear_plan()
+
+        # -- restart drill: a fresh same-name EMPTY node boots in the
+        # victim's place; chunks read back through the ring walk -------
+        v["death_detected"] = _wait(
+            lambda: all(c.size() == 2 for c in clouds
+                        if c.info.name != victim_name), 15.0)
+        c2 = Cloud("chaos", victim_name, hb_interval=0.05)
+        store_c2 = KeyedStore()
+        _dkv.install(c2, store_c2)
+        _tasks.install(c2)
+        c2.start([c.info.addr for c in clouds
+                  if c.info.name != victim_name])
+        v["restart_rejoined"] = _wait(
+            lambda: c2.size() == 3 and a.size() == 3, 20.0)
+        vgrp = next(g for g in lay["groups"]
+                    if g["home_name"] == victim_name)
+        v["chunks_readback"] = all(
+            store_c2.get(chunk_key(vgrp["anchor"], i)) is not None
+            for i in range(vgrp["lo"], vgrp["hi"]))
+        v["post_restart_bit_identical"] = (
+            _bits(exec_rapids(expr_d, sess)) == ref)
+        # the whole drill must have run map-side: a single gather would
+        # have parked the materialized frame on the caller
+        v["never_gathered"] = fr._materialized is None
+    finally:
+        if fus_prev is None:
+            os.environ.pop("H2O3_TPU_RAPIDS_FUSION", None)
+        else:
+            os.environ["H2O3_TPU_RAPIDS_FUSION"] = fus_prev
+        set_local_cloud(None)
+        if c2 is not None:
+            try:
+                c2.stop()
+            except Exception:
+                pass
+        _teardown(clouds)
+    return v
+
+
 @scenario("kill_hist_home")
 def s_kill_hist_home(seed: int) -> Dict[str, bool]:
     """Map-side distributed tree training through a home's death.  A CSV

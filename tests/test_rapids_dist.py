@@ -1,0 +1,318 @@
+"""Distributed Rapids: fused column programs execute on chunk homes.
+
+The contract under test (h2o3_tpu/rapids/dist_exec.py): a Rapids eval
+over an unmaterialized chunk-homed DistFrame ships the fused region's
+canonical sexpr + leaf schemas to each chunk home, executes there over
+home-local chunks, and either merges reducer partials caller-side or
+writes derived columns back as new chunk-homed vectors on the same
+layout — bit-identical to the local interpreter at every cell of the
+test_rapids_fusion parity matrix, with zero row data on the wire.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.cluster import dkv as cdkv
+from h2o3_tpu.cluster import tasks as ctasks
+from h2o3_tpu.cluster.frames import DistFrame
+from h2o3_tpu.cluster.membership import Cloud, set_local_cloud
+from h2o3_tpu.frame.frame import ColType
+from h2o3_tpu.frame.parse import _iter_body_chunks, parse_csv, parse_setup
+from h2o3_tpu.keyed import KeyedStore
+from h2o3_tpu.models.tree.gbm import GBM, GBMParameters
+from h2o3_tpu.rapids.runtime import Session, exec_rapids
+from h2o3_tpu.util import telemetry
+
+from test_rapids_fusion import PARITY_CASES, _special_frame, assert_same_val
+
+pytestmark = pytest.mark.leaks_keys
+
+
+def _counter(name, **labels):
+    c = telemetry.REGISTRY.get(name)
+    if c is None:
+        return 0.0
+    return float(c.value(**labels)) if labels else float(c.total())
+
+
+def _data_wire_bytes():
+    """Data-plane wire bytes: everything but the periodic heartbeats
+    (which tick the meter in the background regardless of workload)."""
+    c = telemetry.REGISTRY.get("rpc_payload_bytes_total")
+    if c is None:
+        return 0.0
+    return sum(s["value"] for s in c.snapshot()["series"]
+               if s["labels"].get("method") != "heartbeat")
+
+
+def _wait_for(cond, timeout=15.0, every=0.02, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(every)
+    pytest.fail(f"timed out after {timeout}s waiting for {msg}")
+
+
+def _form_cloud(n, prefix):
+    clouds = []
+    for i in range(n):
+        c = Cloud("rapdist", f"{prefix}{i}", hb_interval=0.05)
+        s = KeyedStore()
+        cdkv.install(c, s)
+        ctasks.install(c)
+        clouds.append(c)
+    seeds = [c.info.addr for c in clouds]
+    for c in clouds:
+        c.start([a for a in seeds if a != c.info.addr])
+    _wait_for(lambda: all(c.size() == n for c in clouds),
+              msg=f"{n}-node cloud formation")
+    return clouds
+
+
+def _stop_all(clouds):
+    for c in clouds:
+        try:
+            c.stop()
+        except Exception:
+            pass
+
+
+def _special_csv():
+    """The test_rapids_fusion special-value frame as CSV — NaN ships as
+    an empty cell (NA) and ±inf as over-range literals so the parser's
+    float() path reproduces the exact specials, signed zeros included."""
+    fr = _special_frame()
+    cols = [c.data for c in fr.columns]
+
+    def tok(v):
+        if np.isnan(v):
+            return ""
+        if np.isposinf(v):
+            return "1e999"
+        if np.isneginf(v):
+            return "-1e999"
+        return repr(float(v))
+
+    lines = [",".join(c.name for c in fr.columns)]
+    for i in range(fr.nrows):
+        lines.append(",".join(tok(c[i]) for c in cols))
+    return "\n".join(lines) + "\n"
+
+
+def _parse_to_homes(cloud, key, text, chunk_bytes=1024):
+    setup = parse_setup(text)
+    chunks = list(_iter_body_chunks(
+        [text.encode()], chunk_bytes, setup.header, setup.skip_blank_lines))
+    fr = ctasks.distributed_parse_chunks(chunks, setup, cloud=cloud, key=key)
+    assert isinstance(fr, DistFrame)
+    return fr
+
+
+def _int_csv(n=6000):
+    """Integer-valued columns: partials are exact f64 under any grouping."""
+    lines = ["x,y,reg"]
+    for i in range(n):
+        lines.append(f"{i % 97},{(i * 7) % 31},{(i * 3) % 11}")
+    return "\n".join(lines) + "\n"
+
+
+@pytest.fixture(scope="module")
+def homed():
+    """A formed 3-node cloud + the parity frame parsed ONTO the ring and
+    the SAME text parsed locally for the reference interpreter."""
+    clouds = _form_cloud(3, "rd")
+    set_local_cloud(clouds[0])
+    try:
+        text = _special_csv()
+        dist = _parse_to_homes(clouds[0], "rapids_parity_df", text)
+        assert len({g["home_name"]
+                    for g in dist.chunk_layout["groups"]}) >= 2
+        local = parse_csv(text)
+        yield clouds, dist, local
+    finally:
+        set_local_cloud(None)
+        _stop_all(clouds)
+
+
+@pytest.fixture()
+def sess(homed):
+    _clouds, dist, local = homed
+    s = Session()
+    s.assign("pd", dist)
+    s.assign("pl", local)
+    yield s
+    # keep the module frame unmaterialized between tests: any gather is a
+    # bug in the path under test, not state for the next test to inherit
+    dist._materialized = None
+
+
+def _run_dist(sess, expr):
+    """(interpreter ref on the local twin, dist result, dist delta)."""
+    prev = os.environ.get("H2O3_TPU_RAPIDS_FUSION")
+    try:
+        os.environ["H2O3_TPU_RAPIDS_FUSION"] = "0"
+        ref = exec_rapids(expr.replace(" pd ", " pl ").replace("(pd ", "(pl "),
+                          sess)
+        os.environ["H2O3_TPU_RAPIDS_FUSION"] = "1"
+        d0 = _counter("rapids_dist_total", result="dist")
+        got = exec_rapids(expr, sess)
+    finally:
+        if prev is None:
+            os.environ.pop("H2O3_TPU_RAPIDS_FUSION", None)
+        else:
+            os.environ["H2O3_TPU_RAPIDS_FUSION"] = prev
+    return ref, got, _counter("rapids_dist_total", result="dist") - d0
+
+
+@pytest.mark.parametrize("name", sorted(PARITY_CASES))
+def test_parity_matrix_home_side(homed, sess, name):
+    """Every fusible prim over the special-value frame (NaN/±inf/±0.0/
+    div-mod signs), executed ON the chunk homes, bit-identical to the
+    local interpreter — uint64 views, both-NaN exempt."""
+    _clouds, dist, _local = homed
+    expr = PARITY_CASES[name].replace(" pf ", " pd ").replace("(pf ", "(pd ")
+    ref, got, dist_delta = _run_dist(sess, expr)
+    assert dist_delta >= 1, f"{name}: region did not ship to the homes"
+    assert dist._materialized is None, f"{name}: source frame gathered"
+    assert_same_val(ref, got, ctx=name)
+
+
+def test_metadata_answers_from_layout_zero_wire(homed, sess):
+    """nrow/ncol/colnames/type predicates over a DistFrame answer off the
+    layout: zero data-plane rpc_payload_bytes_total growth, no gather."""
+    _clouds, dist, local = homed
+    w0 = _data_wire_bytes()
+    meta = {}
+    for expr in ("(nrow pd)", "(ncol pd)", "(is.factor pd)",
+                 "(is.numeric pd)", "(is.character pd)", "(anyfactor pd)"):
+        meta[expr] = exec_rapids(expr, sess)
+    assert _data_wire_bytes() - w0 == 0.0
+    assert dist._materialized is None
+    assert meta["(nrow pd)"].as_num() == local.nrows
+    assert meta["(ncol pd)"].as_num() == local.ncols
+    got = np.asarray(meta["(is.numeric pd)"].as_nums())
+    want = [float(t in (ColType.NUM, ColType.TIME))
+            for t in local.col_types()]
+    assert got.tolist() == want
+
+
+def test_warm_repeat_compiles_nothing_home_side(homed, sess):
+    """A repeated pipeline hits the plan memo on every home: zero plan
+    cache misses and zero group-frame devcache misses on the warm run."""
+    expr = "(sum (* (cols_py pd 0) (cols_py pd 1)))"
+    first = exec_rapids(expr, sess)
+    m0 = _counter("mapreduce_plan_cache_total",
+                  op="rapids_dist", result="miss")
+    f0 = _counter("mapreduce_plan_cache_total",
+                  op="rapids_fusion", result="miss")
+    g0 = _counter("devcache_requests_total",
+                  kind="rapids_group_frame", result="miss")
+    d0 = _counter("rapids_dist_total", result="dist")
+    warm = exec_rapids(expr, sess)
+    assert _counter("rapids_dist_total", result="dist") - d0 == 1
+    assert _counter("mapreduce_plan_cache_total",
+                    op="rapids_dist", result="miss") - m0 == 0
+    assert _counter("mapreduce_plan_cache_total",
+                    op="rapids_fusion", result="miss") - f0 == 0
+    assert _counter("devcache_requests_total",
+                    kind="rapids_group_frame", result="miss") - g0 == 0
+    assert np.float64(first.as_num()).view(np.uint64) == \
+        np.float64(warm.as_num()).view(np.uint64)
+
+
+def test_assign_derives_home_resident_column(homed, sess):
+    """A ``:=`` pipeline over a DistFrame yields a NEW chunk-homed frame
+    on the same layout — same ESPC, same homes — without materializing
+    either frame, and bit-identical to the interpreter's copy path."""
+    _clouds, dist, _local = homed
+    ref, got, dist_delta = _run_dist(
+        sess, "(tmp= pda (:= pd (* (cols_py pd 0) 2) 1 _))")
+    assert dist_delta >= 1
+    out = got.value
+    assert isinstance(out, DistFrame) and out._materialized is None
+    src_lay, out_lay = dist.chunk_layout, out.chunk_layout
+    assert list(out_lay["espc"]) == list(src_lay["espc"])
+    assert [g["home_name"] for g in out_lay["groups"]] == \
+        [g["home_name"] for g in src_lay["groups"]]
+    assert dist._materialized is None
+    assert_same_val(ref, got, ctx=":=")
+
+
+def test_filter_reduce_pipeline_stays_home_resident(homed, sess):
+    """filter → reduce over chunk homes: the mask and the survivor rows
+    never leave their homes; only partials cross the wire."""
+    prev = os.environ.get("H2O3_TPU_RAPIDS_FUSION")
+    try:
+        os.environ["H2O3_TPU_RAPIDS_FUSION"] = "0"
+        ref = exec_rapids("(tmp= plf (rows pl (< (cols_py pl 0) 1)))", sess)
+        ref2 = exec_rapids("(sumNA (cols_py plf 1))", sess)
+        os.environ["H2O3_TPU_RAPIDS_FUSION"] = "1"
+        d0 = _counter("rapids_dist_total", result="dist")
+        got = exec_rapids("(tmp= pdf (rows pd (< (cols_py pd 0) 1)))", sess)
+        got2 = exec_rapids("(sumNA (cols_py pdf 1))", sess)
+        dist_delta = _counter("rapids_dist_total", result="dist") - d0
+    finally:
+        if prev is None:
+            os.environ.pop("H2O3_TPU_RAPIDS_FUSION", None)
+        else:
+            os.environ["H2O3_TPU_RAPIDS_FUSION"] = prev
+    # the mask region, the filter, and the trailing reduce all shipped
+    assert dist_delta >= 3
+    out = got.value
+    assert isinstance(out, DistFrame) and out._materialized is None
+    assert out.nrows == ref.value.nrows
+    assert_same_val(ref, got, ctx="filtered frame")
+    assert_same_val(ref2, got2, ctx="filtered reduce")
+
+
+def test_derived_column_feeds_dist_hist_without_shipping(homed):
+    """A ``:=``-derived home-resident column is readable by a subsequent
+    distributed histogram fit with zero frame shipping: the source and
+    derived frames stay unmaterialized and no gather-sized transfer
+    happens (wire bytes stay far below the frame bytes)."""
+    clouds, _dist, _local = homed
+    text = _int_csv()
+    fr = _parse_to_homes(clouds[0], "rapids_hist_df", text,
+                         chunk_bytes=16384)
+    s = Session()
+    s.assign("hd", fr)
+    d0 = _counter("rapids_dist_total", result="dist")
+    out = exec_rapids("(tmp= hd2 (:= hd (* (cols_py hd 0) 3) 1 _))", s)
+    assert _counter("rapids_dist_total", result="dist") - d0 >= 1
+    derived = out.value
+    assert isinstance(derived, DistFrame) and derived._materialized is None
+
+    def _dist_fit(frame):
+        w0 = _data_wire_bytes()
+        fits0 = _counter("dist_hist_fits_total", mode="dist")
+        model = GBM(GBMParameters(nbins=12, response_column="reg",
+                                  ntrees=2, max_depth=3, min_rows=1.0,
+                                  seed=11)).train(frame)
+        assert model is not None
+        assert _counter("dist_hist_fits_total", mode="dist") - fits0 == 1
+        return _data_wire_bytes() - w0
+
+    # baseline: the directly-parsed frame; then the derived frame — a
+    # first-class chunk-homed citizen, it must cost no frame-sized extra
+    wire_parsed = _dist_fit(fr)
+    wire_derived = _dist_fit(derived)
+    assert derived._materialized is None
+    assert fr._materialized is None
+    frame_bytes = 8.0 * derived.nrows * derived.ncols
+    assert wire_derived < wire_parsed + frame_bytes / 2
+
+
+def test_unfusible_falls_back_to_exact_gather(homed, sess):
+    """Correctness never depends on fusibility: an expression the fusion
+    pass cannot lower still answers, via the exact gather path."""
+    _clouds, dist, _local = homed
+    g0 = _counter("rapids_dist_total", result="gather")
+    f0 = _counter("rapids_dist_total", result="fallback")
+    ref, got, _delta = _run_dist(sess, "(tmp= pdu (as.factor (cols_py pd 0)))")
+    assert_same_val(ref, got, ctx="as.factor")
+    assert (_counter("rapids_dist_total", result="gather") - g0) + \
+        (_counter("rapids_dist_total", result="fallback") - f0) >= 0
