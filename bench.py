@@ -1904,6 +1904,288 @@ def _chaos_bench() -> None:
         set_local_cloud(None)
 
 
+def _serve_bench_multinode(model, score_fr, smoke, *, client,
+                           read_response):
+    """The cluster-wide serving cell: three REAL node processes (REST +
+    cluster plane each), the model imported on ONE of them and homed
+    onto the DKV ring by the serving plane.  Measures
+
+    * ``one_door_rps`` — every client through the single door that holds
+      the model (the 1-node serving baseline);
+    * ``three_door_rps`` — the same closed-loop load spread across ALL
+      front doors: two of them forward over ``predict_remote`` to the
+      model's ring home, where bundles coalesce (dispatches < forwarded
+      requests, proven from the home's ``/3/Metrics``);
+    * ``replica_spill_rps`` — a second topology whose ring home is
+      spawned with ``H2O3_TPU_SERVE_BUDGET=0``: every forwarded request
+      sheds 429 at the home and must SPILL to the ring replica.
+
+    ``overload_clean`` (nothing outside 2xx/408/413/429 anywhere) and
+    ``bit_identical`` (a forwarded/spilled prediction CSV byte-equal to
+    the home-door's local one) are asserted IN-RUN — a violation raises
+    and fails the bench."""
+    import asyncio
+    import shutil
+    import socket
+    import tempfile
+    import urllib.parse
+    import urllib.request
+
+    from h2o3_tpu.cluster.dkv import HashRing
+    from h2o3_tpu.frame.persist import save_frame
+    from h2o3_tpu.models.persist import save_model
+
+    mn_duration = 0.35 if smoke else 2.0
+    one_door_clients = 6 if smoke else 24
+    three_door_clients = 6 if smoke else 24
+    overload_total = 0 if smoke else 384
+    spill_clients = 4 if smoke else 16
+
+    mkey, fkey = "sb_multi", "sb_score.hex"
+    mpath = f"/3/Predictions/models/{mkey}/frames/{fkey}"
+    tmp = tempfile.mkdtemp(prefix="serve-bench-mn-")
+    frame_path = save_frame(score_fr, os.path.join(tmp, "score.h2f"))
+    model_path = save_model(model, os.path.join(tmp, "model.bin"))
+
+    def _ctl(base, method, path, data=None, retries=40):
+        body = json.dumps(data).encode() if data is not None else None
+        hdrs = {"Content-Type": "application/json"} if body else {}
+        last = None
+        for _ in range(retries):
+            req = urllib.request.Request(
+                base + path, data=body, headers=hdrs, method=method)
+            try:
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    return json.loads(r.read())
+            except Exception as e:  # noqa: BLE001  (node still booting)
+                last = e
+                time.sleep(0.25)
+        raise RuntimeError(f"{method} {path} on {base} failed: {last}")
+
+    def _metric(base, name, **labels):
+        fam = _ctl(base, "GET", "/3/Metrics")["metrics"].get(name)
+        if not fam:
+            return 0.0
+        return sum(s["value"] for s in fam["series"]
+                   if all(s["labels"].get(k) == v
+                          for k, v in labels.items()))
+
+    def _hist(base, name):
+        fam = _ctl(base, "GET", "/3/Metrics")["metrics"].get(name)
+        if not fam:
+            return 0.0, 0.0
+        return (float(sum(s["count"] for s in fam["series"])),
+                float(sum(s["sum"] for s in fam["series"])))
+
+    def _csv(base, frame_id):
+        url = (base + "/3/DownloadDataset?frame_id="
+               + urllib.parse.quote(frame_id))
+        with urllib.request.urlopen(url, timeout=30) as r:
+            return r.read()
+
+    def _free_ports(n):
+        socks = [socket.socket() for _ in range(n)]
+        try:
+            for s in socks:
+                s.bind(("127.0.0.1", 0))
+            return [s.getsockname()[1] for s in socks]
+        finally:
+            for s in socks:
+                s.close()
+
+    def _boot(tag, home_env=None):
+        """Spawn + form a 3-node cloud; returns (procs, REST bases,
+        home door index for ``mkey``).  Ports are parent-picked so ring
+        idents — and therefore the model's home — are known up front."""
+        rpc, rest = _free_ports(3), _free_ports(3)
+        names = [f"sb{tag}{i}" for i in range(3)]
+        idents = [f"{names[i]}@127.0.0.1:{rpc[i]}" for i in range(3)]
+        home_i = idents.index(HashRing(idents).homes(mkey, 1)[0])
+        procs = []
+        for i in range(3):
+            ff = os.path.join(tmp, f"flatfile_{tag}{i}")
+            with open(ff, "w") as f:
+                f.write("".join(f"127.0.0.1:{p}\n"
+                                for j, p in enumerate(rpc) if j != i))
+            env = dict(os.environ)
+            env.pop("BENCH_SERVE_SMOKE", None)
+            env.update(JAX_PLATFORMS="cpu", PYTHONUNBUFFERED="1",
+                       H2O3_TPU_HB_INTERVAL="0.1",
+                       H2O3_TPU_SERVE_REPLICAS="1",
+                       H2O3_TPU_BATCH_WINDOW_MS="6.0")
+            if home_env and i == home_i:
+                env.update(home_env)
+            log = open(os.path.join(tmp, f"{names[i]}.log"), "wb")
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "h2o3_tpu",
+                 "--name", names[i], "--port", str(rest[i]),
+                 "--cluster-name", f"sbench{tag}",
+                 "--node-name", names[i],
+                 "--cluster-port", str(rpc[i]), "--flatfile", ff],
+                stdout=log, stderr=log, env=env, cwd=_HERE))
+        bases = [f"http://127.0.0.1:{p}" for p in rest]
+        deadline = time.time() + 90
+        sizes = []
+        while time.time() < deadline:
+            sizes = [len(_ctl(b, "GET", "/3/Cloud").get("nodes", []))
+                     for b in bases]
+            if sizes == [3, 3, 3]:
+                return procs, bases, home_i
+            time.sleep(0.2)
+        raise RuntimeError(f"multinode cloud never formed: {sizes}")
+
+    def _seed(bases, import_door):
+        for b in bases:
+            _ctl(b, "POST", "/3/Frames/load",
+                 {"dir": frame_path, "frame_id": fkey})
+        _ctl(bases[import_door], "POST", "/99/Models.bin",
+             {"dir": model_path, "model_id": mkey})
+
+    def _halt(procs):
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=15)
+            except Exception:
+                p.kill()
+
+    def _mn_req(door, i):
+        body = json.dumps(
+            {"predictions_frame": f"sb_pred_{door}_{i % 8}"}).encode()
+        return (f"POST {mpath} HTTP/1.1\r\nHost: localhost\r\n"
+                f"Content-Type: application/json\r\n"
+                f"Content-Length: {len(body)}\r\n\r\n").encode() + body
+
+    async def _mn_cell(doors, n_clients):
+        """doors: list of (host, port, door index); clients round-robin
+        across them, closed-loop for ``mn_duration``."""
+        for host, port, d in doors:
+            reader, writer = await asyncio.open_connection(host, port)
+            writer.write(_mn_req(d, 0))
+            await writer.drain()
+            st, _ = await read_response(reader)
+            writer.close()
+            if st != 200:
+                raise RuntimeError(
+                    f"multinode cold request on door {d} answered {st}")
+        lat, statuses, errors = [], {}, [0]
+        stop_t = time.perf_counter() + mn_duration + 0.25
+        await asyncio.gather(*(
+            client(doors[i % len(doors)][0], doors[i % len(doors)][1],
+                   _mn_req(doors[i % len(doors)][2], i), stop_t, lat,
+                   statuses, errors, stagger=0.25 * i / n_clients)
+            for i in range(n_clients)))
+        lat.sort()
+        n_ok = len(lat)
+        return {
+            "p50_ms": round(lat[n_ok // 2] * 1e3, 3) if n_ok else None,
+            "rps": round(n_ok / mn_duration, 1),
+            "statuses": {str(k): v for k, v in sorted(statuses.items())},
+            "conn_errors": errors[0],
+        }
+
+    def _doors(bases, idx):
+        out = []
+        for i in idx:
+            host, port = bases[i][len("http://"):].split(":")
+            out.append((host, int(port), i))
+        return out
+
+    cells = {}
+    all_statuses = []
+    try:
+        # -- topology A: normal budgets.  Import the model on a door
+        # that is NOT the ring home: the importing door scores its own
+        # copy locally (the 1-node baseline), while the OTHER doors miss
+        # in DKV (the model object is node-local and the ring home holds
+        # only the serving blob) and must forward through the ring -----
+        procs, bases, home_i = _boot("a")
+        imp = (home_i + 1) % 3
+        third = 3 - home_i - imp
+        try:
+            _seed(bases, import_door=imp)
+            cells["one_door"] = asyncio.run(
+                _mn_cell(_doors(bases, [imp]), one_door_clients))
+            fwd0 = sum(_metric(b, "serve_forward_total") for b in bases)
+            disp0, req0 = _hist(bases[home_i], "predict_batch_size")
+            cells["three_door"] = asyncio.run(
+                _mn_cell(_doors(bases, [0, 1, 2]), three_door_clients))
+            forwarded = sum(_metric(b, "serve_forward_total")
+                            for b in bases) - fwd0
+            disp1, req1 = _hist(bases[home_i], "predict_batch_size")
+            dispatches, coalesced = disp1 - disp0, req1 - req0
+            if overload_total:
+                cells["three_door_overload"] = asyncio.run(
+                    _mn_cell(_doors(bases, [0, 1, 2]), overload_total))
+            # bit-identity: a forwarded door's prediction CSV byte-equal
+            # to the model-holding door's locally scored one
+            ref_csv = _csv(bases[imp], f"sb_pred_{imp}_0")
+            fwd_csv = _csv(bases[third], f"sb_pred_{third}_0")
+        finally:
+            _halt(procs)
+
+        # -- topology B: the ring home sheds EVERYTHING; forwarded load
+        # must spill to the ring replica.  Import on a non-home door
+        # again and aim the client load at the THIRD door, which holds
+        # nothing locally — every request must forward, shed, spill ----
+        procs, bases, home_i = _boot(
+            "b", home_env={"H2O3_TPU_SERVE_BUDGET": "0"})
+        imp = (home_i + 1) % 3
+        front = 3 - home_i - imp
+        try:
+            _seed(bases, import_door=imp)
+            spill0 = sum(_metric(b, "serve_replica_spill_total")
+                         for b in bases)
+            cells["replica_spill"] = asyncio.run(
+                _mn_cell(_doors(bases, [front]), spill_clients))
+            spilled = sum(_metric(b, "serve_replica_spill_total")
+                          for b in bases) - spill0
+            spill_csv = _csv(bases[front], f"sb_pred_{front}_0")
+        finally:
+            _halt(procs)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    for c in cells.values():
+        all_statuses.extend(c["statuses"])
+    overload_clean = not [
+        s for s in all_statuses
+        if not (200 <= int(s) < 300 or int(s) in (408, 413, 429))]
+    bit_identical = bool(ref_csv) and ref_csv == fwd_csv == spill_csv
+    out = {
+        "nodes": 3,
+        "one_door_rps": cells["one_door"]["rps"],
+        "three_door_rps": cells["three_door"]["rps"],
+        "three_vs_one": round(
+            cells["three_door"]["rps"] / cells["one_door"]["rps"], 2)
+        if cells["one_door"]["rps"] else 0.0,
+        "replica_spill_rps": cells["replica_spill"]["rps"],
+        "forwarded_requests": forwarded,
+        "home_dispatches": dispatches,
+        "home_coalesced_requests": coalesced,
+        "replica_spilled": spilled,
+        "overload_clean": overload_clean,
+        "bit_identical": bit_identical,
+        "cells": cells,
+    }
+    # the in-run contract: violations FAIL the bench, they don't just
+    # dent a number in the JSON
+    if not overload_clean:
+        raise RuntimeError(f"multinode serving answered outside "
+                           f"2xx/408/413/429: {out}")
+    if not bit_identical:
+        raise RuntimeError("forwarded/spilled predictions are not "
+                           "byte-identical to home-door scoring")
+    if not (forwarded > 0 and spilled > 0):
+        raise RuntimeError(f"serving ring never exercised: {out}")
+    if not dispatches < coalesced:
+        raise RuntimeError(
+            f"forwarded requests did not coalesce at the home: "
+            f"{dispatches} dispatches for {coalesced} requests")
+    return out
+
+
 def _serve_bench():
     """Serving-plane microbench (the async front-end's price tags).
 
@@ -2101,6 +2383,10 @@ def _serve_bench():
         overload_clean = overload is not None and not [
             s for s in overload["statuses"]
             if not (200 <= int(s) < 300 or int(s) in (408, 413, 429))]
+
+        multinode = _serve_bench_multinode(
+            model, score_fr, smoke,
+            client=_client, read_response=_read_response)
         base = warm_rps.get(("threaded", ref_clients), 0.0)
         coal = warm_rps.get(("event_loop_coalesce", ref_clients), 0.0)
         speedup = round(coal / base, 2) if base else 0.0
@@ -2122,6 +2408,7 @@ def _serve_bench():
                 "matrix": cells,
                 "bit_identical": bit_identical,
                 "overload_clean": overload_clean,
+                "multinode": multinode,
                 "smoke": smoke,
             },
             "telemetry": {k: (round(v, 3) if isinstance(v, float) else v)

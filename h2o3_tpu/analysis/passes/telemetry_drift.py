@@ -124,6 +124,7 @@ def live_metrics() -> set:
     import h2o3_tpu.models.tree.dist_hist  # noqa: F401  dist_hist_* meters
     import h2o3_tpu.ops.histogram    # noqa: F401  hist_plan_cache meter
     import h2o3_tpu.api.coalesce     # noqa: F401  predict_batch_size
+    import h2o3_tpu.cluster.serving  # noqa: F401  serve_* meters
     import h2o3_tpu.rapids.fusion    # noqa: F401  rapids_fusion_* meters
     import h2o3_tpu.rapids.dist_exec  # noqa: F401  rapids_dist_* meters
     import h2o3_tpu.util.ledger      # noqa: F401  ledger_* / slowop_* meters

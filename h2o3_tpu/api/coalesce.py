@@ -32,6 +32,13 @@ _BATCH_SIZE = telemetry.histogram(
 )
 
 
+def thread_dispatch(fn: Callable[[], None]) -> None:
+    """Dispatcher for pool-less Coalescer owners (the cluster serving
+    plane coalesces forwarded bundles outside any REST worker pool):
+    each closed batch runs on its own daemon thread."""
+    threading.Thread(target=fn, daemon=True, name="coalesce-batch").start()
+
+
 class _Batch:
     __slots__ = ("key", "fn", "entries", "groups", "rows", "closed", "timer")
 

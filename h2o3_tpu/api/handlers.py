@@ -910,6 +910,19 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
         try:
             m = _get_model(requests[0][1]["model_id"])
         except BaseException as e:  # noqa: BLE001
+            if isinstance(e, RestError) and e.status == 404:
+                # not local: a multi-node cloud can still serve it — the
+                # serving ring forwards the whole batch to the model's
+                # home (or its replicas), cluster/serving.py
+                from h2o3_tpu.cluster import serving as _serving
+
+                try:
+                    fwd = _serving.forward_predict(
+                        requests, requests[0][1]["model_id"])
+                except BaseException as fe:  # noqa: BLE001
+                    return [fe] * len(requests)
+                if fwd is not None:
+                    return fwd
             return [e] * len(requests)
         # models with a bespoke predict()/score shape (PCA names PC
         # columns, aggregator has no row scoring) can't share a raw pass:
@@ -1037,6 +1050,12 @@ def register_all(r: RequestServer, server: H2OServer) -> None:
             # model sharing it is never clobbered
             m.key = params["model_id"]
         DKV.put(m.key, m)
+        # an imported model joins the serving ring exactly like a trained
+        # one: on a multi-node cloud its blob homes (+ replicates) so ANY
+        # member's /3/Predictions can reach it (cluster/serving.py)
+        from h2o3_tpu.cluster import serving as _serving
+
+        _serving.home_model(m)
         return {"models": [{"model_id": {"name": m.key}, "algo": m.algo_name}]}
 
     def frame_save(params, frame_id):

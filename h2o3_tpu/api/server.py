@@ -73,9 +73,16 @@ _HTTP_CONNS = telemetry.gauge(
 
 
 class RestError(Exception):
-    def __init__(self, status: int, msg: str) -> None:
+    """``headers`` ride the error response verbatim — the serving plane
+    uses them to propagate a remote home's ``Retry-After`` through the
+    front door unchanged (the front door's own admission meters never
+    tick for a shed that happened elsewhere)."""
+
+    def __init__(self, status: int, msg: str,
+                 headers: Tuple[Tuple[str, str], ...] = ()) -> None:
         super().__init__(msg)
         self.status = status
+        self.headers = tuple(headers)
 
 
 class RequestServer:
@@ -339,7 +346,9 @@ def _keep_alive(version: str, headers: Dict[str, str]) -> bool:
 
 
 #: what the event-loop side resolves a request future to
-#: (status, payload, content-type, trace id to echo)
+#: (status, payload, content-type, trace id to echo[, extra headers]) —
+#: the optional fifth element carries handler-supplied response headers
+#: (RestError.headers, e.g. a forwarded Retry-After)
 _Resp = Tuple[int, bytes, str, Optional[str]]
 _DRAIN_RESP: _Resp = (
     503, _body_bytes(503, "server draining"), "application/json", None)
@@ -389,6 +398,7 @@ def _run_job(job: _Job) -> None:
         trace_id=job.trace_id, parent_id=job.parent_id,
     )
     t0 = time.perf_counter()
+    hdrs: Tuple[Tuple[str, str], ...] = ()
     try:
         with span:
             # logged INSIDE the span so the /3/Logs line carries this
@@ -400,13 +410,14 @@ def _run_job(job: _Job) -> None:
     except BaseException as e:  # noqa: BLE001
         status, payload = _error_body(e)
         ctype = "application/json"
+        hdrs = tuple(getattr(e, "headers", ()) or ())
     # cost accounting BEFORE the future resolves: a client reading its
     # response can immediately GET /3/Traces/{id} and see route/wall meta
     wall_ms = (time.perf_counter() - t0) * 1e3
     _ledger.LEDGER.annotate(span.trace_id, route=job.route,
                             wall_ms=round(wall_ms, 3), status=status)
     _ledger.SLOWOPS.record(job.route, wall_ms, span.trace_id, status)
-    _resolve(job.future, (status, payload, ctype, span.trace_id))
+    _resolve(job.future, (status, payload, ctype, span.trace_id, hdrs))
 
 
 def _run_batch(route: str, batch_fn: Callable, jobs: List[_Job]) -> List[_Resp]:
@@ -450,9 +461,11 @@ def _run_batch(route: str, batch_fn: Callable, jobs: List[_Job]) -> List[_Resp]:
     it = iter(outs)
     for job, err in zip(jobs, built):
         res = err if err is not None else next(it)
+        hdrs: Tuple[Tuple[str, str], ...] = ()
         if isinstance(res, BaseException):
             status, payload = _error_body(res)
             ctype = "application/json"
+            hdrs = tuple(getattr(res, "headers", ()) or ())
         else:
             try:
                 payload, ctype = _encode_out(res)
@@ -465,7 +478,7 @@ def _run_batch(route: str, batch_fn: Callable, jobs: List[_Job]) -> List[_Resp]:
                                 wall_ms=round(wall_ms, 3), status=status,
                                 batch=len(jobs))
         _ledger.SLOWOPS.record(route, wall_ms, tid, status)
-        results.append((status, payload, ctype, tid))
+        results.append((status, payload, ctype, tid, hdrs))
     return results
 
 
@@ -988,7 +1001,8 @@ class H2OServer:
                 raise
             except BaseException as e:  # noqa: BLE001
                 status, payload = _error_body(e)
-                resp = (status, payload, "application/json", None)
+                resp = (status, payload, "application/json", None,
+                        tuple(getattr(e, "headers", ()) or ()))
             return await self._finish_request(
                 writer, method, route, t0, resp, keep)
         finally:
@@ -1000,7 +1014,11 @@ class H2OServer:
     async def _finish_request(self, writer: asyncio.StreamWriter, method: str,
                               route: str, t0: float, resp: _Resp, keep: bool,
                               extra: Tuple[Tuple[str, str], ...] = ()) -> bool:
-        status, payload, ctype, trace_id = resp
+        status, payload, ctype, trace_id, *rest = resp
+        if rest and rest[0]:
+            # handler-supplied headers (RestError.headers): e.g. the
+            # serving plane forwarding a remote home's Retry-After
+            extra = extra + tuple(rest[0])
         # account BEFORE the response flushes: a client that has read its
         # response can immediately see the request in /3/Metrics
         # (read-your-writes for the meters)

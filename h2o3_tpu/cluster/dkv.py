@@ -88,7 +88,12 @@ def ring_key(key: str) -> str:
     before the ``#c<i>`` suffix — so all chunks of a group land
     contiguously on ONE home and ride every ring mechanism (replica
     walk, read-repair, anti-entropy sweep) as a unit.  Every other key
-    hashes as itself."""
+    hashes as itself.  Serving-plane blob keys (``serve#<model_key>``,
+    cluster/serving.py) hash by the MODEL key they shadow, so a model's
+    blob homes — and replicates — exactly where the serving plane routes
+    scoring for that model."""
+    if key.startswith("serve#"):
+        key = key[len("serve#"):]
     if key.startswith("fr#"):
         i = key.rfind("#c")
         if i > 0 and key[i + 2:].isdigit():

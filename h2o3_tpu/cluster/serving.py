@@ -1,0 +1,628 @@
+"""L3d: the cluster-wide serving plane — score any model from any member.
+
+Reference: in H2O-3 a model is just a ``water.Key`` homed on the DKV
+ring, so ``POST /3/Predictions`` works identically on every node of the
+cloud; and the TF-Serving batching paper has the serving front-end route
+to a warm home and batch THERE, so N front doors still collapse into one
+devcache-warm dispatch.  This module composes both out of planes that
+already exist:
+
+* **Homing** — a trained model's :func:`~h2o3_tpu.models.persist.dumps_model`
+  blob is put under ``serve#<model_key>`` with ``replicas=`` fan-out.
+  :func:`~h2o3_tpu.cluster.dkv.ring_key` strips the ``serve#`` prefix,
+  so the blob hashes to the SAME ring home the serving plane routes
+  scoring to, and the copies ride every existing ring mechanism
+  (replicate, read-repair, anti-entropy sweep) unchanged.
+* **Forwarding** — a front door that cannot resolve a model locally
+  ships the scoring bundle over the ``predict_remote`` DTask to the ring
+  home (frames as rows for small payloads, as ``__dist__`` references
+  for chunk-homed frames).  The home feeds every forwarded entry through
+  a :class:`~h2o3_tpu.api.coalesce.Coalescer`, so bundles from N nodes
+  merge into ONE batched raw-score dispatch.
+* **Spill + recovery** — a home past its serving budget answers a typed
+  429; the front door spills to the ring replicas (which score the SAME
+  blob, bit-identically).  A dead home walks the replica → survivor →
+  caller-local ladder from ``cluster/frames.py``, so a SIGKILL mid-storm
+  degrades to 2xx/429 — never a 5xx, never a wrong answer.
+
+Forwarded work runs under the caller's trace (the RPC plane propagates
+trace context and the remote span charges the originating trace), so the
+ledger bills forwarded requests to the client that sent them.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from h2o3_tpu.cluster import dkv as _dkv
+from h2o3_tpu.cluster import rpc as _rpc
+from h2o3_tpu.cluster import tasks as _tasks
+from h2o3_tpu.util import flight as _flight
+from h2o3_tpu.util import telemetry
+
+#: outcome of every front-door serving resolution, per request:
+#: ok=the ring home served, replica=a ring successor (429 spill or home
+#: failure), survivor=any healthy member after the walk died, local=the
+#: caller scored its own blob copy as the last resort, shed=429 after
+#: the whole ladder, error=no rung could serve
+_FORWARD = telemetry.counter(
+    "serve_forward_total",
+    "front-door scoring requests resolved through the serving ring, "
+    "by outcome (ok/replica/survivor/local/shed/error)",
+    labels=("result",),
+)
+_SPILL = telemetry.counter(
+    "serve_replica_spill_total",
+    "forwarded scoring requests spilled from a shedding home to a ring "
+    "replica (the home answered 429 and the replica scored instead)",
+)
+
+#: per-forward RPC timeout — a scoring bundle, not a training job
+FORWARD_TIMEOUT = 30.0
+#: per-entry wait on the serving coalescer's dispatch
+SCORE_TIMEOUT = 60.0
+
+#: serving-plane model cache per store (decoded-from-blob models), LRU
+_MODEL_CACHE_CAP = 8
+
+_LOCK = threading.Lock()
+_COAL = None
+_COAL_LOCK = threading.Lock()
+
+
+# ---------------------------------------------------------------------------
+# knobs (read at call time so tests and spawned bench nodes can retune
+# without rebuilding servers)
+
+
+def serve_key(model_key: str) -> str:
+    """The ring key a model's serving blob lives under.  ``ring_key``
+    strips the prefix, so the blob homes exactly where the serving plane
+    routes scoring for ``model_key``."""
+    return f"serve#{model_key}"
+
+
+def replicas() -> int:
+    """Ring successors that receive a copy of every homed model blob."""
+    try:
+        n = int(os.environ.get("H2O3_TPU_SERVE_REPLICAS", "2"))
+    except ValueError:
+        n = 2
+    return max(0, min(n, _dkv.MAX_REPLICAS - 1))
+
+
+def spill_enabled() -> bool:
+    """Spill shed (429) forwards to ring replicas instead of failing?"""
+    return os.environ.get("H2O3_TPU_SERVE_SPILL", "1").lower() not in (
+        "0", "false", "no")
+
+
+def serve_budget(store=None) -> int:
+    """In-flight serving entries a node accepts before shedding 429 —
+    the serving-side analogue of the REST per-route budget, sharing its
+    knob unless ``H2O3_TPU_SERVE_BUDGET`` pins the serving plane
+    separately (how the bench saturates ONE node's serving path without
+    touching its REST admission).  A store-level override
+    (``store._serve_budget``) lets tests saturate ONE in-process node."""
+    if store is not None:
+        override = getattr(store, "_serve_budget", None)
+        if override is not None:
+            return int(override)
+    try:
+        return int(os.environ.get(
+            "H2O3_TPU_SERVE_BUDGET",
+            os.environ.get("H2O3_TPU_HTTP_ROUTE_BUDGET", "256")))
+    except ValueError:
+        return 256
+
+
+# ---------------------------------------------------------------------------
+# homing + replication
+
+
+def home_model(model, cloud=None, store=None) -> bool:
+    """Publish a trained model's blob onto the serving ring: one copy on
+    the ring home of its key plus :func:`replicas` successors.  Called
+    best-effort after every successful train on a live multi-node cloud;
+    returns False (never raises) when there is no ring to home onto —
+    single-node serving is untouched."""
+    try:
+        if cloud is None:
+            from h2o3_tpu.cluster import active_cloud
+
+            cloud = active_cloud()
+        if cloud is None:
+            return False
+        if store is None:
+            store = getattr(cloud, "dkv_store", None)
+        if store is None:
+            return False
+        router = getattr(store, "router", None)
+        if router is None or not router.active():
+            return False
+        key = getattr(model, "key", None)
+        if not key:
+            return False
+        from h2o3_tpu.models.persist import dumps_model
+
+        blob = dumps_model(model)
+        store.put(serve_key(key), blob, replicas=1 + replicas())
+        _flight.record(_flight.FANOUT, "info", "serve_home",
+                       model=key, bytes=len(blob),
+                       replicas=1 + replicas())
+        return True
+    except Exception:
+        return False
+
+
+def serving_members(model_key: str, store) -> List[Any]:
+    """``[home, successor, ...]`` members that (should) hold the model's
+    blob — the forwarding order of the ladder.  Empty when no live
+    multi-node ring exists."""
+    router = getattr(store, "router", None)
+    if router is None or not router.active():
+        return []
+    return router.home_members(serve_key(model_key), 1 + replicas())
+
+
+def _resolve_model(model_key: str, store):
+    """The model object on THIS node: the local store's own registration
+    (the builder), the serving cache, or a decode of the ring-homed blob
+    (local replica copy first, then the ring walk).  None when no copy
+    of the blob is reachable anywhere."""
+    from h2o3_tpu.models.framework import Model
+
+    m = store.peek(model_key)
+    if isinstance(m, Model):
+        return m
+    cache = getattr(store, "_serve_models", None)
+    if cache is not None:
+        with _LOCK:
+            m = cache.get(model_key)
+        if m is not None:
+            return m
+    sk = serve_key(model_key)
+    blob = store.peek(sk)
+    if not isinstance(blob, (bytes, bytearray)):
+        try:
+            blob = store.get(sk)  # ring walk: home, then replica copies
+        except _rpc.RPCError:
+            blob = None
+    if not isinstance(blob, (bytes, bytearray)):
+        return None
+    from h2o3_tpu.models.persist import loads_model
+
+    m = loads_model(bytes(blob), register=False)
+    m.key = model_key
+    with _LOCK:
+        cache = getattr(store, "_serve_models", None)
+        if cache is None:
+            cache = {}
+            store._serve_models = cache
+        cache[model_key] = m
+        while len(cache) > _MODEL_CACHE_CAP:
+            cache.pop(next(iter(cache)))
+    return m
+
+
+# ---------------------------------------------------------------------------
+# serving side (the ring home or a replica): admission -> coalesce -> score
+
+
+def _admit(store, n: int) -> None:
+    budget = serve_budget(store)
+    with _LOCK:
+        cur = getattr(store, "_serve_inflight", 0)
+        if cur + n > budget:
+            raise _rpc.RpcFault(
+                f"serving budget ({budget}) exhausted "
+                f"({cur} entries in flight)",
+                code=429, detail={"retry_after": "1"})
+        store._serve_inflight = cur + n
+
+
+def _release(store, n: int) -> None:
+    with _LOCK:
+        store._serve_inflight = max(
+            0, getattr(store, "_serve_inflight", 0) - n)
+
+
+def _coalescer():
+    """The process-wide serving coalescer (batches key per store+model,
+    so in-process test nodes never share a batch).  None when the batch
+    window is configured off — bundles then score in one direct call."""
+    global _COAL
+    if _COAL is None:
+        with _COAL_LOCK:
+            if _COAL is None:
+                try:
+                    window_ms = float(
+                        os.environ.get("H2O3_TPU_BATCH_WINDOW_MS", "2.0"))
+                    max_rows = int(
+                        os.environ.get("H2O3_TPU_BATCH_MAX_ROWS", "262144"))
+                    max_reqs = int(
+                        os.environ.get("H2O3_TPU_BATCH_MAX_REQUESTS", "256"))
+                except ValueError:
+                    window_ms, max_rows, max_reqs = 2.0, 262144, 256
+                if window_ms <= 0:
+                    return None
+                from h2o3_tpu.api.coalesce import Coalescer, thread_dispatch
+
+                _COAL = Coalescer(
+                    dispatch=thread_dispatch,
+                    window_s=window_ms / 1000.0,
+                    max_rows=max_rows,
+                    max_requests=max_reqs,
+                )
+    return _COAL
+
+
+def _metrics_payload(mm) -> Optional[Dict[str, Any]]:
+    from h2o3_tpu.api.handlers import _metrics_schema
+
+    return _metrics_schema(mm)
+
+
+def _err(code: int, e: BaseException) -> Dict[str, Any]:
+    return {"error": {"code": int(code), "msg": f"{type(e).__name__}: {e}"}}
+
+
+def _score_batch(payloads: List[Tuple[Any, Any]]) -> List[Dict[str, Any]]:
+    """One coalesced dispatch: every payload is ``(model, frame)`` for
+    the SAME model — the whole batch costs one raw-score pass, exactly
+    the REST coalescer's contract (api/handlers.py predict_batch), so
+    forwarded scoring stays bit-identical to local scoring."""
+    from h2o3_tpu.cluster.search import frame_payload
+    from h2o3_tpu.models.framework import Model
+
+    m = payloads[0][0]
+    frames = [fr for _, fr in payloads]
+    out: List[Dict[str, Any]] = []
+    if type(m).predict is not Model.predict:
+        # bespoke predict shapes (PCA, aggregator) can't share a raw pass
+        for fr in frames:
+            try:
+                pred = m.predict(fr)
+                try:
+                    metrics = _metrics_payload(m.model_performance(fr))
+                except Exception:
+                    metrics = None
+                out.append({"prediction": frame_payload(pred),
+                            "metrics": metrics})
+            except BaseException as e:  # noqa: BLE001
+                out.append(_err(400, e))
+        return out
+    try:
+        scored: List[Any] = m.predict_raw_batched(frames)
+    except BaseException:  # noqa: BLE001
+        # one bad frame must not poison the bundle: retry serially
+        scored = []
+        for fr in frames:
+            try:
+                pre = m._apply_preprocessors(fr)
+                scored.append((m._predict_raw(pre), pre))
+            except BaseException as e:  # noqa: BLE001
+                scored.append(e)
+    own_perf = type(m).model_performance is Model.model_performance
+    for fr, s in zip(frames, scored):
+        if isinstance(s, BaseException):
+            out.append(_err(400, s))
+            continue
+        try:
+            raw, pre = s
+            pred = m.prediction_from_raw(raw)
+            try:
+                mm = (m._metrics_from_raw(pre, raw) if own_perf
+                      else m.model_performance(fr))
+                metrics = _metrics_payload(mm)
+            except Exception:
+                metrics = None  # frames without a response still score
+            out.append({"prediction": frame_payload(pred),
+                        "metrics": metrics})
+        except BaseException as e:  # noqa: BLE001
+            out.append(_err(500, e))
+    return out
+
+
+def serve_entries(model_key: str, entries: List[Dict[str, Any]],
+                  store) -> List[Dict[str, Any]]:
+    """Score a forwarded bundle on THIS node (the ring home or a replica
+    holding the blob).  Every entry rides the serving coalescer keyed by
+    (store, model), so concurrent bundles from N front doors close into
+    one batched dispatch.  Raises :class:`~h2o3_tpu.cluster.rpc.RpcFault`
+    with code 429 (plus a retry_after detail) past the serving budget,
+    404 when no blob copy is reachable; per-entry failures come back as
+    ``{"error": {...}}`` so one bad frame never poisons the bundle."""
+    from h2o3_tpu.cluster.search import frame_restore
+
+    if store is None:
+        raise _rpc.RpcFault("no DKV store on this member", code=503)
+    n = len(entries)
+    _admit(store, n)
+    try:
+        m = _resolve_model(model_key, store)
+        if m is None:
+            raise _rpc.RpcFault(
+                f"model {model_key!r} has no reachable blob on the "
+                f"serving ring", code=404)
+        span = telemetry.current_span()
+        tid = span.trace_id if span is not None else None
+        outs: List[Optional[Dict[str, Any]]] = [None] * n
+        coal = _coalescer()
+        direct: List[Tuple[int, Any]] = []
+        waits: List[Tuple[int, Any]] = []
+        for i, e in enumerate(entries):
+            try:
+                fr = frame_restore(e["frame"], store)
+            except _rpc.RpcFault as fe:
+                outs[i] = {"error": {"code": fe.code, "msg": str(fe)}}
+                continue
+            except BaseException as fe:  # noqa: BLE001
+                outs[i] = _err(400, fe)
+                continue
+            if coal is None:
+                direct.append((i, fr))
+            else:
+                waits.append((i, coal.submit(
+                    _score_batch, ("serve", id(store), model_key),
+                    (m, fr),
+                    rows_hint=int(e.get("rows") or
+                                  getattr(fr, "nrows", 0) or 0),
+                    trace_id=tid,
+                )))
+        if direct:
+            for (i, _), r in zip(direct,
+                                 _score_batch([(m, fr)
+                                               for _, fr in direct])):
+                outs[i] = r
+        for i, fut in waits:
+            try:
+                outs[i] = fut.result(timeout=SCORE_TIMEOUT)
+            except BaseException as fe:  # noqa: BLE001
+                outs[i] = _err(500, fe)
+        return [o if o is not None else _err(500, RuntimeError("unscored"))
+                for o in outs]
+    finally:
+        _release(store, n)
+
+
+# ---------------------------------------------------------------------------
+# front door: resolve the home, forward, spill, walk the recovery ladder
+
+
+def _shed_code(e: BaseException) -> Optional[int]:
+    code = getattr(e, "code", None)
+    return code if isinstance(code, int) else None
+
+
+def _retry_after(e: BaseException) -> str:
+    detail = getattr(e, "detail", None) or {}
+    return str(detail.get("retry_after", "1"))
+
+
+def _forward_ladder(cloud, store, members, model_key: str,
+                    wire: List[Dict[str, Any]]):
+    """Run one wire bundle down the serving ladder: home, then (on 429
+    spill or home failure) the ring replicas, then — for failures only —
+    any healthy survivor, then the caller itself.  Returns the aligned
+    per-entry results; raises RestError(429) when every reachable rung
+    shed (propagating the home's Retry-After) and RestError(503) when no
+    rung could serve."""
+    from h2o3_tpu.api.server import RestError
+
+    me = cloud.info.name
+    payload = {"model_key": model_key, "entries": wire}
+    n = len(wire)
+    shed: Optional[BaseException] = None
+    first_err: Optional[BaseException] = None
+    tried = set()
+
+    def _try(member):
+        tried.add(member.info.name)
+        if member.info.name == me:
+            return serve_entries(model_key, wire, store)
+        return _tasks.submit(cloud, member, "predict_remote", payload,
+                             timeout=FORWARD_TIMEOUT)
+
+    # rung 0: the ring home — where forwarded bundles coalesce
+    try:
+        res = _try(members[0])
+        _FORWARD.inc(n, result="ok")
+        return res
+    except (_rpc.RpcFault, _rpc.RemoteError) as e:
+        if _shed_code(e) == 429:
+            shed = e
+        else:
+            first_err = e
+    except _rpc.RPCError as e:
+        first_err = e
+
+    # rung 1: ring replicas — spill targets on shed, failover otherwise;
+    # replica scoring decodes the SAME blob, so answers stay bit-identical
+    if shed is None or spill_enabled():
+        for m in members[1:]:
+            try:
+                res = _try(m)
+            except (_rpc.RpcFault, _rpc.RemoteError) as e:
+                if _shed_code(e) == 429:
+                    shed = shed or e
+                else:
+                    first_err = first_err or e
+                continue
+            except _rpc.RPCError as e:
+                first_err = first_err or e
+                continue
+            if shed is not None:
+                _SPILL.inc(n)
+                _flight.record(_flight.RECOVERY, "info", "serve_spill",
+                               model=model_key, to=m.info.name)
+            else:
+                _tasks._RECOVERED.inc(path="replica")
+                _flight.record(_flight.RECOVERY, "warn", "serve_forward",
+                               model=model_key, path="replica",
+                               to=m.info.name)
+            _FORWARD.inc(n, result="replica")
+            return res
+    if shed is None:
+        # rung 2: any healthy survivor — it resolves the blob over the
+        # ring walk itself (read-repair re-homes it as a side effect)
+        for m in _tasks._healthy_workers(cloud):
+            if m.info.name in tried or m.info.name == me:
+                continue
+            try:
+                res = _try(m)
+            except (_rpc.RpcFault, _rpc.RemoteError) as e:
+                if _shed_code(e) == 429:
+                    shed = e
+                    break
+                first_err = first_err or e
+                continue
+            except _rpc.RPCError as e:
+                first_err = first_err or e
+                continue
+            _tasks._RECOVERED.inc(path="survivor")
+            _flight.record(_flight.RECOVERY, "warn", "serve_forward",
+                           model=model_key, path="survivor",
+                           to=m.info.name)
+            _FORWARD.inc(n, result="survivor")
+            return res
+    if shed is None and me not in tried:
+        # rung 3: the caller itself — the last resort, same blob walk
+        try:
+            res = serve_entries(model_key, wire, store)
+            _tasks._RECOVERED.inc(path="local")
+            _flight.record(_flight.RECOVERY, "warn", "serve_forward",
+                           model=model_key, path="local")
+            _FORWARD.inc(n, result="local")
+            return res
+        except (_rpc.RpcFault, _rpc.RPCError) as e:
+            if _shed_code(e) == 429:
+                shed = e
+            else:
+                first_err = first_err or e
+    if shed is not None:
+        _FORWARD.inc(n, result="shed")
+        # the home's Retry-After crosses the front door UNCHANGED, and
+        # the front door's own route budget never double-counts the shed
+        # (http_shed_total ticks at REST admission only)
+        raise RestError(
+            429, f"serving capacity for model {model_key!r} exhausted: "
+                 f"{getattr(shed, 'msg', None) or shed}",
+            headers=(("Retry-After", _retry_after(shed)),))
+    _FORWARD.inc(n, result="error")
+    raise RestError(
+        503, f"model {model_key!r} unreachable on the serving ring"
+             + (f": {first_err}" if first_err is not None else ""))
+
+
+def _front_frame(frame_id: str, store):
+    """The front door's view of a frame to forward: its own registration
+    (plain or chunk-homed), else the ring's layout/setup for a
+    chunk-homed frame parsed elsewhere."""
+    from h2o3_tpu.api.server import RestError
+    from h2o3_tpu.frame.frame import Frame
+
+    fr = store.get(frame_id)
+    if isinstance(fr, Frame):
+        return fr
+    from h2o3_tpu.cluster import frames as _frames
+
+    try:
+        layout = store.get(_frames.layout_key(frame_id))
+        if isinstance(layout, dict):
+            setup = store.get(_frames.setup_key(frame_id))
+            if setup is not None:
+                return _frames.DistFrame(
+                    layout, _frames.setup_from_payload(setup), store)
+    except Exception:
+        pass
+    raise RestError(404, f"frame {frame_id!r} not found")
+
+
+def forward_predict(requests, model_id: str, cloud=None, store=None):
+    """Resolve a scoring batch the local node cannot serve through the
+    serving ring.  ``requests`` is the REST batch shape — a list of
+    ``(params, {"model_id", "frame_id"})`` — and the return value aligns
+    with it: one REST response dict or exception per entry (what
+    ``predict_batch`` returns), or None when no multi-node ring exists
+    and the caller should fall back to its local 404."""
+    from h2o3_tpu.api.server import RestError
+    from h2o3_tpu.cluster.search import frame_payload
+
+    if cloud is None:
+        from h2o3_tpu.cluster import active_cloud
+
+        cloud = active_cloud()
+    if cloud is None:
+        return None
+    if store is None:
+        store = getattr(cloud, "dkv_store", None)
+    if store is None:
+        return None
+    members = serving_members(model_id, store)
+    if not members:
+        return None
+    results: List[Any] = [None] * len(requests)
+    wire: List[Dict[str, Any]] = []
+    live: List[int] = []
+    for i, (_params, kw) in enumerate(requests):
+        try:
+            fr = _front_frame(kw["frame_id"], store)
+            wire.append({"frame": frame_payload(fr),
+                         "rows": int(getattr(fr, "nrows", 0) or 0)})
+            live.append(i)
+        except BaseException as e:  # noqa: BLE001
+            results[i] = e
+    if live:
+        try:
+            outs = _forward_ladder(cloud, store, members, model_id, wire)
+            if len(outs) != len(live):
+                raise RestError(
+                    502, f"serving ring returned {len(outs)} results "
+                         f"for {len(live)} entries")
+        except BaseException as e:  # noqa: BLE001
+            for i in live:
+                results[i] = e
+            return results
+        for i, out in zip(live, outs):
+            params, kw = requests[i]
+            err = (out or {}).get("error") if isinstance(out, dict) else None
+            if err is not None or not isinstance(out, dict):
+                results[i] = RestError(
+                    int((err or {}).get("code", 502)),
+                    str((err or {}).get("msg", "remote scoring failed")))
+                continue
+            try:
+                results[i] = _assemble(
+                    params, model_id, kw["frame_id"], out, store)
+            except BaseException as e:  # noqa: BLE001
+                results[i] = e
+    return results
+
+
+def _assemble(params, model_id: str, frame_id: str,
+              out: Dict[str, Any], store) -> Dict[str, Any]:
+    """One forwarded entry's REST response: register the predictions
+    frame LOCALLY (the client talks to this front door) and mirror the
+    local handler's /3/Predictions shape.  The DKV scoring record stays
+    on the serving node's side — the model object lives there."""
+    from h2o3_tpu.cluster.search import frame_restore
+
+    dest = params.get("predictions_frame") or store.make_key("pred")
+    pred = frame_restore(out["prediction"], store)
+    pred.key = dest
+    store.put(dest, pred)
+    resp: Dict[str, Any] = {
+        "model_metrics": [
+            {
+                "frame": {"name": frame_id},
+                "model": {"name": model_id},
+                "predictions_frame": {"name": dest},
+            }
+        ]
+    }
+    if out.get("metrics"):
+        resp["model_metrics"][0].update(out["metrics"])
+    return resp

@@ -302,6 +302,18 @@ def _task_rapids_exec(payload: Dict[str, Any], cloud, store) -> Any:
     return _dx.rapids_exec(payload, cloud, store)
 
 
+@register_ctx_task("predict_remote")
+def _task_predict_remote(payload: Dict[str, Any], cloud, store) -> Any:
+    """Serving plane: score a forwarded bundle on this node — the
+    model's ring home (where bundles from N front doors coalesce into
+    one dispatch) or a replica taking spilled/failed-over load.  See
+    cluster/serving.py."""
+    from h2o3_tpu.cluster import serving as _serving
+
+    return _serving.serve_entries(
+        payload["model_key"], payload["entries"], store)
+
+
 # ---------------------------------------------------------------------------
 # fan-outs
 

@@ -415,6 +415,16 @@ class ModelBuilder:
             _FITS.inc(algo=self.algo_name, outcome="ok")
             self.job.done()
             keep = None  # success: everything the build registered lives
+            # on a live multi-node cloud the finished model is homed onto
+            # the serving ring (blob + replicas) so ANY member can score
+            # it; best-effort — a failed homing leaves builder-local
+            # serving intact (cluster/serving.py)
+            from h2o3_tpu.cluster import active_cloud as _active_cloud
+
+            if _active_cloud() is not None:
+                from h2o3_tpu.cluster import serving as _serving
+
+                _serving.home_model(model)
             log.info(
                 "%s train done in %.2fs -> %s", self.algo_name,
                 model.run_time, model.key,

@@ -184,9 +184,16 @@ def _write_archive(dest, model) -> None:
     buf = io.BytesIO()
     np.savez_compressed(buf, **enc.arrays)
     with zipfile.ZipFile(dest, "w", zipfile.ZIP_DEFLATED) as z:
-        z.writestr("meta.json", json.dumps(meta))
-        z.writestr("model.json", json.dumps(tree))
-        z.writestr("arrays.npz", buf.getvalue())
+        # fixed entry timestamps: dumps_model of the same model is
+        # byte-identical across calls and nodes, so the serving plane can
+        # compare home/replica blob copies by digest
+        for name, data in (("meta.json", json.dumps(meta)),
+                           ("model.json", json.dumps(tree)),
+                           ("arrays.npz", buf.getvalue())):
+            info = zipfile.ZipInfo(name, date_time=(1980, 1, 1, 0, 0, 0))
+            info.compress_type = zipfile.ZIP_DEFLATED
+            info.external_attr = 0o600 << 16
+            z.writestr(info, data)
 
 
 def _read_archive(src):

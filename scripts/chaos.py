@@ -635,6 +635,125 @@ def s_kill_chunk_home(seed: int) -> Dict[str, bool]:
     return v
 
 
+@scenario("kill_serving_replica")
+def s_kill_serving_replica(seed: int) -> Dict[str, bool]:
+    """Serving plane through a serving member's death mid-storm.  A GLM
+    trains on one node and its blob homes onto the ring (home + one
+    successor); a front door that holds neither model nor blob storms
+    ``forward_predict``.  Phase one saturates the home's serving budget:
+    every request must SPILL to the replica (429 at the home, 2xx from
+    the replica, bit-identical to the builder's own predict).  Phase two
+    makes the home refuse its ``predict_remote`` task and stops it
+    mid-storm: the remaining requests must degrade down the ladder to
+    the surviving replica with nothing but 2xx/429 — never a 5xx, never
+    a wrong answer.  (As with ``kill_chunk_home``, in-process ``stop()``
+    drains in-flight dispatches gracefully and pooled connections can
+    outlive the listener, so the refusal rule is what makes the death
+    observable at task granularity.)"""
+    from h2o3_tpu.api.server import RestError
+    from h2o3_tpu.cluster import faults
+    from h2o3_tpu.cluster import serving
+    from h2o3_tpu.frame.frame import Frame
+    from h2o3_tpu.models.glm import GLM
+
+    saved_reps = os.environ.get("H2O3_TPU_SERVE_REPLICAS")
+    os.environ["H2O3_TPU_SERVE_REPLICAS"] = "1"
+    clouds, stores, formed = _mini_cloud(3, hb=0.05, prefix="sv")
+    v: Dict[str, bool] = {"formed": formed}
+    try:
+        rng = np.random.default_rng(seed)
+        X = rng.normal(size=(500, 4))
+        logit = X @ np.array([1.1, -0.7, 0.4, 0.0]) - 0.1
+        y = rng.random(500) < 1.0 / (1.0 + np.exp(-logit))
+        fr = Frame.from_dict(
+            {f"x{i}": X[:, i] for i in range(4)}
+            | {"y": np.where(y, "yes", "no").astype(object)})
+        m = GLM(family="binomial", response_column="y",
+                lambda_=0.0, seed=seed).train(fr)
+        v["homed"] = serving.home_model(
+            m, cloud=clouds[0], store=stores[0])
+        members = serving.serving_members(m.key, stores[0])
+        names = [mm.info.name for mm in members]
+        v["replicated"] = len(names) == 2 and _wait(
+            lambda: all(isinstance(
+                s.peek(serving.serve_key(m.key)), (bytes, bytearray))
+                for c, s in zip(clouds, stores) if c.info.name in names),
+            10.0)
+        if not (v["homed"] and v["replicated"]):
+            return v
+        by_name = {c.info.name: (c, s)
+                   for c, s in zip(clouds, stores)}
+        front_c, front_s = next(
+            (c, s) for c, s in zip(clouds, stores)
+            if c.info.name not in names)
+        Xs = rng.normal(size=(60, 4))
+        sf = Frame.from_dict({f"x{i}": Xs[:, i] for i in range(4)})
+        front_s.put("chaos_serve_df", sf)
+        ref = [np.asarray(c.data, dtype=np.float64)
+               for c in m.predict(sf).columns]
+
+        def _shot() -> str:
+            """One forwarded request: '2xx' only if the answer is
+            bit-identical to the builder's predict, '429' on a clean
+            shed, '5xx' on anything else."""
+            try:
+                outs = serving.forward_predict(
+                    [({}, {"model_id": m.key,
+                           "frame_id": "chaos_serve_df"})],
+                    m.key, cloud=front_c, store=front_s)
+            except Exception as e:
+                return "429" if getattr(e, "status", 0) == 429 else "5xx"
+            if outs is None:
+                return "5xx"
+            out = outs[0]
+            if isinstance(out, BaseException):
+                return ("429" if isinstance(out, RestError)
+                        and out.status == 429 else "5xx")
+            dest = out["model_metrics"][0]["predictions_frame"]["name"]
+            pred = front_s.get(dest)
+            got = [np.asarray(c.data, dtype=np.float64)
+                   for c in pred.columns]
+            same = len(got) == len(ref) and all(
+                np.array_equal(g, r) for g, r in zip(got, ref))
+            return "2xx" if same else "5xx"
+
+        # -- phase one: saturated home spills to the replica -----------
+        home_c, home_s = by_name[names[0]]
+        spill0 = _counter_value("serve_replica_spill_total")
+        home_s._serve_budget = 0
+        spill_outcomes = [_shot() for _ in range(3)]
+        home_s._serve_budget = None
+        v["spill_served"] = spill_outcomes == ["2xx"] * 3
+        v["spill_observable"] = (
+            _counter_value("serve_replica_spill_total") >= spill0 + 3)
+
+        # -- phase two: the home refuses predict_remote and dies -------
+        rep0 = _counter_value("cluster_fanout_recovered_total",
+                              path="replica")
+        plan = faults.plan_from_dict({"seed": seed, "rules": [
+            {"action": "drop", "side": "server", "src": names[0],
+             "method": "dtask:predict_remote"},
+        ]})
+        faults.set_plan(plan)
+        outcomes = [_shot() for _ in range(2)]
+        home_c.stop()
+        outcomes += [_shot() for _ in range(5)]
+        v["refusal_injected"] = plan.hits()[0] > 0
+        v["overload_clean"] = all(o in ("2xx", "429") for o in outcomes)
+        v["no_5xx"] = "5xx" not in outcomes
+        v["killed_storm_served"] = outcomes.count("2xx") >= 5
+        v["replica_recovered"] = _counter_value(
+            "cluster_fanout_recovered_total", path="replica") > rep0
+    finally:
+        faults.clear_plan()
+        if saved_reps is None:
+            os.environ.pop("H2O3_TPU_SERVE_REPLICAS", None)
+        else:
+            os.environ["H2O3_TPU_SERVE_REPLICAS"] = saved_reps
+        _teardown(clouds)
+    return v
+
+
 @scenario("kill_rapids_home")
 def s_kill_rapids_home(seed: int) -> Dict[str, bool]:
     """Distributed Rapids through a home's death.  A CSV parses ONTO

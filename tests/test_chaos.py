@@ -39,7 +39,7 @@ def test_scenarios_registered():
     names = set(chaos.SCENARIOS)
     assert {"dup_reorder", "slow_node", "partition_gossip",
             "wedged_member", "kill_chunk_home", "kill_hist_home",
-            "kill_rapids_home",
+            "kill_rapids_home", "kill_serving_replica",
             "kill_search_member", "kill_fanout", "kill_grid"} <= names
     # the ISSUE floor: at least four scripted scenarios
     assert len(names) >= 4
@@ -71,6 +71,10 @@ def test_kill_hist_home_deterministic():
 
 def test_kill_rapids_home_deterministic():
     _run_twice("kill_rapids_home")
+
+
+def test_kill_serving_replica_deterministic():
+    _run_twice("kill_serving_replica")
 
 
 def test_kill_search_member_deterministic():

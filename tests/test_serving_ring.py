@@ -1,0 +1,449 @@
+"""Cluster-wide serving plane (ISSUE 19): consistent-hash model homing,
+replicated bit-identical scoring, forwarded-bundle coalescing at the
+model's ring home, 429 spill to replicas, and the replica→survivor
+recovery ladder.
+
+Real multi-Cloud topologies over real sockets (the test_cluster_search
+fixture idiom) — no mocked transport.  The acceptance contracts pinned
+here:
+
+* a model trained on node A scores from B and C **bit-identically**
+  (same blob, deterministic ``dumps_model`` container);
+* forwarded requests from N front doors **coalesce at the home** —
+  dispatch count strictly below request count;
+* a shedding home's 429 crosses the front door with its ``Retry-After``
+  intact and never double-counts against the front door's route budget.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.cluster import dkv as cdkv
+from h2o3_tpu.cluster import serving
+from h2o3_tpu.cluster import tasks as ctasks
+from h2o3_tpu.cluster.membership import Cloud, set_local_cloud
+from h2o3_tpu.cluster.search import frame_payload
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.keyed import DKV, KeyedStore
+from h2o3_tpu.util import telemetry
+
+pytestmark = pytest.mark.leaks_keys
+
+N_NODES = 3
+
+
+def _counter(name, **labels):
+    c = telemetry.REGISTRY.get(name)
+    if c is None:
+        return 0.0
+    return float(c.value(**labels)) if labels else float(c.total())
+
+
+def _wait_for(cond, timeout=15.0, every=0.02, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(every)
+    pytest.fail(f"timed out after {timeout}s waiting for {msg}")
+
+
+@pytest.fixture(scope="module")
+def ring():
+    """A formed 3-node cloud with one ring replica per homed blob, the
+    first node installed as the process-local cloud (so ``train`` homes
+    models automatically, exactly like a booted member)."""
+    saved = os.environ.get("H2O3_TPU_SERVE_REPLICAS")
+    os.environ["H2O3_TPU_SERVE_REPLICAS"] = "1"
+    clouds, stores = [], []
+    for i in range(N_NODES):
+        c = Cloud("servering", f"sr{i}", hb_interval=0.05)
+        s = KeyedStore()
+        cdkv.install(c, s)
+        ctasks.install(c)
+        clouds.append(c)
+        stores.append(s)
+    seeds = [c.info.addr for c in clouds]
+    for c in clouds:
+        c.start([a for a in seeds if a != c.info.addr])
+    _wait_for(lambda: all(c.size() == N_NODES for c in clouds),
+              msg="3-node cloud formation")
+    set_local_cloud(clouds[0])
+    try:
+        yield clouds, stores
+    finally:
+        set_local_cloud(None)
+        if saved is None:
+            os.environ.pop("H2O3_TPU_SERVE_REPLICAS", None)
+        else:
+            os.environ["H2O3_TPU_SERVE_REPLICAS"] = saved
+        for c in clouds:
+            try:
+                c.stop()
+            except Exception:
+                pass
+
+
+def _train_glm(seed=3, n=400):
+    from h2o3_tpu.models.glm import GLM
+
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 4))
+    logit = X @ np.array([1.2, -0.8, 0.5, 0.0]) - 0.2
+    y = rng.random(n) < 1.0 / (1.0 + np.exp(-logit))
+    fr = Frame.from_dict(
+        {f"x{i}": X[:, i] for i in range(4)}
+        | {"y": np.where(y, "yes", "no").astype(object)}
+    )
+    return GLM(family="binomial", response_column="y",
+               lambda_=0.0, seed=seed).train(fr), fr
+
+
+def _train_gbm(seed=5, n=300):
+    from h2o3_tpu.models.tree.gbm import GBM
+
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 3))
+    y = (X[:, 0] + 0.5 * X[:, 1] ** 2 + rng.normal(size=n) * 0.1 > 0.4)
+    fr = Frame.from_dict(
+        {f"x{i}": X[:, i] for i in range(3)}
+        | {"y": np.where(y, "pos", "neg").astype(object)}
+    )
+    return GBM(response_column="y", ntrees=5, max_depth=3,
+               seed=seed).train(fr), fr
+
+
+def _score_frame(seed, n, ncols, names=None):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, ncols))
+    names = names or [f"x{i}" for i in range(ncols)]
+    return Frame.from_dict({nm: X[:, i] for i, nm in enumerate(names)})
+
+
+def _assert_frames_equal(got, want):
+    assert [c.name for c in got.columns] == [c.name for c in want.columns]
+    for cg, cw in zip(got.columns, want.columns):
+        a, b = np.asarray(cg.data), np.asarray(cw.data)
+        if a.dtype.kind in "fc" or b.dtype.kind in "fc":
+            np.testing.assert_array_equal(a.astype(np.float64),
+                                          b.astype(np.float64))
+        else:
+            assert list(a) == list(b), cg.name
+
+
+def _wire(fr):
+    return [{"frame": frame_payload(fr),
+             "rows": int(getattr(fr, "nrows", 0) or 0)}]
+
+
+def _forwarded_pred(store, out):
+    dest = out["model_metrics"][0]["predictions_frame"]["name"]
+    fr = store.get(dest)
+    assert isinstance(fr, Frame)
+    return fr
+
+
+class TestBlobRing:
+    def test_dumps_model_deterministic_and_replicated(self, ring):
+        """The homing hook lands one byte-identical blob copy on the ring
+        home AND each successor; ``dumps_model`` itself is deterministic
+        (fixed zip timestamps) so copies compare equal by digest."""
+        from h2o3_tpu.models.persist import dumps_model, loads_model
+
+        clouds, stores = ring
+        m, fr = _train_glm(seed=11)
+        assert dumps_model(m) == dumps_model(m)
+
+        members = serving.serving_members(m.key, stores[0])
+        names = [mm.info.name for mm in members]
+        assert len(names) == 2  # home + 1 replica
+        sk = serving.serve_key(m.key)
+        holders = {c.info.name: s for c, s in zip(clouds, stores)
+                   if c.info.name in names}
+        _wait_for(lambda: all(
+            isinstance(s.peek(sk), (bytes, bytearray))
+            for s in holders.values()), msg="blob replication")
+        blobs = [bytes(s.peek(sk)) for s in holders.values()]
+        assert blobs[0] == blobs[1] == dumps_model(m)
+
+        # round-trip through the REPLICA's copy scores bit-identically
+        back = loads_model(blobs[1], register=False)
+        sf = _score_frame(1, 64, 4)
+        _assert_frames_equal(back.predict(sf), m.predict(sf))
+
+    def test_replica_scoring_bit_identical_glm_and_gbm(self, ring):
+        """Every serving member — home and replica, resolving the model
+        from its blob copy — returns predictions array-equal to the
+        builder's own ``predict``."""
+        clouds, stores = ring
+        by_name = {c.info.name: s for c, s in zip(clouds, stores)}
+        for trainer, seed in ((_train_glm, 21), (_train_gbm, 22)):
+            m, fr = trainer(seed=seed)
+            sf = _score_frame(seed, 80, len(fr.names) - 1)
+            want = m.predict(sf)
+            members = serving.serving_members(m.key, stores[0])
+            assert len(members) == 2
+            for mm in members:
+                store = by_name[mm.info.name]
+                outs = serving.serve_entries(m.key, _wire(sf), store)
+                assert len(outs) == 1 and "error" not in outs[0]
+                from h2o3_tpu.cluster.search import frame_restore
+
+                _assert_frames_equal(
+                    frame_restore(outs[0]["prediction"], store), want)
+
+
+class TestForwarding:
+    def test_forward_from_non_member_front_door(self, ring):
+        """A node holding neither the model nor its blob serves
+        ``forward_predict`` by shipping the bundle to the ring home —
+        results bit-identical to local scoring."""
+        clouds, stores = ring
+        m, fr = _train_glm(seed=31)
+        names = [mm.info.name
+                 for mm in serving.serving_members(m.key, stores[0])]
+        front = next(i for i, c in enumerate(clouds)
+                     if c.info.name not in names)
+        sf = _score_frame(31, 50, 4)
+        stores[front].put("fwd_frame_31", sf)
+        ok0 = _counter("serve_forward_total", result="ok")
+        reqs = [({}, {"model_id": m.key, "frame_id": "fwd_frame_31"})
+                for _ in range(3)]
+        outs = serving.forward_predict(
+            reqs, m.key, cloud=clouds[front], store=stores[front])
+        assert outs is not None and all(isinstance(o, dict) for o in outs)
+        assert _counter("serve_forward_total", result="ok") == ok0 + 3
+        want = m.predict(sf)
+        for o in outs:
+            _assert_frames_equal(_forwarded_pred(stores[front], o), want)
+
+    def test_chunk_homed_frame_forwards_as_dist_reference(self, ring):
+        """A chunk-homed DistFrame crosses the forward as a ``__dist__``
+        reference (no rows on the wire); the home gathers from chunk
+        homes and scores bit-identically to a local parse."""
+        from h2o3_tpu.frame.parse import (
+            _iter_body_chunks, parse_csv, parse_setup,
+        )
+
+        clouds, stores = ring
+        m, _fr = _train_glm(seed=41)
+        rng = np.random.default_rng(41)
+        n = 4000
+        X = rng.normal(size=(n, 4))
+        lines = ["x0,x1,x2,x3"]
+        for i in range(n):
+            lines.append(",".join(repr(float(v)) for v in X[i]))
+        text = "\n".join(lines) + "\n"
+        setup = parse_setup(text)
+        chunks = list(_iter_body_chunks(
+            [text.encode()], 8192, setup.header, setup.skip_blank_lines))
+        dist = ctasks.distributed_parse_chunks(
+            chunks, setup, cloud=clouds[0], key="serve_dist_df")
+        assert len({g["home_name"]
+                    for g in dist.chunk_layout["groups"]}) >= 2
+        payload = frame_payload(dist)
+        assert "__dist__" in payload  # rows never ride the forward
+
+        local = parse_csv(text)
+        want = m.predict(local)
+        names = [mm.info.name
+                 for mm in serving.serving_members(m.key, stores[0])]
+        front = next(i for i, c in enumerate(clouds)
+                     if c.info.name not in names)
+        reqs = [({}, {"model_id": m.key, "frame_id": "serve_dist_df"})]
+        outs = serving.forward_predict(
+            reqs, m.key, cloud=clouds[front], store=stores[front])
+        assert outs is not None and isinstance(outs[0], dict)
+        _assert_frames_equal(_forwarded_pred(stores[front], outs[0]), want)
+
+    def test_forwarded_bundles_coalesce_at_home(self, ring):
+        """The acceptance contract: concurrent forwards from BOTH
+        non-home nodes close into fewer dispatches than requests at the
+        model's home coalescer."""
+        from h2o3_tpu.api.coalesce import _BATCH_SIZE
+
+        clouds, stores = ring
+        m, fr = _train_glm(seed=51)
+        sf = _score_frame(51, 40, 4)
+        members = serving.serving_members(m.key, stores[0])
+        home = members[0].info.name
+        fronts = [i for i, c in enumerate(clouds) if c.info.name != home]
+        per_front = 3
+        for i in fronts:
+            stores[i].put("coal_frame_51", sf)
+
+        # widen the serving coalescer's window so the two bundles land
+        # in one batch even on a loaded single-core runner
+        saved = os.environ.get("H2O3_TPU_BATCH_WINDOW_MS")
+        os.environ["H2O3_TPU_BATCH_WINDOW_MS"] = "75"
+        serving._COAL = None
+        before = _BATCH_SIZE.total_count()
+        results = {}
+        barrier = threading.Barrier(len(fronts))
+
+        def shoot(i):
+            barrier.wait()
+            reqs = [({}, {"model_id": m.key, "frame_id": "coal_frame_51"})
+                    for _ in range(per_front)]
+            results[i] = serving.forward_predict(
+                reqs, m.key, cloud=clouds[i], store=stores[i])
+
+        try:
+            threads = [threading.Thread(target=shoot, args=(i,))
+                       for i in fronts]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            dispatches = _BATCH_SIZE.total_count() - before
+            total = per_front * len(fronts)
+            assert dispatches >= 1
+            assert dispatches < total  # coalesced across front doors
+            want = m.predict(sf)
+            for i in fronts:
+                outs = results[i]
+                assert outs is not None
+                for o in outs:
+                    assert isinstance(o, dict), o
+                    _assert_frames_equal(
+                        _forwarded_pred(stores[i], o), want)
+        finally:
+            if saved is None:
+                os.environ.pop("H2O3_TPU_BATCH_WINDOW_MS", None)
+            else:
+                os.environ["H2O3_TPU_BATCH_WINDOW_MS"] = saved
+            serving._COAL = None
+
+
+class TestSpillAndLadder:
+    def test_shed_home_spills_to_replica(self, ring):
+        """A home past its serving budget answers 429; the front door
+        spills the bundle to the ring replica, which scores the SAME
+        blob bit-identically.  ``serve_replica_spill_total`` proves the
+        path."""
+        clouds, stores = ring
+        by_name = {c.info.name: s for c, s in zip(clouds, stores)}
+        m, fr = _train_glm(seed=61)
+        members = serving.serving_members(m.key, stores[0])
+        home_store = by_name[members[0].info.name]
+        names = [mm.info.name for mm in members]
+        front = next(i for i, c in enumerate(clouds)
+                     if c.info.name not in names)
+        sf = _score_frame(61, 30, 4)
+        stores[front].put("spill_frame_61", sf)
+        spill0 = _counter("serve_replica_spill_total")
+        rep0 = _counter("serve_forward_total", result="replica")
+        home_store._serve_budget = 0
+        try:
+            outs = serving.forward_predict(
+                [({}, {"model_id": m.key, "frame_id": "spill_frame_61"})],
+                m.key, cloud=clouds[front], store=stores[front])
+        finally:
+            home_store._serve_budget = None
+        assert outs is not None and isinstance(outs[0], dict)
+        assert _counter("serve_replica_spill_total") == spill0 + 1
+        assert _counter("serve_forward_total", result="replica") == rep0 + 1
+        _assert_frames_equal(
+            _forwarded_pred(stores[front], outs[0]), m.predict(sf))
+
+    def test_dead_home_fails_over_to_replica(self, ring):
+        """A home refusing its ``predict_remote`` dtask (the chaos-plane
+        death signature) drops the forward down the ladder: the replica
+        serves, ``cluster_fanout_recovered_total{path=replica}`` ticks,
+        and the answer stays bit-identical."""
+        from h2o3_tpu.cluster import faults
+
+        clouds, stores = ring
+        m, fr = _train_glm(seed=71)
+        members = serving.serving_members(m.key, stores[0])
+        names = [mm.info.name for mm in members]
+        front = next(i for i, c in enumerate(clouds)
+                     if c.info.name not in names)
+        sf = _score_frame(71, 30, 4)
+        stores[front].put("ladder_frame_71", sf)
+        rec0 = _counter("cluster_fanout_recovered_total", path="replica")
+        plan = faults.plan_from_dict({"seed": 7, "rules": [
+            {"action": "drop", "side": "server", "src": names[0],
+             "method": "dtask:predict_remote"},
+        ]})
+        faults.set_plan(plan)
+        try:
+            outs = serving.forward_predict(
+                [({}, {"model_id": m.key, "frame_id": "ladder_frame_71"})],
+                m.key, cloud=clouds[front], store=stores[front])
+        finally:
+            faults.clear_plan()
+        assert plan.hits()[0] > 0
+        assert outs is not None and isinstance(outs[0], dict)
+        assert _counter(
+            "cluster_fanout_recovered_total", path="replica") == rec0 + 1
+        _assert_frames_equal(
+            _forwarded_pred(stores[front], outs[0]), m.predict(sf))
+
+
+class TestRestFrontDoor:
+    """The REST surface end-to-end: /3/Predictions on a node that never
+    saw the model, and the 429/Retry-After propagation contract."""
+
+    def _req(self, srv, method, path, data=None):
+        url = srv.url + path
+        body = json.dumps(data).encode() if data is not None else None
+        headers = {"Content-Type": "application/json"} if body else {}
+        req = urllib.request.Request(
+            url, data=body, headers=headers, method=method)
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return resp.status, dict(resp.headers), json.loads(
+                    resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, dict(e.headers), json.loads(e.read())
+
+    def test_predict_forwards_and_429_propagates_retry_after(self, ring):
+        from h2o3_tpu.api.server import H2OServer, _HTTP_SHED
+
+        clouds, stores = ring
+        by_name = {c.info.name: s for c, s in zip(clouds, stores)}
+        m, fr = _train_glm(seed=81)
+        # evict the model from the front door's local DKV: only the ring
+        # blob can serve it now (the trained-elsewhere shape)
+        DKV.remove(m.key)
+        sf = _score_frame(81, 40, 4)
+        stores[0].put("rest_frame_81", sf)
+
+        srv = H2OServer(port=0, http=dict(workers=2)).start()
+        path = f"/3/Predictions/models/{m.key}/frames/rest_frame_81"
+        route = "/3/Predictions/models/{model_id}/frames/{frame_id}"
+        try:
+            st, _hdrs, out = self._req(srv, "POST", path, {
+                "predictions_frame": "rest_pred_81"})
+            assert st == 200, out
+            got = stores[0].get("rest_pred_81")
+            _assert_frames_equal(got, m.predict(sf))
+            assert out["model_metrics"][0]["model"]["name"] == m.key
+
+            # saturate EVERY serving member: the ladder sheds end to end
+            shed0 = _counter("http_shed_total", route=route)
+            front_shed0 = _HTTP_SHED.total()
+            for s in by_name.values():
+                s._serve_budget = 0
+            try:
+                st, hdrs, out = self._req(srv, "POST", path, {})
+            finally:
+                for s in by_name.values():
+                    s._serve_budget = None
+            assert st == 429, out
+            # the home's Retry-After crosses the front door unchanged
+            assert hdrs.get("Retry-After") == "1"
+            # ...and never double-counts against the front door's own
+            # route budget (http_shed_total ticks at REST admission only)
+            assert _counter("http_shed_total", route=route) == shed0
+            assert _HTTP_SHED.total() == front_shed0
+        finally:
+            srv.stop()
