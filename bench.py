@@ -58,10 +58,10 @@ if os.environ.get("BENCH_FORCE_CPU") or "--cache-bench" in sys.argv \
         or "--parse-bench" in sys.argv or "--cluster-bench" in sys.argv \
         or "--chaos-bench" in sys.argv or "--serve-bench" in sys.argv \
         or "--rapids-bench" in sys.argv or "--hist-bench" in sys.argv \
-        or "--obs-bench" in sys.argv:
+        or "--obs-bench" in sys.argv or "--codec-bench" in sys.argv:
     # --cache-bench / --parse-bench / --cluster-bench / --chaos-bench /
-    # --serve-bench / --rapids-bench / --hist-bench / --obs-bench are
-    # CPU-only by construction: same hazard
+    # --serve-bench / --rapids-bench / --hist-bench / --obs-bench /
+    # --codec-bench are CPU-only by construction: same hazard
     for _k in _CACHE_ENV:
         os.environ.pop(_k, None)
 else:
@@ -2479,6 +2479,219 @@ def main() -> None:
     _fail(f"all {ATTEMPTS} attempts failed", last_note)
 
 
+def _codec_bench() -> None:
+    """CPU chunk-codec bench (codec-layer PR acceptance).
+
+    Parses the mixed NUM/CAT/TIME/STR/NUM CSV (~BENCH_CODEC_MB, default
+    96) onto a 2-node in-process cloud twice — codecs on (the default
+    data plane) and ``H2O3_TPU_CODECS=0`` — and prices the layer:
+    resident (ring wire) bytes/row and replica fan-out bytes encoded vs
+    dense, the warm fused Rapids pipeline wall over encoded vs dense
+    chunks, and the parse→fit working set (frame wire bytes + decoded
+    devcache bytes by kind + peak RSS) for a distributed tree fit on the
+    encoded frame.  Asserts IN-RUN that both parses materialize every
+    column bit-identically (uint64 views) and that the encoded resident
+    footprint is at most half the dense one.  Prints ONE JSON line and
+    mirrors it to CODEC_BENCH.json (`--codec-bench`).
+    """
+    import resource
+
+    import numpy as np
+
+    from h2o3_tpu.cluster import dkv as cdkv
+    from h2o3_tpu.cluster import tasks as ctasks
+    from h2o3_tpu.cluster.membership import Cloud, set_local_cloud
+    from h2o3_tpu.frame import codecs as _codecs  # noqa: F401  registers
+    from h2o3_tpu.frame import devcache as _devcache  # the codec meters
+    from h2o3_tpu.frame.frame import ColType
+    from h2o3_tpu.frame.parse import _iter_body_chunks, parse_setup
+    from h2o3_tpu.keyed import KeyedStore
+    from h2o3_tpu.models.tree.gbm import GBM, GBMParameters
+    from h2o3_tpu.rapids.runtime import Session, exec_rapids
+    from h2o3_tpu.util import telemetry
+
+    size_mb = float(os.environ.get("BENCH_CODEC_MB", 96))
+    reps = int(os.environ.get("BENCH_CODEC_REPS", 3))
+    chunk_bytes = int(os.environ.get("H2O3_TPU_PARSE_CHUNK_BYTES",
+                                     2 << 20))
+
+    def _meter(name, **labels):
+        c = telemetry.REGISTRY.get(name)
+        if c is None:
+            return 0.0
+        return sum(s["value"] for s in c.snapshot()["series"]
+                   if all(s["labels"].get(k) == v
+                          for k, v in labels.items()))
+
+    t0 = time.time()
+    text = _parse_bench_csv(size_mb)
+    raw_mb = len(text.encode()) / 1e6
+    print(f"# generated {raw_mb:.1f} MB csv in {time.time() - t0:.1f}s",
+          file=sys.stderr)
+
+    clouds = []
+    for i in range(2):
+        c = Cloud("codecbench", f"cb{i}", hb_interval=0.1)
+        cdkv.install(c, KeyedStore())
+        ctasks.install(c)
+        clouds.append(c)
+    seeds = [c.info.addr for c in clouds]
+    for c in clouds:
+        c.start([a for a in seeds if a != c.info.addr])
+    deadline = time.monotonic() + 20.0
+    while time.monotonic() < deadline and not all(
+            c.size() == 2 for c in clouds):
+        time.sleep(0.02)
+
+    saved = os.environ.get("H2O3_TPU_CODECS")
+    try:
+        set_local_cloud(clouds[0])
+        setup = parse_setup(text)
+        chunks = list(_iter_body_chunks(
+            [text.encode()], chunk_bytes, setup.header,
+            setup.skip_blank_lines))
+
+        def _parse(key, codecs_on):
+            os.environ["H2O3_TPU_CODECS"] = "1" if codecs_on else "0"
+            mix0 = {s["labels"]["codec"]: s["value"] for s in
+                    telemetry.REGISTRY.get("chunk_codec_total")
+                    .snapshot()["series"]} if codecs_on else {}
+            r0 = _meter("cluster_chunk_replica_bytes")
+            t = time.perf_counter()
+            fr = ctasks.distributed_parse_chunks(
+                chunks, setup, cloud=clouds[0], key=key)
+            wall = time.perf_counter() - t
+            mix = {}
+            if codecs_on:
+                for s in (telemetry.REGISTRY.get("chunk_codec_total")
+                          .snapshot()["series"]):
+                    codec = s["labels"]["codec"]
+                    d = s["value"] - mix0.get(codec, 0.0)
+                    if d:
+                        mix[codec] = int(d)
+            return fr, wall, _meter("cluster_chunk_replica_bytes") - r0, mix
+
+        enc, enc_wall, enc_replica, codec_mix = _parse("codec_enc", True)
+        dense, dense_wall, dense_replica, _ = _parse("codec_dense", False)
+        os.environ["H2O3_TPU_CODECS"] = "1"
+        nrows = enc.nrows
+
+        # bit-identity: both chunk-homed parses must materialize every
+        # column to the same bits (uint64 views for numeric, exact codes
+        # + domains for CAT, element equality for STR)
+        bit_identical = True
+        for name in enc.names:
+            a, b = enc.col(name), dense.col(name)
+            if a.type != b.type or a.domain != b.domain:
+                bit_identical = False
+            elif a.data.dtype == object:
+                bit_identical &= all(
+                    x == y for x, y in zip(a.data, b.data))
+            elif a.type in (ColType.NUM, ColType.TIME):
+                bit_identical &= bool(np.array_equal(
+                    a.numeric_view().view(np.uint64),
+                    b.numeric_view().view(np.uint64)))
+            else:
+                bit_identical &= bool(np.array_equal(a.data, b.data))
+
+        # warm fused pipeline over encoded vs dense chunks: drop the
+        # materialized copies so the dist path (group reps + in-program
+        # decode) is what actually runs
+        session = Session()
+        session.assign("ce", enc)
+        session.assign("cd", dense)
+
+        def _pipeline(v):
+            out = exec_rapids(
+                f"(sumNA (* (cols_py {v} 0) (cols_py {v} 4)))", session)
+            return int(np.float64(out.value).view(np.uint64))
+
+        def _warm(v, fr):
+            fr._materialized = None
+            sig = _pipeline(v)  # cold: compiles + uploads + caches
+            best = None
+            for _ in range(max(1, reps)):
+                t = time.perf_counter()
+                assert _pipeline(v) == sig
+                dt = time.perf_counter() - t
+                best = dt if best is None else min(best, dt)
+            return best, sig
+
+        warm_enc_s, sig_enc = _warm("ce", enc)
+        warm_dense_s, sig_dense = _warm("cd", dense)
+        pipeline_identical = sig_enc == sig_dense
+
+        # parse→fit working set: a distributed tree fit straight off the
+        # encoded chunks — what stays resident is the encoded ring copy
+        # plus the byte-budgeted devcache entries, not a dense frame
+        enc._materialized = None
+        t = time.perf_counter()
+        model = GBM(GBMParameters(
+            nbins=16, response_column="count", ntrees=2, max_depth=3,
+            min_rows=10.0, seed=11,
+            ignored_columns=["str"])).train(enc)
+        fit_wall = time.perf_counter() - t
+        assert model is not None
+        fit_cell = {
+            "fit_wall_s": round(fit_wall, 3),
+            "frame_wire_bytes": int(enc.nbytes_wire),
+            "devcache_bytes_by_kind": {
+                k: int(v) for k, v in sorted(
+                    _devcache.DEVCACHE.kind_bytes().items())},
+            "peak_rss_mb": round(
+                resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024,
+                1),
+        }
+
+        resident_ratio = enc.nbytes_wire / max(dense.nbytes_wire, 1)
+        replica_ratio = enc_replica / max(dense_replica, 1.0)
+        result = {
+            "metric": "chunk_codec_resident_ratio",
+            "unit": "x (encoded ring bytes / dense ring bytes, same frame)",
+            "csv_mb": round(raw_mb, 1),
+            "n_rows": nrows,
+            "n_cols": len(enc.names),
+            "resident": {
+                "encoded_bytes_per_row": round(enc.nbytes_wire / nrows, 2),
+                "dense_bytes_per_row": round(dense.nbytes_wire / nrows, 2),
+                "ratio": round(resident_ratio, 4),
+            },
+            "replicas": {
+                "encoded_replica_bytes": int(enc_replica),
+                "dense_replica_bytes": int(dense_replica),
+                "ratio": round(replica_ratio, 4),
+            },
+            "codec_mix": codec_mix,
+            "parse_wall": {"encoded_s": round(enc_wall, 3),
+                           "dense_s": round(dense_wall, 3)},
+            "fused_pipeline": {
+                "warm_encoded_s": round(warm_enc_s, 4),
+                "warm_dense_s": round(warm_dense_s, 4),
+                "bit_identical": pipeline_identical,
+            },
+            "fit_working_set": fit_cell,
+            "bit_identical": bit_identical and pipeline_identical,
+            "resident_ratio_within_half": resident_ratio <= 0.5,
+        }
+        with open(os.path.join(_HERE, "CODEC_BENCH.json"), "w") as f:
+            json.dump(result, f, indent=1)
+        print(json.dumps(result))
+        if not (result["bit_identical"]
+                and result["resident_ratio_within_half"]):
+            sys.exit(1)
+    finally:
+        if saved is None:
+            os.environ.pop("H2O3_TPU_CODECS", None)
+        else:
+            os.environ["H2O3_TPU_CODECS"] = saved
+        set_local_cloud(None)
+        for c in clouds:
+            try:
+                c.stop()
+            except Exception:
+                pass
+
+
 if __name__ == "__main__":
     if "--probe" in sys.argv:
         _probe()
@@ -2500,5 +2713,7 @@ if __name__ == "__main__":
         _hist_bench()
     elif "--obs-bench" in sys.argv:
         _obs_bench()
+    elif "--codec-bench" in sys.argv:
+        _codec_bench()
     else:
         main()

@@ -61,7 +61,7 @@ _METRIC_SECTIONS = ("Observability", "Clustering", "Distributed Frames",
                     "Distributed Rapids", "Distributed model search",
                     "Distributed training", "Failure model", "Serving plane",
                     "Cost ledger & slow-op log", "Cluster profiler",
-                    "Health plane", "Device cache")
+                    "Health plane", "Device cache", "Chunk codecs")
 
 
 def readme_documented_routes(readme_path: str) -> set:
@@ -111,6 +111,7 @@ def live_metrics() -> set:
     import below; list the frame layer explicitly so the lint cannot go
     vacuous if a route stops importing it)."""
     import h2o3_tpu.frame.ingest     # noqa: F401  parse_* / ingest_* meters
+    import h2o3_tpu.frame.codecs     # noqa: F401  chunk_codec_* meters
     import h2o3_tpu.frame.devcache   # noqa: F401  devcache_* meters
     import h2o3_tpu.compute.mapreduce  # noqa: F401  mapreduce_* meters
     import h2o3_tpu.models.framework  # noqa: F401  model_fit_seconds

@@ -81,7 +81,12 @@ class ChunkTooLargeError(ValueError):
 def guard_chunk_payload(chunk_id: str, value: Any) -> int:
     """Size ``value`` as it will cross the wire and raise a typed
     :class:`ChunkTooLargeError` when it cannot fit one transport frame.
-    Returns the measured byte size (the replica-bytes meter reuses it)."""
+    Returns the measured byte size (the replica-bytes meter reuses it).
+
+    Callers landing tokenized chunks MUST pass the ENCODED value (after
+    :func:`h2o3_tpu.frame.codecs.encode_chunk`): the wire carries the
+    encoded bytes, so guarding the dense size would refuse chunks that
+    ship fine — and under-meter the replica fan-out."""
     if isinstance(value, (bytes, bytearray, memoryview)):
         nbytes = len(value)
     else:
@@ -229,6 +234,32 @@ class DistFrame(Frame):
         return int(sum(getattr(c.data, "nbytes", 0)
                        for c in self._materialized))
 
+    def column_rollups(self, name: str):
+        """RollupStats for one NUM/TIME column straight off the ring's
+        ENCODED chunk payloads (rollups.payload_rollups) — no gather, no
+        dense materialization; const/sparse/affine/dict chunks reduce
+        from their own tables.  Other column types (CAT global-domain
+        remap, STR/UUID) take the materializing path."""
+        from h2o3_tpu.frame import rollups as _rollups
+
+        layout = self.chunk_layout
+        j = layout["column_names"].index(name)
+        if self._materialized is None and \
+                layout["column_types"][j] in (ColType.NUM, ColType.TIME):
+            vals = []
+            for g in range(len(layout["groups"])):
+                vals.extend(_fetch_group_chunks(self._store, layout, g))
+            return _rollups.payload_rollups([v[1][j] for v in vals])
+        return self._cols[j].rollups
+
+    @property
+    def nbytes_wire(self) -> int:
+        """ENCODED bytes of this frame's chunks as landed on the ring —
+        the size that replication, spill, and the chunk guard actually
+        see (frame/codecs.py), NOT the dense f64 footprint.  Answers
+        from the layout with no ring traffic."""
+        return int(self.chunk_layout.get("nbytes", 0))
+
     @property
     def nrows(self) -> int:
         return int(self.chunk_layout["espc"][-1])
@@ -331,12 +362,14 @@ def distributed_parse_to_homes(
     def _local_land(i: int, j: int) -> Dict[str, Any]:
         """Caller-side fallback: tokenize here, route the payload to the
         chunk's CURRENT ring home through the store."""
+        from h2o3_tpu.frame import codecs as _codecs
+
         n, payloads, used_native = _parse._parse_chunk(
             chunks[i], setup, na, napack)
-        value = [int(n), payloads, bool(used_native)]
+        doms = [p[1] if isinstance(p, tuple) else None for p in payloads]
+        value = _codecs.encode_chunk([int(n), payloads, bool(used_native)])
         nbytes = guard_chunk_payload(chunk_key(anchors[j], i), value)
         store.put(chunk_key(anchors[j], i), value, replicas=replicas)
-        doms = [p[1] if isinstance(p, tuple) else None for p in payloads]
         return {"nrows": int(n), "domains": doms, "nbytes": nbytes}
 
     with telemetry.Span("distributed_parse_to_homes", chunks=nchunks,
@@ -432,8 +465,13 @@ def materialize(frame):
 
 
 def parse_chunk_home(payload: Dict[str, Any], cloud, store) -> Dict[str, Any]:
-    """Tokenize one chunk ON its home and store the payload locally with
-    replica fan-out; only shape metadata returns to the caller."""
+    """Tokenize one chunk ON its home, ENCODE it through the chunk codec
+    layer (frame/codecs.py — per-column, bit-exact round-trip or dense
+    fallback), and store the encoded payload locally with replica
+    fan-out; only shape metadata returns to the caller.  Replicas carry
+    the same encoded bytes, so write-time durability cost shrinks with
+    the resident footprint."""
+    from h2o3_tpu.frame import codecs as _codecs
     from h2o3_tpu.frame import parse as _parse
 
     setup = payload["setup"]
@@ -441,27 +479,27 @@ def parse_chunk_home(payload: Dict[str, Any], cloud, store) -> Dict[str, Any]:
     napack = _parse._pipeline_napack(setup)
     n, payloads, used_native = _parse._parse_chunk(
         payload["chunk"], setup, na, napack)
-    value = [int(n), payloads, bool(used_native)]
+    doms = [p[1] if isinstance(p, tuple) else None for p in payloads]
+    value = _codecs.encode_chunk([int(n), payloads, bool(used_native)])
     ck = payload["chunk_key"]
     replicas = int(payload.get("replicas", 1))
     nbytes = guard_chunk_payload(ck, value)
     store.put(ck, value, replicas=replicas)
     if replicas > 1:
         _REPLICA_BYTES.inc(nbytes * (replicas - 1))
-    doms = [p[1] if isinstance(p, tuple) else None for p in payloads]
     return {"nrows": int(n), "domains": doms, "nbytes": nbytes,
             "native": bool(used_native)}
 
 
-#: (frame_key, stamp) -> layout, and (frame_key, stamp, g, names) ->
-#: assembled host columns — both bounded LRU so repeated map_reduce over
-#: the same chunk-homed frame re-runs from warm host columns instead of
-#: re-walking the ring per call
+#: (frame_key, stamp) -> layout, bounded LRU so repeated map_reduce over
+#: the same chunk-homed frame re-reads no layout per call.  Assembled
+#: host columns (the DECODED dense working set) moved to the byte-
+#: budgeted device frame cache (devcache.cached_host): decode is
+#: deferred to first compute touch and dense copies are reclaimed under
+#: memory pressure instead of pinned in an entry-counted LRU.
 _CACHE_LOCK = threading.Lock()
 _LAYOUT_CACHE: "OrderedDict[Tuple[str, str], Dict[str, Any]]" = OrderedDict()
-_GROUP_CACHE: "OrderedDict[tuple, Dict[str, np.ndarray]]" = OrderedDict()
 _LAYOUT_CACHE_MAX = 8
-_GROUP_CACHE_MAX = 8
 
 
 def _cache_put(cache: OrderedDict, key, value, cap: int) -> None:
@@ -506,54 +544,113 @@ def _fetch_group_chunks(store, layout: Dict[str, Any], g: int) -> list:
     return vals
 
 
+def _cat_group_codes(vals: list, j: int, name: str,
+                     layout: Dict[str, Any]) -> np.ndarray:
+    """One CAT column's group codes remapped to the layout's GLOBAL
+    domain — the EXACT parse phase-2 arithmetic (decode first: encoded
+    catpack payloads carry the same int32 codes bit-for-bit)."""
+    from h2o3_tpu.frame import codecs as _codecs
+
+    gdl = layout["domains"].get(name) or []
+    gd = np.array(gdl) if gdl else None
+    parts = []
+    for v in vals:
+        codes, dom = _codecs.decode_column(v[1][j])
+        if dom:
+            remap = np.searchsorted(
+                gd, np.array(dom)).astype(np.int32)
+            codes = np.where(
+                codes >= 0, remap[np.clip(codes, 0, None)], NA_CAT
+            ).astype(np.int32)
+        parts.append(codes)
+    return (np.concatenate(parts) if parts
+            else np.empty(0, dtype=np.int32))
+
+
 def columns_from_group(store, layout: Dict[str, Any], g: int,
                        names: Sequence[str]) -> Dict[str, np.ndarray]:
     """Assemble one group's host columns (float64 numeric views) from
     its chunks — local hits on the home/replica holder, ring walk +
     read-repair anywhere else.  CAT codes remap to the layout's GLOBAL
     domain with the same arithmetic as the parse phase-2 merge, so every
-    executor sees the numbers a materializing gather would."""
-    ckey = (layout["frame_key"], layout["stamp"], int(g), tuple(names))
-    with _CACHE_LOCK:
-        cached = _GROUP_CACHE.get(ckey)
-        if cached is not None:
-            _GROUP_CACHE.move_to_end(ckey)
-    if cached is not None:
-        return cached
-    vals = _fetch_group_chunks(store, layout, g)
-    col_names = layout["column_names"]
-    col_types = layout["column_types"]
-    out: Dict[str, np.ndarray] = {}
-    for name in names:
+    executor sees the numbers a materializing gather would.
+
+    Chunks land ENCODED (frame/codecs.py); each referenced column
+    decodes bit-exactly here, and the decoded dense working set lives in
+    the byte-budgeted devcache (kind ``group_columns``) — decode is paid
+    at first compute touch, not at rest, and dense copies are reclaimed
+    under memory pressure while the ring keeps only encoded bytes."""
+    from h2o3_tpu.frame import codecs as _codecs
+    from h2o3_tpu.frame import devcache as _devcache
+
+    token = (layout["frame_key"], layout["stamp"], int(g), tuple(names))
+
+    def build() -> Dict[str, np.ndarray]:
+        vals = _fetch_group_chunks(store, layout, g)
+        col_names = layout["column_names"]
+        col_types = layout["column_types"]
+        out: Dict[str, np.ndarray] = {}
+        for name in names:
+            j = col_names.index(name)
+            ctype = col_types[j]
+            if ctype is ColType.CAT:
+                data = _cat_group_codes(vals, j, name, layout)
+                view = data.astype(np.float64)
+                view[data < 0] = np.nan
+                out[name] = view
+            elif ctype in (ColType.STR, ColType.UUID):
+                raise TypeError(
+                    f"column {name!r} of type {ctype} has no numeric view")
+            else:
+                parts = [np.asarray(_codecs.decode_column(v[1][j]),
+                                    dtype=np.float64) for v in vals]
+                out[name] = (np.concatenate(parts) if parts
+                             else np.empty(0, dtype=np.float64))
+        return out
+
+    return _devcache.cached_host("group_columns", token, (), build,
+                                 frame_key=layout["frame_key"])
+
+
+def group_column_rep(store, layout: Dict[str, Any], g: int,
+                     name: str) -> Tuple:
+    """Codec-aware group rep of ONE numeric/CAT column for the fused
+    executor: ``("dense", f64)`` / ``("const", v, n)`` /
+    ``("affine", codes, offset, scale, sentinel)`` /
+    ``("dict", codes, uniq)`` / ``("f32", data)`` — everything but dense
+    feeds the jitted program as packed codes plus decode arithmetic,
+    with no dense host copy resident.  CAT columns remap to the global
+    domain first and present as affine codes over offset 0, scale 1
+    (their numeric view), re-verified bit-exactly like every rep."""
+    from h2o3_tpu.frame import codecs as _codecs
+    from h2o3_tpu.frame import devcache as _devcache
+
+    token = (layout["frame_key"], layout["stamp"], int(g), name)
+
+    def build() -> Tuple:
+        vals = _fetch_group_chunks(store, layout, g)
+        col_names = layout["column_names"]
         j = col_names.index(name)
-        ctype = col_types[j]
-        if ctype is ColType.CAT:
-            gdl = layout["domains"].get(name) or []
-            gd = np.array(gdl) if gdl else None
-            parts = []
-            for v in vals:
-                codes, dom = v[1][j]
-                if dom:
-                    remap = np.searchsorted(
-                        gd, np.array(dom)).astype(np.int32)
-                    codes = np.where(
-                        codes >= 0, remap[np.clip(codes, 0, None)], NA_CAT
-                    ).astype(np.int32)
-                parts.append(codes)
-            data = (np.concatenate(parts) if parts
-                    else np.empty(0, dtype=np.int32))
-            view = data.astype(np.float64)
-            view[data < 0] = np.nan
-            out[name] = view
-        elif ctype in (ColType.STR, ColType.UUID):
+        ctype = layout["column_types"][j]
+        if ctype in (ColType.STR, ColType.UUID):
             raise TypeError(
                 f"column {name!r} of type {ctype} has no numeric view")
-        else:
-            parts = [np.asarray(v[1][j], dtype=np.float64) for v in vals]
-            out[name] = (np.concatenate(parts) if parts
-                         else np.empty(0, dtype=np.float64))
-    _cache_put(_GROUP_CACHE, ckey, out, _GROUP_CACHE_MAX)
-    return out
+        if ctype is ColType.CAT:
+            data = _cat_group_codes(vals, j, name, layout)
+            view = data.astype(np.float64)
+            view[data < 0] = np.nan
+            if data.size and 0 <= int(data.max(initial=0)) < 65535:
+                codes = np.where(data < 0, 65535, data).astype(np.uint16)
+                out = 0.0 + codes.astype(np.float64) * 1.0
+                out[codes == 65535] = np.nan
+                if np.array_equal(out.view(np.uint64),
+                                  view.view(np.uint64)):
+                    return ("affine", codes, 0.0, 1.0, 65535)
+            return ("dense", view)
+        return _codecs.group_rep([v[1][j] for v in vals])
+
+    return _devcache.cached_host("group_rep", token, (), build,
+                                 frame_key=layout["frame_key"])
 
 
 def mr_chunks(payload: Dict[str, Any], cloud, store) -> Any:
@@ -757,7 +854,9 @@ def layout_health(frame: Frame, cloud=None) -> Optional[Dict[str, Any]]:
     """Chunk layout + replica health for the /3/Frames listing: per
     group, whether the frozen home is still a healthy member and how
     many ring candidates for its anchor are currently alive.  Answers
-    from membership state only — no ring traffic."""
+    from membership state only — no ring traffic.  ``nbytes`` is the
+    ENCODED wire size the chunks actually occupy on the ring
+    (frame/codecs.py), not their dense f64 footprint."""
     layout = getattr(frame, "chunk_layout", None)
     if layout is None:
         return None

@@ -1,0 +1,519 @@
+"""Compressed chunk codecs — the in-memory codec layer under the data plane.
+
+Reference: the platform ships 20+ compressed Chunk representations under
+``water/fvec/`` (C0DChunk constants, C1/C2 biased ints, CXI sparse,
+CCSChunk dictionaries, C4FChunk narrow floats), chosen per chunk in
+``NewChunk.close()`` — host memory, not compute, caps rows per node, so
+the data plane never holds a dense copy it can avoid.  This module is
+that layer for the TPU port's tokenized chunk payloads: every chunk a
+parse lands on its DKV ring home (``cluster/frames.py``) passes through
+:func:`encode_chunk`, and everything downstream — replica fan-out,
+read-repair, map-side execution, fused Rapids programs — moves and holds
+the *encoded* bytes.
+
+The hard contract (enforced, not assumed): a codec is selected for a
+column-chunk only if a literal encode→decode round-trip reproduces the
+dense payload **bit-exactly** (uint64 views for float64, exact int codes
+for CAT, element equality for STR/UUID).  Anything that fails the
+round-trip — NaN payload bits, denormals, values outside a packed range —
+stays dense.  Decoding therefore never changes a result anywhere: the
+bit-identity guarantees of the distributed frame plane are codec
+independent.
+
+Codecs (per column-chunk, numeric unless noted):
+
+======== ==================================================================
+codec    representation
+======== ==================================================================
+const    one 8-byte value broadcast to ``n`` rows (C0DChunk)
+sparse   (int32 index, float64 value) pairs over a +0.0 background (CXI)
+affine   uint8/uint16 codes with ``offset + code * scale`` decode and a
+         reserved NA sentinel (C1Chunk/C2Chunk biased ints, scaled)
+dict     uint8/uint16 codes into a table of unique 64-bit patterns — the
+         decode is a pure gather, bit-exact by construction (CCSChunk)
+f32      float32 storage where the f64→f32→f64 round-trip is exact (C4F)
+catpack  CAT codes biased +1 into uint8/uint16 (NA_CAT → 0)
+strdict  STR/UUID values dictionary-coded into uint32 codes + unique list
+dense    the unencoded payload (fallback; always correct)
+======== ==================================================================
+
+Selection: candidates are generated in the order above, each verified by
+an actual round-trip, and the smallest verified encoding wins — but only
+when its size is at most ``H2O3_TPU_CODEC_MIN_RATIO`` (default 0.75) of
+the dense size; marginal wins are not worth the decode arithmetic.
+``H2O3_TPU_CODECS=0`` disables the layer entirely (every chunk ships and
+lands dense — the pre-codec data plane, byte for byte).
+
+Encoded chunk values keep the store's ``[n, payloads, used_native]``
+shape; an encoded column payload is a plain dict (``{"c": <codec>, ...}``
+holding only python scalars, lists and numpy arrays) so it stays DKV
+routable (``dkv.ROUTABLE_VALUE_TYPES``) and rides replica walk,
+read-repair and anti-entropy sweeps unchanged.
+
+Device decode: the fused-program paths (``rapids/fusion.py`` /
+``rapids/dist_exec.py``) do not decode host-side — a group's column is
+homogenized to one :func:`group_rep` (const / affine / dict / f32) whose
+decode arithmetic is emitted INTO the jitted program (offset/scale as
+traced runtime scalars — never baked constants, which XLA's algebraic
+simplifier could fold through; see fusion._externalize_lits for the
+signed-zero precedent — and dictionary decode as a device gather).
+Homogenizing across chunks re-verifies bit-exactness against the
+per-chunk decode and falls back to dense on any mismatch.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from h2o3_tpu.frame.frame import NA_CAT
+from h2o3_tpu.util import ledger as _ledger
+from h2o3_tpu.util import telemetry
+
+__all__ = [
+    "codecs_enabled",
+    "min_ratio",
+    "encode_chunk",
+    "decode_chunk",
+    "decode_column",
+    "is_encoded_chunk",
+    "is_encoded",
+    "encoded_nbytes",
+    "group_rep",
+]
+
+#: per column-chunk encode decision at land time (dense = fallback kept)
+_CODEC_TOTAL = telemetry.counter(
+    "chunk_codec_total",
+    "column-chunk codec selections at encode time (dense = the chunk "
+    "stayed uncompressed: round-trip failed or the win was marginal)",
+    labels=("codec",),
+)
+#: running resident footprint of encoded payloads by codec
+_RESIDENT_BYTES = telemetry.gauge(
+    "chunk_resident_bytes",
+    "cumulative bytes of column-chunk payloads landed per codec (the "
+    "resident/replicated footprint the codec layer actually stores)",
+    labels=("codec",),
+)
+
+#: NA sentinel per packed-int dtype (the all-ones code is reserved)
+_SENTINEL = {np.dtype(np.uint8): 255, np.dtype(np.uint16): 65535}
+
+
+def codecs_enabled() -> bool:
+    """Kill switch: ``H2O3_TPU_CODECS=0`` lands every chunk dense —
+    byte-for-byte the pre-codec data plane."""
+    return os.environ.get("H2O3_TPU_CODECS", "1").lower() not in (
+        "0", "false", "off")
+
+
+def min_ratio() -> float:
+    """Maximum encoded/dense size ratio worth the decode arithmetic
+    (``H2O3_TPU_CODEC_MIN_RATIO``, default 0.75)."""
+    try:
+        r = float(os.environ.get("H2O3_TPU_CODEC_MIN_RATIO", "0.75"))
+    except ValueError:
+        r = 0.75
+    return min(max(r, 0.0), 1.0)
+
+
+# ---------------------------------------------------------------------------
+# sizing — structural bytes (what the pickled store value is dominated by)
+
+
+def _list_nbytes(vals: Sequence[Any]) -> int:
+    return sum(
+        (len(v) if isinstance(v, str) else 8) + 8 for v in vals)
+
+
+def _payload_nbytes(p: Any) -> int:
+    """Structural bytes of one column payload (dense or encoded)."""
+    if isinstance(p, dict):  # encoded
+        total = 0
+        for v in p.values():
+            if isinstance(v, np.ndarray):
+                if v.dtype == object:
+                    total += _list_nbytes(list(v))
+                else:
+                    total += int(v.nbytes)
+            elif isinstance(v, (list, tuple)):
+                total += _list_nbytes(v)
+            else:
+                total += 8
+        return total
+    if isinstance(p, tuple):  # CAT (codes, domain)
+        return int(p[0].nbytes) + _list_nbytes(p[1])
+    if isinstance(p, np.ndarray):
+        if p.dtype == object:
+            return _list_nbytes([v if v is not None else "" for v in p])
+        return int(p.nbytes)
+    return 8
+
+
+def encoded_nbytes(value: Sequence[Any]) -> int:
+    """Structural bytes of a chunk value ([n, payloads, native]) as the
+    codec layer accounts it — encoded columns at their packed size."""
+    return sum(_payload_nbytes(p) for p in value[1])
+
+
+# ---------------------------------------------------------------------------
+# numeric candidates (float64 payloads: NUM / TIME / BAD)
+
+
+def _bits(x: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(x).view(np.uint64)
+
+
+def _bit_identical(a: np.ndarray, b: np.ndarray) -> bool:
+    return a.shape == b.shape and bool(np.all(_bits(a) == _bits(b)))
+
+
+def _cand_const(x: np.ndarray) -> Optional[Dict[str, Any]]:
+    if np.unique(_bits(x)).size != 1:
+        return None
+    return {"c": "const", "n": int(x.size), "v": x[:1].copy()}
+
+
+def _cand_sparse(x: np.ndarray) -> Optional[Dict[str, Any]]:
+    nz = np.flatnonzero(_bits(x) != 0)
+    # 12 bytes per stored pair; anything denser than ~1/2 never wins
+    if nz.size * 12 >= x.size * 8:
+        return None
+    return {"c": "sparse", "n": int(x.size),
+            "idx": nz.astype(np.int32), "vals": x[nz].copy()}
+
+
+def _cand_affine(x: np.ndarray) -> Optional[Dict[str, Any]]:
+    finite = np.isfinite(x)
+    na = np.isnan(x)
+    if not finite.any() or bool(np.any(~finite & ~na)):
+        return None  # all-NA is const's business; ±inf cannot pack
+    v = x[finite]
+    offset = float(v.min())
+    d = v - offset
+    with np.errstate(invalid="ignore"):
+        if np.all(d == np.floor(d)):
+            scale = 1.0
+        else:
+            u = np.unique(d)
+            u = u[u > 0]
+            if u.size == 0:
+                return None
+            scale = float(u[0])
+            q = d / scale
+            if not np.all(q == np.floor(q)):
+                return None
+        kmax = d.max() / scale
+    if not np.isfinite(kmax):
+        return None
+    for dt in (np.uint8, np.uint16):
+        sent = _SENTINEL[np.dtype(dt)]
+        if kmax < sent:  # the sentinel code itself stays reserved
+            codes = np.full(x.size, sent, dtype=dt)
+            codes[finite] = np.rint(d / scale).astype(dt)
+            return {"c": "affine", "n": int(x.size), "codes": codes,
+                    "offset": offset, "scale": float(scale)}
+    return None
+
+
+def _cand_dict(x: np.ndarray) -> Optional[Dict[str, Any]]:
+    b = _bits(x)
+    uniq_bits, inv = np.unique(b, return_inverse=True)
+    for dt, cap in ((np.uint8, 256), (np.uint16, 65536)):
+        if uniq_bits.size <= cap:
+            return {"c": "dict", "n": int(x.size),
+                    "codes": inv.astype(dt),
+                    "uniq": uniq_bits.view(np.float64).copy()}
+    return None
+
+
+def _cand_f32(x: np.ndarray) -> Optional[Dict[str, Any]]:
+    with np.errstate(over="ignore"):
+        f = x.astype(np.float32)
+    if not _bit_identical(f.astype(np.float64), x):
+        return None
+    return {"c": "f32", "n": int(x.size), "data": f}
+
+
+_NUM_CANDIDATES = (_cand_const, _cand_sparse, _cand_affine, _cand_dict,
+                   _cand_f32)
+
+
+def _decode_numeric(p: Dict[str, Any]) -> np.ndarray:
+    c = p["c"]
+    n = int(p["n"])
+    if c == "const":
+        return np.repeat(np.asarray(p["v"], dtype=np.float64), n)
+    if c == "sparse":
+        out = np.zeros(n, dtype=np.float64)
+        out[np.asarray(p["idx"])] = np.asarray(p["vals"])
+        return out
+    if c == "affine":
+        codes = np.asarray(p["codes"])
+        sent = _SENTINEL[codes.dtype]
+        # the EXACT formula the fused device program emits (offset and
+        # scale as runtime scalars): bit parity host/device rests on both
+        # sides running the same two IEEE f64 ops in the same order
+        out = p["offset"] + codes.astype(np.float64) * p["scale"]
+        out[codes == sent] = np.nan
+        return out
+    if c == "dict":
+        return np.asarray(p["uniq"])[np.asarray(p["codes"])]
+    if c == "f32":
+        return np.asarray(p["data"]).astype(np.float64)
+    raise ValueError(f"unknown numeric codec {c!r}")
+
+
+def _encode_numeric(x: np.ndarray, ratio: float) -> Tuple[Any, str]:
+    x = np.ascontiguousarray(x, dtype=np.float64)
+    if x.size == 0:
+        return x, "dense"
+    dense_nb = int(x.nbytes)
+    best: Optional[Dict[str, Any]] = None
+    best_nb = dense_nb
+    for gen in _NUM_CANDIDATES:
+        try:
+            p = gen(x)
+        except (ValueError, FloatingPointError):
+            p = None
+        if p is None:
+            continue
+        nb = _payload_nbytes(p)
+        if nb < best_nb and _bit_identical(_decode_numeric(p), x):
+            best, best_nb = p, nb
+    if best is None or best_nb > ratio * dense_nb:
+        return x, "dense"
+    return best, best["c"]
+
+
+# ---------------------------------------------------------------------------
+# CAT / STR candidates
+
+
+def _encode_cat(codes: np.ndarray, domain: list,
+                ratio: float) -> Tuple[Any, str]:
+    codes = np.ascontiguousarray(codes, dtype=np.int32)
+    if codes.size == 0:
+        return (codes, domain), "dense"
+    cmax = int(codes.max()) if codes.size else -1
+    if int(codes.min()) < -1:
+        return (codes, domain), "dense"
+    packed = None
+    for dt, cap in ((np.uint8, 255), (np.uint16, 65535)):
+        if cmax + 1 <= cap:
+            packed = (codes + 1).astype(dt)  # NA_CAT (-1) biases to 0
+            break
+    if packed is None or packed.nbytes > ratio * codes.nbytes:
+        return (codes, domain), "dense"
+    p = {"c": "catpack", "n": int(codes.size), "codes": packed,
+         "domain": list(domain)}
+    back = _decode_cat(p)
+    if not (np.array_equal(back[0], codes) and back[1] == list(domain)):
+        return (codes, domain), "dense"
+    return p, "catpack"
+
+
+def _decode_cat(p: Dict[str, Any]) -> Tuple[np.ndarray, list]:
+    codes = np.asarray(p["codes"]).astype(np.int32) - 1
+    codes[codes < 0] = NA_CAT
+    return codes, list(p["domain"])
+
+
+def _encode_str(arr: np.ndarray, ratio: float) -> Tuple[Any, str]:
+    if arr.size == 0:
+        return arr, "dense"
+    table: Dict[Any, int] = {}
+    codes = np.empty(arr.size, dtype=np.uint32)
+    for i, v in enumerate(arr):
+        k = table.get(v)
+        if k is None:
+            k = table[v] = len(table)
+        codes[i] = k
+    uniq = list(table)
+    p = {"c": "strdict", "n": int(arr.size), "codes": codes, "uniq": uniq}
+    if _payload_nbytes(p) > ratio * _payload_nbytes(arr):
+        return arr, "dense"
+    back = _decode_str(p)
+    if not all(a == b for a, b in zip(back, arr)):
+        return arr, "dense"
+    return p, "strdict"
+
+
+def _decode_str(p: Dict[str, Any]) -> np.ndarray:
+    uniq = np.empty(len(p["uniq"]), dtype=object)
+    uniq[:] = list(p["uniq"])
+    return uniq[np.asarray(p["codes"])]
+
+
+# ---------------------------------------------------------------------------
+# chunk-level entry points
+
+
+def is_encoded(payload: Any) -> bool:
+    """True for an encoded column payload (a codec dict)."""
+    return isinstance(payload, dict) and "c" in payload
+
+
+def is_encoded_chunk(value: Sequence[Any]) -> bool:
+    """True when any column payload of a chunk value is encoded."""
+    return any(is_encoded(p) for p in value[1])
+
+
+def encode_chunk(value: Sequence[Any]) -> List[Any]:
+    """Encode a tokenized chunk value ``[n, payloads, used_native]`` per
+    column; meters ``chunk_codec_total{codec}`` and
+    ``chunk_resident_bytes{codec}`` and charges the ledger for the bytes
+    actually landed.  Idempotent: already-encoded payloads pass through
+    unmetered; with codecs disabled the value returns unchanged."""
+    if not codecs_enabled():
+        return list(value)
+    n, payloads, used_native = value[0], value[1], value[2]
+    ratio = min_ratio()
+    out: List[Any] = []
+    for p in payloads:
+        if is_encoded(p):
+            out.append(p)
+            continue
+        if isinstance(p, tuple):  # CAT (codes, domain)
+            enc, codec = _encode_cat(p[0], p[1], ratio)
+        elif isinstance(p, np.ndarray) and p.dtype == object:
+            enc, codec = _encode_str(p, ratio)
+        elif isinstance(p, np.ndarray):
+            enc, codec = _encode_numeric(p, ratio)
+        else:
+            enc, codec = p, "dense"
+        _CODEC_TOTAL.inc(codec=codec)
+        nb = _payload_nbytes(enc)
+        _RESIDENT_BYTES.inc(nb, codec=codec)
+        _ledger.charge(_ledger.CHUNK_ENCODED_BYTES, nb)
+        out.append(enc)
+    return [int(n), out, bool(used_native)]
+
+
+def decode_column(payload: Any) -> Any:
+    """Dense payload from any column payload — encoded dicts decode,
+    plain payloads pass through untouched."""
+    if not is_encoded(payload):
+        return payload
+    c = payload["c"]
+    if c == "catpack":
+        return _decode_cat(payload)
+    if c == "strdict":
+        return _decode_str(payload)
+    return _decode_numeric(payload)
+
+
+def decode_chunk(value: Sequence[Any]) -> List[Any]:
+    """Dense chunk value from a possibly-encoded one (idempotent)."""
+    if not is_encoded_chunk(value):
+        return list(value)
+    return [int(value[0]), [decode_column(p) for p in value[1]],
+            bool(value[2])]
+
+
+# ---------------------------------------------------------------------------
+# group homogenization — ONE decodable rep per (group, column) so the
+# fused executor can emit the decode into the jitted program
+
+
+def _as_affine(p: Dict[str, Any]):
+    """(codes u8/u16, offset, scale, sentinel) view of one encoded
+    payload, or None when the codec has no affine reading."""
+    c = p["c"]
+    if c == "affine":
+        codes = np.asarray(p["codes"])
+        return codes, float(p["offset"]), float(p["scale"]), \
+            _SENTINEL[codes.dtype]
+    return None
+
+
+def group_rep(payloads: Sequence[Any]) -> Tuple:
+    """Homogenize one column's per-chunk payloads (dense float64 arrays
+    and/or numeric codec dicts) into a single group-level rep:
+
+    - ``("const", value_f64_scalar_array, n)`` — every chunk constant on
+      the same bits;
+    - ``("affine", codes_u16, offset, scale, 65535)`` — every chunk
+      affine on one shared scale, codes rebased to a group offset;
+    - ``("dict", codes_u16, uniq_f64)`` — unique 64-bit patterns across
+      the group fit 16-bit codes (pure-gather decode);
+    - ``("f32", data_f32)`` — every chunk stored f32;
+    - ``("dense", data_f64)`` — anything else (including mixed codecs).
+
+    Every non-dense rep is RE-VERIFIED bit-exactly against the per-chunk
+    decode before it is returned — regrouping arithmetic (code rebasing,
+    table unions) must never weaken the chunk-level contract."""
+    dense = [np.ascontiguousarray(decode_column(p), dtype=np.float64)
+             for p in payloads]
+    full = (np.concatenate(dense) if dense
+            else np.empty(0, dtype=np.float64))
+
+    def fallback() -> Tuple:
+        return ("dense", full)
+
+    encs = [p for p in payloads if is_encoded(p)]
+    if len(encs) != len(payloads) or not encs or full.size == 0:
+        return fallback()
+    kinds = {p["c"] for p in encs}
+
+    if kinds == {"const"}:
+        v0 = _bits(np.asarray(encs[0]["v"], dtype=np.float64))
+        if all(np.all(_bits(np.asarray(p["v"], dtype=np.float64)) == v0)
+               for p in encs):
+            rep = ("const", np.asarray(encs[0]["v"], dtype=np.float64),
+                   int(full.size))
+            back = np.repeat(rep[1], full.size)
+            if _bit_identical(back, full):
+                return rep
+        return fallback()
+
+    if kinds == {"f32"}:
+        data = np.concatenate([np.asarray(p["data"], dtype=np.float32)
+                               for p in encs])
+        if _bit_identical(data.astype(np.float64), full):
+            return ("f32", data)
+        return fallback()
+
+    if kinds == {"affine"}:
+        views = [_as_affine(p) for p in encs]
+        scales = {v[2] for v in views}
+        if len(scales) == 1:
+            scale = scales.pop()
+            off_g = min(v[1] for v in views)
+            parts: List[np.ndarray] = []
+            ok = True
+            for codes, off_c, _s, sent in views:
+                shift = (off_c - off_g) / scale if scale else 0.0
+                if shift != np.floor(shift):
+                    ok = False
+                    break
+                c16 = codes.astype(np.uint32) + np.uint32(int(shift))
+                c16[codes == sent] = 65535
+                if c16.max(initial=0) > 65535 or \
+                        bool(np.any(c16[codes != sent] >= 65535)):
+                    ok = False
+                    break
+                parts.append(c16.astype(np.uint16))
+            if ok:
+                codes_g = np.concatenate(parts)
+                out = off_g + codes_g.astype(np.float64) * scale
+                out[codes_g == 65535] = np.nan
+                if _bit_identical(out, full):
+                    return ("affine", codes_g, float(off_g), float(scale),
+                            65535)
+        # fall through: heterogeneous offsets/scales often still share a
+        # small value set — try the dict union below
+        kinds = {"dict"}
+
+    if kinds <= {"dict", "affine", "const", "sparse", "f32"}:
+        uniq_bits = np.unique(_bits(full))
+        if uniq_bits.size <= 65536:
+            codes_g = np.searchsorted(
+                uniq_bits, _bits(full)).astype(np.uint16)
+            uniq = uniq_bits.view(np.float64).copy()
+            if _bit_identical(uniq[codes_g], full):
+                return ("dict", codes_g, uniq)
+    return fallback()

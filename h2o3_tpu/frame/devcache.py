@@ -20,6 +20,13 @@ Memory: entries are LRU in a byte-accounted budget
 (``H2O3_TPU_DEVCACHE_BYTES``, default 1 GiB) so device/host pressure
 reclaims the least recently used placements first. Hit/miss/evict and
 bytes-saved counters flow through the PR 1 telemetry registry.
+
+Chunk codecs (frame/codecs.py) lean on this cache for decode deferral:
+chunks rest ENCODED on the DKV ring, and the decoded dense working set
+(``group_columns`` host dicts, ``group_rep`` packed-code reps) lives
+here — decode is paid at first compute touch and its dense product is
+reclaimable under the same byte budget, so at-rest footprint stays at
+the encoded size.
 """
 
 from __future__ import annotations
@@ -195,6 +202,16 @@ class DeviceFrameCache:
                 "bytes": self._bytes,
                 "max_bytes": self._max_bytes,
             }
+
+    def kind_bytes(self) -> Dict[str, int]:
+        """Resident bytes by placement kind — the chunk-codec bench reads
+        this to report the decoded dense working set (``group_columns`` /
+        ``group_rep`` entries) separately from device placements."""
+        with self._lock:
+            out: Dict[str, int] = {}
+            for entry in self._entries.values():
+                out[entry.kind] = out.get(entry.kind, 0) + entry.nbytes
+            return out
 
     # -- the cache protocol --------------------------------------------------
     def get_or_put(

@@ -802,7 +802,16 @@ def _parse_pipeline(
 
 def _reduce_chunks(results: List[_ChunkResult], setup: ParseSetup) -> Frame:
     """Phase 2: unify per-chunk dictionaries into sorted global domains
-    (reference Categorical.java), remap codes, concatenate columns."""
+    (reference Categorical.java), remap codes, concatenate columns.
+
+    Codec-aware: chunk results read back off the DKV ring may carry
+    ENCODED column payloads (frame/codecs.py — parse lands encoded
+    chunks on their homes); each decodes bit-exactly here, so a
+    materializing gather over encoded chunks is uint64-view identical
+    to a dense local parse."""
+    from h2o3_tpu.frame import codecs as _codecs
+
+    results = [_codecs.decode_chunk(r) for r in results]
     cols: List[Column] = []
     for j, name in enumerate(setup.column_names):
         ctype = setup.column_types[j]

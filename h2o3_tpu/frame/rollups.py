@@ -17,7 +17,7 @@ Cached on the Column object, invalidated by ``Column.invalidate_rollups()``.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional
+from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -59,6 +59,135 @@ def compute_rollups(col: Column) -> RollupStats:
         bool(np.all(np.floor(v) == v)),
         checksum=float(v.sum()),
     )
+
+
+# ---------------------------------------------------------------------------
+# codec-aware rollups: stats straight off ENCODED chunk payloads
+#
+# A chunk-homed column rests encoded on the DKV ring (frame/codecs.py);
+# computing its rollups must not force the dense working set back into
+# host memory.  Each codec yields its moments from its own small tables:
+# const is O(1), sparse touches only the stored non-zeros, affine/dict
+# reduce a bincount over the (≤64Ki) value table, f32/dense stream one
+# transient chunk at a time — the full column is never concatenated.
+# min/max/na/zero/is_int are exact; mean/sigma merge per-chunk partial
+# moments (Chan et al.) and can differ from the single-pass dense result
+# in final-ulp rounding — rollups sit OUTSIDE the codec layer's strict
+# bit-identity contract (that covers materialization, map_reduce,
+# dist_hist, and Rapids results).
+
+
+def _weighted_moments(
+    vals: np.ndarray, counts: np.ndarray
+) -> Tuple[int, int, int, float, float, float, float, bool]:
+    """Moments of a value table with multiplicities (affine/dict codecs):
+    (n_valid, na, zero, mn, mx, mean, m2, is_int)."""
+    vals = np.asarray(vals, dtype=np.float64)
+    counts = np.asarray(counts, dtype=np.int64)
+    ok = ~np.isnan(vals)
+    na = int(counts[~ok].sum())
+    v, c = vals[ok], counts[ok]
+    live = c > 0
+    v, c = v[live], c[live]
+    n = int(c.sum())
+    if n == 0:
+        return 0, na, 0, np.nan, np.nan, np.nan, 0.0, True
+    mean = float((v * c).sum() / n)
+    m2 = float((c * (v - mean) ** 2).sum())
+    return (n, na, int(c[v == 0].sum()), float(v.min()), float(v.max()),
+            mean, m2, bool(np.all(np.floor(v) == v)))
+
+
+def _dense_moments(
+    x: np.ndarray,
+) -> Tuple[int, int, int, float, float, float, float, bool]:
+    ok = ~np.isnan(x)
+    n = int(ok.sum())
+    if n == 0:
+        return 0, int(x.size), 0, np.nan, np.nan, np.nan, 0.0, True
+    v = np.asarray(x[ok], dtype=np.float64)
+    mean = float(v.mean())
+    return (n, int(x.size - n), int((v == 0).sum()), float(v.min()),
+            float(v.max()), mean, float(((v - mean) ** 2).sum()),
+            bool(np.all(np.floor(v) == v)))
+
+
+def _payload_moments(payload):
+    """Per-chunk moments without a dense copy where the codec allows."""
+    if isinstance(payload, dict):
+        c = payload.get("c")
+        if c == "const":
+            v = float(payload["v"][0])
+            n = int(payload["n"])
+            if np.isnan(v):
+                return 0, n, 0, np.nan, np.nan, np.nan, 0.0, True
+            return (n, 0, n if v == 0 else 0, v, v, v, 0.0,
+                    bool(np.floor(v) == v))
+        if c == "sparse":
+            n = int(payload["n"])
+            vals = np.asarray(payload["vals"], dtype=np.float64)
+            nz = n - vals.size  # background +0.0 entries
+            tv = np.concatenate([vals, np.zeros(1)])
+            tc = np.concatenate(
+                [np.ones(vals.size, dtype=np.int64), np.asarray([nz])])
+            return _weighted_moments(tv, tc)
+        if c == "affine":
+            codes = payload["codes"]
+            sent = int(np.iinfo(codes.dtype).max)
+            counts = np.bincount(codes.astype(np.int64),
+                                 minlength=sent + 1)
+            vals = (float(payload["offset"])
+                    + np.arange(sent + 1, dtype=np.float64)
+                    * float(payload["scale"]))
+            vals[sent] = np.nan  # the reserved NA sentinel
+            return _weighted_moments(vals, counts)
+        if c == "dict":
+            codes = payload["codes"]
+            uniq = np.asarray(payload["uniq"], dtype=np.float64)
+            counts = np.bincount(codes.astype(np.int64),
+                                 minlength=uniq.size)
+            return _weighted_moments(uniq, counts)
+        if c == "f32":
+            return _dense_moments(
+                np.asarray(payload["data"], dtype=np.float64))
+        # unknown codec: literal decode, still one chunk at a time
+        from h2o3_tpu.frame import codecs as _codecs
+
+        return _dense_moments(
+            np.asarray(_codecs.decode_column(payload), dtype=np.float64))
+    return _dense_moments(np.asarray(payload, dtype=np.float64))
+
+
+def payload_rollups(payloads: Sequence) -> RollupStats:
+    """RollupStats for one numeric/TIME column from its per-chunk
+    payloads (encoded dicts or dense f64 arrays), merging per-chunk
+    partial moments — no whole-column dense materialization."""
+    n = na = zero = 0
+    mn, mx = np.inf, -np.inf
+    mean = 0.0
+    m2 = 0.0
+    is_int = True
+    for p in payloads:
+        cn, cna, czero, cmn, cmx, cmean, cm2, cint = _payload_moments(p)
+        na += cna
+        zero += czero
+        if cn == 0:
+            continue
+        mn, mx = min(mn, cmn), max(mx, cmx)
+        is_int = is_int and cint
+        if n == 0:
+            n, mean, m2 = cn, cmean, cm2
+        else:
+            tot = n + cn
+            delta = cmean - mean
+            m2 = m2 + cm2 + delta * delta * n * cn / tot
+            mean = mean + delta * cn / tot
+            n = tot
+    if n == 0:
+        return RollupStats(np.nan, np.nan, np.nan, np.nan, na, 0, True)
+    sigma = float(np.sqrt(m2 / (n - 1))) if n > 1 else 0.0
+    return RollupStats(mn, mx, mean, sigma, na, zero, is_int,
+                       checksum=mean * n)
 
 
 def histogram(col: Column, nbins: int = 64) -> np.ndarray:

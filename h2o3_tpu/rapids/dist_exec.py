@@ -49,7 +49,6 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-import jax.numpy as jnp
 from jax.experimental import enable_x64
 
 from h2o3_tpu.cluster import frames as _frames
@@ -57,9 +56,10 @@ from h2o3_tpu.cluster import rpc as _rpc
 from h2o3_tpu.cluster.dkv import MAX_REPLICAS
 from h2o3_tpu.compute.mapreduce import FrameTable, gather_rows, map_batches, \
     plan_memo
+from h2o3_tpu.frame import codecs as _codecs
 from h2o3_tpu.frame import devcache as _devcache
-from h2o3_tpu.frame.frame import ColType, Column, Frame, NA_CAT
-from h2o3_tpu.parallel.mesh import default_mesh
+from h2o3_tpu.frame.frame import ColType, NA_CAT
+from h2o3_tpu.parallel.mesh import default_mesh, row_mask, shard_rows
 from h2o3_tpu.rapids import fusion as _fusion
 from h2o3_tpu.rapids.parser import AstId, canonical_sexpr
 from h2o3_tpu.rapids.runtime import Val
@@ -192,18 +192,54 @@ def _context(base_frame):
 # home-side executor (the rapids_exec ctx-DTask body)
 
 
-def _group_frame(layout: Dict[str, Any], g: int, names: Tuple[str, ...],
-                 arrays: Dict[str, np.ndarray]) -> Frame:
-    """The group's columns as a host Frame with STABLE Column identity —
-    cached in the device cache's host store so a warm repeat presents the
-    same version stamps to FrameTable.from_frame and uploads nothing."""
-    token = (layout["frame_key"], layout["stamp"], int(g), names)
+def _rep_inputs(refs, layouts: Dict[int, Dict[str, Any]], g: int,
+                base_svals: List[Any], store):
+    """Codec-aware device inputs for one group's referenced columns.
 
-    def build() -> Frame:
-        return Frame([Column(nm, arrays[nm], ColType.NUM) for nm in names])
-
-    return _devcache.cached_host("rapids_group_frame", token, (), build,
-                                 frame_key=layout["frame_key"])
+    Each referenced column homogenizes to one chunk-codec group rep
+    (cluster/frames.group_column_rep) and the rep — not a dense f64
+    column — becomes the program input: packed u16 codes (affine/dict),
+    f32 storage, or nothing at all (const columns ride a scalar slot).
+    Returns ``(decode, run_svals, uploads)`` where ``decode`` maps akeys
+    to the specs _make_fn emits arithmetic for, ``run_svals`` extends the
+    plan's scalar slots with decode params (offset/scale/const values as
+    TRACED runtime args, dict tables as replicated trailing arrays), and
+    ``uploads`` lists ``(li, name, akey, host_data, pad_fill)``."""
+    reps = {}
+    for li, x in refs:
+        reps[(int(li), x)] = _frames.group_column_rep(
+            store, layouts[int(li)], g, x)
+    if reps and all(r[0] == "const" for r in reps.values()):
+        # the shard shapes need at least one row-sharded array: demote
+        # one all-const rep to its (tiny) dense broadcast
+        k0 = next(iter(reps))
+        rep0 = reps[k0]
+        reps[k0] = ("dense", np.repeat(
+            np.asarray(rep0[1], dtype=np.float64), int(rep0[2])))
+    decode: Dict[str, Tuple] = {}
+    run_svals = list(base_svals)
+    uploads: List[Tuple] = []
+    for (li, x), rep in reps.items():
+        akey = _fusion._akey(li, x)
+        kind = rep[0]
+        if kind == "const":
+            decode[akey] = ("const", len(run_svals))
+            run_svals.append(float(rep[1][0]))
+        elif kind == "affine":
+            decode[akey] = ("affine", len(run_svals), len(run_svals) + 1,
+                            int(rep[4]))
+            run_svals.extend([float(rep[2]), float(rep[3])])
+            uploads.append((li, x, akey, rep[1], int(rep[4])))
+        elif kind == "dict":
+            decode[akey] = ("dict", len(run_svals))
+            run_svals.append(np.ascontiguousarray(rep[2]))
+            uploads.append((li, x, akey, rep[1], 0))
+        elif kind == "f32":
+            decode[akey] = ("f32",)
+            uploads.append((li, x, akey, rep[1], np.nan))
+        else:
+            uploads.append((li, x, akey, rep[1], np.nan))
+    return decode, run_svals, uploads
 
 
 def _partial(reduce_name: str, d: np.ndarray) -> Dict[str, Any]:
@@ -253,11 +289,25 @@ def rapids_exec(payload: Dict[str, Any], cloud, store) -> Dict[str, Any]:
     lo, hi = int(grp["lo"]), int(grp["hi"])
     n = int(espc[hi]) - int(espc[lo])
 
+    # dense host columns only where a dense copy is genuinely needed:
+    # pass-through outputs and filter masks.  Program INPUTS go through
+    # the codec rep path below instead — no dense working set for them.
+    host_names: Dict[int, List[str]] = {}
+
+    def _need_host(li: int, nm: str) -> None:
+        cols = host_names.setdefault(int(li), [])
+        if nm not in cols:
+            cols.append(nm)
+
+    for out in payload["outputs"]:
+        if out[0] == "host":
+            _need_host(int(out[1]), out[2])
+    _flt = payload.get("filter")
+    if _flt is not None:
+        _need_host(int(_flt["li"]), _flt["name"])
     host: Dict[int, Dict[str, np.ndarray]] = {}
-    for li, lay in layouts.items():
-        names = list(payload["names"].get(li) or ())
-        if names:
-            host[li] = _frames.columns_from_group(store, lay, g, names)
+    for li, nms in host_names.items():
+        host[li] = _frames.columns_from_group(store, layouts[li], g, nms)
 
     dev_host: List[np.ndarray] = []
     dev_exprs = tuple(payload.get("dev_exprs") or ())
@@ -267,32 +317,45 @@ def rapids_exec(payload: Dict[str, Any], cloud, store) -> Dict[str, Any]:
         refs = [tuple(r) for r in payload["refs"]]
         svals = [float(s) for s in payload["svals"]]
         if n > 0:
-            fn = plan_memo("rapids_dist", ("fn",) + tuple(payload["key"]),
-                           lambda: _fusion._make_fn(dev_exprs))
             mesh = default_mesh()
-            ref_lis = list(dict.fromkeys(li for li, _ in refs))
+            decode, run_svals, uploads = _rep_inputs(
+                refs, layouts, g, svals, store)
+            # the program is memoized per decode signature too: the same
+            # region over differently-encoded frames (or the dense
+            # H2O3_TPU_CODECS=0 plane) must not share a compiled decode
+            dsig = tuple(sorted(
+                (ak,) + tuple(s for s in sp) for ak, sp in decode.items()))
+            fn = plan_memo(
+                "rapids_dist",
+                ("fn",) + tuple(payload["key"]) + (dsig,),
+                lambda: _fusion._make_fn(dev_exprs,
+                                         tuple(decode.items())))
             # one multi-device program at a time in this process — XLA:CPU
             # wedges on concurrent launches from several server threads
             with _tasks._SHARD_EXEC_LOCK:
                 with enable_x64():
                     merged: Dict[str, Any] = {}
                     mask = None
-                    for li in ref_lis:
-                        nm = [x for l2, x in refs if l2 == li]
-                        frm = _group_frame(layouts[li], g, tuple(nm),
-                                           host[li])
-                        t = FrameTable.from_frame(
-                            frm, columns=nm, mesh=mesh,
-                            dtype=jnp.float64, cache=True)
-                        for x in nm:
-                            merged[_fusion._akey(li, x)] = t.arrays[x]
-                        mask = t.mask
+                    for li, x, akey, data, fill in uploads:
+                        lay = layouts[li]
+                        token = (lay["frame_key"], lay["stamp"], int(g),
+                                 x, decode.get(akey, ("dense",))[0])
+
+                        def build(d=data, f=fill):
+                            return shard_rows(np.asarray(d), mesh,
+                                              fill=f)[0]
+
+                        arr = _devcache.cached(
+                            "rapids_rep_arr", token, (), mesh, build,
+                            frame_key=lay["frame_key"])
+                        merged[akey] = arr
+                        mask = row_mask(n, int(arr.shape[0]), mesh)
                     table = FrameTable(merged, mask, n, mesh)
                     # _SHARD_EXEC_LOCK exists to serialize shard
                     # execution: XLA:CPU multi-device collectives
                     # deadlock when dispatched from concurrent threads
                     # h2o3: noqa[LOCK001]
-                    outs = map_batches(fn, table, *svals)
+                    outs = map_batches(fn, table, *run_svals)
                 dev_host = [np.asarray(gather_rows(o, n)).copy()
                             for o in outs]
         else:
@@ -351,7 +414,9 @@ def rapids_exec(payload: Dict[str, Any], cloud, store) -> Dict[str, Any]:
                 pls.append((codes, list(domains.get(nm2) or [])))
             else:
                 pls.append(np.ascontiguousarray(seg, dtype=np.float64))
-        value = [ni, pls, False]
+        # derived chunks land ENCODED exactly like parsed ones: the wire
+        # guard, replica fan-out and layout nbytes all see codec bytes
+        value = _codecs.encode_chunk([ni, pls, False])
         ck = _frames.chunk_key(w["anchor"], i)
         nbytes += _frames.guard_chunk_payload(ck, value)
         store.put(ck, value, replicas=replicas)

@@ -483,12 +483,50 @@ def _akey(li: int, name: str) -> str:
     return f"{li}:{name}"
 
 
-def _make_fn(dev_exprs: Tuple):
+def _make_fn(dev_exprs: Tuple, decode: Tuple = ()):
     """The jitted column-program. ONE closure per cached plan: map_batches
     keys its shard_map plan on this function's identity, so a warm repeat
-    re-traces and re-compiles nothing."""
+    re-traces and re-compiles nothing.
+
+    ``decode`` maps column-ref akeys to chunk-codec decode specs
+    (frame/codecs.py group reps) so ENCODED columns feed the program as
+    packed codes with the decode arithmetic emitted INTO the trace — XLA
+    fuses decompress-into-compute and no dense host copy ever exists:
+
+    - ``("affine", off_slot, scale_slot, sentinel)`` — the input array
+      holds u16 codes; decode is ``off + codes.astype(f64) * scale``
+      (offset/scale as TRACED scalar slots, never baked constants — the
+      _externalize_lits signed-zero rule applies to decode params too)
+      with sentinel codes mapping to NaN;
+    - ``("dict", table_slot)`` — u16 codes gather into a replicated
+      unique-value table riding as a trailing map_batches arg (bit-exact
+      by construction);
+    - ``("const", val_slot)`` — the column never ships: its value
+      broadcasts from a scalar slot;
+    - ``("f32",)`` — f32 storage widens in-trace (exact by selection);
+    - absent / ``("dense",)`` — the array is plain f64."""
+    dec = dict(decode)
 
     def fused_program(arrays, mask, *svals):
+        def col(li, name):
+            akey = _akey(li, name)
+            spec = dec.get(akey)
+            if spec is None or spec[0] == "dense":
+                return arrays[akey]
+            kind = spec[0]
+            if kind == "f32":
+                return arrays[akey].astype(jnp.float64)
+            if kind == "const":
+                return jnp.full(mask.shape, svals[spec[1]],
+                                dtype=jnp.float64)
+            if kind == "affine":
+                c = arrays[akey]
+                x = svals[spec[1]] + c.astype(jnp.float64) * svals[spec[2]]
+                return jnp.where(c == spec[3], jnp.nan, x)
+            if kind == "dict":
+                return jnp.take(svals[spec[1]], arrays[akey])
+            raise ValueError(f"unknown decode spec {kind!r}")
+
         def ev(e):
             tag = e[0]
             if tag == "lit":
@@ -496,7 +534,7 @@ def _make_fn(dev_exprs: Tuple):
             if tag == "sval":
                 return svals[e[1]]
             if tag == "colref":
-                return arrays[_akey(e[1], e[2])]
+                return col(e[1], e[2])
             spec = FUSIBLE[e[1]]
             return spec.emit(jnp, *[ev(x) for x in e[2:]])
 

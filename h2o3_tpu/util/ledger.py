@@ -72,6 +72,7 @@ __all__ = [
     "SEARCH_CELL_SECONDS",
     "HIST_LEVEL_WALL",
     "RAPIDS_PARTIAL_BYTES",
+    "CHUNK_ENCODED_BYTES",
 ]
 
 #: the closed category vocabulary — one constant per choke point, so the
@@ -88,6 +89,7 @@ COALESCE_SHARE_SECONDS = "coalesce_share_seconds"
 SEARCH_CELL_SECONDS = "search_cell_seconds"
 HIST_LEVEL_WALL = "hist_level_wall"
 RAPIDS_PARTIAL_BYTES = "rapids_partial_bytes"
+CHUNK_ENCODED_BYTES = "chunk_encoded_bytes"
 
 _CHARGES = telemetry.counter(
     "ledger_charges_total",

@@ -618,6 +618,23 @@ def s_kill_chunk_home(seed: int) -> Dict[str, bool]:
         dist2 = _tasks.distributed_map_reduce(mr_stat, fr, cloud=a)
         v["post_restart_mr_bit_identical"] = (
             _tree_bytes(local) == _tree_bytes(dist2))
+
+        # -- codec plane: chunks (and their replicas) rest ENCODED on
+        # the ring, and a full materialization — here necessarily read
+        # through replica/ring-walk bytes after the home died — decodes
+        # bit-identically to the serial parse ---------------------------
+        from h2o3_tpu.frame import codecs as _codecs
+
+        grp0 = lay["groups"][0]
+        enc_val = stores[0].get(chunk_key(grp0["anchor"], int(grp0["lo"])))
+        v["chunks_landed_encoded"] = (
+            _codecs.codecs_enabled() and enc_val is not None
+            and _codecs.is_encoded_chunk(enc_val))
+        v["replica_decode_bit_identical"] = all(
+            np.array_equal(
+                fr.col(nm).numeric_view().view(np.uint64),
+                serial.col(nm).numeric_view().view(np.uint64))
+            for nm in ("x", "y", "c"))
         v["rehome_observable"] = _wait(
             lambda: (
                 _counter_value("cluster_dkv_read_repair_total") > repairs0
